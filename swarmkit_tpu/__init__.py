@@ -1,0 +1,10 @@
+"""swarmkit_tpu — a TPU-native cluster-orchestration framework.
+
+Capabilities of moby/swarmkit, re-designed TPU-first: a host-side control
+plane (replicated store, orchestrators, dispatcher, agents, CA) around a
+JAX/XLA scheduling kernel that evaluates the per-task filter pipeline and
+spread scorer as batched tasks×nodes array programs, sharded over a device
+mesh for large clusters.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from .types import *  # noqa: F401,F403
+from .specs import *  # noqa: F401,F403
+from .objects import *  # noqa: F401,F403

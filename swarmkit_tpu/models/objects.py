@@ -1,0 +1,331 @@
+"""Top-level store objects (reference: api/objects.proto).
+
+Every store object has an ``id``, a ``Meta`` (store version + timestamps), a
+user ``spec`` and system-owned runtime state.  ``collection`` names the store
+table; ``copy()`` produces the deep copy the store keeps on write so readers
+can treat returned objects as immutable snapshots.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .specs import (
+    ClusterSpec,
+    ConfigSpec,
+    ExtensionSpec,
+    NetworkSpec,
+    NodeSpec,
+    SecretSpec,
+    ServiceSpec,
+    TaskSpec,
+    VolumeSpec,
+)
+from .types import (
+    Annotations,
+    Driver,
+    Endpoint,
+    EncryptionKey,
+    GenericResource,
+    IPAMOptions,
+    JoinTokens,
+    NetworkAttachment,
+    NodeCSIInfo,
+    NodeDescription,
+    NodeStatus,
+    RaftMemberStatus,
+    TaskState,
+    TaskStatus,
+    TopologyRequirement,
+    UpdateStatus,
+    Version,
+    VolumeAttachment,
+    VolumePublishStatus,
+    now,
+)
+
+
+@dataclass
+class Meta:
+    """Store metadata (reference: api/objects.proto:17)."""
+
+    version: Version = field(default_factory=Version)
+    created_at: float = 0.0
+    updated_at: float = 0.0
+
+    def copy(self) -> "Meta":
+        return Meta(self.version.copy(), self.created_at, self.updated_at)
+
+
+@dataclass
+class Node:
+    """reference: api/objects.proto:28"""
+
+    collection = "nodes"
+
+    id: str = ""
+    meta: Meta = field(default_factory=Meta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    description: Optional[NodeDescription] = None
+    status: NodeStatus = field(default_factory=NodeStatus)
+    manager_status: Optional[RaftMemberStatus] = None
+    attachments: List[NetworkAttachment] = field(default_factory=list)
+    certificate: Optional[bytes] = None
+    role: int = 0               # observed role (reconciled towards spec)
+    vxlan_udp_port: int = 0
+
+    def copy(self) -> "Node":
+        return Node(
+            self.id, self.meta.copy(), self.spec.copy(),
+            self.description.copy() if self.description else None,
+            self.status.copy(),
+            dataclasses.replace(self.manager_status) if self.manager_status else None,
+            [a.copy() for a in self.attachments],
+            self.certificate, self.role, self.vxlan_udp_port)
+
+
+@dataclass
+class Service:
+    """reference: api/objects.proto:90"""
+
+    collection = "services"
+
+    id: str = ""
+    meta: Meta = field(default_factory=Meta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+    spec_version: Optional[Version] = None
+    previous_spec: Optional[ServiceSpec] = None
+    previous_spec_version: Optional[Version] = None
+    endpoint: Optional[Endpoint] = None
+    update_status: Optional[UpdateStatus] = None
+    job_status: Optional["JobStatus"] = None
+    pending_delete: bool = False
+
+    def copy(self) -> "Service":
+        return Service(
+            self.id, self.meta.copy(), self.spec.copy(),
+            self.spec_version.copy() if self.spec_version else None,
+            self.previous_spec.copy() if self.previous_spec else None,
+            self.previous_spec_version.copy() if self.previous_spec_version else None,
+            self.endpoint.copy() if self.endpoint else None,
+            self.update_status.copy() if self.update_status else None,
+            dataclasses.replace(self.job_status) if self.job_status else None,
+            self.pending_delete)
+
+
+@dataclass
+class JobStatus:
+    """Status of a job-mode service (reference: api/objects.proto)."""
+
+    job_iteration: Version = field(default_factory=Version)
+    last_execution: float = 0.0
+
+
+@dataclass
+class Task:
+    """reference: api/objects.proto:183"""
+
+    collection = "tasks"
+
+    id: str = ""
+    meta: Meta = field(default_factory=Meta)
+    spec: TaskSpec = field(default_factory=TaskSpec)
+    spec_version: Optional[Version] = None
+    service_id: str = ""
+    slot: int = 0
+    node_id: str = ""
+    annotations: Annotations = field(default_factory=Annotations)
+    service_annotations: Annotations = field(default_factory=Annotations)
+    status: TaskStatus = field(default_factory=TaskStatus)
+    desired_state: TaskState = TaskState.NEW
+    networks: List[NetworkAttachment] = field(default_factory=list)
+    endpoint: Optional[Endpoint] = None
+    log_driver: Optional[Driver] = None
+    assigned_generic_resources: List[GenericResource] = field(default_factory=list)
+    job_iteration: Optional[Version] = None
+    volumes: List[VolumeAttachment] = field(default_factory=list)
+
+    def copy(self) -> "Task":
+        # Specs are immutable once attached to a task (the system "never
+        # modifies" a spec — api/objects.proto:203); sharing the reference
+        # makes task copies cheap on the scheduler/dispatcher hot paths.
+        # Anyone changing a task's spec must attach a *new* spec object.
+        return Task(
+            self.id, self.meta.copy(), self.spec,
+            self.spec_version.copy() if self.spec_version else None,
+            self.service_id, self.slot, self.node_id,
+            self.annotations.copy(), self.service_annotations.copy(),
+            self.status.copy(), self.desired_state,
+            [n.copy() for n in self.networks],
+            self.endpoint.copy() if self.endpoint else None,
+            self.log_driver.copy() if self.log_driver else None,
+            list(self.assigned_generic_resources),
+            self.job_iteration.copy() if self.job_iteration else None,
+            [v.copy() for v in self.volumes])
+
+
+@dataclass
+class Network:
+    """reference: api/objects.proto:297"""
+
+    collection = "networks"
+
+    id: str = ""
+    meta: Meta = field(default_factory=Meta)
+    spec: NetworkSpec = field(default_factory=NetworkSpec)
+    driver_state: Optional[Driver] = None
+    ipam: Optional[IPAMOptions] = None
+    pending_delete: bool = False
+
+    def copy(self) -> "Network":
+        return Network(
+            self.id, self.meta.copy(), self.spec.copy(),
+            self.driver_state.copy() if self.driver_state else None,
+            self.ipam.copy() if self.ipam else None,
+            self.pending_delete)
+
+
+@dataclass
+class Cluster:
+    """reference: api/objects.proto:343"""
+
+    collection = "clusters"
+
+    id: str = ""
+    meta: Meta = field(default_factory=Meta)
+    spec: ClusterSpec = field(default_factory=ClusterSpec)
+    root_ca: Optional["RootCAState"] = None
+    network_bootstrap_keys: List[EncryptionKey] = field(default_factory=list)
+    encryption_key_lamport_clock: int = 0
+    unlock_keys: List[EncryptionKey] = field(default_factory=list)
+    fips: bool = False
+    default_address_pool: List[str] = field(default_factory=list)
+    subnet_size: int = 24
+    vxlan_udp_port: int = 4789
+
+    def copy(self) -> "Cluster":
+        return Cluster(
+            self.id, self.meta.copy(), self.spec.copy(),
+            dataclasses.replace(self.root_ca) if self.root_ca else None,
+            list(self.network_bootstrap_keys),
+            self.encryption_key_lamport_clock,
+            list(self.unlock_keys), self.fips,
+            list(self.default_address_pool), self.subnet_size,
+            self.vxlan_udp_port)
+
+
+@dataclass
+class RootCAState:
+    """Cluster CA material (reference: api/types.proto:936)."""
+
+    ca_key: bytes = b""
+    ca_cert: bytes = b""
+    cross_signed_ca_cert: bytes = b""
+    join_tokens: JoinTokens = field(default_factory=JoinTokens)
+    root_rotation_in_progress: bool = False
+    last_forced_rotation: int = 0
+
+
+@dataclass
+class Secret:
+    collection = "secrets"
+
+    id: str = ""
+    meta: Meta = field(default_factory=Meta)
+    spec: SecretSpec = field(default_factory=SecretSpec)
+    internal: bool = False
+
+    def copy(self) -> "Secret":
+        return Secret(self.id, self.meta.copy(), self.spec.copy(),
+                      self.internal)
+
+
+@dataclass
+class Config:
+    collection = "configs"
+
+    id: str = ""
+    meta: Meta = field(default_factory=Meta)
+    spec: ConfigSpec = field(default_factory=ConfigSpec)
+
+    def copy(self) -> "Config":
+        return Config(self.id, self.meta.copy(), self.spec.copy())
+
+
+@dataclass
+class Volume:
+    """CSI volume (reference: api/objects.proto:526)."""
+
+    collection = "volumes"
+
+    id: str = ""
+    meta: Meta = field(default_factory=Meta)
+    spec: VolumeSpec = field(default_factory=VolumeSpec)
+    publish_status: List[VolumePublishStatus] = field(default_factory=list)
+    volume_info: Optional["VolumeInfo"] = None
+    pending_delete: bool = False
+
+    def copy(self) -> "Volume":
+        return Volume(
+            self.id, self.meta.copy(), self.spec.copy(),
+            [p.copy() for p in self.publish_status],
+            dataclasses.replace(self.volume_info) if self.volume_info else None,
+            self.pending_delete)
+
+
+@dataclass
+class VolumeInfo:
+    capacity_bytes: int = 0
+    volume_context: Dict[str, str] = field(default_factory=dict)
+    volume_id: str = ""   # plugin-side id
+    accessible_topology: List[Dict[str, str]] = field(default_factory=list)
+
+
+@dataclass
+class Extension:
+    """Custom object-type registration (reference: api/objects.proto:487)."""
+
+    collection = "extensions"
+
+    id: str = ""
+    meta: Meta = field(default_factory=Meta)
+    annotations: Annotations = field(default_factory=Annotations)
+    description: str = ""
+
+    def copy(self) -> "Extension":
+        return Extension(self.id, self.meta.copy(), self.annotations.copy(),
+                         self.description)
+
+    @property
+    def spec(self) -> ExtensionSpec:  # uniform access for the store
+        return ExtensionSpec(self.annotations, self.description)
+
+
+@dataclass
+class Resource:
+    """Custom object instance of an Extension kind
+    (reference: api/objects.proto:456)."""
+
+    collection = "resources"
+
+    id: str = ""
+    meta: Meta = field(default_factory=Meta)
+    annotations: Annotations = field(default_factory=Annotations)
+    kind: str = ""
+    payload: bytes = b""
+
+    def copy(self) -> "Resource":
+        return Resource(self.id, self.meta.copy(), self.annotations.copy(),
+                        self.kind, self.payload)
+
+    @property
+    def spec(self):  # uniform access for the store
+        return self
+
+
+STORE_OBJECT_TYPES = (Node, Service, Task, Network, Cluster, Secret, Config,
+                      Volume, Extension, Resource)
+
+__all__ = [name for name in dir() if not name.startswith("_")]

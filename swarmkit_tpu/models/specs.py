@@ -1,0 +1,321 @@
+"""User-authored desired-state specs (reference: api/specs.proto).
+
+A spec is what the user writes; the system never modifies it.  Objects carry a
+spec plus system-owned runtime state (see objects.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .types import (
+    Annotations,
+    CAConfig,
+    ConfigReference,
+    DispatcherConfig,
+    Driver,
+    EncryptionConfig,
+    EndpointSpec,
+    GenericResource,
+    IPAMOptions,
+    Mount,
+    NetworkAttachmentConfig,
+    NodeAvailability,
+    NodeRole,
+    OrchestrationConfig,
+    Placement,
+    Platform,
+    RaftConfig,
+    ResourceRequirements,
+    RestartPolicy,
+    SecretReference,
+    TaskDefaults,
+    TopologyRequirement,
+    UpdateConfig,
+    VolumeAccessMode,
+)
+
+
+@dataclass
+class NodeSpec:
+    """reference: api/specs.proto:21"""
+
+    annotations: Annotations = field(default_factory=Annotations)
+    desired_role: NodeRole = NodeRole.WORKER
+    membership: int = 1  # NodeMembership.ACCEPTED
+    availability: NodeAvailability = NodeAvailability.ACTIVE
+
+    def copy(self) -> "NodeSpec":
+        return NodeSpec(self.annotations.copy(), self.desired_role,
+                        self.membership, self.availability)
+
+
+class ServiceMode(enum.IntEnum):
+    REPLICATED = 0
+    GLOBAL = 1
+    REPLICATED_JOB = 2
+    GLOBAL_JOB = 3
+
+
+@dataclass
+class ReplicatedService:
+    replicas: int = 1
+
+
+@dataclass
+class GlobalService:
+    pass
+
+
+@dataclass
+class ReplicatedJob:
+    """Run-to-completion job (reference: api/specs.proto:106)."""
+
+    max_concurrent: int = 0       # 0 = same as total_completions
+    total_completions: int = 1
+
+
+@dataclass
+class GlobalJob:
+    pass
+
+
+@dataclass
+class HealthConfig:
+    test: List[str] = field(default_factory=list)
+    interval: float = 0.0
+    timeout: float = 0.0
+    retries: int = 0
+    start_period: float = 0.0
+
+
+@dataclass
+class ContainerSpec:
+    """Container runtime parameters (reference: api/specs.proto:188).
+
+    Trimmed to the fields the orchestration layer actually consumes; the
+    executor receives the whole spec and may interpret more.
+    """
+
+    image: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    hostname: str = ""
+    env: List[str] = field(default_factory=list)
+    dir: str = ""
+    user: str = ""
+    groups: List[str] = field(default_factory=list)
+    tty: bool = False
+    open_stdin: bool = False
+    read_only: bool = False
+    stop_signal: str = ""
+    stop_grace_period: float = 10.0
+    mounts: List[Mount] = field(default_factory=list)
+    secrets: List[SecretReference] = field(default_factory=list)
+    configs: List[ConfigReference] = field(default_factory=list)
+    hosts: List[str] = field(default_factory=list)
+    healthcheck: Optional[HealthConfig] = None
+    isolation: str = ""
+    init: Optional[bool] = None
+    sysctls: Dict[str, str] = field(default_factory=dict)
+    capability_add: List[str] = field(default_factory=list)
+    capability_drop: List[str] = field(default_factory=list)
+    ulimits: Dict[str, int] = field(default_factory=dict)
+
+    def copy(self) -> "ContainerSpec":
+        return dataclasses.replace(
+            self,
+            labels=dict(self.labels), command=list(self.command),
+            args=list(self.args), env=list(self.env),
+            groups=list(self.groups),
+            mounts=[m.copy() for m in self.mounts],
+            secrets=list(self.secrets), configs=list(self.configs),
+            hosts=list(self.hosts), sysctls=dict(self.sysctls),
+            capability_add=list(self.capability_add),
+            capability_drop=list(self.capability_drop),
+            ulimits=dict(self.ulimits))
+
+
+@dataclass
+class GenericRuntimeSpec:
+    kind: str = ""
+    payload: bytes = b""
+
+
+@dataclass
+class NetworkAttachmentSpec:
+    """Task is a network-attachment pseudo-task
+    (reference: api/specs.proto:180)."""
+
+    container_id: str = ""
+
+
+@dataclass
+class TaskSpec:
+    """reference: api/specs.proto:124.
+
+    Exactly one of (container, generic_runtime, attachment) is the runtime.
+    """
+
+    container: Optional[ContainerSpec] = None
+    generic_runtime: Optional[GenericRuntimeSpec] = None
+    attachment: Optional[NetworkAttachmentSpec] = None
+
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    restart: RestartPolicy = field(default_factory=RestartPolicy)
+    placement: Placement = field(default_factory=Placement)
+    log_driver: Optional[Driver] = None
+    networks: List[NetworkAttachmentConfig] = field(default_factory=list)
+    force_update: int = 0   # counter: bump to force task replacement
+    resource_references: List[str] = field(default_factory=list)
+
+    def copy(self) -> "TaskSpec":
+        return TaskSpec(
+            container=self.container.copy() if self.container else None,
+            generic_runtime=self.generic_runtime,
+            attachment=self.attachment,
+            resources=self.resources.copy(),
+            restart=self.restart.copy(),
+            placement=self.placement.copy(),
+            log_driver=self.log_driver.copy() if self.log_driver else None,
+            networks=[n.copy() for n in self.networks],
+            force_update=self.force_update,
+            resource_references=list(self.resource_references))
+
+
+@dataclass
+class ServiceSpec:
+    """reference: api/specs.proto:63"""
+
+    annotations: Annotations = field(default_factory=Annotations)
+    task: TaskSpec = field(default_factory=TaskSpec)
+    mode: ServiceMode = ServiceMode.REPLICATED
+    replicated: Optional[ReplicatedService] = None
+    replicated_job: Optional[ReplicatedJob] = None
+    update: Optional[UpdateConfig] = None
+    rollback: Optional[UpdateConfig] = None
+    networks: List[NetworkAttachmentConfig] = field(default_factory=list)
+    endpoint: Optional[EndpointSpec] = None
+
+    def replicas(self) -> int:
+        if self.mode == ServiceMode.REPLICATED:
+            return self.replicated.replicas if self.replicated else 1
+        raise ValueError("replicas() only valid for replicated services")
+
+    def copy(self) -> "ServiceSpec":
+        return ServiceSpec(
+            annotations=self.annotations.copy(),
+            task=self.task.copy(),
+            mode=self.mode,
+            replicated=dataclasses.replace(self.replicated) if self.replicated else None,
+            replicated_job=dataclasses.replace(self.replicated_job) if self.replicated_job else None,
+            update=self.update.copy() if self.update else None,
+            rollback=self.rollback.copy() if self.rollback else None,
+            networks=[n.copy() for n in self.networks],
+            endpoint=self.endpoint.copy() if self.endpoint else None)
+
+
+@dataclass
+class NetworkSpec:
+    """reference: api/specs.proto:412"""
+
+    annotations: Annotations = field(default_factory=Annotations)
+    driver_config: Optional[Driver] = None
+    ipv6_enabled: bool = False
+    internal: bool = False
+    ipam: Optional[IPAMOptions] = None
+    attachable: bool = False
+    ingress: bool = False
+
+    def copy(self) -> "NetworkSpec":
+        return NetworkSpec(
+            self.annotations.copy(),
+            self.driver_config.copy() if self.driver_config else None,
+            self.ipv6_enabled, self.internal,
+            self.ipam.copy() if self.ipam else None,
+            self.attachable, self.ingress)
+
+
+@dataclass
+class ClusterSpec:
+    """reference: api/specs.proto:453"""
+
+    annotations: Annotations = field(default_factory=Annotations)
+    acceptance_policy: Dict[str, str] = field(default_factory=dict)  # legacy
+    orchestration: OrchestrationConfig = field(default_factory=OrchestrationConfig)
+    raft: RaftConfig = field(default_factory=RaftConfig)
+    dispatcher: DispatcherConfig = field(default_factory=DispatcherConfig)
+    ca_config: CAConfig = field(default_factory=CAConfig)
+    task_defaults: TaskDefaults = field(default_factory=TaskDefaults)
+    encryption_config: EncryptionConfig = field(default_factory=EncryptionConfig)
+
+    def copy(self) -> "ClusterSpec":
+        return ClusterSpec(
+            self.annotations.copy(), dict(self.acceptance_policy),
+            self.orchestration.copy(), self.raft.copy(),
+            self.dispatcher.copy(), self.ca_config.copy(),
+            self.task_defaults.copy(), self.encryption_config.copy())
+
+
+@dataclass
+class SecretSpec:
+    annotations: Annotations = field(default_factory=Annotations)
+    data: bytes = b""
+    templating: Optional[Driver] = None
+    driver: Optional[Driver] = None
+
+    def copy(self) -> "SecretSpec":
+        return SecretSpec(self.annotations.copy(), self.data,
+                          self.templating.copy() if self.templating else None,
+                          self.driver.copy() if self.driver else None)
+
+
+@dataclass
+class ConfigSpec:
+    annotations: Annotations = field(default_factory=Annotations)
+    data: bytes = b""
+    templating: Optional[Driver] = None
+
+    def copy(self) -> "ConfigSpec":
+        return ConfigSpec(self.annotations.copy(), self.data,
+                          self.templating.copy() if self.templating else None)
+
+
+@dataclass
+class VolumeSpec:
+    """CSI volume spec (reference: api/specs.proto:515)."""
+
+    annotations: Annotations = field(default_factory=Annotations)
+    group: str = ""
+    driver: Optional[Driver] = None
+    access_mode: VolumeAccessMode = field(default_factory=VolumeAccessMode)
+    secrets: Dict[str, str] = field(default_factory=dict)
+    accessibility_requirements: Optional[TopologyRequirement] = None
+    capacity_min: int = 0
+    capacity_max: int = 0
+    availability: int = 0  # VolumeAvailability
+
+    def copy(self) -> "VolumeSpec":
+        return VolumeSpec(
+            self.annotations.copy(), self.group,
+            self.driver.copy() if self.driver else None,
+            self.access_mode.copy(), dict(self.secrets),
+            self.accessibility_requirements.copy()
+            if self.accessibility_requirements else None,
+            self.capacity_min, self.capacity_max, self.availability)
+
+
+@dataclass
+class ExtensionSpec:
+    annotations: Annotations = field(default_factory=Annotations)
+    description: str = ""
+
+    def copy(self) -> "ExtensionSpec":
+        return ExtensionSpec(self.annotations.copy(), self.description)
+
+
+__all__ = [name for name in dir() if not name.startswith("_")]

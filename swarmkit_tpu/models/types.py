@@ -1,0 +1,645 @@
+"""Core value types of the cluster data model.
+
+These are idiomatic-Python equivalents of the reference's protobuf value types
+(reference: api/types.proto).  They are plain dataclasses: the control plane is
+host-side and never touches the device, so there is no reason for protobuf
+codegen here.  Serialization goes through ``to_dict``/``from_dict`` (see
+serde.py) for snapshots, the WAL, and the wire.
+
+Design notes
+------------
+* ``TaskState`` is a lamport-ordered IntEnum exactly like the reference
+  (api/types.proto:510-557): a task only ever moves to a *greater* state, and
+  gaps are left between values for future insertion.
+* Resources are normalized at the edge: CPUs in nano-CPUs (int), memory in
+  bytes (int), matching the reference's resource accounting
+  (api/types.proto:68-77).  The TPU scheduler path converts these to float32
+  SoA arrays; the host oracle uses them directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+def now() -> float:
+    return time.time()
+
+
+class TaskState(enum.IntEnum):
+    """Monotonic task lifecycle state (reference: api/types.proto:510).
+
+    Values keep the reference's 64-wide gaps so orderings (and any on-disk
+    data) stay comparable across versions.
+    """
+
+    NEW = 0
+    PENDING = 64      # waiting for allocation / scheduling decision
+    ASSIGNED = 192    # scheduler picked a node
+    ACCEPTED = 256    # accepted by an agent
+    PREPARING = 320
+    READY = 384
+    STARTING = 448
+    RUNNING = 512
+    COMPLETE = 576    # terminal: ran to successful completion
+    SHUTDOWN = 640    # terminal: orchestrator requested shutdown
+    FAILED = 704      # terminal: execution failed
+    REJECTED = 768    # terminal: never ran (e.g. node-side setup failed)
+    REMOVE = 800      # marked for deletion once shut down (desired state only)
+    ORPHANED = 832    # node unresponsive >24h; resources freed
+
+
+TERMINAL_STATES = frozenset(
+    {TaskState.COMPLETE, TaskState.SHUTDOWN, TaskState.FAILED,
+     TaskState.REJECTED, TaskState.ORPHANED}
+)
+
+
+class NodeRole(enum.IntEnum):
+    WORKER = 0
+    MANAGER = 1
+
+
+class NodeMembership(enum.IntEnum):
+    PENDING = 0
+    ACCEPTED = 1
+
+
+class NodeAvailability(enum.IntEnum):
+    ACTIVE = 0   # accept new tasks
+    PAUSE = 1    # no new tasks; existing keep running
+    DRAIN = 2    # no new tasks; existing are rescheduled away
+
+
+class NodeState(enum.IntEnum):
+    UNKNOWN = 0
+    DOWN = 1
+    READY = 2
+    DISCONNECTED = 3
+
+
+@dataclass
+class Version:
+    """Optimistic-concurrency version: the store index at last write
+    (reference: api/types.proto:14)."""
+
+    index: int = 0
+
+    def copy(self) -> "Version":
+        return Version(self.index)
+
+
+@dataclass
+class Annotations:
+    name: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    indices: Dict[str, str] = field(default_factory=dict)  # custom indexes
+
+    def copy(self) -> "Annotations":
+        return Annotations(self.name, dict(self.labels), dict(self.indices))
+
+
+class GenericResourceKind(enum.IntEnum):
+    DISCRETE = 0  # a count, e.g. gpu=4
+    NAMED = 1     # a named unit of a set, e.g. gpu=uuid1
+
+
+@dataclass(frozen=True)
+class GenericResource:
+    """A custom node resource (reference: api/types.proto:38-59).
+
+    Discrete resources carry a count in ``value``; named resources carry the
+    unit id in ``value_str``.
+    """
+
+    kind: str                  # resource kind, e.g. "gpu", "fpga"
+    value: int = 0             # count (DISCRETE)
+    value_str: str = ""        # unit name (NAMED)
+    res_type: GenericResourceKind = GenericResourceKind.DISCRETE
+
+
+@dataclass
+class Resources:
+    """Normalized resources (reference: api/types.proto:68).
+
+    nano_cpus: 1e-9 CPUs so integer math is exact (3.5 CPUs == 3_500_000_000).
+    memory_bytes: bytes.
+    generic: custom resources (GPUs etc.).
+    """
+
+    nano_cpus: int = 0
+    memory_bytes: int = 0
+    generic: List[GenericResource] = field(default_factory=list)
+
+    def copy(self) -> "Resources":
+        return Resources(self.nano_cpus, self.memory_bytes, list(self.generic))
+
+
+@dataclass
+class ResourceRequirements:
+    reservations: Optional[Resources] = None
+    limits: Optional[Resources] = None
+
+    def copy(self) -> "ResourceRequirements":
+        return ResourceRequirements(
+            self.reservations.copy() if self.reservations else None,
+            self.limits.copy() if self.limits else None,
+        )
+
+
+@dataclass
+class Platform:
+    architecture: str = ""
+    os: str = ""
+
+    def copy(self) -> "Platform":
+        return Platform(self.architecture, self.os)
+
+
+@dataclass
+class PluginDescription:
+    type: str = ""   # "Volume" | "Network" | "Log" | csi plugin name...
+    name: str = ""
+
+
+@dataclass
+class EngineDescription:
+    engine_version: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    plugins: List[PluginDescription] = field(default_factory=list)
+
+    def copy(self) -> "EngineDescription":
+        return EngineDescription(self.engine_version, dict(self.labels),
+                                 list(self.plugins))
+
+
+@dataclass
+class NodeCSIInfo:
+    plugin_name: str = ""
+    node_id: str = ""
+    max_volumes_per_node: int = 0
+    accessible_topology: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class NodeDescription:
+    """What a node reports about itself (reference: api/types.proto:127)."""
+
+    hostname: str = ""
+    platform: Platform = field(default_factory=Platform)
+    resources: Resources = field(default_factory=Resources)
+    engine: EngineDescription = field(default_factory=EngineDescription)
+    tls_info: Optional["NodeTLSInfo"] = None
+    fips: bool = False
+    csi_info: List[NodeCSIInfo] = field(default_factory=list)
+
+    def copy(self) -> "NodeDescription":
+        return NodeDescription(
+            self.hostname, self.platform.copy(), self.resources.copy(),
+            self.engine.copy(), self.tls_info, list(self.csi_info))
+
+
+@dataclass
+class NodeTLSInfo:
+    trust_root: bytes = b""
+    cert_issuer_subject: bytes = b""
+    cert_issuer_public_key: bytes = b""
+
+
+@dataclass
+class NodeStatus:
+    state: NodeState = NodeState.UNKNOWN
+    message: str = ""
+    addr: str = ""
+
+    def copy(self) -> "NodeStatus":
+        return NodeStatus(self.state, self.message, self.addr)
+
+
+@dataclass
+class RaftMemberStatus:
+    leader: bool = False
+    reachability: int = 0  # 0 unknown / 1 unreachable / 2 reachable
+    message: str = ""
+
+
+class RestartCondition(enum.IntEnum):
+    NONE = 0
+    ON_FAILURE = 1
+    ANY = 2
+
+
+@dataclass
+class RestartPolicy:
+    """reference: api/types.proto:380"""
+
+    condition: RestartCondition = RestartCondition.ANY
+    delay: float = 5.0            # seconds between restarts
+    max_attempts: int = 0         # 0 = unlimited (within window)
+    window: float = 0.0           # seconds; 0 = unbounded attempt window
+
+    def copy(self) -> "RestartPolicy":
+        return dataclasses.replace(self)
+
+
+class UpdateFailureAction(enum.IntEnum):
+    PAUSE = 0
+    CONTINUE = 1
+    ROLLBACK = 2
+
+
+class UpdateOrder(enum.IntEnum):
+    STOP_FIRST = 0
+    START_FIRST = 1
+
+
+@dataclass
+class UpdateConfig:
+    """Rolling-update knobs (reference: api/types.proto:407)."""
+
+    parallelism: int = 0          # 0 = all at once
+    delay: float = 0.0            # seconds between batches
+    failure_action: UpdateFailureAction = UpdateFailureAction.PAUSE
+    monitor: float = 30.0         # seconds to monitor each task for failure
+    max_failure_ratio: float = 0.0
+    order: UpdateOrder = UpdateOrder.STOP_FIRST
+
+    def copy(self) -> "UpdateConfig":
+        return dataclasses.replace(self)
+
+
+class UpdateState(enum.IntEnum):
+    UNKNOWN = 0
+    UPDATING = 1
+    PAUSED = 2
+    COMPLETED = 3
+    ROLLBACK_STARTED = 4
+    ROLLBACK_PAUSED = 5
+    ROLLBACK_COMPLETED = 6
+
+
+@dataclass
+class UpdateStatus:
+    state: UpdateState = UpdateState.UNKNOWN
+    started_at: float = 0.0
+    completed_at: float = 0.0
+    message: str = ""
+
+    def copy(self) -> "UpdateStatus":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class ContainerStatus:
+    container_id: str = ""
+    pid: int = 0
+    exit_code: int = 0
+
+
+@dataclass
+class PortStatus:
+    ports: List["PortConfig"] = field(default_factory=list)
+
+
+@dataclass
+class TaskStatus:
+    """Observed task state (reference: api/types.proto:572)."""
+
+    timestamp: float = 0.0
+    state: TaskState = TaskState.NEW
+    message: str = ""
+    err: str = ""
+    container: Optional[ContainerStatus] = None
+    port_status: Optional[PortStatus] = None
+    applied_by: str = ""   # node that reported this status
+    applied_at: float = 0.0
+
+    def copy(self) -> "TaskStatus":
+        return dataclasses.replace(self)
+
+
+class PortProtocol(enum.IntEnum):
+    TCP = 0
+    UDP = 1
+    SCTP = 2
+
+
+class PublishMode(enum.IntEnum):
+    INGRESS = 0  # routing-mesh: port reserved on every node
+    HOST = 1     # published directly on the host the task lands on
+
+
+@dataclass(frozen=True)
+class PortConfig:
+    """reference: api/types.proto:682"""
+
+    name: str = ""
+    protocol: PortProtocol = PortProtocol.TCP
+    target_port: int = 0
+    published_port: int = 0
+    publish_mode: PublishMode = PublishMode.INGRESS
+
+
+class EndpointResolutionMode(enum.IntEnum):
+    VIP = 0
+    DNSRR = 1
+
+
+@dataclass
+class EndpointSpec:
+    mode: EndpointResolutionMode = EndpointResolutionMode.VIP
+    ports: List[PortConfig] = field(default_factory=list)
+
+    def copy(self) -> "EndpointSpec":
+        return EndpointSpec(self.mode, list(self.ports))
+
+
+@dataclass
+class EndpointVIP:
+    network_id: str = ""
+    addr: str = ""
+
+
+@dataclass
+class Endpoint:
+    """Runtime endpoint state attached to services/tasks
+    (reference: api/objects.proto:147)."""
+
+    spec: EndpointSpec = field(default_factory=EndpointSpec)
+    ports: List[PortConfig] = field(default_factory=list)
+    virtual_ips: List[EndpointVIP] = field(default_factory=list)
+
+    def copy(self) -> "Endpoint":
+        return Endpoint(self.spec.copy(), list(self.ports),
+                        list(self.virtual_ips))
+
+
+@dataclass(frozen=True)
+class SpreadOver:
+    spread_descriptor: str = ""   # e.g. "node.labels.datacenter"
+
+
+@dataclass(frozen=True)
+class PlacementPreference:
+    spread: Optional[SpreadOver] = None
+
+
+@dataclass
+class Placement:
+    """reference: api/types.proto:909"""
+
+    constraints: List[str] = field(default_factory=list)  # "key==value" exprs
+    preferences: List[PlacementPreference] = field(default_factory=list)
+    platforms: List[Platform] = field(default_factory=list)
+    max_replicas: int = 0   # per-node cap; 0 = unlimited
+
+    def copy(self) -> "Placement":
+        return Placement(list(self.constraints), list(self.preferences),
+                         [p.copy() for p in self.platforms], self.max_replicas)
+
+
+@dataclass
+class Driver:
+    name: str = ""
+    options: Dict[str, str] = field(default_factory=dict)
+
+    def copy(self) -> "Driver":
+        return Driver(self.name, dict(self.options))
+
+
+@dataclass
+class JoinTokens:
+    worker: str = ""
+    manager: str = ""
+
+    def copy(self) -> "JoinTokens":
+        return JoinTokens(self.worker, self.manager)
+
+
+@dataclass
+class EncryptionKey:
+    subsystem: str = ""
+    algorithm: int = 0
+    key: bytes = b""
+    lamport_time: int = 0
+
+
+@dataclass
+class CAConfig:
+    node_cert_expiry: float = 90 * 24 * 3600.0  # seconds
+    external_cas: List[str] = field(default_factory=list)
+    signing_ca_cert: bytes = b""
+    signing_ca_key: bytes = b""
+    force_rotate: int = 0
+
+    def copy(self) -> "CAConfig":
+        return dataclasses.replace(self, external_cas=list(self.external_cas))
+
+
+@dataclass
+class OrchestrationConfig:
+    task_history_retention_limit: int = 5
+
+    def copy(self) -> "OrchestrationConfig":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class DispatcherConfig:
+    heartbeat_period: float = 5.0
+
+    def copy(self) -> "DispatcherConfig":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class RaftConfig:
+    snapshot_interval: int = 10000
+    keep_old_snapshots: int = 0
+    log_entries_for_slow_followers: int = 500
+    heartbeat_tick: int = 1
+    election_tick: int = 3
+
+    def copy(self) -> "RaftConfig":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class EncryptionConfig:
+    auto_lock_managers: bool = False
+
+    def copy(self) -> "EncryptionConfig":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class TaskDefaults:
+    log_driver: Optional[Driver] = None
+
+    def copy(self) -> "TaskDefaults":
+        return TaskDefaults(self.log_driver.copy() if self.log_driver else None)
+
+
+# ---------------------------------------------------------------------------
+# Volumes (CSI)
+# ---------------------------------------------------------------------------
+
+class VolumeAccessScope(enum.IntEnum):
+    SINGLE_NODE = 0
+    MULTI_NODE = 1
+
+
+class VolumeSharing(enum.IntEnum):
+    NONE = 0
+    READONLY = 1
+    ONEWRITER = 2
+    ALL = 3
+
+
+class VolumeAvailability(enum.IntEnum):
+    ACTIVE = 0
+    PAUSE = 1
+    DRAIN = 2
+
+
+@dataclass
+class VolumeAccessMode:
+    scope: VolumeAccessScope = VolumeAccessScope.SINGLE_NODE
+    sharing: VolumeSharing = VolumeSharing.NONE
+    block: bool = False  # block device vs mount
+
+    def copy(self) -> "VolumeAccessMode":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class TopologyRequirement:
+    requisite: List[Dict[str, str]] = field(default_factory=list)
+    preferred: List[Dict[str, str]] = field(default_factory=list)
+
+    def copy(self) -> "TopologyRequirement":
+        return TopologyRequirement([dict(t) for t in self.requisite],
+                                   [dict(t) for t in self.preferred])
+
+
+@dataclass
+class VolumePublishStatus:
+    class State(enum.IntEnum):
+        PENDING_PUBLISH = 0
+        PUBLISHED = 1
+        PENDING_NODE_UNPUBLISH = 2
+        PENDING_UNPUBLISH = 3
+
+    node_id: str = ""
+    state: "VolumePublishStatus.State" = 0  # type: ignore[assignment]
+    publish_context: Dict[str, str] = field(default_factory=dict)
+    message: str = ""
+
+    def copy(self) -> "VolumePublishStatus":
+        return VolumePublishStatus(self.node_id, self.state,
+                                   dict(self.publish_context), self.message)
+
+
+@dataclass
+class VolumeAttachment:
+    id: str = ""       # volume object id
+    source: str = ""   # mount source as given in the task spec
+    target: str = ""   # mount target
+
+    def copy(self) -> "VolumeAttachment":
+        return dataclasses.replace(self)
+
+
+class MountType(enum.IntEnum):
+    BIND = 0
+    VOLUME = 1
+    TMPFS = 2
+    NPIPE = 3
+    CSI = 4
+
+
+@dataclass
+class Mount:
+    type: MountType = MountType.VOLUME
+    source: str = ""
+    target: str = ""
+    readonly: bool = False
+    volume_driver: str = ""   # driver name for VOLUME mounts
+
+    def copy(self) -> "Mount":
+        return dataclasses.replace(self)
+
+
+# ---------------------------------------------------------------------------
+# Networks
+# ---------------------------------------------------------------------------
+
+@dataclass
+class IPAMConfig:
+    family: int = 4
+    subnet: str = ""
+    range: str = ""
+    gateway: str = ""
+    reserved: Dict[str, str] = field(default_factory=dict)
+
+    def copy(self) -> "IPAMConfig":
+        return dataclasses.replace(self, reserved=dict(self.reserved))
+
+
+@dataclass
+class IPAMOptions:
+    driver: Optional[Driver] = None
+    configs: List[IPAMConfig] = field(default_factory=list)
+
+    def copy(self) -> "IPAMOptions":
+        return IPAMOptions(self.driver.copy() if self.driver else None,
+                           [c.copy() for c in self.configs])
+
+
+@dataclass
+class NetworkAttachmentConfig:
+    target: str = ""  # network id or name
+    aliases: List[str] = field(default_factory=list)
+    addresses: List[str] = field(default_factory=list)
+    driver_attachment_opts: Dict[str, str] = field(default_factory=dict)
+
+    def copy(self) -> "NetworkAttachmentConfig":
+        return NetworkAttachmentConfig(self.target, list(self.aliases),
+                                       list(self.addresses),
+                                       dict(self.driver_attachment_opts))
+
+
+@dataclass
+class NetworkAttachment:
+    network_id: str = ""
+    addresses: List[str] = field(default_factory=list)
+    aliases: List[str] = field(default_factory=list)
+
+    def copy(self) -> "NetworkAttachment":
+        return NetworkAttachment(self.network_id, list(self.addresses),
+                                 list(self.aliases))
+
+
+# ---------------------------------------------------------------------------
+# Secrets / configs references
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class SecretReference:
+    secret_id: str = ""
+    secret_name: str = ""
+    target: str = ""   # filename in the container
+
+
+@dataclass(frozen=True)
+class ConfigReference:
+    config_id: str = ""
+    config_name: str = ""
+    target: str = ""
+
+
+__all__ = [name for name in dir() if not name.startswith("_")]

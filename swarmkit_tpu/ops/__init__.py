@@ -1,0 +1,6 @@
+from .hashing import str_hash
+from .kernel import (
+    GroupInputs, NodeInputs, feasibility_and_capacity, plan_group,
+    plan_group_jit, seg_waterfill,
+)
+from .planner import TPUPlanner

@@ -1,0 +1,26 @@
+"""Stable string hashing for on-device label/constraint matching.
+
+Constraint matching is case-insensitive full-string equality (reference:
+manager/constraint/constraint.go:85-counterpart), so strings can be replaced
+by stable 63-bit hashes: equality of hashes == equality of strings up to a
+2^-63 collision probability per pair.  Python's builtin hash() is salted per
+process, so we use blake2b.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+# hash of the empty string is special-cased to 0 so "label absent" and
+# "label == ''" coincide, matching reference semantics where a missing
+# label behaves as the empty string.
+EMPTY = 0
+
+
+def str_hash(s: str) -> int:
+    """Stable 63-bit hash of a string, case-insensitive. '' -> 0."""
+    if s == "":
+        return EMPTY
+    digest = hashlib.blake2b(s.lower().encode(), digest_size=8).digest()
+    value = int.from_bytes(digest, "little") & 0x7FFF_FFFF_FFFF_FFFF
+    return value or 1  # avoid colliding a real string with EMPTY

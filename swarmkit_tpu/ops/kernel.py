@@ -1,0 +1,296 @@
+"""The TPU scheduling kernel: batched group placement as array programs.
+
+This is the device-side replacement for the reference's hot loops
+(manager/scheduler/scheduler.go:694 scheduleTaskGroup, :772
+scheduleNTasksOnSubtree, :844 scheduleNTasksOnNodes, nodeset.go:50 tree):
+
+* The filter pipeline (Ready/Resource/Constraint/Platform/Plugin/HostPort/
+  MaxReplicas — filter.go) becomes a fused boolean feasibility mask over all
+  nodes at once.
+* The spread comparator (scheduler.go:708 nodeLess) becomes an integer
+  "effective level" per node: per-service task count, down-weighted by
+  recent failures.
+* The sorted round-robin placement loop becomes **hierarchical
+  water-filling**: raise a per-branch water level λ until the group's k
+  tasks fit (respecting per-node capacity), then break ties among marginal
+  nodes with a threshold search on (total-tasks, node-index).  This
+  reproduces the reference's "level per-service counts first, then total
+  counts, capacity-bounded" semantics without any sequential loop.
+
+Everything is fixed-shape, fixed-iteration-count (binary searches with a
+static iteration budget), 32-bit, and built exclusively from ops that XLA
+maps well to TPU (segment-sums, elementwise selects).  The identical code
+runs under plain `jit` (single chip) and under `shard_map` with the node
+axis sharded over a mesh — the only difference is the `reduce` callback,
+which becomes a `psum` over the node-axis (see parallel/sharded.py).
+
+Numeric ranges (32-bit budget):
+  per-service counts clamped to 2^20; failure down-weight factor 2^22
+  (dominates any real count); water-level search over [0, 2^30); node index
+  packed in 20 bits -> supports up to 2^20 (~1M) nodes per shard.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..scheduler.nodeinfo import MAX_FAILURES  # single source of truth
+
+F_BIG = 1 << 22          # failure down-weight step (dominates svc counts)
+FAILURE_CLAMP = 63       # keeps e = svc + failures*F_BIG inside int32
+SVC_CLAMP = (1 << 20) - 1
+LEVEL_ITERS = 34         # binary search over [0, 2^30]; extra margin
+TIE_ITERS = 34           # binary search over packed 31-bit tie keys
+IDX_BITS = 20
+TOTAL_CLAMP = (1 << 10) - 1   # total-tasks clamp: tie keys stay < 2^30 so
+                              # the threshold search range fits in int32
+
+Reduce = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def _identity(x: jnp.ndarray) -> jnp.ndarray:
+    return x
+
+
+class GroupInputs(NamedTuple):
+    """Per-(service, spec-version) task-group inputs, densified host-side."""
+
+    k: jnp.ndarray              # i32 scalar: number of tasks to place
+    cpu_d: jnp.ndarray          # f32 scalar: nano-cpus per task
+    mem_d: jnp.ndarray          # f32 scalar: memory bytes per task
+    gen_d: jnp.ndarray          # f32[G]: generic resource demands (0 = off)
+    con_hash: jnp.ndarray       # i32[Cc, 2, N]: node hash (hi,lo) per constraint
+    con_op: jnp.ndarray         # i32[Cc]: 0 ==, 1 !=, 2 disabled
+    con_exp: jnp.ndarray        # i32[Cc, 2]: expected (hi,lo)
+    plat: jnp.ndarray           # i32[P, 4]: (os_hi, os_lo, arch_hi, arch_lo);
+                                #   row -1 sentinel in col 0 = unused
+    maxrep: jnp.ndarray         # i32 scalar: max replicas per node (0 = off)
+    port_limited: jnp.ndarray   # bool scalar: group publishes host ports
+
+
+class NodeInputs(NamedTuple):
+    """Cluster-wide node state (SoA), maintained incrementally host-side."""
+
+    valid: jnp.ndarray          # bool[N] (padding mask)
+    ready: jnp.ndarray          # bool[N] READY && ACTIVE
+    cpu: jnp.ndarray            # f32[N] available nano-cpus
+    mem: jnp.ndarray            # f32[N] available memory bytes
+    gen: jnp.ndarray            # f32[G, N] available generic resources
+    svc_tasks: jnp.ndarray      # i32[N] active tasks of this service
+    total_tasks: jnp.ndarray    # i32[N] active tasks total
+    failures: jnp.ndarray       # i32[N] recent failures for this service
+    leaf: jnp.ndarray           # i32[N] spread-preference leaf id (0 if none)
+    os_hash: jnp.ndarray        # i32[2, N] node platform.os hash (hi, lo)
+    arch_hash: jnp.ndarray      # i32[2, N] normalized arch hash (hi, lo)
+    port_conflict: jnp.ndarray  # bool[N] a requested host port is taken
+    extra_mask: jnp.ndarray     # bool[N] plugin/volume masks ANDed host-side
+
+
+def _seg_sum(x: jnp.ndarray, seg: jnp.ndarray, L: int) -> jnp.ndarray:
+    return jax.ops.segment_sum(x, seg, num_segments=L)
+
+
+def seg_waterfill(e: jnp.ndarray, cap: jnp.ndarray, tie: jnp.ndarray,
+                  k_seg: jnp.ndarray, seg: jnp.ndarray, L: int,
+                  reduce: Reduce = _identity) -> jnp.ndarray:
+    """Capacity-bounded water-filling within each segment.
+
+    Finds per-segment level λ, assigns x_i = clip(λ-1 - e_i, 0, cap_i), then
+    grants the remainder one-by-one to marginal nodes in ``tie`` order.
+
+    e:    i32[N] current level per element (lower = preferred)
+    cap:  i32[N] max units this element can take
+    tie:  i32[N] tie-break key, unique per element (lower = preferred)
+    k_seg:i32[L] units to place per segment
+    seg:  i32[N] segment id per element
+    reduce: cross-shard sum for [L]-shaped partials (psum under shard_map)
+    """
+    e = e.astype(jnp.int32)
+    cap = cap.astype(jnp.int32)
+
+    def fill_at(lam_seg: jnp.ndarray) -> jnp.ndarray:
+        return jnp.clip(lam_seg[seg] - e, 0, cap)
+
+    def level_body(_, lohi):
+        lo, hi = lohi
+        mid = lo + (hi - lo) // 2   # avoids int32 overflow of lo + hi
+        f = reduce(_seg_sum(fill_at(mid), seg, L))
+        ge = f >= k_seg
+        return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
+
+    lo = jnp.zeros((L,), jnp.int32)
+    hi = jnp.full((L,), 1 << 30, jnp.int32)
+    lo, hi = jax.lax.fori_loop(0, LEVEL_ITERS, level_body, (lo, hi))
+    lam = hi  # minimal λ with fill ≥ k (or 2^30 if capacity-infeasible)
+
+    x_base = fill_at(lam - 1)
+    f_base = reduce(_seg_sum(x_base, seg, L))
+    r = jnp.maximum(k_seg - f_base, 0)
+
+    marginal = (e <= lam[seg] - 1) & (x_base < cap)
+
+    # threshold search: per segment, the r-th smallest tie key among marginals
+    def tie_body(_, lohi):
+        lo, hi = lohi
+        mid = lo + (hi - lo) // 2   # avoids int32 overflow of lo + hi
+        cnt = reduce(_seg_sum(
+            (marginal & (tie <= mid[seg])).astype(jnp.int32), seg, L))
+        ge = cnt >= r
+        return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
+
+    tlo = jnp.full((L,), -1, jnp.int32)
+    thi = jnp.full((L,), 1 << 30, jnp.int32)  # tie keys are < 2^30
+    tlo, thi = jax.lax.fori_loop(0, TIE_ITERS, tie_body, (tlo, thi))
+    grant = marginal & (tie <= thi[seg]) & (r[seg] > 0)
+
+    return x_base + grant.astype(jnp.int32)
+
+
+def _resource_cap(cap: jnp.ndarray, avail: jnp.ndarray,
+                  demand: jnp.ndarray) -> jnp.ndarray:
+    """min(cap, floor(avail / demand)) when demand > 0."""
+    fits = jnp.floor(avail / jnp.maximum(demand, 1e-30)).astype(jnp.int32)
+    return jnp.where(demand > 0, jnp.minimum(cap, jnp.maximum(fits, 0)), cap)
+
+
+def _hash_eq(node_hash: jnp.ndarray, exp: jnp.ndarray) -> jnp.ndarray:
+    """node_hash: i32[2, N], exp: i32[2] -> bool[N]."""
+    return (node_hash[0] == exp[0]) & (node_hash[1] == exp[1])
+
+
+def feasibility_and_capacity(nodes: NodeInputs, group: GroupInputs,
+                             reduce: Reduce = _identity):
+    """Fused filter pipeline: mask[N], per-node capacity[N], and per-filter
+    failure counts (for user-visible ``no suitable node (...)`` diagnostics,
+    matching pipeline.go's short-circuit failure accounting).
+
+    Mirrors filter.go's checklist; a False anywhere is a node the host
+    pipeline would also reject (modulo documented waivers).
+    """
+    # --- individual filter masks
+    ready_m = nodes.ready
+
+    res_m = (group.cpu_d <= 0) | (nodes.cpu >= group.cpu_d)
+    res_m &= (group.mem_d <= 0) | (nodes.mem >= group.mem_d)
+    gen_ok = (group.gen_d[:, None] <= 0) | (nodes.gen >= group.gen_d[:, None])
+    res_m &= jnp.all(gen_ok, axis=0)
+
+    plugin_m = nodes.extra_mask
+
+    def apply_constraint(i, m):
+        eq = _hash_eq(group.con_hash[i], group.con_exp[i])
+        op = group.con_op[i]
+        ok = jnp.where(op == 0, eq, jnp.where(op == 1, ~eq, True))
+        return m & ok
+
+    con_m = jax.lax.fori_loop(0, group.con_op.shape[0], apply_constraint,
+                              jnp.ones_like(ready_m))
+
+    def apply_platform(i, acc):
+        row = group.plat[i]
+        used = row[0] != -1
+        os_ok = ((row[0] == 0) & (row[1] == 0)) | (
+            (nodes.os_hash[0] == row[0]) & (nodes.os_hash[1] == row[1]))
+        arch_ok = ((row[2] == 0) & (row[3] == 0)) | (
+            (nodes.arch_hash[0] == row[2]) & (nodes.arch_hash[1] == row[3]))
+        matched, any_used = acc
+        return matched | (used & os_ok & arch_ok), any_used | used
+
+    matched, any_used = jax.lax.fori_loop(
+        0, group.plat.shape[0], apply_platform,
+        (jnp.zeros_like(ready_m), jnp.zeros((), jnp.bool_)))
+    plat_m = matched | ~any_used
+
+    port_m = ~(group.port_limited & nodes.port_conflict)
+    rep_m = (group.maxrep == 0) | (nodes.svc_tasks < group.maxrep)
+
+    # --- short-circuit failure counts in pipeline order (pipeline.go:10-20)
+    prior = nodes.valid
+    fail_counts = []
+    mask = prior
+    for m in (ready_m, res_m, plugin_m, con_m, plat_m, port_m, rep_m):
+        fails = mask & ~m
+        fail_counts.append(jnp.sum(fails.astype(jnp.int32)))
+        mask = mask & m
+    fail_counts = reduce(jnp.stack(fail_counts))
+
+    # capacity: how many tasks of this group each node can absorb
+    cap = jnp.full(nodes.cpu.shape, 1 << 24, jnp.int32)
+    cap = jnp.minimum(cap, group.k)
+    cap = _resource_cap(cap, nodes.cpu, group.cpu_d)
+    cap = _resource_cap(cap, nodes.mem, group.mem_d)
+    for g in range(nodes.gen.shape[0]):
+        cap = _resource_cap(cap, nodes.gen[g], group.gen_d[g])
+    cap = jnp.where(group.maxrep > 0,
+                    jnp.minimum(cap, jnp.maximum(
+                        group.maxrep - nodes.svc_tasks, 0)), cap)
+    cap = jnp.where(group.port_limited, jnp.minimum(cap, 1), cap)
+    cap = jnp.where(mask, jnp.maximum(cap, 0), 0)
+    return mask, cap, fail_counts
+
+
+def plan_group(nodes: NodeInputs, group: GroupInputs, L: int,
+               reduce: Reduce = _identity,
+               idx_offset: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Place a task group: returns x i32[N] = tasks assigned per node.
+
+    Two-stage hierarchical water-fill (reference semantics:
+    scheduleNTasksOnSubtree equalizes branch totals, scheduleNTasksOnNodes
+    levels per-service counts):
+
+      stage A: branches (spread-preference leaves) — level branch task
+               totals, capacity = branch feasible capacity;
+      stage B: nodes within each branch — level per-service counts
+               (failure-down-weighted), tie-broken by total tasks.
+
+    Returns (x i32[N] tasks per node, fail_counts i32[7] per-filter failure
+    counts in pipeline order).
+    """
+    mask, cap, fail_counts = feasibility_and_capacity(nodes, group, reduce)
+    n = nodes.cpu.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    if idx_offset is not None:
+        idx = idx + idx_offset
+
+    svc = jnp.clip(nodes.svc_tasks, 0, SVC_CLAMP)
+    downweight = jnp.where(nodes.failures >= MAX_FAILURES,
+                           jnp.clip(nodes.failures, 0, FAILURE_CLAMP), 0)
+    e = svc + downweight * F_BIG
+
+    # ---- stage A: allocation across branches
+    # branch load counts every valid node's service tasks (feasible or not),
+    # matching nodeset.go:88-105 where tree.tasks accumulates per walked node
+    branch_load = reduce(_seg_sum(
+        jnp.where(nodes.valid, svc, 0), nodes.leaf, L))
+    branch_cap = reduce(_seg_sum(cap, nodes.leaf, L))
+
+    if L == 1:
+        k_branch = jnp.minimum(group.k, branch_cap)
+    else:
+        bidx = jnp.arange(L, dtype=jnp.int32)
+        k_branch = seg_waterfill(
+            e=branch_load,
+            cap=branch_cap,
+            tie=bidx,
+            k_seg=jnp.full((1,), group.k, jnp.int32),
+            seg=jnp.zeros((L,), jnp.int32),
+            L=1,
+            # stage A runs on [L]-shaped, fully-replicated arrays, so no
+            # cross-shard reduce is needed even under shard_map
+        )
+
+    # ---- stage B: nodes within each branch
+    tie = (jnp.clip(nodes.total_tasks, 0, TOTAL_CLAMP) << IDX_BITS) | idx
+    x = seg_waterfill(e=e, cap=cap, tie=tie, k_seg=k_branch,
+                      seg=nodes.leaf, L=L, reduce=reduce)
+    return x, fail_counts
+
+
+@functools.partial(jax.jit, static_argnames=("L",))
+def plan_group_jit(nodes: NodeInputs, group: GroupInputs,
+                   L: int) -> jnp.ndarray:
+    return plan_group(nodes, group, L)

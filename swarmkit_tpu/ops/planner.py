@@ -1,0 +1,378 @@
+"""TPU batch planner: plugs the device kernel into the scheduler seam.
+
+Implements the ``batch_planner`` protocol consumed by
+scheduler.Scheduler._schedule_task_group: given a task group, either place
+the whole group on device and return True, or return False to fall back to
+the host (oracle) path.
+
+Falls back for features the device path does not model yet (documented
+parity waivers): CSI volume mounts, node.ip constraints, named (non-
+discrete) generic resources in *node* inventories, and multi-level
+placement-preference trees.
+
+Densification is an O(N) pass over the scheduler's NodeSet mirror per
+group, then one fixed-shape kernel launch.  (Caching the group-independent
+arrays across the groups of one tick is a planned optimization; it needs a
+mirror dirty-counter because placements mutate node state between groups.)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.objects import Task
+from ..models.types import (
+    GenericResourceKind, MountType, NodeAvailability, NodeState, PublishMode,
+    now,
+)
+from ..scheduler import constraint as constraint_mod
+from ..scheduler.filters import normalize_arch, _references_volume_plugin
+from ..scheduler.nodeinfo import NodeInfo
+from ..models.types import TaskState, TaskStatus
+from .hashing import str_hash
+from .kernel import GroupInputs, NodeInputs, plan_group_jit
+
+log = logging.getLogger("tpu-planner")
+
+# static shape buckets to bound recompiles
+_CC_BUCKETS = (1, 4, 16)      # constraint slots
+_P_BUCKETS = (1, 4)           # platform slots
+_G_BUCKETS = (1, 4)           # generic resource kinds
+
+
+def _bucket(n: int, buckets) -> Optional[int]:
+    for b in buckets:
+        if n <= b:
+            return b
+    return None
+
+
+def _n_bucket(n: int) -> int:
+    b = 1024
+    while b < n:
+        b *= 2
+    return b
+
+
+def _l_bucket(n: int) -> int:
+    for b in (1, 16, 256, 4096):
+        if n <= b:
+            return b
+    return 1 << (n - 1).bit_length()
+
+
+def _split_hash(h: int) -> Tuple[int, int]:
+    # two non-negative int32 halves (62 effective bits)
+    return (h >> 31) & 0x7FFFFFFF, h & 0x7FFFFFFF
+
+
+_SENTINEL = (-1, -1)  # never matches any real hash column value
+
+
+class TPUPlanner:
+    def __init__(self, plan_fn=None):
+        # plan_fn(nodes: NodeInputs, group: GroupInputs, L: int) -> x[N];
+        # defaults to the single-device jit kernel; parallel/sharded.py
+        # provides a mesh-sharded implementation with the same signature.
+        self._plan_fn = plan_fn or plan_group_jit
+        self.last_explanation = ""
+        self.stats = {"groups_planned": 0, "groups_fallback": 0,
+                      "tasks_planned": 0}
+
+    # explanation builders, pipeline order (matches kernel fail_counts rows
+    # and the host filters' Explain strings — filter.go)
+    _EXPLAINERS = (
+        lambda n: (f"{n} nodes not available for new tasks" if n != 1
+                   else "1 node not available for new tasks"),
+        lambda n: (f"insufficient resources on {n} nodes" if n != 1
+                   else "insufficient resources on 1 node"),
+        lambda n: (f"missing plugin on {n} nodes" if n != 1
+                   else "missing plugin on 1 node"),
+        lambda n: (f"scheduling constraints not satisfied on {n} nodes"
+                   if n != 1
+                   else "scheduling constraints not satisfied on 1 node"),
+        lambda n: (f"unsupported platform on {n} nodes" if n != 1
+                   else "unsupported platform on 1 node"),
+        lambda n: (f"host-mode port already in use on {n} nodes" if n != 1
+                   else "host-mode port already in use on 1 node"),
+        lambda n: "max replicas per node limit exceed",
+    )
+
+    def _explain(self, fail_counts: np.ndarray) -> str:
+        pairs = [(int(c), ex) for c, ex in zip(fail_counts, self._EXPLAINERS)]
+        pairs.sort(key=lambda p: -p[0])
+        return "; ".join(ex(c) for c, ex in pairs if c > 0)
+
+    # ------------------------------------------------------------ suitability
+
+    def _supported(self, t: Task) -> bool:
+        c = t.spec.container
+        if c is not None:
+            for m in c.mounts:
+                if m.type == MountType.CSI:
+                    return False  # volume scheduling stays on host
+        placement = t.spec.placement
+        if placement:
+            prefs = [p for p in placement.preferences if p.spread]
+            if len(prefs) > 1:
+                return False  # multi-level spread tree: host path
+            try:
+                for con in constraint_mod.parse(placement.constraints or []):
+                    if con.key.lower() == "node.ip":
+                        return False  # CIDR semantics: host path
+            except constraint_mod.InvalidConstraint:
+                pass  # host path treats as disabled; we can too
+        res = t.spec.resources.reservations if t.spec.resources else None
+        if res:
+            for g in res.generic:
+                if g.res_type != GenericResourceKind.DISCRETE:
+                    return False
+        return True
+
+    # ---------------------------------------------------------- densification
+
+    def _densify(self, sched, t: Task):
+        """Build (or reuse) the per-tick SoA arrays from the NodeSet mirror.
+
+        The node-level arrays (ready/cpu/mem/total) are group-independent;
+        per-service arrays (svc_tasks/failures) and constraint/platform/port
+        columns are group-dependent and built per group.
+        """
+        node_set = sched.node_set
+        infos: List[NodeInfo] = list(node_set.nodes.values())
+        n = len(infos)
+        nb = _n_bucket(max(n, 1))
+
+        valid = np.zeros(nb, bool)
+        ready = np.zeros(nb, bool)
+        cpu = np.zeros(nb, np.float32)
+        mem = np.zeros(nb, np.float32)
+        total = np.zeros(nb, np.int32)
+        valid[:n] = True
+        for i, info in enumerate(infos):
+            node = info.node
+            ready[i] = (node.status.state == NodeState.READY
+                        and node.spec.availability == NodeAvailability.ACTIVE)
+            cpu[i] = info.available_resources.nano_cpus
+            mem[i] = info.available_resources.memory_bytes
+            total[i] = info.active_tasks_count
+        return infos, n, nb, valid, ready, cpu, mem, total
+
+    def _node_value(self, info: NodeInfo, key: str) -> str:
+        node = info.node
+        lk = key.lower()
+        if lk == "node.id":
+            return node.id
+        if lk == "node.hostname":
+            return node.description.hostname if node.description else ""
+        if lk == "node.role":
+            return "MANAGER" if node.spec.desired_role == 1 else "WORKER"
+        if lk == "node.platform.os":
+            return (node.description.platform.os
+                    if node.description and node.description.platform else "")
+        if lk == "node.platform.arch":
+            return (node.description.platform.architecture
+                    if node.description and node.description.platform else "")
+        if lk.startswith(constraint_mod.NODE_LABEL_PREFIX):
+            return node.spec.annotations.labels.get(
+                key[len(constraint_mod.NODE_LABEL_PREFIX):], "")
+        if lk.startswith(constraint_mod.ENGINE_LABEL_PREFIX):
+            if node.description and node.description.engine:
+                return node.description.engine.labels.get(
+                    key[len(constraint_mod.ENGINE_LABEL_PREFIX):], "")
+            return ""
+        return None  # unknown key
+
+    # ----------------------------------------------------------- entry point
+
+    def schedule_group(self, sched, task_group: Dict[str, Task],
+                       decisions) -> bool:
+        t = next(iter(task_group.values()))
+        if not self._supported(t):
+            self.stats["groups_fallback"] += 1
+            return False
+
+        infos, n, nb, valid, ready, cpu, mem, total = self._densify(sched, t)
+        if n == 0:
+            return False
+
+        k = len(task_group)
+
+        # ---- per-service arrays
+        svc_tasks = np.zeros(nb, np.int32)
+        failures = np.zeros(nb, np.int32)
+        ts = now()
+        for i, info in enumerate(infos):
+            svc_tasks[i] = info.active_tasks_count_by_service.get(
+                t.service_id, 0)
+            if info.recent_failures:
+                failures[i] = info.count_recent_failures(ts, t)
+
+        # ---- constraints
+        placement = t.spec.placement
+        constraints = []
+        if placement and placement.constraints:
+            try:
+                constraints = constraint_mod.parse(placement.constraints)
+            except constraint_mod.InvalidConstraint:
+                constraints = []
+        cc = _bucket(len(constraints), _CC_BUCKETS)
+        if cc is None:
+            self.stats["groups_fallback"] += 1
+            return False
+        con_hash = np.zeros((cc, 2, nb), np.int32)
+        con_op = np.full(cc, 2, np.int32)     # 2 = disabled
+        con_exp = np.zeros((cc, 2), np.int32)
+        for ci, con in enumerate(constraints):
+            values = [self._node_value(info, con.key) for info in infos]
+            if any(v is None for v in values):
+                # unknown key: node never matches, regardless of op
+                con_op[ci] = 0
+                con_exp[ci] = _SENTINEL
+                continue
+            hi_lo = [_split_hash(str_hash(v)) for v in values]
+            arr = np.array(hi_lo, np.int64).T  # [2, n]
+            con_hash[ci, :, :n] = arr
+            con_op[ci] = con.operator
+            con_exp[ci] = _split_hash(str_hash(con.exp))
+
+        # ---- platforms
+        platforms = placement.platforms if placement else []
+        pb = _bucket(max(len(platforms), 1), _P_BUCKETS)
+        if pb is None:
+            self.stats["groups_fallback"] += 1
+            return False
+        plat = np.full((pb, 4), -1, np.int32)
+        for pi, p in enumerate(platforms):
+            os_h = _split_hash(str_hash(p.os)) if p.os else (0, 0)
+            arch = normalize_arch(p.architecture)
+            arch_h = (_split_hash(str_hash(arch)) if arch else (0, 0))
+            plat[pi] = (*os_h, *arch_h)
+        os_hash = np.zeros((2, nb), np.int32)
+        arch_hash = np.zeros((2, nb), np.int32)
+        if platforms:
+            for i, info in enumerate(infos):
+                desc = info.node.description
+                if desc and desc.platform:
+                    os_hash[:, i] = _split_hash(str_hash(desc.platform.os))
+                    arch_hash[:, i] = _split_hash(
+                        str_hash(normalize_arch(desc.platform.architecture)))
+                else:
+                    # no description: PlatformFilter rejects
+                    os_hash[:, i] = _SENTINEL
+                    arch_hash[:, i] = _SENTINEL
+
+        # ---- resources
+        res = t.spec.resources.reservations if t.spec.resources else None
+        cpu_d = float(res.nano_cpus) if res else 0.0
+        mem_d = float(res.memory_bytes) if res else 0.0
+        gen_wanted = [g for g in (res.generic if res else [])]
+        gb = _bucket(max(len(gen_wanted), 1), _G_BUCKETS)
+        if gb is None:
+            self.stats["groups_fallback"] += 1
+            return False
+        gen = np.zeros((gb, nb), np.float32)
+        gen_d = np.zeros(gb, np.float32)
+        for gi, g in enumerate(gen_wanted):
+            gen_d[gi] = g.value
+            for i, info in enumerate(infos):
+                avail = 0
+                for r in info.available_resources.generic:
+                    if r.kind == g.kind:
+                        avail += (1 if r.res_type == GenericResourceKind.NAMED
+                                  else r.value)
+                gen[gi, i] = avail
+
+        # ---- host ports
+        port_conflict = np.zeros(nb, bool)
+        port_limited = False
+        if t.endpoint:
+            wanted = [(p.protocol, p.published_port)
+                      for p in t.endpoint.ports
+                      if p.publish_mode == PublishMode.HOST
+                      and p.published_port]
+            if wanted:
+                port_limited = True
+                for i, info in enumerate(infos):
+                    if info.used_host_ports:
+                        port_conflict[i] = any(
+                            w in info.used_host_ports for w in wanted)
+
+        # ---- plugins (volume/network/log drivers): host-side mask
+        extra_mask = np.ones(nb, bool)
+        needs_plugins = False
+        c = t.spec.container
+        if c is not None and any(_references_volume_plugin(m)
+                                 for m in c.mounts):
+            needs_plugins = True
+        if t.spec.log_driver is not None and \
+                t.spec.log_driver.name not in ("", "none"):
+            needs_plugins = True
+        if needs_plugins:
+            from ..scheduler.filters import PluginFilter
+            pf = PluginFilter()
+            if pf.set_task(t):
+                for i, info in enumerate(infos):
+                    extra_mask[i] = pf.check(info)
+
+        # ---- spread preference -> leaf ids
+        leaf = np.zeros(nb, np.int32)
+        L = 1
+        prefs = [p for p in (placement.preferences if placement else [])
+                 if p.spread]
+        if prefs:
+            descriptor = prefs[0].spread.spread_descriptor
+            values: Dict[str, int] = {}
+            for i, info in enumerate(infos):
+                from ..scheduler.nodeset import _pref_value
+                v = _pref_value(info, descriptor)
+                if v is None:
+                    v = ""
+                leaf[i] = values.setdefault(v, len(values))
+            L = _l_bucket(max(len(values), 1))
+
+        nodes_in = NodeInputs(
+            valid=valid, ready=ready, cpu=cpu, mem=mem, gen=gen,
+            svc_tasks=svc_tasks, total_tasks=total, failures=failures,
+            leaf=leaf, os_hash=os_hash, arch_hash=arch_hash,
+            port_conflict=port_conflict, extra_mask=extra_mask)
+        group_in = GroupInputs(
+            k=np.int32(k), cpu_d=np.float32(cpu_d), mem_d=np.float32(mem_d),
+            gen_d=gen_d, con_hash=con_hash, con_op=con_op, con_exp=con_exp,
+            plat=plat, maxrep=np.int32(
+                placement.max_replicas if placement else 0),
+            port_limited=np.bool_(port_limited))
+
+        x, fail_counts = self._plan_fn(nodes_in, group_in, L)
+        x = np.asarray(x)
+        self.last_explanation = self._explain(np.asarray(fail_counts))
+
+        # ---- apply: expand per-node counts into per-task decisions
+        slots: List[int] = []
+        for i in np.nonzero(x)[0]:
+            slots.extend([int(i)] * int(x[i]))
+        placed = 0
+        for task_id, task in list(task_group.items()):
+            if task_id in decisions:
+                continue
+            if placed >= len(slots):
+                break
+            info = infos[slots[placed]]
+            placed += 1
+            new_t = task.copy()
+            new_t.node_id = info.id
+            new_t.status = TaskStatus(
+                state=TaskState.ASSIGNED, timestamp=now(),
+                message="scheduler assigned task to node")
+            sched.all_tasks[task.id] = new_t
+            info.add_task(new_t)
+            from ..scheduler.scheduler import SchedulingDecision
+            decisions[task_id] = SchedulingDecision(task, new_t)
+            del task_group[task_id]
+
+        self.stats["groups_planned"] += 1
+        self.stats["tasks_planned"] += placed
+        return True

@@ -1,0 +1,1 @@
+from .sharded import NODE_AXIS, ShardedPlanFn, make_mesh, plan_group_sharded

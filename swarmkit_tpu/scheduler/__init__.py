@@ -1,0 +1,6 @@
+from .constraint import Constraint, InvalidConstraint, node_matches, parse
+from .filters import Pipeline
+from .nodeinfo import MAX_FAILURES, MONITOR_FAILURES, NodeInfo
+from .nodeset import NodeSet
+from .scheduler import Scheduler
+from .volumes import VolumeSet

@@ -1,0 +1,140 @@
+"""Placement-constraint expression engine.
+
+Reference: manager/constraint/constraint.go.
+
+Grammar: ``key == value`` / ``key != value`` with case-insensitive full-string
+match.  Keys: node.id, node.hostname, node.ip (exact IP or CIDR), node.role,
+node.platform.os, node.platform.arch, node.labels.*, engine.labels.*.
+
+The TPU path compiles parsed constraints to hashed (key-id, op, value-hash)
+triples evaluated as masks on device (see ops/constraints.py); this module is
+the parsing + host-evaluation oracle.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import re
+from dataclasses import dataclass
+from typing import List, Sequence
+
+from ..models.objects import Node
+from ..models.types import NodeRole
+
+EQ = 0
+NOTEQ = 1
+
+NODE_LABEL_PREFIX = "node.labels."
+ENGINE_LABEL_PREFIX = "engine.labels."
+
+_KEY_RE = re.compile(r"^[a-z_][a-z0-9\-_.]+$", re.IGNORECASE)
+_VALUE_RE = re.compile(
+    r"^[a-z0-9:\-_\s.*()?+\[\]\\^$|/]+$", re.IGNORECASE)
+_OPERATORS = ("==", "!=")
+
+
+class InvalidConstraint(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Constraint:
+    key: str
+    operator: int  # EQ | NOTEQ
+    exp: str
+
+    def match(self, *values: str) -> bool:
+        matched = any(self.exp.lower() == v.lower() for v in values)
+        return matched if self.operator == EQ else not matched
+
+
+def parse(exprs: Sequence[str]) -> List[Constraint]:
+    out: List[Constraint] = []
+    for e in exprs:
+        found = False
+        for op_index, op in enumerate(_OPERATORS):
+            if op not in e:
+                continue
+            key, _, value = e.partition(op)
+            key = key.strip()
+            value = value.strip()
+            if not _KEY_RE.match(key):
+                raise InvalidConstraint(f"key {key!r} is invalid")
+            if not _VALUE_RE.match(value):
+                raise InvalidConstraint(f"value {value!r} is invalid")
+            out.append(Constraint(key, op_index, value))
+            found = True
+            break
+        if not found:
+            raise InvalidConstraint(
+                f"constraint expected one operator from {', '.join(_OPERATORS)}")
+    return out
+
+
+def node_matches(constraints: Sequence[Constraint], n: Node) -> bool:
+    """reference: manager/constraint/constraint.go:107 NodeMatches."""
+    for c in constraints:
+        key = c.key.lower()
+        if key == "node.id":
+            if not c.match(n.id):
+                return False
+        elif key == "node.hostname":
+            hostname = n.description.hostname if n.description else ""
+            if not c.match(hostname):
+                return False
+        elif key == "node.ip":
+            if not _match_ip(c, n.status.addr):
+                return False
+        elif key == "node.role":
+            role = "MANAGER" if n.spec.desired_role == NodeRole.MANAGER else "WORKER"
+            if not c.match(role):
+                return False
+        elif key == "node.platform.os":
+            os_name = (n.description.platform.os
+                       if n.description and n.description.platform else "")
+            if not c.match(os_name):
+                return False
+        elif key == "node.platform.arch":
+            arch = (n.description.platform.architecture
+                    if n.description and n.description.platform else "")
+            if not c.match(arch):
+                return False
+        elif key.startswith(NODE_LABEL_PREFIX):
+            label = c.key[len(NODE_LABEL_PREFIX):]
+            val = n.spec.annotations.labels.get(label, "")
+            if not c.match(val):
+                return False
+        elif key.startswith(ENGINE_LABEL_PREFIX):
+            label = c.key[len(ENGINE_LABEL_PREFIX):]
+            val = (n.description.engine.labels.get(label, "")
+                   if n.description and n.description.engine else "")
+            if not c.match(val):
+                return False
+        else:
+            # unknown constraint key never matches (reference behavior:
+            # constraint.go:188-191 returns false)
+            return False
+    return True
+
+
+def _match_ip(c: Constraint, addr: str) -> bool:
+    try:
+        node_ip = ipaddress.ip_address(addr) if addr else None
+    except ValueError:
+        node_ip = None
+    # exact IP
+    try:
+        want = ipaddress.ip_address(c.exp)
+        ip_eq = node_ip is not None and want == node_ip
+        return ip_eq if c.operator == EQ else not ip_eq
+    except ValueError:
+        pass
+    # CIDR subnet
+    try:
+        subnet = ipaddress.ip_network(c.exp, strict=False)
+        within = node_ip is not None and node_ip in subnet
+        return within if c.operator == EQ else not within
+    except ValueError:
+        pass
+    # malformed expression rejects the node
+    return False

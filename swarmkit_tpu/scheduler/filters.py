@@ -1,0 +1,336 @@
+"""Filter pipeline: the per-task node-feasibility checklist.
+
+Reference: manager/scheduler/filter.go (8 filters), pipeline.go (ordered
+short-circuit checklist with failure counting for Explain).
+
+This host path is the oracle; the TPU path (ops/) evaluates the same
+predicates as vectorized masks over all nodes at once, behind the same
+Pipeline seam.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..models.objects import Task
+from ..models.types import (
+    MountType, NodeAvailability, NodeState, Platform, PublishMode,
+)
+from . import constraint as constraint_mod
+from . import genericresource
+from .nodeinfo import NodeInfo
+from .volumes import VolumeSet, GROUP_PREFIX
+
+
+class Filter:
+    """reference: filter.go:14"""
+
+    def set_task(self, t: Task) -> bool:
+        """Enable the filter for this task; False = not applicable."""
+        raise NotImplementedError
+
+    def check(self, n: NodeInfo) -> bool:
+        raise NotImplementedError
+
+    def explain(self, nodes: int) -> str:
+        raise NotImplementedError
+
+
+class ReadyFilter(Filter):
+    def set_task(self, t: Task) -> bool:
+        return True
+
+    def check(self, n: NodeInfo) -> bool:
+        return (n.node.status.state == NodeState.READY
+                and n.node.spec.availability == NodeAvailability.ACTIVE)
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "1 node not available for new tasks"
+        return f"{nodes} nodes not available for new tasks"
+
+
+class ResourceFilter(Filter):
+    def __init__(self) -> None:
+        self._reservations = None
+
+    def set_task(self, t: Task) -> bool:
+        r = t.spec.resources
+        if r is None or r.reservations is None:
+            return False
+        res = r.reservations
+        if not res.nano_cpus and not res.memory_bytes and not res.generic:
+            return False
+        self._reservations = res
+        return True
+
+    def check(self, n: NodeInfo) -> bool:
+        res = self._reservations
+        if res.nano_cpus > n.available_resources.nano_cpus:
+            return False
+        if res.memory_bytes > n.available_resources.memory_bytes:
+            return False
+        for g in res.generic:
+            if not genericresource.has_enough(n.available_resources.generic, g):
+                return False
+        return True
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "insufficient resources on 1 node"
+        return f"insufficient resources on {nodes} nodes"
+
+
+def _references_volume_plugin(mount) -> bool:
+    return (mount.type == MountType.VOLUME
+            and mount.volume_driver not in ("", "local"))
+
+
+class PluginFilter(Filter):
+    def __init__(self) -> None:
+        self._task: Optional[Task] = None
+
+    def set_task(self, t: Task) -> bool:
+        c = t.spec.container
+        volume_templates = bool(c) and any(
+            _references_volume_plugin(m) for m in c.mounts)
+        if volume_templates or t.networks or t.spec.log_driver is not None:
+            self._task = t
+            return True
+        return False
+
+    def check(self, n: NodeInfo) -> bool:
+        desc = n.node.description
+        if desc is None or desc.engine is None:
+            # node not running an engine: plugins not supported -> pass
+            return True
+        plugins = desc.engine.plugins
+        t = self._task
+        c = t.spec.container
+        if c:
+            for mount in c.mounts:
+                if _references_volume_plugin(mount):
+                    _, exists = self._plugin_on_node(
+                        "Volume", mount.volume_driver, plugins)
+                    if not exists:
+                        return False
+        for attachment in t.networks:
+            # network attachments carry a driver via their network id;
+            # resolution happens at allocation time.  A populated driver name
+            # is checked against the node's Network plugins.
+            driver = getattr(attachment, "driver_name", "")
+            if driver:
+                _, exists = self._plugin_on_node("Network", driver, plugins)
+                if not exists:
+                    return False
+        log_driver = t.spec.log_driver
+        if log_driver is not None and log_driver.name not in ("", "none"):
+            type_found, exists = self._plugin_on_node(
+                "Log", log_driver.name, plugins)
+            if not exists and type_found:
+                return False
+        return True
+
+    @staticmethod
+    def _plugin_on_node(ptype: str, name: str, plugins) -> tuple:
+        type_found = False
+        for p in plugins:
+            if p.type != ptype:
+                continue
+            type_found = True
+            if p.name == name or p.name == name + ":latest":
+                return True, True
+        return type_found, False
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "missing plugin on 1 node"
+        return f"missing plugin on {nodes} nodes"
+
+
+class ConstraintFilter(Filter):
+    def __init__(self) -> None:
+        self._constraints: List[constraint_mod.Constraint] = []
+
+    def set_task(self, t: Task) -> bool:
+        if not t.spec.placement or not t.spec.placement.constraints:
+            return False
+        try:
+            self._constraints = constraint_mod.parse(
+                t.spec.placement.constraints)
+        except constraint_mod.InvalidConstraint:
+            # validated at the control API; treat bad input as disabled
+            return False
+        return True
+
+    def check(self, n: NodeInfo) -> bool:
+        return constraint_mod.node_matches(self._constraints, n.node)
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "scheduling constraints not satisfied on 1 node"
+        return f"scheduling constraints not satisfied on {nodes} nodes"
+
+
+def normalize_arch(arch: str) -> str:
+    if arch == "x86_64":
+        return "amd64"
+    if arch == "aarch64":
+        return "arm64"
+    return arch
+
+
+def platform_equal(img: Platform, node: Platform) -> bool:
+    img_arch = normalize_arch(img.architecture)
+    node_arch = normalize_arch(node.architecture)
+    return ((not img_arch or img_arch == node_arch)
+            and (not img.os or img.os == node.os))
+
+
+class PlatformFilter(Filter):
+    def __init__(self) -> None:
+        self._platforms: Sequence[Platform] = ()
+
+    def set_task(self, t: Task) -> bool:
+        placement = t.spec.placement
+        if placement and placement.platforms:
+            self._platforms = placement.platforms
+            return True
+        return False
+
+    def check(self, n: NodeInfo) -> bool:
+        if not self._platforms:
+            return True
+        desc = n.node.description
+        if desc and desc.platform:
+            return any(platform_equal(p, desc.platform)
+                       for p in self._platforms)
+        return False
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "unsupported platform on 1 node"
+        return f"unsupported platform on {nodes} nodes"
+
+
+class HostPortFilter(Filter):
+    def __init__(self) -> None:
+        self._task: Optional[Task] = None
+
+    def set_task(self, t: Task) -> bool:
+        if t.endpoint:
+            for port in t.endpoint.ports:
+                if port.publish_mode == PublishMode.HOST and port.published_port:
+                    self._task = t
+                    return True
+        return False
+
+    def check(self, n: NodeInfo) -> bool:
+        for port in self._task.endpoint.ports:
+            if port.publish_mode == PublishMode.HOST and port.published_port:
+                if (port.protocol, port.published_port) in n.used_host_ports:
+                    return False
+        return True
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "host-mode port already in use on 1 node"
+        return f"host-mode port already in use on {nodes} nodes"
+
+
+class MaxReplicasFilter(Filter):
+    def __init__(self) -> None:
+        self._task: Optional[Task] = None
+
+    def set_task(self, t: Task) -> bool:
+        if t.spec.placement and t.spec.placement.max_replicas > 0:
+            self._task = t
+            return True
+        return False
+
+    def check(self, n: NodeInfo) -> bool:
+        count = n.active_tasks_count_by_service.get(
+            self._task.service_id, 0)
+        return count < self._task.spec.placement.max_replicas
+
+    def explain(self, nodes: int) -> str:
+        return "max replicas per node limit exceed"
+
+
+class VolumesFilter(Filter):
+    def __init__(self, vs: Optional[VolumeSet]) -> None:
+        self.vs = vs
+        self._task: Optional[Task] = None
+        self._requested = []
+
+    def set_task(self, t: Task) -> bool:
+        if self.vs is None:
+            return False
+        self._task = t
+        self._requested = []
+        c = t.spec.container
+        if c is None:
+            return False
+        for mount in c.mounts:
+            if mount.type == MountType.CSI:
+                self._requested.append(mount)
+        return bool(self._requested)
+
+    def check(self, n: NodeInfo) -> bool:
+        for mount in self._requested:
+            if not self.vs.is_volume_available_on_node(mount, n):
+                return False
+        return True
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "cannot fulfill requested volumes on 1 node"
+        return f"cannot fulfill requested volumes on {nodes} nodes"
+
+
+class _Entry:
+    __slots__ = ("f", "enabled", "failure_count")
+
+    def __init__(self, f: Filter):
+        self.f = f
+        self.enabled = False
+        self.failure_count = 0
+
+
+class Pipeline:
+    """Ordered short-circuit checklist (reference: pipeline.go:38)."""
+
+    def __init__(self) -> None:
+        self._checklist: List[_Entry] = [
+            _Entry(ReadyFilter()),
+            _Entry(ResourceFilter()),
+            _Entry(PluginFilter()),
+            _Entry(ConstraintFilter()),
+            _Entry(PlatformFilter()),
+            _Entry(HostPortFilter()),
+            _Entry(MaxReplicasFilter()),
+        ]
+
+    def add_filter(self, f: Filter) -> None:
+        self._checklist.append(_Entry(f))
+
+    def set_task(self, t: Task) -> None:
+        for entry in self._checklist:
+            entry.enabled = entry.f.set_task(t)
+            entry.failure_count = 0
+
+    def process(self, n: NodeInfo) -> bool:
+        for entry in self._checklist:
+            if entry.enabled and not entry.f.check(n):
+                entry.failure_count += 1
+                return False
+        for entry in self._checklist:
+            entry.failure_count = 0
+        return True
+
+    def explain(self) -> str:
+        parts = []
+        for entry in sorted(self._checklist, key=lambda e: -e.failure_count):
+            if entry.failure_count > 0:
+                parts.append(entry.f.explain(entry.failure_count))
+        return "; ".join(parts)

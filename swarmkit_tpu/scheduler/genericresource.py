@@ -1,0 +1,116 @@
+"""Generic (custom) resource accounting: GPUs, FPGAs, licensed slots...
+
+Reference: api/genericresource/ (Claim resource_management.go:11,
+Reclaim :75, HasEnough validate.go:24, ConsumeNodeResources helpers.go:58).
+
+Two shapes:
+* DISCRETE — a count ("gpu": 4).
+* NAMED    — a set of named units ("gpu": {"uuid1", "uuid2"}); claims pick
+  specific units so agents can pin them (surfaced as env vars downstream).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from ..models.types import GenericResource, GenericResourceKind
+
+
+def _count(avail: Sequence[GenericResource], kind: str) -> Tuple[int, bool]:
+    """Return (available amount, any named units) for a resource kind."""
+    total = 0
+    named = False
+    for r in avail:
+        if r.kind != kind:
+            continue
+        if r.res_type == GenericResourceKind.NAMED:
+            total += 1
+            named = True
+        else:
+            total += r.value
+    return total, named
+
+
+def has_enough(node_available: Sequence[GenericResource],
+               requested: GenericResource) -> bool:
+    want = requested.value if requested.res_type == GenericResourceKind.DISCRETE else 1
+    got, _ = _count(node_available, requested.kind)
+    return got >= want
+
+
+def claim(node_available: List[GenericResource],
+          task_assigned: List[GenericResource],
+          requested: Sequence[GenericResource]) -> None:
+    """Move `requested` amounts from node_available into task_assigned.
+
+    Named units are claimed preferentially (so they can be surfaced to the
+    task); discrete counts cover the rest.
+    """
+    for req in requested:
+        want = req.value
+        # claim named units first
+        i = 0
+        while want > 0 and i < len(node_available):
+            r = node_available[i]
+            if r.kind == req.kind and r.res_type == GenericResourceKind.NAMED:
+                task_assigned.append(r)
+                node_available.pop(i)
+                want -= 1
+                continue
+            i += 1
+        # then discrete counts
+        if want > 0:
+            for i, r in enumerate(node_available):
+                if r.kind == req.kind and r.res_type == GenericResourceKind.DISCRETE:
+                    take = min(want, r.value)
+                    if take > 0:
+                        task_assigned.append(GenericResource(
+                            kind=req.kind, value=take))
+                        remaining = r.value - take
+                        if remaining:
+                            node_available[i] = GenericResource(
+                                kind=r.kind, value=remaining)
+                        else:
+                            node_available.pop(i)
+                        want -= take
+                    break
+
+
+def reclaim(node_available: List[GenericResource],
+            task_assigned: Sequence[GenericResource],
+            node_declared: Sequence[GenericResource]) -> None:
+    """Return a task's assigned resources to the node's available pool."""
+    for r in task_assigned:
+        if r.res_type == GenericResourceKind.NAMED:
+            node_available.append(r)
+        else:
+            for i, a in enumerate(node_available):
+                if a.kind == r.kind and a.res_type == GenericResourceKind.DISCRETE:
+                    node_available[i] = GenericResource(
+                        kind=a.kind, value=a.value + r.value)
+                    break
+            else:
+                node_available.append(r)
+
+
+def consume(node_available: List[GenericResource],
+            task_assigned: Sequence[GenericResource]) -> None:
+    """Subtract a task's assignment from a freshly-copied node resource list
+    (reference: ConsumeNodeResources helpers.go:58)."""
+    for r in task_assigned:
+        if r.res_type == GenericResourceKind.NAMED:
+            for i, a in enumerate(node_available):
+                if (a.res_type == GenericResourceKind.NAMED
+                        and a.kind == r.kind and a.value_str == r.value_str):
+                    node_available.pop(i)
+                    break
+        else:
+            for i, a in enumerate(node_available):
+                if a.kind == r.kind and a.res_type == GenericResourceKind.DISCRETE:
+                    remaining = a.value - r.value
+                    if remaining > 0:
+                        node_available[i] = GenericResource(
+                            kind=a.kind, value=remaining)
+                    else:
+                        node_available.pop(i)
+                    break

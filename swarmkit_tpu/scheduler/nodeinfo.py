@@ -1,0 +1,167 @@
+"""Per-node scheduling scratch state.
+
+Reference: manager/scheduler/nodeinfo.go.
+
+One NodeInfo per node, mutated in place.  (The reference nominally copies
+NodeInfo values, but every interesting field is a Go map or pointer shared
+between copies, so shared mutation is the actual semantics — we make that
+explicit.)
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..models.objects import Node, Task
+from ..models.types import (
+    PortConfig, PublishMode, Resources, TaskState, now,
+)
+from . import genericresource
+
+# Failure down-weighting knobs (reference: scheduler.go:16-24)
+MONITOR_FAILURES = 5 * 60.0   # seconds
+MAX_FAILURES = 5
+
+# (service_id, spec_version_index)
+VersionedService = Tuple[str, int]
+# (protocol, published_port)
+HostPortSpec = Tuple[int, int]
+
+
+def task_reservations(task: Task) -> Resources:
+    r = task.spec.resources
+    if r and r.reservations:
+        return r.reservations
+    return Resources()
+
+
+def _versioned_service(t: Task) -> VersionedService:
+    return (t.service_id, t.spec_version.index if t.spec_version else 0)
+
+
+class NodeInfo:
+    __slots__ = (
+        "node", "tasks", "active_tasks_count", "active_tasks_count_by_service",
+        "available_resources", "used_host_ports", "recent_failures",
+        "last_cleanup",
+    )
+
+    def __init__(self, node: Node, tasks: Optional[Dict[str, Task]] = None,
+                 available: Optional[Resources] = None):
+        self.node = node
+        self.tasks: Dict[str, Task] = {}
+        self.active_tasks_count = 0
+        self.active_tasks_count_by_service: Dict[str, int] = {}
+        self.available_resources: Resources = (
+            available.copy() if available else Resources())
+        self.used_host_ports: Set[HostPortSpec] = set()
+        self.recent_failures: Dict[VersionedService, List[float]] = {}
+        self.last_cleanup = now()
+        if tasks:
+            for t in tasks.values():
+                self.add_task(t)
+
+    # convenience pass-throughs
+    @property
+    def id(self) -> str:
+        return self.node.id
+
+    def remove_task(self, t: Task) -> bool:
+        old = self.tasks.pop(t.id, None)
+        if old is None:
+            return False
+        if old.desired_state <= TaskState.COMPLETE:
+            self.active_tasks_count -= 1
+            self.active_tasks_count_by_service[t.service_id] = (
+                self.active_tasks_count_by_service.get(t.service_id, 0) - 1)
+
+        if t.endpoint:
+            for port in t.endpoint.ports:
+                if port.publish_mode == PublishMode.HOST and port.published_port:
+                    self.used_host_ports.discard(
+                        (port.protocol, port.published_port))
+
+        reservations = task_reservations(t)
+        self.available_resources.memory_bytes += reservations.memory_bytes
+        self.available_resources.nano_cpus += reservations.nano_cpus
+
+        desc = self.node.description
+        if desc and desc.resources and desc.resources.generic:
+            genericresource.reclaim(
+                self.available_resources.generic,
+                t.assigned_generic_resources,
+                desc.resources.generic)
+        return True
+
+    def add_task(self, t: Task) -> bool:
+        old = self.tasks.get(t.id)
+        if old is not None:
+            if (t.desired_state <= TaskState.COMPLETE
+                    and old.desired_state > TaskState.COMPLETE):
+                self.tasks[t.id] = t
+                self.active_tasks_count += 1
+                self.active_tasks_count_by_service[t.service_id] = (
+                    self.active_tasks_count_by_service.get(t.service_id, 0) + 1)
+                return True
+            if (t.desired_state > TaskState.COMPLETE
+                    and old.desired_state <= TaskState.COMPLETE):
+                self.tasks[t.id] = t
+                self.active_tasks_count -= 1
+                self.active_tasks_count_by_service[t.service_id] = (
+                    self.active_tasks_count_by_service.get(t.service_id, 0) - 1)
+                return True
+            return False
+
+        self.tasks[t.id] = t
+        reservations = task_reservations(t)
+        self.available_resources.memory_bytes -= reservations.memory_bytes
+        self.available_resources.nano_cpus -= reservations.nano_cpus
+
+        t.assigned_generic_resources = []
+        genericresource.claim(self.available_resources.generic,
+                              t.assigned_generic_resources,
+                              reservations.generic)
+
+        if t.endpoint:
+            for port in t.endpoint.ports:
+                if port.publish_mode == PublishMode.HOST and port.published_port:
+                    self.used_host_ports.add(
+                        (port.protocol, port.published_port))
+
+        if t.desired_state <= TaskState.COMPLETE:
+            self.active_tasks_count += 1
+            self.active_tasks_count_by_service[t.service_id] = (
+                self.active_tasks_count_by_service.get(t.service_id, 0) + 1)
+        return True
+
+    # ------------------------------------------------- failure down-weighting
+
+    def _cleanup_failures(self, ts: float) -> None:
+        for key in list(self.recent_failures):
+            if all(ts - stamp >= MONITOR_FAILURES
+                   for stamp in self.recent_failures[key]):
+                del self.recent_failures[key]
+        self.last_cleanup = ts
+
+    def task_failed(self, t: Task) -> None:
+        ts = now()
+        if ts - self.last_cleanup >= MONITOR_FAILURES:
+            self._cleanup_failures(ts)
+        key = _versioned_service(t)
+        stamps = self.recent_failures.get(key, [])
+        expired = 0
+        for stamp in stamps:
+            if ts - stamp < MONITOR_FAILURES:
+                break
+            expired += 1
+        self.recent_failures[key] = stamps[expired:] + [ts]
+
+    def count_recent_failures(self, ts: float, t: Task) -> int:
+        stamps = self.recent_failures.get(_versioned_service(t), [])
+        count = len(stamps)
+        for i in range(count - 1, -1, -1):
+            if ts - stamps[i] > MONITOR_FAILURES:
+                count -= i + 1
+                break
+        return count

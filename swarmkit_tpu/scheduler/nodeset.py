@@ -1,0 +1,175 @@
+"""Node set + spread-preference decision tree with bounded max-heaps.
+
+Reference: manager/scheduler/nodeset.go, decision_tree.go, nodeheap.go.
+
+The tree partitions nodes by placement-preference label values; each leaf
+keeps a max-heap of at most ``max_assignments`` best candidates (never need
+more than n nodes to place n tasks — design/scheduler.md:155-161).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..models.objects import Node
+from ..models.types import PlacementPreference
+from .constraint import ENGINE_LABEL_PREFIX, NODE_LABEL_PREFIX
+from .nodeinfo import NodeInfo
+
+LessFunc = Callable[[NodeInfo, NodeInfo], bool]
+ConstraintFunc = Callable[[NodeInfo], bool]
+
+
+class _MaxHeap:
+    """Bounded max-heap keyed by a less function, worst node at the root
+    (reference: nodeheap.go)."""
+
+    __slots__ = ("nodes", "less", "length")
+
+    def __init__(self, less: LessFunc):
+        self.nodes: List[NodeInfo] = []
+        self.less = less
+        self.length = 0
+
+    def _hless(self, i: int, j: int) -> bool:
+        # reversed comparator makes it a max-heap
+        return self.less(self.nodes[j], self.nodes[i])
+
+    def _swap(self, i: int, j: int) -> None:
+        self.nodes[i], self.nodes[j] = self.nodes[j], self.nodes[i]
+
+    def _up(self, j: int) -> None:
+        while j > 0:
+            i = (j - 1) // 2
+            if not self._hless(j, i):
+                break
+            self._swap(i, j)
+            j = i
+
+    def _down(self, i: int, n: int) -> None:
+        while True:
+            left = 2 * i + 1
+            if left >= n:
+                break
+            j = left
+            right = left + 1
+            if right < n and self._hless(right, left):
+                j = right
+            if not self._hless(j, i):
+                break
+            self._swap(i, j)
+            i = j
+
+    def push(self, node: NodeInfo) -> None:
+        self.nodes.append(node)
+        self.length += 1
+        self._up(self.length - 1)
+
+    def fix_root(self) -> None:
+        self._down(0, self.length)
+
+    def heapify(self) -> None:
+        for i in range(self.length // 2 - 1, -1, -1):
+            self._down(i, self.length)
+
+    def collapse_sorted(self) -> List[NodeInfo]:
+        """Pop everything in place: best-first order in self.nodes."""
+        while self.length > 0:
+            self.length -= 1
+            self._swap(0, self.length)
+            self._down(0, self.length)
+        return self.nodes
+
+
+class DecisionTree:
+    __slots__ = ("tasks", "next", "heap")
+
+    def __init__(self) -> None:
+        self.tasks = 0
+        self.next: Optional[Dict[str, "DecisionTree"]] = None
+        self.heap: Optional[_MaxHeap] = None
+
+    def ordered_nodes(self, meets_constraints: ConstraintFunc) -> List[NodeInfo]:
+        """Sorted best-first candidate list; on reuse, re-filters mutated
+        nodes and re-sorts (reference: decision_tree.go:24)."""
+        if self.heap is None:
+            return []
+        if self.heap.length != len(self.heap.nodes):
+            # already collapsed once; nodes may have mutated
+            kept = [n for n in self.heap.nodes if meets_constraints(n)]
+            self.heap.nodes = kept
+            self.heap.length = len(kept)
+            self.heap.heapify()
+        return self.heap.collapse_sorted()
+
+
+def _pref_value(node: NodeInfo, descriptor: str) -> Optional[str]:
+    d = descriptor.lower()
+    if len(descriptor) > len(NODE_LABEL_PREFIX) and \
+            d.startswith(NODE_LABEL_PREFIX):
+        return node.node.spec.annotations.labels.get(
+            descriptor[len(NODE_LABEL_PREFIX):], "")
+    if len(descriptor) > len(ENGINE_LABEL_PREFIX) and \
+            d.startswith(ENGINE_LABEL_PREFIX):
+        desc = node.node.description
+        if desc and desc.engine:
+            return desc.engine.labels.get(
+                descriptor[len(ENGINE_LABEL_PREFIX):], "")
+        return ""
+    return None  # unsupported descriptor: skip this preference level
+
+
+class NodeSet:
+    """reference: nodeset.go:14"""
+
+    def __init__(self) -> None:
+        self.nodes: Dict[str, NodeInfo] = {}
+
+    def node_info(self, node_id: str) -> Optional[NodeInfo]:
+        return self.nodes.get(node_id)
+
+    def add_or_update_node(self, n: NodeInfo) -> None:
+        self.nodes[n.id] = n
+
+    def remove(self, node_id: str) -> None:
+        self.nodes.pop(node_id, None)
+
+    def tree(self, service_id: str,
+             preferences: Sequence[PlacementPreference],
+             max_assignments: int,
+             meets_constraints: ConstraintFunc,
+             node_less: LessFunc) -> DecisionTree:
+        root = DecisionTree()
+        if max_assignments == 0:
+            return root
+
+        for node in self.nodes.values():
+            tree = root
+            for pref in preferences:
+                if pref.spread is None:
+                    continue
+                value = _pref_value(node, pref.spread.spread_descriptor)
+                if value is None:
+                    continue
+                tree.tasks += node.active_tasks_count_by_service.get(
+                    service_id, 0)
+                if tree.next is None:
+                    tree.next = {}
+                nxt = tree.next.get(value)
+                if nxt is None:
+                    nxt = DecisionTree()
+                    tree.next[value] = nxt
+                tree = nxt
+
+            tree.tasks += node.active_tasks_count_by_service.get(service_id, 0)
+            if tree.heap is None:
+                tree.heap = _MaxHeap(node_less)
+
+            if tree.heap.length < max_assignments:
+                if meets_constraints(node):
+                    tree.heap.push(node)
+            elif node_less(node, tree.heap.nodes[0]):
+                if meets_constraints(node):
+                    tree.heap.nodes[0] = node
+                    tree.heap.fix_root()
+        return root

@@ -1,0 +1,618 @@
+"""The scheduler: assigns PENDING tasks to nodes.
+
+Reference: manager/scheduler/scheduler.go.
+
+Event-loop object over the store: mirrors tasks/nodes in memory, debounces
+commit events (50ms gap, 1s max), groups unassigned tasks by (service,
+spec-version), builds a spread-preference tree per group, round-robins tasks
+over sorted candidate nodes re-filtering after every placement, then commits
+ASSIGNED states in batched transactions with node-version conflict rollback.
+
+A pluggable ``batch_planner`` seam lets the TPU path (ops/planner.py) replace
+the per-group tree walk with a device-computed placement while event
+handling, commit logic, and the host path stay identical — the Filter/
+Pipeline gating strategy called for in SURVEY.md §5.8.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..models.objects import Node, Service, Task, Volume
+from ..models.types import (
+    Resources, TaskState, TaskStatus, now,
+)
+from ..state.events import Event, EventCommit, EventSnapshotRestore
+from ..state.store import Batch, MemoryStore, ReadTx
+from ..state.watch import Closed
+from . import genericresource
+from .filters import Pipeline, VolumesFilter
+from .nodeinfo import MAX_FAILURES, NodeInfo, task_reservations
+from .nodeset import DecisionTree, NodeSet
+from .volumes import VolumeSet
+
+log = logging.getLogger("scheduler")
+
+COMMIT_DEBOUNCE_GAP = 0.050   # reference: scheduler.go:149-155
+MAX_LATENCY = 1.0
+
+
+class SchedulingDecision:
+    __slots__ = ("old", "new")
+
+    def __init__(self, old: Task, new: Task):
+        self.old = old
+        self.new = new
+
+
+class Scheduler:
+    def __init__(self, store: MemoryStore,
+                 batch_planner=None):
+        self.store = store
+        self.unassigned_tasks: Dict[str, Task] = {}
+        self.pending_preassigned_tasks: Dict[str, Task] = {}
+        self.preassigned_tasks: set = set()
+        self.node_set = NodeSet()
+        self.all_tasks: Dict[str, Task] = {}
+        self.pipeline = Pipeline()
+        self.volumes = VolumeSet()
+        self.batch_planner = batch_planner
+
+        self._stop = threading.Event()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # stats for benchmarking / tests (bounded: long-lived managers
+        # tick many times per second)
+        from collections import deque
+        self.stats = {"ticks": 0, "decisions": 0,
+                      "tick_seconds": deque(maxlen=1024)}
+
+    # ------------------------------------------------------------------ setup
+
+    def _setup_tasks_list(self, tx: ReadTx) -> None:
+        for volume in tx.find(Volume):
+            if volume.volume_info and volume.volume_info.volume_id:
+                self.volumes.add_or_update_volume(volume)
+
+        tasks_by_node: Dict[str, Dict[str, Task]] = {}
+        for t in tx.find(Task):
+            if (t.status.state < TaskState.PENDING
+                    or t.status.state > TaskState.RUNNING):
+                continue
+            if (t.status.state == TaskState.PENDING
+                    and t.desired_state > TaskState.COMPLETE):
+                # updated/removed before ever being assigned
+                continue
+            self.all_tasks[t.id] = t
+            if not t.node_id:
+                self._enqueue(t)
+                continue
+            if t.status.state == TaskState.PENDING:
+                self.preassigned_tasks.add(t.id)
+                self.pending_preassigned_tasks[t.id] = t
+                continue
+            self.volumes.reserve_task_volumes(t)
+            tasks_by_node.setdefault(t.node_id, {})[t.id] = t
+
+        self._build_node_set(tx, tasks_by_node)
+
+    def _build_node_set(self, tx: ReadTx,
+                        tasks_by_node: Dict[str, Dict[str, Task]]) -> None:
+        for n in tx.find(Node):
+            resources = Resources()
+            if n.description and n.description.resources:
+                resources = n.description.resources
+            self.node_set.add_or_update_node(
+                NodeInfo(n, tasks_by_node.get(n.id), resources))
+
+    # ------------------------------------------------------------- event loop
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, name="scheduler",
+                                        daemon=True)
+        self._thread.start()
+
+    def run(self) -> None:
+        try:
+            self.pipeline.add_filter(VolumesFilter(self.volumes))
+            _, sub = self.store.view_and_watch(
+                lambda tx: self._setup_tasks_list(tx))
+            try:
+                self._process_preassigned_tasks()
+                self.tick()
+
+                debounce_started: Optional[float] = None
+                tick_required = False
+
+                while not self._stop.is_set():
+                    if debounce_started is None:
+                        timeout = 0.2
+                    else:
+                        deadline = min(debounce_started + MAX_LATENCY,
+                                       self._last_event + COMMIT_DEBOUNCE_GAP)
+                        timeout = max(0.0, deadline - now())
+                    try:
+                        event = sub.get(timeout=timeout) if timeout > 0 else None
+                    except TimeoutError:
+                        event = None
+                    except Closed:
+                        return
+
+                    if event is None:
+                        if debounce_started is not None:
+                            if len(self.pending_preassigned_tasks) > 0:
+                                self._process_preassigned_tasks()
+                            if tick_required:
+                                self.tick()
+                                tick_required = False
+                            debounce_started = None
+                        continue
+
+                    if isinstance(event, EventCommit):
+                        self._last_event = now()
+                        if debounce_started is None:
+                            debounce_started = self._last_event
+                    elif isinstance(event, EventSnapshotRestore):
+                        self._resync()
+                        tick_required = True
+                    elif isinstance(event, Event):
+                        tick_required |= self._handle_event(event)
+            finally:
+                self.store.queue.unsubscribe(sub)
+        finally:
+            self._done.set()
+
+    _last_event = 0.0
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._done.wait(timeout=10)
+
+    def _resync(self) -> None:
+        self.unassigned_tasks.clear()
+        self.pending_preassigned_tasks.clear()
+        self.preassigned_tasks.clear()
+        self.all_tasks.clear()
+        self.node_set = NodeSet()
+        # clear in place: the pipeline's VolumesFilter holds a reference
+        self.volumes.clear()
+        self.store.view(lambda tx: self._setup_tasks_list(tx))
+
+    def _handle_event(self, ev: Event) -> bool:
+        obj = ev.obj
+        if isinstance(obj, Task):
+            if ev.action == "create":
+                return self._create_task(obj)
+            if ev.action == "update":
+                return self._update_task(obj)
+            return self._delete_task(self.all_tasks.get(obj.id, obj))
+        if isinstance(obj, Node):
+            if ev.action == "delete":
+                self.node_set.remove(obj.id)
+                return False
+            self._create_or_update_node(obj)
+            return True
+        if isinstance(obj, Volume) and ev.action == "update":
+            if obj.volume_info and obj.volume_info.volume_id:
+                self.volumes.add_or_update_volume(obj)
+                return True
+        return False
+
+    # --------------------------------------------------------- state mirror
+
+    def _enqueue(self, t: Task) -> None:
+        self.unassigned_tasks[t.id] = t
+
+    def _create_task(self, t: Task) -> bool:
+        if (t.status.state < TaskState.PENDING
+                or t.status.state > TaskState.RUNNING):
+            return False
+        self.all_tasks[t.id] = t
+        if not t.node_id:
+            self._enqueue(t)
+            return True
+        if t.status.state == TaskState.PENDING:
+            self.preassigned_tasks.add(t.id)
+            self.pending_preassigned_tasks[t.id] = t
+            return False
+        info = self.node_set.node_info(t.node_id)
+        if info is not None:
+            info.add_task(t)
+        return False
+
+    def _update_task(self, t: Task) -> bool:
+        if t.status.state < TaskState.PENDING:
+            return False
+        old = self.all_tasks.get(t.id)
+        if t.status.state > TaskState.RUNNING:
+            if old is None:
+                return False
+            if (t.status.state != old.status.state
+                    and t.status.state in (TaskState.FAILED,
+                                           TaskState.REJECTED)):
+                if t.id not in self.preassigned_tasks:
+                    info = self.node_set.node_info(t.node_id)
+                    if info is not None:
+                        info.task_failed(t)
+            self._delete_task(old)
+            return True
+        if not t.node_id:
+            if old is not None:
+                self._delete_task(old)
+            self.all_tasks[t.id] = t
+            self._enqueue(t)
+            return True
+        if t.status.state == TaskState.PENDING:
+            if old is not None:
+                self._delete_task(old)
+            self.preassigned_tasks.add(t.id)
+            self.all_tasks[t.id] = t
+            self.pending_preassigned_tasks[t.id] = t
+            return False
+        self.all_tasks[t.id] = t
+        info = self.node_set.node_info(t.node_id)
+        if info is not None:
+            info.add_task(t)
+        return False
+
+    def _delete_task(self, t: Task) -> bool:
+        self.all_tasks.pop(t.id, None)
+        self.preassigned_tasks.discard(t.id)
+        self.pending_preassigned_tasks.pop(t.id, None)
+        self.unassigned_tasks.pop(t.id, None)
+        for va in t.volumes:
+            self.volumes.release_volume(va.id, t.id)
+        info = self.node_set.node_info(t.node_id)
+        if info is not None and info.remove_task(t):
+            return True
+        return False
+
+    def _create_or_update_node(self, n: Node) -> None:
+        info = self.node_set.node_info(n.id)
+        if n.description and n.description.resources:
+            resources = n.description.resources.copy()
+            if info is not None:
+                for task in info.tasks.values():
+                    reservations = task_reservations(task)
+                    resources.memory_bytes -= reservations.memory_bytes
+                    resources.nano_cpus -= reservations.nano_cpus
+                    genericresource.consume(resources.generic,
+                                            task.assigned_generic_resources)
+        else:
+            resources = Resources()
+        if info is None:
+            self.node_set.add_or_update_node(NodeInfo(n, None, resources))
+        else:
+            info.node = n
+            info.available_resources = resources
+
+    # -------------------------------------------------------------- decisions
+
+    def _process_preassigned_tasks(self) -> None:
+        decisions: Dict[str, SchedulingDecision] = {}
+        for t in list(self.pending_preassigned_tasks.values()):
+            new_t = self._task_fit_node(t, t.node_id)
+            if new_t is None:
+                continue
+            decisions[t.id] = SchedulingDecision(t, new_t)
+        successful, failed = self._apply_scheduling_decisions(decisions)
+        for d in successful:
+            if d.new.status.state == TaskState.ASSIGNED:
+                self.pending_preassigned_tasks.pop(d.old.id, None)
+        for d in failed:
+            self.all_tasks[d.old.id] = d.old
+            info = self.node_set.node_info(d.new.node_id)
+            if info is not None:
+                info.remove_task(d.new)
+            for va in d.new.volumes:
+                self.volumes.release_volume(va.id, d.new.id)
+
+    def tick(self) -> int:
+        """Schedule the unassigned queue; returns number of decisions."""
+        t0 = now()
+        self.stats["ticks"] += 1
+        tasks_by_common_spec: Dict[Tuple[str, int], Dict[str, Task]] = {}
+        one_off_tasks: List[Task] = []
+        decisions: Dict[str, SchedulingDecision] = {}
+
+        for task_id, t in list(self.unassigned_tasks.items()):
+            if t is None or t.node_id:
+                del self.unassigned_tasks[task_id]
+                continue
+            if t.spec_version is not None:
+                key = (t.service_id, t.spec_version.index)
+                tasks_by_common_spec.setdefault(key, {})[task_id] = t
+            else:
+                one_off_tasks.append(t)
+            del self.unassigned_tasks[task_id]
+
+        for group in tasks_by_common_spec.values():
+            self._schedule_task_group(group, decisions)
+        for t in one_off_tasks:
+            self._schedule_task_group({t.id: t}, decisions)
+
+        n_decisions = len(decisions)
+        _, failed = self._apply_scheduling_decisions(decisions)
+        for d in failed:
+            self.all_tasks[d.old.id] = d.old
+            info = self.node_set.node_info(d.new.node_id)
+            if info is not None:
+                info.remove_task(d.new)
+            for va in d.new.volumes:
+                self.volumes.release_volume(va.id, d.new.id)
+            self._enqueue(d.old)
+
+        self.stats["decisions"] += n_decisions
+        self.stats["tick_seconds"].append(now() - t0)
+        return n_decisions
+
+    def _apply_scheduling_decisions(
+            self, decisions: Dict[str, SchedulingDecision]
+    ) -> Tuple[List[SchedulingDecision], List[SchedulingDecision]]:
+        """Commit ASSIGNED states (reference: scheduler.go:490)."""
+        successful: List[SchedulingDecision] = []
+        failed: List[SchedulingDecision] = []
+        try:
+            if not decisions:
+                return successful, failed
+
+            def cb(batch: Batch) -> None:
+                for task_id, decision in decisions.items():
+                    def one(tx, task_id=task_id, decision=decision) -> None:
+                        t = tx.get(Task, task_id)
+                        if t is None:
+                            self._delete_task(decision.new)
+                            return
+                        if (t.status.state == decision.new.status.state
+                                and t.status.message == decision.new.status.message
+                                and t.status.err == decision.new.status.err):
+                            return
+                        if t.status.state >= TaskState.ASSIGNED:
+                            # already assigned by someone else; check node
+                            info = self.node_set.node_info(
+                                decision.new.node_id)
+                            if info is None:
+                                failed.append(decision)
+                                return
+                            node = tx.get(Node, decision.new.node_id)
+                            if (node is None or node.meta.version.index
+                                    != info.node.meta.version.index):
+                                failed.append(decision)
+                                return
+                        volumes_to_update = []
+                        for va in decision.new.volumes:
+                            v = tx.get(Volume, va.id)
+                            if v is None:
+                                failed.append(decision)
+                                return
+                            if v.spec.availability != 0:  # not ACTIVE
+                                failed.append(decision)
+                                return
+                            if not any(ps.node_id == decision.new.node_id
+                                       for ps in v.publish_status):
+                                v = v.copy()
+                                from ..models.types import VolumePublishStatus
+                                v.publish_status.append(VolumePublishStatus(
+                                    node_id=decision.new.node_id,
+                                    state=VolumePublishStatus.State.PENDING_PUBLISH))
+                                volumes_to_update.append(v)
+                        committed = decision.new.copy()
+                        committed.meta = t.meta.copy()
+                        try:
+                            tx.update(committed)
+                        except Exception:
+                            failed.append(decision)
+                            return
+                        for v in volumes_to_update:
+                            tx.update(v)
+                        successful.append(decision)
+                    batch.update(one)
+
+            self.store.batch(cb)
+            return successful, failed
+        except Exception:
+            log.exception("scheduler tick transaction failed")
+            failed.extend(successful)
+            return [], failed
+        finally:
+            # always release no-longer-used volumes (reference: defer at
+            # scheduler.go:501)
+            self.store.batch(self.volumes.free_volumes)
+
+    def _task_fit_node(self, t: Task, node_id: str) -> Optional[Task]:
+        """Validate a preassigned task against its node
+        (reference: scheduler.go:646)."""
+        info = self.node_set.node_info(node_id)
+        if info is None:
+            return None
+        self.pipeline.set_task(t)
+        if not self.pipeline.process(info):
+            new_t = t.copy()
+            new_t.status.timestamp = now()
+            new_t.status.err = self.pipeline.explain()
+            self.all_tasks[t.id] = new_t
+            return new_t
+        new_t = t.copy()
+        try:
+            attachments = self.volumes.choose_task_volumes(t, info)
+        except ValueError as e:
+            new_t.status.timestamp = now()
+            new_t.status.err = str(e)
+            self.all_tasks[t.id] = new_t
+            return new_t
+        new_t.volumes = attachments
+        new_t.status = TaskStatus(
+            state=TaskState.ASSIGNED, timestamp=now(),
+            message="scheduler confirmed task can run on preassigned node")
+        self.all_tasks[t.id] = new_t
+        info.add_task(new_t)
+        return new_t
+
+    # --------------------------------------------------------- group schedule
+
+    def _schedule_task_group(self, task_group: Dict[str, Task],
+                             decisions: Dict[str, SchedulingDecision]) -> None:
+        t = next(iter(task_group.values()))
+        self.pipeline.set_task(t)
+
+        if self.batch_planner is not None:
+            handled = self.batch_planner.schedule_group(
+                self, task_group, decisions)
+            if handled:
+                if task_group:
+                    self._no_suitable_node(
+                        task_group, decisions,
+                        explanation=getattr(self.batch_planner,
+                                            "last_explanation", ""))
+                return
+
+        ts = now()
+
+        def node_less(a: NodeInfo, b: NodeInfo) -> bool:
+            fa = a.count_recent_failures(ts, t)
+            fb = b.count_recent_failures(ts, t)
+            if fa >= MAX_FAILURES or fb >= MAX_FAILURES:
+                if fa > fb:
+                    return False
+                if fb > fa:
+                    return True
+            sa = a.active_tasks_count_by_service.get(t.service_id, 0)
+            sb = b.active_tasks_count_by_service.get(t.service_id, 0)
+            if sa != sb:
+                return sa < sb
+            return a.active_tasks_count < b.active_tasks_count
+
+        prefs = t.spec.placement.preferences if t.spec.placement else []
+        tree = self.node_set.tree(t.service_id, prefs, len(task_group),
+                                  self.pipeline.process, node_less)
+        self._schedule_n_tasks_on_subtree(len(task_group), task_group, tree,
+                                          decisions, node_less)
+        if task_group:
+            self._no_suitable_node(task_group, decisions)
+
+    def _schedule_n_tasks_on_subtree(self, n: int,
+                                     task_group: Dict[str, Task],
+                                     tree: DecisionTree,
+                                     decisions: Dict[str, SchedulingDecision],
+                                     node_less) -> int:
+        """Recursive branch equalization (reference: scheduler.go:772)."""
+        if tree.next is None:
+            nodes = tree.ordered_nodes(self.pipeline.process)
+            if not nodes:
+                return 0
+            return self._schedule_n_tasks_on_nodes(n, task_group, nodes,
+                                                   decisions, node_less)
+
+        tasks_scheduled = 0
+        tasks_in_usable_branches = tree.tasks
+        no_room: set = set()
+
+        converging = True
+        while (tasks_scheduled != n and len(no_room) != len(tree.next)
+               and converging):
+            usable = len(tree.next) - len(no_room)
+            desired, remainder = divmod(
+                tasks_in_usable_branches + n - tasks_scheduled, usable)
+            converging = False
+            for subtree in tree.next.values():
+                if id(subtree) in no_room:
+                    continue
+                subtree_tasks = subtree.tasks
+                if (subtree_tasks < desired
+                        or (subtree_tasks == desired and remainder > 0)):
+                    converging = True
+                    to_assign = desired - subtree_tasks
+                    if remainder > 0:
+                        to_assign += 1
+                    res = self._schedule_n_tasks_on_subtree(
+                        to_assign, task_group, subtree, decisions, node_less)
+                    if res < to_assign:
+                        no_room.add(id(subtree))
+                        tasks_in_usable_branches -= subtree_tasks
+                    elif remainder > 0:
+                        remainder -= 1
+                    tasks_scheduled += res
+        return tasks_scheduled
+
+    def _schedule_n_tasks_on_nodes(self, n: int,
+                                   task_group: Dict[str, Task],
+                                   nodes: List[NodeInfo],
+                                   decisions: Dict[str, SchedulingDecision],
+                                   node_less) -> int:
+        """Round-robin assignment over sorted candidates, re-filtering the
+        mutated node after each placement (reference: scheduler.go:844)."""
+        tasks_scheduled = 0
+        failed_constraints: Dict[int, bool] = {}
+        node_iter = 0
+        node_count = len(nodes)
+        for task_id, t in list(task_group.items()):
+            if task_id in decisions:
+                continue
+            node = nodes[node_iter % node_count]
+            try:
+                attachments = self.volumes.choose_task_volumes(t, node)
+            except ValueError:
+                attachments = []
+
+            new_t = t.copy()
+            new_t.volumes = attachments
+            new_t.node_id = node.id
+            self.volumes.reserve_task_volumes(new_t)
+            new_t.status = TaskStatus(
+                state=TaskState.ASSIGNED, timestamp=now(),
+                message="scheduler assigned task to node")
+            self.all_tasks[t.id] = new_t
+            node.add_task(new_t)
+
+            decisions[task_id] = SchedulingDecision(t, new_t)
+            del task_group[task_id]
+            tasks_scheduled += 1
+            if tasks_scheduled == n:
+                return tasks_scheduled
+
+            if node_iter + 1 < node_count:
+                # first pass: level nodes to equal task counts
+                next_node = nodes[(node_iter + 1) % node_count]
+                if node_less(next_node, node):
+                    node_iter += 1
+            else:
+                node_iter += 1
+
+            orig_iter = node_iter
+            while (failed_constraints.get(node_iter % node_count)
+                   or not self.pipeline.process(nodes[node_iter % node_count])):
+                failed_constraints[node_iter % node_count] = True
+                node_iter += 1
+                if node_iter - orig_iter == node_count:
+                    return tasks_scheduled
+        return tasks_scheduled
+
+    def _no_suitable_node(self, task_group: Dict[str, Task],
+                          decisions: Dict[str, SchedulingDecision],
+                          explanation: Optional[str] = None) -> None:
+        if explanation is None:
+            explanation = self.pipeline.explain()
+        for t in task_group.values():
+            service = self.store.view(
+                lambda tx: tx.get(Service, t.service_id))
+            if service is None:
+                continue
+            new_t = t.copy()
+            new_t.status.timestamp = now()
+            sv = service.spec_version
+            tv = new_t.spec_version
+            if sv is not None and tv is not None and sv.index > tv.index:
+                if (t.status.state == TaskState.PENDING
+                        and t.desired_state >= TaskState.SHUTDOWN):
+                    new_t.status.state = TaskState.SHUTDOWN
+                    new_t.status.err = ""
+            else:
+                if explanation:
+                    new_t.status.err = f"no suitable node ({explanation})"
+                else:
+                    new_t.status.err = "no suitable node"
+                self._enqueue(new_t)
+            self.all_tasks[t.id] = new_t
+            decisions[t.id] = SchedulingDecision(t, new_t)

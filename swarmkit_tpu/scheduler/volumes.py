@@ -1,0 +1,205 @@
+"""CSI volume scheduling: the scheduler also chooses volumes.
+
+Reference: manager/scheduler/volumes.go, topology.go.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..models.objects import Task, Volume
+from ..models.types import (
+    Mount, MountType, VolumeAttachment, VolumeAvailability, VolumeSharing,
+    VolumeAccessScope, VolumePublishStatus,
+)
+from .nodeinfo import NodeInfo
+
+GROUP_PREFIX = "group:"
+
+
+def is_in_topology(top: Optional[Dict[str, str]],
+                   accessible: Sequence[Dict[str, str]]) -> bool:
+    """True if node topology ``top`` lies within the volume's accessible
+    topologies (reference: topology.go:22)."""
+    if top is None or not accessible:
+        return True
+    for topology in accessible:
+        if all(top.get(sub) == seg for sub, seg in topology.items()):
+            return True
+    return False
+
+
+@dataclass
+class _VolumeUsage:
+    node_id: str
+    read_only: bool
+
+
+@dataclass
+class _VolumeInfo:
+    volume: Volume
+    tasks: Dict[str, _VolumeUsage] = field(default_factory=dict)
+    nodes: Dict[str, int] = field(default_factory=dict)  # node -> refcount
+
+
+class VolumeSet:
+    def __init__(self) -> None:
+        self.volumes: Dict[str, _VolumeInfo] = {}
+        self.by_group: Dict[str, set] = {}
+        self.by_name: Dict[str, str] = {}
+
+    def clear(self) -> None:
+        """Reset in place (holders of a reference — e.g. the pipeline's
+        VolumesFilter — keep seeing the live set)."""
+        self.volumes.clear()
+        self.by_group.clear()
+        self.by_name.clear()
+
+    def add_or_update_volume(self, v: Volume) -> None:
+        info = self.volumes.get(v.id)
+        if info is None:
+            self.volumes[v.id] = _VolumeInfo(volume=v)
+        else:
+            info.volume = v
+        self.by_group.setdefault(v.spec.group, set()).add(v.id)
+        self.by_name[v.spec.annotations.name] = v.id
+
+    def remove_volume(self, volume_id: str) -> None:
+        info = self.volumes.pop(volume_id, None)
+        if info is not None:
+            self.by_group.get(info.volume.spec.group, set()).discard(volume_id)
+            self.by_name.pop(info.volume.spec.annotations.name, None)
+
+    # ------------------------------------------------------------ reservation
+
+    def reserve_volume(self, volume_id: str, task_id: str, node_id: str,
+                       read_only: bool) -> None:
+        info = self.volumes.get(volume_id)
+        if info is None:
+            return
+        info.tasks[task_id] = _VolumeUsage(node_id, read_only)
+        info.nodes[node_id] = info.nodes.get(node_id, 0) + 1
+
+    def release_volume(self, volume_id: str, task_id: str) -> None:
+        info = self.volumes.get(volume_id)
+        if info is None:
+            return
+        usage = info.tasks.pop(task_id, None)
+        if usage is not None and info.nodes.get(usage.node_id, 0) > 0:
+            info.nodes[usage.node_id] -= 1
+
+    def reserve_task_volumes(self, task: Task) -> None:
+        c = task.spec.container
+        if c is None:
+            return
+        for va in task.volumes:
+            for mount in c.mounts:
+                if mount.source == va.source and mount.target == va.target:
+                    self.reserve_volume(va.id, task.id, task.node_id,
+                                        mount.readonly)
+
+    # -------------------------------------------------------------- selection
+
+    def choose_task_volumes(self, task: Task,
+                            node_info: NodeInfo) -> List[VolumeAttachment]:
+        """Pick concrete volumes for the task's CSI mounts on this node.
+
+        Raises ValueError when a mount cannot be satisfied.  Reservations made
+        while choosing are rolled back; the caller re-reserves on commit
+        (reference: volumes.go:98 chooseTaskVolumes).
+        """
+        chosen: List[VolumeAttachment] = []
+        try:
+            c = task.spec.container
+            if c is None:
+                return []
+            for mount in c.mounts:
+                if mount.type != MountType.CSI:
+                    continue
+                candidate = self.is_volume_available_on_node(mount, node_info)
+                if not candidate:
+                    raise ValueError(
+                        f"cannot find volume to satisfy mount with source "
+                        f"{mount.source}")
+                self.reserve_volume(candidate, task.id, node_info.id,
+                                    mount.readonly)
+                chosen.append(VolumeAttachment(
+                    id=candidate, source=mount.source, target=mount.target))
+            return chosen
+        finally:
+            for va in chosen:
+                self.release_volume(va.id, task.id)
+
+    def is_volume_available_on_node(self, mount: Mount,
+                                    node: NodeInfo) -> str:
+        source = mount.source
+        if source.startswith(GROUP_PREFIX):
+            group = source[len(GROUP_PREFIX):]
+            for vid in self.by_group.get(group, ()):
+                if self.check_volume(vid, node, mount.readonly):
+                    return vid
+            return ""
+        vid = self.by_name.get(source, "")
+        if vid and self.check_volume(vid, node, mount.readonly):
+            return vid
+        return ""
+
+    def check_volume(self, volume_id: str, info: NodeInfo,
+                     read_only: bool) -> bool:
+        vi = self.volumes.get(volume_id)
+        if vi is None:
+            return False
+        v = vi.volume
+        if v.spec.availability != VolumeAvailability.ACTIVE:
+            return False
+
+        top: Optional[Dict[str, str]] = None
+        if info.node.description:
+            for csi in info.node.description.csi_info:
+                if v.spec.driver and csi.plugin_name == v.spec.driver.name:
+                    top = csi.accessible_topology
+                    break
+
+        if v.spec.access_mode.scope == VolumeAccessScope.SINGLE_NODE:
+            for usage in vi.tasks.values():
+                if usage.node_id != info.id:
+                    return False
+
+        sharing = v.spec.access_mode.sharing
+        if sharing == VolumeSharing.NONE:
+            if vi.tasks:
+                return False
+        elif sharing == VolumeSharing.ONEWRITER:
+            if not read_only and any(not u.read_only
+                                     for u in vi.tasks.values()):
+                return False
+        elif sharing == VolumeSharing.READONLY:
+            if not read_only:
+                return False
+
+        accessible = (v.volume_info.accessible_topology
+                      if v.volume_info else [])
+        return is_in_topology(top, accessible)
+
+    # ------------------------------------------------------------- unpublish
+
+    def free_volumes(self, batch) -> None:
+        """Queue PENDING_NODE_UNPUBLISH for volumes no longer used on a node
+        (reference: volumes.go:186 freeVolumes)."""
+        for volume_id, info in self.volumes.items():
+            def cb(tx, volume_id=volume_id, info=info):
+                v = tx.get(Volume, volume_id)
+                if v is None:
+                    return
+                changed = False
+                v = v.copy()
+                for status in v.publish_status:
+                    if (info.nodes.get(status.node_id, 0) == 0
+                            and status.state == VolumePublishStatus.State.PUBLISHED):
+                        status.state = \
+                            VolumePublishStatus.State.PENDING_NODE_UNPUBLISH
+                        changed = True
+                if changed:
+                    tx.update(v)
+            batch.update(cb)

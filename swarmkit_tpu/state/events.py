@@ -1,0 +1,78 @@
+"""Store change events + matcher combinators.
+
+The reference generates a typed event per (object kind × action) with
+per-field "checks" (api/*.pb.go EventCreateTask etc.).  Here one generic
+``Event`` carries (action, object, old_object) and matchers are plain
+predicate builders — equally expressive, no codegen.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple, Type
+
+
+@dataclass(frozen=True)
+class Event:
+    action: str              # "create" | "update" | "delete"
+    obj: Any                 # the (new) object; for delete, the deleted object
+    old: Any = None          # previous version on update, else None
+
+    @property
+    def collection(self) -> str:
+        return self.obj.collection
+
+
+@dataclass(frozen=True)
+class EventCommit:
+    """Published once per committed transaction — drives debounced loops
+    (reference: state/store/memory.go publishes state.EventCommit)."""
+
+    version: int = 0
+
+
+@dataclass(frozen=True)
+class EventSnapshotRestore:
+    """Published after a full store restore; watchers must resync."""
+
+
+Pred = Callable[[Any], bool]
+
+
+def is_event(ev: Any) -> bool:
+    return isinstance(ev, Event)
+
+
+def match(kind: Optional[Type] = None, actions: Tuple[str, ...] = (),
+          where: Optional[Pred] = None) -> Pred:
+    """Build an event predicate: object kind, action set, and object filter.
+
+    ``where`` is applied to the new object (or the deleted one).
+    """
+
+    def pred(ev: Any) -> bool:
+        if not isinstance(ev, Event):
+            return False
+        if kind is not None and not isinstance(ev.obj, kind):
+            return False
+        if actions and ev.action not in actions:
+            return False
+        if where is not None and not where(ev.obj):
+            return False
+        return True
+
+    return pred
+
+
+def any_of(*preds: Pred) -> Pred:
+    def pred(ev: Any) -> bool:
+        return any(p(ev) for p in preds)
+    return pred
+
+
+def commit_or(pred: Pred) -> Pred:
+    """Match commit events plus whatever ``pred`` matches."""
+
+    def p(ev: Any) -> bool:
+        return isinstance(ev, EventCommit) or pred(ev)
+    return p

@@ -1,0 +1,682 @@
+"""Versioned in-memory cluster store with watches and a consensus seam.
+
+Reference: manager/state/store/memory.go (go-memdb based MemoryStore).
+
+Semantics preserved from the reference:
+
+* ``view(cb)`` / ``update(cb)`` transactions; update collects a changelist,
+  (optionally) proposes it through a ``Proposer`` (raft), then commits and
+  publishes one event per change plus an ``EventCommit``
+  (memory.go:395-470).
+* Version sequencing: every committed write stamps ``meta.version.index``
+  with a monotonically increasing store index; updates require the caller's
+  object version to match the stored version (``SequenceConflict``) — the
+  scheduler's node-conflict rollback depends on this (scheduler.go:533-544).
+* ``batch(cb)`` splits a large write into transactions of at most
+  ``MAX_CHANGES_PER_TX`` changes (memory.go:45-51).
+* ``view_and_watch`` atomically snapshots + subscribes so no event is lost
+  (memory.go:892).
+* ``apply_store_actions`` replays follower-side raft log entries
+  (memory.go:280).
+* ``save``/``restore`` full-store snapshots for raft snapshot transfer.
+* Unique, case-preserved names per collection except tasks (naming conflicts
+  return ``NameConflict``).
+
+Implementation differs deliberately: plain dicts + per-store RW mutex instead
+of a radix-tree MVCC — the control plane is low-write-rate and the scheduler
+hot path reads a private mirror, so simplicity wins.  Objects returned by
+reads are the stored instances; callers must not mutate them (writes store
+defensive copies via ``obj.copy()``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+from ..models.objects import (
+    Cluster, Config, Extension, Network, Node, Resource, Secret, Service,
+    Task, Volume, STORE_OBJECT_TYPES,
+)
+from ..models.types import now
+from .events import Event, EventCommit, EventSnapshotRestore
+from .watch import Queue, Subscription
+
+MAX_CHANGES_PER_TX = 200  # reference: memory.go:45-51
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFound(StoreError):
+    pass
+
+
+class AlreadyExists(StoreError):
+    pass
+
+
+class NameConflict(StoreError):
+    pass
+
+
+class SequenceConflict(StoreError):
+    """Update out of sequence (stale version)."""
+
+
+class InvalidStoreAction(StoreError):
+    pass
+
+
+@dataclass(frozen=True)
+class StoreAction:
+    """One replicated mutation (reference: api.StoreAction)."""
+
+    action: str        # "create" | "update" | "delete"
+    obj: Any           # a store object snapshot
+
+
+class Proposer:
+    """Consensus seam (reference: manager/state/proposer.go:17).
+
+    ``propose`` must block until the change list is committed by consensus
+    (or raise).  Actions arrive with their final version indices already
+    stamped (see MemoryStore.update).  A nil proposer (None) keeps the
+    store fully functional standalone — the master test fixture of the
+    reference.
+    """
+
+    def propose(self, actions: Sequence[StoreAction]) -> None:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Find combinators (reference: manager/state/store/by.go)
+# ---------------------------------------------------------------------------
+
+class By:
+    """Query selector; subclasses know how to use indexes or fall back to
+    a linear filter."""
+
+
+@dataclass(frozen=True)
+class All(By):
+    pass
+
+
+@dataclass(frozen=True)
+class ByName(By):
+    name: str
+
+
+@dataclass(frozen=True)
+class ByNamePrefix(By):
+    prefix: str
+
+
+@dataclass(frozen=True)
+class ByIDPrefix(By):
+    prefix: str
+
+
+@dataclass(frozen=True)
+class ByService(By):
+    service_id: str
+
+
+@dataclass(frozen=True)
+class ByNode(By):
+    node_id: str
+
+
+@dataclass(frozen=True)
+class BySlot(By):
+    service_id: str
+    slot: int
+
+
+@dataclass(frozen=True)
+class ByDesiredState(By):
+    state: int
+
+
+@dataclass(frozen=True)
+class ByTaskState(By):
+    state: int
+
+
+@dataclass(frozen=True)
+class ByRole(By):
+    role: int
+
+
+@dataclass(frozen=True)
+class ByMembership(By):
+    membership: int
+
+
+@dataclass(frozen=True)
+class ByReferencedSecret(By):
+    secret_id: str
+
+
+@dataclass(frozen=True)
+class ByReferencedConfig(By):
+    config_id: str
+
+
+@dataclass(frozen=True)
+class ByReferencedNetwork(By):
+    network_id: str
+
+
+@dataclass(frozen=True)
+class ByVolumeGroup(By):
+    group: str
+
+
+@dataclass(frozen=True)
+class ByKind(By):
+    kind: str
+
+
+@dataclass(frozen=True)
+class ByCustom(By):
+    index: str
+    value: str
+
+
+@dataclass(frozen=True)
+class Or(By):
+    bys: Tuple[By, ...]
+
+    def __init__(self, *bys: By):
+        object.__setattr__(self, "bys", tuple(bys))
+
+
+@dataclass(frozen=True)
+class Where(By):
+    """Escape hatch: arbitrary predicate (linear scan)."""
+
+    pred: Callable[[Any], bool]
+
+
+def _task_secret_ids(t: Task) -> Iterable[str]:
+    c = t.spec.container
+    if c:
+        for ref in c.secrets:
+            yield ref.secret_id
+
+
+def _task_config_ids(t: Task) -> Iterable[str]:
+    c = t.spec.container
+    if c:
+        for ref in c.configs:
+            yield ref.config_id
+
+
+def _task_network_ids(t: Task) -> Iterable[str]:
+    for a in t.networks:
+        yield a.network_id
+    for n in t.spec.networks:
+        yield n.target
+
+
+def _service_network_ids(s: Service) -> Iterable[str]:
+    for n in s.spec.networks:
+        yield n.target
+    for n in s.spec.task.networks:
+        yield n.target
+
+
+def _obj_name(obj: Any) -> str:
+    spec = getattr(obj, "spec", None)
+    ann = getattr(spec, "annotations", None) or getattr(obj, "annotations", None)
+    if ann is not None and ann.name:
+        return ann.name
+    # nodes are named by hostname when they have no explicit name
+    desc = getattr(obj, "description", None)
+    if desc is not None and desc.hostname:
+        return desc.hostname
+    return ""
+
+
+class _Table:
+    def __init__(self) -> None:
+        self.objects: Dict[str, Any] = {}
+        self.by_name: Dict[str, str] = {}            # lower(name) -> id
+        self.by_service: Dict[str, set] = {}          # tasks/volumes refcounts
+        self.by_node: Dict[str, set] = {}
+        self.by_slot: Dict[Tuple[str, int], set] = {}
+
+    def snapshot(self) -> Dict[str, Any]:
+        return dict(self.objects)
+
+
+class ReadTx:
+    """Consistent read view.  Holds the store lock only during method calls;
+    objects are immutable-by-convention so the view stays coherent."""
+
+    def __init__(self, store: "MemoryStore"):
+        self._store = store
+
+    def get(self, kind: Type, id: str) -> Optional[Any]:
+        with self._store._lock:
+            return self._store._tables[kind.collection].objects.get(id)
+
+    def find(self, kind: Type, by: By = All()) -> List[Any]:
+        with self._store._lock:
+            return self._store._find_locked(kind, by)
+
+
+class WriteTx(ReadTx):
+    def __init__(self, store: "MemoryStore"):
+        super().__init__(store)
+        self._changes: List[StoreAction] = []
+        self._events: List[Event] = []
+        # staged view: id -> obj (or _TOMBSTONE)
+        self._staged: Dict[Tuple[str, str], Any] = {}
+        # staged name index: (collection, lower-name) -> id, so name-conflict
+        # checks stay O(1) even for 10k-create transactions
+        self._staged_names: Dict[Tuple[str, str], str] = {}
+        self._staged_name_by_id: Dict[Tuple[str, str], str] = {}
+        self.closed = False
+
+    # reads see staged writes
+    def get(self, kind: Type, id: str) -> Optional[Any]:
+        key = (kind.collection, id)
+        if key in self._staged:
+            obj = self._staged[key]
+            return None if obj is _TOMBSTONE else obj
+        return super().get(kind, id)
+
+    def find(self, kind: Type, by: By = All()) -> List[Any]:
+        base = super().find(kind, by)
+        if not self._staged:
+            return base
+        staged_ids = {i for (c, i) in self._staged if c == kind.collection}
+        if not staged_ids:
+            return base
+        out = [o for o in base if o.id not in staged_ids]
+        pred = self._store._predicate_for(kind, by)
+        for (c, i), obj in self._staged.items():
+            if c != kind.collection or obj is _TOMBSTONE:
+                continue
+            if pred(obj):
+                out.append(obj)
+        return out
+
+    def _check_name(self, kind: Type, obj: Any) -> None:
+        if kind.collection == "tasks":
+            return
+        name = _obj_name(obj)
+        if not name:
+            return
+        lname = name.lower()
+        staged_holder = self._staged_names.get((kind.collection, lname))
+        if staged_holder is not None and staged_holder != obj.id:
+            raise NameConflict(f"name conflict: {name!r}")
+        with self._store._lock:
+            existing = self._store._tables[kind.collection].by_name.get(lname)
+        if existing is not None and existing != obj.id:
+            # unless the holder is staged for deletion / rename
+            holder = self._staged.get((kind.collection, existing))
+            if holder is _TOMBSTONE:
+                return
+            if holder is not None and _obj_name(holder).lower() != lname:
+                return
+            raise NameConflict(f"name conflict: {name!r}")
+
+    def _stage_name(self, kind: Type, obj: Any) -> None:
+        if kind.collection == "tasks":
+            return
+        # drop any staged name previously held by this id (rename in-tx)
+        old = self._staged_name_by_id.pop((kind.collection, obj.id), None)
+        if old is not None:
+            self._staged_names.pop((kind.collection, old), None)
+        name = _obj_name(obj).lower()
+        if name:
+            self._staged_names[(kind.collection, name)] = obj.id
+            self._staged_name_by_id[(kind.collection, obj.id)] = name
+
+    def create(self, obj: Any) -> None:
+        kind = type(obj)
+        if self.get(kind, obj.id) is not None:
+            raise AlreadyExists(obj.id)
+        self._check_name(kind, obj)
+        cp = obj.copy()
+        ts = now()
+        cp.meta.created_at = cp.meta.created_at or ts
+        cp.meta.updated_at = ts
+        self._staged[(kind.collection, obj.id)] = cp
+        self._stage_name(kind, cp)
+        self._changes.append(StoreAction("create", cp))
+        self._events.append(Event("create", cp))
+
+    def update(self, obj: Any) -> None:
+        kind = type(obj)
+        existing = self.get(kind, obj.id)
+        if existing is None:
+            raise NotFound(obj.id)
+        if existing.meta.version.index != obj.meta.version.index:
+            raise SequenceConflict(
+                f"{kind.collection}/{obj.id}: stale version "
+                f"{obj.meta.version.index} != {existing.meta.version.index}")
+        self._check_name(kind, obj)
+        cp = obj.copy()
+        cp.meta.created_at = existing.meta.created_at
+        cp.meta.updated_at = now()
+        self._staged[(kind.collection, obj.id)] = cp
+        self._stage_name(kind, cp)
+        self._changes.append(StoreAction("update", cp))
+        self._events.append(Event("update", cp, existing))
+
+    def delete(self, kind: Type, id: str) -> None:
+        existing = self.get(kind, id)
+        if existing is None:
+            raise NotFound(id)
+        self._staged[(kind.collection, id)] = _TOMBSTONE
+        old = self._staged_name_by_id.pop((kind.collection, id), None)
+        if old is not None:
+            self._staged_names.pop((kind.collection, old), None)
+        self._changes.append(StoreAction("delete", existing))
+        self._events.append(Event("delete", existing))
+
+
+class _Tombstone:
+    def __repr__(self) -> str:
+        return "<deleted>"
+
+
+_TOMBSTONE = _Tombstone()
+
+
+class MemoryStore:
+    def __init__(self, proposer: Optional[Proposer] = None):
+        self._lock = threading.RLock()
+        self._update_lock = threading.Lock()  # serializes writers
+        self._tables: Dict[str, _Table] = {
+            t.collection: _Table() for t in STORE_OBJECT_TYPES
+        }
+        self._proposer = proposer
+        self._version = 0
+        self.queue = Queue()
+
+    # ------------------------------------------------------------------ reads
+
+    def view(self, cb: Optional[Callable[[ReadTx], Any]] = None) -> Any:
+        tx = ReadTx(self)
+        if cb is None:
+            return tx
+        return cb(tx)
+
+    def view_and_watch(self, cb: Callable[[ReadTx], Any],
+                       predicate=None, limit: Optional[int] = None
+                       ) -> Tuple[Any, Subscription]:
+        """Atomic snapshot + subscribe (reference: memory.go:892)."""
+        with self._update_lock:
+            sub = (self.queue.subscribe_limited(limit, predicate)
+                   if limit else self.queue.subscribe(predicate))
+            result = cb(ReadTx(self))
+        return result, sub
+
+    def watch_queue(self) -> Queue:
+        return self.queue
+
+    # ----------------------------------------------------------------- writes
+
+    def update(self, cb: Callable[[WriteTx], Any]) -> Any:
+        """Run a write transaction; commit via proposer when configured.
+
+        Version indices are stamped *before* proposing so the replicated
+        StoreActions carry the exact versions the leader will commit —
+        followers replaying them converge bit-for-bit (the reference gets
+        this via proposer.GetVersion(); memory.go).
+        """
+        with self._update_lock:
+            tx = WriteTx(self)
+            result = cb(tx)   # exceptions roll back (nothing committed yet)
+            if tx._changes:
+                with self._lock:
+                    seq = self._version
+                for change in tx._changes:
+                    seq += 1
+                    if change.action in ("create", "update"):
+                        change.obj.meta.version.index = seq
+                if self._proposer is not None:
+                    self._proposer.propose(tx._changes)
+            self._commit(tx)
+            return result
+
+    def batch(self, cb: Callable[["Batch"], Any]) -> Any:
+        """Split a large write into ≤MAX_CHANGES_PER_TX transactions
+        (reference: memory.go:531)."""
+        b = Batch(self)
+        try:
+            result = cb(b)
+        finally:
+            b._flush()
+        return result
+
+    def _commit(self, tx: WriteTx) -> None:
+        if not tx._changes:
+            tx.closed = True
+            return
+        with self._lock:
+            for change in tx._changes:
+                self._version += 1   # versions pre-stamped in update()
+                self._apply_locked(change)
+        tx.closed = True
+        for ev in tx._events:
+            self.queue.publish(ev)
+        self.queue.publish(EventCommit(self._version))
+
+    def _apply_locked(self, change: StoreAction) -> None:
+        obj = change.obj
+        table = self._tables[obj.collection]
+        old = table.objects.get(obj.id)
+        # name index maintenance
+        if old is not None:
+            oldname = _obj_name(old).lower()
+            if oldname and table.by_name.get(oldname) == obj.id:
+                del table.by_name[oldname]
+        if change.action == "delete":
+            table.objects.pop(obj.id, None)
+            self._unindex(table, old if old is not None else obj)
+            return
+        if obj.collection != "tasks":
+            name = _obj_name(obj).lower()
+            if name:
+                table.by_name[name] = obj.id
+        if old is not None:
+            self._unindex(table, old)
+        table.objects[obj.id] = obj
+        self._index(table, obj)
+
+    def _index(self, table: _Table, obj: Any) -> None:
+        if isinstance(obj, Task):
+            if obj.service_id:
+                table.by_service.setdefault(obj.service_id, set()).add(obj.id)
+                table.by_slot.setdefault((obj.service_id, obj.slot), set()).add(obj.id)
+            if obj.node_id:
+                table.by_node.setdefault(obj.node_id, set()).add(obj.id)
+
+    def _unindex(self, table: _Table, obj: Any) -> None:
+        if isinstance(obj, Task):
+            if obj.service_id:
+                table.by_service.get(obj.service_id, set()).discard(obj.id)
+                table.by_slot.get((obj.service_id, obj.slot), set()).discard(obj.id)
+            if obj.node_id:
+                table.by_node.get(obj.node_id, set()).discard(obj.id)
+
+    # ------------------------------------------------------- queries (locked)
+
+    def _predicate_for(self, kind: Type, by: By) -> Callable[[Any], bool]:
+        if isinstance(by, All):
+            return lambda o: True
+        if isinstance(by, ByName):
+            return lambda o: _obj_name(o).lower() == by.name.lower()
+        if isinstance(by, ByNamePrefix):
+            return lambda o: _obj_name(o).lower().startswith(by.prefix.lower())
+        if isinstance(by, ByIDPrefix):
+            return lambda o: o.id.startswith(by.prefix)
+        if isinstance(by, ByService):
+            return lambda o: getattr(o, "service_id", None) == by.service_id
+        if isinstance(by, ByNode):
+            return lambda o: getattr(o, "node_id", None) == by.node_id
+        if isinstance(by, BySlot):
+            return lambda o: (getattr(o, "service_id", None) == by.service_id
+                              and getattr(o, "slot", None) == by.slot)
+        if isinstance(by, ByDesiredState):
+            return lambda o: o.desired_state == by.state
+        if isinstance(by, ByTaskState):
+            return lambda o: o.status.state == by.state
+        if isinstance(by, ByRole):
+            return lambda o: o.spec.desired_role == by.role
+        if isinstance(by, ByMembership):
+            return lambda o: o.spec.membership == by.membership
+        if isinstance(by, ByReferencedSecret):
+            return lambda o: by.secret_id in set(_task_secret_ids(o)) \
+                if isinstance(o, Task) else False
+        if isinstance(by, ByReferencedConfig):
+            return lambda o: by.config_id in set(_task_config_ids(o)) \
+                if isinstance(o, Task) else False
+        if isinstance(by, ByReferencedNetwork):
+            def net_pred(o):
+                if isinstance(o, Task):
+                    return by.network_id in set(_task_network_ids(o))
+                if isinstance(o, Service):
+                    return by.network_id in set(_service_network_ids(o))
+                return False
+            return net_pred
+        if isinstance(by, ByVolumeGroup):
+            return lambda o: o.spec.group == by.group
+        if isinstance(by, ByKind):
+            return lambda o: getattr(o, "kind", None) == by.kind
+        if isinstance(by, ByCustom):
+            return lambda o: (getattr(o, "annotations", None) or
+                              o.spec.annotations).indices.get(by.index) == by.value
+        if isinstance(by, Where):
+            return by.pred
+        if isinstance(by, Or):
+            preds = [self._predicate_for(kind, b) for b in by.bys]
+            return lambda o: any(p(o) for p in preds)
+        raise InvalidStoreAction(f"unsupported selector {by!r}")
+
+    def _find_locked(self, kind: Type, by: By) -> List[Any]:
+        table = self._tables[kind.collection]
+        # fast paths via indexes
+        if isinstance(by, All):
+            return list(table.objects.values())
+        if isinstance(by, ByName) and kind.collection != "tasks":
+            oid = table.by_name.get(by.name.lower())
+            return [table.objects[oid]] if oid in table.objects else []
+        if kind is Task:
+            ids: Optional[set] = None
+            if isinstance(by, ByService):
+                ids = table.by_service.get(by.service_id, set())
+            elif isinstance(by, ByNode):
+                ids = table.by_node.get(by.node_id, set())
+            elif isinstance(by, BySlot):
+                ids = table.by_slot.get((by.service_id, by.slot), set())
+            if ids is not None:
+                return [table.objects[i] for i in ids if i in table.objects]
+        pred = self._predicate_for(kind, by)
+        return [o for o in table.objects.values() if pred(o)]
+
+    # --------------------------------------------------- raft follower replay
+
+    def apply_store_actions(self, actions: Sequence[StoreAction]) -> None:
+        """Apply replicated actions without re-proposing
+        (reference: memory.go:280)."""
+        events: List[Event] = []
+        with self._update_lock:
+            with self._lock:
+                for change in actions:
+                    obj = change.obj.copy()
+                    old = self._tables[obj.collection].objects.get(obj.id)
+                    if change.action == "create":
+                        events.append(Event("create", obj))
+                    elif change.action == "update":
+                        events.append(Event("update", obj, old))
+                    else:
+                        events.append(Event("delete", old if old is not None else obj))
+                    self._version = max(self._version,
+                                        obj.meta.version.index)
+                    self._apply_locked(StoreAction(change.action, obj))
+            for ev in events:
+                self.queue.publish(ev)
+            self.queue.publish(EventCommit(self._version))
+
+    # ----------------------------------------------------------- snapshotting
+
+    def save(self) -> Dict[str, Any]:
+        """Full-store snapshot (reference: snapshot.proto StoreSnapshot)."""
+        with self._lock:
+            return {
+                "version": self._version,
+                "tables": {
+                    coll: [o.copy() for o in t.objects.values()]
+                    for coll, t in self._tables.items()
+                },
+            }
+
+    def restore(self, snapshot: Dict[str, Any]) -> None:
+        with self._update_lock:
+            with self._lock:
+                for coll in self._tables:
+                    self._tables[coll] = _Table()
+                for coll, objs in snapshot["tables"].items():
+                    table = self._tables[coll]
+                    for o in objs:
+                        cp = o.copy()
+                        table.objects[cp.id] = cp
+                        self._index(table, cp)
+                        if coll != "tasks":
+                            name = _obj_name(cp).lower()
+                            if name:
+                                table.by_name[name] = cp.id
+                self._version = snapshot.get("version", 0)
+            self.queue.publish(EventSnapshotRestore())
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def close(self) -> None:
+        self.queue.close()
+
+
+class Batch:
+    """Accumulates updates, committing every MAX_CHANGES_PER_TX changes
+    (reference: memory.go:531)."""
+
+    def __init__(self, store: MemoryStore):
+        self._store = store
+        self._pending: List[Callable[[WriteTx], Any]] = []
+        self._count = 0
+        self.applied = 0
+        self.committed = 0
+
+    def update(self, cb: Callable[[WriteTx], Any]) -> None:
+        self._pending.append(cb)
+        self._count += 1
+        self.applied += 1
+        if self._count >= MAX_CHANGES_PER_TX:
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._pending:
+            return
+        pending, self._pending, self._count = self._pending, [], 0
+
+        def run_all(tx: WriteTx) -> None:
+            for cb in pending:
+                cb(tx)
+
+        self._store.update(run_all)
+        self.committed += len(pending)

@@ -1,0 +1,142 @@
+"""Bounded pub/sub event queue (reference: watch/watch.go:20).
+
+The store publishes every committed change here; control loops subscribe with
+a predicate.  Semantics mirror the reference's Queue built on go-events:
+
+* ``subscribe``   — unbounded buffered channel; slow consumers grow the buffer.
+* ``subscribe_limited(n)`` — bounded buffer; on overflow the subscription is
+  CLOSED (the consumer sees the closure and must resync from a store view),
+  matching the reference's close-on-overflow sink behavior.
+
+A subscription is a thread-safe iterator/queue hybrid: ``get(timeout)`` or
+iteration; ``close()`` cancels.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+Predicate = Callable[[Any], bool]
+
+
+class Closed(Exception):
+    """The subscription was closed (by cancel or overflow)."""
+
+
+class Subscription:
+    def __init__(self, queue: "Queue", predicate: Optional[Predicate],
+                 limit: Optional[int]):
+        self._queue = queue
+        self._predicate = predicate
+        self._limit = limit
+        self._buf: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self.overflowed = False
+
+    # -- producer side -----------------------------------------------------
+    def _publish(self, event: Any) -> None:
+        if self._predicate is not None:
+            try:
+                if not self._predicate(event):
+                    return
+            except Exception:
+                return
+        with self._cond:
+            if self._closed:
+                return
+            if self._limit is not None and len(self._buf) >= self._limit:
+                # close-on-overflow: consumer must resync
+                self.overflowed = True
+                self._closed = True
+                self._cond.notify_all()
+                return
+            self._buf.append(event)
+            self._cond.notify()
+
+    # -- consumer side -----------------------------------------------------
+    def get(self, timeout: Optional[float] = None) -> Any:
+        with self._cond:
+            if not self._buf and not self._closed:
+                self._cond.wait(timeout)
+            if self._buf:
+                return self._buf.popleft()
+            if self._closed:
+                raise Closed()
+            raise TimeoutError()
+
+    def poll(self) -> Optional[Any]:
+        with self._cond:
+            if self._buf:
+                return self._buf.popleft()
+            return None
+
+    def drain(self) -> List[Any]:
+        with self._cond:
+            items = list(self._buf)
+            self._buf.clear()
+            return items
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            try:
+                yield self.get()
+            except Closed:
+                return
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed and not self._buf
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+class Queue:
+    """Broadcast queue: every event goes to every matching subscriber."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._subs: List[Subscription] = []
+
+    def publish(self, event: Any) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for sub in subs:
+            sub._publish(event)
+
+    def publish_all(self, events: Iterable[Any]) -> None:
+        for e in events:
+            self.publish(e)
+
+    def subscribe(self, predicate: Optional[Predicate] = None) -> Subscription:
+        return self._add(Subscription(self, predicate, None))
+
+    def subscribe_limited(self, limit: int,
+                          predicate: Optional[Predicate] = None) -> Subscription:
+        return self._add(Subscription(self, predicate, limit))
+
+    def _add(self, sub: Subscription) -> Subscription:
+        with self._lock:
+            self._subs.append(sub)
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        sub.close()
+        with self._lock:
+            try:
+                self._subs.remove(sub)
+            except ValueError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            subs = list(self._subs)
+            self._subs.clear()
+        for sub in subs:
+            sub.close()

@@ -1,0 +1,3 @@
+from .identity import new_id, new_secret
+
+__all__ = ["new_id", "new_secret"]

@@ -1,0 +1,26 @@
+"""Random identifiers (reference: identity/randomid.go).
+
+IDs are 25-character base36 strings drawn from a cryptographic source, like
+the reference's, so they sort uniformly and are URL-safe.
+"""
+
+import secrets
+import string
+
+_ALPHABET = string.digits + string.ascii_lowercase
+_ID_LEN = 25
+# largest value representable in _ID_LEN base36 digits
+_MAX = 36 ** _ID_LEN
+
+
+def new_id() -> str:
+    n = secrets.randbelow(_MAX)
+    digits = []
+    for _ in range(_ID_LEN):
+        n, rem = divmod(n, 36)
+        digits.append(_ALPHABET[rem])
+    return "".join(reversed(digits))
+
+
+def new_secret(nbytes: int = 16) -> str:
+    return secrets.token_hex(nbytes)

@@ -1,0 +1,23 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU mesh so sharding tests (shard_map over
+the node axis) run without TPU hardware.
+
+The axon sitecustomize imports jax at interpreter start with
+JAX_PLATFORMS=axon already in the environment, so mutating os.environ here is
+too late for jax's config defaults — use jax.config.update instead (backend
+initialization is lazy, so this still takes effect as long as no test
+touched a device before conftest import, which pytest guarantees).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,300 @@
+"""MemoryStore semantics tests.
+
+Mirrors the reference's store test strategy (manager/state/store/memory_test.go):
+real store, nil proposer, watch-channel assertions.
+"""
+
+import threading
+
+import pytest
+
+from swarmkit_tpu.models import (
+    Annotations, Node, NodeSpec, Service, ServiceSpec, Task, TaskState,
+    TaskStatus, ReplicatedService, ServiceMode,
+)
+from swarmkit_tpu.state import (
+    All, AlreadyExists, ByName, ByNode, ByService, BySlot, ByDesiredState,
+    Event, EventCommit, MemoryStore, NameConflict, NotFound,
+    SequenceConflict, StoreAction, match,
+)
+from swarmkit_tpu.utils import new_id
+
+
+def make_service(name="web", replicas=3):
+    return Service(
+        id=new_id(),
+        spec=ServiceSpec(
+            annotations=Annotations(name=name),
+            mode=ServiceMode.REPLICATED,
+            replicated=ReplicatedService(replicas=replicas),
+        ),
+    )
+
+
+def make_task(service, slot=1, node_id=""):
+    return Task(id=new_id(), service_id=service.id, slot=slot,
+                node_id=node_id, desired_state=TaskState.RUNNING,
+                status=TaskStatus(state=TaskState.NEW))
+
+
+def test_create_get_update_delete():
+    s = MemoryStore()
+    svc = make_service()
+    s.update(lambda tx: tx.create(svc))
+
+    got = s.view(lambda tx: tx.get(Service, svc.id))
+    assert got.spec.annotations.name == "web"
+    assert got.meta.version.index == 1
+    assert got.meta.created_at > 0
+
+    got2 = got.copy()
+    got2.spec.replicated.replicas = 5
+    s.update(lambda tx: tx.update(got2))
+    got3 = s.view(lambda tx: tx.get(Service, svc.id))
+    assert got3.spec.replicated.replicas == 5
+    assert got3.meta.version.index == 2
+
+    s.update(lambda tx: tx.delete(Service, svc.id))
+    assert s.view(lambda tx: tx.get(Service, svc.id)) is None
+
+
+def test_sequence_conflict():
+    s = MemoryStore()
+    svc = make_service()
+    s.update(lambda tx: tx.create(svc))
+    stale = s.view(lambda tx: tx.get(Service, svc.id)).copy()
+    fresh = stale.copy()
+    s.update(lambda tx: tx.update(fresh))  # bumps version to 2
+    with pytest.raises(SequenceConflict):
+        s.update(lambda tx: tx.update(stale))
+
+
+def test_create_conflicts():
+    s = MemoryStore()
+    svc = make_service("web")
+    s.update(lambda tx: tx.create(svc))
+    with pytest.raises(AlreadyExists):
+        s.update(lambda tx: tx.create(svc.copy()))
+    other = make_service("WEB")  # case-insensitive name conflict
+    with pytest.raises(NameConflict):
+        s.update(lambda tx: tx.create(other))
+    with pytest.raises(NotFound):
+        s.update(lambda tx: tx.delete(Service, "nope"))
+
+
+def test_rollback_on_error():
+    s = MemoryStore()
+    svc = make_service()
+
+    def cb(tx):
+        tx.create(svc)
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        s.update(cb)
+    assert s.view(lambda tx: tx.get(Service, svc.id)) is None
+    assert s.version == 0
+
+
+def test_task_indexes():
+    s = MemoryStore()
+    svc_a, svc_b = make_service("a"), make_service("b")
+    tasks = [make_task(svc_a, slot=i, node_id=f"n{i % 2}") for i in range(1, 5)]
+    tasks += [make_task(svc_b, slot=1, node_id="n0")]
+
+    def cb(tx):
+        tx.create(svc_a)
+        tx.create(svc_b)
+        for t in tasks:
+            tx.create(t)
+
+    s.update(cb)
+    assert len(s.view(lambda tx: tx.find(Task, ByService(svc_a.id)))) == 4
+    assert len(s.view(lambda tx: tx.find(Task, ByNode("n0")))) == 3
+    assert len(s.view(lambda tx: tx.find(Task, BySlot(svc_a.id, 2)))) == 1
+    assert len(s.view(lambda tx: tx.find(Task, All()))) == 5
+    assert len(s.view(lambda tx: tx.find(
+        Task, ByDesiredState(TaskState.RUNNING)))) == 5
+
+    # node reassignment moves index membership
+    t = s.view(lambda tx: tx.get(Task, tasks[0].id)).copy()
+    t.node_id = "n9"
+    s.update(lambda tx: tx.update(t))
+    assert len(s.view(lambda tx: tx.find(Task, ByNode("n9")))) == 1
+    s.update(lambda tx: tx.delete(Task, t.id))
+    assert len(s.view(lambda tx: tx.find(Task, ByNode("n9")))) == 0
+
+
+def test_find_by_name():
+    s = MemoryStore()
+    s.update(lambda tx: tx.create(make_service("alpha")))
+    s.update(lambda tx: tx.create(make_service("beta")))
+    res = s.view(lambda tx: tx.find(Service, ByName("ALPHA")))
+    assert len(res) == 1 and res[0].spec.annotations.name == "alpha"
+
+
+def test_watch_events():
+    s = MemoryStore()
+    sub = s.queue.subscribe(match(Task, actions=("create", "update")))
+    svc = make_service()
+    t = make_task(svc)
+
+    def cb(tx):
+        tx.create(svc)
+        tx.create(t)
+
+    s.update(cb)
+    ev = sub.get(timeout=1)
+    assert isinstance(ev, Event) and ev.action == "create"
+    assert ev.obj.id == t.id
+
+    t2 = s.view(lambda tx: tx.get(Task, t.id)).copy()
+    t2.status.state = TaskState.RUNNING
+    s.update(lambda tx: tx.update(t2))
+    ev = sub.get(timeout=1)
+    assert ev.action == "update"
+    assert ev.obj.status.state == TaskState.RUNNING
+    assert ev.old.status.state == TaskState.NEW
+
+
+def test_commit_event_per_transaction():
+    s = MemoryStore()
+    sub = s.queue.subscribe(lambda e: isinstance(e, EventCommit))
+    svc = make_service()
+    s.update(lambda tx: tx.create(svc))
+    ev = sub.get(timeout=1)
+    assert isinstance(ev, EventCommit)
+
+
+def test_view_and_watch_atomicity():
+    s = MemoryStore()
+    svc = make_service()
+    s.update(lambda tx: tx.create(svc))
+    snapshot, sub = s.view_and_watch(lambda tx: tx.find(Service, All()))
+    assert len(snapshot) == 1
+    s.update(lambda tx: tx.create(make_service("other")))
+    ev = sub.get(timeout=1)
+    assert isinstance(ev, (Event, EventCommit))
+
+
+def test_batch_splits_transactions():
+    s = MemoryStore()
+    commits = []
+    sub = s.queue.subscribe(lambda e: isinstance(e, EventCommit))
+
+    def cb(batch):
+        svc = make_service()
+        batch.update(lambda tx: tx.create(svc))
+        for i in range(450):
+            t = make_task(svc, slot=i)
+            batch.update(lambda tx, t=t: tx.create(t))
+
+    s.batch(cb)
+    assert len(s.view(lambda tx: tx.find(Task, All()))) == 450
+    while True:
+        ev = sub.poll()
+        if ev is None:
+            break
+        commits.append(ev)
+    assert len(commits) == 3  # 451 changes / 200 per tx
+
+
+def test_save_restore():
+    s = MemoryStore()
+    svc = make_service()
+    t = make_task(svc)
+
+    def cb(tx):
+        tx.create(svc)
+        tx.create(t)
+
+    s.update(cb)
+    snap = s.save()
+
+    s2 = MemoryStore()
+    s2.restore(snap)
+    assert s2.view(lambda tx: tx.get(Service, svc.id)).id == svc.id
+    assert len(s2.view(lambda tx: tx.find(Task, ByService(svc.id)))) == 1
+    assert s2.version == s.version
+    # indexes rebuilt
+    assert len(s2.view(lambda tx: tx.find(Service, ByName("web")))) == 1
+
+
+def test_apply_store_actions_follower_replay():
+    leader = MemoryStore()
+    follower = MemoryStore()
+    svc = make_service()
+    leader.update(lambda tx: tx.create(svc))
+    committed = leader.view(lambda tx: tx.get(Service, svc.id))
+
+    follower.apply_store_actions([StoreAction("create", committed)])
+    got = follower.view(lambda tx: tx.get(Service, svc.id))
+    assert got is not None
+    assert got.meta.version.index == committed.meta.version.index
+
+
+def test_proposer_seam():
+    proposed = []
+
+    class P:
+        def propose(self, actions):
+            proposed.append(list(actions))
+
+
+    s = MemoryStore(proposer=P())
+    svc = make_service()
+    s.update(lambda tx: tx.create(svc))
+    assert len(proposed) == 1
+    assert proposed[0][0].action == "create"
+
+    class Failing:
+        def propose(self, actions):
+            raise RuntimeError("no quorum")
+
+
+    s2 = MemoryStore(proposer=Failing())
+    with pytest.raises(RuntimeError):
+        s2.update(lambda tx: tx.create(make_service("x")))
+    assert s2.view(lambda tx: tx.get(Service, svc.id)) is None
+
+
+def test_staged_reads_within_tx():
+    s = MemoryStore()
+    svc = make_service()
+
+    def cb(tx):
+        tx.create(svc)
+        assert tx.get(Service, svc.id) is not None
+        assert len(tx.find(Service, All())) == 1
+        tx.delete(Service, svc.id)
+        assert tx.get(Service, svc.id) is None
+
+    s.update(cb)
+    assert s.view(lambda tx: tx.get(Service, svc.id)) is None
+
+
+def test_concurrent_updates():
+    s = MemoryStore()
+    svc = make_service()
+    s.update(lambda tx: tx.create(svc))
+    errors = []
+
+    def worker(n):
+        for _ in range(50):
+            try:
+                def cb(tx):
+                    cur = tx.get(Service, svc.id).copy()
+                    cur.spec.replicated.replicas += 1
+                    tx.update(cur)
+                s.update(cb)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    final = s.view(lambda tx: tx.get(Service, svc.id))
+    assert final.spec.replicated.replicas == 3 + 200

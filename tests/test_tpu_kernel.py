@@ -1,0 +1,332 @@
+"""TPU scheduling kernel tests: unit, differential vs host oracle, sharded.
+
+Runs on a virtual 8-device CPU mesh (see conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+from swarmkit_tpu.models import (
+    Annotations, Node, PlacementPreference, Platform, PortConfig, PublishMode,
+    Resources, Service, SpreadOver, Task, TaskState,
+)
+from swarmkit_tpu.models.types import PortProtocol
+from swarmkit_tpu.ops import (
+    GroupInputs, NodeInputs, TPUPlanner, plan_group_jit, seg_waterfill,
+    str_hash,
+)
+from swarmkit_tpu.scheduler import Scheduler
+from swarmkit_tpu.state import ByService, MemoryStore
+
+from test_scheduler import (  # reuse fixtures/helpers
+    make_ready_node, make_service_with_tasks,
+)
+
+import jax.numpy as jnp
+
+
+# ----------------------------------------------------------------- waterfill
+
+def wf(e, cap, tie, k_seg, seg, L):
+    return np.asarray(seg_waterfill(
+        jnp.asarray(e, jnp.int32), jnp.asarray(cap, jnp.int32),
+        jnp.asarray(tie, jnp.int32), jnp.asarray(k_seg, jnp.int32),
+        jnp.asarray(seg, jnp.int32), L))
+
+
+def test_waterfill_flat_even():
+    x = wf(e=[0, 0, 0, 0], cap=[10] * 4, tie=[0, 1, 2, 3],
+           k_seg=[8], seg=[0] * 4, L=1)
+    assert list(x) == [2, 2, 2, 2]
+
+
+def test_waterfill_levels_existing_load():
+    # nodes already at levels 3,0,1 -> new 5 tasks should level to 3: [0,4,1]?
+    # level to λ: fill nodes below. total = 5: levels become [3,4,3]? λ=4:
+    # fill = (4-3)+(4-0)+(4-1) = 1+4+3 = 8 >= 5; λ-1=3: 0+3+2=5 = exactly 5.
+    x = wf(e=[3, 0, 1], cap=[10] * 3, tie=[0, 1, 2],
+           k_seg=[5], seg=[0] * 3, L=1)
+    assert list(x) == [0, 3, 2]
+
+
+def test_waterfill_remainder_tiebreak():
+    # all equal level; 2 tasks on 3 nodes; tie prefers lowest key
+    x = wf(e=[0, 0, 0], cap=[5] * 3, tie=[2, 0, 1],
+           k_seg=[2], seg=[0] * 3, L=1)
+    assert list(x) == [0, 1, 1]
+
+
+def test_waterfill_respects_caps():
+    x = wf(e=[0, 0], cap=[1, 10], tie=[0, 1], k_seg=[6], seg=[0, 0], L=1)
+    assert list(x) == [1, 5]
+
+
+def test_waterfill_infeasible_partial():
+    x = wf(e=[0, 0], cap=[1, 1], tie=[0, 1], k_seg=[5], seg=[0, 0], L=1)
+    assert list(x) == [1, 1]  # places what it can
+
+
+def test_waterfill_segments_independent():
+    x = wf(e=[0, 0, 0, 0], cap=[9] * 4, tie=[0, 1, 2, 3],
+           k_seg=[2, 4], seg=[0, 0, 1, 1], L=2)
+    assert list(x) == [1, 1, 2, 2]
+
+
+def test_waterfill_downweighted_node_last():
+    # node 0 heavily down-weighted (failures): used only after others full
+    from swarmkit_tpu.ops.kernel import F_BIG
+    x = wf(e=[5 * F_BIG, 0, 0], cap=[5, 2, 2], tie=[0, 1, 2],
+           k_seg=[4], seg=[0] * 3, L=1)
+    assert list(x) == [0, 2, 2]
+    x = wf(e=[5 * F_BIG, 0, 0], cap=[5, 2, 2], tie=[0, 1, 2],
+           k_seg=[6], seg=[0] * 3, L=1)
+    assert list(x) == [2, 2, 2]  # overflow lands on the down-weighted node
+
+
+# ------------------------------------------------------------ plan via store
+
+def run_schedulers(nodes, svc, tasks, planner=None):
+    """Create store, run one synchronous scheduler pass, return tasks."""
+    store = MemoryStore()
+
+    def setup(tx):
+        for n in nodes:
+            tx.create(n)
+        tx.create(svc)
+        for t in tasks:
+            tx.create(t)
+
+    store.update(setup)
+    sched = Scheduler(store, batch_planner=planner)
+    store.view(sched._setup_tasks_list)
+    sched.tick()
+    return store, sched, store.view(
+        lambda tx: tx.find(Task, ByService(svc.id)))
+
+
+def per_node_counts(tasks):
+    counts = {}
+    for t in tasks:
+        if t.node_id:
+            counts[t.node_id] = counts.get(t.node_id, 0) + 1
+    return counts
+
+
+def assert_distribution_matches(nodes, svc, make_tasks):
+    """Differential: host oracle vs TPU planner yield the same per-node
+    assignment-count distribution (tie order is a documented waiver)."""
+    svc_o, tasks_o = make_tasks()
+    _, _, host_tasks = run_schedulers(nodes, svc_o, tasks_o, planner=None)
+    nodes2 = [n.copy() for n in nodes]
+    svc_t, tasks_t = make_tasks()
+    _, sched, tpu_tasks = run_schedulers(nodes2, svc_t, tasks_t,
+                                         planner=TPUPlanner())
+    assert sched.batch_planner.stats["groups_planned"] >= 1
+
+    host_counts = per_node_counts(host_tasks)
+    tpu_counts = per_node_counts(tpu_tasks)
+    assert sum(host_counts.values()) == sum(tpu_counts.values())
+    assert sorted(host_counts.values()) == sorted(tpu_counts.values())
+    return host_tasks, tpu_tasks
+
+
+def test_tpu_basic_spread():
+    nodes = [make_ready_node(f"n{i}") for i in range(5)]
+    svc, tasks = make_service_with_tasks(10)
+    _, sched, got = run_schedulers(nodes, svc, tasks, planner=TPUPlanner())
+    counts = per_node_counts(got)
+    assert sorted(counts.values()) == [2, 2, 2, 2, 2]
+    assert sched.batch_planner.stats["tasks_planned"] == 10
+
+
+def test_tpu_respects_resources():
+    nodes = [make_ready_node("big", cpus=8),
+             make_ready_node("small", cpus=1)]
+    svc, tasks = make_service_with_tasks(
+        6, reservations=Resources(nano_cpus=10**9))
+    _, _, got = run_schedulers(nodes, svc, tasks, planner=TPUPlanner())
+    counts = per_node_counts(got)
+    by_name = {n.id: n.spec.annotations.name for n in nodes}
+    named = {by_name[k]: v for k, v in counts.items()}
+    assert named == {"big": 5, "small": 1}
+
+
+def test_tpu_constraints():
+    nodes = [make_ready_node("ssd1", labels={"disk": "ssd"}),
+             make_ready_node("ssd2", labels={"disk": "ssd"}),
+             make_ready_node("hdd1", labels={"disk": "hdd"})]
+    svc, tasks = make_service_with_tasks(
+        4, constraints=["node.labels.disk==ssd"])
+    _, _, got = run_schedulers(nodes, svc, tasks, planner=TPUPlanner())
+    ssd_ids = {nodes[0].id, nodes[1].id}
+    assert all(t.node_id in ssd_ids for t in got if t.node_id)
+    assert sum(1 for t in got if t.node_id) == 4
+
+
+def test_tpu_not_constraint():
+    nodes = [make_ready_node("a", labels={"zone": "1"}),
+             make_ready_node("b", labels={"zone": "2"})]
+    svc, tasks = make_service_with_tasks(
+        2, constraints=["node.labels.zone != 1"])
+    _, _, got = run_schedulers(nodes, svc, tasks, planner=TPUPlanner())
+    assert all(t.node_id == nodes[1].id for t in got if t.node_id)
+    assert sum(1 for t in got if t.node_id) == 2
+
+
+def test_tpu_platform_filter():
+    nodes = [make_ready_node("lin", os="linux", arch="amd64"),
+             make_ready_node("win", os="windows", arch="amd64")]
+    svc, tasks = make_service_with_tasks(
+        2, platforms=[Platform(architecture="x86_64", os="linux")])
+    _, _, got = run_schedulers(nodes, svc, tasks, planner=TPUPlanner())
+    assert all(t.node_id == nodes[0].id for t in got if t.node_id)
+    assert sum(1 for t in got if t.node_id) == 2
+
+
+def test_tpu_max_replicas():
+    nodes = [make_ready_node(f"n{i}") for i in range(3)]
+    svc, tasks = make_service_with_tasks(9, max_replicas=2)
+    _, _, got = run_schedulers(nodes, svc, tasks, planner=TPUPlanner())
+    counts = per_node_counts(got)
+    assert sorted(counts.values()) == [2, 2, 2]
+    unassigned = [t for t in got if not t.node_id]
+    assert len(unassigned) == 3
+
+
+def test_tpu_host_ports():
+    nodes = [make_ready_node(f"n{i}") for i in range(3)]
+    port = PortConfig(protocol=PortProtocol.TCP, target_port=80,
+                      published_port=8080, publish_mode=PublishMode.HOST)
+    svc, tasks = make_service_with_tasks(5, ports=[port])
+    _, _, got = run_schedulers(nodes, svc, tasks, planner=TPUPlanner())
+    counts = per_node_counts(got)
+    assert sorted(counts.values()) == [1, 1, 1]  # one per node max
+    assert sum(1 for t in got if not t.node_id) == 2
+
+
+def test_tpu_drained_and_down_nodes_excluded():
+    from swarmkit_tpu.models import NodeAvailability, NodeState
+    ok = make_ready_node("ok")
+    drained = make_ready_node("drained",
+                              availability=NodeAvailability.DRAIN)
+    down = make_ready_node("down")
+    down.status.state = NodeState.DOWN
+    svc, tasks = make_service_with_tasks(3)
+    _, _, got = run_schedulers([ok, drained, down], svc, tasks,
+                               planner=TPUPlanner())
+    assert all(t.node_id == ok.id for t in got if t.node_id)
+    assert sum(1 for t in got if t.node_id) == 3
+
+
+def test_tpu_spread_preference():
+    nodes = []
+    for dc in ("east", "west", "north"):
+        for i in range(2):
+            nodes.append(make_ready_node(f"{dc}{i}",
+                                         labels={"dc": dc}))
+    prefs = [PlacementPreference(
+        spread=SpreadOver(spread_descriptor="node.labels.dc"))]
+    svc, tasks = make_service_with_tasks(9, prefs=prefs)
+    _, _, got = run_schedulers(nodes, svc, tasks, planner=TPUPlanner())
+    node_dc = {n.id: n.spec.annotations.labels["dc"] for n in nodes}
+    per_dc = {}
+    for t in got:
+        per_dc[node_dc[t.node_id]] = per_dc.get(node_dc[t.node_id], 0) + 1
+    assert sorted(per_dc.values()) == [3, 3, 3]
+
+
+def test_differential_uniform():
+    nodes = [make_ready_node(f"n{i}") for i in range(7)]
+    assert_distribution_matches(
+        nodes, None, lambda: make_service_with_tasks(23))
+
+
+def test_differential_resources():
+    rng = np.random.RandomState(42)
+    nodes = [make_ready_node(f"n{i}", cpus=int(rng.randint(1, 16)))
+             for i in range(9)]
+    assert_distribution_matches(
+        nodes, None,
+        lambda: make_service_with_tasks(
+            30, reservations=Resources(nano_cpus=2 * 10**9)))
+
+
+def test_differential_constraints_and_platform():
+    rng = np.random.RandomState(7)
+    nodes = []
+    for i in range(12):
+        nodes.append(make_ready_node(
+            f"n{i}", cpus=int(rng.randint(2, 8)),
+            labels={"tier": rng.choice(["web", "db"])},
+            os="linux" if rng.rand() < 0.8 else "windows"))
+    assert_distribution_matches(
+        nodes, None,
+        lambda: make_service_with_tasks(
+            15, constraints=["node.labels.tier==web"],
+            platforms=[Platform(os="linux")],
+            reservations=Resources(nano_cpus=10**9)))
+
+
+def test_differential_spread_preference():
+    rng = np.random.RandomState(3)
+    nodes = []
+    for i in range(10):
+        nodes.append(make_ready_node(
+            f"n{i}", labels={"rack": f"r{i % 3}"}))
+    prefs = [PlacementPreference(
+        spread=SpreadOver(spread_descriptor="node.labels.rack"))]
+    assert_distribution_matches(
+        nodes, None, lambda: make_service_with_tasks(12, prefs=prefs))
+
+
+def test_tpu_no_suitable_node_explanation():
+    """The device path must preserve user-visible scheduling diagnostics
+    (SURVEY.md §5.5: task Status.Err written from filter failure counts)."""
+    nodes = [make_ready_node("tiny", cpus=1)]
+    svc, tasks = make_service_with_tasks(
+        1, reservations=Resources(nano_cpus=64 * 10**9))
+    _, _, got = run_schedulers(nodes, svc, tasks, planner=TPUPlanner())
+    assert got[0].node_id == ""
+    assert got[0].status.err == \
+        "no suitable node (insufficient resources on 1 node)"
+
+
+# ------------------------------------------------------------------- sharded
+
+def test_sharded_matches_single_device():
+    import jax
+    from swarmkit_tpu.parallel import ShardedPlanFn, make_mesh
+
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+
+    n, nb = 100, 128
+    rng = np.random.RandomState(0)
+    valid = np.zeros(nb, bool); valid[:n] = True
+    ready = valid.copy()
+    cpu = np.zeros(nb, np.float32); cpu[:n] = rng.randint(1, 9, n) * 1e9
+    mem = np.zeros(nb, np.float32); mem[:n] = 32e9
+    svc_tasks = np.zeros(nb, np.int32)
+    svc_tasks[:n] = rng.randint(0, 4, n)
+    total = svc_tasks * 2
+    nodes = NodeInputs(
+        valid=valid, ready=ready, cpu=cpu, mem=mem,
+        gen=np.zeros((1, nb), np.float32),
+        svc_tasks=svc_tasks, total_tasks=total,
+        failures=np.zeros(nb, np.int32), leaf=np.zeros(nb, np.int32),
+        os_hash=np.zeros((2, nb), np.int32),
+        arch_hash=np.zeros((2, nb), np.int32),
+        port_conflict=np.zeros(nb, bool), extra_mask=np.ones(nb, bool))
+    group = GroupInputs(
+        k=np.int32(57), cpu_d=np.float32(1e9), mem_d=np.float32(0),
+        gen_d=np.zeros(1, np.float32),
+        con_hash=np.zeros((1, 2, nb), np.int32),
+        con_op=np.full(1, 2, np.int32), con_exp=np.zeros((1, 2), np.int32),
+        plat=np.full((1, 4), -1, np.int32), maxrep=np.int32(0),
+        port_limited=np.bool_(False))
+
+    single, counts_s = plan_group_jit(nodes, group, 1)
+    sharded, counts_m = ShardedPlanFn(make_mesh())(nodes, group, 1)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(sharded))
+    np.testing.assert_array_equal(np.asarray(counts_s),
+                                  np.asarray(counts_m))
+    assert np.asarray(single).sum() == 57
