@@ -1,0 +1,141 @@
+"""Headline benchmark: scheduling decisions/sec at 100k tasks × 10k nodes.
+
+Matches BASELINE.json config 4/5 scale (the reference's
+BenchmarkScheduler100kNodes*/1kNodes* family,
+manager/scheduler/scheduler_test.go:3338-3376): one big task group scheduled
+onto a 10k-node cluster through the full path — store → scheduler tick →
+(TPU plan | host oracle) → batched store commit — measured from tick start
+to all ASSIGNED rows committed.
+
+Baseline: the Go toolchain is not present in this image, so the reference's
+own benches cannot run here.  ``vs_baseline`` therefore compares against the
+**host oracle path** (the faithful reimplementation of the reference
+algorithm) measured in this same process on a proportionally scaled workload
+(same 10k nodes, BASELINE_TASKS tasks), normalized per decision.  See
+BASELINE.md for the methodology note.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "decisions/sec", "vs_baseline": N, ...}
+
+Env overrides: BENCH_NODES, BENCH_TASKS, BENCH_BASELINE_TASKS, BENCH_SKIP_HOST.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_NODES = int(os.environ.get("BENCH_NODES", 10_000))
+N_TASKS = int(os.environ.get("BENCH_TASKS", 100_000))
+BASELINE_TASKS = int(os.environ.get("BENCH_BASELINE_TASKS", 5_000))
+SKIP_HOST = os.environ.get("BENCH_SKIP_HOST", "") == "1"
+
+
+def build_cluster(n_nodes, n_tasks):
+    from swarmkit_tpu.models import (
+        Annotations, Node, NodeDescription, NodeSpec, NodeState, NodeStatus,
+        Placement, ReplicatedService, Resources, ResourceRequirements,
+        Service, ServiceMode, ServiceSpec, Task, TaskSpec, TaskState,
+        TaskStatus, Version,
+    )
+    from swarmkit_tpu.state import MemoryStore
+    from swarmkit_tpu.utils import new_id
+
+    store = MemoryStore()
+    nodes = [
+        Node(id=new_id(),
+             spec=NodeSpec(annotations=Annotations(
+                 name=f"node-{i:05d}", labels={"rack": f"r{i % 20}"})),
+             status=NodeStatus(state=NodeState.READY),
+             description=NodeDescription(
+                 hostname=f"node-{i:05d}",
+                 resources=Resources(nano_cpus=32 * 10**9,
+                                     memory_bytes=128 << 30)))
+        for i in range(n_nodes)
+    ]
+    svc = Service(
+        id=new_id(),
+        spec=ServiceSpec(annotations=Annotations(name="bench"),
+                         mode=ServiceMode.REPLICATED,
+                         replicated=ReplicatedService(replicas=n_tasks)),
+        spec_version=Version(index=1))
+    shared_spec = TaskSpec(
+        resources=ResourceRequirements(
+            reservations=Resources(nano_cpus=10**9,
+                                   memory_bytes=1 << 30)))
+    tasks = [
+        Task(id=new_id(), service_id=svc.id, slot=s,
+             desired_state=TaskState.RUNNING, spec=shared_spec,
+             spec_version=Version(index=1),
+             status=TaskStatus(state=TaskState.PENDING))
+        for s in range(1, n_tasks + 1)
+    ]
+
+    def setup(tx):
+        for n in nodes:
+            tx.create(n)
+        tx.create(svc)
+
+    store.update(setup)
+
+    def add_tasks(tx):
+        for t in tasks:
+            tx.create(t)
+
+    store.update(add_tasks)
+    return store, svc
+
+
+def run_path(n_nodes, n_tasks, planner):
+    from swarmkit_tpu.scheduler import Scheduler
+
+    store, svc = build_cluster(n_nodes, n_tasks)
+    sched = Scheduler(store, batch_planner=planner)
+    store.view(sched._setup_tasks_list)
+    t0 = time.perf_counter()
+    n_dec = sched.tick()
+    dt = time.perf_counter() - t0
+    assert n_dec == n_tasks, f"scheduled {n_dec}/{n_tasks}"
+    return n_dec / dt, dt
+
+
+def main():
+    from swarmkit_tpu.ops import TPUPlanner
+
+    # warm the kernel compile cache out of the timed region — must use the
+    # same node count so the padded N bucket (and thus the jit cache key)
+    # matches the measured run
+    run_path(N_NODES, 64, TPUPlanner())
+
+    planner = TPUPlanner()
+    tpu_dps, tpu_dt = run_path(N_NODES, N_TASKS, planner)
+    assert planner.stats["groups_planned"] >= 1, "TPU path did not engage"
+
+    assert planner.stats["tasks_planned"] == N_TASKS, planner.stats
+    plan_dps = (planner.stats["tasks_planned"]
+                / max(planner.stats["plan_seconds"], 1e-9))
+
+    if SKIP_HOST:
+        host_dps = None
+        vs = 0.0
+    else:
+        host_dps, _ = run_path(N_NODES, BASELINE_TASKS, None)
+        vs = tpu_dps / host_dps
+
+    print(json.dumps({
+        "metric": f"scheduling decisions/sec, {N_TASKS // 1000}k tasks x "
+                  f"{N_NODES // 1000}k nodes (single tick, store-committed)",
+        "value": round(tpu_dps, 1),
+        "unit": "decisions/sec",
+        "vs_baseline": round(vs, 2),
+        "tick_seconds": round(tpu_dt, 3),
+        "plan_phase_decisions_per_sec": round(plan_dps, 1),
+        "baseline": "host-oracle path (Go toolchain unavailable; see BASELINE.md)",
+        "baseline_decisions_per_sec": round(host_dps, 1) if host_dps else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -148,22 +148,21 @@ class Task:
     volumes: List[VolumeAttachment] = field(default_factory=list)
 
     def copy(self) -> "Task":
-        # Specs are immutable once attached to a task (the system "never
-        # modifies" a spec — api/objects.proto:203); sharing the reference
-        # makes task copies cheap on the scheduler/dispatcher hot paths.
-        # Anyone changing a task's spec must attach a *new* spec object.
-        return Task(
-            self.id, self.meta.copy(), self.spec,
-            self.spec_version.copy() if self.spec_version else None,
-            self.service_id, self.slot, self.node_id,
-            self.annotations.copy(), self.service_annotations.copy(),
-            self.status.copy(), self.desired_state,
-            [n.copy() for n in self.networks],
-            self.endpoint.copy() if self.endpoint else None,
-            self.log_driver.copy() if self.log_driver else None,
-            list(self.assigned_generic_resources),
-            self.job_iteration.copy() if self.job_iteration else None,
-            [v.copy() for v in self.volumes])
+        # Hot path: tasks are copied once per scheduling decision and once
+        # per store write.  Fields follow a replace-don't-mutate convention
+        # (spec/annotations/spec_version/endpoint/log_driver are immutable
+        # once attached — the system "never modifies" a spec,
+        # api/objects.proto:203 — so they are shared by reference); only
+        # meta/status (stamped by the store / scheduler) and the list
+        # containers are isolated.
+        new = object.__new__(Task)
+        new.__dict__.update(self.__dict__)
+        new.meta = self.meta.copy()
+        new.status = self.status.copy()
+        new.networks = list(self.networks)
+        new.assigned_generic_resources = list(self.assigned_generic_resources)
+        new.volumes = list(self.volumes)
+        return new
 
 
 @dataclass

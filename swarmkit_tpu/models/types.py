@@ -319,7 +319,10 @@ class TaskStatus:
     applied_at: float = 0.0
 
     def copy(self) -> "TaskStatus":
-        return dataclasses.replace(self)
+        # hot path (copied with every Task.copy): avoid dataclasses.replace
+        new = object.__new__(TaskStatus)
+        new.__dict__.update(self.__dict__)
+        return new
 
 
 class PortProtocol(enum.IntEnum):

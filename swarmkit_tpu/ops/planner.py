@@ -80,7 +80,7 @@ class TPUPlanner:
         self._plan_fn = plan_fn or plan_group_jit
         self.last_explanation = ""
         self.stats = {"groups_planned": 0, "groups_fallback": 0,
-                      "tasks_planned": 0}
+                      "tasks_planned": 0, "plan_seconds": 0.0}
 
     # explanation builders, pipeline order (matches kernel fail_counts rows
     # and the host filters' Explain strings — filter.go)
@@ -195,6 +195,8 @@ class TPUPlanner:
             self.stats["groups_fallback"] += 1
             return False
 
+        import time as _time
+        _plan_t0 = _time.perf_counter()
         infos, n, nb, valid, ready, cpu, mem, total = self._densify(sched, t)
         if n == 0:
             return False
@@ -349,29 +351,28 @@ class TPUPlanner:
         x, fail_counts = self._plan_fn(nodes_in, group_in, L)
         x = np.asarray(x)
         self.last_explanation = self._explain(np.asarray(fail_counts))
+        self.stats["plan_seconds"] += _time.perf_counter() - _plan_t0
 
         # ---- apply: expand per-node counts into per-task decisions
-        slots: List[int] = []
-        for i in np.nonzero(x)[0]:
-            slots.extend([int(i)] * int(x[i]))
+        from ..scheduler.scheduler import SchedulingDecision
+        slots = np.repeat(np.arange(x.shape[0]), x)
+        items = [(tid, tk) for tid, tk in task_group.items()
+                 if tid not in decisions]
+        ts_now = now()
+        all_tasks = sched.all_tasks
         placed = 0
-        for task_id, task in list(task_group.items()):
-            if task_id in decisions:
-                continue
-            if placed >= len(slots):
-                break
-            info = infos[slots[placed]]
-            placed += 1
+        for (task_id, task), node_i in zip(items, slots):
+            info = infos[int(node_i)]
             new_t = task.copy()
             new_t.node_id = info.id
             new_t.status = TaskStatus(
-                state=TaskState.ASSIGNED, timestamp=now(),
+                state=TaskState.ASSIGNED, timestamp=ts_now,
                 message="scheduler assigned task to node")
-            sched.all_tasks[task.id] = new_t
+            all_tasks[task_id] = new_t
             info.add_task(new_t)
-            from ..scheduler.scheduler import SchedulingDecision
             decisions[task_id] = SchedulingDecision(task, new_t)
             del task_group[task_id]
+            placed += 1
 
         self.stats["groups_planned"] += 1
         self.stats["tasks_planned"] += placed

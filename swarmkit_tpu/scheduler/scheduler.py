@@ -358,61 +358,73 @@ class Scheduler:
             if not decisions:
                 return successful, failed
 
+            def commit_one(tx, decision: SchedulingDecision) -> None:
+                t = tx.get(Task, decision.old.id)
+                if t is None:
+                    self._delete_task(decision.new)
+                    return
+                new_status = decision.new.status
+                old_status = t.status
+                if (old_status.state == new_status.state
+                        and old_status.message == new_status.message
+                        and old_status.err == new_status.err):
+                    return
+                if old_status.state >= TaskState.ASSIGNED:
+                    # already assigned by someone else; check node version
+                    info = self.node_set.node_info(decision.new.node_id)
+                    if info is None:
+                        failed.append(decision)
+                        return
+                    node = tx.get(Node, decision.new.node_id)
+                    if (node is None or node.meta.version.index
+                            != info.node.meta.version.index):
+                        failed.append(decision)
+                        return
+                volumes_to_update = []
+                for va in decision.new.volumes:
+                    v = tx.get(Volume, va.id)
+                    if v is None:
+                        failed.append(decision)
+                        return
+                    if v.spec.availability != 0:  # not ACTIVE
+                        failed.append(decision)
+                        return
+                    if not any(ps.node_id == decision.new.node_id
+                               for ps in v.publish_status):
+                        v = v.copy()
+                        from ..models.types import VolumePublishStatus
+                        v.publish_status.append(VolumePublishStatus(
+                            node_id=decision.new.node_id,
+                            state=VolumePublishStatus.State.PENDING_PUBLISH))
+                        volumes_to_update.append(v)
+                # tx.update defensively copies, so stamping the store's
+                # meta onto the mirror object is safe and avoids a second
+                # deep copy on the hot path
+                decision.new.meta = t.meta
+                try:
+                    tx.update(decision.new)
+                except Exception:
+                    failed.append(decision)
+                    return
+                for v in volumes_to_update:
+                    tx.update(v)
+                successful.append(decision)
+
+            # Batch bounds each transaction/raft proposal by actual change
+            # count (decisions may add volume updates beyond one change each)
             def cb(batch: Batch) -> None:
-                for task_id, decision in decisions.items():
-                    def one(tx, task_id=task_id, decision=decision) -> None:
-                        t = tx.get(Task, task_id)
-                        if t is None:
-                            self._delete_task(decision.new)
-                            return
-                        if (t.status.state == decision.new.status.state
-                                and t.status.message == decision.new.status.message
-                                and t.status.err == decision.new.status.err):
-                            return
-                        if t.status.state >= TaskState.ASSIGNED:
-                            # already assigned by someone else; check node
-                            info = self.node_set.node_info(
-                                decision.new.node_id)
-                            if info is None:
-                                failed.append(decision)
-                                return
-                            node = tx.get(Node, decision.new.node_id)
-                            if (node is None or node.meta.version.index
-                                    != info.node.meta.version.index):
-                                failed.append(decision)
-                                return
-                        volumes_to_update = []
-                        for va in decision.new.volumes:
-                            v = tx.get(Volume, va.id)
-                            if v is None:
-                                failed.append(decision)
-                                return
-                            if v.spec.availability != 0:  # not ACTIVE
-                                failed.append(decision)
-                                return
-                            if not any(ps.node_id == decision.new.node_id
-                                       for ps in v.publish_status):
-                                v = v.copy()
-                                from ..models.types import VolumePublishStatus
-                                v.publish_status.append(VolumePublishStatus(
-                                    node_id=decision.new.node_id,
-                                    state=VolumePublishStatus.State.PENDING_PUBLISH))
-                                volumes_to_update.append(v)
-                        committed = decision.new.copy()
-                        committed.meta = t.meta.copy()
-                        try:
-                            tx.update(committed)
-                        except Exception:
-                            failed.append(decision)
-                            return
-                        for v in volumes_to_update:
-                            tx.update(v)
-                        successful.append(decision)
-                    batch.update(one)
+                for decision in decisions.values():
+                    batch.update(
+                        lambda tx, d=decision: commit_one(tx, d))
 
             self.store.batch(cb)
             return successful, failed
         except Exception:
+            # Reference-parity behavior (scheduler.go:639-644): on a batch
+            # error, treat everything as failed so tasks are rolled back in
+            # the mirror and re-enqueued.  Earlier sub-transactions may have
+            # committed (best-effort batch) — the re-scheduled tasks then
+            # hit the status-unchanged early return or node-version check.
             log.exception("scheduler tick transaction failed")
             failed.extend(successful)
             return [], failed

@@ -264,8 +264,9 @@ class ReadTx:
         self._store = store
 
     def get(self, kind: Type, id: str) -> Optional[Any]:
-        with self._store._lock:
-            return self._store._tables[kind.collection].objects.get(id)
+        # single dict lookup: GIL-atomic against _commit's dict writes, and
+        # stored objects are immutable — no lock needed on this hot path
+        return self._store._tables[kind.collection].objects.get(id)
 
     def find(self, kind: Type, by: By = All()) -> List[Any]:
         with self._store._lock:
@@ -439,27 +440,36 @@ class MemoryStore:
         with self._update_lock:
             tx = WriteTx(self)
             result = cb(tx)   # exceptions roll back (nothing committed yet)
-            if tx._changes:
-                with self._lock:
-                    seq = self._version
-                for change in tx._changes:
-                    seq += 1
-                    if change.action in ("create", "update"):
-                        change.obj.meta.version.index = seq
-                if self._proposer is not None:
-                    self._proposer.propose(tx._changes)
-            self._commit(tx)
+            self._propose_and_commit(tx)
             return result
 
+    def _propose_and_commit(self, tx: "WriteTx") -> None:
+        """Stamp versions, run consensus, apply.  Caller holds _update_lock."""
+        if tx._changes:
+            with self._lock:
+                seq = self._version
+            for change in tx._changes:
+                seq += 1
+                if change.action in ("create", "update"):
+                    change.obj.meta.version.index = seq
+            if self._proposer is not None:
+                self._proposer.propose(tx._changes)
+        self._commit(tx)
+
     def batch(self, cb: Callable[["Batch"], Any]) -> Any:
-        """Split a large write into ≤MAX_CHANGES_PER_TX transactions
-        (reference: memory.go:531)."""
+        """Split a large write into transactions bounded by
+        MAX_CHANGES_PER_TX *store changes* (reference: memory.go:531).
+
+        Sub-transactions commit incrementally (best-effort): an error midway
+        leaves earlier flushes committed, like the reference.
+        """
         b = Batch(self)
         try:
             result = cb(b)
-        finally:
             b._flush()
-        return result
+            return result
+        finally:
+            b._abort()
 
     def _commit(self, tx: WriteTx) -> None:
         if not tx._changes:
@@ -652,31 +662,46 @@ class MemoryStore:
 
 
 class Batch:
-    """Accumulates updates, committing every MAX_CHANGES_PER_TX changes
-    (reference: memory.go:531)."""
+    """Accumulates updates in one open transaction, committing whenever the
+    staged *change count* reaches MAX_CHANGES_PER_TX — the bound a single
+    raft proposal must respect (reference: memory.go:45-51, :531).
+
+    Callbacks run immediately against the open transaction; the writer lock
+    is held from the first update until the enclosing ``store.batch`` call
+    returns (flush or abort).
+    """
 
     def __init__(self, store: MemoryStore):
         self._store = store
-        self._pending: List[Callable[[WriteTx], Any]] = []
-        self._count = 0
-        self.applied = 0
-        self.committed = 0
+        self._tx: Optional[WriteTx] = None
+        self.applied = 0    # callbacks run
+        self.committed = 0  # changes committed
 
-    def update(self, cb: Callable[[WriteTx], Any]) -> None:
-        self._pending.append(cb)
-        self._count += 1
+    def update(self, cb: Callable[[WriteTx], Any]) -> Any:
+        if self._tx is None:
+            self._store._update_lock.acquire()
+            self._tx = WriteTx(self._store)
+        result = cb(self._tx)
         self.applied += 1
-        if self._count >= MAX_CHANGES_PER_TX:
-            self._flush()
+        if len(self._tx._changes) >= MAX_CHANGES_PER_TX:
+            self._flush_tx()
+        return result
+
+    def _flush_tx(self) -> None:
+        tx, self._tx = self._tx, None
+        try:
+            n = len(tx._changes)
+            self._store._propose_and_commit(tx)
+            self.committed += n
+        finally:
+            self._store._update_lock.release()
 
     def _flush(self) -> None:
-        if not self._pending:
-            return
-        pending, self._pending, self._count = self._pending, [], 0
+        if self._tx is not None:
+            self._flush_tx()
 
-        def run_all(tx: WriteTx) -> None:
-            for cb in pending:
-                cb(tx)
-
-        self._store.update(run_all)
-        self.committed += len(pending)
+    def _abort(self) -> None:
+        """Discard any uncommitted tail (after an error) and release."""
+        if self._tx is not None:
+            self._tx = None
+            self._store._update_lock.release()
