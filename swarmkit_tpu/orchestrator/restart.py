@@ -1,0 +1,339 @@
+"""Restart supervisor: replaces failed/stopped tasks under the service's
+restart policy, with delayed starts and per-slot restart history.
+
+Reference: manager/orchestrator/restart/restart.go.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..models.objects import Cluster, Node, Service, Task
+from ..models.types import (
+    NodeAvailability, NodeState, RestartCondition, TaskState, now,
+)
+from ..state.events import Event, match
+from ..state.store import MemoryStore, WriteTx
+from . import common
+
+log = logging.getLogger("restart")
+
+DEFAULT_OLD_TASK_TIMEOUT = 60.0  # reference: restart.go:20
+
+
+@dataclass
+class _RestartInfo:
+    total_restarts: int = 0
+    restarted_instances: List[float] = field(default_factory=list)
+    spec_version: int = 0
+
+
+class _DelayedStart:
+    def __init__(self) -> None:
+        self.cancelled = threading.Event()
+        self.done = threading.Event()
+        self.waiter = False
+
+
+class Supervisor:
+    def __init__(self, store: MemoryStore):
+        self.store = store
+        self._mu = threading.Lock()
+        self._delays: Dict[str, _DelayedStart] = {}
+        self._history: Dict[str, Dict[common.SlotTuple, _RestartInfo]] = {}
+        self.task_timeout = DEFAULT_OLD_TASK_TIMEOUT
+
+    # ------------------------------------------------------------ restarting
+
+    def restart(self, tx: WriteTx, cluster: Optional[Cluster],
+                service: Service, t: Task) -> None:
+        """Shut down t and create a replacement if policy allows.
+
+        Must be called inside a store.update transaction (reference:
+        restart.go:117 Restart).
+        """
+        with self._mu:
+            old_delay = self._delays.get(t.id)
+            if old_delay is not None:
+                if not old_delay.waiter:
+                    old_delay.waiter = True
+                    threading.Thread(
+                        target=self._wait_restart,
+                        args=(old_delay, cluster, t.id),
+                        daemon=True).start()
+                return
+
+        if t.desired_state > TaskState.COMPLETE:
+            raise RuntimeError(
+                "restart called on task that was already shut down")
+
+        t = t.copy()
+        t.desired_state = TaskState.SHUTDOWN
+        tx.update(t)
+
+        if not self._should_restart(t, service):
+            return
+
+        if common.is_replicated_service(service) \
+                or common.is_replicated_job(service):
+            restart_task = common.new_task(cluster, service, t.slot, "")
+        elif common.is_global_service(service) \
+                or common.is_global_job(service):
+            restart_task = common.new_task(cluster, service, 0, t.node_id)
+        else:
+            log.error("service not supported by restart supervisor")
+            return
+
+        if common.is_replicated_job(service) or common.is_global_job(service):
+            from ..models.types import Version
+            restart_task.job_iteration = Version(
+                service.job_status.job_iteration.index
+                if service.job_status else 0)
+
+        n = tx.get(Node, t.node_id) if t.node_id else None
+
+        restart_task.desired_state = TaskState.READY
+
+        restart_delay = 0.0
+        # restart delay is not applied on drained nodes
+        if n is None or n.spec.availability != NodeAvailability.DRAIN:
+            if t.spec.restart is not None:
+                restart_delay = t.spec.restart.delay
+            else:
+                restart_delay = common.DEFAULT_RESTART_DELAY
+
+        # normally wait for the old task to stop running; skip if it's
+        # already dead or its node is down
+        wait_stop = not ((n is not None
+                          and n.status.state == NodeState.DOWN)
+                         or t.status.state > TaskState.RUNNING)
+
+        tx.create(restart_task)
+
+        tuple_ = common.SlotTuple(
+            service_id=restart_task.service_id, slot=restart_task.slot,
+            node_id=restart_task.node_id if not restart_task.slot else "")
+        self.record_restart_history(tuple_, restart_task)
+        self.delay_start(t, restart_task.id, restart_delay, wait_stop)
+
+    def _wait_restart(self, old_delay: _DelayedStart,
+                      cluster: Optional[Cluster], task_id: str) -> None:
+        old_delay.done.wait()
+
+        def cb(tx: WriteTx) -> None:
+            t = tx.get(Task, task_id)
+            if t is None or t.desired_state > TaskState.RUNNING:
+                return
+            service = tx.get(Service, t.service_id)
+            if service is None:
+                return
+            self.restart(tx, cluster, service, t)
+
+        try:
+            self.store.update(cb)
+        except Exception:
+            log.exception("failed to restart task after waiting for "
+                          "previous restart")
+
+    # -------------------------------------------------------------- policy
+
+    def _should_restart(self, t: Task, service: Service) -> bool:
+        condition = common.restart_condition(t)
+        if condition == RestartCondition.ANY:
+            if (common.is_replicated_job(service)
+                    or common.is_global_job(service)) \
+                    and t.status.state == TaskState.COMPLETE:
+                return False
+        elif condition == RestartCondition.ON_FAILURE:
+            if t.status.state == TaskState.COMPLETE:
+                return False
+        else:  # NONE
+            return False
+
+        if t.spec.restart is None or t.spec.restart.max_attempts == 0:
+            return True
+
+        tuple_ = common.SlotTuple(service_id=t.service_id, slot=t.slot)
+        if common.is_global_service(service):
+            tuple_ = common.SlotTuple(service_id=t.service_id,
+                                      node_id=t.node_id)
+
+        with self._mu:
+            info = self._history.get(t.service_id, {}).get(tuple_)
+            if info is None or (t.spec_version is not None
+                                and t.spec_version.index != info.spec_version):
+                return True
+
+            max_attempts = t.spec.restart.max_attempts
+            window = t.spec.restart.window
+            if not window:
+                return info.total_restarts < max_attempts
+
+            if not info.restarted_instances:
+                return True
+
+            timestamp = t.status.applied_at or t.status.timestamp or now()
+            lookback = timestamp - window
+
+            # drop restarts before the lookback window
+            instances = [s for s in info.restarted_instances if s > lookback]
+            info.restarted_instances = instances
+            # ignore restarts that happened after this task's timestamp
+            num = sum(1 for s in instances if s < timestamp)
+            return num < max_attempts
+
+    def updatable_tasks_in_slot(self, slot: common.Slot,
+                                service: Service) -> common.Slot:
+        """reference: restart.go:333 UpdatableTasksInSlot."""
+        if not slot:
+            return []
+        updatable = [t for t in slot if t.desired_state <= TaskState.RUNNING]
+        if updatable:
+            return updatable
+        from ..models.types import UpdateState
+        if service.update_status is not None and \
+                service.update_status.state == UpdateState.ROLLBACK_STARTED:
+            return []
+        newest = max(slot, key=common.task_timestamp)
+        if not self._should_restart(newest, service):
+            return [newest]
+        return []
+
+    def record_restart_history(self, tuple_: common.SlotTuple,
+                               replacement: Task) -> None:
+        if replacement.spec.restart is None \
+                or replacement.spec.restart.max_attempts == 0:
+            return
+        with self._mu:
+            per_service = self._history.setdefault(
+                replacement.service_id, {})
+            info = per_service.setdefault(tuple_, _RestartInfo())
+            if replacement.spec_version is not None and \
+                    replacement.spec_version.index != info.spec_version:
+                info.total_restarts = 0
+                info.restarted_instances = []
+                info.spec_version = replacement.spec_version.index
+            info.total_restarts += 1
+            if replacement.spec.restart.window:
+                info.restarted_instances.append(
+                    replacement.meta.created_at or now())
+
+    # -------------------------------------------------------- delayed starts
+
+    def delay_start(self, old_task: Optional[Task], new_task_id: str,
+                    delay: float, wait_stop: bool) -> threading.Event:
+        """Move new_task READY->RUNNING after the delay elapses and the old
+        task stops (or times out).  Returns the completion event
+        (reference: restart.go:427 DelayStart)."""
+        ds = _DelayedStart()
+        with self._mu:
+            while True:
+                old = self._delays.get(new_task_id)
+                if old is None:
+                    break
+                old.cancelled.set()
+                self._mu.release()
+                old.done.wait(timeout=5)
+                self._mu.acquire()
+                if self._delays.get(new_task_id) is old:
+                    del self._delays[new_task_id]
+            self._delays[new_task_id] = ds
+
+        wait_for_task = (wait_stop and old_task is not None
+                         and old_task.status.state <= TaskState.RUNNING)
+
+        sub = None
+        if wait_for_task:
+            old_id = old_task.id
+            old_node = old_task.node_id
+
+            def pred(ev):
+                if not isinstance(ev, Event):
+                    return False
+                obj = ev.obj
+                if isinstance(obj, Task) and obj.id == old_id \
+                        and ev.action == "update" \
+                        and obj.status.state > TaskState.RUNNING:
+                    return True
+                if isinstance(obj, Node) and obj.id == old_node:
+                    if ev.action == "delete":
+                        return True
+                    if ev.action == "update" \
+                            and obj.status.state == NodeState.DOWN:
+                        return True
+                return False
+
+            sub = self.store.queue.subscribe(pred)
+
+        threading.Thread(target=self._delayed_start_thread,
+                         args=(ds, sub, new_task_id, delay, wait_for_task),
+                         daemon=True).start()
+        return ds.done
+
+    def _delayed_start_thread(self, ds: _DelayedStart, sub,
+                              new_task_id: str, delay: float,
+                              wait_for_task: bool) -> None:
+        try:
+            # 1. wait out the restart delay (interruptible by cancel)
+            if ds.cancelled.wait(timeout=delay):
+                return
+            # 2. wait for the old task to stop (bounded by task_timeout)
+            if wait_for_task and sub is not None:
+                deadline = now() + self.task_timeout
+                while not ds.cancelled.is_set():
+                    remaining = deadline - now()
+                    if remaining <= 0:
+                        break
+                    try:
+                        sub.get(timeout=min(remaining, 0.5))
+                        break
+                    except TimeoutError:
+                        continue
+                    except Exception:
+                        break
+            if ds.cancelled.is_set():
+                return
+            try:
+                self.start_now(new_task_id)
+            except Exception:
+                log.exception("moving task to RUNNING failed")
+        finally:
+            if sub is not None:
+                self.store.queue.unsubscribe(sub)
+            with self._mu:
+                if self._delays.get(new_task_id) is ds:
+                    del self._delays[new_task_id]
+            ds.done.set()
+
+    def start_now(self, task_id: str) -> None:
+        """Moves the task to the RUNNING state (reference: StartNow)."""
+
+        def cb(tx: WriteTx) -> None:
+            t = tx.get(Task, task_id)
+            if t is None or t.desired_state >= TaskState.RUNNING:
+                return
+            t = t.copy()
+            t.desired_state = TaskState.RUNNING
+            tx.update(t)
+
+        self.store.update(cb)
+
+    def cancel(self, task_id: str) -> None:
+        with self._mu:
+            ds = self._delays.get(task_id)
+        if ds is not None:
+            ds.cancelled.set()
+            ds.done.wait(timeout=5)
+
+    def cancel_all(self) -> None:
+        with self._mu:
+            delays = list(self._delays.values())
+        for ds in delays:
+            ds.cancelled.set()
+
+    def clear_service_history(self, service_id: str) -> None:
+        with self._mu:
+            self._history.pop(service_id, None)
