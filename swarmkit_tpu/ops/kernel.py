@@ -27,7 +27,21 @@ which becomes a `psum` over the node-axis (see parallel/sharded.py).
 Numeric ranges (32-bit budget):
   per-service counts clamped to 2^20; failure down-weight factor 2^22
   (dominates any real count); water-level search over [0, 2^30); node index
-  packed in 20 bits -> supports up to 2^20 (~1M) nodes per shard.
+  packed in 20 bits -> supports up to 2^20 (~1M) nodes per shard; group size
+  k clamped to 2^22 (the planner falls back to the host path above that).
+
+Resource accounting is **exact**: the host densifier compares int64
+nano-cpus/bytes and floor-divides in int64 (matching the reference's integer
+comparisons, api/types.proto:68), shipping the kernel a boolean ``res_ok``
+mask and an int32 per-node capacity ``res_cap`` — no float rounding can
+admit/reject a node the host oracle would decide differently.
+
+Segment sums that can exceed int32 (fill volumes up to N*k ~ 2^42) are
+computed in float32, which is safe *for comparisons against k <= K_CLAMP*:
+all addends are non-negative, so every partial sum <= the true total; totals
+< 2^24 are therefore exact at every step, and totals >= 2^24 keep enough
+relative accuracy (error ~ N*eps) to stay far above K_CLAMP = 2^22 — either
+way the `sum >= k` comparison is decided correctly.
 """
 
 from __future__ import annotations
@@ -43,6 +57,8 @@ from ..scheduler.nodeinfo import MAX_FAILURES  # single source of truth
 F_BIG = 1 << 22          # failure down-weight step (dominates svc counts)
 FAILURE_CLAMP = 63       # keeps e = svc + failures*F_BIG inside int32
 SVC_CLAMP = (1 << 20) - 1
+K_CLAMP = 1 << 22        # max group size the kernel accepts (see docstring)
+LOAD_CLAMP = 1 << 29     # branch-load clamp: stage-A levels stay in-range
 LEVEL_ITERS = 34         # binary search over [0, 2^30]; extra margin
 TIE_ITERS = 34           # binary search over packed 31-bit tie keys
 IDX_BITS = 20
@@ -60,9 +76,6 @@ class GroupInputs(NamedTuple):
     """Per-(service, spec-version) task-group inputs, densified host-side."""
 
     k: jnp.ndarray              # i32 scalar: number of tasks to place
-    cpu_d: jnp.ndarray          # f32 scalar: nano-cpus per task
-    mem_d: jnp.ndarray          # f32 scalar: memory bytes per task
-    gen_d: jnp.ndarray          # f32[G]: generic resource demands (0 = off)
     con_hash: jnp.ndarray       # i32[Cc, 2, N]: node hash (hi,lo) per constraint
     con_op: jnp.ndarray         # i32[Cc]: 0 ==, 1 !=, 2 disabled
     con_exp: jnp.ndarray        # i32[Cc, 2]: expected (hi,lo)
@@ -77,9 +90,11 @@ class NodeInputs(NamedTuple):
 
     valid: jnp.ndarray          # bool[N] (padding mask)
     ready: jnp.ndarray          # bool[N] READY && ACTIVE
-    cpu: jnp.ndarray            # f32[N] available nano-cpus
-    mem: jnp.ndarray            # f32[N] available memory bytes
-    gen: jnp.ndarray            # f32[G, N] available generic resources
+    res_ok: jnp.ndarray         # bool[N] node meets this group's reservations
+                                #   (exact int64 comparison, host-side)
+    res_cap: jnp.ndarray        # i32[N] tasks of this group the node's
+                                #   resources can absorb (exact int64 floor
+                                #   division host-side, clipped to K_CLAMP)
     svc_tasks: jnp.ndarray      # i32[N] active tasks of this service
     total_tasks: jnp.ndarray    # i32[N] active tasks total
     failures: jnp.ndarray       # i32[N] recent failures for this service
@@ -90,8 +105,11 @@ class NodeInputs(NamedTuple):
     extra_mask: jnp.ndarray     # bool[N] plugin/volume masks ANDed host-side
 
 
-def _seg_sum(x: jnp.ndarray, seg: jnp.ndarray, L: int) -> jnp.ndarray:
-    return jax.ops.segment_sum(x, seg, num_segments=L)
+def _seg_sum_f32(x: jnp.ndarray, seg: jnp.ndarray, L: int) -> jnp.ndarray:
+    """int32 segment sum carried in f32 so totals up to N*k (~2^42) cannot
+    wrap.  Safe for comparisons against bounds <= K_CLAMP — see module
+    docstring for the exactness argument."""
+    return jax.ops.segment_sum(x.astype(jnp.float32), seg, num_segments=L)
 
 
 def seg_waterfill(e: jnp.ndarray, cap: jnp.ndarray, tie: jnp.ndarray,
@@ -105,12 +123,13 @@ def seg_waterfill(e: jnp.ndarray, cap: jnp.ndarray, tie: jnp.ndarray,
     e:    i32[N] current level per element (lower = preferred)
     cap:  i32[N] max units this element can take
     tie:  i32[N] tie-break key, unique per element (lower = preferred)
-    k_seg:i32[L] units to place per segment
+    k_seg:i32[L] units to place per segment (each <= K_CLAMP)
     seg:  i32[N] segment id per element
     reduce: cross-shard sum for [L]-shaped partials (psum under shard_map)
     """
     e = e.astype(jnp.int32)
     cap = cap.astype(jnp.int32)
+    kf = k_seg.astype(jnp.float32)
 
     def fill_at(lam_seg: jnp.ndarray) -> jnp.ndarray:
         return jnp.clip(lam_seg[seg] - e, 0, cap)
@@ -118,8 +137,8 @@ def seg_waterfill(e: jnp.ndarray, cap: jnp.ndarray, tie: jnp.ndarray,
     def level_body(_, lohi):
         lo, hi = lohi
         mid = lo + (hi - lo) // 2   # avoids int32 overflow of lo + hi
-        f = reduce(_seg_sum(fill_at(mid), seg, L))
-        ge = f >= k_seg
+        f = reduce(_seg_sum_f32(fill_at(mid), seg, L))
+        ge = f >= kf
         return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
 
     lo = jnp.zeros((L,), jnp.int32)
@@ -128,8 +147,9 @@ def seg_waterfill(e: jnp.ndarray, cap: jnp.ndarray, tie: jnp.ndarray,
     lam = hi  # minimal λ with fill ≥ k (or 2^30 if capacity-infeasible)
 
     x_base = fill_at(lam - 1)
-    f_base = reduce(_seg_sum(x_base, seg, L))
-    r = jnp.maximum(k_seg - f_base, 0)
+    f_base = reduce(_seg_sum_f32(x_base, seg, L))
+    # remainder is exact: whenever r > 0, f_base < k <= K_CLAMP < 2^24
+    r = jnp.maximum(kf - f_base, 0.0)
 
     marginal = (e <= lam[seg] - 1) & (x_base < cap)
 
@@ -137,7 +157,7 @@ def seg_waterfill(e: jnp.ndarray, cap: jnp.ndarray, tie: jnp.ndarray,
     def tie_body(_, lohi):
         lo, hi = lohi
         mid = lo + (hi - lo) // 2   # avoids int32 overflow of lo + hi
-        cnt = reduce(_seg_sum(
+        cnt = reduce(_seg_sum_f32(
             (marginal & (tie <= mid[seg])).astype(jnp.int32), seg, L))
         ge = cnt >= r
         return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
@@ -148,13 +168,6 @@ def seg_waterfill(e: jnp.ndarray, cap: jnp.ndarray, tie: jnp.ndarray,
     grant = marginal & (tie <= thi[seg]) & (r[seg] > 0)
 
     return x_base + grant.astype(jnp.int32)
-
-
-def _resource_cap(cap: jnp.ndarray, avail: jnp.ndarray,
-                  demand: jnp.ndarray) -> jnp.ndarray:
-    """min(cap, floor(avail / demand)) when demand > 0."""
-    fits = jnp.floor(avail / jnp.maximum(demand, 1e-30)).astype(jnp.int32)
-    return jnp.where(demand > 0, jnp.minimum(cap, jnp.maximum(fits, 0)), cap)
 
 
 def _hash_eq(node_hash: jnp.ndarray, exp: jnp.ndarray) -> jnp.ndarray:
@@ -173,12 +186,7 @@ def feasibility_and_capacity(nodes: NodeInputs, group: GroupInputs,
     """
     # --- individual filter masks
     ready_m = nodes.ready
-
-    res_m = (group.cpu_d <= 0) | (nodes.cpu >= group.cpu_d)
-    res_m &= (group.mem_d <= 0) | (nodes.mem >= group.mem_d)
-    gen_ok = (group.gen_d[:, None] <= 0) | (nodes.gen >= group.gen_d[:, None])
-    res_m &= jnp.all(gen_ok, axis=0)
-
+    res_m = nodes.res_ok       # exact int64 comparison done host-side
     plugin_m = nodes.extra_mask
 
     def apply_constraint(i, m):
@@ -219,12 +227,7 @@ def feasibility_and_capacity(nodes: NodeInputs, group: GroupInputs,
     fail_counts = reduce(jnp.stack(fail_counts))
 
     # capacity: how many tasks of this group each node can absorb
-    cap = jnp.full(nodes.cpu.shape, 1 << 24, jnp.int32)
-    cap = jnp.minimum(cap, group.k)
-    cap = _resource_cap(cap, nodes.cpu, group.cpu_d)
-    cap = _resource_cap(cap, nodes.mem, group.mem_d)
-    for g in range(nodes.gen.shape[0]):
-        cap = _resource_cap(cap, nodes.gen[g], group.gen_d[g])
+    cap = jnp.minimum(nodes.res_cap, jnp.minimum(group.k, K_CLAMP))
     cap = jnp.where(group.maxrep > 0,
                     jnp.minimum(cap, jnp.maximum(
                         group.maxrep - nodes.svc_tasks, 0)), cap)
@@ -251,7 +254,7 @@ def plan_group(nodes: NodeInputs, group: GroupInputs, L: int,
     counts in pipeline order).
     """
     mask, cap, fail_counts = feasibility_and_capacity(nodes, group, reduce)
-    n = nodes.cpu.shape[0]
+    n = nodes.ready.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
     if idx_offset is not None:
         idx = idx + idx_offset
@@ -263,20 +266,27 @@ def plan_group(nodes: NodeInputs, group: GroupInputs, L: int,
 
     # ---- stage A: allocation across branches
     # branch load counts every valid node's service tasks (feasible or not),
-    # matching nodeset.go:88-105 where tree.tasks accumulates per walked node
-    branch_load = reduce(_seg_sum(
-        jnp.where(nodes.valid, svc, 0), nodes.leaf, L))
-    branch_cap = reduce(_seg_sum(cap, nodes.leaf, L))
+    # matching nodeset.go:88-105 where tree.tasks accumulates per walked node.
+    # Sums ride f32 (overflow-safe, see docstring) and are clamped back into
+    # the int32 search ranges: loads above LOAD_CLAMP are equi-preferred,
+    # caps above k are equivalent to k.
+    kk = jnp.minimum(group.k, K_CLAMP)
+    branch_load = jnp.minimum(
+        reduce(_seg_sum_f32(jnp.where(nodes.valid, svc, 0), nodes.leaf, L)),
+        float(LOAD_CLAMP)).astype(jnp.int32)
+    branch_cap = jnp.minimum(
+        reduce(_seg_sum_f32(cap, nodes.leaf, L)),
+        kk.astype(jnp.float32)).astype(jnp.int32)
 
     if L == 1:
-        k_branch = jnp.minimum(group.k, branch_cap)
+        k_branch = jnp.minimum(kk, branch_cap)
     else:
         bidx = jnp.arange(L, dtype=jnp.int32)
         k_branch = seg_waterfill(
             e=branch_load,
             cap=branch_cap,
             tie=bidx,
-            k_seg=jnp.full((1,), group.k, jnp.int32),
+            k_seg=kk.reshape(1),
             seg=jnp.zeros((L,), jnp.int32),
             L=1,
             # stage A runs on [L]-shaped, fully-replicated arrays, so no

@@ -33,14 +33,13 @@ from ..scheduler.filters import normalize_arch, _references_volume_plugin
 from ..scheduler.nodeinfo import NodeInfo
 from ..models.types import TaskState, TaskStatus
 from .hashing import str_hash
-from .kernel import GroupInputs, NodeInputs, plan_group_jit
+from .kernel import GroupInputs, K_CLAMP, NodeInputs, plan_group_jit
 
 log = logging.getLogger("tpu-planner")
 
 # static shape buckets to bound recompiles
 _CC_BUCKETS = (1, 4, 16)      # constraint slots
 _P_BUCKETS = (1, 4)           # platform slots
-_G_BUCKETS = (1, 4)           # generic resource kinds
 
 
 def _bucket(n: int, buckets) -> Optional[int]:
@@ -148,8 +147,10 @@ class TPUPlanner:
 
         valid = np.zeros(nb, bool)
         ready = np.zeros(nb, bool)
-        cpu = np.zeros(nb, np.float32)
-        mem = np.zeros(nb, np.float32)
+        # int64 columns: resource comparisons/divisions stay exact (the
+        # reference compares integer nano-cpus/bytes; float32 would round)
+        cpu = np.zeros(nb, np.int64)
+        mem = np.zeros(nb, np.int64)
         total = np.zeros(nb, np.int32)
         valid[:n] = True
         for i, info in enumerate(infos):
@@ -202,6 +203,9 @@ class TPUPlanner:
             return False
 
         k = len(task_group)
+        if k > K_CLAMP:  # beyond the kernel's 32-bit budget (see kernel.py)
+            self.stats["groups_fallback"] += 1
+            return False
 
         # ---- per-service arrays
         svc_tasks = np.zeros(nb, np.int32)
@@ -267,26 +271,32 @@ class TPUPlanner:
                     os_hash[:, i] = _SENTINEL
                     arch_hash[:, i] = _SENTINEL
 
-        # ---- resources
+        # ---- resources: exact int64 mask + capacity, computed host-side so
+        # device decisions match the host oracle's integer comparisons
         res = t.spec.resources.reservations if t.spec.resources else None
-        cpu_d = float(res.nano_cpus) if res else 0.0
-        mem_d = float(res.memory_bytes) if res else 0.0
+        cpu_d = int(res.nano_cpus) if res else 0
+        mem_d = int(res.memory_bytes) if res else 0
         gen_wanted = [g for g in (res.generic if res else [])]
-        gb = _bucket(max(len(gen_wanted), 1), _G_BUCKETS)
-        if gb is None:
-            self.stats["groups_fallback"] += 1
-            return False
-        gen = np.zeros((gb, nb), np.float32)
-        gen_d = np.zeros(gb, np.float32)
-        for gi, g in enumerate(gen_wanted):
-            gen_d[gi] = g.value
+        res_ok = valid.copy()
+        res_cap = np.full(nb, K_CLAMP, np.int64)
+        for avail, demand in ((cpu, cpu_d), (mem, mem_d)):
+            if demand > 0:
+                res_ok &= avail >= demand
+                np.minimum(res_cap, avail // demand, out=res_cap)
+        for g in gen_wanted:
+            if g.value <= 0:
+                continue
+            gen_avail = np.zeros(nb, np.int64)
             for i, info in enumerate(infos):
                 avail = 0
                 for r in info.available_resources.generic:
                     if r.kind == g.kind:
                         avail += (1 if r.res_type == GenericResourceKind.NAMED
                                   else r.value)
-                gen[gi, i] = avail
+                gen_avail[i] = avail
+            res_ok &= gen_avail >= g.value
+            np.minimum(res_cap, gen_avail // g.value, out=res_cap)
+        res_cap = np.clip(res_cap, 0, K_CLAMP).astype(np.int32)
 
         # ---- host ports
         port_conflict = np.zeros(nb, bool)
@@ -337,13 +347,12 @@ class TPUPlanner:
             L = _l_bucket(max(len(values), 1))
 
         nodes_in = NodeInputs(
-            valid=valid, ready=ready, cpu=cpu, mem=mem, gen=gen,
+            valid=valid, ready=ready, res_ok=res_ok, res_cap=res_cap,
             svc_tasks=svc_tasks, total_tasks=total, failures=failures,
             leaf=leaf, os_hash=os_hash, arch_hash=arch_hash,
             port_conflict=port_conflict, extra_mask=extra_mask)
         group_in = GroupInputs(
-            k=np.int32(k), cpu_d=np.float32(cpu_d), mem_d=np.float32(mem_d),
-            gen_d=gen_d, con_hash=con_hash, con_op=con_op, con_exp=con_exp,
+            k=np.int32(k), con_hash=con_hash, con_op=con_op, con_exp=con_exp,
             plat=plat, maxrep=np.int32(
                 placement.max_replicas if placement else 0),
             port_limited=np.bool_(port_limited))

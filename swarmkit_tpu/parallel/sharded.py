@@ -43,14 +43,14 @@ def make_mesh(devices=None, axis: str = NODE_AXIS) -> Mesh:
 
 # PartitionSpecs: node-dimension sharded, everything else replicated.
 _NODE_SPECS = NodeInputs(
-    valid=P(NODE_AXIS), ready=P(NODE_AXIS), cpu=P(NODE_AXIS),
-    mem=P(NODE_AXIS), gen=P(None, NODE_AXIS), svc_tasks=P(NODE_AXIS),
+    valid=P(NODE_AXIS), ready=P(NODE_AXIS), res_ok=P(NODE_AXIS),
+    res_cap=P(NODE_AXIS), svc_tasks=P(NODE_AXIS),
     total_tasks=P(NODE_AXIS), failures=P(NODE_AXIS), leaf=P(NODE_AXIS),
     os_hash=P(None, NODE_AXIS), arch_hash=P(None, NODE_AXIS),
     port_conflict=P(NODE_AXIS), extra_mask=P(NODE_AXIS))
 
 _GROUP_SPECS = GroupInputs(
-    k=P(), cpu_d=P(), mem_d=P(), gen_d=P(), con_hash=P(None, None, NODE_AXIS),
+    k=P(), con_hash=P(None, None, NODE_AXIS),
     con_op=P(), con_exp=P(), plat=P(), maxrep=P(), port_limited=P())
 
 
@@ -60,7 +60,7 @@ def plan_group_sharded(nodes: NodeInputs, group: GroupInputs, L: int,
     """Sharded group placement: (x i32[N] sharded, fail_counts i32[7])."""
 
     n_devices = mesh.shape[NODE_AXIS]
-    local_n = nodes.cpu.shape[0] // n_devices
+    local_n = nodes.ready.shape[0] // n_devices
 
     def kernel(nodes_l: NodeInputs, group_l: GroupInputs) -> jnp.ndarray:
         reduce = lambda v: jax.lax.psum(v, NODE_AXIS)  # noqa: E731
@@ -86,7 +86,7 @@ class ShardedPlanFn:
 
     def __call__(self, nodes: NodeInputs, group: GroupInputs, L: int):
         d = self.mesh.shape[NODE_AXIS]
-        n = nodes.cpu.shape[0]
+        n = nodes.ready.shape[0]
         if n % d:
             pad = d - n % d
 

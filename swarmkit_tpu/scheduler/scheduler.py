@@ -397,10 +397,12 @@ class Scheduler:
                             node_id=decision.new.node_id,
                             state=VolumePublishStatus.State.PENDING_PUBLISH))
                         volumes_to_update.append(v)
-                # tx.update defensively copies, so stamping the store's
-                # meta onto the mirror object is safe and avoids a second
-                # deep copy on the hot path
-                decision.new.meta = t.meta
+                # decision.new carries the mirror's version: if the task
+                # changed in the store after the scheduler mirrored it (e.g.
+                # an orchestrator bumped desired_state during the debounce
+                # window), tx.update raises SequenceConflict and the
+                # decision fails instead of overwriting the concurrent
+                # write (reference: scheduler.go:607-611).
                 try:
                     tx.update(decision.new)
                 except Exception:

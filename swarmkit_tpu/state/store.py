@@ -614,8 +614,16 @@ class MemoryStore:
                         events.append(Event("update", obj, old))
                     else:
                         events.append(Event("delete", old if old is not None else obj))
-                    self._version = max(self._version,
-                                        obj.meta.version.index)
+                    # The leader's _commit advances _version once per change
+                    # (including deletes, whose payload carries the *old*
+                    # object version) — mirror that exactly so follower
+                    # EventCommit indices and post-failover version counters
+                    # match the leader's.
+                    if change.action == "delete":
+                        self._version += 1
+                    else:
+                        self._version = max(self._version + 1,
+                                            obj.meta.version.index)
                     self._apply_locked(StoreAction(change.action, obj))
             for ev in events:
                 self.queue.publish(ev)

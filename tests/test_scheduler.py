@@ -371,3 +371,40 @@ def test_constraint_node_matches_ip_and_platform():
     assert node_matches(parse(["node.hostname == host1"]), n)
     assert not node_matches(parse(["node.hostname != host1"]), n)
     assert node_matches(parse(["unknown.key != whatever"]), n) is False
+
+
+def test_concurrent_update_not_overwritten_by_stale_decision():
+    """A write that lands between the scheduler's mirror and its commit must
+    fail the decision via SequenceConflict, not be overwritten (reference:
+    scheduler.go:607-611 relies on UpdateTask's version check)."""
+    store = MemoryStore()
+    node = make_ready_node("n1")
+    svc, tasks = make_service_with_tasks(1)
+    t = tasks[0]
+
+    def setup(tx):
+        tx.create(node)
+        tx.create(svc)
+        tx.create(t)
+
+    store.update(setup)
+    sched = Scheduler(store)
+    store.view(sched._setup_tasks_list)
+
+    # concurrent orchestrator write during the debounce window: the
+    # scheduler's mirror has NOT seen this event yet
+    def shutdown(tx):
+        cur = tx.get(Task, t.id).copy()
+        cur.desired_state = TaskState.SHUTDOWN
+        tx.update(cur)
+
+    store.update(shutdown)
+
+    sched.tick()
+
+    cur = store.view(lambda tx: tx.get(Task, t.id))
+    assert cur.desired_state == TaskState.SHUTDOWN, \
+        "stale scheduler decision overwrote a concurrent desired_state change"
+    assert cur.status.state == TaskState.PENDING
+    # the failed decision was rolled back in the mirror and re-enqueued
+    assert t.id in sched.unassigned_tasks

@@ -298,3 +298,33 @@ def test_concurrent_updates():
     assert not errors
     final = s.view(lambda tx: tx.get(Service, svc.id))
     assert final.spec.replicated.replicas == 3 + 200
+
+
+def test_follower_version_counter_matches_leader_after_deletes():
+    """Delete actions carry the deleted object's *old* version; the follower
+    must still advance its version counter once per change like the leader
+    does, so post-failover version indices never repeat."""
+    leader = MemoryStore()
+    follower = MemoryStore()
+    replicated = []
+
+    class Relay:
+        def propose(self, actions):
+            replicated.append(list(actions))
+
+    leader._proposer = Relay()
+
+    def mk(name):
+        return Node(id=new_id(), spec=NodeSpec(
+            annotations=Annotations(name=name)))
+
+    n1, n2 = mk("a"), mk("b")
+    leader.update(lambda tx: (tx.create(n1), tx.create(n2)))
+    leader.update(lambda tx: tx.delete(Node, n1.id))
+    n2b = leader.view(lambda tx: tx.get(Node, n2.id)).copy()
+    leader.update(lambda tx: tx.update(n2b))
+
+    for actions in replicated:
+        follower.apply_store_actions(actions)
+
+    assert follower.version == leader.version

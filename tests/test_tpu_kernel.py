@@ -303,22 +303,23 @@ def test_sharded_matches_single_device():
     rng = np.random.RandomState(0)
     valid = np.zeros(nb, bool); valid[:n] = True
     ready = valid.copy()
-    cpu = np.zeros(nb, np.float32); cpu[:n] = rng.randint(1, 9, n) * 1e9
-    mem = np.zeros(nb, np.float32); mem[:n] = 32e9
+    cpu = np.zeros(nb, np.int64); cpu[:n] = rng.randint(1, 9, n) * 10**9
+    cpu_d = 10**9
     svc_tasks = np.zeros(nb, np.int32)
     svc_tasks[:n] = rng.randint(0, 4, n)
     total = svc_tasks * 2
+    from swarmkit_tpu.ops.kernel import K_CLAMP
     nodes = NodeInputs(
-        valid=valid, ready=ready, cpu=cpu, mem=mem,
-        gen=np.zeros((1, nb), np.float32),
+        valid=valid, ready=ready,
+        res_ok=valid & (cpu >= cpu_d),
+        res_cap=np.clip(cpu // cpu_d, 0, K_CLAMP).astype(np.int32),
         svc_tasks=svc_tasks, total_tasks=total,
         failures=np.zeros(nb, np.int32), leaf=np.zeros(nb, np.int32),
         os_hash=np.zeros((2, nb), np.int32),
         arch_hash=np.zeros((2, nb), np.int32),
         port_conflict=np.zeros(nb, bool), extra_mask=np.ones(nb, bool))
     group = GroupInputs(
-        k=np.int32(57), cpu_d=np.float32(1e9), mem_d=np.float32(0),
-        gen_d=np.zeros(1, np.float32),
+        k=np.int32(57),
         con_hash=np.zeros((1, 2, nb), np.int32),
         con_op=np.full(1, 2, np.int32), con_exp=np.zeros((1, 2), np.int32),
         plat=np.full((1, 4), -1, np.int32), maxrep=np.int32(0),
