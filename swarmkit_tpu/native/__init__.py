@@ -1,0 +1,47 @@
+"""Native hot-path loader.
+
+Compiles hotpath.c on first use (cached as an in-place .so next to the
+source) and falls back to the pure-Python implementations when compilation
+or import fails — the package never *requires* the toolchain.  Set
+SWARMKIT_TPU_NO_NATIVE=1 to force the Python paths (used by differential
+tests that pit the two implementations against each other).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+
+log = logging.getLogger("native")
+
+_mod = None
+_tried = False
+
+
+def get():
+    """Return the _hotpath C module, or None when unavailable/disabled."""
+    global _mod, _tried
+    if os.environ.get("SWARMKIT_TPU_NO_NATIVE"):
+        return None
+    if _tried:
+        return _mod
+    _tried = True
+    try:
+        from . import _hotpath as m  # type: ignore[attr-defined]
+        _mod = m
+        return _mod
+    except ImportError:
+        pass
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "build.py")],
+            check=True, capture_output=True, timeout=300, cwd=here)
+        from . import _hotpath as m  # type: ignore[attr-defined]
+        _mod = m
+    except Exception as e:  # toolchain missing, etc. — run pure-Python
+        log.warning("native hotpath unavailable (%s); using Python paths", e)
+        _mod = None
+    return _mod

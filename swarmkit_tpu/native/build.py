@@ -1,0 +1,16 @@
+"""Build the _hotpath C extension in place (invoked as a subprocess by
+swarmkit_tpu.native on first import; see __init__.py)."""
+
+import os
+
+from setuptools import Extension, setup
+
+os.chdir(os.path.dirname(os.path.abspath(__file__)))
+
+setup(
+    name="swarmkit-tpu-hotpath",
+    script_args=["build_ext", "--inplace"],
+    ext_modules=[
+        Extension("_hotpath", ["hotpath.c"], extra_compile_args=["-O2"])
+    ],
+)

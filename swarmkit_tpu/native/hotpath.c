@@ -1,0 +1,606 @@
+/* Native hot path for the columnar scheduler commit.
+ *
+ * The TPU kernel plans a 100k-task group in ~0.1s; the Python loops that
+ * clone Task objects and commit them to the store were ~10us/task and
+ * dominated end-to-end throughput (see BASELINE.md).  This module moves
+ * exactly those two loops to C:
+ *
+ *   plan_apply     - clone-and-register the planner's per-task decisions
+ *                    (replaces ops/planner.py's apply loop body)
+ *   commit_prepare - validate + version-check + stamp one commit chunk
+ *                    (replaces the per-task half of store.bulk_update_tasks)
+ *   commit_apply   - install stamped tasks into the store table + indexes
+ *
+ * Semantics are identical to the pure-Python implementations, which remain
+ * as fallbacks (and as the differential-test oracle).  The reference has no
+ * native code (SURVEY.md section 2); this is a deliberate tpu-framework
+ * improvement, not parity work.
+ *
+ * All objects handled here are plain-dict Python instances following the
+ * store's replace-don't-mutate convention, so a shallow __dict__ copy plus
+ * targeted overrides reproduces Task.copy()/Meta.copy() exactly.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+static PyObject *s_dict, *s_meta, *s_version, *s_index, *s_created_at,
+    *s_updated_at, *s_status, *s_node_id, *s_networks, *s_volumes, *s_agr,
+    *s_id, *s_state, *s_message, *s_err, *s_service_id, *s_slot, *s_old,
+    *s_new, *s_update;
+static PyObject *empty_tuple;
+
+static PyObject *
+new_instance(PyTypeObject *tp)
+{
+    return tp->tp_new(tp, empty_tuple, NULL);
+}
+
+/* Fresh instance of type(obj) with a copy of obj.__dict__.  If out_dict is
+ * non-NULL it receives a NEW reference to the copied dict (mutating it
+ * mutates the clone's attributes). */
+static PyObject *
+shallow_clone(PyObject *obj, PyObject **out_dict)
+{
+    PyTypeObject *tp = Py_TYPE(obj);
+    PyObject *nobj = new_instance(tp);
+    if (!nobj)
+        return NULL;
+    PyObject *od = PyObject_GetAttr(obj, s_dict);
+    if (!od)
+        goto fail;
+    PyObject *d = PyDict_Copy(od);
+    Py_DECREF(od);
+    if (!d)
+        goto fail;
+    if (PyObject_SetAttr(nobj, s_dict, d) < 0) {
+        Py_DECREF(d);
+        goto fail;
+    }
+    if (out_dict)
+        *out_dict = d; /* transfer our reference */
+    else
+        Py_DECREF(d);
+    return nobj;
+fail:
+    Py_DECREF(nobj);
+    return NULL;
+}
+
+/* Meta copy: clone meta and its nested Version (objects.py Meta.copy). */
+static PyObject *
+clone_meta(PyObject *meta)
+{
+    PyObject *md = NULL;
+    PyObject *nm = shallow_clone(meta, &md);
+    if (!nm)
+        return NULL;
+    PyObject *ver = PyDict_GetItem(md, s_version); /* borrowed */
+    if (ver) {
+        PyObject *nv = shallow_clone(ver, NULL);
+        if (!nv) {
+            Py_DECREF(md);
+            Py_DECREF(nm);
+            return NULL;
+        }
+        if (PyDict_SetItem(md, s_version, nv) < 0) {
+            Py_DECREF(nv);
+            Py_DECREF(md);
+            Py_DECREF(nm);
+            return NULL;
+        }
+        Py_DECREF(nv);
+    }
+    Py_DECREF(md);
+    return nm;
+}
+
+/* Replace d[key] (a list) with a shallow copy of it. */
+static int
+copy_list_field(PyObject *d, PyObject *key)
+{
+    PyObject *lst = PyDict_GetItem(d, key);
+    if (!lst)
+        return 0;
+    PyObject *c = PySequence_List(lst);
+    if (!c)
+        return -1;
+    int r = PyDict_SetItem(d, key, c);
+    Py_DECREF(c);
+    return r;
+}
+
+/* plan_apply(items, slots, node_ids_by_node, task_dicts_by_node,
+ *            shared_status, all_tasks, decisions, decision_cls) -> None
+ *
+ * items: list of (task_id, Task) pairs; slots: list of int node indices
+ * (aligned with items); node_ids_by_node / task_dicts_by_node: per-*node*
+ * lookup tables (id string, NodeInfo.tasks dict).  For each i: clone
+ * items[i]'s task as an ASSIGNED task on node slots[i], register it in
+ * all_tasks and that node's task map, and store decision_cls(old, new) in
+ * decisions keyed by task id.  min(len(items), len(slots)) entries are
+ * processed — slots may be shorter when the group did not fully fit.
+ */
+static PyObject *
+plan_apply(PyObject *self, PyObject *args)
+{
+    PyObject *items, *slots, *node_ids, *task_dicts, *status, *all_tasks,
+        *decisions, *decision_cls;
+    if (!PyArg_ParseTuple(args, "OOOOOOOO", &items, &slots, &node_ids,
+                          &task_dicts, &status, &all_tasks, &decisions,
+                          &decision_cls))
+        return NULL;
+    if (!PyList_Check(items) || !PyList_Check(slots) ||
+        !PyList_Check(node_ids) || !PyList_Check(task_dicts)) {
+        PyErr_SetString(PyExc_TypeError, "expected lists");
+        return NULL;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(items);
+    Py_ssize_t ns = PyList_GET_SIZE(slots);
+    if (ns < n)
+        n = ns;
+    Py_ssize_t n_nodes = PyList_GET_SIZE(node_ids);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *pair = PyList_GET_ITEM(items, i);
+        if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) {
+            PyErr_SetString(PyExc_TypeError, "items must be (id, task)");
+            return NULL;
+        }
+        PyObject *old = PyTuple_GET_ITEM(pair, 1);
+        Py_ssize_t ni = PyLong_AsSsize_t(PyList_GET_ITEM(slots, i));
+        if (ni < 0 || ni >= n_nodes) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_IndexError, "slot out of range");
+            return NULL;
+        }
+        PyObject *nid = PyList_GET_ITEM(node_ids, ni);
+        PyObject *idict = PyList_GET_ITEM(task_dicts, ni);
+        PyObject *d = NULL;
+        PyObject *nt = shallow_clone(old, &d);
+        if (!nt)
+            return NULL;
+        PyObject *meta = PyDict_GetItem(d, s_meta);
+        if (meta) {
+            PyObject *nm = clone_meta(meta);
+            if (!nm)
+                goto item_fail;
+            if (PyDict_SetItem(d, s_meta, nm) < 0) {
+                Py_DECREF(nm);
+                goto item_fail;
+            }
+            Py_DECREF(nm);
+        }
+        if (PyDict_SetItem(d, s_status, status) < 0 ||
+            PyDict_SetItem(d, s_node_id, nid) < 0 ||
+            copy_list_field(d, s_networks) < 0 ||
+            copy_list_field(d, s_volumes) < 0)
+            goto item_fail;
+        PyObject *empty = PyList_New(0);
+        if (!empty || PyDict_SetItem(d, s_agr, empty) < 0) {
+            Py_XDECREF(empty);
+            goto item_fail;
+        }
+        Py_DECREF(empty);
+        PyObject *tid = PyDict_GetItem(d, s_id);
+        if (!tid) {
+            PyErr_SetString(PyExc_AttributeError, "task has no id");
+            goto item_fail;
+        }
+        if (PyDict_SetItem(all_tasks, tid, nt) < 0 ||
+            PyDict_SetItem(idict, tid, nt) < 0)
+            goto item_fail;
+        PyObject *dec = new_instance((PyTypeObject *)decision_cls);
+        if (!dec)
+            goto item_fail;
+        if (PyObject_SetAttr(dec, s_old, old) < 0 ||
+            PyObject_SetAttr(dec, s_new, nt) < 0 ||
+            PyDict_SetItem(decisions, tid, dec) < 0) {
+            Py_DECREF(dec);
+            goto item_fail;
+        }
+        Py_DECREF(dec);
+        Py_DECREF(d);
+        Py_DECREF(nt);
+        continue;
+    item_fail:
+        Py_XDECREF(d);
+        Py_DECREF(nt);
+        return NULL;
+    }
+    Py_RETURN_NONE;
+}
+
+/* commit_prepare(new_tasks, start, stop, objects, seq_start, ts,
+ *                guard_state, action_cls_or_None, event_cls_or_None,
+ *                on_missing, on_assigned)
+ *   -> (committed_idx, failed_idx, stamped, actions_or_None, events_or_None)
+ *
+ * Mirrors the validation half of MemoryStore.bulk_update_tasks:
+ *   - missing stored object        -> on_missing(new), skip
+ *   - status unchanged             -> skip
+ *   - stored state >= guard_state  -> on_assigned(new) False => fail
+ *   - version mismatch             -> fail (SequenceConflict semantics)
+ *   - otherwise stamp version/timestamps and collect
+ */
+static PyObject *
+commit_prepare(PyObject *self, PyObject *args)
+{
+    PyObject *new_tasks, *objects, *action_cls, *event_cls, *on_missing,
+        *on_assigned, *guard_state;
+    Py_ssize_t start, stop;
+    long long seq;
+    double ts;
+    if (!PyArg_ParseTuple(args, "OnnOLdOOOOO", &new_tasks, &start, &stop,
+                          &objects, &seq, &ts, &guard_state, &action_cls,
+                          &event_cls, &on_missing, &on_assigned))
+        return NULL;
+
+    int want_actions = action_cls != Py_None;
+    int want_events = event_cls != Py_None;
+    PyObject *committed = PyList_New(0);
+    PyObject *failed = PyList_New(0);
+    PyObject *stamped = PyList_New(0);
+    PyObject *actions = want_actions ? PyList_New(0) : Py_NewRef(Py_None);
+    PyObject *events = want_events ? PyList_New(0) : Py_NewRef(Py_None);
+    PyObject *ts_obj = PyFloat_FromDouble(ts);
+    if (!committed || !failed || !stamped || !actions || !events || !ts_obj)
+        goto fail;
+
+    for (Py_ssize_t i = start; i < stop; i++) {
+        PyObject *nt = PyList_GET_ITEM(new_tasks, i);
+        PyObject *nd = PyObject_GetAttr(nt, s_dict);
+        if (!nd)
+            goto fail;
+        PyObject *tid = PyDict_GetItem(nd, s_id);
+        PyObject *cur = tid ? PyDict_GetItem(objects, tid) : NULL;
+        if (!cur) {
+            Py_DECREF(nd);
+            PyObject *r = PyObject_CallOneArg(on_missing, nt);
+            if (!r)
+                goto fail;
+            Py_DECREF(r);
+            continue;
+        }
+        PyObject *cd = PyObject_GetAttr(cur, s_dict);
+        if (!cd) {
+            Py_DECREF(nd);
+            goto fail;
+        }
+        /* status equality (state, message, err) */
+        PyObject *cstat = PyDict_GetItem(cd, s_status);
+        PyObject *nstat = PyDict_GetItem(nd, s_status);
+        int skip = 0, failed_item = 0;
+        PyObject *cs_state = NULL;
+        if (cstat && nstat) {
+            PyObject *csd = PyObject_GetAttr(cstat, s_dict);
+            PyObject *nsd = PyObject_GetAttr(nstat, s_dict);
+            if (!csd || !nsd) {
+                Py_XDECREF(csd);
+                Py_XDECREF(nsd);
+                Py_DECREF(cd);
+                Py_DECREF(nd);
+                goto fail;
+            }
+            cs_state = PyDict_GetItem(csd, s_state);
+            Py_XINCREF(cs_state);
+            int eq = 1;
+            PyObject *keys[3] = {s_state, s_message, s_err};
+            for (int k = 0; k < 3 && eq; k++) {
+                PyObject *a = PyDict_GetItem(csd, keys[k]);
+                PyObject *b = PyDict_GetItem(nsd, keys[k]);
+                if (a == b)
+                    continue;
+                if (!a || !b) {
+                    eq = 0;
+                    break;
+                }
+                int r = PyObject_RichCompareBool(a, b, Py_EQ);
+                if (r < 0) {
+                    Py_DECREF(csd);
+                    Py_DECREF(nsd);
+                    Py_XDECREF(cs_state);
+                    Py_DECREF(cd);
+                    Py_DECREF(nd);
+                    goto fail;
+                }
+                eq = r;
+            }
+            Py_DECREF(csd);
+            Py_DECREF(nsd);
+            skip = eq;
+        }
+        if (!skip && cs_state) {
+            int ge = PyObject_RichCompareBool(cs_state, guard_state, Py_GE);
+            if (ge < 0) {
+                Py_XDECREF(cs_state);
+                Py_DECREF(cd);
+                Py_DECREF(nd);
+                goto fail;
+            }
+            if (ge) {
+                PyObject *r = PyObject_CallOneArg(on_assigned, nt);
+                if (!r) {
+                    Py_XDECREF(cs_state);
+                    Py_DECREF(cd);
+                    Py_DECREF(nd);
+                    goto fail;
+                }
+                int ok = PyObject_IsTrue(r);
+                Py_DECREF(r);
+                if (!ok)
+                    failed_item = 1;
+            }
+        }
+        Py_XDECREF(cs_state);
+        if (skip) {
+            Py_DECREF(cd);
+            Py_DECREF(nd);
+            continue;
+        }
+        PyObject *cmeta = PyDict_GetItem(cd, s_meta);
+        PyObject *nmeta = PyDict_GetItem(nd, s_meta);
+        if (!failed_item) {
+            /* version check: cur.meta.version.index == new.meta.version.index */
+            PyObject *cv = cmeta ? PyObject_GetAttr(cmeta, s_version) : NULL;
+            PyObject *nv = nmeta ? PyObject_GetAttr(nmeta, s_version) : NULL;
+            PyObject *cvi = cv ? PyObject_GetAttr(cv, s_index) : NULL;
+            PyObject *nvi = nv ? PyObject_GetAttr(nv, s_index) : NULL;
+            Py_XDECREF(cv);
+            Py_XDECREF(nv);
+            if (!cvi || !nvi) {
+                Py_XDECREF(cvi);
+                Py_XDECREF(nvi);
+                Py_DECREF(cd);
+                Py_DECREF(nd);
+                goto fail;
+            }
+            int eq = PyObject_RichCompareBool(cvi, nvi, Py_EQ);
+            Py_DECREF(cvi);
+            Py_DECREF(nvi);
+            if (eq < 0) {
+                Py_DECREF(cd);
+                Py_DECREF(nd);
+                goto fail;
+            }
+            if (!eq)
+                failed_item = 1;
+        }
+        if (failed_item) {
+            PyObject *iobj = PyLong_FromSsize_t(i);
+            int r = iobj ? PyList_Append(failed, iobj) : -1;
+            Py_XDECREF(iobj);
+            Py_DECREF(cd);
+            Py_DECREF(nd);
+            if (r < 0)
+                goto fail;
+            continue;
+        }
+        /* stamp */
+        seq += 1;
+        {
+            PyObject *nv = PyObject_GetAttr(nmeta, s_version);
+            PyObject *seq_obj = PyLong_FromLongLong(seq);
+            PyObject *created = cmeta ? PyObject_GetAttr(cmeta, s_created_at)
+                                      : NULL;
+            int err = !nv || !seq_obj || !created ||
+                      PyObject_SetAttr(nv, s_index, seq_obj) < 0 ||
+                      PyObject_SetAttr(nmeta, s_created_at, created) < 0 ||
+                      PyObject_SetAttr(nmeta, s_updated_at, ts_obj) < 0;
+            Py_XDECREF(nv);
+            Py_XDECREF(seq_obj);
+            Py_XDECREF(created);
+            if (err) {
+                Py_DECREF(cd);
+                Py_DECREF(nd);
+                goto fail;
+            }
+        }
+        PyObject *iobj = PyLong_FromSsize_t(i);
+        int r = iobj ? PyList_Append(committed, iobj) : -1;
+        Py_XDECREF(iobj);
+        if (r < 0 || PyList_Append(stamped, nt) < 0) {
+            Py_DECREF(cd);
+            Py_DECREF(nd);
+            goto fail;
+        }
+        if (want_actions) {
+            PyObject *act = PyObject_CallFunctionObjArgs(action_cls, s_update,
+                                                         nt, NULL);
+            int ar = act ? PyList_Append(actions, act) : -1;
+            Py_XDECREF(act);
+            if (ar < 0) {
+                Py_DECREF(cd);
+                Py_DECREF(nd);
+                goto fail;
+            }
+        }
+        if (want_events) {
+            PyObject *ev = PyObject_CallFunctionObjArgs(event_cls, s_update,
+                                                        nt, cur, NULL);
+            int er = ev ? PyList_Append(events, ev) : -1;
+            Py_XDECREF(ev);
+            if (er < 0) {
+                Py_DECREF(cd);
+                Py_DECREF(nd);
+                goto fail;
+            }
+        }
+        Py_DECREF(cd);
+        Py_DECREF(nd);
+    }
+    Py_DECREF(ts_obj);
+    PyObject *out = PyTuple_Pack(5, committed, failed, stamped, actions,
+                                 events);
+    Py_DECREF(committed);
+    Py_DECREF(failed);
+    Py_DECREF(stamped);
+    Py_DECREF(actions);
+    Py_DECREF(events);
+    return out;
+fail:
+    Py_XDECREF(committed);
+    Py_XDECREF(failed);
+    Py_XDECREF(stamped);
+    Py_XDECREF(actions);
+    Py_XDECREF(events);
+    Py_XDECREF(ts_obj);
+    return NULL;
+}
+
+/* commit_apply(stamped, objects, by_node, reindex_cb) -> None
+ *
+ * Install each stamped task into the objects table; maintain the by_node
+ * index for the common case (only node_id changed).  reindex_cb(old, new)
+ * handles the rare service/slot change. */
+static PyObject *
+commit_apply(PyObject *self, PyObject *args)
+{
+    PyObject *stamped, *objects, *by_node, *reindex_cb;
+    if (!PyArg_ParseTuple(args, "OOOO", &stamped, &objects, &by_node,
+                          &reindex_cb))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(stamped);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *obj = PyList_GET_ITEM(stamped, i);
+        PyObject *d = PyObject_GetAttr(obj, s_dict);
+        if (!d)
+            return NULL;
+        PyObject *tid = PyDict_GetItem(d, s_id);
+        PyObject *old = PyDict_GetItem(objects, tid); /* borrowed */
+        Py_XINCREF(old);
+        if (PyDict_SetItem(objects, tid, obj) < 0) {
+            Py_XDECREF(old);
+            Py_DECREF(d);
+            return NULL;
+        }
+        if (old) {
+            PyObject *od = PyObject_GetAttr(old, s_dict);
+            if (!od) {
+                Py_DECREF(old);
+                Py_DECREF(d);
+                return NULL;
+            }
+            PyObject *osid = PyDict_GetItem(od, s_service_id);
+            PyObject *nsid = PyDict_GetItem(d, s_service_id);
+            PyObject *oslot = PyDict_GetItem(od, s_slot);
+            PyObject *nslot = PyDict_GetItem(d, s_slot);
+            int same_sid = (osid == nsid) ||
+                           PyObject_RichCompareBool(osid, nsid, Py_EQ);
+            int same_slot = (oslot == nslot) ||
+                            PyObject_RichCompareBool(oslot, nslot, Py_EQ);
+            if (same_sid < 0 || same_slot < 0) {
+                Py_DECREF(od);
+                Py_DECREF(old);
+                Py_DECREF(d);
+                return NULL;
+            }
+            if (!same_sid || !same_slot) {
+                PyObject *r = PyObject_CallFunctionObjArgs(reindex_cb, old,
+                                                           obj, NULL);
+                if (!r) {
+                    Py_DECREF(od);
+                    Py_DECREF(old);
+                    Py_DECREF(d);
+                    return NULL;
+                }
+                Py_DECREF(r);
+            }
+            else {
+                PyObject *onid = PyDict_GetItem(od, s_node_id);
+                PyObject *nnid = PyDict_GetItem(d, s_node_id);
+                int eq = (onid == nnid) ||
+                         PyObject_RichCompareBool(onid, nnid, Py_EQ);
+                if (eq < 0) {
+                    Py_DECREF(od);
+                    Py_DECREF(old);
+                    Py_DECREF(d);
+                    return NULL;
+                }
+                if (!eq) {
+                    if (onid && PyObject_IsTrue(onid)) {
+                        PyObject *st = PyDict_GetItem(by_node, onid);
+                        if (st && PySet_Discard(st, tid) < 0) {
+                            Py_DECREF(od);
+                            Py_DECREF(old);
+                            Py_DECREF(d);
+                            return NULL;
+                        }
+                    }
+                    if (nnid && PyObject_IsTrue(nnid)) {
+                        PyObject *st = PyDict_GetItem(by_node, nnid);
+                        if (!st) {
+                            PyObject *ns = PySet_New(NULL);
+                            if (!ns ||
+                                PyDict_SetItem(by_node, nnid, ns) < 0) {
+                                Py_XDECREF(ns);
+                                Py_DECREF(od);
+                                Py_DECREF(old);
+                                Py_DECREF(d);
+                                return NULL;
+                            }
+                            Py_DECREF(ns);
+                            st = PyDict_GetItem(by_node, nnid);
+                        }
+                        if (PySet_Add(st, tid) < 0) {
+                            Py_DECREF(od);
+                            Py_DECREF(old);
+                            Py_DECREF(d);
+                            return NULL;
+                        }
+                    }
+                }
+            }
+            Py_DECREF(od);
+            Py_DECREF(old);
+        }
+        Py_DECREF(d);
+    }
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef methods[] = {
+    {"plan_apply", plan_apply, METH_VARARGS,
+     "Clone and register planner decisions."},
+    {"commit_prepare", commit_prepare, METH_VARARGS,
+     "Validate, version-check, and stamp one commit chunk."},
+    {"commit_apply", commit_apply, METH_VARARGS,
+     "Install stamped tasks into the store table and indexes."},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_hotpath",
+                                       NULL, -1, methods};
+
+PyMODINIT_FUNC
+PyInit__hotpath(void)
+{
+#define INTERN(var, str)                                                      \
+    do {                                                                      \
+        var = PyUnicode_InternFromString(str);                                \
+        if (!var)                                                             \
+            return NULL;                                                      \
+    } while (0)
+    INTERN(s_dict, "__dict__");
+    INTERN(s_meta, "meta");
+    INTERN(s_version, "version");
+    INTERN(s_index, "index");
+    INTERN(s_created_at, "created_at");
+    INTERN(s_updated_at, "updated_at");
+    INTERN(s_status, "status");
+    INTERN(s_node_id, "node_id");
+    INTERN(s_networks, "networks");
+    INTERN(s_volumes, "volumes");
+    INTERN(s_agr, "assigned_generic_resources");
+    INTERN(s_id, "id");
+    INTERN(s_state, "state");
+    INTERN(s_message, "message");
+    INTERN(s_err, "err");
+    INTERN(s_service_id, "service_id");
+    INTERN(s_slot, "slot");
+    INTERN(s_old, "old");
+    INTERN(s_new, "new");
+    INTERN(s_update, "update");
+#undef INTERN
+    empty_tuple = PyTuple_New(0);
+    if (!empty_tuple)
+        return NULL;
+    return PyModule_Create(&moduledef);
+}

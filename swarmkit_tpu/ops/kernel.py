@@ -58,7 +58,10 @@ F_BIG = 1 << 22          # failure down-weight step (dominates svc counts)
 FAILURE_CLAMP = 63       # keeps e = svc + failures*F_BIG inside int32
 SVC_CLAMP = (1 << 20) - 1
 K_CLAMP = 1 << 22        # max group size the kernel accepts (see docstring)
-LOAD_CLAMP = 1 << 29     # branch-load clamp: stage-A levels stay in-range
+LOAD_CLAMP = (1 << 24) - 1   # branch-load clamp: the f32 segment sums are
+                             # exact below 2^24, so clamping there keeps
+                             # stage-A branch ordering exact; branches with
+                             # >16.7M tasks of one service are equi-preferred
 LEVEL_ITERS = 34         # binary search over [0, 2^30]; extra margin
 TIE_ITERS = 34           # binary search over packed 31-bit tie keys
 IDX_BITS = 20

@@ -23,10 +23,10 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..models.objects import Task
+from ..models.objects import Meta, Task
 from ..models.types import (
     GenericResourceKind, MountType, NodeAvailability, NodeState, PublishMode,
-    now,
+    Version, now,
 )
 from ..scheduler import constraint as constraint_mod
 from ..scheduler.filters import normalize_arch, _references_volume_plugin
@@ -69,6 +69,28 @@ def _split_hash(h: int) -> Tuple[int, int]:
 
 
 _SENTINEL = (-1, -1)  # never matches any real hash column value
+
+
+def _fast_assign(task: Task, node_id: str, status) -> Task:
+    """Minimal assignment clone for the columnar commit hot path.
+
+    Equivalent to ``task.copy()`` + set node_id/status, minus the wasted
+    copy of the status we immediately replace.  ``status`` may be shared
+    across the whole group: stored/mirrored objects follow the
+    replace-don't-mutate convention (Task.copy always copies status before
+    any mutation), so structural sharing is safe.
+    """
+    new = object.__new__(Task)
+    d = new.__dict__
+    d.update(task.__dict__)
+    m = task.meta
+    new.meta = Meta(Version(m.version.index), m.created_at, m.updated_at)
+    new.status = status
+    new.node_id = node_id
+    new.networks = list(task.networks)
+    new.assigned_generic_resources = []
+    new.volumes = list(task.volumes)
+    return new
 
 
 class TPUPlanner:
@@ -364,24 +386,66 @@ class TPUPlanner:
 
         # ---- apply: expand per-node counts into per-task decisions
         from ..scheduler.scheduler import SchedulingDecision
-        slots = np.repeat(np.arange(x.shape[0]), x)
-        items = [(tid, tk) for tid, tk in task_group.items()
-                 if tid not in decisions]
+        slots = np.repeat(np.arange(x.shape[0]), x).tolist()
+        items = list(task_group.items())
         ts_now = now()
+        shared_status = TaskStatus(
+            state=TaskState.ASSIGNED, timestamp=ts_now,
+            message="scheduler assigned task to node")
         all_tasks = sched.all_tasks
         placed = 0
-        for (task_id, task), node_i in zip(items, slots):
-            info = infos[int(node_i)]
-            new_t = task.copy()
-            new_t.node_id = info.id
-            new_t.status = TaskStatus(
-                state=TaskState.ASSIGNED, timestamp=ts_now,
-                message="scheduler assigned task to node")
-            all_tasks[task_id] = new_t
-            info.add_task(new_t)
-            decisions[task_id] = SchedulingDecision(task, new_t)
-            del task_group[task_id]
-            placed += 1
+        # batched per-node counting below assumes every placed task counts
+        # toward active-task totals, which holds only for desired_state <=
+        # COMPLETE (reference: nodeinfo.go:132 addTask guard) — tasks
+        # already marked for shutdown take the per-task path
+        simple = (not gen_wanted and not port_limited
+                  and not any(tk.desired_state > TaskState.COMPLETE
+                              for _, tk in items))
+        if simple:
+            # batched mirror update: per-task dict entries, per-*node*
+            # counter/resource arithmetic (NodeInfo.add_task is O(1) but its
+            # Python cost dominates large groups when run per task)
+            from .. import native
+            hp = native.get()
+            placed = min(len(items), len(slots))
+            if hp is not None:
+                node_id_by_i = [info.node.id for info in infos]
+                task_dict_by_i = [info.tasks for info in infos]
+                hp.plan_apply(items, slots, node_id_by_i, task_dict_by_i,
+                              shared_status, all_tasks, decisions,
+                              SchedulingDecision)
+            else:
+                for (task_id, task), node_i in zip(items, slots):
+                    info = infos[node_i]
+                    new_t = _fast_assign(task, info.id, shared_status)
+                    all_tasks[task_id] = new_t
+                    info.tasks[task_id] = new_t
+                    decisions[task_id] = SchedulingDecision(task, new_t)
+            if placed == len(task_group):
+                task_group.clear()
+            else:
+                for task_id, _ in items[:placed]:
+                    del task_group[task_id]
+            service_id = t.service_id
+            for ni in np.nonzero(x)[0].tolist():
+                c = int(x[ni])
+                info = infos[ni]
+                info.active_tasks_count += c
+                svc_map = info.active_tasks_count_by_service
+                svc_map[service_id] = svc_map.get(service_id, 0) + c
+                ar = info.available_resources
+                ar.nano_cpus -= c * cpu_d
+                ar.memory_bytes -= c * mem_d
+        else:
+            # generic resources / host ports need per-task claim bookkeeping
+            for (task_id, task), node_i in zip(items, slots):
+                info = infos[node_i]
+                new_t = _fast_assign(task, info.id, shared_status)
+                all_tasks[task_id] = new_t
+                info.add_task(new_t)
+                decisions[task_id] = SchedulingDecision(task, new_t)
+                del task_group[task_id]
+                placed += 1
 
         self.stats["groups_planned"] += 1
         self.stats["tasks_planned"] += placed

@@ -52,6 +52,12 @@ class Scheduler:
                  batch_planner=None):
         self.store = store
         self.unassigned_tasks: Dict[str, Task] = {}
+        # incremental (service, spec-version) grouping of the unassigned
+        # queue: maintained at enqueue/dequeue time so tick() does not pay
+        # a per-task grouping pass (reference groups in tick,
+        # scheduler.go:438-462 — same result, amortized differently)
+        self.unassigned_groups: Dict[Optional[Tuple[str, int]],
+                                     Dict[str, Task]] = {}
         self.pending_preassigned_tasks: Dict[str, Task] = {}
         self.preassigned_tasks: set = set()
         self.node_set = NodeSet()
@@ -66,7 +72,7 @@ class Scheduler:
         # stats for benchmarking / tests (bounded: long-lived managers
         # tick many times per second)
         from collections import deque
-        self.stats = {"ticks": 0, "decisions": 0,
+        self.stats = {"ticks": 0, "decisions": 0, "commit_seconds": 0.0,
                       "tick_seconds": deque(maxlen=1024)}
 
     # ------------------------------------------------------------------ setup
@@ -172,6 +178,7 @@ class Scheduler:
 
     def _resync(self) -> None:
         self.unassigned_tasks.clear()
+        self.unassigned_groups.clear()
         self.pending_preassigned_tasks.clear()
         self.preassigned_tasks.clear()
         self.all_tasks.clear()
@@ -204,6 +211,20 @@ class Scheduler:
 
     def _enqueue(self, t: Task) -> None:
         self.unassigned_tasks[t.id] = t
+        sv = t.spec_version
+        key = (t.service_id, sv.index) if sv is not None else None
+        self.unassigned_groups.setdefault(key, {})[t.id] = t
+
+    def _dequeue(self, task_id: str) -> None:
+        t = self.unassigned_tasks.pop(task_id, None)
+        if t is not None:
+            sv = t.spec_version
+            key = (t.service_id, sv.index) if sv is not None else None
+            group = self.unassigned_groups.get(key)
+            if group is not None:
+                group.pop(task_id, None)
+                if not group:
+                    del self.unassigned_groups[key]
 
     def _create_task(self, t: Task) -> bool:
         if (t.status.state < TaskState.PENDING
@@ -261,7 +282,7 @@ class Scheduler:
         self.all_tasks.pop(t.id, None)
         self.preassigned_tasks.discard(t.id)
         self.pending_preassigned_tasks.pop(t.id, None)
-        self.unassigned_tasks.pop(t.id, None)
+        self._dequeue(t.id)
         for va in t.volumes:
             self.volumes.release_volume(va.id, t.id)
         info = self.node_set.node_info(t.node_id)
@@ -311,27 +332,33 @@ class Scheduler:
 
     def tick(self) -> int:
         """Schedule the unassigned queue; returns number of decisions."""
+        from ..utils.gctune import paused_gc
+        with paused_gc():
+            return self._tick_inner()
+
+    def _tick_inner(self) -> int:
         t0 = now()
         self.stats["ticks"] += 1
-        tasks_by_common_spec: Dict[Tuple[str, int], Dict[str, Task]] = {}
-        one_off_tasks: List[Task] = []
         decisions: Dict[str, SchedulingDecision] = {}
 
-        for task_id, t in list(self.unassigned_tasks.items()):
-            if t is None or t.node_id:
-                del self.unassigned_tasks[task_id]
-                continue
-            if t.spec_version is not None:
-                key = (t.service_id, t.spec_version.index)
-                tasks_by_common_spec.setdefault(key, {})[task_id] = t
-            else:
-                one_off_tasks.append(t)
-            del self.unassigned_tasks[task_id]
+        # groups are maintained incrementally by _enqueue/_dequeue; take
+        # them over wholesale — failures re-enqueue into fresh dicts during
+        # the scheduling phase below
+        groups = self.unassigned_groups
+        self.unassigned_groups = {}
+        self.unassigned_tasks.clear()
+        one_off_tasks = groups.pop(None, {})
 
-        for group in tasks_by_common_spec.values():
-            self._schedule_task_group(group, decisions)
-        for t in one_off_tasks:
-            self._schedule_task_group({t.id: t}, decisions)
+        for group in groups.values():
+            # drop entries that were assigned out-of-band since enqueue
+            stale = [tid for tid, t in group.items() if t is None or t.node_id]
+            for tid in stale:
+                del group[tid]
+            if group:
+                self._schedule_task_group(group, decisions)
+        for t in one_off_tasks.values():
+            if t is not None and not t.node_id:
+                self._schedule_task_group({t.id: t}, decisions)
 
         n_decisions = len(decisions)
         _, failed = self._apply_scheduling_decisions(decisions)
@@ -351,7 +378,78 @@ class Scheduler:
     def _apply_scheduling_decisions(
             self, decisions: Dict[str, SchedulingDecision]
     ) -> Tuple[List[SchedulingDecision], List[SchedulingDecision]]:
-        """Commit ASSIGNED states (reference: scheduler.go:490)."""
+        """Commit ASSIGNED states (reference: scheduler.go:490).
+
+        Decisions without volume attachments take the store's columnar
+        bulk-commit path (one validation callback per task, no per-task
+        transaction objects or defensive copies); volume-carrying decisions
+        keep the transactional path that also stages volume publish updates.
+        """
+        if not decisions:
+            return [], []
+        t0 = now()
+        try:
+            return self._apply_decisions_inner(decisions)
+        finally:
+            self.stats["commit_seconds"] += now() - t0
+
+    def _apply_decisions_inner(self, decisions):
+        fast: List[SchedulingDecision] = []
+        fast_tasks: List[Task] = []
+        slow: Dict[str, SchedulingDecision] = {}
+        for tid, d in decisions.items():
+            new = d.new
+            if new.volumes:
+                slow[tid] = d
+            else:
+                fast.append(d)
+                fast_tasks.append(new)
+
+        successful: List[SchedulingDecision] = []
+        failed: List[SchedulingDecision] = []
+        if fast:
+            s, f = self._apply_decisions_bulk(fast, fast_tasks)
+            successful.extend(s)
+            failed.extend(f)
+        if slow:
+            s, f = self._apply_decisions_tx(slow)
+            successful.extend(s)
+            failed.extend(f)
+        elif fast:
+            # the tx path frees volumes in its finally; mirror that here
+            self.store.batch(self.volumes.free_volumes)
+        return successful, failed
+
+    def _apply_decisions_bulk(self, fast: List[SchedulingDecision],
+                              fast_tasks: List[Task]):
+        """Columnar commit via store.bulk_update_tasks; same semantic
+        checks as commit_one below."""
+        node_info = self.node_set.node_info
+        raw_get = self.store.raw_get
+
+        def on_assigned(new: Task) -> bool:
+            # stored task already >= ASSIGNED: commit only if our view of
+            # the node is current (node-version conflict check)
+            info = node_info(new.node_id)
+            if info is None:
+                return False
+            node = raw_get(Node, new.node_id)
+            return (node is not None and node.meta.version.index
+                    == info.node.meta.version.index)
+
+        try:
+            committed, failed_idx = self.store.bulk_update_tasks(
+                fast_tasks, on_missing=self._delete_task,
+                on_assigned=on_assigned, guard_state=TaskState.ASSIGNED)
+            return ([fast[i] for i in committed],
+                    [fast[i] for i in failed_idx])
+        except Exception:
+            log.exception("scheduler bulk commit failed")
+            return [], list(fast)
+
+    def _apply_decisions_tx(
+            self, decisions: Dict[str, SchedulingDecision]
+    ) -> Tuple[List[SchedulingDecision], List[SchedulingDecision]]:
         successful: List[SchedulingDecision] = []
         failed: List[SchedulingDecision] = []
         try:

@@ -31,6 +31,7 @@ defensive copies via ``obj.copy()``).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -45,6 +46,8 @@ from .events import Event, EventCommit, EventSnapshotRestore
 from .watch import Queue, Subscription
 
 MAX_CHANGES_PER_TX = 200  # reference: memory.go:45-51
+
+log = logging.getLogger("store")
 
 
 class StoreError(Exception):
@@ -264,9 +267,7 @@ class ReadTx:
         self._store = store
 
     def get(self, kind: Type, id: str) -> Optional[Any]:
-        # single dict lookup: GIL-atomic against _commit's dict writes, and
-        # stored objects are immutable — no lock needed on this hot path
-        return self._store._tables[kind.collection].objects.get(id)
+        return self._store.raw_get(kind, id)
 
     def find(self, kind: Type, by: By = All()) -> List[Any]:
         with self._store._lock:
@@ -407,6 +408,13 @@ class MemoryStore:
         self.queue = Queue()
 
     # ------------------------------------------------------------------ reads
+
+    def raw_get(self, kind: Type, id: str) -> Optional[Any]:
+        """Lock-free point read: a single GIL-atomic dict lookup of an
+        immutable stored object.  The supported fast-read API for hot-path
+        friends (scheduler commit checks); everything else should use
+        ``view``."""
+        return self._tables[kind.collection].objects.get(id)
 
     def view(self, cb: Optional[Callable[[ReadTx], Any]] = None) -> Any:
         tx = ReadTx(self)
@@ -596,6 +604,163 @@ class MemoryStore:
                 return [table.objects[i] for i in ids if i in table.objects]
         pred = self._predicate_for(kind, by)
         return [o for o in table.objects.values() if pred(o)]
+
+    # --------------------------------------------- columnar scheduler commits
+
+    def bulk_update_tasks(self, new_tasks: Sequence[Task], on_missing,
+                          on_assigned,
+                          guard_state: int = 192,  # TaskState.ASSIGNED
+                          ) -> Tuple[List[int], List[int]]:
+        """Columnar commit path for scheduler decisions (the TPU path's
+        array-shaped output).  Semantically one ``batch`` of single-task
+        updates (reference: memory.go:531 + scheduler.go:490), stripped of
+        per-task transaction machinery; the inner loops run in C when the
+        native hotpath module is available (see native/hotpath.c), with an
+        identical pure-Python fallback below.
+
+        Per-item semantics (scheduler.go:594-611 applySchedulingDecisions):
+
+        * no stored object                -> ``on_missing(new)``, skipped;
+        * status (state, message, err) unchanged -> skipped;
+        * stored state >= ``guard_state`` -> ``on_assigned(new)`` returning
+          False fails the item (node-version conflict path);
+        * stale ``new.meta.version.index`` -> failed (SequenceConflict);
+        * otherwise version-stamped and committed.
+
+        ``new_tasks`` ownership transfers to the store — no defensive
+        copies; callers must treat them as immutable afterwards (the same
+        replace-don't-mutate convention stored objects already follow).
+        Proposals/commits/events are chunked at MAX_CHANGES_PER_TX so each
+        raft proposal stays within bounds.  StoreAction construction is
+        elided with a nil proposer, Event construction when nobody is
+        subscribed — both are observable only by their consumers.
+
+        Returns (committed_indices, failed_indices); skipped items appear
+        in neither.
+        """
+        from .. import native
+        hp = native.get()
+        committed_idx: List[int] = []
+        failed_idx: List[int] = []
+        n = len(new_tasks)
+        ts = now()
+        if not isinstance(new_tasks, list):
+            new_tasks = list(new_tasks)
+        with self._update_lock:
+            table = self._tables["tasks"]
+            objects = table.objects
+            want_actions = self._proposer is not None
+            want_events = self.queue.has_subscribers()
+            i = 0
+            while i < n:
+                stop = min(i + MAX_CHANGES_PER_TX, n)
+                with self._lock:
+                    seq = self._version
+                if hp is not None:
+                    committed, failed, stamped, actions, events = \
+                        hp.commit_prepare(
+                            new_tasks, i, stop, objects, seq, ts,
+                            int(guard_state),
+                            StoreAction if want_actions else None,
+                            Event if want_events else None,
+                            on_missing, on_assigned)
+                else:
+                    committed, failed, stamped, actions, events = \
+                        self._commit_prepare_py(
+                            new_tasks, i, stop, objects, seq, ts,
+                            guard_state, want_actions, want_events,
+                            on_missing, on_assigned)
+                i = stop
+                failed_idx.extend(failed)
+                if not stamped:
+                    continue
+                if want_actions:
+                    try:
+                        self._proposer.propose(actions)
+                    except Exception:
+                        # per-chunk failure granularity: earlier chunks are
+                        # committed and stay committed; this chunk and all
+                        # remaining items fail so the caller rolls back only
+                        # what the store did not apply
+                        log.exception("bulk task-update proposal failed")
+                        failed_idx.extend(committed)
+                        failed_idx.extend(range(i, n))
+                        break
+                with self._lock:
+                    if hp is not None:
+                        hp.commit_apply(stamped, objects, table.by_node,
+                                        self._reindex_pair)
+                    else:
+                        self._commit_apply_py(stamped, table)
+                    self._version += len(stamped)
+                committed_idx.extend(committed)
+                if want_events:
+                    publish = self.queue.publish
+                    for ev in events:
+                        publish(ev)
+                self.queue.publish(EventCommit(self._version))
+        return committed_idx, failed_idx
+
+    def _reindex_pair(self, old: Task, new: Task) -> None:
+        table = self._tables["tasks"]
+        self._unindex(table, old)
+        self._index(table, new)
+
+    def _commit_prepare_py(self, new_tasks, start, stop, objects, seq, ts,
+                           guard_state, want_actions, want_events,
+                           on_missing, on_assigned):
+        """Pure-Python mirror of native commit_prepare (and the
+        differential-test oracle for it)."""
+        committed: List[int] = []
+        failed: List[int] = []
+        stamped: List[Task] = []
+        actions: List[StoreAction] = []
+        events: List[Event] = []
+        for i in range(start, stop):
+            new = new_tasks[i]
+            cur = objects.get(new.id)
+            if cur is None:
+                on_missing(new)
+                continue
+            cs, ns = cur.status, new.status
+            if (cs.state == ns.state and cs.message == ns.message
+                    and cs.err == ns.err):
+                continue
+            if cs.state >= guard_state and not on_assigned(new):
+                failed.append(i)
+                continue
+            if cur.meta.version.index != new.meta.version.index:
+                failed.append(i)
+                continue
+            seq += 1
+            m = new.meta
+            m.version.index = seq
+            m.created_at = cur.meta.created_at
+            m.updated_at = ts
+            committed.append(i)
+            stamped.append(new)
+            if want_actions:
+                actions.append(StoreAction("update", new))
+            if want_events:
+                events.append(Event("update", new, cur))
+        return committed, failed, stamped, actions, events
+
+    def _commit_apply_py(self, stamped: List[Task], table: _Table) -> None:
+        objects = table.objects
+        by_node = table.by_node
+        for obj in stamped:
+            old = objects.get(obj.id)
+            objects[obj.id] = obj
+            if old is None:
+                continue
+            if old.service_id != obj.service_id or old.slot != obj.slot:
+                self._unindex(table, old)
+                self._index(table, obj)
+            elif old.node_id != obj.node_id:
+                if old.node_id:
+                    by_node.get(old.node_id, set()).discard(obj.id)
+                if obj.node_id:
+                    by_node.setdefault(obj.node_id, set()).add(obj.id)
 
     # --------------------------------------------------- raft follower replay
 

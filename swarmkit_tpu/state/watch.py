@@ -110,6 +110,9 @@ class Queue:
         for sub in subs:
             sub._publish(event)
 
+    def has_subscribers(self) -> bool:
+        return bool(self._subs)
+
     def publish_all(self, events: Iterable[Any]) -> None:
         for e in events:
             self.publish(e)

@@ -1,0 +1,36 @@
+"""Cyclic-GC control for allocation-heavy hot paths.
+
+The cluster store keeps millions of small objects alive (tasks × nested
+dataclasses); CPython's gen-0 collector fires every ~700 allocations and
+each run scans a slice of that graph.  A 100k-task scheduling tick
+allocates ~1M objects, so GC multiplies the tick's Python cost ~5x
+(measured: 4.4µs vs 26µs per task clone).
+
+``paused_gc()`` disables collection for the duration of a tick-sized
+critical section.  Nothing the scheduler allocates in a tick is cyclic
+garbage (object graphs are trees), so deferring collection is safe; normal
+allocation pressure triggers a collection shortly after the section ends.
+Re-entrant, and leaves GC untouched if the caller already disabled it.
+"""
+
+from __future__ import annotations
+
+import gc
+from contextlib import contextmanager
+
+_depth = 0
+
+
+@contextmanager
+def paused_gc():
+    global _depth
+    outer = _depth == 0 and gc.isenabled()
+    if outer:
+        gc.disable()
+    _depth += 1
+    try:
+        yield
+    finally:
+        _depth -= 1
+        if outer:
+            gc.enable()

@@ -328,3 +328,46 @@ def test_follower_version_counter_matches_leader_after_deletes():
         follower.apply_store_actions(actions)
 
     assert follower.version == leader.version
+
+
+def test_bulk_commit_native_matches_python(monkeypatch):
+    """The C hotpath commit and the pure-Python fallback must produce
+    byte-identical store states (same assignments, same version stamps)."""
+    import swarmkit_tpu.native as native
+    from swarmkit_tpu.scheduler import Scheduler
+    from swarmkit_tpu.ops import TPUPlanner
+    import sys
+    sys.path.insert(0, "tests")
+    from test_scheduler import make_ready_node, make_service_with_tasks
+
+    def run(disable_native):
+        if disable_native:
+            monkeypatch.setenv("SWARMKIT_TPU_NO_NATIVE", "1")
+        else:
+            monkeypatch.delenv("SWARMKIT_TPU_NO_NATIVE", raising=False)
+        store = MemoryStore()
+        nodes = [make_ready_node(f"n{i}", cpus=4) for i in range(7)]
+        svc, tasks = make_service_with_tasks(23)
+
+        def setup(tx):
+            for n in nodes:
+                tx.create(n)
+            tx.create(svc)
+            for t in tasks:
+                tx.create(t)
+
+        store.update(setup)
+        sched = Scheduler(store, batch_planner=TPUPlanner())
+        store.view(sched._setup_tasks_list)
+        n_dec = sched.tick()
+        got = store.view(lambda tx: tx.find(Task))
+        by_name = {nd.id: nd.spec.annotations.name for nd in nodes}
+        return n_dec, sorted(
+            (t.slot, by_name[t.node_id], t.meta.version.index,
+             t.status.state, t.status.message) for t in got)
+
+    n1, native_state = run(disable_native=False)
+    assert native.get() is not None, "native hotpath must build on this image"
+    n2, python_state = run(disable_native=True)
+    assert n1 == n2 == 23
+    assert native_state == python_state
