@@ -1,0 +1,10 @@
+from .global_ import Orchestrator as GlobalOrchestrator
+from .replicated import Orchestrator as ReplicatedOrchestrator
+from .restart import Supervisor as RestartSupervisor
+from .taskreaper import TaskReaper
+from .update import Supervisor as UpdateSupervisor
+
+__all__ = [
+    "GlobalOrchestrator", "ReplicatedOrchestrator", "RestartSupervisor",
+    "TaskReaper", "UpdateSupervisor",
+]

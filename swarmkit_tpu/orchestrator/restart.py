@@ -2,20 +2,27 @@
 restart policy, with delayed starts and per-slot restart history.
 
 Reference: manager/orchestrator/restart/restart.go.
+
+Design difference from the reference: the reference spawns one goroutine per
+delayed start (cheap in Go); here a restart storm would mean thousands of
+Python threads, so all delayed starts are driven by a **single timer worker**
+holding a deadline heap plus one store subscription that watches for the
+old-task-stopped / node-down conditions.
 """
 
 from __future__ import annotations
 
+import heapq
 import logging
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..models.objects import Cluster, Node, Service, Task
 from ..models.types import (
     NodeAvailability, NodeState, RestartCondition, TaskState, now,
 )
-from ..state.events import Event, match
+from ..state.events import Event
 from ..state.store import MemoryStore, WriteTx
 from . import common
 
@@ -32,10 +39,24 @@ class _RestartInfo:
 
 
 class _DelayedStart:
-    def __init__(self) -> None:
-        self.cancelled = threading.Event()
+    """One pending READY->RUNNING transition, owned by the timer worker."""
+
+    __slots__ = ("task_id", "cancelled", "done", "waiter", "delay_deadline",
+                 "wait_task_id", "wait_node_id", "waiting", "wait_deadline",
+                 "callbacks")
+
+    def __init__(self, task_id: str, delay_deadline: float,
+                 wait_task_id: str, wait_node_id: str):
+        self.task_id = task_id
+        self.cancelled = False
         self.done = threading.Event()
         self.waiter = False
+        self.delay_deadline = delay_deadline
+        self.wait_task_id = wait_task_id   # "" = no wait
+        self.wait_node_id = wait_node_id
+        self.waiting = False               # True once in the wait-stop phase
+        self.wait_deadline = 0.0
+        self.callbacks: List[Callable[[], None]] = []
 
 
 class Supervisor:
@@ -45,6 +66,12 @@ class Supervisor:
         self._delays: Dict[str, _DelayedStart] = {}
         self._history: Dict[str, Dict[common.SlotTuple, _RestartInfo]] = {}
         self.task_timeout = DEFAULT_OLD_TASK_TIMEOUT
+        self._worker: Optional[threading.Thread] = None
+        self._stopped = False
+        self._heap: List = []   # (deadline, seq, _DelayedStart)
+        self._seq = 0
+        self._sub = None
+        self._orphans: List[_DelayedStart] = []  # replaced, to be completed
 
     # ------------------------------------------------------------ restarting
 
@@ -58,12 +85,13 @@ class Supervisor:
         with self._mu:
             old_delay = self._delays.get(t.id)
             if old_delay is not None:
+                # t is itself a delayed-start replacement that has not
+                # started yet; restart it after the delay completes
+                # (reference: restart.go:124-139)
                 if not old_delay.waiter:
                     old_delay.waiter = True
-                    threading.Thread(
-                        target=self._wait_restart,
-                        args=(old_delay, cluster, t.id),
-                        daemon=True).start()
+                    old_delay.callbacks.append(
+                        lambda: self._restart_after_delay(cluster, t.id))
                 return
 
         if t.desired_state > TaskState.COMPLETE:
@@ -119,10 +147,8 @@ class Supervisor:
         self.record_restart_history(tuple_, restart_task)
         self.delay_start(t, restart_task.id, restart_delay, wait_stop)
 
-    def _wait_restart(self, old_delay: _DelayedStart,
-                      cluster: Optional[Cluster], task_id: str) -> None:
-        old_delay.done.wait()
-
+    def _restart_after_delay(self, cluster: Optional[Cluster],
+                             task_id: str) -> None:
         def cb(tx: WriteTx) -> None:
             t = tx.get(Task, task_id)
             if t is None or t.desired_state > TaskState.RUNNING:
@@ -228,111 +254,200 @@ class Supervisor:
         """Move new_task READY->RUNNING after the delay elapses and the old
         task stops (or times out).  Returns the completion event
         (reference: restart.go:427 DelayStart)."""
-        ds = _DelayedStart()
-        with self._mu:
-            while True:
-                old = self._delays.get(new_task_id)
-                if old is None:
-                    break
-                old.cancelled.set()
-                self._mu.release()
-                old.done.wait(timeout=5)
-                self._mu.acquire()
-                if self._delays.get(new_task_id) is old:
-                    del self._delays[new_task_id]
-            self._delays[new_task_id] = ds
-
         wait_for_task = (wait_stop and old_task is not None
                          and old_task.status.state <= TaskState.RUNNING)
-
-        sub = None
-        if wait_for_task:
-            old_id = old_task.id
-            old_node = old_task.node_id
-
-            def pred(ev):
-                if not isinstance(ev, Event):
-                    return False
-                obj = ev.obj
-                if isinstance(obj, Task) and obj.id == old_id \
-                        and ev.action == "update" \
-                        and obj.status.state > TaskState.RUNNING:
-                    return True
-                if isinstance(obj, Node) and obj.id == old_node:
-                    if ev.action == "delete":
-                        return True
-                    if ev.action == "update" \
-                            and obj.status.state == NodeState.DOWN:
-                        return True
-                return False
-
-            sub = self.store.queue.subscribe(pred)
-
-        threading.Thread(target=self._delayed_start_thread,
-                         args=(ds, sub, new_task_id, delay, wait_for_task),
-                         daemon=True).start()
+        ds = _DelayedStart(
+            new_task_id, now() + delay,
+            old_task.id if wait_for_task else "",
+            old_task.node_id if wait_for_task else "")
+        with self._mu:
+            old = self._delays.pop(new_task_id, None)
+            if old is not None:
+                # keep it visible to the sweep so its done event fires and
+                # any waiter callbacks run promptly
+                old.cancelled = True
+                self._orphans.append(old)
+            self._delays[new_task_id] = ds
+            self._seq += 1
+            heapq.heappush(self._heap, (ds.delay_deadline, self._seq, ds))
+            self._ensure_worker_locked()
+        if self._sub is not None:
+            self._sub.wake()   # react to the new deadline without poll lag
         return ds.done
 
-    def _delayed_start_thread(self, ds: _DelayedStart, sub,
-                              new_task_id: str, delay: float,
-                              wait_for_task: bool) -> None:
-        try:
-            # 1. wait out the restart delay (interruptible by cancel)
-            if ds.cancelled.wait(timeout=delay):
-                return
-            # 2. wait for the old task to stop (bounded by task_timeout)
-            if wait_for_task and sub is not None:
-                deadline = now() + self.task_timeout
-                while not ds.cancelled.is_set():
-                    remaining = deadline - now()
-                    if remaining <= 0:
-                        break
-                    try:
-                        sub.get(timeout=min(remaining, 0.5))
-                        break
-                    except TimeoutError:
-                        continue
-                    except Exception:
-                        break
-            if ds.cancelled.is_set():
-                return
+    def _ensure_worker_locked(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._stopped = False
+            if self._sub is None:
+                self._sub = self.store.queue.subscribe(self._event_pred)
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="restart-timer", daemon=True)
+            self._worker.start()
+
+    @staticmethod
+    def _event_pred(ev) -> bool:
+        if not isinstance(ev, Event):
+            return False
+        obj = ev.obj
+        if isinstance(obj, Task):
+            return (ev.action == "update"
+                    and obj.status.state > TaskState.RUNNING)
+        if isinstance(obj, Node):
+            return (ev.action == "delete"
+                    or (ev.action == "update"
+                        and obj.status.state == NodeState.DOWN))
+        return False
+
+    def _worker_loop(self) -> None:
+        from ..state.watch import Closed, Subscription
+        while not self._stopped:
+            with self._mu:
+                deadline = self._heap[0][0] if self._heap else None
+            timeout = 0.2 if deadline is None else \
+                min(0.2, max(0.0, deadline - now()))
+            ev = None
             try:
-                self.start_now(new_task_id)
+                ev = self._sub.get(timeout=timeout) if timeout > 0 else None
+            except TimeoutError:
+                pass
+            except Closed:
+                break
+            if ev is not None and ev is not Subscription.WAKE:
+                self._handle_stop_event(ev)
+            self._sweep_cancelled()
+            self._fire_due()
+        # final pass: complete whatever remains so done events always fire
+        self._sweep_cancelled()
+
+    def _handle_stop_event(self, ev: Event) -> None:
+        """An old task stopped or its node died: release waiting entries."""
+        obj = ev.obj
+        ready: List[_DelayedStart] = []
+        with self._mu:
+            for ds in self._delays.values():
+                if not ds.waiting or ds.cancelled:
+                    continue
+                if (isinstance(obj, Task) and obj.id == ds.wait_task_id) or \
+                        (isinstance(obj, Node) and obj.id == ds.wait_node_id):
+                    ready.append(ds)
+        for ds in ready:
+            self._complete(ds)
+
+    def _sweep_cancelled(self) -> None:
+        with self._mu:
+            cancelled = [ds for ds in self._delays.values()
+                         if ds.cancelled and not ds.done.is_set()]
+            cancelled.extend(self._orphans)
+            self._orphans = []
+        for ds in cancelled:
+            self._complete(ds)
+
+    def _fire_due(self) -> None:
+        ts = now()
+        while True:
+            with self._mu:
+                if not self._heap or self._heap[0][0] > ts:
+                    return
+                _, _, ds = heapq.heappop(self._heap)
+                if ds.done.is_set():
+                    continue
+                if not ds.cancelled and not ds.waiting and ds.wait_task_id:
+                    # delay elapsed; wait only if the old task may still
+                    # stop gracefully: it reads <= RUNNING *and* its node is
+                    # alive (a node that died during the delay phase will
+                    # never report the stop — don't sit out task_timeout)
+                    cur = self.store.raw_get(Task, ds.wait_task_id)
+                    node = self.store.raw_get(Node, ds.wait_node_id) \
+                        if ds.wait_node_id else None
+                    node_dead = (ds.wait_node_id
+                                 and (node is None or node.status.state
+                                      == NodeState.DOWN))
+                    if cur is not None and not node_dead and \
+                            cur.status.state <= TaskState.RUNNING:
+                        ds.waiting = True
+                        ds.wait_deadline = ts + self.task_timeout
+                        self._seq += 1
+                        heapq.heappush(self._heap,
+                                       (ds.wait_deadline, self._seq, ds))
+                        continue
+            self._complete(ds)
+
+    def _complete(self, ds: _DelayedStart) -> None:
+        """Fire the READY->RUNNING transition and mark done.  Runs outside
+        _mu: start_now and the callbacks take store locks, and restart()
+        (which can run inside a store transaction) takes _mu — completing
+        under _mu would invert that order."""
+        with self._mu:
+            if ds.done.is_set():
+                return
+            cancelled = ds.cancelled
+        if not cancelled:
+            try:
+                self.start_now(ds.task_id)
             except Exception:
                 log.exception("moving task to RUNNING failed")
-        finally:
-            if sub is not None:
-                self.store.queue.unsubscribe(sub)
-            with self._mu:
-                if self._delays.get(new_task_id) is ds:
-                    del self._delays[new_task_id]
+        with self._mu:
+            if ds.done.is_set():
+                return
+            if self._delays.get(ds.task_id) is ds:
+                del self._delays[ds.task_id]
+            callbacks, ds.callbacks = ds.callbacks, []
             ds.done.set()
+        for cb in callbacks:
+            try:
+                cb()
+            except Exception:
+                log.exception("delayed-start callback failed")
+
+    def start_now_tx(self, tx: WriteTx, task_id: str) -> None:
+        """Move the task to desired RUNNING inside an open transaction."""
+        t = tx.get(Task, task_id)
+        if t is None or t.desired_state >= TaskState.RUNNING:
+            return
+        t = t.copy()
+        t.desired_state = TaskState.RUNNING
+        tx.update(t)
 
     def start_now(self, task_id: str) -> None:
         """Moves the task to the RUNNING state (reference: StartNow)."""
-
-        def cb(tx: WriteTx) -> None:
-            t = tx.get(Task, task_id)
-            if t is None or t.desired_state >= TaskState.RUNNING:
-                return
-            t = t.copy()
-            t.desired_state = TaskState.RUNNING
-            tx.update(t)
-
-        self.store.update(cb)
+        self.store.update(lambda tx: self.start_now_tx(tx, task_id))
 
     def cancel(self, task_id: str) -> None:
         with self._mu:
             ds = self._delays.get(task_id)
+            if ds is not None:
+                ds.cancelled = True
         if ds is not None:
-            ds.cancelled.set()
+            if self._sub is not None:
+                self._sub.wake()
             ds.done.wait(timeout=5)
 
     def cancel_all(self) -> None:
         with self._mu:
-            delays = list(self._delays.values())
-        for ds in delays:
-            ds.cancelled.set()
+            for ds in self._delays.values():
+                ds.cancelled = True
+        if self._sub is not None:
+            self._sub.wake()
+
+    def stop(self) -> None:
+        """Shut the timer worker down (manager demotion/shutdown)."""
+        self.cancel_all()
+        self._stopped = True
+        if self._sub is not None:
+            # closing the subscription pops the worker out of get(); its
+            # exit path runs a final sweep so pending done events fire
+            self.store.queue.unsubscribe(self._sub)
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+            self._worker = None
+        self._sub = None
+        # belt-and-braces: if the worker was wedged, complete leftovers here
+        with self._mu:
+            leftovers = ([ds for ds in self._delays.values()
+                          if not ds.done.is_set()] + self._orphans)
+            self._orphans = []
+        for ds in leftovers:
+            self._complete(ds)
 
     def clear_service_history(self, service_id: str) -> None:
         with self._mu:

@@ -1,0 +1,474 @@
+"""Rolling-update supervisor: one Updater per service, parallelism-bounded
+workers over dirty slots, start-first/stop-first ordering, failure monitoring
+with pause/rollback.
+
+Reference: manager/orchestrator/update/updater.go.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue as queue_mod
+import threading
+from typing import Dict, List, Optional
+
+from ..models.objects import Cluster, Service, Task
+from ..models.types import (
+    TaskState, UpdateFailureAction, UpdateOrder, UpdateState, UpdateStatus,
+    now,
+)
+from ..state.events import Event
+from ..state.store import MemoryStore, WriteTx
+from . import common
+from .restart import Supervisor as RestartSupervisor
+
+log = logging.getLogger("update")
+
+
+def _specs_equal(a, b) -> bool:
+    return a is b or dataclasses.asdict(a) == dataclasses.asdict(b)
+
+
+class Supervisor:
+    """Tracks at most one in-flight Updater per service
+    (reference: updater.go:26)."""
+
+    def __init__(self, store: MemoryStore, restarts: RestartSupervisor):
+        self.store = store
+        self.restarts = restarts
+        self._mu = threading.Lock()
+        self._updates: Dict[str, "Updater"] = {}
+
+    def update(self, cluster: Optional[Cluster], service: Service,
+               slots: List[common.Slot]) -> None:
+        with self._mu:
+            existing = self._updates.get(service.id)
+            if existing is not None:
+                if _specs_equal(service.spec, existing.new_service.spec):
+                    return  # already working towards this goal
+                # blocking cancel serializes updaters per service: the old
+                # one must be fully out of its slots before the new one
+                # touches them (reference: updater.go:56-61).  Safe under
+                # _mu — the updater's done event fires before its cleanup
+                # callback re-takes _mu.
+                existing.cancel()
+            updater = Updater(self.store, self.restarts, cluster, service)
+            self._updates[service.id] = updater
+
+        def run():
+            updater.run(slots)
+            with self._mu:
+                if self._updates.get(service.id) is updater:
+                    del self._updates[service.id]
+
+        threading.Thread(target=run, name=f"updater-{service.id[:8]}",
+                         daemon=True).start()
+
+    def cancel_all(self) -> None:
+        with self._mu:
+            updates = list(self._updates.values())
+        for u in updates:
+            u.cancel()
+
+
+class Updater:
+    """Updates one service's slots to the new spec
+    (reference: updater.go:85)."""
+
+    def __init__(self, store: MemoryStore, restarts: RestartSupervisor,
+                 cluster: Optional[Cluster], new_service: Service):
+        self.store = store
+        self.restarts = restarts
+        self.cluster = cluster.copy() if cluster else None
+        self.new_service = new_service.copy()
+        self._stop = threading.Event()
+        self._done = threading.Event()
+        self._mu = threading.Lock()
+        self._updated_tasks: Dict[str, float] = {}  # id -> RUNNING stamp
+
+    def cancel(self) -> None:
+        self._stop.set()
+        self._done.wait(timeout=10)
+
+    # ----------------------------------------------------------------- run
+
+    def run(self, slots: List[common.Slot]) -> None:
+        try:
+            self._run(slots)
+        except Exception:
+            log.exception("updater failed")
+        finally:
+            self._done.set()
+
+    def _run(self, slots: List[common.Slot]) -> None:
+        service = self.new_service
+        us = service.update_status
+        if us is not None and us.state in (UpdateState.PAUSED,
+                                           UpdateState.ROLLBACK_PAUSED):
+            return
+
+        dirty_slots = [s for s in slots if self._is_slot_dirty(s)]
+        if not dirty_slots:
+            if us is not None and us.state in (UpdateState.UPDATING,
+                                               UpdateState.ROLLBACK_STARTED):
+                self._complete_update(service.id)
+            return
+
+        if us is None:
+            self._start_update(service.id)
+
+        rollback = us is not None and us.state == UpdateState.ROLLBACK_STARTED
+        update_config = common.update_config_for(service, rollback)
+        monitoring_period = update_config.monitor or 30.0
+
+        parallelism = update_config.parallelism or len(dirty_slots)
+
+        failed_tasks: set = set()
+        self._total_failures = 0
+        self._stopped = False
+        n_dirty = len(dirty_slots)
+
+        def failure_triggers_action(failed_task: Task) -> bool:
+            if failed_task.id in failed_tasks:
+                return False
+            with self._mu:
+                started_at = self._updated_tasks.get(failed_task.id)
+            if started_at is None:
+                return False
+            if started_at and now() - started_at > monitoring_period:
+                return False
+            failed_tasks.add(failed_task.id)
+            self._total_failures += 1
+            if (self._total_failures / n_dirty
+                    > update_config.max_failure_ratio):
+                action = update_config.failure_action
+                if action == UpdateFailureAction.PAUSE:
+                    self._stopped = True
+                    self._pause_update(
+                        service.id,
+                        "update paused due to failure or early termination "
+                        f"of task {failed_task.id}")
+                    return True
+                if action == UpdateFailureAction.ROLLBACK:
+                    if rollback:
+                        # never roll back a rollback
+                        self._pause_update(
+                            service.id,
+                            "rollback paused due to failure or early "
+                            f"termination of task {failed_task.id}")
+                        return True
+                    self._stopped = True
+                    self._rollback_update(
+                        service.id,
+                        "update rolled back due to failure or early "
+                        f"termination of task {failed_task.id}")
+                    return True
+            return False
+
+        watch_failures = (update_config.failure_action
+                          != UpdateFailureAction.CONTINUE)
+        failed_watch = None
+        if watch_failures:
+            sid = service.id
+
+            def pred(ev):
+                return (isinstance(ev, Event) and ev.action == "update"
+                        and isinstance(ev.obj, Task)
+                        and ev.obj.service_id == sid
+                        and ev.obj.status.state > TaskState.RUNNING)
+
+            failed_watch = self.store.queue.subscribe(pred)
+
+        try:
+            slot_queue: "queue_mod.Queue" = queue_mod.Queue(maxsize=1)
+            workers = [threading.Thread(
+                target=self._worker, args=(slot_queue, update_config),
+                daemon=True) for _ in range(parallelism)]
+            for w in workers:
+                w.start()
+
+            aborted = False
+            for slot in dirty_slots:
+                while not aborted:
+                    if self._stop.is_set():
+                        self._stopped = True
+                        aborted = True
+                        break
+                    if failed_watch is not None:
+                        try:
+                            ev = failed_watch.get_nowait()
+                            if failure_triggers_action(ev.obj):
+                                aborted = True
+                                break
+                        except queue_mod.Empty:
+                            pass
+                        except Exception:
+                            pass
+                    try:
+                        slot_queue.put(slot, timeout=0.1)
+                        break
+                    except queue_mod.Full:
+                        continue
+                if aborted:
+                    break
+
+            for _ in workers:
+                while True:
+                    try:
+                        slot_queue.put(None, timeout=0.5)
+                        break
+                    except queue_mod.Full:
+                        if self._stop.is_set():
+                            break
+            for w in workers:
+                w.join(timeout=30)
+
+            if not self._stopped and not self._stop.is_set():
+                # monitor window before declaring completion
+                if update_config.delay >= monitoring_period:
+                    monitoring_period = update_config.delay + 1.0
+                from ..state.watch import Closed
+                deadline = now() + monitoring_period
+                while now() < deadline:
+                    if self._stop.is_set():
+                        self._stopped = True
+                        break
+                    if failed_watch is None:
+                        break
+                    try:
+                        ev = failed_watch.get(
+                            timeout=min(0.2, deadline - now()))
+                    except TimeoutError:
+                        continue
+                    except Closed:
+                        break
+                    if failure_triggers_action(ev.obj):
+                        break
+
+            if not self._stopped and not self._stop.is_set():
+                self._complete_update(service.id)
+        finally:
+            if failed_watch is not None:
+                self.store.queue.unsubscribe(failed_watch)
+
+    # -------------------------------------------------------------- workers
+
+    def _worker(self, slot_queue, update_config) -> None:
+        while True:
+            slot = slot_queue.get()
+            if slot is None:
+                return
+            running_task = None
+            clean_task = None
+            for t in slot:
+                if not self._is_task_dirty(t):
+                    if t.desired_state == TaskState.RUNNING:
+                        running_task = t
+                        break
+                    if t.desired_state < TaskState.RUNNING:
+                        clean_task = t
+            try:
+                if running_task is not None:
+                    self._use_existing_task(slot, running_task)
+                elif clean_task is not None:
+                    self._use_existing_task(slot, clean_task)
+                else:
+                    node_id = ""
+                    if common.is_global_service(self.new_service):
+                        node_id = slot[0].node_id
+                    updated = common.new_task(
+                        self.cluster, self.new_service, slot[0].slot, node_id)
+                    updated.desired_state = TaskState.READY
+                    self._update_task(slot, updated, update_config.order)
+            except Exception:
+                log.exception("update failed")
+            if update_config.delay:
+                if self._stop.wait(timeout=update_config.delay):
+                    return
+
+    def _update_task(self, slot: common.Slot, updated: Task, order) -> None:
+        """Atomically create the updated task and bring down the old one
+        (reference: updater.go:367)."""
+        uid = updated.id
+
+        def pred(ev):
+            return (isinstance(ev, Event) and isinstance(ev.obj, Task)
+                    and ev.obj.id == uid and ev.action == "update")
+
+        sub = self.store.queue.subscribe(pred)
+        try:
+            with self._mu:
+                self._updated_tasks[uid] = 0.0
+
+            start_then_stop = order == UpdateOrder.START_FIRST
+            delay_done = None
+
+            def txn(tx: WriteTx) -> None:
+                nonlocal delay_done
+                if tx.get(Service, updated.service_id) is None:
+                    raise RuntimeError("service was deleted")
+                tx.create(updated)
+                if start_then_stop:
+                    delay_done = self.restarts.delay_start(
+                        None, uid, 0.0, False)
+                else:
+                    old_task = self._remove_old_tasks(tx, slot)
+                    delay_done = self.restarts.delay_start(
+                        old_task, uid, 0.0, True)
+
+            self.store.update(txn)
+
+            if delay_done is not None:
+                while not delay_done.wait(timeout=0.2):
+                    if self._stop.is_set():
+                        return
+
+            # wait for the new task to come up
+            while True:
+                if self._stop.is_set():
+                    return
+                try:
+                    ev = sub.get(timeout=0.2)
+                except TimeoutError:
+                    continue
+                except Exception:
+                    return
+                t = ev.obj
+                if t.status.state >= TaskState.RUNNING:
+                    with self._mu:
+                        self._updated_tasks[uid] = now()
+                    if start_then_stop and \
+                            t.status.state == TaskState.RUNNING:
+                        def rm(tx: WriteTx) -> None:
+                            self._remove_old_tasks(tx, slot)
+                        try:
+                            self.store.update(rm)
+                        except Exception:
+                            log.exception("failed to remove old task after "
+                                          "starting replacement")
+                    return
+        finally:
+            self.store.queue.unsubscribe(sub)
+
+    def _use_existing_task(self, slot: common.Slot, existing: Task) -> None:
+        remove = [t for t in slot if t is not existing]
+        if not remove and existing.desired_state == TaskState.RUNNING:
+            return
+        delay_done = None
+
+        def txn(tx: WriteTx) -> None:
+            nonlocal delay_done
+            old_task = self._remove_old_tasks(tx, remove) if remove else None
+            if existing.desired_state != TaskState.RUNNING:
+                delay_done = self.restarts.delay_start(
+                    old_task, existing.id, 0.0, True)
+
+        self.store.update(txn)
+        if delay_done is not None:
+            while not delay_done.wait(timeout=0.2):
+                if self._stop.is_set():
+                    return
+
+    def _remove_old_tasks(self, tx: WriteTx,
+                          remove: common.Slot) -> Optional[Task]:
+        """Shut down the given tasks; returns one that was shut down
+        (reference: updater.go:493)."""
+        removed = None
+        for original in remove:
+            if original.desired_state > TaskState.RUNNING:
+                continue
+            t = tx.get(Task, original.id)
+            if t is None:
+                continue
+            if t.desired_state > TaskState.RUNNING:
+                continue
+            t = t.copy()
+            t.desired_state = TaskState.SHUTDOWN
+            tx.update(t)
+            removed = t
+        return removed
+
+    # ------------------------------------------------------------ dirtiness
+
+    def _is_task_dirty(self, t: Task) -> bool:
+        from ..models.objects import Node
+        n = self.store.raw_get(Node, t.node_id) if t.node_id else None
+        return common.is_task_dirty(self.new_service, t, n)
+
+    def _is_slot_dirty(self, slot: common.Slot) -> bool:
+        return len(slot) > 1 or (len(slot) == 1
+                                 and self._is_task_dirty(slot[0]))
+
+    # -------------------------------------------------------- status writes
+
+    def _start_update(self, service_id: str) -> None:
+        def cb(tx: WriteTx) -> None:
+            service = tx.get(Service, service_id)
+            if service is None or service.update_status is not None:
+                return
+            service = service.copy()
+            service.update_status = UpdateStatus(
+                state=UpdateState.UPDATING, started_at=now(),
+                message="update in progress")
+            tx.update(service)
+
+        self._safe_update(cb, "mark update in progress")
+
+    def _pause_update(self, service_id: str, message: str) -> None:
+        def cb(tx: WriteTx) -> None:
+            service = tx.get(Service, service_id)
+            if service is None or service.update_status is None:
+                return
+            service = service.copy()
+            if service.update_status.state == UpdateState.ROLLBACK_STARTED:
+                service.update_status.state = UpdateState.ROLLBACK_PAUSED
+            else:
+                service.update_status.state = UpdateState.PAUSED
+            service.update_status.message = message
+            tx.update(service)
+
+        self._safe_update(cb, "pause update")
+
+    def _rollback_update(self, service_id: str, message: str) -> None:
+        def cb(tx: WriteTx) -> None:
+            service = tx.get(Service, service_id)
+            if service is None or service.update_status is None:
+                return
+            service = service.copy()
+            service.update_status.state = UpdateState.ROLLBACK_STARTED
+            service.update_status.message = message
+            if service.previous_spec is None:
+                raise RuntimeError("cannot roll back service because no "
+                                   "previous spec is available")
+            service.spec = service.previous_spec
+            service.spec_version = (service.previous_spec_version.copy()
+                                    if service.previous_spec_version else None)
+            service.previous_spec = None
+            service.previous_spec_version = None
+            tx.update(service)
+
+        self._safe_update(cb, "start rollback")
+
+    def _complete_update(self, service_id: str) -> None:
+        def cb(tx: WriteTx) -> None:
+            service = tx.get(Service, service_id)
+            if service is None or service.update_status is None:
+                return
+            service = service.copy()
+            if service.update_status.state == UpdateState.ROLLBACK_STARTED:
+                service.update_status.state = UpdateState.ROLLBACK_COMPLETED
+                service.update_status.message = "rollback completed"
+            else:
+                service.update_status.state = UpdateState.COMPLETED
+                service.update_status.message = "update completed"
+            service.update_status.completed_at = now()
+            tx.update(service)
+
+        self._safe_update(cb, "mark update complete")
+
+    def _safe_update(self, cb, what: str) -> None:
+        try:
+            self.store.update(cb)
+        except Exception:
+            log.exception("failed to %s", what)

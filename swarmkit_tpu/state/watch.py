@@ -67,6 +67,18 @@ class Subscription:
                 raise Closed()
             raise TimeoutError()
 
+    WAKE = object()   # sentinel returned by get() after wake()
+
+    def wake(self) -> None:
+        """Make a blocked get() return Subscription.WAKE promptly — lets a
+        worker that multiplexes timers with this subscription react to new
+        timers without waiting out its poll timeout."""
+        with self._cond:
+            if self._closed:
+                return
+            self._buf.append(Subscription.WAKE)
+            self._cond.notify()
+
     def poll(self) -> Optional[Any]:
         with self._cond:
             if self._buf:

@@ -1,0 +1,128 @@
+"""Integration: the full control-plane slice in one process.
+
+Mirrors the reference's integration suite approach (integration/
+cluster_test.go — real components wired together, no containers):
+service create → replicated orchestrator → TPU scheduler → fake agent →
+RUNNING; failure → restart → re-placement; scale-down → REMOVE → reaper.
+"""
+
+from swarmkit_tpu.models import (
+    Annotations, Cluster, ReplicatedService, Service, Task, TaskState,
+    TaskStatus,
+)
+from swarmkit_tpu.models.specs import ClusterSpec
+from swarmkit_tpu.models.types import now
+from swarmkit_tpu.ops import TPUPlanner
+from swarmkit_tpu.orchestrator import (
+    GlobalOrchestrator, ReplicatedOrchestrator, TaskReaper,
+)
+from swarmkit_tpu.scheduler import Scheduler
+from swarmkit_tpu.state import ByService, MemoryStore
+
+from test_orchestrator import FakeAgent, make_global, make_replicated, poll
+from test_scheduler import make_ready_node
+
+
+def test_full_slice_service_to_running_with_healing():
+    store = MemoryStore()
+    cluster = Cluster(id="c1", spec=ClusterSpec(
+        annotations=Annotations(name="default")))
+    nodes = [make_ready_node(f"n{i}", cpus=8) for i in range(5)]
+
+    def setup(tx):
+        tx.create(cluster)
+        for n in nodes:
+            tx.create(n)
+
+    store.update(setup)
+
+    sched = Scheduler(store, batch_planner=TPUPlanner())
+    orch = ReplicatedOrchestrator(store)
+    reaper = TaskReaper(store)
+    agent = FakeAgent(store)
+    sched.start()
+    orch.start()
+    reaper.start()
+
+    try:
+        svc = make_replicated("web", 10)
+        store.update(lambda tx: tx.create(svc))
+
+        def all_running():
+            got = [t for t in store.view(
+                lambda tx: tx.find(Task, ByService(svc.id)))
+                if t.desired_state == TaskState.RUNNING]
+            return (len(got) == 10
+                    and all(t.status.state == TaskState.RUNNING
+                            and t.node_id for t in got))
+
+        poll(all_running, timeout=30,
+             msg="10 replicas should reach RUNNING on nodes")
+        got = store.view(lambda tx: tx.find(Task, ByService(svc.id)))
+        per_node = {}
+        for t in got:
+            per_node[t.node_id] = per_node.get(t.node_id, 0) + 1
+        assert sorted(per_node.values()) == [2, 2, 2, 2, 2], per_node
+
+        # failure healing
+        victim = got[0]
+
+        def fail(tx):
+            t = tx.get(Task, victim.id).copy()
+            t.status = TaskStatus(state=TaskState.FAILED, timestamp=now(),
+                                  err="sim crash")
+            tx.update(t)
+
+        store.update(fail)
+
+        def healed():
+            live = [t for t in store.view(
+                lambda tx: tx.find(Task, ByService(svc.id)))
+                if t.desired_state <= TaskState.RUNNING
+                and t.id != victim.id]
+            return (len(live) == 10
+                    and all(t.status.state == TaskState.RUNNING
+                            and t.node_id for t in live))
+
+        poll(healed, timeout=30,
+             msg="failed task should be replaced and re-placed")
+
+        # scale down + reap
+        cur = store.view(lambda tx: tx.get(Service, svc.id)).copy()
+        cur.spec.replicated = ReplicatedService(replicas=3)
+        store.update(lambda tx: tx.update(cur))
+
+        def scaled():
+            all_t = store.view(lambda tx: tx.find(Task, ByService(svc.id)))
+            live = [t for t in all_t
+                    if t.desired_state == TaskState.RUNNING]
+            return len(live) == 3 and len(all_t) <= 6
+
+        poll(scaled, timeout=30,
+             msg="scale down to 3 with REMOVE'd tasks reaped")
+
+        # global service on the side, sharing the restart supervisor
+        gsvc = make_global("monitor")
+        store.update(lambda tx: tx.create(gsvc))
+        gorch = GlobalOrchestrator(store, restarts=orch.restarts)
+        gorch.start()
+        try:
+            def global_done():
+                got = [t for t in store.view(
+                    lambda tx: tx.find(Task, ByService(gsvc.id)))
+                    if t.desired_state <= TaskState.RUNNING]
+                return (len(got) == 5
+                        and {t.node_id for t in got}
+                        == {n.id for n in nodes}
+                        and all(t.status.state == TaskState.RUNNING
+                                for t in got))
+
+            poll(global_done, timeout=30,
+                 msg="global service should run on all 5 nodes")
+        finally:
+            gorch.stop()
+    finally:
+        sched.stop()
+        orch.stop()
+        reaper.stop()
+        agent.stop()

@@ -1,0 +1,596 @@
+"""Orchestrator layer tests: replicated/global reconciliation, restart
+policy, rolling updates, task reaper.
+
+Mirrors the reference's test strategy (manager/orchestrator/*/..._test.go):
+real MemoryStore with nil proposer, orchestrators running their event loops,
+assertions via store polling.  A FakeAgent stands in for the dispatcher+agent
+pipeline by advancing task status to follow desired state.
+"""
+
+import threading
+import time
+
+import pytest
+
+from swarmkit_tpu.models import (
+    Annotations, Cluster, GlobalService, Node, NodeAvailability,
+    NodeDescription, NodeSpec, NodeState, NodeStatus, ReplicatedService,
+    Resources, RestartCondition, RestartPolicy, Service, ServiceMode,
+    ServiceSpec, Task, TaskSpec, TaskState, TaskStatus, UpdateConfig,
+    UpdateFailureAction, UpdateState, Version,
+)
+from swarmkit_tpu.models.specs import ClusterSpec
+from swarmkit_tpu.models.types import now
+from swarmkit_tpu.orchestrator import (
+    GlobalOrchestrator, ReplicatedOrchestrator, TaskReaper,
+)
+from swarmkit_tpu.state import ByService, MemoryStore
+from swarmkit_tpu.state.events import Event
+from swarmkit_tpu.utils import new_id
+
+
+def poll(cond, timeout=8.0, interval=0.05, msg="condition not met"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        result = cond()
+        if result:
+            return result
+        time.sleep(interval)
+    raise AssertionError(msg)
+
+
+def make_node(name, availability=NodeAvailability.ACTIVE,
+              state=NodeState.READY, labels=None):
+    return Node(
+        id=new_id(),
+        spec=NodeSpec(annotations=Annotations(name=name, labels=labels or {}),
+                      availability=availability),
+        status=NodeStatus(state=state),
+        description=NodeDescription(hostname=name),
+    )
+
+
+def make_replicated(name, replicas, restart=None, update=None, image="img:1"):
+    from swarmkit_tpu.models.specs import ContainerSpec
+    return Service(
+        id=new_id(),
+        spec=ServiceSpec(
+            annotations=Annotations(name=name),
+            task=TaskSpec(container=ContainerSpec(image=image),
+                          restart=restart or RestartPolicy(delay=0.05)),
+            mode=ServiceMode.REPLICATED,
+            replicated=ReplicatedService(replicas=replicas),
+            update=update,
+        ),
+        spec_version=Version(index=1),
+    )
+
+
+def make_global(name, constraints=None):
+    from swarmkit_tpu.models.specs import ContainerSpec
+    from swarmkit_tpu.models import Placement
+    return Service(
+        id=new_id(),
+        spec=ServiceSpec(
+            annotations=Annotations(name=name),
+            task=TaskSpec(container=ContainerSpec(image="img:1"),
+                          restart=RestartPolicy(delay=0.05),
+                          placement=Placement(constraints=constraints or [])),
+            mode=ServiceMode.GLOBAL,
+        ),
+        spec_version=Version(index=1),
+    )
+
+
+class FakeAgent:
+    """Advances task status to follow desired state, like a worker would
+    (tests/fakes pattern, reference: agent/testutils/fakes.go)."""
+
+    def __init__(self, store):
+        self.store = store
+        self._stop = threading.Event()
+        self._sub = store.queue.subscribe(
+            lambda ev: isinstance(ev, Event) and isinstance(ev.obj, Task))
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        from swarmkit_tpu.state.watch import Closed
+        while not self._stop.is_set():
+            try:
+                ev = self._sub.get(timeout=0.1)
+            except TimeoutError:
+                continue
+            except Closed:
+                return
+            if ev.action == "delete":
+                continue
+            self._advance(ev.obj.id)
+
+    def _advance(self, task_id):
+        def cb(tx):
+            t = tx.get(Task, task_id)
+            if t is None:
+                return
+            t = t.copy()
+            if t.desired_state == TaskState.RUNNING and \
+                    t.status.state < TaskState.RUNNING:
+                t.status = TaskStatus(state=TaskState.RUNNING,
+                                      timestamp=now(), message="started")
+            elif t.desired_state >= TaskState.SHUTDOWN and \
+                    TaskState.ASSIGNED <= t.status.state <= TaskState.RUNNING:
+                t.status = TaskStatus(state=TaskState.SHUTDOWN,
+                                      timestamp=now(), message="shutdown")
+            else:
+                return
+            tx.update(t)
+        try:
+            self.store.update(cb)
+        except Exception:
+            pass
+
+    def stop(self):
+        self._stop.set()
+        self.store.queue.unsubscribe(self._sub)
+        self._thread.join(timeout=2)
+
+
+@pytest.fixture
+def store():
+    s = MemoryStore()
+    cluster = Cluster(id=new_id(),
+                      spec=ClusterSpec(annotations=Annotations(
+                          name="default")))
+    s.update(lambda tx: tx.create(cluster))
+    yield s
+    s.close()
+
+
+def tasks_of(store, svc):
+    return store.view(lambda tx: tx.find(Task, ByService(svc.id)))
+
+
+def live_tasks(store, svc):
+    return [t for t in tasks_of(store, svc)
+            if t.desired_state <= TaskState.RUNNING]
+
+
+# ------------------------------------------------------------------ replicated
+
+def test_replicated_creates_tasks(store):
+    orch = ReplicatedOrchestrator(store)
+    orch.start()
+    try:
+        svc = make_replicated("web", 3)
+        store.update(lambda tx: tx.create(svc))
+        poll(lambda: len(tasks_of(store, svc)) == 3,
+             msg="3 tasks should be created")
+        got = tasks_of(store, svc)
+        assert sorted(t.slot for t in got) == [1, 2, 3]
+        assert all(t.desired_state == TaskState.RUNNING for t in got)
+        assert all(t.status.state == TaskState.NEW for t in got)
+    finally:
+        orch.stop()
+
+
+def test_replicated_scale_up_and_down(store):
+    orch = ReplicatedOrchestrator(store)
+    orch.start()
+    try:
+        svc = make_replicated("web", 2)
+        store.update(lambda tx: tx.create(svc))
+        poll(lambda: len(live_tasks(store, svc)) == 2)
+
+        cur = store.view(lambda tx: tx.get(Service, svc.id)).copy()
+        cur.spec.replicated = ReplicatedService(replicas=5)
+        store.update(lambda tx: tx.update(cur))
+        poll(lambda: len(live_tasks(store, svc)) == 5,
+             msg="scale up to 5")
+        assert sorted(t.slot for t in live_tasks(store, svc)) == \
+            [1, 2, 3, 4, 5]
+
+        cur = store.view(lambda tx: tx.get(Service, svc.id)).copy()
+        cur.spec.replicated = ReplicatedService(replicas=1)
+        store.update(lambda tx: tx.update(cur))
+        poll(lambda: len(live_tasks(store, svc)) == 1,
+             msg="scale down to 1")
+        removed = [t for t in tasks_of(store, svc)
+                   if t.desired_state == TaskState.REMOVE]
+        assert len(removed) == 4
+    finally:
+        orch.stop()
+
+
+def test_replicated_restart_on_failure(store):
+    orch = ReplicatedOrchestrator(store)
+    orch.start()
+    try:
+        svc = make_replicated("web", 1)
+        store.update(lambda tx: tx.create(svc))
+        poll(lambda: len(tasks_of(store, svc)) == 1)
+        t0 = tasks_of(store, svc)[0]
+
+        # simulate the agent reporting failure
+        def fail(tx):
+            t = tx.get(Task, t0.id).copy()
+            t.status = TaskStatus(state=TaskState.FAILED, timestamp=now(),
+                                  err="boom")
+            tx.update(t)
+        store.update(fail)
+
+        def replaced():
+            got = tasks_of(store, svc)
+            news = [t for t in got if t.id != t0.id]
+            olds = [t for t in got if t.id == t0.id]
+            return (news and olds
+                    and olds[0].desired_state == TaskState.SHUTDOWN
+                    and news[0].slot == t0.slot)
+        poll(replaced, msg="failed task should be replaced in same slot")
+
+        # the replacement moves READY->RUNNING after the restart delay
+        def replacement_running():
+            news = [t for t in tasks_of(store, svc) if t.id != t0.id]
+            return news and news[0].desired_state == TaskState.RUNNING
+        poll(replacement_running,
+             msg="replacement should reach desired RUNNING after delay")
+    finally:
+        orch.stop()
+
+
+def test_replicated_restart_condition_none(store):
+    orch = ReplicatedOrchestrator(store)
+    orch.start()
+    try:
+        svc = make_replicated(
+            "web", 1, restart=RestartPolicy(condition=RestartCondition.NONE))
+        store.update(lambda tx: tx.create(svc))
+        poll(lambda: len(tasks_of(store, svc)) == 1)
+        t0 = tasks_of(store, svc)[0]
+
+        def fail(tx):
+            t = tx.get(Task, t0.id).copy()
+            t.status = TaskStatus(state=TaskState.FAILED, timestamp=now())
+            tx.update(t)
+        store.update(fail)
+        poll(lambda: tasks_of(store, svc)[0].desired_state
+             == TaskState.SHUTDOWN)
+        time.sleep(0.3)
+        assert len(tasks_of(store, svc)) == 1, \
+            "no replacement for restart-condition NONE"
+    finally:
+        orch.stop()
+
+
+def test_replicated_node_down_restarts_elsewhere(store):
+    orch = ReplicatedOrchestrator(store)
+    orch.start()
+    try:
+        node = make_node("n1")
+        store.update(lambda tx: tx.create(node))
+        svc = make_replicated("web", 1)
+        store.update(lambda tx: tx.create(svc))
+        poll(lambda: len(tasks_of(store, svc)) == 1)
+        t0 = tasks_of(store, svc)[0]
+
+        # pretend the scheduler assigned it and it ran on n1
+        def assign(tx):
+            t = tx.get(Task, t0.id).copy()
+            t.node_id = node.id
+            t.status = TaskStatus(state=TaskState.RUNNING, timestamp=now())
+            tx.update(t)
+        store.update(assign)
+
+        def down(tx):
+            n = tx.get(Node, node.id).copy()
+            n.status = NodeStatus(state=NodeState.DOWN)
+            tx.update(n)
+        store.update(down)
+
+        def replacement_created():
+            got = tasks_of(store, svc)
+            news = [t for t in got if t.id != t0.id]
+            return news and not news[0].node_id
+        poll(replacement_created,
+             msg="task on downed node should be replaced with unassigned")
+    finally:
+        orch.stop()
+
+
+def test_service_delete_marks_tasks_remove(store):
+    orch = ReplicatedOrchestrator(store)
+    orch.start()
+    try:
+        svc = make_replicated("web", 2)
+        store.update(lambda tx: tx.create(svc))
+        poll(lambda: len(tasks_of(store, svc)) == 2)
+        store.update(lambda tx: tx.delete(Service, svc.id))
+        poll(lambda: all(t.desired_state == TaskState.REMOVE
+                         for t in tasks_of(store, svc)),
+             msg="deleted service's tasks should be marked REMOVE")
+    finally:
+        orch.stop()
+
+
+# -------------------------------------------------------------------- global
+
+def test_global_one_task_per_node(store):
+    orch = GlobalOrchestrator(store)
+    orch.start()
+    try:
+        n1, n2 = make_node("n1"), make_node("n2")
+        store.update(lambda tx: (tx.create(n1), tx.create(n2)))
+        svc = make_global("agent")
+        store.update(lambda tx: tx.create(svc))
+        poll(lambda: len(tasks_of(store, svc)) == 2)
+        got = tasks_of(store, svc)
+        assert {t.node_id for t in got} == {n1.id, n2.id}
+        assert all(t.slot == 0 for t in got)
+
+        # a new node gets a task too
+        n3 = make_node("n3")
+        store.update(lambda tx: tx.create(n3))
+        poll(lambda: len(tasks_of(store, svc)) == 3)
+    finally:
+        orch.stop()
+
+
+def test_global_respects_constraints(store):
+    orch = GlobalOrchestrator(store)
+    orch.start()
+    try:
+        n1 = make_node("gpu1", labels={"gpu": "true"})
+        n2 = make_node("cpu1")
+        store.update(lambda tx: (tx.create(n1), tx.create(n2)))
+        svc = make_global("gpu-agent",
+                          constraints=["node.labels.gpu==true"])
+        store.update(lambda tx: tx.create(svc))
+        poll(lambda: len(tasks_of(store, svc)) == 1)
+        assert tasks_of(store, svc)[0].node_id == n1.id
+        time.sleep(0.3)
+        assert len(tasks_of(store, svc)) == 1
+    finally:
+        orch.stop()
+
+
+def test_global_drain_shuts_down_tasks(store):
+    orch = GlobalOrchestrator(store)
+    orch.start()
+    try:
+        n1, n2 = make_node("n1"), make_node("n2")
+        store.update(lambda tx: (tx.create(n1), tx.create(n2)))
+        svc = make_global("agent")
+        store.update(lambda tx: tx.create(svc))
+        poll(lambda: len(tasks_of(store, svc)) == 2)
+
+        def drain(tx):
+            n = tx.get(Node, n1.id).copy()
+            n.spec.availability = NodeAvailability.DRAIN
+            tx.update(n)
+        store.update(drain)
+
+        def drained():
+            got = tasks_of(store, svc)
+            on_n1 = [t for t in got if t.node_id == n1.id]
+            return on_n1 and all(t.desired_state >= TaskState.SHUTDOWN
+                                 for t in on_n1)
+        poll(drained, msg="tasks on drained node should be shut down")
+    finally:
+        orch.stop()
+
+
+# ------------------------------------------------------------- rolling update
+
+def test_rolling_update_replaces_tasks(store):
+    agent = FakeAgent(store)
+    orch = ReplicatedOrchestrator(store)
+    orch.start()
+    try:
+        svc = make_replicated(
+            "web", 2, image="img:1",
+            update=UpdateConfig(parallelism=1, monitor=0.1))
+        store.update(lambda tx: tx.create(svc))
+        poll(lambda: len(tasks_of(store, svc)) == 2)
+        poll(lambda: all(t.status.state == TaskState.RUNNING
+                         for t in live_tasks(store, svc)))
+
+        # update the image
+        def bump(tx):
+            cur = tx.get(Service, svc.id).copy()
+            cur.previous_spec = cur.spec
+            cur.previous_spec_version = cur.spec_version
+            cur.spec = cur.spec.copy()
+            cur.spec.task.container.image = "img:2"
+            cur.spec_version = Version(index=2)
+            tx.update(cur)
+        store.update(bump)
+
+        def updated():
+            live = live_tasks(store, svc)
+            return (len(live) == 2
+                    and all(t.spec.container.image == "img:2" for t in live)
+                    and all(t.status.state == TaskState.RUNNING
+                            for t in live))
+        poll(updated, timeout=15, msg="all tasks should run img:2")
+
+        cur = store.view(lambda tx: tx.get(Service, svc.id))
+        poll(lambda: (store.view(lambda tx: tx.get(Service, svc.id))
+                      .update_status.state == UpdateState.COMPLETED),
+             msg="update status should complete")
+    finally:
+        orch.stop()
+        agent.stop()
+
+
+def test_rolling_update_failure_pauses(store):
+    orch = ReplicatedOrchestrator(store)
+
+    # agent that runs img:1 but fails img:2 tasks
+    class FailingAgent(FakeAgent):
+        def _advance(self, task_id):
+            def cb(tx):
+                t = tx.get(Task, task_id)
+                if t is None:
+                    return
+                t = t.copy()
+                if t.desired_state == TaskState.RUNNING and \
+                        t.status.state < TaskState.RUNNING:
+                    if t.spec.container.image == "img:2":
+                        t.status = TaskStatus(state=TaskState.FAILED,
+                                              timestamp=now(), err="crash")
+                    else:
+                        t.status = TaskStatus(state=TaskState.RUNNING,
+                                              timestamp=now())
+                elif t.desired_state >= TaskState.SHUTDOWN and \
+                        TaskState.ASSIGNED <= t.status.state <= \
+                        TaskState.RUNNING:
+                    t.status = TaskStatus(state=TaskState.SHUTDOWN,
+                                          timestamp=now())
+                else:
+                    return
+                tx.update(t)
+            try:
+                self.store.update(cb)
+            except Exception:
+                pass
+
+    agent = FailingAgent(store)
+    orch.start()
+    try:
+        svc = make_replicated(
+            "web", 2, image="img:1",
+            update=UpdateConfig(parallelism=1, monitor=5.0,
+                                failure_action=UpdateFailureAction.PAUSE),
+            restart=RestartPolicy(condition=RestartCondition.NONE))
+        store.update(lambda tx: tx.create(svc))
+        poll(lambda: len(live_tasks(store, svc)) == 2)
+        poll(lambda: all(t.status.state == TaskState.RUNNING
+                         for t in live_tasks(store, svc)))
+
+        def bump(tx):
+            cur = tx.get(Service, svc.id).copy()
+            cur.previous_spec = cur.spec
+            cur.previous_spec_version = cur.spec_version
+            cur.spec = cur.spec.copy()
+            cur.spec.task.container.image = "img:2"
+            cur.spec_version = Version(index=2)
+            tx.update(cur)
+        store.update(bump)
+
+        poll(lambda: (store.view(lambda tx: tx.get(Service, svc.id))
+                      .update_status is not None
+                      and store.view(lambda tx: tx.get(Service, svc.id))
+                      .update_status.state == UpdateState.PAUSED),
+             timeout=15, msg="update should pause after failure")
+    finally:
+        orch.stop()
+        agent.stop()
+
+
+# ---------------------------------------------------------------- task reaper
+
+def test_task_reaper_respects_retention_limit(store):
+    # set retention limit to 2
+    def set_limit(tx):
+        from swarmkit_tpu.state import ByName
+        c = tx.find(Cluster, ByName("default"))[0].copy()
+        c.spec.orchestration.task_history_retention_limit = 2
+        tx.update(c)
+    store.update(set_limit)
+
+    reaper = TaskReaper(store)
+    reaper.start()
+    try:
+        svc = make_replicated("web", 1)
+        store.update(lambda tx: tx.create(svc))
+
+        # simulate a slot with 5 historic (dead) tasks + 1 running
+        def add_history(tx):
+            for i in range(5):
+                t = Task(id=new_id(), service_id=svc.id, slot=1,
+                         desired_state=TaskState.SHUTDOWN,
+                         spec=svc.spec.task,
+                         spec_version=Version(index=1),
+                         status=TaskStatus(state=TaskState.SHUTDOWN,
+                                           timestamp=now() - 100 + i))
+                tx.create(t)
+            live = Task(id=new_id(), service_id=svc.id, slot=1,
+                        desired_state=TaskState.RUNNING,
+                        spec=svc.spec.task, spec_version=Version(index=1),
+                        status=TaskStatus(state=TaskState.RUNNING,
+                                          timestamp=now()))
+            tx.create(live)
+        store.update(add_history)
+
+        poll(lambda: len(tasks_of(store, svc)) == 2,
+             msg=f"reaper should prune history to limit; have "
+                 f"{len(tasks_of(store, svc))}")
+    finally:
+        reaper.stop()
+
+
+def test_task_reaper_deletes_removed_tasks(store):
+    reaper = TaskReaper(store)
+    reaper.start()
+    try:
+        svc = make_replicated("web", 1)
+        store.update(lambda tx: tx.create(svc))
+        t = Task(id=new_id(), service_id=svc.id, slot=1,
+                 desired_state=TaskState.RUNNING, spec=svc.spec.task,
+                 spec_version=Version(index=1),
+                 status=TaskStatus(state=TaskState.RUNNING))
+        store.update(lambda tx: tx.create(t))
+
+        # scale-down marks it REMOVE; the agent then reports SHUTDOWN
+        def mark_remove(tx):
+            cur = tx.get(Task, t.id).copy()
+            cur.desired_state = TaskState.REMOVE
+            tx.update(cur)
+        store.update(mark_remove)
+
+        def agent_shutdown(tx):
+            cur = tx.get(Task, t.id).copy()
+            cur.status = TaskStatus(state=TaskState.SHUTDOWN, timestamp=now())
+            tx.update(cur)
+        store.update(agent_shutdown)
+
+        poll(lambda: store.view(lambda tx: tx.get(Task, t.id)) is None,
+             msg="shut-down REMOVE task should be deleted")
+    finally:
+        reaper.stop()
+
+
+def test_orchestrator_startup_fixes_inconsistent_tasks(store):
+    """taskinit pass: the previous leader left (a) a task whose service was
+    deleted and (b) a READY task whose restart delay already elapsed.
+    Startup must fix both without deadlocking the store (regression: the
+    check ran inside view_and_watch's critical section)."""
+    svc = make_replicated("web", 1)
+    orphan = Task(id=new_id(), service_id="gone-service", slot=1,
+                  desired_state=TaskState.RUNNING, spec=svc.spec.task,
+                  spec_version=Version(index=1),
+                  status=TaskStatus(state=TaskState.RUNNING))
+    ready = Task(id=new_id(), service_id=svc.id, slot=1,
+                 desired_state=TaskState.READY, spec=svc.spec.task,
+                 spec_version=Version(index=1),
+                 status=TaskStatus(state=TaskState.ASSIGNED,
+                                   timestamp=now() - 60))
+
+    def setup(tx):
+        tx.create(svc)
+        tx.create(orphan)
+        tx.create(ready)
+    store.update(setup)
+
+    orch = ReplicatedOrchestrator(store)
+    orch.start()
+    try:
+        poll(lambda: store.view(lambda tx: tx.get(Task, orphan.id)) is None,
+             msg="orphan task of deleted service should be removed")
+        poll(lambda: (store.view(lambda tx: tx.get(Task, ready.id))
+                      .desired_state == TaskState.RUNNING),
+             msg="stranded READY task should be started")
+        # the store must still accept writes (no deadlock)
+        probe = make_node("probe")
+        store.update(lambda tx: tx.create(probe))
+    finally:
+        orch.stop()
