@@ -1,0 +1,6 @@
+from .agent import Agent
+from .exec import Controller, Executor, do_task
+from .worker import TaskManager, Worker
+
+__all__ = ["Agent", "Controller", "Executor", "TaskManager", "Worker",
+           "do_task"]

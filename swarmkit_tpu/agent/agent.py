@@ -1,0 +1,169 @@
+"""Agent: the node-side runtime connecting a worker to the dispatcher.
+
+Reference: agent/{agent.go,session.go,reporter.go}.
+
+One session loop: register → heartbeat keepalive → assignments stream →
+worker; status changes flow back through a batching reporter.  On any
+session failure the agent backs off exponentially and re-registers — the
+dispatcher sends a fresh COMPLETE set on reconnect (session.go:120,
+agent.go:179).
+
+The ``client`` is anything with the dispatcher's surface (register /
+heartbeat / open_assignments / update_task_status); in-process that is the
+Dispatcher object itself, over the network a gRPC client wrapper.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..models.types import TaskStatus
+from ..state.watch import Closed
+from .exec import Executor
+from .worker import Worker
+
+log = logging.getLogger("agent")
+
+
+class Agent:
+    def __init__(self, node_id: str, executor: Executor, client,
+                 description=None):
+        self.node_id = node_id
+        self.executor = executor
+        self.client = client
+        self.description = description
+        self.worker = Worker(executor, self._report)
+        self.session_id: Optional[str] = None
+        self._stop = threading.Event()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # status reporter batching (reference: reporter.go)
+        self._statuses_mu = threading.Lock()
+        self._statuses: Dict[str, TaskStatus] = {}
+        self._statuses_cond = threading.Condition(self._statuses_mu)
+        self._reporter_thread: Optional[threading.Thread] = None
+        self.stats = {"sessions": 0, "reports": 0}
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, name="agent",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._statuses_cond:
+            self._statuses_cond.notify_all()
+        self.worker.close()
+        self._done.wait(timeout=10)
+
+    def run(self) -> None:
+        backoff = 0.1
+        try:
+            self._reporter_thread = threading.Thread(
+                target=self._reporter_loop, name="agent-reporter",
+                daemon=True)
+            self._reporter_thread.start()
+            while not self._stop.is_set():
+                try:
+                    self._session()
+                    backoff = 0.1
+                except Exception as e:
+                    if self._stop.is_set():
+                        return
+                    log.info("agent session failed (%s); backing off %.1fs",
+                             e, backoff)
+                    self._stop.wait(timeout=backoff)
+                    backoff = min(backoff * 2, 8.0)
+        finally:
+            self._done.set()
+
+    # --------------------------------------------------------------- session
+
+    def _session(self) -> None:
+        description = self.description
+        if description is None:
+            try:
+                description = self.executor.describe()
+            except Exception:
+                description = None
+        session_id, period = self.client.register(
+            self.node_id, description=description)
+        self.session_id = session_id
+        self.stats["sessions"] += 1
+        log.info("agent session established (%s)", session_id[:8])
+
+        failed = threading.Event()
+
+        def heartbeat_loop():
+            p = period
+            while not self._stop.is_set() and not failed.is_set():
+                if self._stop.wait(timeout=p):
+                    return
+                try:
+                    p = self.client.heartbeat(self.node_id, session_id)
+                except Exception:
+                    failed.set()
+                    return
+
+        hb = threading.Thread(target=heartbeat_loop, name="agent-heartbeat",
+                              daemon=True)
+        hb.start()
+
+        stream = self.client.open_assignments(self.node_id, session_id)
+        try:
+            while not self._stop.is_set() and not failed.is_set():
+                try:
+                    msg = stream.get(timeout=0.2)
+                except TimeoutError:
+                    continue
+                except Closed:
+                    raise stream.error or ConnectionError("stream closed")
+                if msg.type == "complete":
+                    self.worker.assign(msg.changes)
+                else:
+                    self.worker.update(msg.changes)
+            if failed.is_set():
+                raise ConnectionError("heartbeat failed")
+        finally:
+            stream.close()
+            failed.set()
+            hb.join(timeout=2)
+
+    # -------------------------------------------------------------- reporter
+
+    def _report(self, task_id: str, status: TaskStatus) -> None:
+        with self._statuses_cond:
+            self._statuses[task_id] = status
+            self._statuses_cond.notify()
+
+    def _reporter_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._statuses_cond:
+                if not self._statuses:
+                    self._statuses_cond.wait(timeout=0.2)
+                batch, self._statuses = self._statuses, {}
+            if not batch:
+                continue
+            session_id = self.session_id
+            if session_id is None:
+                self._requeue(batch)
+                continue
+            try:
+                self.client.update_task_status(
+                    self.node_id, session_id, list(batch.items()))
+                self.stats["reports"] += len(batch)
+            except Exception:
+                # retry on next session; newer statuses win
+                self._requeue(batch)
+                self._stop.wait(timeout=0.2)
+
+    def _requeue(self, batch: Dict[str, TaskStatus]) -> None:
+        with self._statuses_cond:
+            for task_id, status in batch.items():
+                cur = self._statuses.get(task_id)
+                if cur is None or cur.state < status.state:
+                    self._statuses[task_id] = status

@@ -1,0 +1,211 @@
+"""Worker: applies assignment sets and supervises per-task managers.
+
+Reference: agent/{worker.go,task.go} plus the dependency stores in
+agent/dependency.go.
+
+The worker holds the node's view of its assigned tasks (plus the secrets/
+configs they reference) and runs one TaskManager per task.  A TaskManager
+drives the Controller FSM via exec.do_task in its own thread and reports
+every status change through the agent's reporter.  (The reference persists
+assigned tasks in bbolt so supervision survives daemon restarts —
+agent/storage.go; the host-side task DB lands with the serde layer.)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..models.objects import Config, Secret, Task
+from ..models.types import TaskState, TaskStatus, now
+from . import exec as exec_mod
+
+log = logging.getLogger("agent.worker")
+
+Reporter = Callable[[str, TaskStatus], None]
+
+
+class TaskManager:
+    """Supervises one task: drives the controller FSM and pushes status
+    (reference: agent/task.go:16)."""
+
+    RETRY_BACKOFF = 0.1
+
+    def __init__(self, task: Task, ctlr: exec_mod.Controller,
+                 reporter: Reporter):
+        self.task = task.copy()
+        self.ctlr = ctlr
+        self.reporter = reporter
+        self._update_cond = threading.Condition()
+        self._pending_update: Optional[Task] = None
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"taskmanager-{task.id[:8]}", daemon=True)
+        self._thread.start()
+
+    def update(self, t: Task) -> None:
+        with self._update_cond:
+            desired_changed = t.desired_state != self.task.desired_state
+            self._pending_update = t.copy()
+            self._update_cond.notify()
+        if desired_changed:
+            # pop the manager thread out of a blocking controller call so
+            # it can act on the new desired state (e.g. shut down a task
+            # that is blocked in wait())
+            try:
+                self.ctlr.interrupt()
+            except Exception:
+                log.exception("controller interrupt failed")
+
+    def close(self) -> None:
+        self._closed.set()
+        with self._update_cond:
+            self._update_cond.notify()
+        try:
+            self.ctlr.interrupt()
+        except Exception:
+            pass
+
+    def join(self, timeout=5) -> None:
+        self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        while not self._closed.is_set():
+            with self._update_cond:
+                if self._pending_update is not None:
+                    update = self._pending_update
+                    self._pending_update = None
+                    self.task.desired_state = update.desired_state
+                    self.task.spec = update.spec
+                    try:
+                        self.ctlr.update(self.task)
+                    except Exception:
+                        log.exception("controller update failed")
+
+            status, flag = exec_mod.do_task(self.task, self.ctlr)
+            changed = (status.state != self.task.status.state
+                       or status.message != self.task.status.message
+                       or status.err != self.task.status.err)
+            self.task.status = status
+            if changed:
+                try:
+                    self.reporter(self.task.id, status.copy())
+                except Exception:
+                    log.exception("status report failed")
+
+            if flag is exec_mod.ErrTaskNoop:
+                # nothing to do until the task definition changes
+                with self._update_cond:
+                    if self._pending_update is None \
+                            and not self._closed.is_set():
+                        self._update_cond.wait(timeout=0.5)
+            elif flag is exec_mod.ErrTaskRetry:
+                self._closed.wait(timeout=self.RETRY_BACKOFF)
+        try:
+            self.ctlr.close()
+        except Exception:
+            pass
+
+
+class Worker:
+    """reference: agent/worker.go:30."""
+
+    def __init__(self, executor: exec_mod.Executor, reporter: Reporter):
+        self.executor = executor
+        self.reporter = reporter
+        self._mu = threading.Lock()
+        self.task_managers: Dict[str, TaskManager] = {}
+        self.secrets: Dict[str, Secret] = {}
+        self.configs: Dict[str, Config] = {}
+        self._closed = False
+
+    # ------------------------------------------------------------- applying
+
+    def assign(self, changes: List[tuple]) -> None:
+        """Apply a COMPLETE assignment set (reference: worker.go:129)."""
+        with self._mu:
+            if self._closed:
+                return
+            self._reconcile_deps(changes, full=True)
+            self._reconcile_tasks(changes, full=True)
+
+    def update(self, changes: List[tuple]) -> None:
+        """Apply an INCREMENTAL assignment set
+        (reference: worker.go:168)."""
+        with self._mu:
+            if self._closed:
+                return
+            self._reconcile_deps(changes, full=False)
+            self._reconcile_tasks(changes, full=False)
+
+    def _reconcile_deps(self, changes: List[tuple], full: bool) -> None:
+        seen_secrets, seen_configs = set(), set()
+        for action, kind, obj in changes:
+            if kind == "secret":
+                if action == "update":
+                    self.secrets[obj.id] = obj
+                    seen_secrets.add(obj.id)
+                else:
+                    self.secrets.pop(obj.id, None)
+            elif kind == "config":
+                if action == "update":
+                    self.configs[obj.id] = obj
+                    seen_configs.add(obj.id)
+                else:
+                    self.configs.pop(obj.id, None)
+        if full:
+            for sid in list(self.secrets):
+                if sid not in seen_secrets:
+                    del self.secrets[sid]
+            for cid in list(self.configs):
+                if cid not in seen_configs:
+                    del self.configs[cid]
+
+    def _reconcile_tasks(self, changes: List[tuple], full: bool) -> None:
+        updated: List[Task] = []
+        removed: List[Task] = []
+        for action, kind, obj in changes:
+            if kind != "task":
+                continue
+            (updated if action == "update" else removed).append(obj)
+
+        assigned = set()
+        for t in updated:
+            assigned.add(t.id)
+            mgr = self.task_managers.get(t.id)
+            if mgr is not None:
+                mgr.update(t)
+            else:
+                self._start_task(t)
+
+        if full:
+            for task_id in list(self.task_managers):
+                if task_id not in assigned:
+                    self._close_manager(task_id)
+        for t in removed:
+            self._close_manager(t.id)
+
+    def _start_task(self, t: Task) -> None:
+        try:
+            ctlr = self.executor.controller(t)
+        except Exception:
+            log.exception("controller resolution failed")
+            self.reporter(t.id, TaskStatus(
+                state=TaskState.REJECTED, timestamp=now(),
+                err="controller resolution failed"))
+            return
+        self.task_managers[t.id] = TaskManager(t, ctlr, self.reporter)
+
+    def _close_manager(self, task_id: str) -> None:
+        mgr = self.task_managers.pop(task_id, None)
+        if mgr is not None:
+            mgr.close()
+
+    def close(self) -> None:
+        with self._mu:
+            self._closed = True
+            managers = list(self.task_managers.values())
+            self.task_managers.clear()
+        for mgr in managers:
+            mgr.close()

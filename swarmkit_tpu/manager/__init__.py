@@ -1,0 +1,7 @@
+from .allocator import Allocator, PortAllocator
+from .dispatcher import (
+    AssignmentsMessage, AssignmentStream, DefaultConfig, Dispatcher,
+)
+
+__all__ = ["Allocator", "AssignmentsMessage", "AssignmentStream",
+           "DefaultConfig", "Dispatcher", "PortAllocator"]

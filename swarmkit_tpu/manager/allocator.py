@@ -1,0 +1,285 @@
+"""Allocator: moves tasks NEW → PENDING by allocating their resources.
+
+Reference: manager/allocator/{allocator.go,network.go,portallocator.go}.
+
+The reference's allocator runs a set of sub-allocators (today: network) that
+each *vote* on a task; when every registered voter has approved, the task
+moves to PENDING with message "pending task scheduling" (allocator.go:38-48,
+network.go:770).  Network allocation itself (VIPs, overlay attachments) is a
+pluggable driver that lives outside the core in the reference (libnetwork);
+here the network layer is the ``Inert`` implementation plus real **ingress
+port bookkeeping**: published ports are assigned from the dynamic range
+30000-32767 when unspecified, and conflicts are rejected
+(portallocator.go:201).
+
+Service allocation materializes ``service.endpoint`` from the endpoint spec;
+task allocation copies the service endpoint onto the task so the scheduler's
+host-port filter sees published ports.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..models.objects import Network, Service, Task
+from ..models.types import (
+    Endpoint, PortConfig, PublishMode, TaskState, TaskStatus, now,
+)
+from ..state.events import Event, EventCommit, EventSnapshotRestore
+from ..state.store import Batch, MemoryStore
+from ..state.watch import Closed
+
+log = logging.getLogger("allocator")
+
+ALLOCATED_STATUS_MESSAGE = "pending task scheduling"  # network.go:21
+DYNAMIC_PORT_START = 30000  # portallocator.go (dynamicPortStart)
+DYNAMIC_PORT_END = 32767
+
+
+class PortAllocator:
+    """Ingress published-port bookkeeping (reference: portallocator.go)."""
+
+    def __init__(self) -> None:
+        self._allocated: Set[Tuple[int, int]] = set()  # (protocol, port)
+        self._next_dynamic = DYNAMIC_PORT_START
+
+    def restore(self, endpoint: Optional[Endpoint]) -> None:
+        if endpoint is None:
+            return
+        for p in endpoint.ports:
+            if p.publish_mode == PublishMode.INGRESS and p.published_port:
+                self._allocated.add((p.protocol, p.published_port))
+
+    def release(self, endpoint: Optional[Endpoint]) -> None:
+        if endpoint is None:
+            return
+        for p in endpoint.ports:
+            if p.publish_mode == PublishMode.INGRESS and p.published_port:
+                self._allocated.discard((p.protocol, p.published_port))
+
+    def allocate(self, spec_ports: List[PortConfig]) -> List[PortConfig]:
+        """Resolve a port list: keep user-specified ports (conflict =
+        error), assign dynamic ports for unspecified ingress publishes."""
+        resolved: List[PortConfig] = []
+        taken: List[Tuple[int, int]] = []
+        try:
+            for p in spec_ports:
+                if p.publish_mode != PublishMode.INGRESS:
+                    resolved.append(p)
+                    continue
+                if p.published_port:
+                    key = (p.protocol, p.published_port)
+                    if key in self._allocated:
+                        raise ValueError(
+                            f"port '{p.published_port}' is already in use "
+                            "by service")
+                    self._allocated.add(key)
+                    taken.append(key)
+                    resolved.append(p)
+                else:
+                    port = self._find_dynamic(p.protocol)
+                    key = (p.protocol, port)
+                    self._allocated.add(key)
+                    taken.append(key)
+                    resolved.append(PortConfig(
+                        name=p.name, protocol=p.protocol,
+                        target_port=p.target_port, published_port=port,
+                        publish_mode=p.publish_mode))
+            return resolved
+        except ValueError:
+            for key in taken:
+                self._allocated.discard(key)
+            raise
+
+    def _find_dynamic(self, protocol: int) -> int:
+        for _ in range(DYNAMIC_PORT_END - DYNAMIC_PORT_START + 1):
+            port = self._next_dynamic
+            self._next_dynamic += 1
+            if self._next_dynamic > DYNAMIC_PORT_END:
+                self._next_dynamic = DYNAMIC_PORT_START
+            if (protocol, port) not in self._allocated:
+                return port
+        raise ValueError("dynamic port space exhausted")
+
+
+class Allocator:
+    """Event-loop allocator (reference: allocator.go:82 Run)."""
+
+    def __init__(self, store: MemoryStore):
+        self.store = store
+        self.ports = PortAllocator()
+        self._stop = threading.Event()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._pending_tasks: Dict[str, Task] = {}
+        self._pending_services: Dict[str, Service] = {}
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, name="allocator",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._done.wait(timeout=10)
+
+    def run(self) -> None:
+        try:
+            def init(tx):
+                for s in tx.find(Service):
+                    self.ports.restore(s.endpoint)
+                for s in tx.find(Service):
+                    if self._service_needs_allocation(s):
+                        self._pending_services[s.id] = s
+                for t in tx.find(Task):
+                    if t.status.state == TaskState.NEW:
+                        self._pending_tasks[t.id] = t
+
+            _, sub = self.store.view_and_watch(init)
+            try:
+                self._tick()
+                while not self._stop.is_set():
+                    try:
+                        event = sub.get(timeout=0.2)
+                    except TimeoutError:
+                        continue
+                    except Closed:
+                        return
+                    if isinstance(event, EventCommit):
+                        self._tick()
+                    elif isinstance(event, EventSnapshotRestore):
+                        self._resync()
+                    elif isinstance(event, Event):
+                        self._handle_event(event)
+            finally:
+                self.store.queue.unsubscribe(sub)
+        finally:
+            self._done.set()
+
+    def _resync(self) -> None:
+        self._pending_tasks.clear()
+        self._pending_services.clear()
+        self.ports = PortAllocator()
+
+        def init(tx):
+            for s in tx.find(Service):
+                self.ports.restore(s.endpoint)
+                if self._service_needs_allocation(s):
+                    self._pending_services[s.id] = s
+            for t in tx.find(Task):
+                if t.status.state == TaskState.NEW:
+                    self._pending_tasks[t.id] = t
+
+        self.store.view(init)
+        self._tick()
+
+    # ----------------------------------------------------------- event intake
+
+    def _handle_event(self, ev: Event) -> None:
+        obj = ev.obj
+        if isinstance(obj, Task):
+            if ev.action == "delete":
+                self._pending_tasks.pop(obj.id, None)
+            elif obj.status.state == TaskState.NEW:
+                self._pending_tasks[obj.id] = obj
+        elif isinstance(obj, Service):
+            if ev.action == "delete":
+                self.ports.release(obj.endpoint)
+                self._pending_services.pop(obj.id, None)
+            elif self._service_needs_allocation(obj):
+                self._pending_services[obj.id] = obj
+
+    @staticmethod
+    def _service_needs_allocation(s: Service) -> bool:
+        spec_ep = s.spec.endpoint
+        if s.endpoint is None:
+            return spec_ep is not None
+        have = {(p.protocol, p.target_port, p.publish_mode)
+                for p in s.endpoint.ports}
+        want = {(p.protocol, p.target_port, p.publish_mode)
+                for p in (spec_ep.ports if spec_ep else [])}
+        return have != want
+
+    # ----------------------------------------------------------------- ticks
+
+    def _tick(self) -> None:
+        if self._pending_services:
+            services, self._pending_services = self._pending_services, {}
+            self._allocate_services(services)
+        if self._pending_tasks:
+            tasks, self._pending_tasks = self._pending_tasks, {}
+            self._allocate_tasks(tasks)
+
+    def _allocate_services(self, services: Dict[str, Service]) -> None:
+        def cb(batch: Batch) -> None:
+            for service in services.values():
+                def one(tx, service=service):
+                    cur = tx.get(Service, service.id)
+                    if cur is None or not self._service_needs_allocation(cur):
+                        return
+                    cur = cur.copy()
+                    old_endpoint = cur.endpoint
+                    spec_ep = cur.spec.endpoint
+                    # release this service's own ports first so keeping a
+                    # port across a spec change doesn't self-conflict;
+                    # restore them if the new allocation fails
+                    self.ports.release(old_endpoint)
+                    try:
+                        ports = self.ports.allocate(
+                            list(spec_ep.ports) if spec_ep else [])
+                    except ValueError as e:
+                        self.ports.restore(old_endpoint)
+                        log.warning("service %s port allocation failed: %s",
+                                    service.id, e)
+                        return
+                    cur.endpoint = Endpoint(
+                        spec=spec_ep.copy() if spec_ep else None,
+                        ports=ports)
+                    tx.update(cur)
+                try:
+                    batch.update(one)
+                except Exception:
+                    log.exception("service allocation failed")
+
+        try:
+            self.store.batch(cb)
+        except Exception:
+            log.exception("service allocation batch failed")
+
+    def _allocate_tasks(self, tasks: Dict[str, Task]) -> None:
+        def cb(batch: Batch) -> None:
+            for task in tasks.values():
+                def one(tx, task=task):
+                    t = tx.get(Task, task.id)
+                    if t is None or t.status.state != TaskState.NEW:
+                        return
+                    t = t.copy()
+                    # propagate the service's allocated endpoint so the
+                    # scheduler's host-port filter and the agent see ports
+                    if t.service_id:
+                        service = tx.get(Service, t.service_id)
+                        if service is not None:
+                            if self._service_needs_allocation(service):
+                                # wait for service allocation first; the
+                                # commit event will re-trigger us
+                                self._pending_tasks[t.id] = t
+                                return
+                            if service.endpoint is not None:
+                                t.endpoint = service.endpoint.copy()
+                    t.status = TaskStatus(
+                        state=TaskState.PENDING, timestamp=now(),
+                        message=ALLOCATED_STATUS_MESSAGE)
+                    tx.update(t)
+                try:
+                    batch.update(one)
+                except Exception:
+                    log.exception("task allocation failed")
+
+        try:
+            self.store.batch(cb)
+        except Exception:
+            log.exception("task allocation batch failed")

@@ -1,0 +1,586 @@
+"""Dispatcher: the worker-facing control channel.
+
+Reference: manager/dispatcher/dispatcher.go, assignments.go, nodes.go,
+heartbeat/heartbeat.go.
+
+Responsibilities (matching the reference):
+
+* ``register``      — session creation for a known node; marks node READY
+  (dispatcher.go:553).
+* ``heartbeat``     — TTL refresh with ±epsilon jitter; expiry marks the
+  node DOWN (dispatcher.go:1317, :29-34).
+* ``open_assignments`` — a stream of COMPLETE + INCREMENTAL assignment
+  diffs (tasks >= ASSIGNED on the node, plus referenced secrets/configs),
+  batched 100ms / 100 modifications (dispatcher.go:1013, assignments.go).
+* ``update_task_status`` — validated, batched status writeback; status only
+  moves forward (dispatcher.go:607, :726).
+* down-node tracking — nodes DOWN longer than ``orphan_timeout`` get their
+  tasks moved to ORPHANED so resources free up (dispatcher.go:52, :1209).
+
+Transport: in-process method calls shaped like the gRPC surface (register /
+session stream / assignments stream / unary status updates) so a network
+transport can wrap this object 1:1.  All timers (heartbeat TTLs, orphan
+deadlines, status-update batching) run on one worker thread.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..models.objects import Config, Node, Secret, Task
+from ..models.types import NodeState, NodeStatus, TaskState, TaskStatus, now
+from ..state.events import Event
+from ..state.store import Batch, ByNode, MemoryStore
+from ..state.watch import Closed, Subscription
+from ..utils import new_id
+
+log = logging.getLogger("dispatcher")
+
+
+@dataclass
+class Config_:
+    """reference: dispatcher.go:29-53 DefaultConfig."""
+
+    heartbeat_period: float = 5.0
+    heartbeat_epsilon: float = 0.5
+    grace_multiplier: float = 3.0
+    rate_limit_period: float = 8.0
+    process_updates_interval: float = 0.100
+    max_batch_items: int = 100
+    assignment_batching_wait: float = 0.100
+    modification_batch_limit: int = 100
+    orphan_timeout: float = 24 * 3600.0
+
+
+DefaultConfig = Config_
+
+
+class DispatcherError(Exception):
+    pass
+
+
+class ErrNodeNotFound(DispatcherError):
+    pass
+
+
+class ErrSessionInvalid(DispatcherError):
+    pass
+
+
+class ErrNodeNotRegistered(DispatcherError):
+    pass
+
+
+@dataclass
+class _RegisteredNode:
+    node_id: str
+    session_id: str
+    deadline: float = 0.0
+    registered_at: float = field(default_factory=now)
+    streams: List["AssignmentStream"] = field(default_factory=list)
+
+
+class AssignmentsMessage:
+    """One batch of assignment changes (reference: api/dispatcher.proto)."""
+
+    COMPLETE = "complete"
+    INCREMENTAL = "incremental"
+
+    __slots__ = ("type", "applies_to", "results_in", "changes")
+
+    def __init__(self, type_, applies_to, results_in, changes):
+        self.type = type_
+        self.applies_to = applies_to
+        self.results_in = results_in
+        self.changes = changes  # list of (action, kind, obj)
+
+
+class AssignmentStream:
+    """Server-side push stream of AssignmentsMessage, one per Assignments
+    call; a thread in the dispatcher feeds it."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self._buf: List[AssignmentsMessage] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self.error: Optional[Exception] = None
+
+    def _push(self, msg: AssignmentsMessage) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._buf.append(msg)
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> AssignmentsMessage:
+        with self._cond:
+            if not self._buf and not self._closed:
+                self._cond.wait(timeout)
+            if self._buf:
+                return self._buf.pop(0)
+            if self._closed:
+                raise Closed()
+            raise TimeoutError()
+
+    def close(self, error: Optional[Exception] = None) -> None:
+        with self._cond:
+            self.error = error
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+
+class _AssignmentSet:
+    """Tracks what a node currently knows and computes diffs
+    (reference: assignments.go newAssignmentSet)."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self.tasks: Dict[str, Task] = {}
+        self.deps_use: Dict[Tuple[str, str], Set[str]] = {}  # (kind,id)->task ids
+        self.changes: Dict[Tuple[str, str], tuple] = {}
+
+    # --- dependencies
+
+    def _task_deps(self, t: Task) -> List[Tuple[str, str]]:
+        deps = []
+        c = t.spec.container
+        if c is not None:
+            for ref in c.secrets:
+                deps.append(("secret", ref.secret_id))
+            for ref in c.configs:
+                deps.append(("config", ref.config_id))
+        return deps
+
+    def _add_task_deps(self, tx, t: Task) -> None:
+        for key in self._task_deps(t):
+            users = self.deps_use.setdefault(key, set())
+            if not users:
+                kind, obj_id = key
+                obj = tx.get(Secret if kind == "secret" else Config, obj_id)
+                if obj is not None:
+                    self.changes[key] = ("update", kind, obj)
+            users.add(t.id)
+
+    def _release_task_deps(self, t: Task) -> bool:
+        modified = False
+        for key in self._task_deps(t):
+            users = self.deps_use.get(key)
+            if users is None:
+                continue
+            users.discard(t.id)
+            if not users:
+                del self.deps_use[key]
+                kind, obj_id = key
+                stub = (Secret(id=obj_id) if kind == "secret"
+                        else Config(id=obj_id))
+                self.changes[key] = ("remove", kind, stub)
+                modified = True
+        return modified
+
+    # --- tasks
+
+    def add_or_update_task(self, tx, t: Task) -> bool:
+        # only tasks ASSIGNED or higher concern the agent
+        if t.status.state < TaskState.ASSIGNED:
+            return False
+        old = self.tasks.get(t.id)
+        if old is not None:
+            # states <= ASSIGNED are manager-set and must always be sent;
+            # above that, skip sends when nothing the agent cares about
+            # changed (reference: assignments.go:268)
+            if (t.status.state > TaskState.ASSIGNED
+                    and old.desired_state == t.desired_state
+                    and old.spec is t.spec
+                    and old.node_id == t.node_id):
+                self.tasks[t.id] = t
+                if t.status.state > TaskState.RUNNING:
+                    return self._release_task_deps(t)
+                return False
+        elif t.status.state <= TaskState.RUNNING:
+            self._add_task_deps(tx, t)
+        self.tasks[t.id] = t
+        self.changes[("task", t.id)] = ("update", "task", t)
+        return True
+
+    def remove_task(self, t: Task) -> bool:
+        if t.id not in self.tasks:
+            return False
+        self.changes[("task", t.id)] = ("remove", "task", Task(id=t.id))
+        del self.tasks[t.id]
+        self._release_task_deps(t)
+        return True
+
+    def message(self, type_, applies_to, results_in) -> AssignmentsMessage:
+        changes = list(self.changes.values())
+        self.changes = {}
+        return AssignmentsMessage(type_, applies_to, results_in, changes)
+
+
+class Dispatcher:
+    def __init__(self, store: MemoryStore,
+                 config: Optional[Config_] = None):
+        self.store = store
+        self.config = config or Config_()
+        self._mu = threading.Lock()
+        self._nodes: Dict[str, _RegisteredNode] = {}
+        self._down_nodes: Dict[str, float] = {}  # node_id -> down since
+        self._task_updates: Dict[str, TaskStatus] = {}
+        self._node_updates: Dict[str, tuple] = {}  # id->(status, description)
+        self._updates_lock = threading.Lock()
+        self._heap: List = []    # (deadline, seq, kind, node_id)
+        self._seq = 0
+        self._running = False
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        self._streams_threads: List[threading.Thread] = []
+        self.stats = {"heartbeats": 0, "expirations": 0}
+
+    # ------------------------------------------------------------- lifecycle
+
+    def run(self) -> None:
+        """Start the dispatcher's timer/batching worker."""
+        with self._mu:
+            if self._running:
+                return
+            self._running = True
+            self._stop.clear()
+            self._worker = threading.Thread(target=self._worker_loop,
+                                            name="dispatcher", daemon=True)
+            self._worker.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._mu:
+            self._running = False
+            nodes = list(self._nodes.values())
+            self._nodes.clear()
+        for rn in nodes:
+            for stream in rn.streams:
+                stream.close(DispatcherError("dispatcher stopped"))
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+            self._worker = None
+        self._flush_updates()
+
+    # -------------------------------------------------------------- register
+
+    def register(self, node_id: str,
+                 description=None, addr: str = "") -> Tuple[str, float]:
+        """Create a session; returns (session_id, heartbeat_period)
+        (reference: dispatcher.go:553)."""
+        if not self._running:
+            raise DispatcherError("dispatcher is not running")
+        node = self.store.raw_get(Node, node_id)
+        if node is None:
+            raise ErrNodeNotFound(node_id)
+
+        session_id = new_id()
+        period = self._heartbeat_period()
+        with self._mu:
+            old = self._nodes.get(node_id)
+            if old is not None:
+                for stream in old.streams:
+                    stream.close(ErrSessionInvalid("node re-registered"))
+            rn = _RegisteredNode(node_id=node_id, session_id=session_id)
+            rn.deadline = now() + period * self.config.grace_multiplier
+            self._nodes[node_id] = rn
+            self._down_nodes.pop(node_id, None)
+            self._push_deadline(rn.deadline, "hb", node_id)
+
+        self._mark_node_ready(node_id, description, addr)
+        log.info("worker %s registered", node_id)
+        return session_id, period
+
+    def _heartbeat_period(self) -> float:
+        base = self.config.heartbeat_period
+        return base + random.uniform(-self.config.heartbeat_epsilon,
+                                     self.config.heartbeat_epsilon)
+
+    def heartbeat(self, node_id: str, session_id: str) -> float:
+        """TTL refresh; returns the next period
+        (reference: dispatcher.go:1317)."""
+        period = self._heartbeat_period()
+        with self._mu:
+            rn = self._nodes.get(node_id)
+            if rn is None:
+                raise ErrNodeNotRegistered(node_id)
+            if rn.session_id != session_id:
+                raise ErrSessionInvalid(node_id)
+            rn.deadline = now() + period * self.config.grace_multiplier
+            self._push_deadline(rn.deadline, "hb", node_id)
+        self.stats["heartbeats"] += 1
+        return period
+
+    def _check_session(self, node_id: str, session_id: str) -> None:
+        with self._mu:
+            rn = self._nodes.get(node_id)
+        if rn is None:
+            raise ErrNodeNotRegistered(node_id)
+        if rn.session_id != session_id:
+            raise ErrSessionInvalid(node_id)
+
+    # ------------------------------------------------------- node up/down
+
+    def _mark_node_ready(self, node_id: str, description, addr: str) -> None:
+        with self._updates_lock:
+            self._node_updates[node_id] = (
+                NodeStatus(state=NodeState.READY, addr=addr), description)
+        # readiness must not wait for the batching interval: orchestrators
+        # treat DOWN nodes as invalid (reference marks ready synchronously)
+        self._flush_updates()
+
+    def _mark_node_not_ready(self, node_id: str, message: str) -> None:
+        """Heartbeat expiry or disconnect: node DOWN
+        (reference: dispatcher.go:1253)."""
+        self.stats["expirations"] += 1
+        with self._mu:
+            rn = self._nodes.pop(node_id, None)
+            self._down_nodes[node_id] = now()
+            self._push_deadline(now() + self.config.orphan_timeout,
+                                "orphan", node_id)
+        if rn is not None:
+            for stream in rn.streams:
+                stream.close(ErrSessionInvalid(message))
+        with self._updates_lock:
+            self._node_updates[node_id] = (
+                NodeStatus(state=NodeState.DOWN, message=message), None)
+        self._flush_updates()
+
+    def _move_tasks_to_orphaned(self, node_id: str) -> None:
+        """reference: dispatcher.go:1209."""
+        tasks = self.store.view(lambda tx: tx.find(Task, ByNode(node_id)))
+
+        def cb(batch: Batch) -> None:
+            for t in tasks:
+                if t.status.state >= TaskState.ORPHANED:
+                    continue
+
+                def one(tx, t=t):
+                    cur = tx.get(Task, t.id)
+                    if cur is None or cur.status.state >= TaskState.ORPHANED:
+                        return
+                    cur = cur.copy()
+                    cur.status = TaskStatus(state=TaskState.ORPHANED,
+                                            timestamp=now(),
+                                            message="node unreachable")
+                    tx.update(cur)
+                batch.update(one)
+
+        try:
+            self.store.batch(cb)
+        except Exception:
+            log.exception("moving tasks to orphaned failed")
+
+    # --------------------------------------------------------- status intake
+
+    def update_task_status(self, node_id: str, session_id: str,
+                           updates: List[Tuple[str, TaskStatus]]) -> None:
+        """Batched agent status writeback (reference: dispatcher.go:607)."""
+        self._check_session(node_id, session_id)
+        valid: List[Tuple[str, TaskStatus]] = []
+        for task_id, status in updates:
+            t = self.store.raw_get(Task, task_id)
+            if t is None:
+                continue  # task may have been deleted
+            if t.node_id != node_id:
+                raise DispatcherError(
+                    "cannot update a task not assigned this node")
+            valid.append((task_id, status))
+        with self._updates_lock:
+            for task_id, status in valid:
+                self._task_updates[task_id] = status
+            n = len(self._task_updates)
+        if n >= self.config.max_batch_items:
+            self._flush_updates()
+
+    def _flush_updates(self) -> None:
+        """reference: dispatcher.go:726 processUpdates."""
+        with self._updates_lock:
+            task_updates, self._task_updates = self._task_updates, {}
+            node_updates, self._node_updates = self._node_updates, {}
+        if not task_updates and not node_updates:
+            return
+
+        def cb(batch: Batch) -> None:
+            for task_id, status in task_updates.items():
+                def one(tx, task_id=task_id, status=status):
+                    t = tx.get(Task, task_id)
+                    if t is None:
+                        return
+                    if t.status.state > status.state:
+                        return  # invalid transition
+                    if (t.status.state == status.state
+                            and t.status.message == status.message
+                            and t.status.err == status.err):
+                        return
+                    t = t.copy()
+                    status = status.copy()
+                    status.applied_at = now()
+                    t.status = status
+                    tx.update(t)
+                batch.update(one)
+            for node_id, (status, description) in node_updates.items():
+                def one_n(tx, node_id=node_id, status=status,
+                          description=description):
+                    n = tx.get(Node, node_id)
+                    if n is None:
+                        return
+                    n = n.copy()
+                    if status is not None:
+                        n.status.state = status.state
+                        n.status.message = status.message
+                        if status.addr:
+                            n.status.addr = status.addr
+                    if description is not None:
+                        n.description = description
+                    tx.update(n)
+                batch.update(one_n)
+
+        try:
+            self.store.batch(cb)
+        except Exception:
+            log.exception("dispatcher update batch failed")
+
+    # ------------------------------------------------------------ worker
+
+    def _push_deadline(self, deadline: float, kind: str,
+                       node_id: str) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (deadline, self._seq, kind, node_id))
+
+    def _worker_loop(self) -> None:
+        last_flush = now()
+        while not self._stop.is_set():
+            interval = self.config.process_updates_interval
+            with self._mu:
+                deadline = self._heap[0][0] if self._heap else None
+            timeout = interval if deadline is None else \
+                max(0.0, min(interval, deadline - now()))
+            self._stop.wait(timeout=timeout)
+            ts = now()
+            # heartbeat expirations + orphan deadlines
+            while True:
+                with self._mu:
+                    if not self._heap or self._heap[0][0] > ts:
+                        break
+                    _, _, kind, node_id = heapq.heappop(self._heap)
+                    if kind == "hb":
+                        rn = self._nodes.get(node_id)
+                        expired = rn is not None and rn.deadline <= ts
+                    else:
+                        down_since = self._down_nodes.get(node_id)
+                        expired = (down_since is not None
+                                   and ts - down_since
+                                   >= self.config.orphan_timeout)
+                        if expired:
+                            del self._down_nodes[node_id]
+                if kind == "hb" and expired:
+                    log.info("heartbeat expiration for worker %s", node_id)
+                    self._mark_node_not_ready(node_id, "heartbeat failure")
+                elif kind == "orphan" and expired:
+                    self._move_tasks_to_orphaned(node_id)
+            if ts - last_flush >= interval:
+                self._flush_updates()
+                last_flush = ts
+
+    # ---------------------------------------------------------- assignments
+
+    def open_assignments(self, node_id: str,
+                         session_id: str) -> AssignmentStream:
+        """Start an assignments stream for the node
+        (reference: dispatcher.go:1013)."""
+        self._check_session(node_id, session_id)
+        stream = AssignmentStream(node_id)
+        with self._mu:
+            rn = self._nodes.get(node_id)
+            if rn is None or rn.session_id != session_id:
+                raise ErrSessionInvalid(node_id)
+            rn.streams.append(stream)
+        t = threading.Thread(
+            target=self._assignments_loop, args=(stream, node_id, session_id),
+            name=f"assignments-{node_id[:8]}", daemon=True)
+        t.start()
+        return stream
+
+    def _assignments_loop(self, stream: AssignmentStream, node_id: str,
+                          session_id: str) -> None:
+        aset = _AssignmentSet(node_id)
+        sequence = 0
+        applies_to = ""
+
+        def send(type_) -> None:
+            nonlocal sequence, applies_to
+            sequence += 1
+            results_in = str(sequence)
+            stream._push(aset.message(type_, applies_to, results_in))
+            applies_to = results_in
+
+        def pred(ev):
+            return (isinstance(ev, Event) and isinstance(ev.obj, Task)
+                    and ev.obj.node_id == node_id)
+
+        def init(tx):
+            for t in tx.find(Task, ByNode(node_id)):
+                aset.add_or_update_task(tx, t)
+
+        try:
+            _, sub = self.store.view_and_watch(init, predicate=pred)
+        except Exception as e:
+            stream.close(e)
+            return
+        try:
+            send(AssignmentsMessage.COMPLETE)
+            cfg = self.config
+            while not stream.closed and not self._stop.is_set():
+                try:
+                    self._check_session(node_id, session_id)
+                except DispatcherError as e:
+                    stream.close(e)
+                    return
+                modifications = 0
+                deadline = None
+                while modifications < cfg.modification_batch_limit:
+                    if stream.closed or self._stop.is_set():
+                        return
+                    timeout = 0.2 if deadline is None else \
+                        max(0.0, min(0.2, deadline - now()))
+                    try:
+                        ev = sub.get(timeout=timeout) if timeout > 0 \
+                            else None
+                    except TimeoutError:
+                        if deadline is None:
+                            continue
+                        ev = None
+                    except Closed:
+                        stream.close()
+                        return
+                    if ev is None:
+                        if deadline is not None and now() >= deadline:
+                            break
+                        continue
+                    t = ev.obj
+                    tx = self.store.view()
+                    if ev.action == "delete":
+                        modified = aset.remove_task(t)
+                    else:
+                        modified = aset.add_or_update_task(tx, t)
+                    if modified:
+                        modifications += 1
+                        deadline = now() + cfg.assignment_batching_wait
+                    if stream.closed or self._stop.is_set():
+                        return
+                if modifications > 0:
+                    send(AssignmentsMessage.INCREMENTAL)
+        finally:
+            self.store.queue.unsubscribe(sub)

@@ -89,7 +89,10 @@ class Updater:
 
     def cancel(self) -> None:
         self._stop.set()
-        self._done.wait(timeout=10)
+        # must outlast _run's worker joins so per-service serialization
+        # holds: a successor updater may not start while our workers can
+        # still touch slots
+        self._done.wait(timeout=30)
 
     # ----------------------------------------------------------------- run
 
@@ -213,16 +216,18 @@ class Updater:
                 if aborted:
                     break
 
+            # poison pills must always be delivered: workers only ever exit
+            # by consuming one, so giving up on a Full queue would leave
+            # them blocked in get() forever
             for _ in workers:
                 while True:
                     try:
                         slot_queue.put(None, timeout=0.5)
                         break
                     except queue_mod.Full:
-                        if self._stop.is_set():
-                            break
+                        continue
             for w in workers:
-                w.join(timeout=30)
+                w.join(timeout=5)
 
             if not self._stopped and not self._stop.is_set():
                 # monitor window before declaring completion
@@ -284,8 +289,9 @@ class Updater:
             except Exception:
                 log.exception("update failed")
             if update_config.delay:
-                if self._stop.wait(timeout=update_config.delay):
-                    return
+                # on stop, fall through to get() so we exit by consuming a
+                # poison pill rather than stranding one in the queue
+                self._stop.wait(timeout=update_config.delay)
 
     def _update_task(self, slot: common.Slot, updated: Task, order) -> None:
         """Atomically create the updated task and bring down the old one
