@@ -1,7 +1,8 @@
 from .allocator import Allocator, PortAllocator
+from .controlapi import ControlAPI
 from .dispatcher import (
     AssignmentsMessage, AssignmentStream, DefaultConfig, Dispatcher,
 )
 
-__all__ = ["Allocator", "AssignmentsMessage", "AssignmentStream",
+__all__ = ["Allocator", "ControlAPI", "AssignmentsMessage", "AssignmentStream",
            "DefaultConfig", "Dispatcher", "PortAllocator"]

@@ -198,11 +198,26 @@ class Allocator:
         spec_ep = s.spec.endpoint
         if s.endpoint is None:
             return spec_ep is not None
-        have = {(p.protocol, p.target_port, p.publish_mode)
-                for p in s.endpoint.ports}
-        want = {(p.protocol, p.target_port, p.publish_mode)
-                for p in (spec_ep.ports if spec_ep else [])}
-        return have != want
+        spec_ports = list(spec_ep.ports) if spec_ep else []
+        have_ports = s.endpoint.ports
+        if len(spec_ports) != len(have_ports):
+            return True
+        have_exact = {(p.protocol, p.target_port, p.publish_mode,
+                       p.published_port) for p in have_ports}
+        have_any = {(p.protocol, p.target_port, p.publish_mode)
+                    for p in have_ports}
+        for p in spec_ports:
+            if p.published_port:
+                # user-specified port: the endpoint must carry exactly it
+                if (p.protocol, p.target_port, p.publish_mode,
+                        p.published_port) not in have_exact:
+                    return True
+            else:
+                # dynamic port: any allocated published port satisfies it
+                if (p.protocol, p.target_port,
+                        p.publish_mode) not in have_any:
+                    return True
+        return False
 
     # ----------------------------------------------------------------- ticks
 

@@ -1,0 +1,646 @@
+"""Control API: user-facing validated CRUD for every cluster object.
+
+Reference: manager/controlapi/{service,node,secret,config,network,cluster}.go.
+
+Host-callable server object (a gRPC layer can wrap it 1:1).  Validation
+messages match the reference byte-for-byte where tests assert on them.
+Errors carry gRPC-style codes via exception types: InvalidArgument /
+NotFound / AlreadyExists / FailedPrecondition.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from ..models.objects import (
+    Cluster, Config, Network, Node, Secret, Service, Task,
+)
+from ..models.specs import (
+    ConfigSpec, NetworkSpec, NodeSpec, SecretSpec, ServiceMode, ServiceSpec,
+)
+from ..models.types import (
+    EndpointResolutionMode, NodeRole, PublishMode, TaskState, Version, now,
+)
+from ..scheduler import constraint as constraint_mod
+from ..state.store import (
+    AlreadyExists as StoreExists, ByName, ByReferencedSecret,
+    ByReferencedConfig, MemoryStore, NameConflict, NotFound as StoreNotFound,
+    SequenceConflict,
+)
+from ..utils import new_id
+
+
+class APIError(Exception):
+    code = "unknown"
+
+
+class InvalidArgument(APIError):
+    code = "invalid_argument"
+
+
+class NotFound(APIError):
+    code = "not_found"
+
+
+class AlreadyExists(APIError):
+    code = "already_exists"
+
+
+class FailedPrecondition(APIError):
+    code = "failed_precondition"
+
+
+# reference: manager/controlapi/common.go isValidDNSName
+_DNS_NAME = re.compile(r"^[a-zA-Z0-9](?:[-a-zA-Z0-9]*[a-zA-Z0-9])?$")
+_SECRET_NAME = re.compile(r"^[a-zA-Z0-9]+(?:[a-zA-Z0-9-_.]*[a-zA-Z0-9])?$")
+
+MAX_SECRET_SIZE = 500 * 1024  # reference: api/validation/secrets.go
+
+
+def _validate_annotations(ann) -> None:
+    if not ann.name:
+        raise InvalidArgument("meta: name must be provided")
+    if not _DNS_NAME.match(ann.name):
+        raise InvalidArgument("name must be valid as a DNS name component")
+    if len(ann.name) > 63:
+        raise InvalidArgument("name must be 63 characters or fewer")
+
+
+def _validate_secret_annotations(ann) -> None:
+    if not ann.name:
+        raise InvalidArgument("name must be provided")
+    if len(ann.name) > 64 or not _SECRET_NAME.match(ann.name):
+        raise InvalidArgument(
+            "invalid name, only 64 [a-zA-Z0-9-_.] characters allowed, "
+            "and the start and end character must be [a-zA-Z0-9]")
+
+
+def _validate_resources(r) -> None:
+    if r is None:
+        return
+    if r.nano_cpus != 0 and r.nano_cpus < 1e6:
+        raise InvalidArgument(
+            f"invalid cpu value {r.nano_cpus / 1e9:g}: "
+            f"Must be at least {1e6 / 1e9:g}")
+    if r.memory_bytes != 0 and r.memory_bytes < 4 * 1024 * 1024:
+        raise InvalidArgument(
+            f"invalid memory value {r.memory_bytes}: Must be at least 4MiB")
+
+
+def _validate_task_spec(task_spec) -> None:
+    if task_spec.resources is not None:
+        _validate_resources(task_spec.resources.limits)
+        _validate_resources(task_spec.resources.reservations)
+    rp = task_spec.restart
+    if rp is not None:
+        if rp.delay < 0:
+            raise InvalidArgument("TaskSpec: restart-delay cannot be negative")
+        if rp.window < 0:
+            raise InvalidArgument(
+                "TaskSpec: restart-window cannot be negative")
+    placement = task_spec.placement
+    if placement is not None and placement.constraints:
+        try:
+            constraint_mod.parse(placement.constraints)
+        except constraint_mod.InvalidConstraint as e:
+            raise InvalidArgument(str(e))
+    c = task_spec.container
+    if c is None and task_spec.generic_runtime is None \
+            and task_spec.attachment is None:
+        raise InvalidArgument("TaskSpec: missing runtime")
+    if c is not None:
+        if not c.image:
+            raise InvalidArgument(
+                "ContainerSpec: image reference must be provided")
+        mounts = {}
+        for m in c.mounts:
+            if m.target in mounts:
+                raise InvalidArgument(
+                    f"ContainerSpec: duplicate mount point: {m.target}")
+            mounts[m.target] = m
+        targets = {}
+        for ref in c.secrets:
+            if not ref.secret_id or not ref.secret_name:
+                raise InvalidArgument("malformed secret reference")
+            if not ref.target:
+                raise InvalidArgument(
+                    "malformed secret reference, no target provided")
+            prev = targets.get(ref.target)
+            if prev is not None:
+                raise InvalidArgument(
+                    f"secret references '{prev}' and '{ref.secret_name}' "
+                    f"have a conflicting target: '{ref.target}'")
+            targets[ref.target] = ref.secret_name
+        targets = {}
+        for ref in c.configs:
+            if not ref.config_id or not ref.config_name:
+                raise InvalidArgument("malformed config reference")
+            if not ref.target:
+                raise InvalidArgument(
+                    "malformed config reference, no target provided")
+            prev = targets.get(ref.target)
+            if prev is not None:
+                raise InvalidArgument(
+                    f"config references '{prev}' and '{ref.config_name}' "
+                    f"have a conflicting target: '{ref.target}'")
+            targets[ref.target] = ref.config_name
+
+
+def _validate_update(uc) -> None:
+    if uc is None:
+        return
+    if uc.delay < 0:
+        raise InvalidArgument("TaskSpec: update-delay cannot be negative")
+    if uc.monitor < 0:
+        raise InvalidArgument("TaskSpec: update-monitor cannot be negative")
+    if uc.max_failure_ratio < 0 or uc.max_failure_ratio > 1:
+        raise InvalidArgument(
+            "TaskSpec: update-maxfailureratio cannot be less than 0 "
+            "or bigger than 1")
+
+
+def _validate_endpoint_spec(ep_spec) -> None:
+    if ep_spec is None:
+        return
+    port_set = set()
+    for p in ep_spec.ports:
+        if p.publish_mode == PublishMode.INGRESS \
+                and ep_spec.mode == EndpointResolutionMode.DNSRR \
+                and p.published_port:
+            raise InvalidArgument(
+                "EndpointSpec: port published with ingress mode can't be "
+                "used with dnsrr mode")
+        key = (p.protocol, p.target_port, p.published_port)
+        if key in port_set:
+            raise InvalidArgument(
+                "EndpointSpec: duplicate published ports provided")
+        port_set.add(key)
+
+
+def _validate_mode(spec: ServiceSpec) -> None:
+    if spec.mode == ServiceMode.REPLICATED:
+        if spec.replicated is not None and spec.replicated.replicas < 0:
+            raise InvalidArgument("Number of replicas must be non-negative")
+        if spec.task.restart is not None:
+            pass
+    elif spec.mode in (ServiceMode.REPLICATED_JOB, ServiceMode.GLOBAL_JOB):
+        if spec.update is not None:
+            raise InvalidArgument(
+                "job-mode services cannot have update options")
+
+
+def validate_service_spec(spec: Optional[ServiceSpec]) -> None:
+    """reference: service.go:527 validateServiceSpec."""
+    if spec is None:
+        raise InvalidArgument("invalid argument")
+    _validate_annotations(spec.annotations)
+    _validate_task_spec(spec.task)
+    _validate_mode(spec)
+    if spec.mode not in (ServiceMode.REPLICATED_JOB, ServiceMode.GLOBAL_JOB):
+        _validate_update(spec.update)
+    _validate_endpoint_spec(spec.endpoint)
+
+
+class ControlAPI:
+    def __init__(self, store: MemoryStore):
+        self.store = store
+
+    # ------------------------------------------------------------- services
+
+    def _check_port_conflicts(self, spec: ServiceSpec,
+                              service_id: str) -> None:
+        """reference: service.go:570 checkPortConflicts."""
+        if spec.endpoint is None:
+            return
+        ingress, host = set(), set()
+        for p in spec.endpoint.ports:
+            if not p.published_port:
+                continue
+            key = (p.protocol, p.published_port)
+            if p.publish_mode == PublishMode.INGRESS:
+                ingress.add(key)
+            elif p.publish_mode == PublishMode.HOST:
+                host.add(key)
+        if not ingress and not host:
+            return
+
+        def in_use(p, service):
+            if not p.published_port:
+                return
+            key = (p.protocol, p.published_port)
+            name = service.spec.annotations.name
+            if p.publish_mode == PublishMode.HOST:
+                if key in ingress:
+                    raise InvalidArgument(
+                        f"port '{p.published_port}' is already in use by "
+                        f"service '{name}' ({service.id}) as a "
+                        "host-published port")
+            elif p.publish_mode == PublishMode.INGRESS:
+                if key in ingress or key in host:
+                    raise InvalidArgument(
+                        f"port '{p.published_port}' is already in use by "
+                        f"service '{name}' ({service.id}) as an ingress "
+                        "port")
+
+        for service in self.store.view(lambda tx: tx.find(Service)):
+            if service_id and service.id == service_id:
+                continue
+            if service.spec.endpoint is not None:
+                for p in service.spec.endpoint.ports:
+                    in_use(p, service)
+            if service.endpoint is not None:
+                for p in service.endpoint.ports:
+                    in_use(p, service)
+
+    def _check_secret_existence(self, tx, spec: ServiceSpec) -> None:
+        c = spec.task.container
+        if c is None:
+            return
+        failed = []
+        for ref in c.secrets:
+            secret = tx.get(Secret, ref.secret_id)
+            if secret is None or \
+                    secret.spec.annotations.name != ref.secret_name:
+                failed.append(ref.secret_name)
+        if failed:
+            word = "secret" if len(failed) == 1 else "secrets"
+            raise InvalidArgument(f"{word} not found: {', '.join(failed)}")
+
+    def _check_config_existence(self, tx, spec: ServiceSpec) -> None:
+        c = spec.task.container
+        if c is None:
+            return
+        failed = []
+        for ref in c.configs:
+            config = tx.get(Config, ref.config_id)
+            if config is None or \
+                    config.spec.annotations.name != ref.config_name:
+                failed.append(ref.config_name)
+        if failed:
+            word = "config" if len(failed) == 1 else "configs"
+            raise InvalidArgument(f"{word} not found: {', '.join(failed)}")
+
+    def create_service(self, spec: ServiceSpec) -> Service:
+        """reference: service.go:727 CreateService."""
+        validate_service_spec(spec)
+        self._check_port_conflicts(spec, "")
+        service = Service(id=new_id(), spec=spec.copy(),
+                          spec_version=Version(index=1))
+
+        def cb(tx):
+            self._check_secret_existence(tx, spec)
+            self._check_config_existence(tx, spec)
+            tx.create(service)
+
+        try:
+            self.store.update(cb)
+        except NameConflict:
+            raise AlreadyExists(
+                f"service {spec.annotations.name} already exists")
+        return self.store.view(lambda tx: tx.get(Service, service.id))
+
+    def get_service(self, service_id: str) -> Service:
+        s = self.store.view(lambda tx: tx.get(Service, service_id))
+        if s is None:
+            raise NotFound(f"service {service_id} not found")
+        return s
+
+    def update_service(self, service_id: str, version: int,
+                       spec: ServiceSpec, rollback: bool = False) -> Service:
+        """reference: service.go:817 UpdateService."""
+        validate_service_spec(spec)
+        self._check_port_conflicts(spec, service_id)
+
+        def cb(tx):
+            service = tx.get(Service, service_id)
+            if service is None:
+                raise NotFound(f"service {service_id} not found")
+            if spec.annotations.name != service.spec.annotations.name:
+                raise InvalidArgument("renaming services is not supported")
+            if spec.mode != service.spec.mode:
+                raise InvalidArgument("service mode change is not allowed")
+            self._check_secret_existence(tx, spec)
+            self._check_config_existence(tx, spec)
+            service = service.copy()
+            service.meta.version.index = version
+            service.previous_spec = service.spec
+            service.previous_spec_version = service.spec_version
+            service.spec = spec.copy()
+            service.spec_version = Version(index=self.store.version + 1)
+            service.update_status = None
+            tx.update(service)
+            return service
+
+        try:
+            updated = self.store.update(cb)
+        except SequenceConflict as e:
+            raise FailedPrecondition(str(e))
+        return self.store.view(lambda tx: tx.get(Service, updated.id))
+
+    def remove_service(self, service_id: str) -> None:
+        def cb(tx):
+            if tx.get(Service, service_id) is None:
+                raise NotFound(f"service {service_id} not found")
+            tx.delete(Service, service_id)
+
+        self.store.update(cb)
+
+    def list_services(self, name_prefix: str = "") -> List[Service]:
+        from ..state.store import All, ByNamePrefix
+        by = ByNamePrefix(name_prefix) if name_prefix else All()
+        return self.store.view(lambda tx: tx.find(Service, by))
+
+    # ---------------------------------------------------------------- nodes
+
+    def get_node(self, node_id: str) -> Node:
+        n = self.store.view(lambda tx: tx.get(Node, node_id))
+        if n is None:
+            raise NotFound(f"node {node_id} not found")
+        return n
+
+    def list_nodes(self) -> List[Node]:
+        return self.store.view(lambda tx: tx.find(Node))
+
+    def update_node(self, node_id: str, version: int,
+                    spec: NodeSpec) -> Node:
+        """reference: node.go:203 UpdateNode."""
+        def cb(tx):
+            node = tx.get(Node, node_id)
+            if node is None:
+                raise NotFound(f"node {node_id} not found")
+            if spec.desired_role != node.spec.desired_role \
+                    and node.spec.desired_role == NodeRole.MANAGER:
+                managers = [n for n in tx.find(Node)
+                            if n.spec.desired_role == NodeRole.MANAGER]
+                if len(managers) <= 1:
+                    raise FailedPrecondition(
+                        "attempting to demote the last manager of the swarm")
+            node = node.copy()
+            node.meta.version.index = version
+            node.spec = spec.copy()
+            tx.update(node)
+            return node
+
+        try:
+            updated = self.store.update(cb)
+        except SequenceConflict as e:
+            raise FailedPrecondition(str(e))
+        return self.store.view(lambda tx: tx.get(Node, updated.id))
+
+    def remove_node(self, node_id: str, force: bool = False) -> None:
+        """reference: node.go:294 RemoveNode."""
+        from ..models.types import NodeState
+
+        def cb(tx):
+            node = tx.get(Node, node_id)
+            if node is None:
+                raise NotFound(f"node {node_id} not found")
+            if not force:
+                if node.spec.desired_role == NodeRole.MANAGER:
+                    raise FailedPrecondition(
+                        f"node {node_id} is a cluster manager and is a "
+                        "member of the raft cluster. It must be demoted to "
+                        "worker before removal")
+                if node.status.state != NodeState.DOWN:
+                    raise FailedPrecondition(
+                        f"node {node_id} is not down and can't be removed")
+            tx.delete(Node, node_id)
+
+        self.store.update(cb)
+
+    # --------------------------------------------------------------- secrets
+
+    def create_secret(self, spec: SecretSpec) -> Secret:
+        _validate_secret_annotations(spec.annotations)
+        if not spec.data or len(spec.data) >= MAX_SECRET_SIZE:
+            raise InvalidArgument(
+                f"secret data must be larger than 0 and less than "
+                f"{MAX_SECRET_SIZE} bytes")
+        secret = Secret(id=new_id(), spec=spec.copy())
+        try:
+            self.store.update(lambda tx: tx.create(secret))
+        except NameConflict:
+            raise AlreadyExists(
+                f"secret {spec.annotations.name} already exists")
+        return self.store.view(lambda tx: tx.get(Secret, secret.id))
+
+    def get_secret(self, secret_id: str) -> Secret:
+        s = self.store.view(lambda tx: tx.get(Secret, secret_id))
+        if s is None:
+            raise NotFound(f"secret {secret_id} not found")
+        return s
+
+    def update_secret(self, secret_id: str, version: int,
+                      spec: SecretSpec) -> Secret:
+        def cb(tx):
+            secret = tx.get(Secret, secret_id)
+            if secret is None:
+                raise NotFound(f"secret {secret_id} not found")
+            if spec.annotations.name != secret.spec.annotations.name \
+                    or (spec.data and spec.data != secret.spec.data):
+                raise InvalidArgument("only updates to Labels are allowed")
+            secret = secret.copy()
+            secret.meta.version.index = version
+            secret.spec.annotations.labels = dict(spec.annotations.labels)
+            tx.update(secret)
+            return secret
+
+        try:
+            return self.store.update(cb)
+        except SequenceConflict as e:
+            raise FailedPrecondition(str(e))
+
+    def remove_secret(self, secret_id: str) -> None:
+        def check(tx):
+            secret = tx.get(Secret, secret_id)
+            if secret is None:
+                raise NotFound(f"secret {secret_id} not found")
+            return secret, tx.find(Task, ByReferencedSecret(secret_id))
+
+        secret, tasks = self.store.view(check)
+        services = sorted({t.service_annotations.name for t in tasks
+                           if t.service_id})
+        if services:
+            word = "service" if len(services) == 1 else "services"
+            raise InvalidArgument(
+                f"secret '{secret.spec.annotations.name}' is in use by the "
+                f"following {word}: {', '.join(services)}")
+
+        def cb(tx):
+            if tx.get(Secret, secret_id) is None:
+                raise NotFound(f"secret {secret_id} not found")
+            tx.delete(Secret, secret_id)
+
+        self.store.update(cb)
+
+    def list_secrets(self) -> List[Secret]:
+        secrets = self.store.view(lambda tx: tx.find(Secret))
+        # data is never returned over the API (reference: secret.go:98)
+        out = []
+        for s in secrets:
+            cp = s.copy()
+            cp.spec.data = b""
+            out.append(cp)
+        return out
+
+    # --------------------------------------------------------------- configs
+
+    def create_config(self, spec: ConfigSpec) -> Config:
+        _validate_secret_annotations(spec.annotations)
+        if not spec.data or len(spec.data) >= MAX_SECRET_SIZE:
+            raise InvalidArgument(
+                f"config data must be larger than 0 and less than "
+                f"{MAX_SECRET_SIZE} bytes")
+        config = Config(id=new_id(), spec=spec.copy())
+        try:
+            self.store.update(lambda tx: tx.create(config))
+        except NameConflict:
+            raise AlreadyExists(
+                f"config {spec.annotations.name} already exists")
+        return self.store.view(lambda tx: tx.get(Config, config.id))
+
+    def get_config(self, config_id: str) -> Config:
+        c = self.store.view(lambda tx: tx.get(Config, config_id))
+        if c is None:
+            raise NotFound(f"config {config_id} not found")
+        return c
+
+    def update_config(self, config_id: str, version: int,
+                      spec: ConfigSpec) -> Config:
+        def cb(tx):
+            config = tx.get(Config, config_id)
+            if config is None:
+                raise NotFound(f"config {config_id} not found")
+            if spec.annotations.name != config.spec.annotations.name \
+                    or (spec.data and spec.data != config.spec.data):
+                raise InvalidArgument("only updates to Labels are allowed")
+            config = config.copy()
+            config.meta.version.index = version
+            config.spec.annotations.labels = dict(spec.annotations.labels)
+            tx.update(config)
+            return config
+
+        try:
+            return self.store.update(cb)
+        except SequenceConflict as e:
+            raise FailedPrecondition(str(e))
+
+    def remove_config(self, config_id: str) -> None:
+        def check(tx):
+            config = tx.get(Config, config_id)
+            if config is None:
+                raise NotFound(f"config {config_id} not found")
+            return config, tx.find(Task, ByReferencedConfig(config_id))
+
+        config, tasks = self.store.view(check)
+        services = sorted({t.service_annotations.name for t in tasks
+                           if t.service_id})
+        if services:
+            word = "service" if len(services) == 1 else "services"
+            raise InvalidArgument(
+                f"config '{config.spec.annotations.name}' is in use by the "
+                f"following {word}: {', '.join(services)}")
+
+        def cb(tx):
+            if tx.get(Config, config_id) is None:
+                raise NotFound(f"config {config_id} not found")
+            tx.delete(Config, config_id)
+
+        self.store.update(cb)
+
+    def list_configs(self) -> List[Config]:
+        return self.store.view(lambda tx: tx.find(Config))
+
+    # -------------------------------------------------------------- networks
+
+    def create_network(self, spec: NetworkSpec) -> Network:
+        _validate_annotations(spec.annotations)
+        network = Network(id=new_id(), spec=spec.copy())
+        try:
+            self.store.update(lambda tx: tx.create(network))
+        except NameConflict:
+            raise AlreadyExists(
+                f"network {spec.annotations.name} already exists")
+        return self.store.view(lambda tx: tx.get(Network, network.id))
+
+    def get_network(self, network_id: str) -> Network:
+        n = self.store.view(lambda tx: tx.get(Network, network_id))
+        if n is None:
+            raise NotFound(f"network {network_id} not found")
+        return n
+
+    def remove_network(self, network_id: str) -> None:
+        from ..state.store import ByReferencedNetwork
+
+        def check(tx):
+            network = tx.get(Network, network_id)
+            if network is None:
+                raise NotFound(f"network {network_id} not found")
+            return tx.find(Service, ByReferencedNetwork(network_id))
+
+        services = self.store.view(check)
+        if services:
+            raise FailedPrecondition(
+                f"network {network_id} is in use by service "
+                f"{services[0].id}")
+
+        def cb(tx):
+            if tx.get(Network, network_id) is None:
+                raise NotFound(f"network {network_id} not found")
+            tx.delete(Network, network_id)
+
+        self.store.update(cb)
+
+    def list_networks(self) -> List[Network]:
+        return self.store.view(lambda tx: tx.find(Network))
+
+    # --------------------------------------------------------------- cluster
+
+    def get_cluster(self, cluster_id: str) -> Cluster:
+        c = self.store.view(lambda tx: tx.get(Cluster, cluster_id))
+        if c is None:
+            raise NotFound(f"cluster {cluster_id} not found")
+        return c
+
+    def update_cluster(self, cluster_id: str, version: int, spec) -> Cluster:
+        def cb(tx):
+            cluster = tx.get(Cluster, cluster_id)
+            if cluster is None:
+                raise NotFound(f"cluster {cluster_id} not found")
+            cluster = cluster.copy()
+            cluster.meta.version.index = version
+            cluster.spec = spec.copy()
+            tx.update(cluster)
+            return cluster
+
+        try:
+            return self.store.update(cb)
+        except SequenceConflict as e:
+            raise FailedPrecondition(str(e))
+
+    # ----------------------------------------------------------------- tasks
+
+    def get_task(self, task_id: str) -> Task:
+        t = self.store.view(lambda tx: tx.get(Task, task_id))
+        if t is None:
+            raise NotFound(f"task {task_id} not found")
+        return t
+
+    def list_tasks(self, service_id: str = "", node_id: str = "") -> List[Task]:
+        from ..state.store import All, ByNode, ByService
+        if service_id:
+            by = ByService(service_id)
+        elif node_id:
+            by = ByNode(node_id)
+        else:
+            by = All()
+        return self.store.view(lambda tx: tx.find(Task, by))
+
+    def remove_task(self, task_id: str) -> None:
+        def cb(tx):
+            if tx.get(Task, task_id) is None:
+                raise NotFound(f"task {task_id} not found")
+            tx.delete(Task, task_id)
+
+        self.store.update(cb)

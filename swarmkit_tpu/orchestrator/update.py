@@ -226,8 +226,10 @@ class Updater:
                         break
                     except queue_mod.Full:
                         continue
+            # workers must be fully out of their slots before the monitor
+            # window / completion / a successor updater can run
             for w in workers:
-                w.join(timeout=5)
+                w.join(timeout=30)
 
             if not self._stopped and not self._stop.is_set():
                 # monitor window before declaring completion
@@ -264,16 +266,19 @@ class Updater:
             slot = slot_queue.get()
             if slot is None:
                 return
-            running_task = None
-            clean_task = None
-            for t in slot:
-                if not self._is_task_dirty(t):
-                    if t.desired_state == TaskState.RUNNING:
-                        running_task = t
-                        break
-                    if t.desired_state < TaskState.RUNNING:
-                        clean_task = t
+            # the entire slot handling stays inside try: a worker that dies
+            # without consuming its poison pill would wedge _run's pill
+            # delivery loop forever
             try:
+                running_task = None
+                clean_task = None
+                for t in slot:
+                    if not self._is_task_dirty(t):
+                        if t.desired_state == TaskState.RUNNING:
+                            running_task = t
+                            break
+                        if t.desired_state < TaskState.RUNNING:
+                            clean_task = t
                 if running_task is not None:
                     self._use_existing_task(slot, running_task)
                 elif clean_task is not None:

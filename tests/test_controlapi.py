@@ -1,0 +1,222 @@
+"""Control API tests: validated CRUD with reference-parity error messages
+(mirrors manager/controlapi/*_test.go assertions)."""
+
+import pytest
+
+from swarmkit_tpu.manager import ControlAPI
+from swarmkit_tpu.manager.controlapi import (
+    AlreadyExists, FailedPrecondition, InvalidArgument, NotFound,
+)
+from swarmkit_tpu.models import (
+    Annotations, EndpointSpec, NodeState, PortConfig, PublishMode,
+    ReplicatedService, Resources, ResourceRequirements, ServiceMode,
+    TaskSpec, UpdateConfig,
+)
+from swarmkit_tpu.models.specs import (
+    ConfigSpec, ContainerSpec, NodeSpec, SecretSpec, ServiceSpec,
+)
+from swarmkit_tpu.models.types import NodeRole, SecretReference
+from swarmkit_tpu.state import MemoryStore
+
+from test_orchestrator import make_node
+
+
+def spec(name="web", replicas=1, image="nginx", **kw):
+    return ServiceSpec(
+        annotations=Annotations(name=name),
+        task=TaskSpec(container=ContainerSpec(image=image)),
+        mode=ServiceMode.REPLICATED,
+        replicated=ReplicatedService(replicas=replicas),
+        **kw,
+    )
+
+
+@pytest.fixture
+def api():
+    return ControlAPI(MemoryStore())
+
+
+def test_create_service_validates_name(api):
+    with pytest.raises(InvalidArgument, match="meta: name must be provided"):
+        api.create_service(spec(name=""))
+    with pytest.raises(InvalidArgument,
+                       match="name must be valid as a DNS name component"):
+        api.create_service(spec(name="not valid!"))
+    with pytest.raises(InvalidArgument,
+                       match="name must be 63 characters or fewer"):
+        api.create_service(spec(name="x" * 64))
+
+
+def test_create_service_validates_runtime_and_resources(api):
+    s = spec()
+    s.task.container = None
+    with pytest.raises(InvalidArgument, match="TaskSpec: missing runtime"):
+        api.create_service(s)
+
+    s = spec()
+    s.task.container.image = ""
+    with pytest.raises(InvalidArgument,
+                       match="image reference must be provided"):
+        api.create_service(s)
+
+    s = spec()
+    s.task.resources = ResourceRequirements(
+        reservations=Resources(memory_bytes=1024))
+    with pytest.raises(InvalidArgument, match="Must be at least 4MiB"):
+        api.create_service(s)
+
+
+def test_create_service_name_conflict(api):
+    api.create_service(spec(name="web"))
+    with pytest.raises(AlreadyExists):
+        api.create_service(spec(name="web"))
+
+
+def test_create_service_missing_secret(api):
+    s = spec()
+    s.task.container.secrets = [
+        SecretReference(secret_id="nope", secret_name="missing",
+                        target="cert")]
+    with pytest.raises(InvalidArgument, match="secret not found: missing"):
+        api.create_service(s)
+
+
+def test_create_service_with_existing_secret(api):
+    secret = api.create_secret(SecretSpec(
+        annotations=Annotations(name="tls-cert"), data=b"shh"))
+    s = spec()
+    s.task.container.secrets = [
+        SecretReference(secret_id=secret.id, secret_name="tls-cert",
+                        target="cert")]
+    created = api.create_service(s)
+    assert created.spec.task.container.secrets[0].secret_id == secret.id
+
+
+def test_update_service_rules(api):
+    created = api.create_service(spec(name="web", replicas=2))
+    new_spec = spec(name="web", replicas=5)
+    updated = api.update_service(created.id, created.meta.version.index,
+                                 new_spec)
+    assert updated.spec.replicated.replicas == 5
+    assert updated.previous_spec is not None
+    assert updated.spec_version.index > created.spec_version.index
+
+    with pytest.raises(InvalidArgument,
+                       match="renaming services is not supported"):
+        api.update_service(updated.id, updated.meta.version.index,
+                           spec(name="web2", replicas=5))
+
+    bad = spec(name="web", replicas=5)
+    bad.mode = ServiceMode.GLOBAL
+    bad.replicated = None
+    with pytest.raises(InvalidArgument,
+                       match="service mode change is not allowed"):
+        api.update_service(updated.id, updated.meta.version.index, bad)
+
+    # stale version -> FailedPrecondition
+    with pytest.raises(FailedPrecondition):
+        api.update_service(updated.id, updated.meta.version.index - 1,
+                           spec(name="web", replicas=7))
+
+
+def test_ingress_port_conflict(api):
+    s1 = spec(name="a")
+    s1.endpoint = EndpointSpec(ports=[PortConfig(
+        target_port=80, published_port=8080,
+        publish_mode=PublishMode.INGRESS)])
+    api.create_service(s1)
+    s2 = spec(name="b")
+    s2.endpoint = EndpointSpec(ports=[PortConfig(
+        target_port=80, published_port=8080,
+        publish_mode=PublishMode.INGRESS)])
+    with pytest.raises(InvalidArgument,
+                       match="already in use by service 'a'"):
+        api.create_service(s2)
+
+
+def test_remove_service(api):
+    created = api.create_service(spec())
+    api.remove_service(created.id)
+    with pytest.raises(NotFound):
+        api.get_service(created.id)
+    with pytest.raises(NotFound):
+        api.remove_service(created.id)
+
+
+def test_node_remove_rules(api):
+    node = make_node("n1")
+    api.store.update(lambda tx: tx.create(node))
+    with pytest.raises(FailedPrecondition,
+                       match="is not down and can't be removed"):
+        api.remove_node(node.id)
+    api.remove_node(node.id, force=True)
+    with pytest.raises(NotFound):
+        api.get_node(node.id)
+
+
+def test_demote_last_manager_fails(api):
+    node = make_node("m1")
+    node.spec.desired_role = NodeRole.MANAGER
+    api.store.update(lambda tx: tx.create(node))
+    demote = NodeSpec(annotations=Annotations(name="m1"),
+                      desired_role=NodeRole.WORKER)
+    with pytest.raises(FailedPrecondition,
+                       match="attempting to demote the last manager"):
+        api.update_node(node.id, node.meta.version.index, demote)
+
+
+def test_secret_lifecycle(api):
+    with pytest.raises(InvalidArgument):
+        api.create_secret(SecretSpec(annotations=Annotations(name="s"),
+                                     data=b""))
+    secret = api.create_secret(SecretSpec(
+        annotations=Annotations(name="s"), data=b"data"))
+    with pytest.raises(AlreadyExists):
+        api.create_secret(SecretSpec(annotations=Annotations(name="s"),
+                                     data=b"x"))
+
+    # list hides data
+    listed = api.list_secrets()
+    assert listed[0].spec.data == b""
+    assert api.get_secret(secret.id).spec.data == b"data"
+
+    with pytest.raises(InvalidArgument,
+                       match="only updates to Labels are allowed"):
+        api.update_secret(secret.id, secret.meta.version.index,
+                          SecretSpec(annotations=Annotations(name="s"),
+                                     data=b"different"))
+    updated = api.update_secret(
+        secret.id, secret.meta.version.index,
+        SecretSpec(annotations=Annotations(name="s",
+                                           labels={"env": "prod"})))
+    assert updated.spec.annotations.labels == {"env": "prod"}
+    assert api.get_secret(secret.id).spec.data == b"data"
+
+    api.remove_secret(secret.id)
+    with pytest.raises(NotFound):
+        api.get_secret(secret.id)
+
+
+def test_remove_secret_in_use(api):
+    secret = api.create_secret(SecretSpec(
+        annotations=Annotations(name="tls"), data=b"shh"))
+    s = spec(name="web")
+    s.task.container.secrets = [
+        SecretReference(secret_id=secret.id, secret_name="tls",
+                        target="cert")]
+    svc = api.create_service(s)
+    # materialize a task referencing the secret (orchestrator would)
+    from swarmkit_tpu.orchestrator.common import new_task
+    t = new_task(None, api.store.view(
+        lambda tx: tx.get(type(svc), svc.id)), 1, "")
+    api.store.update(lambda tx: tx.create(t))
+    with pytest.raises(InvalidArgument,
+                       match="is in use by the following service: web"):
+        api.remove_secret(secret.id)
+
+
+def test_update_config_validation(api):
+    s = spec()
+    s.update = UpdateConfig(max_failure_ratio=1.5)
+    with pytest.raises(InvalidArgument, match="maxfailureratio"):
+        api.create_service(s)
