@@ -1,0 +1,7 @@
+from .core import RaftCore
+from .node import NotLeader, ProposalDropped, RaftNode
+from .storage import Encoder, RaftLogger
+from .transport import LocalNetwork
+
+__all__ = ["Encoder", "LocalNetwork", "NotLeader", "ProposalDropped",
+           "RaftCore", "RaftLogger", "RaftNode"]

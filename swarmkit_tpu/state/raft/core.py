@@ -1,0 +1,476 @@
+"""Raft consensus core: a sans-IO state machine.
+
+Reference behavior: manager/state/raft/raft.go wraps etcd-raft; this module
+implements the same consensus protocol (leader election with randomized
+timeouts, log replication, commit by majority match, snapshot install,
+leader no-op entry on election) as a pure state machine — no threads, no
+clocks, no sockets.  The driver (node.py) feeds it ``tick()`` and
+``step(msg)`` and drains ``ready()``:
+
+    rd = core.ready()
+    1. persist rd.hard_state and rd.entries (WAL) BEFORE sending
+    2. send rd.messages
+    3. apply rd.committed to the application state machine
+    4. core.advance(rd)
+
+This ordering gives raft's durability guarantee: nothing is sent or applied
+before it is on stable storage (raft.go:540's Ready loop does the same).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+ENTRY_NORMAL = 0
+ENTRY_NOOP = 1
+
+
+@dataclass
+class Entry:
+    term: int
+    index: int
+    data: bytes = b""
+    type: int = ENTRY_NORMAL
+
+
+@dataclass
+class HardState:
+    """Must be persisted before acting on a Ready (raft thesis §3.8)."""
+
+    term: int = 0
+    voted_for: str = ""
+    commit: int = 0
+
+
+@dataclass
+class Snapshot:
+    index: int = 0
+    term: int = 0
+    data: bytes = b""
+
+
+@dataclass
+class Message:
+    type: str            # vote / vote_resp / app / app_resp / snap
+    term: int
+    src: str
+    dst: str
+    # vote
+    last_log_index: int = 0
+    last_log_term: int = 0
+    granted: bool = False
+    # append
+    prev_index: int = 0
+    prev_term: int = 0
+    entries: List[Entry] = field(default_factory=list)
+    commit: int = 0
+    success: bool = False
+    match_index: int = 0
+    # snapshot
+    snapshot: Optional[Snapshot] = None
+
+
+@dataclass
+class Ready:
+    hard_state: Optional[HardState]
+    entries: List[Entry]             # new entries to persist
+    messages: List[Message]          # send after persisting
+    committed: List[Entry]           # apply to the state machine
+    snapshot: Optional[Snapshot]     # received snapshot to persist+restore
+
+
+class RaftCore:
+    """One member's consensus state (pure; deterministic given inputs)."""
+
+    def __init__(self, node_id: str, peers: Sequence[str],
+                 election_tick: int = 10, heartbeat_tick: int = 1,
+                 rng: Optional[random.Random] = None):
+        self.id = node_id
+        self.peers = set(peers) | {node_id}
+        self.election_tick = election_tick
+        self.heartbeat_tick = heartbeat_tick
+        self._rng = rng or random.Random()
+
+        self.term = 0
+        self.voted_for = ""
+        self.role = FOLLOWER
+        self.leader_id = ""
+
+        # log[0] corresponds to index snap_index+1
+        self.log: List[Entry] = []
+        self.snap_index = 0
+        self.snap_term = 0
+        self.commit_index = 0
+        self.applied_index = 0
+
+        self._elapsed = 0
+        self._timeout = self._rand_timeout()
+        self._votes: Dict[str, bool] = {}
+        self.next_index: Dict[str, int] = {}
+        self.match_index: Dict[str, int] = {}
+        # index of the no-op appended at election: the leader is not ready
+        # for proposals until it is applied (all prior-term entries are then
+        # in the state machine — swarmkit's signalledLeadership gate)
+        self.noop_index = 0
+
+        self._msgs: List[Message] = []
+        self._persisted_index = 0    # highest entry index known persisted
+        self._hs_dirty = False
+        self._pending_snapshot: Optional[Snapshot] = None
+        # check-quorum: a leader that cannot reach a majority steps down so
+        # its blocked proposals fail fast (etcd-raft CheckQuorum behavior)
+        self._quorum_elapsed = 0
+        self._recent_active: set = set()
+
+    # ------------------------------------------------------------- log utils
+
+    def last_index(self) -> int:
+        return self.snap_index + len(self.log)
+
+    def _term_at(self, index: int) -> Optional[int]:
+        if index == 0:
+            return 0
+        if index == self.snap_index:
+            return self.snap_term
+        if index < self.snap_index or index > self.last_index():
+            return None
+        return self.log[index - self.snap_index - 1].term
+
+    def _entry_at(self, index: int) -> Entry:
+        return self.log[index - self.snap_index - 1]
+
+    def entries_from(self, index: int) -> List[Entry]:
+        if index <= self.snap_index:
+            return []
+        return self.log[index - self.snap_index - 1:]
+
+    def _rand_timeout(self) -> int:
+        return self.election_tick + self._rng.randrange(self.election_tick)
+
+    # --------------------------------------------------------------- loading
+
+    def load(self, hard_state: HardState, entries: List[Entry],
+             snapshot: Optional[Snapshot]) -> None:
+        """Restore persisted state on restart (before any tick/step)."""
+        if snapshot is not None:
+            self.snap_index = snapshot.index
+            self.snap_term = snapshot.term
+            self.commit_index = snapshot.index
+            self.applied_index = snapshot.index
+        self.term = hard_state.term
+        self.voted_for = hard_state.voted_for
+        self.commit_index = max(self.commit_index, hard_state.commit)
+        self.log = [e for e in entries if e.index > self.snap_index]
+        self._persisted_index = self.last_index()
+
+    # ----------------------------------------------------------------- ticks
+
+    def tick(self) -> None:
+        if self.role == LEADER:
+            self._elapsed += 1
+            if self._elapsed >= self.heartbeat_tick:
+                self._elapsed = 0
+                self._broadcast_append(heartbeat=True)
+            self._quorum_elapsed += 1
+            if self._quorum_elapsed >= 2 * self.election_tick:
+                self._quorum_elapsed = 0
+                active = len(self._recent_active | {self.id})
+                self._recent_active = set()
+                if active <= len(self.peers) // 2:
+                    self._become_follower(self.term)
+        else:
+            self._elapsed += 1
+            if self._elapsed >= self._timeout:
+                self._campaign()
+
+    def _campaign(self) -> None:
+        self._become_candidate()
+        if len(self.peers) == 1:
+            self._become_leader()
+            return
+        for peer in self.peers:
+            if peer == self.id:
+                continue
+            self._msgs.append(Message(
+                type="vote", term=self.term, src=self.id, dst=peer,
+                last_log_index=self.last_index(),
+                last_log_term=self._term_at(self.last_index()) or 0))
+
+    # ------------------------------------------------------------ transitions
+
+    def _become_follower(self, term: int, leader: str = "") -> None:
+        if term > self.term:
+            self.term = term
+            self.voted_for = ""
+            self._hs_dirty = True
+        self.role = FOLLOWER
+        self.leader_id = leader
+        self._elapsed = 0
+        self._timeout = self._rand_timeout()
+
+    def _become_candidate(self) -> None:
+        self.term += 1
+        self.voted_for = self.id
+        self._hs_dirty = True
+        self.role = CANDIDATE
+        self.leader_id = ""
+        self._votes = {self.id: True}
+        self._elapsed = 0
+        self._timeout = self._rand_timeout()
+
+    def _become_leader(self) -> None:
+        self.role = LEADER
+        self.leader_id = self.id
+        self._elapsed = 0
+        last = self.last_index()
+        for peer in self.peers:
+            self.next_index[peer] = last + 1
+            self.match_index[peer] = 0
+        self.match_index[self.id] = last
+        # no-op entry commits prior-term entries (raft thesis §3.6.2; etcd
+        # does the same on election)
+        self._append(Entry(term=self.term, index=last + 1,
+                           type=ENTRY_NOOP))
+        self.noop_index = last + 1
+        self._broadcast_append()
+
+    @property
+    def leader_ready(self) -> bool:
+        """True once this leader may accept proposals: its election no-op
+        (and hence everything before it) has been applied locally."""
+        return self.role == LEADER and self.applied_index >= self.noop_index
+
+    # -------------------------------------------------------------- proposal
+
+    def propose(self, data: bytes) -> int:
+        """Leader-only: append a new entry; returns its index."""
+        assert self.role == LEADER, "propose on non-leader"
+        index = self.last_index() + 1
+        self._append(Entry(term=self.term, index=index, data=data))
+        self._broadcast_append()
+        return index
+
+    def _append(self, entry: Entry) -> None:
+        self.log.append(entry)
+        self.match_index[self.id] = self.last_index()
+        if len(self.peers) == 1:
+            self._maybe_commit()
+
+    # -------------------------------------------------------------- messages
+
+    def step(self, m: Message) -> None:
+        if self.role == LEADER and m.src in self.peers:
+            self._recent_active.add(m.src)
+        if m.term > self.term:
+            leader = m.src if m.type in ("app", "snap") else ""
+            self._become_follower(m.term, leader)
+        if m.type == "vote":
+            self._on_vote(m)
+        elif m.type == "vote_resp":
+            self._on_vote_resp(m)
+        elif m.type == "app":
+            self._on_append(m)
+        elif m.type == "app_resp":
+            self._on_append_resp(m)
+        elif m.type == "snap":
+            self._on_snapshot(m)
+
+    def _on_vote(self, m: Message) -> None:
+        if m.term < self.term:
+            self._msgs.append(Message(type="vote_resp", term=self.term,
+                                      src=self.id, dst=m.src, granted=False))
+            return
+        my_last = self.last_index()
+        my_last_term = self._term_at(my_last) or 0
+        up_to_date = (m.last_log_term, m.last_log_index) >= \
+            (my_last_term, my_last)
+        grant = (self.voted_for in ("", m.src)) and up_to_date
+        if grant:
+            self.voted_for = m.src
+            self._hs_dirty = True
+            self._elapsed = 0
+        self._msgs.append(Message(type="vote_resp", term=self.term,
+                                  src=self.id, dst=m.src, granted=grant))
+
+    def _on_vote_resp(self, m: Message) -> None:
+        if self.role != CANDIDATE or m.term < self.term:
+            return
+        self._votes[m.src] = m.granted
+        granted = sum(1 for g in self._votes.values() if g)
+        if granted > len(self.peers) // 2:
+            self._become_leader()
+        elif len(self._votes) - granted > len(self.peers) // 2:
+            self._become_follower(self.term)
+
+    def _on_append(self, m: Message) -> None:
+        if m.term < self.term:
+            self._msgs.append(Message(type="app_resp", term=self.term,
+                                      src=self.id, dst=m.src, success=False))
+            return
+        self.role = FOLLOWER
+        self.leader_id = m.src
+        self._elapsed = 0
+
+        prev_term = self._term_at(m.prev_index)
+        if prev_term is None or (m.prev_index > 0
+                                 and prev_term != m.prev_term):
+            # log mismatch: ask the leader to back up
+            self._msgs.append(Message(
+                type="app_resp", term=self.term, src=self.id, dst=m.src,
+                success=False,
+                match_index=min(m.prev_index - 1, self.last_index())))
+            return
+        # append, truncating conflicts
+        for e in m.entries:
+            existing = self._term_at(e.index)
+            if existing is None or existing != e.term:
+                if e.index <= self.last_index():
+                    # conflict: truncate from here
+                    del self.log[e.index - self.snap_index - 1:]
+                    self._persisted_index = min(self._persisted_index,
+                                                self.last_index())
+                self.log.append(e)
+        # commit may only advance over entries this append VERIFIED to
+        # match the leader (up to prev_index + new entries) — never over
+        # untruncated local tail entries (raft paper fig. 2: AppendEntries
+        # step 5, "index of last new entry")
+        last_new = m.prev_index + len(m.entries)
+        if m.commit > self.commit_index:
+            self.commit_index = min(m.commit, last_new)
+            self._hs_dirty = True
+        self._msgs.append(Message(
+            type="app_resp", term=self.term, src=self.id, dst=m.src,
+            success=True, match_index=max(last_new, self.commit_index)))
+
+    def _on_append_resp(self, m: Message) -> None:
+        if self.role != LEADER or m.term < self.term:
+            return
+        if m.success:
+            self.match_index[m.src] = max(self.match_index.get(m.src, 0),
+                                          m.match_index)
+            self.next_index[m.src] = self.match_index[m.src] + 1
+            self._maybe_commit()
+            if self.next_index[m.src] <= self.last_index():
+                # follower acked a heartbeat but is missing entries
+                # (e.g. rejoined after a partition): repair now
+                self._send_append(m.src)
+        else:
+            hint = m.match_index
+            self.next_index[m.src] = max(1, min(
+                hint + 1, self.next_index.get(m.src, 1) - 1))
+            self._send_append(m.src)
+
+    def _on_snapshot(self, m: Message) -> None:
+        if m.term < self.term or m.snapshot is None:
+            return
+        self.role = FOLLOWER
+        self.leader_id = m.src
+        self._elapsed = 0
+        snap = m.snapshot
+        if snap.index <= self.commit_index:
+            # stale snapshot; report progress instead
+            self._msgs.append(Message(
+                type="app_resp", term=self.term, src=self.id, dst=m.src,
+                success=True, match_index=self.commit_index))
+            return
+        self._pending_snapshot = snap
+        self.snap_index = snap.index
+        self.snap_term = snap.term
+        self.log = []
+        self.commit_index = snap.index
+        self.applied_index = snap.index
+        self._persisted_index = snap.index
+        self._hs_dirty = True
+        self._msgs.append(Message(
+            type="app_resp", term=self.term, src=self.id, dst=m.src,
+            success=True, match_index=snap.index))
+
+    # ------------------------------------------------------------ replication
+
+    def _maybe_commit(self) -> None:
+        for n in range(self.last_index(), self.commit_index, -1):
+            if (self._term_at(n) == self.term
+                    and sum(1 for p in self.peers
+                            if self.match_index.get(p, 0) >= n)
+                    > len(self.peers) // 2):
+                self.commit_index = n
+                self._hs_dirty = True
+                break
+
+    def _broadcast_append(self, heartbeat: bool = False) -> None:
+        for peer in self.peers:
+            if peer != self.id:
+                self._send_append(peer, heartbeat=heartbeat)
+
+    def _send_append(self, peer: str, heartbeat: bool = False) -> None:
+        next_i = self.next_index.get(peer, self.last_index() + 1)
+        if next_i <= self.snap_index:
+            # follower is behind our log start: needs a snapshot; the
+            # driver fills in the snapshot data (we only know the index)
+            self._msgs.append(Message(
+                type="snap", term=self.term, src=self.id, dst=peer,
+                snapshot=Snapshot(index=self.snap_index,
+                                  term=self.snap_term)))
+            return
+        prev = next_i - 1
+        entries = [] if heartbeat else self.entries_from(next_i)
+        self._msgs.append(Message(
+            type="app", term=self.term, src=self.id, dst=peer,
+            prev_index=prev, prev_term=self._term_at(prev) or 0,
+            entries=list(entries), commit=self.commit_index))
+
+    # ----------------------------------------------------------------- ready
+
+    def has_ready(self) -> bool:
+        return bool(self._msgs or self._hs_dirty
+                    or self._pending_snapshot is not None
+                    or self.last_index() > self._persisted_index
+                    or self.commit_index > self.applied_index)
+
+    def ready(self) -> Ready:
+        hs = None
+        if self._hs_dirty:
+            hs = HardState(term=self.term, voted_for=self.voted_for,
+                           commit=self.commit_index)
+        new_entries = self.entries_from(self._persisted_index + 1)
+        # only committed entries that are also persisted locally are applied
+        apply_upto = min(self.commit_index,
+                         max(self._persisted_index, self.last_index()))
+        committed = [self._entry_at(i)
+                     for i in range(self.applied_index + 1, apply_upto + 1)
+                     if self._term_at(i) is not None]
+        msgs, self._msgs = self._msgs, []
+        snap, self._pending_snapshot = self._pending_snapshot, None
+        return Ready(hard_state=hs, entries=list(new_entries),
+                     messages=msgs, committed=committed, snapshot=snap)
+
+    def advance(self, rd: Ready) -> None:
+        if rd.hard_state is not None:
+            self._hs_dirty = False
+        if rd.entries:
+            self._persisted_index = max(self._persisted_index,
+                                        rd.entries[-1].index)
+        if rd.committed:
+            self.applied_index = max(self.applied_index,
+                                     rd.committed[-1].index)
+        if rd.snapshot is not None:
+            self.applied_index = max(self.applied_index, rd.snapshot.index)
+
+    # ------------------------------------------------------------ compaction
+
+    def compact(self, index: int, snapshot_term: Optional[int] = None) -> None:
+        """Drop log entries up to ``index`` (inclusive); the driver has a
+        durable snapshot at that index."""
+        if index <= self.snap_index:
+            return
+        term = snapshot_term if snapshot_term is not None \
+            else self._term_at(index)
+        self.log = self.entries_from(index + 1)
+        self.snap_index = index
+        self.snap_term = term or 0

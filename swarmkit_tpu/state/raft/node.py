@@ -1,0 +1,278 @@
+"""RaftNode: drives the consensus core, persists through the logger, and
+implements the store's Proposer seam.
+
+Reference: manager/state/raft/raft.go (Node.Run Ready loop :540,
+ProposeValue :1592 / processInternalRaftRequest :1785, processCommitted
+:1890) and manager/state/proposer.go.
+
+Wiring: every member owns a MemoryStore.  The leader's store proposes
+change-lists here; ``propose`` blocks until the entry commits, then the
+leader's store applies locally (MemoryStore.update's normal flow).
+Followers apply committed entries via ``apply_store_actions`` — identical
+bytes, identical version stamps, so all stores converge bit-for-bit.
+Snapshots carry the full store (store.save_bytes) and are installed on
+slow/new followers.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .. import serde
+from ..store import MemoryStore, Proposer, StoreAction
+from .core import (
+    ENTRY_NOOP, Entry, HardState, LEADER, Message, RaftCore, Snapshot,
+)
+from .storage import RaftLogger
+
+log = logging.getLogger("raft")
+
+
+class NotLeader(Exception):
+    """Proposal sent to a non-leader member."""
+
+
+class ProposalDropped(Exception):
+    """Leadership was lost before the proposal committed."""
+
+
+@dataclass
+class _Waiter:
+    event: threading.Event
+    term: int
+    index: int
+    ok: bool = False
+    commit_cb: Optional[Callable[[], None]] = None
+
+
+class RaftNode(Proposer):
+    """One consensus member (reference: raft.Node)."""
+
+    TICK_INTERVAL = 0.02
+
+    def __init__(self, node_id: str, peers: Sequence[str],
+                 store: MemoryStore, logger: RaftLogger, transport,
+                 snapshot_interval: int = 1000,
+                 on_leadership: Optional[Callable[[bool], None]] = None):
+        self.id = node_id
+        self.store = store
+        self.logger = logger
+        self.transport = transport
+        self.snapshot_interval = snapshot_interval
+        self.on_leadership = on_leadership
+        self.core = RaftCore(node_id, peers)
+
+        self._inbox: "queue.Queue" = queue.Queue()
+        self._waiters: Dict[int, _Waiter] = {}
+        self._waiters_lock = threading.Lock()
+        self._local_indices: set = set()
+        self._stop = threading.Event()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._was_leader = False
+        self._last_snap_applied = 0
+        self.stats = {"applied": 0, "snapshots": 0}
+
+        # boot from disk (reference: JoinAndStart -> BootstrapFromDisk)
+        hs, entries, snapshot = logger.bootstrap()
+        if snapshot is not None and snapshot.data:
+            self.store.restore_bytes(snapshot.data)
+            self._last_snap_applied = snapshot.index
+        self.core.load(hs, entries, snapshot)
+        # replay committed-but-unapplied log entries into the store
+        for e in self.core.entries_from(self.core.applied_index + 1):
+            if e.index > self.core.commit_index:
+                break
+            self._apply_entry(e, replay=True)
+            self.core.applied_index = e.index
+
+        transport.register(node_id, self._inbox.put)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run,
+                                        name=f"raft-{self.id}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._done.wait(timeout=10)
+        self.transport.unregister(self.id)
+        self.logger.close()
+        self._fail_waiters()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.core.role == LEADER
+
+    @property
+    def leader_id(self) -> str:
+        return self.core.leader_id
+
+    def run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    item = self._inbox.get(timeout=self.TICK_INTERVAL)
+                except queue.Empty:
+                    item = None
+                if item is None:
+                    self.core.tick()
+                elif isinstance(item, Message):
+                    self.core.step(item)
+                elif isinstance(item, tuple):   # local proposal
+                    self._handle_proposal(*item)
+                # drain any further queued items before processing ready
+                while True:
+                    try:
+                        item = self._inbox.get_nowait()
+                    except queue.Empty:
+                        break
+                    if isinstance(item, Message):
+                        self.core.step(item)
+                    elif isinstance(item, tuple):
+                        self._handle_proposal(*item)
+
+                if self._was_leader and self.core.role != LEADER:
+                    # fail blocked proposers NOW, before applying anything:
+                    # apply_store_actions/restore need the store update
+                    # lock a blocked proposer may hold
+                    self._fail_waiters()
+                self._process_ready()
+                self._leadership_change()
+        finally:
+            self._done.set()
+
+    def _handle_proposal(self, data, waiter) -> None:
+        if not self.core.leader_ready:
+            waiter.ok = False
+            waiter.event.set()
+            return
+        index = self.core.propose(data)
+        waiter.term = self.core.term
+        waiter.index = index
+        self._local_indices.add(index)
+        with self._waiters_lock:
+            self._waiters[index] = waiter
+
+    def _process_ready(self) -> None:
+        while self.core.has_ready():
+            rd = self.core.ready()
+            # 1. persist before anything else
+            self.logger.save(rd.hard_state, rd.entries)
+            if rd.snapshot is not None and rd.snapshot.data:
+                self.logger.save_snapshot(rd.snapshot, rd.snapshot.index)
+                self.store.restore_bytes(rd.snapshot.data)
+                self._last_snap_applied = rd.snapshot.index
+                self.stats["snapshots"] += 1
+            # 2. send messages (attach snapshot payloads)
+            for m in rd.messages:
+                if m.type == "snap" and m.snapshot is not None \
+                        and not m.snapshot.data:
+                    snap = self.logger.load_snapshot()
+                    if snap is None:
+                        continue
+                    m.snapshot = snap
+                self.transport.send(m)
+            # 3. apply committed entries
+            for e in rd.committed:
+                self._apply_entry(e)
+            self.core.advance(rd)
+            if rd.committed:
+                self._maybe_snapshot()
+
+    # -------------------------------------------------------------- applying
+
+    def _apply_entry(self, e: Entry, replay: bool = False) -> None:
+        if e.type == ENTRY_NOOP or not e.data:
+            return
+        self.stats["applied"] += 1
+        local = e.index in self._local_indices
+        if local:
+            self._local_indices.discard(e.index)
+        if local and not replay:
+            # run the proposing store's commit callback *here*, in the
+            # apply path, before appliedIndex advances — snapshots taken at
+            # this index must include this entry's changes (reference:
+            # wait.trigger runs the commit cb inside processEntry,
+            # raft.go:1917)
+            with self._waiters_lock:
+                waiter = self._waiters.pop(e.index, None)
+            if waiter is not None:
+                ok = True
+                if waiter.commit_cb is not None:
+                    try:
+                        waiter.commit_cb()
+                    except Exception:
+                        # contract: on failure propose must raise — never
+                        # report success for an uncommitted local tx
+                        log.exception("local commit callback failed")
+                        ok = False
+                waiter.ok = ok
+                waiter.event.set()
+                return
+            # the waiter was cancelled (leadership churn) but the entry
+            # committed anyway: apply it like a remote entry so this store
+            # does not diverge from the cluster (reference: processEntry's
+            # no-wait branch, raft.go:1907)
+        try:
+            actions = [serde.action_from_dict(d)
+                       for d in serde.loads_dict(e.data)]
+            self.store.apply_store_actions(actions)
+        except Exception:
+            log.exception("applying raft entry %d failed", e.index)
+
+    def _maybe_snapshot(self) -> None:
+        """reference: raft.go:781 needsSnapshot + doSnapshot."""
+        if self.core.applied_index - self.core.snap_index \
+                < self.snapshot_interval:
+            return
+        index = self.core.applied_index
+        snap = Snapshot(index=index, term=self.core._term_at(index) or 0,
+                        data=self.store.save_bytes())
+        self.logger.save_snapshot(snap, index)
+        self.core.compact(index, snap.term)
+        self.stats["snapshots"] += 1
+
+    def _leadership_change(self) -> None:
+        leader = self.core.role == LEADER
+        if leader != self._was_leader:
+            self._was_leader = leader
+            if not leader:
+                self._fail_waiters()
+            if self.on_leadership is not None:
+                try:
+                    self.on_leadership(leader)
+                except Exception:
+                    log.exception("leadership callback failed")
+
+    def _fail_waiters(self) -> None:
+        with self._waiters_lock:
+            waiters, self._waiters = self._waiters, {}
+        for w in waiters.values():
+            w.ok = False
+            w.event.set()
+
+    # -------------------------------------------------------------- proposer
+
+    def propose(self, actions: Sequence[StoreAction],
+                commit_cb=None) -> None:
+        """Block until the change list is committed by consensus and
+        ``commit_cb`` ran in the apply path (reference: raft.go:1592
+        ProposeValue; no internal timeout by design, design/raft.md:215 —
+        but leadership loss fails us)."""
+        if self.core.role != LEADER:
+            raise NotLeader(f"{self.id} is not the leader")
+        data = serde.dumps([serde.action_to_dict(a) for a in actions])
+        waiter = _Waiter(event=threading.Event(), term=self.core.term,
+                         index=0, commit_cb=commit_cb)
+        self._inbox.put((data, waiter))
+        waiter.event.wait()
+        if not waiter.ok:
+            raise ProposalDropped(
+                "raft proposal dropped (leadership change)")

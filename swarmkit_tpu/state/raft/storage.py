@@ -1,0 +1,174 @@
+"""Raft persistence: write-ahead log + snapshot files.
+
+Reference: manager/state/raft/storage/ (EncryptedRaftLogger over etcd
+wal/snap).  Layout under a state directory:
+
+    wal.jsonl       — append-only records: hardstate / entry lines
+    snapshot        — latest snapshot (index, term, payload)
+    snapshot.tmp    — atomic-replace staging
+
+Records are serde JSON lines; an ``Encoder`` seam (encode/decode bytes)
+slots in at-rest encryption (reference: manager/encryption) without
+touching the log logic.  On restart ``bootstrap()`` loads the snapshot,
+replays the WAL, and returns (hard_state, entries, snapshot) for
+RaftCore.load.  The WAL is truncated to post-snapshot entries whenever a
+new snapshot is saved (KeepOldSnapshots=0 semantics).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import List, Optional, Tuple
+
+from .core import Entry, HardState, Snapshot
+
+
+class Encoder:
+    """At-rest encryption seam (reference: manager/encryption)."""
+
+    def encode(self, data: bytes) -> bytes:
+        return data
+
+    def decode(self, data: bytes) -> bytes:
+        return data
+
+
+class RaftLogger:
+    def __init__(self, state_dir: str, encoder: Optional[Encoder] = None,
+                 fsync: bool = False):
+        self.state_dir = state_dir
+        self.encoder = encoder or Encoder()
+        self.fsync = fsync
+        os.makedirs(state_dir, exist_ok=True)
+        self._wal_path = os.path.join(state_dir, "wal.jsonl")
+        self._snap_path = os.path.join(state_dir, "snapshot")
+        self._wal = None
+
+    # ---------------------------------------------------------------- write
+
+    def _open_wal(self, mode: str = "ab"):
+        if self._wal is None:
+            self._wal = open(self._wal_path, mode)
+        return self._wal
+
+    def _write_record(self, record: dict) -> None:
+        data = json.dumps(record, sort_keys=True,
+                          separators=(",", ":")).encode()
+        payload = base64.b64encode(self.encoder.encode(data))
+        wal = self._open_wal()
+        wal.write(payload + b"\n")
+        wal.flush()
+        if self.fsync:
+            os.fsync(wal.fileno())
+
+    def save(self, hard_state: Optional[HardState],
+             entries: List[Entry]) -> None:
+        """Persist a Ready's durable parts; called before sending/applying
+        (reference: raft.go:540 saveToStorage)."""
+        if hard_state is not None:
+            self._write_record({
+                "t": "hs", "term": hard_state.term,
+                "vote": hard_state.voted_for, "commit": hard_state.commit})
+        for e in entries:
+            self._write_record({
+                "t": "ent", "term": e.term, "index": e.index,
+                "type": e.type,
+                "data": base64.b64encode(e.data).decode("ascii")})
+
+    def save_snapshot(self, snapshot: Snapshot,
+                      keep_entries_from: int) -> None:
+        """Atomically persist a snapshot and truncate the WAL to entries
+        after ``keep_entries_from`` (reference: storage.go:198)."""
+        tmp = self._snap_path + ".tmp"
+        record = json.dumps({
+            "index": snapshot.index, "term": snapshot.term,
+            "data": base64.b64encode(
+                self.encoder.encode(snapshot.data)).decode("ascii"),
+        }, sort_keys=True).encode()
+        with open(tmp, "wb") as f:
+            f.write(record)
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
+
+        # rewrite the WAL without pre-snapshot entries
+        hs, entries, _ = self._load_wal()
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+        wal_tmp = self._wal_path + ".tmp"
+        with open(wal_tmp, "wb") as f:
+            self._wal = f
+            if hs is not None:
+                self._write_record({"t": "hs", "term": hs.term,
+                                    "vote": hs.voted_for,
+                                    "commit": hs.commit})
+            for e in entries:
+                if e.index > keep_entries_from:
+                    self._write_record({
+                        "t": "ent", "term": e.term, "index": e.index,
+                        "type": e.type,
+                        "data": base64.b64encode(e.data).decode("ascii")})
+            self._wal = None
+        os.replace(wal_tmp, self._wal_path)
+
+    # ----------------------------------------------------------------- read
+
+    def _load_wal(self) -> Tuple[Optional[HardState], List[Entry], int]:
+        hs: Optional[HardState] = None
+        entries: List[Entry] = []
+        if not os.path.exists(self._wal_path):
+            return hs, entries, 0
+        count = 0
+        with open(self._wal_path, "rb") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    data = self.encoder.decode(base64.b64decode(line))
+                    rec = json.loads(data)
+                except Exception:
+                    break  # torn tail record: stop replay here
+                count += 1
+                if rec["t"] == "hs":
+                    hs = HardState(term=rec["term"], voted_for=rec["vote"],
+                                   commit=rec["commit"])
+                elif rec["t"] == "ent":
+                    e = Entry(term=rec["term"], index=rec["index"],
+                              type=rec.get("type", 0),
+                              data=base64.b64decode(rec["data"]))
+                    # later records override earlier ones (truncation)
+                    while entries and entries[-1].index >= e.index:
+                        entries.pop()
+                    entries.append(e)
+        return hs, entries, count
+
+    def load_snapshot(self) -> Optional[Snapshot]:
+        if not os.path.exists(self._snap_path):
+            return None
+        try:
+            with open(self._snap_path, "rb") as f:
+                rec = json.loads(f.read())
+            return Snapshot(
+                index=rec["index"], term=rec["term"],
+                data=self.encoder.decode(base64.b64decode(rec["data"])))
+        except Exception:
+            return None
+
+    def bootstrap(self) -> Tuple[HardState, List[Entry],
+                                 Optional[Snapshot]]:
+        """reference: storage.go:51 BootstrapFromDisk."""
+        snapshot = self.load_snapshot()
+        hs, entries, _ = self._load_wal()
+        if snapshot is not None:
+            entries = [e for e in entries if e.index > snapshot.index]
+        return hs or HardState(), entries, snapshot
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None

@@ -1,0 +1,171 @@
+"""Serialization for the cluster data model: dataclasses ↔ dicts ↔ bytes.
+
+Reference: the role of api/*.pb.go generated marshaling + api/snapshot.proto.
+
+The model is plain typed dataclasses (models/types.py, specs.py,
+objects.py), so serialization is **schema-driven from the type hints**:
+``to_dict`` lowers any model object to JSON-compatible primitives (enums →
+ints, bytes → base64 strings); ``from_dict(cls, data)`` reconstructs using
+``cls``'s resolved field types — List[X], Dict[str, X], Optional[X], nested
+dataclasses, IntEnums.  ``dumps``/``loads`` produce deterministic bytes
+(sorted keys, compact separators) for snapshots, the WAL, and the wire.
+
+Forward compatibility: unknown dict keys are ignored on decode, missing
+keys take field defaults — the same leniency protobuf gives the reference.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import enum
+import json
+import typing
+from typing import Any, Dict, Optional, Type
+
+_HINTS_CACHE: Dict[type, Dict[str, Any]] = {}
+
+
+def _hints(cls: type) -> Dict[str, Any]:
+    cached = _HINTS_CACHE.get(cls)
+    if cached is None:
+        import sys
+        mod = sys.modules.get(cls.__module__)
+        localns = dict(vars(mod)) if mod else {}
+        # nested classes (e.g. VolumePublishStatus.State) resolve via the
+        # enclosing class being in scope
+        localns[cls.__name__] = cls
+        cached = typing.get_type_hints(cls, localns=localns)
+        _HINTS_CACHE[cls] = cached
+    return cached
+
+
+def to_dict(obj: Any) -> Any:
+    """Lower a model object to JSON-compatible primitives."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        if isinstance(obj, enum.Enum):
+            return int(obj)
+        return obj
+    if isinstance(obj, enum.Enum):
+        return int(obj)
+    if isinstance(obj, bytes):
+        return base64.b64encode(obj).decode("ascii")
+    if dataclasses.is_dataclass(obj):
+        return {f.name: to_dict(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    if isinstance(obj, dict):
+        return {str(k): to_dict(v) for k, v in obj.items()}
+    raise TypeError(f"cannot serialize {type(obj).__name__}")
+
+
+def _from_typed(tp: Any, data: Any) -> Any:
+    if data is None:
+        return None
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return _from_typed(args[0], data)
+        raise TypeError(f"unsupported union {tp}")
+    if origin in (list, tuple):
+        (item_tp,) = typing.get_args(tp)[:1] or (Any,)
+        seq = [_from_typed(item_tp, v) for v in data]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        args = typing.get_args(tp)
+        val_tp = args[1] if len(args) == 2 else Any
+        return {k: _from_typed(val_tp, v) for k, v in data.items()}
+    if isinstance(tp, type):
+        if issubclass(tp, enum.Enum):
+            return tp(data)
+        if tp is bytes:
+            return base64.b64decode(data)
+        if dataclasses.is_dataclass(tp):
+            return from_dict(tp, data)
+        if tp is float:
+            return float(data)
+        if tp is int:
+            return int(data)
+    return data
+
+
+def from_dict(cls: Type, data: Optional[Dict[str, Any]]) -> Any:
+    """Reconstruct a dataclass instance from to_dict output."""
+    if data is None:
+        return None
+    hints = _hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue  # field default applies (forward compatibility)
+        kwargs[f.name] = _from_typed(hints.get(f.name, Any), data[f.name])
+    return cls(**kwargs)
+
+
+def dumps(obj: Any) -> bytes:
+    """Deterministic bytes for any to_dict-able value."""
+    return json.dumps(to_dict(obj), sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def loads_dict(data: bytes) -> Any:
+    return json.loads(data.decode("utf-8"))
+
+
+def loads(cls: Type, data: bytes) -> Any:
+    return from_dict(cls, loads_dict(data))
+
+
+# ---------------------------------------------------------------------------
+# Store snapshots and replicated actions (reference: api/snapshot.proto,
+# api.StoreAction)
+# ---------------------------------------------------------------------------
+
+def _collection_map():
+    from ..models.objects import STORE_OBJECT_TYPES
+    return {t.collection: t for t in STORE_OBJECT_TYPES}
+
+
+def snapshot_to_bytes(snapshot: Dict[str, Any]) -> bytes:
+    """Serialize MemoryStore.save() output to deterministic bytes."""
+    payload = {
+        "version": snapshot["version"],
+        "tables": {
+            coll: sorted((to_dict(o) for o in objs),
+                         key=lambda d: d.get("id", ""))
+            for coll, objs in snapshot["tables"].items()
+        },
+    }
+    return json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def snapshot_from_bytes(data: bytes) -> Dict[str, Any]:
+    """Deserialize into the dict shape MemoryStore.restore() accepts."""
+    payload = json.loads(data.decode("utf-8"))
+    classes = _collection_map()
+    return {
+        "version": payload["version"],
+        "tables": {
+            coll: [from_dict(classes[coll], d) for d in objs]
+            for coll, objs in payload["tables"].items()
+            if coll in classes
+        },
+    }
+
+
+def action_to_dict(action) -> Dict[str, Any]:
+    """One replicated store mutation (reference: api.StoreAction)."""
+    return {
+        "action": action.action,
+        "collection": action.obj.collection,
+        "obj": to_dict(action.obj),
+    }
+
+
+def action_from_dict(data: Dict[str, Any]):
+    from .store import StoreAction
+    cls = _collection_map()[data["collection"]]
+    return StoreAction(data["action"], from_dict(cls, data["obj"]))

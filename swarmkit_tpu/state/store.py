@@ -86,13 +86,20 @@ class Proposer:
     """Consensus seam (reference: manager/state/proposer.go:17).
 
     ``propose`` must block until the change list is committed by consensus
-    (or raise).  Actions arrive with their final version indices already
-    stamped (see MemoryStore.update).  A nil proposer (None) keeps the
-    store fully functional standalone — the master test fixture of the
+    (or raise).  ``commit_cb`` — the store-side commit — must be invoked
+    exactly once, synchronously in the consensus apply path, before the
+    applied index advances past this entry; this is what keeps snapshots
+    consistent with the entries they claim to cover (the reference passes
+    the memstore commit as the wait callback run by wait.trigger inside
+    processEntry, raft.go:1917).  On failure commit_cb must NOT run and
+    propose raises.  Actions arrive with their final version indices
+    already stamped (see MemoryStore.update).  A nil proposer (None) keeps
+    the store fully functional standalone — the master test fixture of the
     reference.
     """
 
-    def propose(self, actions: Sequence[StoreAction]) -> None:
+    def propose(self, actions: Sequence[StoreAction],
+                commit_cb: Callable[[], None]) -> None:
         raise NotImplementedError
 
 
@@ -452,7 +459,11 @@ class MemoryStore:
             return result
 
     def _propose_and_commit(self, tx: "WriteTx") -> None:
-        """Stamp versions, run consensus, apply.  Caller holds _update_lock."""
+        """Stamp versions, run consensus, apply.  Caller holds _update_lock.
+
+        With a proposer, the local commit runs inside the consensus apply
+        path (see Proposer.propose) so snapshots taken at an applied index
+        always include that index's changes."""
         if tx._changes:
             with self._lock:
                 seq = self._version
@@ -461,7 +472,9 @@ class MemoryStore:
                 if change.action in ("create", "update"):
                     change.obj.meta.version.index = seq
             if self._proposer is not None:
-                self._proposer.propose(tx._changes)
+                self._proposer.propose(tx._changes,
+                                       lambda: self._commit(tx))
+                return
         self._commit(tx)
 
     def batch(self, cb: Callable[["Batch"], Any]) -> Any:
@@ -674,9 +687,21 @@ class MemoryStore:
                 failed_idx.extend(failed)
                 if not stamped:
                     continue
+
+                def apply_chunk(stamped=stamped):
+                    with self._lock:
+                        if hp is not None:
+                            hp.commit_apply(stamped, objects, table.by_node,
+                                            self._reindex_pair)
+                        else:
+                            self._commit_apply_py(stamped, table)
+                        self._version += len(stamped)
+
                 if want_actions:
                     try:
-                        self._proposer.propose(actions)
+                        # commit runs inside the consensus apply path (see
+                        # Proposer.propose)
+                        self._proposer.propose(actions, apply_chunk)
                     except Exception:
                         # per-chunk failure granularity: earlier chunks are
                         # committed and stay committed; this chunk and all
@@ -686,13 +711,8 @@ class MemoryStore:
                         failed_idx.extend(committed)
                         failed_idx.extend(range(i, n))
                         break
-                with self._lock:
-                    if hp is not None:
-                        hp.commit_apply(stamped, objects, table.by_node,
-                                        self._reindex_pair)
-                    else:
-                        self._commit_apply_py(stamped, table)
-                    self._version += len(stamped)
+                else:
+                    apply_chunk()
                 committed_idx.extend(committed)
                 if want_events:
                     publish = self.queue.publish
@@ -824,6 +844,15 @@ class MemoryStore:
                                 table.by_name[name] = cp.id
                 self._version = snapshot.get("version", 0)
             self.queue.publish(EventSnapshotRestore())
+
+    def save_bytes(self) -> bytes:
+        """Deterministic snapshot bytes (raft snapshot transfer / disk)."""
+        from . import serde
+        return serde.snapshot_to_bytes(self.save())
+
+    def restore_bytes(self, data: bytes) -> None:
+        from . import serde
+        self.restore(serde.snapshot_from_bytes(data))
 
     @property
     def version(self) -> int:

@@ -1,0 +1,276 @@
+"""Raft consensus tests: in-process multi-node clusters with pausable
+transport and real on-disk WAL/snapshots (mirrors the reference's
+manager/state/raft/testutils approach: real nodes, loopback links,
+partitions, restarts)."""
+
+import dataclasses
+import os
+import time
+
+import pytest
+
+from swarmkit_tpu.models import Annotations, Node, NodeSpec
+from swarmkit_tpu.state import MemoryStore
+from swarmkit_tpu.state.raft import (
+    LocalNetwork, NotLeader, ProposalDropped, RaftLogger, RaftNode,
+)
+from swarmkit_tpu.utils import new_id
+
+from test_orchestrator import poll
+
+
+def make_cluster(tmp_path, n=3, snapshot_interval=1000):
+    net = LocalNetwork()
+    ids = [f"m{i}" for i in range(n)]
+    nodes = {}
+    for node_id in ids:
+        store = MemoryStore()
+        logger = RaftLogger(os.path.join(tmp_path, node_id))
+        rn = RaftNode(node_id, ids, store, logger, net,
+                      snapshot_interval=snapshot_interval)
+        store._proposer = rn
+        nodes[node_id] = rn
+    for rn in nodes.values():
+        rn.start()
+    return net, nodes
+
+
+def wait_leader(nodes, timeout=10):
+    def find():
+        leaders = [rn for rn in nodes.values() if rn.is_leader]
+        return leaders[0] if len(leaders) == 1 else None
+    return poll(find, timeout=timeout, msg="no single leader elected")
+
+
+def mk_node_obj(name):
+    return Node(id=new_id(),
+                spec=NodeSpec(annotations=Annotations(name=name)))
+
+
+def stores_converged(nodes, expect_names, timeout=10):
+    def check():
+        for rn in nodes.values():
+            got = {n.spec.annotations.name
+                   for n in rn.store.view(lambda tx: tx.find(Node))}
+            if got != set(expect_names):
+                return False
+        return True
+    poll(check, timeout=timeout,
+         msg=f"stores should converge to {expect_names}")
+
+
+def test_single_node_cluster_commits(tmp_path):
+    net, nodes = make_cluster(tmp_path, n=1)
+    try:
+        leader = wait_leader(nodes)
+        leader.store.update(lambda tx: tx.create(mk_node_obj("a")))
+        stores_converged(nodes, {"a"})
+    finally:
+        for rn in nodes.values():
+            rn.stop()
+
+
+def test_three_node_replication(tmp_path):
+    net, nodes = make_cluster(tmp_path)
+    try:
+        leader = wait_leader(nodes)
+        leader.store.update(lambda tx: tx.create(mk_node_obj("a")))
+        leader.store.update(lambda tx: tx.create(mk_node_obj("b")))
+        stores_converged(nodes, {"a", "b"})
+        # follower stores carry identical version stamps
+        versions = set()
+        for rn in nodes.values():
+            for n in rn.store.view(lambda tx: tx.find(Node)):
+                versions.add((n.spec.annotations.name,
+                              n.meta.version.index))
+        assert len(versions) == 2, versions
+    finally:
+        for rn in nodes.values():
+            rn.stop()
+
+
+def test_proposal_on_follower_rejected(tmp_path):
+    net, nodes = make_cluster(tmp_path)
+    try:
+        leader = wait_leader(nodes)
+        follower = next(rn for rn in nodes.values() if not rn.is_leader)
+        with pytest.raises(NotLeader):
+            follower.store.update(lambda tx: tx.create(mk_node_obj("x")))
+    finally:
+        for rn in nodes.values():
+            rn.stop()
+
+
+def test_leader_failure_elects_new_and_resumes(tmp_path):
+    net, nodes = make_cluster(tmp_path)
+    try:
+        leader = wait_leader(nodes)
+        leader.store.update(lambda tx: tx.create(mk_node_obj("a")))
+        stores_converged(nodes, {"a"})
+
+        # kill the leader
+        net.pause(leader.id)
+        survivors = {k: v for k, v in nodes.items() if v is not leader}
+        new_leader = wait_leader(survivors, timeout=15)
+        assert new_leader.id != leader.id
+
+        new_leader.store.update(lambda tx: tx.create(mk_node_obj("b")))
+        stores_converged(survivors, {"a", "b"})
+
+        # old leader comes back: catches up, steps down
+        net.resume(leader.id)
+        stores_converged(nodes, {"a", "b"})
+        poll(lambda: not leader.is_leader or new_leader.is_leader,
+             timeout=10)
+    finally:
+        for rn in nodes.values():
+            rn.stop()
+
+
+def test_partitioned_leader_cannot_commit(tmp_path):
+    net, nodes = make_cluster(tmp_path)
+    try:
+        leader = wait_leader(nodes)
+        others = [rn for rn in nodes.values() if rn is not leader]
+        net.cut(leader.id, others[0].id)
+        net.cut(leader.id, others[1].id)
+        # a proposal on the partitioned leader must not commit
+        with pytest.raises(ProposalDropped):
+            leader.store.update(lambda tx: tx.create(mk_node_obj("lost")))
+        # majority side elects and commits
+        survivors = {rn.id: rn for rn in others}
+        new_leader = wait_leader(survivors, timeout=15)
+        new_leader.store.update(lambda tx: tx.create(mk_node_obj("ok")))
+        net.heal(leader.id, others[0].id)
+        net.heal(leader.id, others[1].id)
+        stores_converged(nodes, {"ok"}, timeout=15)
+        # the lost write must not reappear anywhere
+        for rn in nodes.values():
+            names = {n.spec.annotations.name
+                     for n in rn.store.view(lambda tx: tx.find(Node))}
+            assert "lost" not in names
+    finally:
+        for rn in nodes.values():
+            rn.stop()
+
+
+def test_restart_replays_wal(tmp_path):
+    net, nodes = make_cluster(tmp_path, n=1)
+    leader = wait_leader(nodes)
+    leader.store.update(lambda tx: tx.create(mk_node_obj("a")))
+    leader.store.update(lambda tx: tx.create(mk_node_obj("b")))
+    leader.stop()
+
+    # new process: same state dir
+    store2 = MemoryStore()
+    logger2 = RaftLogger(os.path.join(tmp_path, "m0"))
+    net2 = LocalNetwork()
+    rn2 = RaftNode("m0", ["m0"], store2, logger2, net2)
+    store2._proposer = rn2
+    names = {n.spec.annotations.name
+             for n in store2.view(lambda tx: tx.find(Node))}
+    assert names == {"a", "b"}, "WAL replay must rebuild the store"
+    rn2.start()
+    try:
+        wait_leader({"m0": rn2})
+        rn2.store.update(lambda tx: tx.create(mk_node_obj("c")))
+        assert {n.spec.annotations.name
+                for n in store2.view(lambda tx: tx.find(Node))} == \
+            {"a", "b", "c"}
+    finally:
+        rn2.stop()
+
+
+def test_snapshot_and_catchup(tmp_path):
+    net, nodes = make_cluster(tmp_path, snapshot_interval=10)
+    try:
+        leader = wait_leader(nodes)
+        names = set()
+        for i in range(25):
+            name = f"n{i:02d}"
+            names.add(name)
+            leader.store.update(lambda tx, name=name: tx.create(
+                mk_node_obj(name)))
+        stores_converged(nodes, names)
+        assert leader.stats["snapshots"] >= 1, "leader should snapshot"
+        assert leader.core.snap_index > 0
+
+        # a follower that missed everything catches up via snapshot
+        lagger = next(rn for rn in nodes.values() if rn is not leader)
+        net.pause(lagger.id)
+        more = set()
+        for i in range(25, 45):
+            name = f"n{i:02d}"
+            names.add(name)
+            more.add(name)
+            leader.store.update(lambda tx, name=name: tx.create(
+                mk_node_obj(name)))
+        live = {k: v for k, v in nodes.items() if v is not lagger}
+        stores_converged(live, names)
+        net.resume(lagger.id)
+        stores_converged(nodes, names, timeout=20)
+    finally:
+        for rn in nodes.values():
+            rn.stop()
+
+
+def test_leader_failover_preserves_scheduler_input(tmp_path):
+    """The headline HA property: leader dies, the new leader's store has
+    everything needed to keep scheduling (SURVEY §5.3)."""
+    from swarmkit_tpu.scheduler import Scheduler
+    from swarmkit_tpu.models import Task, TaskState
+    from swarmkit_tpu.state import ByService
+
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_scheduler import make_ready_node, make_service_with_tasks
+
+    net, nodes = make_cluster(tmp_path)
+    scheds = []
+    try:
+        leader = wait_leader(nodes)
+        worker = make_ready_node("w1", cpus=8)
+        svc, tasks = make_service_with_tasks(4)
+
+        def setup(tx):
+            tx.create(worker)
+            tx.create(svc)
+            for t in tasks:
+                tx.create(t)
+        leader.store.update(setup)
+
+        # leader-only control loop: scheduler on the leader
+        sched = Scheduler(leader.store)
+        scheds.append(sched)
+        sched.start()
+        poll(lambda: all(
+            t.status.state == TaskState.ASSIGNED
+            for t in leader.store.view(
+                lambda tx: tx.find(Task, ByService(svc.id)))), timeout=15)
+        sched.stop()
+
+        # leader dies; new leader resumes scheduling from replicated state
+        net.pause(leader.id)
+        survivors = {k: v for k, v in nodes.items() if v is not leader}
+        new_leader = wait_leader(survivors, timeout=15)
+
+        # a new task arrives (e.g. scale-up committed via new leader)
+        t_new = tasks[0].copy()
+        t_new.id = new_id()
+        t_new.slot = 99
+        t_new.node_id = ""
+        new_leader.store.update(lambda tx: tx.create(t_new))
+
+        sched2 = Scheduler(new_leader.store)
+        scheds.append(sched2)
+        sched2.start()
+        poll(lambda: (new_leader.store.view(
+            lambda tx: tx.get(Task, t_new.id)).status.state
+            == TaskState.ASSIGNED), timeout=15,
+            msg="new leader must schedule from replayed state")
+        sched2.stop()
+    finally:
+        for s in scheds:
+            s.stop()
+        for rn in nodes.values():
+            rn.stop()

@@ -237,8 +237,9 @@ def test_proposer_seam():
     proposed = []
 
     class P:
-        def propose(self, actions):
+        def propose(self, actions, commit_cb):
             proposed.append(list(actions))
+            commit_cb()
 
 
     s = MemoryStore(proposer=P())
@@ -248,7 +249,7 @@ def test_proposer_seam():
     assert proposed[0][0].action == "create"
 
     class Failing:
-        def propose(self, actions):
+        def propose(self, actions, commit_cb):
             raise RuntimeError("no quorum")
 
 
@@ -309,8 +310,9 @@ def test_follower_version_counter_matches_leader_after_deletes():
     replicated = []
 
     class Relay:
-        def propose(self, actions):
+        def propose(self, actions, commit_cb):
             replicated.append(list(actions))
+            commit_cb()   # consensus commits, then the leader store applies
 
     leader._proposer = Relay()
 
