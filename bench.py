@@ -1,24 +1,26 @@
-"""Headline benchmark: scheduling decisions/sec at 100k tasks × 10k nodes.
+"""Benchmark: all five BASELINE.json configs through the full path —
+store → scheduler tick → TPU plan → columnar store commit.
 
-Matches BASELINE.json config 4 scale (the reference's
-BenchmarkScheduler100kNodes*/1kNodes* family,
-manager/scheduler/scheduler_test.go:3338-3376): one big task group scheduled
-onto a 10k-node cluster through the full path — store → scheduler tick →
-(TPU plan | host oracle) → columnar store commit — measured from tick start
-to all ASSIGNED rows committed, median of BENCH_TRIALS runs.
+Headline (the driver's one JSON line) is config 4's scale: 100k tasks ×
+10k nodes, median of BENCH_TRIALS runs with p50/p99 and plan/commit phase
+breakdown.  The other configs run once each and are embedded in the same
+JSON line under "configs":
+
+  1. 1k tasks × 100 nodes, no constraints (spread-only baseline)
+  2. 10k × 1k with CPU/memory reservations (ResourceFilter bin-packing)
+  3. 50k × 5k with node.labels + platform constraints
+  4. 100k × 10k mixed replicated+global with spread-by-label preference
+  5. reschedule storm: 500k tasks on 10k nodes, drain 1k nodes → re-place
+     the displaced tasks in one tick (plus a 500k cold-storm single tick)
 
 Baseline: the Go toolchain is not present in this image, so the reference's
-own benches cannot run here.  ``vs_baseline`` therefore compares against the
-**host oracle path** (the faithful reimplementation of the reference
-algorithm running on the same store) measured in this same process on a
-proportionally scaled workload (same 10k nodes, BENCH_BASELINE_TASKS tasks),
-normalized per decision.  See BASELINE.md for the methodology note.
-
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "decisions/sec", "vs_baseline": N, ...}
+own benches cannot run here.  ``vs_baseline`` compares against the **host
+oracle path** (the faithful reimplementation of the reference algorithm on
+the same store) measured in this process on a proportionally scaled
+workload, normalized per decision.  See BASELINE.md.
 
 Env overrides: BENCH_NODES, BENCH_TASKS, BENCH_BASELINE_TASKS,
-BENCH_SKIP_HOST, BENCH_TRIALS.
+BENCH_SKIP_HOST, BENCH_TRIALS, BENCH_SKIP_CONFIGS.
 """
 
 import gc
@@ -34,31 +36,38 @@ N_NODES = int(os.environ.get("BENCH_NODES", 10_000))
 N_TASKS = int(os.environ.get("BENCH_TASKS", 100_000))
 BASELINE_TASKS = int(os.environ.get("BENCH_BASELINE_TASKS", 5_000))
 SKIP_HOST = os.environ.get("BENCH_SKIP_HOST", "") == "1"
+SKIP_CONFIGS = os.environ.get("BENCH_SKIP_CONFIGS", "") == "1"
 TRIALS = int(os.environ.get("BENCH_TRIALS", 3))
 
 
-def build_cluster(n_nodes, n_tasks):
+def build_cluster(n_nodes, n_tasks, node_labels=None, reservations=None,
+                  constraints=None, platforms=None, prefs=None,
+                  node_platform=None, global_share=0.0, assigned_state=None):
     from swarmkit_tpu.models import (
         Annotations, Node, NodeDescription, NodeSpec, NodeState, NodeStatus,
-        Placement, ReplicatedService, Resources, ResourceRequirements,
-        Service, ServiceMode, ServiceSpec, Task, TaskSpec, TaskState,
-        TaskStatus, Version,
+        Placement, Platform, ReplicatedService, Resources,
+        ResourceRequirements, Service, ServiceMode, ServiceSpec, Task,
+        TaskSpec, TaskState, TaskStatus, Version,
     )
     from swarmkit_tpu.state import MemoryStore
     from swarmkit_tpu.utils import new_id
 
     store = MemoryStore()
-    nodes = [
-        Node(id=new_id(),
-             spec=NodeSpec(annotations=Annotations(
-                 name=f"node-{i:05d}", labels={"rack": f"r{i % 20}"})),
-             status=NodeStatus(state=NodeState.READY),
-             description=NodeDescription(
-                 hostname=f"node-{i:05d}",
-                 resources=Resources(nano_cpus=32 * 10**9,
-                                     memory_bytes=128 << 30)))
-        for i in range(n_nodes)
-    ]
+    nodes = []
+    for i in range(n_nodes):
+        labels = dict(node_labels(i)) if node_labels else \
+            {"rack": f"r{i % 20}"}
+        platform = Platform(**node_platform(i)) if node_platform else \
+            Platform(os="linux", architecture="amd64")
+        nodes.append(Node(
+            id=new_id(),
+            spec=NodeSpec(annotations=Annotations(
+                name=f"node-{i:05d}", labels=labels)),
+            status=NodeStatus(state=NodeState.READY),
+            description=NodeDescription(
+                hostname=f"node-{i:05d}", platform=platform,
+                resources=Resources(nano_cpus=64 * 10**9,
+                                    memory_bytes=256 << 30))))
     svc = Service(
         id=new_id(),
         spec=ServiceSpec(annotations=Annotations(name="bench"),
@@ -66,82 +75,242 @@ def build_cluster(n_nodes, n_tasks):
                          replicated=ReplicatedService(replicas=n_tasks)),
         spec_version=Version(index=1))
     shared_spec = TaskSpec(
+        placement=Placement(constraints=constraints or [],
+                            platforms=platforms or [],
+                            preferences=prefs or []),
         resources=ResourceRequirements(
-            reservations=Resources(nano_cpus=10**9,
-                                   memory_bytes=1 << 30)))
-    tasks = [
-        Task(id=new_id(), service_id=svc.id, slot=s,
-             desired_state=TaskState.RUNNING, spec=shared_spec,
-             spec_version=Version(index=1),
-             status=TaskStatus(state=TaskState.PENDING))
-        for s in range(1, n_tasks + 1)
-    ]
+            reservations=reservations
+            or Resources(nano_cpus=10**8, memory_bytes=64 << 20)))
 
-    def setup(tx):
+    n_global = int(n_tasks * global_share)
+    tasks = []
+    for s in range(1, n_tasks + 1):
+        t = Task(id=new_id(), service_id=svc.id, slot=s,
+                 desired_state=TaskState.RUNNING, spec=shared_spec,
+                 spec_version=Version(index=1),
+                 status=TaskStatus(state=TaskState.PENDING))
+        if s <= n_global:
+            # global-service style: preassigned to a node
+            t.slot = 0
+            t.node_id = nodes[s % n_nodes].id
+        if assigned_state is not None and s > n_global:
+            t.node_id = nodes[s % n_nodes].id
+            t.status = TaskStatus(state=assigned_state)
+        tasks.append(t)
+
+    def create_nodes(tx):
         for n in nodes:
             tx.create(n)
         tx.create(svc)
 
-    store.update(setup)
+    store.update(create_nodes)
 
-    def add_tasks(tx):
+    def create_tasks(tx):
         for t in tasks:
             tx.create(t)
 
-    store.update(add_tasks)
-    return store, svc
+    store.update(create_tasks)
+    return store, svc, nodes, tasks
 
 
-def run_path(n_nodes, n_tasks, planner):
-    """One full tick on a fresh cluster; returns timing detail."""
+def one_tick(store, planner, preassigned=False):
     from swarmkit_tpu.scheduler import Scheduler
 
-    store, svc = build_cluster(n_nodes, n_tasks)
     sched = Scheduler(store, batch_planner=planner)
     store.view(sched._setup_tasks_list)
+    n_pre = len(sched.pending_preassigned_tasks)
     gc.collect()
-    gc.freeze()   # long-lived store objects out of GC scan range
+    gc.freeze()
+    t0 = time.perf_counter()
+    if preassigned:
+        sched._process_preassigned_tasks()
+    n_dec = sched.tick()
+    if preassigned:
+        # only preassigned tasks that actually confirmed count
+        n_dec += n_pre - len(sched.pending_preassigned_tasks)
+    dt = time.perf_counter() - t0
+    gc.unfreeze()
+    return sched, n_dec, dt
+
+
+def run_config(name, n_nodes, n_tasks, planner_factory, expect=None, **kw):
+    from swarmkit_tpu.models import Task as _Task, TaskState
+
+    preassigned = kw.get("global_share", 0.0) > 0
+    store, svc, nodes, tasks = build_cluster(n_nodes, n_tasks, **kw)
+    planner = planner_factory()
+    sched, n_dec, dt = one_tick(store, planner, preassigned=preassigned)
+    expected = expect if expect is not None else n_tasks
+    n_assigned = sum(
+        1 for t in store.view(lambda tx: tx.find(_Task))
+        if t.status.state >= TaskState.ASSIGNED and t.node_id)
+    assert n_assigned >= expected, \
+        f"{name}: only {n_assigned}/{expected} tasks actually ASSIGNED"
+    small = planner.stats["groups_small_to_host"]
+    if planner.stats["tasks_planned"] == 0:
+        # legitimate only when the adaptive router sent every group to the
+        # host because the measured device round-trip would not amortize
+        assert small > 0 and planner.stats["groups_fallback"] == 0, \
+            f"{name}: TPU path did not engage: {planner.stats}"
+    return {
+        "nodes": n_nodes, "tasks": n_tasks,
+        "decisions": n_dec,
+        "decisions_per_sec": round(n_dec / dt, 1),
+        "tick_s": round(dt, 3),
+        "plan_s": round(planner.stats["plan_seconds"], 3),
+        "commit_s": round(sched.stats["commit_seconds"], 3),
+        "fallback_groups": planner.stats["groups_fallback"],
+        "groups_small_to_host": small,
+        "path": "host-routed" if planner.stats["tasks_planned"] == 0
+        else "device",
+    }
+
+
+def run_storm(planner_factory):
+    """Config 5: 500k tasks running on 10k nodes; 1k nodes are drained and
+    the tasks they hosted must be re-placed on the remaining 9k nodes in
+    one tick.  The cluster is built post-drain: drained nodes carry
+    availability=DRAIN with their old tasks already SHUT DOWN (what the
+    orchestrator/enforcer do), and one PENDING replacement per displaced
+    task sits in the queue."""
+    from swarmkit_tpu.models import (
+        NodeAvailability, Task, TaskState, TaskStatus,
+    )
+    from swarmkit_tpu.scheduler import Scheduler
+    from swarmkit_tpu.utils import new_id
+
+    n_nodes, n_tasks, n_drained = 10_000, 500_000, 1_000
+    store, svc, nodes, tasks = build_cluster(
+        n_nodes, n_tasks, assigned_state=TaskState.RUNNING)
+
+    drained = set(n.id for n in nodes[:n_drained])
+
+    def drain_nodes(tx):
+        for n in nodes[:n_drained]:
+            cur = tx.get(type(n), n.id).copy()
+            cur.spec.availability = NodeAvailability.DRAIN
+            tx.update(cur)
+
+    store.update(drain_nodes)
+
+    displaced = [t for t in tasks if t.node_id in drained]
+    replacements = []
+    for t in displaced:
+        r = t.copy()
+        r.id = new_id()
+        r.node_id = ""
+        r.status = TaskStatus(state=TaskState.PENDING)
+        replacements.append(r)
+
+    def shutdown_and_replace(batch):
+        for t in displaced:
+            def down(tx, t=t):
+                cur = tx.get(Task, t.id).copy()
+                cur.desired_state = TaskState.SHUTDOWN
+                cur.status = TaskStatus(state=TaskState.SHUTDOWN)
+                tx.update(cur)
+            batch.update(down)
+        for r in replacements:
+            batch.update(lambda tx, r=r: tx.create(r))
+
+    store.batch(shutdown_and_replace)
+
+    planner = planner_factory()
+    sched = Scheduler(store, batch_planner=planner)
+    store.view(sched._setup_tasks_list)
+
+    gc.collect()
+    gc.freeze()
     t0 = time.perf_counter()
     n_dec = sched.tick()
     dt = time.perf_counter() - t0
     gc.unfreeze()
-    assert n_dec == n_tasks, f"scheduled {n_dec}/{n_tasks}"
-    if planner is not None:
-        # fail loudly if a regression silently routed tasks to the host
-        # fallback: the headline number must measure the device path
-        assert planner.stats["groups_planned"] >= 1, planner.stats
-        assert planner.stats["tasks_planned"] == n_tasks, planner.stats
+    assert n_dec == len(replacements), (n_dec, len(replacements))
+    placed = store.view(lambda tx: [tx.get(Task, r.id) for r in replacements])
+    assert all(t is not None and t.node_id and t.node_id not in drained
+               for t in placed), "replacements must avoid drained nodes"
     return {
-        "decisions": n_dec,
-        "tick_s": dt,
-        "plan_s": planner.stats["plan_seconds"] if planner else 0.0,
-        "commit_s": sched.stats["commit_seconds"],
+        "nodes": n_nodes, "tasks": n_tasks,
+        "drained_nodes": n_drained,
+        "replacements": len(replacements),
+        "decisions_per_sec": round(n_dec / dt, 1),
+        "tick_s": round(dt, 3),
+        "plan_s": round(planner.stats["plan_seconds"], 3),
+        "commit_s": round(sched.stats["commit_seconds"], 3),
+        "fallback_groups": planner.stats["groups_fallback"],
     }
 
 
 def main():
+    from swarmkit_tpu.models import Platform, PlacementPreference, Resources, SpreadOver
     from swarmkit_tpu.ops import TPUPlanner
 
-    # warm the kernel compile cache out of the timed region — must use the
-    # same node count so the padded N bucket (and thus the jit cache key)
-    # matches the measured run
-    run_path(N_NODES, 64, TPUPlanner())
+    tpu = TPUPlanner
 
-    trials = [run_path(N_NODES, N_TASKS, TPUPlanner()) for _ in range(TRIALS)]
-    ticks = sorted(t["tick_s"] for t in trials)
+    # warm the kernel compile cache for each (node-bucket, spread-level)
+    # jit signature used below, outside the timed regions
+    rack_pref = [PlacementPreference(
+        spread=SpreadOver(spread_descriptor="node.labels.rack"))]
+    warm = [(N_NODES, None)]
+    if not SKIP_CONFIGS:
+        warm += [(100, None), (5_000, None), (N_NODES, rack_pref)]
+    for n_nodes, prefs in warm:
+        store, svc, nodes, tasks = build_cluster(
+            n_nodes, 64, prefs=prefs)
+        one_tick(store, TPUPlanner())
+
+    # ---- headline: config 4 scale, median of TRIALS
+    trials = []
+    for _ in range(TRIALS):
+        store, svc, nodes, tasks = build_cluster(N_NODES, N_TASKS)
+        planner = TPUPlanner()
+        sched, n_dec, dt = one_tick(store, planner)
+        assert n_dec == N_TASKS
+        assert planner.stats["tasks_planned"] == N_TASKS, planner.stats
+        trials.append((dt, planner.stats["plan_seconds"],
+                       sched.stats["commit_seconds"]))
+        del store, svc, nodes, tasks, planner, sched
+        gc.collect()
+    ticks = sorted(t[0] for t in trials)
     med = statistics.median(ticks)
-    rep = min(trials, key=lambda t: abs(t["tick_s"] - med))
+    rep = min(trials, key=lambda t: abs(t[0] - med))
     tpu_dps = N_TASKS / med
 
     if SKIP_HOST:
-        host_dps = None
-        vs = 0.0
+        host_dps, vs = None, 0.0
     else:
-        host_trials = [run_path(N_NODES, BASELINE_TASKS, None)
-                       for _ in range(TRIALS)]
-        host_med = statistics.median(t["tick_s"] for t in host_trials)
-        host_dps = BASELINE_TASKS / host_med
+        host_ticks = []
+        for _ in range(TRIALS):
+            store, svc, nodes, tasks = build_cluster(N_NODES, BASELINE_TASKS)
+            _, n_dec, dt = one_tick(store, None)
+            host_ticks.append(dt)
+        host_dps = BASELINE_TASKS / statistics.median(host_ticks)
         vs = tpu_dps / host_dps
+
+    configs = {}
+    if not SKIP_CONFIGS:
+        configs["1_spread_1k_x_100"] = run_config(
+            "cfg1", 100, 1_000, tpu,
+            reservations=Resources())
+        configs["2_binpack_10k_x_1k"] = run_config(
+            "cfg2", 1_000, 10_000, tpu,
+            reservations=Resources(nano_cpus=2 * 10**9,
+                                   memory_bytes=2 << 30))
+        configs["3_constraints_50k_x_5k"] = run_config(
+            "cfg3", 5_000, 50_000, tpu,
+            node_labels=lambda i: {"tier": "web" if i % 2 else "db",
+                                   "rack": f"r{i % 40}"},
+            node_platform=lambda i: {"os": "linux" if i % 10 else "windows",
+                                     "architecture": "amd64"},
+            constraints=["node.labels.tier==web"],
+            platforms=[Platform(os="linux", architecture="amd64")],
+            expect=50_000)
+        configs["4_mixed_100k_x_10k"] = run_config(
+            "cfg4", N_NODES, N_TASKS, tpu,
+            prefs=[PlacementPreference(
+                spread=SpreadOver(spread_descriptor="node.labels.rack"))],
+            global_share=0.2)
+        configs["5_reschedule_storm"] = run_storm(tpu)
 
     print(json.dumps({
         "metric": f"scheduling decisions/sec, {N_TASKS // 1000}k tasks x "
@@ -151,14 +320,16 @@ def main():
         "vs_baseline": round(vs, 2),
         "tick_p50_s": round(med, 3),
         "tick_p99_s": round(ticks[-1], 3),
-        "plan_phase_s": round(rep["plan_s"], 3),
-        "commit_phase_s": round(rep["commit_s"], 3),
-        "plan_phase_decisions_per_sec": round(N_TASKS / rep["plan_s"], 1)
-        if rep["plan_s"] else None,
+        "plan_phase_s": round(rep[1], 3),
+        "commit_phase_s": round(rep[2], 3),
+        "plan_phase_decisions_per_sec": round(N_TASKS / rep[1], 1)
+        if rep[1] else None,
         "trials": TRIALS,
         "baseline": "host-oracle path, same store+commit framework "
                     "(Go toolchain unavailable; see BASELINE.md)",
-        "baseline_decisions_per_sec": round(host_dps, 1) if host_dps else None,
+        "baseline_decisions_per_sec": round(host_dps, 1) if host_dps
+        else None,
+        "configs": configs,
     }))
 
 

@@ -10,10 +10,18 @@ parity waivers): CSI volume mounts, node.ip constraints, named (non-
 discrete) generic resources in *node* inventories, and multi-level
 placement-preference trees.
 
-Densification is an O(N) pass over the scheduler's NodeSet mirror per
-group, then one fixed-shape kernel launch.  (Caching the group-independent
-arrays across the groups of one tick is a planned optimization; it needs a
-mirror dirty-counter because placements mutate node state between groups.)
+Small groups route to the host path: a device launch costs a fixed
+round-trip (measured adaptively; ~100ms over a tunneled TPU, far less
+locally) while the host oracle costs tens of microseconds per task, so
+below the measured break-even the pipeline seam simply keeps the group on
+the host.  Large groups — where the kernel's margin is 30x+ per decision —
+go to the device.
+
+Densification builds SoA arrays from the scheduler's NodeSet mirror.  The
+group-independent node columns are built once per tick (begin_tick), kept
+in sync by the apply phase's batched per-node updates, and invalidated
+whenever a host-path fallback (which mutates NodeInfos directly) occurs —
+so a tick of many small groups pays O(N) once, not O(N x groups).
 """
 
 from __future__ import annotations
@@ -93,6 +101,25 @@ def _fast_assign(task: Task, node_id: str, status) -> Task:
     return new
 
 
+def _probe_inputs():
+    nb = 1024
+    valid = np.ones(nb, bool)
+    nodes = NodeInputs(
+        valid=valid, ready=valid.copy(),
+        res_ok=valid.copy(), res_cap=np.full(nb, 8, np.int32),
+        svc_tasks=np.zeros(nb, np.int32), total_tasks=np.zeros(nb, np.int32),
+        failures=np.zeros(nb, np.int32), leaf=np.zeros(nb, np.int32),
+        os_hash=np.zeros((2, nb), np.int32),
+        arch_hash=np.zeros((2, nb), np.int32),
+        port_conflict=np.zeros(nb, bool), extra_mask=np.ones(nb, bool))
+    group = GroupInputs(
+        k=np.int32(8), con_hash=np.zeros((1, 2, nb), np.int32),
+        con_op=np.full(1, 2, np.int32), con_exp=np.zeros((1, 2), np.int32),
+        plat=np.full((1, 4), -1, np.int32), maxrep=np.int32(0),
+        port_limited=np.bool_(False))
+    return nodes, group
+
+
 class TPUPlanner:
     def __init__(self, plan_fn=None):
         # plan_fn(nodes: NodeInputs, group: GroupInputs, L: int) -> x[N];
@@ -101,7 +128,47 @@ class TPUPlanner:
         self._plan_fn = plan_fn or plan_group_jit
         self.last_explanation = ""
         self.stats = {"groups_planned": 0, "groups_fallback": 0,
+                      "groups_small_to_host": 0,
                       "tasks_planned": 0, "plan_seconds": 0.0}
+        # measured fixed launch overhead (dispatch + D2H round-trip on a
+        # minimal workload) vs. the host oracle's per-task cost: groups too
+        # small to amortize a device round-trip stay on the host path
+        self._launch_overhead = None
+        self.host_cost_per_task = 50e-6
+        # per-tick cache of group-independent node columns; built on
+        # begin_tick, updated incrementally by the apply phase, invalidated
+        # by host-path fallbacks (which mutate NodeInfos behind our back)
+        self._cache = None
+
+    # ------------------------------------------------------- per-tick caching
+
+    def begin_tick(self, sched) -> None:
+        self._in_tick = True
+        self._cache = self._build_columns(sched)
+
+    def end_tick(self) -> None:
+        self._in_tick = False
+        self._cache = None
+
+    def _build_columns(self, sched):
+        node_set = sched.node_set
+        infos: List[NodeInfo] = list(node_set.nodes.values())
+        n = len(infos)
+        nb = _n_bucket(max(n, 1))
+        valid = np.zeros(nb, bool)
+        ready = np.zeros(nb, bool)
+        cpu = np.zeros(nb, np.int64)
+        mem = np.zeros(nb, np.int64)
+        total = np.zeros(nb, np.int32)
+        valid[:n] = True
+        for i, info in enumerate(infos):
+            node = info.node
+            ready[i] = (node.status.state == NodeState.READY
+                        and node.spec.availability == NodeAvailability.ACTIVE)
+            cpu[i] = info.available_resources.nano_cpus
+            mem[i] = info.available_resources.memory_bytes
+            total[i] = info.active_tasks_count
+        return [infos, n, nb, valid, ready, cpu, mem, total]
 
     # explanation builders, pipeline order (matches kernel fail_counts rows
     # and the host filters' Explain strings — filter.go)
@@ -158,31 +225,42 @@ class TPUPlanner:
     def _densify(self, sched, t: Task):
         """Build (or reuse) the per-tick SoA arrays from the NodeSet mirror.
 
-        The node-level arrays (ready/cpu/mem/total) are group-independent;
-        per-service arrays (svc_tasks/failures) and constraint/platform/port
-        columns are group-dependent and built per group.
+        The node-level arrays (ready/cpu/mem/total, int64 for exact
+        resource math) are group-independent and cached across the groups
+        of one tick (begin_tick); per-service arrays (svc_tasks/failures)
+        and constraint/platform/port columns are group-dependent and built
+        per group.
         """
-        node_set = sched.node_set
-        infos: List[NodeInfo] = list(node_set.nodes.values())
-        n = len(infos)
-        nb = _n_bucket(max(n, 1))
+        if self._cache is not None:
+            return self._cache
+        cols = self._build_columns(sched)
+        if getattr(self, "_in_tick", False):
+            # re-cache after an invalidation: the fresh columns already
+            # reflect any host-path mutations
+            self._cache = cols
+        return cols
 
-        valid = np.zeros(nb, bool)
-        ready = np.zeros(nb, bool)
-        # int64 columns: resource comparisons/divisions stay exact (the
-        # reference compares integer nano-cpus/bytes; float32 would round)
-        cpu = np.zeros(nb, np.int64)
-        mem = np.zeros(nb, np.int64)
-        total = np.zeros(nb, np.int32)
-        valid[:n] = True
-        for i, info in enumerate(infos):
-            node = info.node
-            ready[i] = (node.status.state == NodeState.READY
-                        and node.spec.availability == NodeAvailability.ACTIVE)
-            cpu[i] = info.available_resources.nano_cpus
-            mem[i] = info.available_resources.memory_bytes
-            total[i] = info.active_tasks_count
-        return infos, n, nb, valid, ready, cpu, mem, total
+    def _measure_launch_overhead(self) -> None:
+        """Time a minimal warm launch: dispatch + compute-epsilon + D2H
+        round-trip.  ~100ms over a tunneled TPU, ~1ms locally; this is the
+        fixed cost a group must amortize to be worth the device."""
+        import time as _time
+        import jax as _jax
+        nodes_in, group_in = _probe_inputs()
+        try:
+            _jax.device_get(self._plan_fn(nodes_in, group_in, 1))  # compile
+            t0 = _time.perf_counter()
+            _jax.device_get(self._plan_fn(nodes_in, group_in, 1))
+            self._launch_overhead = _time.perf_counter() - t0
+        except Exception:
+            log.exception("launch-overhead probe failed")
+            self._launch_overhead = 0.0
+
+    def _fallback(self) -> bool:
+        # the host path will mutate NodeInfos the cached columns mirror
+        self.stats["groups_fallback"] += 1
+        self._cache = None
+        return False
 
     def _node_value(self, info: NodeInfo, key: str) -> str:
         node = info.node
@@ -215,7 +293,13 @@ class TPUPlanner:
                        decisions) -> bool:
         t = next(iter(task_group.values()))
         if not self._supported(t):
-            self.stats["groups_fallback"] += 1
+            return self._fallback()
+        if self._launch_overhead is None:
+            self._measure_launch_overhead()
+        if len(task_group) * self.host_cost_per_task \
+                < 0.8 * self._launch_overhead:
+            self.stats["groups_small_to_host"] += 1
+            self._cache = None   # host path mutates NodeInfos
             return False
 
         import time as _time
@@ -226,8 +310,7 @@ class TPUPlanner:
 
         k = len(task_group)
         if k > K_CLAMP:  # beyond the kernel's 32-bit budget (see kernel.py)
-            self.stats["groups_fallback"] += 1
-            return False
+            return self._fallback()
 
         # ---- per-service arrays
         svc_tasks = np.zeros(nb, np.int32)
@@ -249,8 +332,7 @@ class TPUPlanner:
                 constraints = []
         cc = _bucket(len(constraints), _CC_BUCKETS)
         if cc is None:
-            self.stats["groups_fallback"] += 1
-            return False
+            return self._fallback()
         con_hash = np.zeros((cc, 2, nb), np.int32)
         con_op = np.full(cc, 2, np.int32)     # 2 = disabled
         con_exp = np.zeros((cc, 2), np.int32)
@@ -271,8 +353,7 @@ class TPUPlanner:
         platforms = placement.platforms if placement else []
         pb = _bucket(max(len(platforms), 1), _P_BUCKETS)
         if pb is None:
-            self.stats["groups_fallback"] += 1
-            return False
+            return self._fallback()
         plat = np.full((pb, 4), -1, np.int32)
         for pi, p in enumerate(platforms):
             os_h = _split_hash(str_hash(p.os)) if p.os else (0, 0)
@@ -379,9 +460,12 @@ class TPUPlanner:
                 placement.max_replicas if placement else 0),
             port_limited=np.bool_(port_limited))
 
+        import jax as _jax
         x, fail_counts = self._plan_fn(nodes_in, group_in, L)
-        x = np.asarray(x)
-        self.last_explanation = self._explain(np.asarray(fail_counts))
+        # one round-trip for both outputs: D2H latency dominates over
+        # tunneled links, so never fetch twice
+        x, fail_counts = _jax.device_get((x, fail_counts))
+        self.last_explanation = self._explain(fail_counts)
         self.stats["plan_seconds"] += _time.perf_counter() - _plan_t0
 
         # ---- apply: expand per-node counts into per-task decisions
@@ -427,6 +511,7 @@ class TPUPlanner:
                 for task_id, _ in items[:placed]:
                     del task_group[task_id]
             service_id = t.service_id
+            cached = self._cache is not None
             for ni in np.nonzero(x)[0].tolist():
                 c = int(x[ni])
                 info = infos[ni]
@@ -436,8 +521,14 @@ class TPUPlanner:
                 ar = info.available_resources
                 ar.nano_cpus -= c * cpu_d
                 ar.memory_bytes -= c * mem_d
+                if cached:
+                    # keep the per-tick columns in sync for later groups
+                    total[ni] += c
+                    cpu[ni] -= c * cpu_d
+                    mem[ni] -= c * mem_d
         else:
             # generic resources / host ports need per-task claim bookkeeping
+            self._cache = None   # add_task mutates behind the columns
             for (task_id, task), node_i in zip(items, slots):
                 info = infos[node_i]
                 new_t = _fast_assign(task, info.id, shared_status)

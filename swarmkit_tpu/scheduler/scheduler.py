@@ -349,16 +349,24 @@ class Scheduler:
         self.unassigned_tasks.clear()
         one_off_tasks = groups.pop(None, {})
 
-        for group in groups.values():
-            # drop entries that were assigned out-of-band since enqueue
-            stale = [tid for tid, t in group.items() if t is None or t.node_id]
-            for tid in stale:
-                del group[tid]
-            if group:
-                self._schedule_task_group(group, decisions)
-        for t in one_off_tasks.values():
-            if t is not None and not t.node_id:
-                self._schedule_task_group({t.id: t}, decisions)
+        planner = self.batch_planner
+        if planner is not None and hasattr(planner, "begin_tick"):
+            planner.begin_tick(self)
+        try:
+            for group in groups.values():
+                # drop entries that were assigned out-of-band since enqueue
+                stale = [tid for tid, t in group.items()
+                         if t is None or t.node_id]
+                for tid in stale:
+                    del group[tid]
+                if group:
+                    self._schedule_task_group(group, decisions)
+            for t in one_off_tasks.values():
+                if t is not None and not t.node_id:
+                    self._schedule_task_group({t.id: t}, decisions)
+        finally:
+            if planner is not None and hasattr(planner, "end_tick"):
+                planner.end_tick()
 
         n_decisions = len(decisions)
         _, failed = self._apply_scheduling_decisions(decisions)
@@ -706,9 +714,14 @@ class Scheduler:
                           explanation: Optional[str] = None) -> None:
         if explanation is None:
             explanation = self.pipeline.explain()
+        # one service lookup per group, not per task: all tasks in a group
+        # share (service_id, spec_version)
+        services: Dict[str, Optional[Service]] = {}
         for t in task_group.values():
-            service = self.store.view(
-                lambda tx: tx.get(Service, t.service_id))
+            if t.service_id not in services:
+                services[t.service_id] = self.store.raw_get(
+                    Service, t.service_id)
+            service = services[t.service_id]
             if service is None:
                 continue
             new_t = t.copy()
