@@ -1,0 +1,266 @@
+"""swarmctl-equivalent operator CLI over the control API.
+
+Reference: swarmd/cmd/swarmctl (service/node/task/secret/config/cluster
+subcommands).
+
+``run_command(argv, api)`` parses and executes one command against a
+ControlAPI and returns the rendered output — the same surface the
+reference's cobra commands offer, minus the network hop (the gRPC client
+slots in where ``api`` is passed).  ``main()`` runs a self-contained
+single-node cluster for demos: swarmd-style bootstrap with an in-process
+manager, a fake executor agent, and an interactive prompt.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+from typing import List, Optional
+
+from .manager.controlapi import APIError, ControlAPI
+from .models.specs import ContainerSpec, SecretSpec, ConfigSpec, ServiceSpec
+from .models.types import (
+    Annotations, NodeAvailability, TaskState,
+)
+from .models import ReplicatedService, ServiceMode, TaskSpec
+
+
+def _fmt_table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+    lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    for row in rows:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    return "\n".join(lines)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="swarmctl", add_help=True)
+    sub = p.add_subparsers(dest="noun", required=True)
+
+    svc = sub.add_parser("service").add_subparsers(dest="verb",
+                                                  required=True)
+    create = svc.add_parser("create")
+    create.add_argument("--name", required=True)
+    create.add_argument("--image", required=True)
+    create.add_argument("--replicas", type=int, default=1)
+    create.add_argument("--constraint", action="append", default=[])
+    svc.add_parser("ls")
+    inspect = svc.add_parser("inspect")
+    inspect.add_argument("service")
+    scale = svc.add_parser("scale")
+    scale.add_argument("target")  # name=replicas
+    rm = svc.add_parser("rm")
+    rm.add_argument("service")
+
+    node = sub.add_parser("node").add_subparsers(dest="verb", required=True)
+    node.add_parser("ls")
+    drain = node.add_parser("drain")
+    drain.add_argument("node")
+    activate = node.add_parser("activate")
+    activate.add_argument("node")
+    nrm = node.add_parser("rm")
+    nrm.add_argument("node")
+    nrm.add_argument("--force", action="store_true")
+
+    task = sub.add_parser("task").add_subparsers(dest="verb", required=True)
+    tls = task.add_parser("ls")
+    tls.add_argument("--service", default="")
+
+    secret = sub.add_parser("secret").add_subparsers(dest="verb",
+                                                     required=True)
+    screate = secret.add_parser("create")
+    screate.add_argument("name")
+    screate.add_argument("data")
+    secret.add_parser("ls")
+    srm = secret.add_parser("rm")
+    srm.add_argument("secret")
+
+    config = sub.add_parser("config").add_subparsers(dest="verb",
+                                                     required=True)
+    ccreate = config.add_parser("create")
+    ccreate.add_argument("name")
+    ccreate.add_argument("data")
+    config.add_parser("ls")
+    crm = config.add_parser("rm")
+    crm.add_argument("config")
+    return p
+
+
+def _resolve(items, ident, what):
+    for obj in items:
+        if obj.id == ident or obj.id.startswith(ident):
+            return obj
+        name = getattr(obj.spec.annotations, "name", "")
+        if name == ident:
+            return obj
+    raise APIError(f"{what} {ident} not found")
+
+
+def run_command(argv: List[str], api: ControlAPI) -> str:
+    """Execute one CLI command; returns rendered output, raises APIError."""
+    args = _build_parser().parse_args(argv)
+
+    if args.noun == "service":
+        if args.verb == "create":
+            spec = ServiceSpec(
+                annotations=Annotations(name=args.name),
+                task=TaskSpec(container=ContainerSpec(image=args.image)),
+                mode=ServiceMode.REPLICATED,
+                replicated=ReplicatedService(replicas=args.replicas))
+            if args.constraint:
+                spec.task.placement.constraints = list(args.constraint)
+            service = api.create_service(spec)
+            return service.id
+        if args.verb == "ls":
+            rows = []
+            for s in api.list_services():
+                replicas = (str(s.spec.replicated.replicas)
+                            if s.spec.replicated else "-")
+                image = (s.spec.task.container.image
+                         if s.spec.task.container else "-")
+                rows.append([s.id[:12], s.spec.annotations.name,
+                             s.spec.mode.name.lower(), replicas, image])
+            return _fmt_table(["ID", "NAME", "MODE", "REPLICAS", "IMAGE"],
+                              rows)
+        if args.verb == "inspect":
+            s = _resolve(api.list_services(), args.service, "service")
+            tasks = api.list_tasks(service_id=s.id)
+            lines = [f"ID\t\t: {s.id}",
+                     f"Name\t\t: {s.spec.annotations.name}",
+                     f"Mode\t\t: {s.spec.mode.name.lower()}",
+                     f"Tasks\t\t: {len(tasks)}"]
+            return "\n".join(lines)
+        if args.verb == "scale":
+            name, _, replicas = args.target.partition("=")
+            if not replicas.isdigit():
+                raise APIError("scale target must be <service>=<replicas>")
+            s = _resolve(api.list_services(), name, "service")
+            spec = s.spec.copy()
+            spec.replicated = ReplicatedService(replicas=int(replicas))
+            api.update_service(s.id, s.meta.version.index, spec)
+            return f"{s.spec.annotations.name} scaled to {replicas}"
+        if args.verb == "rm":
+            s = _resolve(api.list_services(), args.service, "service")
+            api.remove_service(s.id)
+            return s.id
+
+    if args.noun == "node":
+        if args.verb == "ls":
+            rows = []
+            for n in api.list_nodes():
+                rows.append([
+                    n.id[:12], n.spec.annotations.name or
+                    (n.description.hostname if n.description else ""),
+                    n.status.state.name,
+                    n.spec.availability.name.lower(),
+                    "manager" if n.spec.desired_role else "worker"])
+            return _fmt_table(
+                ["ID", "NAME", "STATUS", "AVAILABILITY", "ROLE"], rows)
+        if args.verb in ("drain", "activate"):
+            n = _resolve(api.list_nodes(), args.node, "node")
+            spec = n.spec.copy()
+            spec.availability = (NodeAvailability.DRAIN
+                                 if args.verb == "drain"
+                                 else NodeAvailability.ACTIVE)
+            api.update_node(n.id, n.meta.version.index, spec)
+            return f"{n.id} " + ("drained" if args.verb == "drain" else "activated")
+        if args.verb == "rm":
+            n = _resolve(api.list_nodes(), args.node, "node")
+            api.remove_node(n.id, force=args.force)
+            return n.id
+
+    if args.noun == "task":
+        tasks = api.list_tasks()
+        if args.service:
+            s = _resolve(api.list_services(), args.service, "service")
+            tasks = api.list_tasks(service_id=s.id)
+        rows = []
+        for t in sorted(tasks, key=lambda t: (t.service_id, t.slot)):
+            rows.append([
+                t.id[:12],
+                f"{t.service_annotations.name or t.service_id[:8]}.{t.slot}",
+                t.status.state.name,
+                t.desired_state.name,
+                t.node_id[:12] if t.node_id else "-"])
+        return _fmt_table(
+            ["ID", "TASK", "STATUS", "DESIRED", "NODE"], rows)
+
+    if args.noun == "secret":
+        if args.verb == "create":
+            secret = api.create_secret(SecretSpec(
+                annotations=Annotations(name=args.name),
+                data=args.data.encode()))
+            return secret.id
+        if args.verb == "ls":
+            rows = [[s.id[:12], s.spec.annotations.name]
+                    for s in api.list_secrets()]
+            return _fmt_table(["ID", "NAME"], rows)
+        if args.verb == "rm":
+            s = _resolve(api.list_secrets(), args.secret, "secret")
+            api.remove_secret(s.id)
+            return s.id
+
+    if args.noun == "config":
+        if args.verb == "create":
+            config = api.create_config(ConfigSpec(
+                annotations=Annotations(name=args.name),
+                data=args.data.encode()))
+            return config.id
+        if args.verb == "ls":
+            rows = [[c.id[:12], c.spec.annotations.name]
+                    for c in api.list_configs()]
+            return _fmt_table(["ID", "NAME"], rows)
+        if args.verb == "rm":
+            c = _resolve(api.list_configs(), args.config, "config")
+            api.remove_config(c.id)
+            return c.id
+
+    raise APIError("unknown command")
+
+
+def main() -> None:   # pragma: no cover - interactive demo entry
+    """A self-contained single-node cluster with an interactive prompt
+    (swarmd + swarmctl in one process)."""
+    import tempfile
+
+    from .agent.testutils import TestExecutor
+    from .manager.dispatcher import Config_
+    from .manager.manager import Manager
+    from .node import Node
+
+    manager = Manager(dispatcher_config=Config_(heartbeat_period=1.0))
+    manager.run()
+    node = Node(TestExecutor(hostname="local"),
+                tempfile.mkdtemp(prefix="swarmkit-tpu-"))
+    token = manager.root_ca.join_token(0)
+    node.load_or_join(manager.ca_server, token)
+    node.start(manager.dispatcher, store=manager.store, hostname="local")
+    print("single-node cluster up; try: service create --name web "
+          "--image nginx --replicas 3 | service ls | task ls | quit")
+    try:
+        while True:
+            try:
+                line = input("swarmctl> ").strip()
+            except EOFError:
+                break
+            if not line:
+                continue
+            if line in ("quit", "exit"):
+                break
+            try:
+                print(run_command(shlex.split(line), manager.control_api))
+            except SystemExit:
+                pass
+            except APIError as e:
+                print(f"error: {e}")
+    finally:
+        node.stop()
+        manager.stop()
+
+
+if __name__ == "__main__":   # pragma: no cover
+    main()

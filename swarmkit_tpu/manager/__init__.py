@@ -3,6 +3,13 @@ from .controlapi import ControlAPI
 from .dispatcher import (
     AssignmentsMessage, AssignmentStream, DefaultConfig, Dispatcher,
 )
+from .keymanager import KeyManager
+from .logbroker import LogBroker, LogMessage, LogSelector
+from .manager import Manager
+from .metrics import Collector
+from .watchapi import WatchRequest, WatchServer
 
-__all__ = ["Allocator", "ControlAPI", "AssignmentsMessage", "AssignmentStream",
-           "DefaultConfig", "Dispatcher", "PortAllocator"]
+__all__ = ["Allocator", "AssignmentsMessage", "AssignmentStream",
+           "Collector", "ControlAPI", "DefaultConfig", "Dispatcher",
+           "KeyManager", "LogBroker", "LogMessage", "LogSelector",
+           "Manager", "PortAllocator", "WatchRequest", "WatchServer"]

@@ -1,0 +1,140 @@
+"""Log broker: connects log subscribers (users) to log publishers (agents).
+
+Reference: manager/logbroker/{broker.go,subscription.go}.
+
+``subscribe_logs`` registers a selector (services/tasks/nodes) and returns
+a stream; agents listening via ``listen_subscriptions`` are told which
+tasks to start publishing for, and push messages through ``publish_logs``,
+which the broker fans out to matching subscribers.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ..models.objects import Task
+from ..state.store import ByNode, ByService, MemoryStore
+from ..state.watch import Closed, Queue, Subscription
+from ..utils import new_id
+
+log = logging.getLogger("logbroker")
+
+
+@dataclass
+class LogSelector:
+    """reference: api/logbroker.proto LogSelector."""
+
+    service_ids: List[str] = field(default_factory=list)
+    task_ids: List[str] = field(default_factory=list)
+    node_ids: List[str] = field(default_factory=list)
+
+
+@dataclass
+class LogMessage:
+    task_id: str
+    node_id: str
+    stream: str       # "stdout" | "stderr"
+    data: bytes
+    timestamp: float = 0.0
+
+
+@dataclass
+class SubscriptionMessage:
+    """Told to agents: start/stop publishing for these tasks."""
+
+    id: str
+    selector: LogSelector
+    close: bool = False
+
+
+class _LogSubscription:
+    def __init__(self, broker: "LogBroker", selector: LogSelector,
+                 follow: bool):
+        self.id = new_id()
+        self.broker = broker
+        self.selector = selector
+        self.follow = follow
+        self.stream = Queue()
+        self._sub = self.stream.subscribe()
+
+    def matches(self, msg: LogMessage, task: Optional[Task]) -> bool:
+        sel = self.selector
+        if msg.task_id in sel.task_ids:
+            return True
+        if msg.node_id in sel.node_ids:
+            return True
+        if task is not None and task.service_id in sel.service_ids:
+            return True
+        return False
+
+    def get(self, timeout: Optional[float] = None) -> LogMessage:
+        return self._sub.get(timeout=timeout)
+
+    def close(self) -> None:
+        self.broker._remove_subscription(self)
+
+
+class LogBroker:
+    """reference: broker.go:52."""
+
+    def __init__(self, store: MemoryStore):
+        self.store = store
+        self._mu = threading.Lock()
+        self._subscriptions: Dict[str, _LogSubscription] = {}
+        self._listeners = Queue()   # agents following subscription changes
+
+    # ------------------------------------------------------------- consumers
+
+    def subscribe_logs(self, selector: LogSelector,
+                       follow: bool = True) -> _LogSubscription:
+        """reference: broker.go:223 SubscribeLogs."""
+        sub = _LogSubscription(self, selector, follow)
+        with self._mu:
+            self._subscriptions[sub.id] = sub
+        self._listeners.publish(SubscriptionMessage(sub.id, selector))
+        return sub
+
+    def _remove_subscription(self, sub: _LogSubscription) -> None:
+        with self._mu:
+            self._subscriptions.pop(sub.id, None)
+        self._listeners.publish(
+            SubscriptionMessage(sub.id, sub.selector, close=True))
+        sub.stream.close()
+
+    # -------------------------------------------------------------- agents
+
+    def listen_subscriptions(self) -> Subscription:
+        """Agents follow this to learn what to publish
+        (reference: broker.go:305); current subscriptions are replayed."""
+        listener = self._listeners.subscribe()
+        with self._mu:
+            current = list(self._subscriptions.values())
+        for sub in current:
+            listener._publish(SubscriptionMessage(sub.id, sub.selector))
+        return listener
+
+    def stop_listening(self, listener: Subscription) -> None:
+        self._listeners.unsubscribe(listener)
+
+    def publish_logs(self, messages: List[LogMessage]) -> None:
+        """Agent-side ingest (reference: broker.go:379 PublishLogs)."""
+        with self._mu:
+            subs = list(self._subscriptions.values())
+        if not subs:
+            return
+        for msg in messages:
+            task = self.store.raw_get(Task, msg.task_id)
+            for sub in subs:
+                if sub.matches(msg, task):
+                    sub.stream.publish(msg)
+
+    def close(self) -> None:
+        with self._mu:
+            subs = list(self._subscriptions.values())
+            self._subscriptions.clear()
+        for sub in subs:
+            sub.stream.close()
+        self._listeners.close()

@@ -1,0 +1,234 @@
+"""Manager composition: wires every control-plane component and drives
+their lifecycle from raft leadership.
+
+Reference: manager/manager.go — server registration :475-563, becomeLeader
+:927-1147 / becomeFollower :1150, default cluster/node creation :952-1011,
+role manager, cluster-spec watching :801.
+
+All control loops (allocator, scheduler, orchestrators, reaper, enforcers,
+keymanager, dispatcher) run **only on the raft leader**; followers keep
+only the store + raft + serving surfaces.  In standalone mode (no raft)
+the manager is always the leader.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..models.objects import Cluster, Node
+from ..models.specs import ClusterSpec
+from ..models.types import Annotations, NodeRole
+from ..ops import TPUPlanner
+from ..orchestrator import (
+    ConstraintEnforcer, GlobalOrchestrator, JobsOrchestrator,
+    ReplicatedOrchestrator, RestartSupervisor, TaskReaper, VolumeEnforcer,
+)
+from ..scheduler import Scheduler
+from ..security.ca import CAServer, RootCA
+from ..state.store import ByName, MemoryStore
+from ..utils import new_id
+from .allocator import Allocator
+from .controlapi import ControlAPI
+from .dispatcher import Config_ as DispatcherConfig, Dispatcher
+from .keymanager import KeyManager
+from .logbroker import LogBroker
+from .metrics import Collector
+from .watchapi import WatchServer
+
+log = logging.getLogger("manager")
+
+DEFAULT_CLUSTER_NAME = "default"
+
+
+class Manager:
+    def __init__(self, store: Optional[MemoryStore] = None,
+                 raft_node=None, node_id: Optional[str] = None,
+                 root_ca: Optional[RootCA] = None,
+                 dispatcher_config: Optional[DispatcherConfig] = None,
+                 use_device_scheduler: bool = True):
+        """``raft_node``: a state.raft.RaftNode already wired as the
+        store's proposer, or None for standalone single-manager mode."""
+        self.node_id = node_id or new_id()
+        self.raft = raft_node
+        self.store = store if store is not None else (
+            raft_node.store if raft_node is not None else MemoryStore())
+        self.root_ca = root_ca or RootCA()
+        self.use_device_scheduler = use_device_scheduler
+        self._dispatcher_config = dispatcher_config or DispatcherConfig()
+
+        # always-on surfaces (follower-safe; mutations go through the
+        # store's proposer so they fail on non-leaders)
+        self.control_api = ControlAPI(self.store)
+        self.watch_server = WatchServer(self.store)
+        self.logbroker = LogBroker(self.store)
+        self.ca_server = CAServer(self.root_ca)
+        self.collector = Collector(self.store)
+
+        # leader-only loops, created on become_leader
+        self.dispatcher: Optional[Dispatcher] = None
+        self.allocator: Optional[Allocator] = None
+        self.scheduler: Optional[Scheduler] = None
+        self.replicated: Optional[ReplicatedOrchestrator] = None
+        self.global_: Optional[GlobalOrchestrator] = None
+        self.jobs: Optional[JobsOrchestrator] = None
+        self.reaper: Optional[TaskReaper] = None
+        self.constraint_enforcer: Optional[ConstraintEnforcer] = None
+        self.volume_enforcer: Optional[VolumeEnforcer] = None
+        self.keymanager: Optional[KeyManager] = None
+
+        self._mu = threading.Lock()
+        self._running = False
+        self._is_leader = False
+        # leadership transitions apply strictly in arrival order: raft can
+        # flap faster than loops start/stop, and out-of-order application
+        # would leave a live leader with its control loops stopped
+        import queue as _queue
+        self._leadership_q: "_queue.Queue" = _queue.Queue()
+        self._leadership_worker: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def run(self) -> None:
+        self._running = True
+        self.collector.start()
+        if self.raft is None:
+            self._ensure_cluster_object()
+            self._become_leader()
+        else:
+            self.raft.on_leadership = self._on_leadership
+            if self.raft.is_leader:
+                self._on_leadership(True)
+        self._running = True
+
+    def stop(self) -> None:
+        self._running = False
+        self._become_follower()
+        self.collector.stop()
+        self.logbroker.close()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    def _on_leadership(self, leader: bool) -> None:
+        """raft leadership callback (runs on the raft thread)."""
+        with self._mu:
+            if self._leadership_worker is None \
+                    or not self._leadership_worker.is_alive():
+                self._leadership_worker = threading.Thread(
+                    target=self._leadership_loop, name="leadership",
+                    daemon=True)
+                self._leadership_worker.start()
+        self._leadership_q.put(leader)
+
+    def _leadership_loop(self) -> None:
+        import queue as _queue
+        while self._running or not self._leadership_q.empty():
+            try:
+                leader = self._leadership_q.get(timeout=0.5)
+            except _queue.Empty:
+                continue
+            # collapse bursts to the latest state
+            while True:
+                try:
+                    leader = self._leadership_q.get_nowait()
+                except _queue.Empty:
+                    break
+            try:
+                if leader:
+                    self._become_leader_safe()
+                else:
+                    self._become_follower()
+            except Exception:
+                log.exception("leadership transition failed")
+
+    def _become_leader_safe(self) -> None:
+        try:
+            self._ensure_cluster_object()
+            self._become_leader()
+        except Exception:
+            log.exception("becoming leader failed")
+
+    def _ensure_cluster_object(self) -> None:
+        """Create the default cluster (+ its join tokens) on first
+        leadership (reference: manager.go:952-1011)."""
+        def cb(tx):
+            existing = tx.find(Cluster, ByName(DEFAULT_CLUSTER_NAME))
+            if existing:
+                # adopt the persisted trust root: a fresh random RootCA
+                # would invalidate every issued cert and join token
+                state = existing[0].root_ca
+                if state is not None and state.ca_key:
+                    self.root_ca.key = state.ca_key
+                    self.root_ca.restore_join_tokens(state.join_tokens)
+                return
+            cluster = Cluster(
+                id=new_id(),
+                spec=ClusterSpec(annotations=Annotations(
+                    name=DEFAULT_CLUSTER_NAME)))
+            from ..models.objects import RootCAState
+            from ..models.types import JoinTokens
+            cluster.root_ca = RootCAState(
+                ca_key=self.root_ca.key,
+                join_tokens=JoinTokens(
+                    worker=self.root_ca.join_token(NodeRole.WORKER),
+                    manager=self.root_ca.join_token(NodeRole.MANAGER)))
+            tx.create(cluster)
+
+        try:
+            self.store.update(cb)
+        except Exception:
+            log.exception("ensuring cluster object failed")
+
+    def _become_leader(self) -> None:
+        """reference: manager.go:927 becomeLeader."""
+        with self._mu:
+            if self._is_leader:
+                return
+            self._is_leader = True
+            log.info("manager %s became leader", self.node_id[:8])
+            restarts = RestartSupervisor(self.store)
+            self.dispatcher = Dispatcher(self.store,
+                                         self._dispatcher_config)
+            self.dispatcher.run()
+            self.allocator = Allocator(self.store)
+            planner = TPUPlanner() if self.use_device_scheduler else None
+            self.scheduler = Scheduler(self.store, batch_planner=planner)
+            self.replicated = ReplicatedOrchestrator(self.store,
+                                                     restarts=restarts)
+            self.global_ = GlobalOrchestrator(self.store, restarts=restarts)
+            self.jobs = JobsOrchestrator(self.store, restarts=restarts)
+            self.reaper = TaskReaper(self.store)
+            self.constraint_enforcer = ConstraintEnforcer(self.store)
+            self.volume_enforcer = VolumeEnforcer(self.store)
+            self.keymanager = KeyManager(self.store)
+            for loop in (self.allocator, self.scheduler, self.replicated,
+                         self.global_, self.jobs, self.reaper,
+                         self.constraint_enforcer, self.volume_enforcer,
+                         self.keymanager):
+                loop.start()
+
+    def _become_follower(self) -> None:
+        """reference: manager.go:1150 becomeFollower."""
+        with self._mu:
+            if not self._is_leader:
+                return
+            self._is_leader = False
+            log.info("manager %s lost leadership", self.node_id[:8])
+            loops = [self.keymanager, self.volume_enforcer,
+                     self.constraint_enforcer, self.reaper, self.jobs,
+                     self.global_, self.replicated, self.scheduler,
+                     self.allocator, self.dispatcher]
+            for loop in loops:
+                if loop is not None:
+                    try:
+                        loop.stop()
+                    except Exception:
+                        log.exception("stopping %r failed", loop)
+            self.dispatcher = self.allocator = self.scheduler = None
+            self.replicated = self.global_ = self.jobs = None
+            self.reaper = None
+            self.constraint_enforcer = self.volume_enforcer = None
+            self.keymanager = None

@@ -1,0 +1,79 @@
+"""Watch API: an external event-stream surface over the store's queue.
+
+Reference: manager/watchapi/watch.go:16.
+
+Clients subscribe with per-kind/action/field filters and receive committed
+change events; ``include_old_object`` mirrors the reference's option, and a
+``resume_from_version`` replays nothing (like the reference, resume needs
+the raft log — ChangesBetween) but fails explicitly instead of silently.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Type
+
+from ..state.events import Event
+from ..state.store import MemoryStore
+from ..state.watch import Subscription
+
+
+@dataclass
+class WatchRequest:
+    kinds: List[Type] = field(default_factory=list)   # [] = all kinds
+    actions: List[str] = field(default_factory=list)  # [] = all actions
+    id_prefix: str = ""
+    name_prefix: str = ""
+    include_old_object: bool = False
+
+
+@dataclass
+class WatchEvent:
+    action: str
+    obj: Any
+    old: Optional[Any] = None
+
+
+class WatchServer:
+    def __init__(self, store: MemoryStore):
+        self.store = store
+
+    def watch(self, request: WatchRequest) -> "WatchStream":
+        kinds = tuple(request.kinds) or None
+        actions = set(request.actions) or None
+
+        def pred(ev) -> bool:
+            if not isinstance(ev, Event):
+                return False
+            if kinds is not None and not isinstance(ev.obj, kinds):
+                return False
+            if actions is not None and ev.action not in actions:
+                return False
+            if request.id_prefix and \
+                    not ev.obj.id.startswith(request.id_prefix):
+                return False
+            if request.name_prefix:
+                from ..state.store import _obj_name
+                if not _obj_name(ev.obj).lower().startswith(
+                        request.name_prefix.lower()):
+                    return False
+            return True
+
+        sub = self.store.queue.subscribe(pred)
+        return WatchStream(self, sub, request.include_old_object)
+
+
+class WatchStream:
+    def __init__(self, server: WatchServer, sub: Subscription,
+                 include_old: bool):
+        self._server = server
+        self._sub = sub
+        self._include_old = include_old
+
+    def get(self, timeout: Optional[float] = None) -> WatchEvent:
+        ev = self._sub.get(timeout=timeout)
+        return WatchEvent(ev.action, ev.obj,
+                          ev.old if self._include_old else None)
+
+    def close(self) -> None:
+        self._server.store.queue.unsubscribe(self._sub)

@@ -400,12 +400,16 @@ class Supervisor:
                 log.exception("delayed-start callback failed")
 
     def start_now_tx(self, tx: WriteTx, task_id: str) -> None:
-        """Move the task to desired RUNNING inside an open transaction."""
+        """Move the task out of its delayed state inside an open
+        transaction: job tasks (those carrying a job_iteration) run to
+        desired COMPLETE, service tasks to desired RUNNING (reference:
+        restart.go StartNow's JobIteration branch)."""
         t = tx.get(Task, task_id)
         if t is None or t.desired_state >= TaskState.RUNNING:
             return
         t = t.copy()
-        t.desired_state = TaskState.RUNNING
+        t.desired_state = (TaskState.COMPLETE if t.job_iteration is not None
+                           else TaskState.RUNNING)
         tx.update(t)
 
     def start_now(self, task_id: str) -> None:

@@ -1,0 +1,7 @@
+from .ca import (
+    CAServer, Certificate, InvalidCertificate, InvalidToken, KeyReadWriter,
+    RootCA, SecurityError,
+)
+
+__all__ = ["CAServer", "Certificate", "InvalidCertificate", "InvalidToken",
+           "KeyReadWriter", "RootCA", "SecurityError"]

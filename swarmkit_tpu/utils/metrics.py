@@ -1,0 +1,102 @@
+"""Minimal metrics registry: counters, gauges, and latency timers with a
+Prometheus-style text exposition.
+
+Reference role: docker/go-metrics as used by the reference (store tx/lock
+timers memory.go:84-112, dispatcher scheduling-delay timer
+dispatcher.go:72-77, object-count collector manager/metrics/collector.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+_QUANTILES = (0.5, 0.9, 0.99)
+
+
+class Timer:
+    """Latency accumulator with reservoir-free streaming quantiles
+    (bounded ring of recent observations)."""
+
+    def __init__(self, maxlen: int = 2048):
+        self._lock = threading.Lock()
+        self._buf: List[float] = []
+        self._maxlen = maxlen
+        self._i = 0
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += seconds
+            if len(self._buf) < self._maxlen:
+                self._buf.append(seconds)
+            else:
+                self._buf[self._i % self._maxlen] = seconds
+            self._i += 1
+
+    def time(self):
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                timer.observe(time.perf_counter() - self.t0)
+
+        return _Ctx()
+
+    def quantiles(self) -> Dict[float, float]:
+        with self._lock:
+            buf = sorted(self._buf)
+        if not buf:
+            return {q: 0.0 for q in _QUANTILES}
+        return {q: buf[min(len(buf) - 1, int(q * len(buf)))]
+                for q in _QUANTILES}
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = defaultdict(float)
+        self.gauges: Dict[str, float] = {}
+        self.timers: Dict[str, Timer] = {}
+
+    def counter(self, name: str, delta: float = 1.0) -> None:
+        with self._lock:
+            self.counters[name] += delta
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = value
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            t = self.timers.get(name)
+            if t is None:
+                t = self.timers[name] = Timer()
+            return t
+
+    def expose(self) -> str:
+        """Prometheus-style text format."""
+        lines: List[str] = []
+        with self._lock:
+            for name, v in sorted(self.counters.items()):
+                lines.append(f"{name}_total {v:g}")
+            for name, v in sorted(self.gauges.items()):
+                lines.append(f"{name} {v:g}")
+            timers = list(self.timers.items())
+        for name, t in sorted(timers):
+            for q, v in t.quantiles().items():
+                lines.append(f'{name}_seconds{{quantile="{q}"}} {v:.6f}')
+            lines.append(f"{name}_seconds_count {t.count}")
+            lines.append(f"{name}_seconds_sum {t.total:.6f}")
+        return "\n".join(lines) + "\n"
+
+
+registry = Registry()

@@ -1,0 +1,198 @@
+"""Jobs orchestrator + constraint/volume enforcer tests (reference:
+manager/orchestrator/jobs/*_test.go, constraintenforcer tests)."""
+
+import time
+
+import pytest
+
+from swarmkit_tpu.models import (
+    Annotations, Cluster, Node, Resources, ResourceRequirements, Service,
+    ServiceMode, ServiceSpec, Task, TaskSpec, TaskState, TaskStatus, Version,
+    Volume,
+)
+from swarmkit_tpu.models.specs import (
+    ClusterSpec, ContainerSpec, GlobalJob, ReplicatedJob, VolumeSpec,
+)
+from swarmkit_tpu.models.types import (
+    Placement, RestartPolicy, RestartCondition, VolumeAvailability,
+    VolumeAttachment, now,
+)
+from swarmkit_tpu.orchestrator import (
+    ConstraintEnforcer, JobsOrchestrator, VolumeEnforcer,
+)
+from swarmkit_tpu.state import ByService, MemoryStore
+from swarmkit_tpu.utils import new_id
+
+from test_orchestrator import make_node, poll
+
+
+@pytest.fixture
+def store():
+    s = MemoryStore()
+    s.update(lambda tx: tx.create(Cluster(
+        id=new_id(), spec=ClusterSpec(annotations=Annotations(
+            name="default")))))
+    yield s
+    s.close()
+
+
+def make_replicated_job(name, total, max_concurrent=0):
+    return Service(
+        id=new_id(),
+        spec=ServiceSpec(
+            annotations=Annotations(name=name),
+            task=TaskSpec(container=ContainerSpec(image="job:1"),
+                          restart=RestartPolicy(
+                              condition=RestartCondition.ON_FAILURE,
+                              delay=0.05)),
+            mode=ServiceMode.REPLICATED_JOB,
+            replicated_job=ReplicatedJob(total_completions=total,
+                                         max_concurrent=max_concurrent),
+        ),
+        spec_version=Version(index=1))
+
+
+def tasks_of(store, svc):
+    return store.view(lambda tx: tx.find(Task, ByService(svc.id)))
+
+
+def test_replicated_job_respects_max_concurrent(store):
+    orch = JobsOrchestrator(store)
+    orch.start()
+    try:
+        svc = make_replicated_job("batch", total=6, max_concurrent=2)
+        store.update(lambda tx: tx.create(svc))
+        poll(lambda: len(tasks_of(store, svc)) == 2,
+             msg="only max_concurrent tasks at once")
+        time.sleep(0.3)
+        assert len(tasks_of(store, svc)) == 2
+        got = tasks_of(store, svc)
+        assert {t.slot for t in got} == {0, 1}
+        assert all(t.desired_state == TaskState.COMPLETE for t in got)
+
+        # complete one: a new slot's task is created
+        def complete(tx, tid=got[0].id):
+            t = tx.get(Task, tid).copy()
+            t.status = TaskStatus(state=TaskState.COMPLETE, timestamp=now())
+            tx.update(t)
+        store.update(complete)
+        poll(lambda: len(tasks_of(store, svc)) == 3,
+             msg="a replacement completion should be scheduled")
+
+        # complete everything; no new tasks beyond total
+        def complete_all(tx):
+            for t in tx.find(Task, ByService(svc.id)):
+                if t.status.state != TaskState.COMPLETE:
+                    cur = t.copy()
+                    cur.status = TaskStatus(state=TaskState.COMPLETE,
+                                            timestamp=now())
+                    tx.update(cur)
+        for _ in range(4):
+            store.update(complete_all)
+            time.sleep(0.2)
+        got = tasks_of(store, svc)
+        completed = [t for t in got
+                     if t.status.state == TaskState.COMPLETE]
+        assert len(completed) == 6, \
+            f"6 completions expected, got {len(completed)}"
+        assert {t.slot for t in completed} == set(range(6))
+    finally:
+        orch.stop()
+
+
+def test_global_job_one_completion_per_node(store):
+    n1, n2 = make_node("n1"), make_node("n2")
+    store.update(lambda tx: (tx.create(n1), tx.create(n2)))
+    orch = JobsOrchestrator(store)
+    orch.start()
+    try:
+        svc = Service(
+            id=new_id(),
+            spec=ServiceSpec(
+                annotations=Annotations(name="gjob"),
+                task=TaskSpec(container=ContainerSpec(image="job:1")),
+                mode=ServiceMode.GLOBAL_JOB),
+            spec_version=Version(index=1))
+        store.update(lambda tx: tx.create(svc))
+        poll(lambda: len(tasks_of(store, svc)) == 2)
+        got = tasks_of(store, svc)
+        assert {t.node_id for t in got} == {n1.id, n2.id}
+        assert all(t.desired_state == TaskState.COMPLETE for t in got)
+
+        # new node -> one more run
+        n3 = make_node("n3")
+        store.update(lambda tx: tx.create(n3))
+        poll(lambda: len(tasks_of(store, svc)) == 3)
+    finally:
+        orch.stop()
+
+
+def test_constraint_enforcer_evicts_on_label_change(store):
+    node = make_node("n1", labels={"disk": "ssd"})
+    svc = Service(
+        id=new_id(),
+        spec=ServiceSpec(
+            annotations=Annotations(name="web"),
+            task=TaskSpec(
+                container=ContainerSpec(image="img"),
+                placement=Placement(constraints=["node.labels.disk==ssd"])),
+            mode=ServiceMode.REPLICATED),
+        spec_version=Version(index=1))
+    t = Task(id=new_id(), service_id=svc.id, slot=1, node_id=node.id,
+             desired_state=TaskState.RUNNING, spec=svc.spec.task,
+             spec_version=Version(index=1),
+             status=TaskStatus(state=TaskState.RUNNING))
+
+    def setup(tx):
+        tx.create(node)
+        tx.create(svc)
+        tx.create(t)
+    store.update(setup)
+
+    ce = ConstraintEnforcer(store)
+    ce.start()
+    try:
+        time.sleep(0.3)
+        assert store.view(lambda tx: tx.get(Task, t.id)).desired_state \
+            == TaskState.RUNNING, "compliant task must not be touched"
+
+        def drop_label(tx):
+            n = tx.get(Node, node.id).copy()
+            n.spec.annotations.labels = {}
+            tx.update(n)
+        store.update(drop_label)
+        poll(lambda: store.view(lambda tx: tx.get(Task, t.id))
+             .desired_state == TaskState.SHUTDOWN,
+             msg="noncompliant task should be shut down")
+    finally:
+        ce.stop()
+
+
+def test_volume_enforcer_removes_tasks_on_drained_volume(store):
+    vol = Volume(id=new_id(),
+                 spec=VolumeSpec(annotations=Annotations(name="vol1")))
+    t = Task(id=new_id(), service_id=new_id(), slot=1,
+             desired_state=TaskState.RUNNING,
+             spec=TaskSpec(container=ContainerSpec(image="img")),
+             status=TaskStatus(state=TaskState.RUNNING),
+             volumes=[VolumeAttachment(id=vol.id, source="v",
+                                       target="/data")])
+
+    def setup(tx):
+        tx.create(vol)
+        tx.create(t)
+    store.update(setup)
+
+    ve = VolumeEnforcer(store)
+    ve.start()
+    try:
+        def drain(tx):
+            v = tx.get(Volume, vol.id).copy()
+            v.spec.availability = VolumeAvailability.DRAIN
+            tx.update(v)
+        store.update(drain)
+        poll(lambda: store.view(lambda tx: tx.get(Task, t.id))
+             .desired_state == TaskState.REMOVE,
+             msg="task using drained volume should be removed")
+    finally:
+        ve.stop()

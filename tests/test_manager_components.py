@@ -1,0 +1,242 @@
+"""Manager composition, CA/join tokens, keymanager, logbroker, watch API,
+metrics, CLI — the remaining manager-side components."""
+
+import os
+import time
+
+import pytest
+
+from swarmkit_tpu.cli import run_command
+from swarmkit_tpu.manager import (
+    KeyManager, LogBroker, LogSelector, Manager, WatchRequest, WatchServer,
+)
+from swarmkit_tpu.manager.controlapi import APIError
+from swarmkit_tpu.manager.dispatcher import Config_
+from swarmkit_tpu.manager.keymanager import Config as KMConfig
+from swarmkit_tpu.manager.logbroker import LogMessage
+from swarmkit_tpu.models import (
+    Annotations, Cluster, Node, Service, Task, TaskState,
+)
+from swarmkit_tpu.models.specs import ClusterSpec
+from swarmkit_tpu.models.types import NodeRole
+from swarmkit_tpu.node import Node as ClusterNode
+from swarmkit_tpu.agent.testutils import TestExecutor
+from swarmkit_tpu.security import (
+    InvalidCertificate, InvalidToken, KeyReadWriter, RootCA,
+)
+from swarmkit_tpu.state import MemoryStore
+from swarmkit_tpu.utils import new_id
+
+from test_orchestrator import poll
+
+
+def fast_cfg():
+    return Config_(heartbeat_period=0.3, heartbeat_epsilon=0.02,
+                   process_updates_interval=0.02,
+                   assignment_batching_wait=0.02)
+
+
+# --------------------------------------------------------------- CA / tokens
+
+def test_join_tokens_and_certificates():
+    ca = RootCA()
+    worker_token = ca.join_token(NodeRole.WORKER)
+    manager_token = ca.join_token(NodeRole.MANAGER)
+    assert worker_token.startswith("SWMTKN-1-")
+    assert ca.role_for_token(worker_token) == NodeRole.WORKER
+    assert ca.role_for_token(manager_token) == NodeRole.MANAGER
+    with pytest.raises(InvalidToken):
+        ca.role_for_token("SWMTKN-1-bogus-bogus")
+    with pytest.raises(InvalidToken):
+        RootCA().role_for_token(worker_token)  # different cluster
+
+    cert = ca.issue("node1", NodeRole.WORKER)
+    ca.verify(cert)
+    cert.role = int(NodeRole.MANAGER)   # tamper
+    with pytest.raises(InvalidCertificate):
+        ca.verify(cert)
+
+    # token rotation invalidates old tokens
+    old = worker_token
+    new = ca.rotate_join_token(NodeRole.WORKER)
+    assert new != old
+    with pytest.raises(InvalidToken):
+        ca.role_for_token(old)
+    assert ca.role_for_token(new) == NodeRole.WORKER
+
+
+def test_key_read_writer_kek(tmp_path):
+    ca = RootCA()
+    cert = ca.issue("n1", NodeRole.WORKER)
+    path = os.path.join(tmp_path, "sub", "node.key")
+    rw = KeyReadWriter(path, kek=b"passphrase")
+    rw.write(cert, b"keydata")
+    got, key = rw.read()
+    assert got.node_id == "n1" and key == b"keydata"
+    # wrong KEK fails
+    with pytest.raises(Exception):
+        KeyReadWriter(path, kek=b"wrong").read()
+    # KEK rotation and unlock
+    rw.rotate_kek(None)
+    got2, _ = KeyReadWriter(path).read()
+    assert got2.node_id == "n1"
+
+
+# --------------------------------------------------------------- key manager
+
+def test_keymanager_rotation():
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(Cluster(
+        id=new_id(), spec=ClusterSpec(annotations=Annotations(
+            name="default")))))
+    km = KeyManager(store, KMConfig(rotation_interval=0.2))
+    km.start()
+    try:
+        def keys():
+            from swarmkit_tpu.state.store import ByName
+            c = store.view(lambda tx: tx.find(Cluster, ByName("default")))[0]
+            return c.network_bootstrap_keys, c.encryption_key_lamport_clock
+
+        poll(lambda: len(keys()[0]) >= 2, msg="keys created at startup")
+        first_clock = keys()[1]
+        poll(lambda: keys()[1] > first_clock, msg="rotation advances clock")
+        ks, _ = keys()
+        # at most 2 keys per subsystem (current + previous)
+        from collections import Counter
+        per = Counter(k.subsystem for k in ks)
+        assert all(v <= 2 for v in per.values()), per
+    finally:
+        km.stop()
+
+
+# ---------------------------------------------------------------- log broker
+
+def test_logbroker_fanout():
+    store = MemoryStore()
+    t = Task(id=new_id(), service_id="svcA", slot=1, node_id="n1")
+    store.update(lambda tx: tx.create(t))
+    broker = LogBroker(store)
+
+    listener = broker.listen_subscriptions()
+    sub = broker.subscribe_logs(LogSelector(service_ids=["svcA"]))
+    msg = listener.get(timeout=2)
+    assert msg.id == sub.id and not msg.close
+
+    broker.publish_logs([
+        LogMessage(task_id=t.id, node_id="n1", stream="stdout",
+                   data=b"hello"),
+        LogMessage(task_id="other", node_id="n2", stream="stdout",
+                   data=b"not for us"),
+    ])
+    got = sub.get(timeout=2)
+    assert got.data == b"hello"
+    import pytest as _p
+    with _p.raises(TimeoutError):
+        sub.get(timeout=0.1)
+
+    sub.close()
+    end = listener.get(timeout=2)
+    assert end.close
+    broker.close()
+
+
+# ----------------------------------------------------------------- watch api
+
+def test_watch_api_filters():
+    store = MemoryStore()
+    server = WatchServer(store)
+    stream = server.watch(WatchRequest(kinds=[Node], actions=["create"],
+                                       include_old_object=True))
+    n = Node(id=new_id())
+    t = Task(id=new_id())
+    store.update(lambda tx: (tx.create(n), tx.create(t)))
+    ev = stream.get(timeout=2)
+    assert ev.action == "create" and ev.obj.id == n.id
+    with pytest.raises(TimeoutError):
+        stream.get(timeout=0.1)   # the task event was filtered out
+    stream.close()
+
+
+# ------------------------------------------------- manager composition + CLI
+
+def test_manager_standalone_cluster_and_cli():
+    manager = Manager(dispatcher_config=fast_cfg(),
+                      use_device_scheduler=False)
+    manager.run()
+    node = None
+    try:
+        assert manager.is_leader
+        # the default cluster exists with join tokens
+        from swarmkit_tpu.state.store import ByName
+        cluster = manager.store.view(
+            lambda tx: tx.find(Cluster, ByName("default")))[0]
+        assert cluster.root_ca.join_tokens.worker.startswith("SWMTKN-1-")
+
+        # join a worker node through the CA with the worker token
+        import tempfile
+        node = ClusterNode(TestExecutor(hostname="w1"),
+                           tempfile.mkdtemp())
+        node.load_or_join(manager.ca_server,
+                          cluster.root_ca.join_tokens.worker)
+        assert node.role == NodeRole.WORKER
+        node.start(manager.dispatcher, store=manager.store, hostname="w1")
+
+        api = manager.control_api
+        out = run_command(["service", "create", "--name", "web",
+                           "--image", "nginx", "--replicas", "2"], api)
+        service_id = out.strip()
+
+        def running():
+            tasks = api.list_tasks(service_id=service_id)
+            return (len([t for t in tasks
+                         if t.desired_state == TaskState.RUNNING]) == 2
+                    and all(t.status.state == TaskState.RUNNING
+                            for t in tasks
+                            if t.desired_state == TaskState.RUNNING))
+        poll(running, timeout=20,
+             msg="service created via CLI should reach RUNNING")
+
+        ls = run_command(["service", "ls"], api)
+        assert "web" in ls and "nginx" in ls
+        tasks_out = run_command(["task", "ls"], api)
+        assert "RUNNING" in tasks_out and "web.1" in tasks_out
+        nodes_out = run_command(["node", "ls"], api)
+        assert "w1" in nodes_out and "READY" in nodes_out
+
+        run_command(["service", "scale", "web=4"], api)
+        poll(lambda: len([t for t in api.list_tasks(service_id=service_id)
+                          if t.desired_state == TaskState.RUNNING]) == 4,
+             timeout=20)
+
+        out = run_command(["service", "rm", "web"], api)
+        assert out == service_id
+        with pytest.raises(APIError):
+            run_command(["service", "inspect", "web"], api)
+
+        # metrics exposed
+        from swarmkit_tpu.utils.metrics import registry
+        text = registry.expose()
+        assert "swarm_manager_nodes" in text
+        assert "swarm_store_write_tx_latency_seconds_count" in text
+    finally:
+        if node is not None:
+            node.stop()
+        manager.stop()
+
+
+def test_manager_leadership_lifecycle():
+    """become_leader starts the loops; become_follower stops them."""
+    manager = Manager(dispatcher_config=fast_cfg(),
+                      use_device_scheduler=False)
+    manager.run()
+    try:
+        assert manager.scheduler is not None
+        assert manager.dispatcher is not None
+        manager._become_follower()
+        assert manager.scheduler is None
+        assert manager.dispatcher is None
+        assert not manager.is_leader
+        manager._become_leader()
+        assert manager.scheduler is not None
+    finally:
+        manager.stop()
