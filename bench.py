@@ -257,7 +257,9 @@ def main():
     for n_nodes, prefs in warm:
         store, svc, nodes, tasks = build_cluster(
             n_nodes, 64, prefs=prefs)
-        one_tick(store, TPUPlanner())
+        warm_planner = TPUPlanner()
+        warm_planner.enable_small_group_routing = False  # compile shapes
+        one_tick(store, warm_planner)
 
     # ---- headline: config 4 scale, median of TRIALS
     trials = []

@@ -241,16 +241,21 @@ def feasibility_and_capacity(nodes: NodeInputs, group: GroupInputs,
 
 def plan_group(nodes: NodeInputs, group: GroupInputs, L: int,
                reduce: Reduce = _identity,
-               idx_offset: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+               idx_offset: Optional[jnp.ndarray] = None,
+               hier: Tuple = ()) -> jnp.ndarray:
     """Place a task group: returns x i32[N] = tasks assigned per node.
 
-    Two-stage hierarchical water-fill (reference semantics:
-    scheduleNTasksOnSubtree equalizes branch totals, scheduleNTasksOnNodes
-    levels per-service counts):
+    Multi-stage hierarchical water-fill (reference semantics:
+    scheduleNTasksOnSubtree equalizes branch totals level by level,
+    scheduleNTasksOnNodes levels per-service counts):
 
-      stage A: branches (spread-preference leaves) — level branch task
-               totals, capacity = branch feasible capacity;
-      stage B: nodes within each branch — level per-service counts
+      stage A: walk the spread-preference tree top-down; at each level the
+               parent's allocation is water-filled over its child branches
+               (loads = branch service-task totals, capacity = branch
+               feasible capacity).  ``hier`` carries the upper levels as
+               (seg_nodes i32[N], parent i32[L_d]) pairs, top level first;
+               ``nodes.leaf`` is the deepest level with L segments.
+      stage B: nodes within each leaf — level per-service counts
                (failure-down-weighted), tie-broken by total tasks.
 
     Returns (x i32[N] tasks per node, fail_counts i32[7] per-filter failure
@@ -267,36 +272,55 @@ def plan_group(nodes: NodeInputs, group: GroupInputs, L: int,
                            jnp.clip(nodes.failures, 0, FAILURE_CLAMP), 0)
     e = svc + downweight * F_BIG
 
-    # ---- stage A: allocation across branches
+    # ---- stage A: allocation down the branch hierarchy
     # branch load counts every valid node's service tasks (feasible or not),
-    # matching nodeset.go:88-105 where tree.tasks accumulates per walked node.
-    # Sums ride f32 (overflow-safe, see docstring) and are clamped back into
-    # the int32 search ranges: loads above LOAD_CLAMP are equi-preferred,
-    # caps above k are equivalent to k.
+    # matching nodeset.go:88-105 where tree.tasks accumulates per walked
+    # node.  Sums ride f32 (overflow-safe, see docstring) and are clamped
+    # back into the int32 search ranges: loads above LOAD_CLAMP are
+    # equi-preferred, caps above k are equivalent to k.
     kk = jnp.minimum(group.k, K_CLAMP)
-    branch_load = jnp.minimum(
-        reduce(_seg_sum_f32(jnp.where(nodes.valid, svc, 0), nodes.leaf, L)),
-        float(LOAD_CLAMP)).astype(jnp.int32)
-    branch_cap = jnp.minimum(
-        reduce(_seg_sum_f32(cap, nodes.leaf, L)),
-        kk.astype(jnp.float32)).astype(jnp.int32)
+    svc_valid = jnp.where(nodes.valid, svc, 0)
 
-    if L == 1:
+    def branch_arrays(seg, n_segs):
+        load = jnp.minimum(
+            reduce(_seg_sum_f32(svc_valid, seg, n_segs)),
+            float(LOAD_CLAMP)).astype(jnp.int32)
+        bcap = jnp.minimum(
+            reduce(_seg_sum_f32(cap, seg, n_segs)),
+            kk.astype(jnp.float32)).astype(jnp.int32)
+        return load, bcap
+
+    # hier = (upper_levels, leaf_parent):
+    #   upper_levels — tuple of (seg_nodes i32[N], parent i32[L_d]) pairs,
+    #   top level first, for every level ABOVE the leaves;
+    #   leaf_parent  — i32[L] mapping each leaf to its upper-level branch.
+    upper_levels, leaf_parent = hier if hier else ((), None)
+
+    k_parent = kk.reshape(1)   # the root's allocation
+    parent_count = 1
+    for seg_nodes, parent in upper_levels:
+        L_d = parent.shape[0]
+        load, bcap = branch_arrays(seg_nodes, L_d)
+        # stage-A waterfills run on [L_d]-shaped, fully-replicated arrays
+        # (the reduce already happened in branch_arrays), so no cross-shard
+        # reduce is needed even under shard_map
+        k_parent = seg_waterfill(
+            e=load, cap=bcap, tie=jnp.arange(L_d, dtype=jnp.int32),
+            k_seg=k_parent, seg=parent, L=parent_count)
+        parent_count = L_d
+
+    if L == 1 and not upper_levels:
+        _, branch_cap = branch_arrays(nodes.leaf, 1)
         k_branch = jnp.minimum(kk, branch_cap)
     else:
-        bidx = jnp.arange(L, dtype=jnp.int32)
+        load, bcap = branch_arrays(nodes.leaf, L)
+        seg = leaf_parent if leaf_parent is not None \
+            else jnp.zeros((L,), jnp.int32)
         k_branch = seg_waterfill(
-            e=branch_load,
-            cap=branch_cap,
-            tie=bidx,
-            k_seg=kk.reshape(1),
-            seg=jnp.zeros((L,), jnp.int32),
-            L=1,
-            # stage A runs on [L]-shaped, fully-replicated arrays, so no
-            # cross-shard reduce is needed even under shard_map
-        )
+            e=load, cap=bcap, tie=jnp.arange(L, dtype=jnp.int32),
+            k_seg=k_parent, seg=seg, L=parent_count)
 
-    # ---- stage B: nodes within each branch
+    # ---- stage B: nodes within each leaf branch
     tie = (jnp.clip(nodes.total_tasks, 0, TOTAL_CLAMP) << IDX_BITS) | idx
     x = seg_waterfill(e=e, cap=cap, tie=tie, k_seg=k_branch,
                       seg=nodes.leaf, L=L, reduce=reduce)
@@ -304,6 +328,6 @@ def plan_group(nodes: NodeInputs, group: GroupInputs, L: int,
 
 
 @functools.partial(jax.jit, static_argnames=("L",))
-def plan_group_jit(nodes: NodeInputs, group: GroupInputs,
-                   L: int) -> jnp.ndarray:
-    return plan_group(nodes, group, L)
+def plan_group_jit(nodes: NodeInputs, group: GroupInputs, L: int,
+                   hier: Tuple = ()) -> jnp.ndarray:
+    return plan_group(nodes, group, L, hier=hier)

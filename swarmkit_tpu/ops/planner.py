@@ -7,8 +7,9 @@ the host (oracle) path.
 
 Falls back for features the device path does not model yet (documented
 parity waivers): CSI volume mounts, node.ip constraints, named (non-
-discrete) generic resources in *node* inventories, and multi-level
-placement-preference trees.
+discrete) generic resources in *node* inventories, and spread-preference
+trees deeper than 4 levels.  Multi-level spread (up to 4 levels) runs on
+device via the kernel's hierarchical stage-A water-fill.
 
 Small groups route to the host path: a device launch costs a fixed
 round-trip (measured adaptively; ~100ms over a tunneled TPU, far less
@@ -135,6 +136,9 @@ class TPUPlanner:
         # small to amortize a device round-trip stay on the host path
         self._launch_overhead = None
         self.host_cost_per_task = 50e-6
+        # set False to force every supported group onto the device (bench
+        # warm-ups, dryruns, deployments with local sub-ms D2H)
+        self.enable_small_group_routing = True
         # per-tick cache of group-independent node columns; built on
         # begin_tick, updated incrementally by the apply phase, invalidated
         # by host-path fallbacks (which mutate NodeInfos behind our back)
@@ -205,8 +209,8 @@ class TPUPlanner:
         placement = t.spec.placement
         if placement:
             prefs = [p for p in placement.preferences if p.spread]
-            if len(prefs) > 1:
-                return False  # multi-level spread tree: host path
+            if len(prefs) > 4:
+                return False  # absurdly deep spread tree: host path
             try:
                 for con in constraint_mod.parse(placement.constraints or []):
                     if con.key.lower() == "node.ip":
@@ -248,9 +252,9 @@ class TPUPlanner:
         import jax as _jax
         nodes_in, group_in = _probe_inputs()
         try:
-            _jax.device_get(self._plan_fn(nodes_in, group_in, 1))  # compile
+            _jax.device_get(self._plan_fn(nodes_in, group_in, 1, ()))
             t0 = _time.perf_counter()
-            _jax.device_get(self._plan_fn(nodes_in, group_in, 1))
+            _jax.device_get(self._plan_fn(nodes_in, group_in, 1, ()))
             self._launch_overhead = _time.perf_counter() - t0
         except Exception:
             log.exception("launch-overhead probe failed")
@@ -294,9 +298,10 @@ class TPUPlanner:
         t = next(iter(task_group.values()))
         if not self._supported(t):
             return self._fallback()
-        if self._launch_overhead is None:
+        if self.enable_small_group_routing and self._launch_overhead is None:
             self._measure_launch_overhead()
-        if len(task_group) * self.host_cost_per_task \
+        if self.enable_small_group_routing and \
+                len(task_group) * self.host_cost_per_task \
                 < 0.8 * self._launch_overhead:
             self.stats["groups_small_to_host"] += 1
             self._cache = None   # host path mutates NodeInfos
@@ -433,21 +438,46 @@ class TPUPlanner:
                 for i, info in enumerate(infos):
                     extra_mask[i] = pf.check(info)
 
-        # ---- spread preference -> leaf ids
+        # ---- spread preferences -> hierarchical branch ids.  Each level's
+        # segment id identifies the node's branch path prefix; the kernel's
+        # stage A equalizes allocations level by level (nodeset.go:50 tree)
         leaf = np.zeros(nb, np.int32)
         L = 1
+        hier = ()
         prefs = [p for p in (placement.preferences if placement else [])
                  if p.spread]
         if prefs:
-            descriptor = prefs[0].spread.spread_descriptor
-            values: Dict[str, int] = {}
-            for i, info in enumerate(infos):
-                from ..scheduler.nodeset import _pref_value
-                v = _pref_value(info, descriptor)
-                if v is None:
-                    v = ""
-                leaf[i] = values.setdefault(v, len(values))
-            L = _l_bucket(max(len(values), 1))
+            from ..scheduler.nodeset import _pref_value
+            descriptors = [p.spread.spread_descriptor for p in prefs]
+            depth = len(descriptors)
+            paths = []
+            for info in infos:
+                paths.append(tuple(_pref_value(info, d) or ""
+                                   for d in descriptors))
+            level_ids: List[Dict[tuple, int]] = []
+            seg_arrays: List[np.ndarray] = []
+            for di in range(depth):
+                ids: Dict[tuple, int] = {}
+                seg = np.zeros(nb, np.int32)
+                for i, path in enumerate(paths):
+                    seg[i] = ids.setdefault(path[:di + 1], len(ids))
+                level_ids.append(ids)
+                seg_arrays.append(seg)
+            leaf = seg_arrays[-1]
+            L = _l_bucket(max(len(level_ids[-1]), 1))
+            if depth > 1:
+                upper = []
+                for di in range(depth - 1):
+                    L_d = _l_bucket(max(len(level_ids[di]), 1))
+                    parent = np.zeros(L_d, np.int32)
+                    if di > 0:
+                        for path, cid in level_ids[di].items():
+                            parent[cid] = level_ids[di - 1][path[:di]]
+                    upper.append((seg_arrays[di], parent))
+                leaf_parent = np.zeros(L, np.int32)
+                for path, cid in level_ids[-1].items():
+                    leaf_parent[cid] = level_ids[-2][path[:depth - 1]]
+                hier = (tuple(upper), leaf_parent)
 
         nodes_in = NodeInputs(
             valid=valid, ready=ready, res_ok=res_ok, res_cap=res_cap,
@@ -461,7 +491,7 @@ class TPUPlanner:
             port_limited=np.bool_(port_limited))
 
         import jax as _jax
-        x, fail_counts = self._plan_fn(nodes_in, group_in, L)
+        x, fail_counts = self._plan_fn(nodes_in, group_in, L, hier)
         # one round-trip for both outputs: D2H latency dominates over
         # tunneled links, so never fetch twice
         x, fail_counts = _jax.device_get((x, fail_counts))

@@ -56,22 +56,29 @@ _GROUP_SPECS = GroupInputs(
 
 @functools.partial(jax.jit, static_argnames=("L", "mesh"))
 def plan_group_sharded(nodes: NodeInputs, group: GroupInputs, L: int,
-                       mesh: Mesh):
+                       mesh: Mesh, hier=()):
     """Sharded group placement: (x i32[N] sharded, fail_counts i32[7])."""
 
     n_devices = mesh.shape[NODE_AXIS]
     local_n = nodes.ready.shape[0] // n_devices
 
-    def kernel(nodes_l: NodeInputs, group_l: GroupInputs) -> jnp.ndarray:
+    def kernel(nodes_l: NodeInputs, group_l: GroupInputs, hier_l):
         reduce = lambda v: jax.lax.psum(v, NODE_AXIS)  # noqa: E731
         offset = jax.lax.axis_index(NODE_AXIS).astype(jnp.int32) * local_n
         return plan_group(nodes_l, group_l, L, reduce=reduce,
-                          idx_offset=offset)
+                          idx_offset=offset, hier=hier_l)
 
+    if hier:
+        upper, leaf_parent = hier
+        # node-dim segment columns shard with the nodes; the small
+        # branch-level parent maps are replicated
+        hier_specs = (tuple((P(NODE_AXIS), P()) for _ in upper), P())
+    else:
+        hier_specs = ()
     fn = shard_map(kernel, mesh=mesh,
-                   in_specs=(_NODE_SPECS, _GROUP_SPECS),
+                   in_specs=(_NODE_SPECS, _GROUP_SPECS, hier_specs),
                    out_specs=(P(NODE_AXIS), P()))
-    return fn(nodes, group)
+    return fn(nodes, group, hier)
 
 
 class ShardedPlanFn:
@@ -84,7 +91,8 @@ class ShardedPlanFn:
     def __init__(self, mesh: Optional[Mesh] = None):
         self.mesh = mesh or make_mesh()
 
-    def __call__(self, nodes: NodeInputs, group: GroupInputs, L: int):
+    def __call__(self, nodes: NodeInputs, group: GroupInputs, L: int,
+                 hier=()):
         d = self.mesh.shape[NODE_AXIS]
         n = nodes.ready.shape[0]
         if n % d:
@@ -96,4 +104,8 @@ class ShardedPlanFn:
 
             nodes = NodeInputs(*[pad_last(a) for a in nodes])
             group = group._replace(con_hash=pad_last(group.con_hash))
-        return plan_group_sharded(nodes, group, L, self.mesh)
+            if hier:
+                upper, leaf_parent = hier
+                hier = (tuple((pad_last(seg), parent)
+                              for seg, parent in upper), leaf_parent)
+        return plan_group_sharded(nodes, group, L, self.mesh, hier)

@@ -331,3 +331,106 @@ def test_sharded_matches_single_device():
     np.testing.assert_array_equal(np.asarray(counts_s),
                                   np.asarray(counts_m))
     assert np.asarray(single).sum() == 57
+
+
+def test_differential_multilevel_spread():
+    """2-level spread tree (dc -> rack) on the device path must match the
+    host oracle's per-branch distribution (nodeset.go:50-124 semantics)."""
+    nodes = []
+    for dc in ("east", "west"):
+        for rack in range(3):
+            for i in range(2):
+                nodes.append(make_ready_node(
+                    f"{dc}-r{rack}-{i}",
+                    labels={"dc": dc, "rack": f"{dc}-r{rack}"}))
+    prefs = [
+        PlacementPreference(spread=SpreadOver(
+            spread_descriptor="node.labels.dc")),
+        PlacementPreference(spread=SpreadOver(
+            spread_descriptor="node.labels.rack")),
+    ]
+    host_tasks, tpu_tasks = assert_distribution_matches(
+        nodes, None, lambda: make_service_with_tasks(24, prefs=prefs))
+    # exact per-dc and per-rack balance: 12 per dc, 4 per rack
+    node_by_id = {n.id: n for n in nodes}
+
+    def check(tasks):
+        per_dc, per_rack = {}, {}
+        for t in tasks:
+            labels = node_by_id.get(t.node_id)
+            if labels is None:
+                # ids differ between the two clusters; match by name prefix
+                continue
+            dc = labels.spec.annotations.labels["dc"]
+            rack = labels.spec.annotations.labels["rack"]
+            per_dc[dc] = per_dc.get(dc, 0) + 1
+            per_rack[rack] = per_rack.get(rack, 0) + 1
+        return per_dc, per_rack
+
+    per_dc, per_rack = check(host_tasks)
+    assert sorted(per_dc.values()) == [12, 12], per_dc
+    assert sorted(per_rack.values()) == [4] * 6, per_rack
+
+
+def test_multilevel_spread_unbalanced_branches():
+    """Per reference semantics, drained branches absorb less: one dc has
+    1 node, the other 3 — tasks still split per-dc first."""
+    nodes = [make_ready_node("solo", labels={"dc": "a", "rack": "a-r0"})]
+    for i in range(3):
+        nodes.append(make_ready_node(f"b{i}", labels={"dc": "b",
+                                                      "rack": f"b-r{i}"}))
+    prefs = [
+        PlacementPreference(spread=SpreadOver(
+            spread_descriptor="node.labels.dc")),
+        PlacementPreference(spread=SpreadOver(
+            spread_descriptor="node.labels.rack")),
+    ]
+    svc, tasks = make_service_with_tasks(8, prefs=prefs)
+    _, sched, got = run_schedulers(nodes, svc, tasks, planner=TPUPlanner())
+    assert sched.batch_planner.stats["groups_planned"] == 1
+    by_name = {n.id: n.spec.annotations.name for n in nodes}
+    per_dc = {}
+    for t in got:
+        dc = "a" if by_name[t.node_id] == "solo" else "b"
+        per_dc[dc] = per_dc.get(dc, 0) + 1
+    assert per_dc == {"a": 4, "b": 4}, per_dc
+
+
+def test_sharded_multilevel_matches_single_device():
+    import jax
+    from swarmkit_tpu.parallel import ShardedPlanFn, make_mesh
+    from swarmkit_tpu.ops.kernel import K_CLAMP
+
+    n, nb = 96, 128
+    rng = np.random.RandomState(1)
+    valid = np.zeros(nb, bool); valid[:n] = True
+    cpu = np.zeros(nb, np.int64); cpu[:n] = rng.randint(2, 9, n) * 10**9
+    dc = np.zeros(nb, np.int32); dc[:n] = rng.randint(0, 2, n)
+    rack = np.zeros(nb, np.int32)
+    rack[:n] = dc[:n] * 3 + rng.randint(0, 3, n)
+    nodes = NodeInputs(
+        valid=valid, ready=valid.copy(),
+        res_ok=valid & (cpu >= 10**9),
+        res_cap=np.clip(cpu // 10**9, 0, K_CLAMP).astype(np.int32),
+        svc_tasks=np.zeros(nb, np.int32),
+        total_tasks=np.zeros(nb, np.int32),
+        failures=np.zeros(nb, np.int32), leaf=rack,
+        os_hash=np.zeros((2, nb), np.int32),
+        arch_hash=np.zeros((2, nb), np.int32),
+        port_conflict=np.zeros(nb, bool), extra_mask=np.ones(nb, bool))
+    group = GroupInputs(
+        k=np.int32(41),
+        con_hash=np.zeros((1, 2, nb), np.int32),
+        con_op=np.full(1, 2, np.int32), con_exp=np.zeros((1, 2), np.int32),
+        plat=np.full((1, 4), -1, np.int32), maxrep=np.int32(0),
+        port_limited=np.bool_(False))
+    # hierarchy: 2 dcs (bucketed to 16), 6 racks (bucketed to 16)
+    parent0 = np.zeros(16, np.int32)
+    leaf_parent = np.zeros(16, np.int32)
+    leaf_parent[:6] = np.array([0, 0, 0, 1, 1, 1], np.int32)
+    hier = (((dc, parent0),), leaf_parent)
+
+    single, counts_s = plan_group_jit(nodes, group, 16, hier)
+    sharded, counts_m = ShardedPlanFn(make_mesh())(nodes, group, 16, hier)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(sharded))
+    assert np.asarray(single).sum() == 41
