@@ -123,9 +123,11 @@ def _probe_inputs():
 
 class TPUPlanner:
     def __init__(self, plan_fn=None):
-        # plan_fn(nodes: NodeInputs, group: GroupInputs, L: int) -> x[N];
-        # defaults to the single-device jit kernel; parallel/sharded.py
-        # provides a mesh-sharded implementation with the same signature.
+        # plan_fn(nodes: NodeInputs, group: GroupInputs, L: int, hier)
+        # -> (x i32[N], fail_counts i32[7]); hier carries multi-level
+        # spread segments (() for flat).  Defaults to the single-device jit
+        # kernel; parallel/sharded.py provides a mesh-sharded
+        # implementation with the same signature.
         self._plan_fn = plan_fn or plan_group_jit
         self.last_explanation = ""
         self.stats = {"groups_planned": 0, "groups_fallback": 0,
@@ -446,7 +448,16 @@ class TPUPlanner:
         hier = ()
         prefs = [p for p in (placement.preferences if placement else [])
                  if p.spread]
-        if prefs:
+        if len(prefs) == 1:
+            # the common flat case: one pass keyed by the raw value
+            from ..scheduler.nodeset import _pref_value
+            descriptor = prefs[0].spread.spread_descriptor
+            values: Dict[str, int] = {}
+            for i, info in enumerate(infos):
+                v = _pref_value(info, descriptor) or ""
+                leaf[i] = values.setdefault(v, len(values))
+            L = _l_bucket(max(len(values), 1))
+        elif prefs:
             from ..scheduler.nodeset import _pref_value
             descriptors = [p.spread.spread_descriptor for p in prefs]
             depth = len(descriptors)

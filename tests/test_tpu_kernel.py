@@ -119,8 +119,11 @@ def assert_distribution_matches(nodes, svc, make_tasks):
     _, _, host_tasks = run_schedulers(nodes, svc_o, tasks_o, planner=None)
     nodes2 = [n.copy() for n in nodes]
     svc_t, tasks_t = make_tasks()
+    planner = TPUPlanner()
+    # differentials must exercise the device regardless of launch latency
+    planner.enable_small_group_routing = False
     _, sched, tpu_tasks = run_schedulers(nodes2, svc_t, tasks_t,
-                                         planner=TPUPlanner())
+                                         planner=planner)
     assert sched.batch_planner.stats["groups_planned"] >= 1
 
     host_counts = per_node_counts(host_tasks)
@@ -386,7 +389,9 @@ def test_multilevel_spread_unbalanced_branches():
             spread_descriptor="node.labels.rack")),
     ]
     svc, tasks = make_service_with_tasks(8, prefs=prefs)
-    _, sched, got = run_schedulers(nodes, svc, tasks, planner=TPUPlanner())
+    planner = TPUPlanner()
+    planner.enable_small_group_routing = False
+    _, sched, got = run_schedulers(nodes, svc, tasks, planner=planner)
     assert sched.batch_planner.stats["groups_planned"] == 1
     by_name = {n.id: n.spec.annotations.name for n in nodes}
     per_dc = {}
