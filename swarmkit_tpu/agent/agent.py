@@ -29,12 +29,16 @@ log = logging.getLogger("agent")
 
 class Agent:
     def __init__(self, node_id: str, executor: Executor, client,
-                 description=None):
+                 description=None, task_db_path=None):
         self.node_id = node_id
         self.executor = executor
         self.client = client
         self.description = description
-        self.worker = Worker(executor, self._report)
+        db = None
+        if task_db_path:
+            from .storage import TaskDB
+            db = TaskDB(task_db_path)
+        self.worker = Worker(executor, self._report, db=db)
         self.session_id: Optional[str] = None
         self._stop = threading.Event()
         self._done = threading.Event()
@@ -67,6 +71,12 @@ class Agent:
                 target=self._reporter_loop, name="agent-reporter",
                 daemon=True)
             self._reporter_thread.start()
+            # resume persisted tasks only once the reporter machinery is
+            # fully constructed and running
+            try:
+                self.worker.init_from_db()
+            except Exception:
+                log.exception("resuming persisted tasks failed")
             while not self._stop.is_set():
                 try:
                     self._session()
@@ -136,6 +146,11 @@ class Agent:
     # -------------------------------------------------------------- reporter
 
     def _report(self, task_id: str, status: TaskStatus) -> None:
+        if self.worker.db is not None:
+            try:
+                self.worker.db.put_status(task_id, status)
+            except Exception:
+                log.exception("persisting task status failed")
         with self._statuses_cond:
             self._statuses[task_id] = status
             self._statuses_cond.notify()

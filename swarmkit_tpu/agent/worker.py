@@ -6,9 +6,9 @@ agent/dependency.go.
 The worker holds the node's view of its assigned tasks (plus the secrets/
 configs they reference) and runs one TaskManager per task.  A TaskManager
 drives the Controller FSM via exec.do_task in its own thread and reports
-every status change through the agent's reporter.  (The reference persists
-assigned tasks in bbolt so supervision survives daemon restarts —
-agent/storage.go; the host-side task DB lands with the serde layer.)
+every status change through the agent's reporter.  Assigned tasks persist
+in the agent task DB (storage.py) so supervision survives daemon restarts,
+like the reference's bbolt store (agent/storage.go).
 """
 
 from __future__ import annotations
@@ -111,14 +111,26 @@ class TaskManager:
 class Worker:
     """reference: agent/worker.go:30."""
 
-    def __init__(self, executor: exec_mod.Executor, reporter: Reporter):
+    def __init__(self, executor: exec_mod.Executor, reporter: Reporter,
+                 db=None):
         self.executor = executor
         self.reporter = reporter
+        self.db = db   # agent/storage.py TaskDB (optional persistence)
         self._mu = threading.Lock()
         self.task_managers: Dict[str, TaskManager] = {}
         self.secrets: Dict[str, Secret] = {}
         self.configs: Dict[str, Config] = {}
         self._closed = False
+
+    def init_from_db(self) -> None:
+        """Resume supervision of persisted assigned tasks before the
+        dispatcher reconnects (reference: worker.go:82 Init)."""
+        if self.db is None:
+            return
+        with self._mu:
+            for t in self.db.assigned_tasks():
+                if t.id not in self.task_managers:
+                    self._start_task(t)
 
     # ------------------------------------------------------------- applying
 
@@ -171,20 +183,46 @@ class Worker:
             (updated if action == "update" else removed).append(obj)
 
         assigned = set()
-        for t in updated:
-            assigned.add(t.id)
-            mgr = self.task_managers.get(t.id)
-            if mgr is not None:
-                mgr.update(t)
-            else:
-                self._start_task(t)
+        import contextlib
+        db_batch = self.db.batch() if self.db is not None \
+            else contextlib.nullcontext()
+        with db_batch:
+            for t in updated:
+                assigned.add(t.id)
+                if self.db is not None:
+                    # fold our last reported status back in so a restarted
+                    # agent does not re-run earlier lifecycle steps; DB
+                    # errors must never block task execution
+                    try:
+                        st = self.db.get_status(t.id)
+                        if st is not None and st.state > t.status.state:
+                            t = t.copy()
+                            t.status = st
+                        self.db.put_task(t)
+                    except Exception:
+                        log.exception("task DB write failed")
+                mgr = self.task_managers.get(t.id)
+                if mgr is not None:
+                    mgr.update(t)
+                else:
+                    self._start_task(t)
 
-        if full:
-            for task_id in list(self.task_managers):
-                if task_id not in assigned:
-                    self._close_manager(task_id)
-        for t in removed:
-            self._close_manager(t.id)
+            if full:
+                for task_id in list(self.task_managers):
+                    if task_id not in assigned:
+                        self._close_manager(task_id)
+                if self.db is not None:
+                    # also sweep persisted tasks that never got a manager
+                    # (e.g. controller resolution failed): a COMPLETE set
+                    # is the full truth
+                    try:
+                        for t in self.db.assigned_tasks():
+                            if t.id not in assigned:
+                                self.db.remove(t.id)
+                    except Exception:
+                        log.exception("task DB sweep failed")
+            for t in removed:
+                self._close_manager(t.id)
 
     def _start_task(self, t: Task) -> None:
         try:
@@ -201,6 +239,8 @@ class Worker:
         mgr = self.task_managers.pop(task_id, None)
         if mgr is not None:
             mgr.close()
+        if self.db is not None:
+            self.db.remove(task_id)
 
     def close(self) -> None:
         with self._mu:

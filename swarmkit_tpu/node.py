@@ -93,7 +93,9 @@ class Node:
                     tx.create(node_obj)
 
             store.update(cb)
-        self.agent = Agent(self.node_id, self.executor, dispatcher_client)
+        self.agent = Agent(
+            self.node_id, self.executor, dispatcher_client,
+            task_db_path=os.path.join(self.state_dir, "worker", "tasks.db"))
         self.agent.start()
 
     def stop(self) -> None:

@@ -1,0 +1,145 @@
+"""Templating, agent task DB persistence, and rafttool dumps."""
+
+import os
+
+import pytest
+
+from swarmkit_tpu import template
+from swarmkit_tpu.agent.storage import TaskDB
+from swarmkit_tpu.models import Task, TaskSpec, TaskState, TaskStatus
+from swarmkit_tpu.models.specs import ContainerSpec
+from swarmkit_tpu.models.types import Annotations, NodeDescription, Platform
+from swarmkit_tpu.utils import new_id
+
+
+def make_task():
+    return Task(
+        id="task1", service_id="svc1", slot=3, node_id="nodeA",
+        service_annotations=Annotations(name="web",
+                                        labels={"env": "prod"}),
+        spec=TaskSpec(container=ContainerSpec(
+            image="nginx",
+            env=["SERVICE={{.Service.Name}}", "SLOT={{.Task.Slot}}",
+                 "HOST={{.Node.Hostname}}", "PLAIN=1"],
+            hostname="{{.Service.Name}}-{{.Task.Slot}}",
+            labels={"which": "{{index .Service.Labels \"env\"}}"})),
+        status=TaskStatus(state=TaskState.ASSIGNED))
+
+
+def test_template_container_spec_expansion():
+    node = NodeDescription(hostname="host7",
+                           platform=Platform(os="linux",
+                                             architecture="amd64"))
+    out = template.expand_container_spec(node, make_task())
+    assert out.env == ["SERVICE=web", "SLOT=3", "HOST=host7", "PLAIN=1"]
+    assert out.hostname == "web-3"
+    assert out.labels == {"which": "prod"}
+    assert template.task_name(make_task()) == "web.3.task1"
+
+
+def test_template_payload_functions_and_errors():
+    t = make_task()
+    node = NodeDescription(hostname="host7")
+    data = b'user={{env "SERVICE"}} secret={{secret "tls"}}'
+    out = template.expand_secret_payload(
+        data, node, t, secrets={"tls": b"sekrit"})
+    assert out == b"user=web secret=sekrit"
+
+    with pytest.raises(template.TemplateError,
+                       match="secret not found: nope"):
+        template.expand_secret_payload(b'{{secret "nope"}}', node, t)
+    with pytest.raises(template.TemplateError,
+                       match="cannot evaluate template expression"):
+        template.expand_secret_payload(b"{{.Bogus.Path}}", node, t)
+    # binary payloads pass through untouched
+    blob = bytes(range(256))
+    assert template.expand_secret_payload(blob, node, t) == blob
+
+
+def test_task_db_roundtrip_and_resume(tmp_path):
+    path = os.path.join(tmp_path, "worker", "tasks.db")
+    db = TaskDB(path)
+    t = make_task()
+    db.put_task(t)
+    db.put_status(t.id, TaskStatus(state=TaskState.RUNNING,
+                                   message="started"))
+
+    # restart: a fresh TaskDB on the same path resumes the task with its
+    # last reported status folded in
+    db2 = TaskDB(path)
+    got = db2.assigned_tasks()
+    assert len(got) == 1
+    assert got[0].id == t.id
+    assert got[0].status.state == TaskState.RUNNING
+    db2.remove(t.id)
+    assert TaskDB(path).assigned_tasks() == []
+
+
+def test_agent_restart_resumes_tasks(tmp_path):
+    """Worker restarted with the same task DB resumes supervising without
+    any dispatcher contact (reference: worker.go Init)."""
+    from swarmkit_tpu.agent.testutils import TestExecutor
+    from swarmkit_tpu.agent.worker import Worker
+    import time
+
+    path = os.path.join(tmp_path, "tasks.db")
+    t = make_task()
+    t.desired_state = TaskState.RUNNING
+    reported = {}
+
+    db = TaskDB(path)
+    w = Worker(TestExecutor(), lambda tid, st: reported.update({tid: st}),
+               db=db)
+    w.assign([("update", "task", t)])
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if reported.get(t.id) and \
+                reported[t.id].state == TaskState.RUNNING:
+            break
+        time.sleep(0.05)
+    assert reported[t.id].state == TaskState.RUNNING
+    for tid, st in reported.items():
+        db.put_status(tid, st)
+    w.close()
+
+    # "restart": new worker from the same db, no assign() call
+    reported2 = {}
+    w2 = Worker(TestExecutor(),
+                lambda tid, st: reported2.update({tid: st}), db=TaskDB(path))
+    w2.init_from_db()
+    assert t.id in w2.task_managers, "persisted task must be resumed"
+    w2.close()
+
+
+def test_rafttool_dumps(tmp_path):
+    from swarmkit_tpu import rafttool
+    from swarmkit_tpu.state import MemoryStore
+    from swarmkit_tpu.state.raft import LocalNetwork, RaftLogger, RaftNode
+    from swarmkit_tpu.models import Node, NodeSpec
+
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_orchestrator import poll
+
+    state_dir = os.path.join(tmp_path, "m0")
+    net = LocalNetwork()
+    store = MemoryStore()
+    rn = RaftNode("m0", ["m0"], store, RaftLogger(state_dir), net,
+                  snapshot_interval=2)
+    store._proposer = rn
+    rn.start()
+    try:
+        poll(lambda: rn.is_leader, timeout=10)
+        for name in ("a", "b", "c", "d"):
+            store.update(lambda tx, name=name: tx.create(Node(
+                id=new_id(),
+                spec=NodeSpec(annotations=Annotations(name=name)))))
+    finally:
+        rn.stop()
+
+    wal = rafttool.dump_wal(state_dir)
+    assert any(r["type"] == "hardstate" for r in wal)
+    snap = rafttool.dump_snapshot(state_dir)
+    assert snap is not None and snap["objects"]["nodes"] >= 2
+    objs = rafttool.dump_objects(state_dir, "nodes")
+    assert all("id" in o for o in objs)
