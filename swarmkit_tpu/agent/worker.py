@@ -13,6 +13,7 @@ like the reference's bbolt store (agent/storage.go).
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
@@ -183,7 +184,6 @@ class Worker:
             (updated if action == "update" else removed).append(obj)
 
         assigned = set()
-        import contextlib
         db_batch = self.db.batch() if self.db is not None \
             else contextlib.nullcontext()
         with db_batch:

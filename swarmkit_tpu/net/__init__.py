@@ -1,0 +1,9 @@
+from .client import (
+    RemoteControlClient, RemoteDispatcherClient, issue_certificate,
+)
+from .raft_transport import TCPRaftTransport
+from .server import ManagerServer
+
+__all__ = ["ManagerServer", "RemoteControlClient",
+           "RemoteDispatcherClient", "TCPRaftTransport",
+           "issue_certificate"]

@@ -1,0 +1,276 @@
+"""Network clients: the dispatcher surface for remote agents and the
+control surface for remote swarmctl.
+
+``RemoteDispatcherClient`` implements exactly the client surface
+``agent.Agent`` consumes (register / heartbeat / open_assignments /
+update_task_status), so an agent runs against a remote manager unchanged.
+``RemoteControlClient`` mirrors ControlAPI methods for the CLI.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..manager.controlapi import (
+    AlreadyExists, APIError, FailedPrecondition, InvalidArgument, NotFound,
+)
+from ..models.objects import STORE_OBJECT_TYPES
+from ..models.types import TaskStatus
+from ..security.ca import Certificate
+from ..state import serde
+from ..state.watch import Closed
+from .wire import recv_frame, send_frame
+
+_COLLECTIONS = {t.collection: t for t in STORE_OBJECT_TYPES}
+
+_ERROR_TYPES = {
+    "invalid_argument": InvalidArgument,
+    "not_found": NotFound,
+    "already_exists": AlreadyExists,
+    "failed_precondition": FailedPrecondition,
+    "unauthenticated": PermissionError,
+}
+
+
+class RemoteError(Exception):
+    pass
+
+
+def _obj_in(data):
+    if data is None:
+        return None
+    cls = _COLLECTIONS[data["collection"]]
+    return serde.from_dict(cls, data["obj"])
+
+
+class _Connection:
+    def __init__(self, addr: Tuple[str, int],
+                 certificate: Optional[Certificate]):
+        self.addr = addr
+        self.certificate = certificate
+        self._sock: Optional[socket.socket] = None
+        self._mu = threading.Lock()
+        self._next_id = 0
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self.addr, timeout=10)
+        cert_data = (self.certificate.to_bytes().decode()
+                     if self.certificate else None)
+        send_frame(sock, {"id": 0, "method": "hello",
+                          "params": {"certificate": cert_data}})
+        resp = recv_frame(sock)
+        if resp.get("error"):
+            sock.close()
+            raise _ERROR_TYPES.get(resp.get("code"), RemoteError)(
+                resp["error"])
+        return sock
+
+    def call(self, method: str, params: Dict[str, Any]) -> Any:
+        with self._mu:
+            if self._sock is None:
+                self._sock = self._connect()
+            self._next_id += 1
+            rid = self._next_id
+            try:
+                send_frame(self._sock, {"id": rid, "method": method,
+                                        "params": params})
+                resp = recv_frame(self._sock)
+            except (ConnectionError, OSError):
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                raise
+            if resp.get("error"):
+                raise _ERROR_TYPES.get(resp.get("code"), RemoteError)(
+                    resp["error"])
+            return resp.get("result")
+
+    def close(self) -> None:
+        with self._mu:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+def issue_certificate(addr: Tuple[str, int], node_id: str,
+                      token: str) -> Certificate:
+    """Join: obtain a certificate with a join token (no cert needed)."""
+    conn = _Connection(addr, None)
+    try:
+        data = conn.call("issue_certificate",
+                         {"node_id": node_id, "token": token})
+        return Certificate.from_bytes(data.encode())
+    finally:
+        conn.close()
+
+
+class RemoteAssignmentStream:
+    """Client half of the assignments stream: reads pushed frames on a
+    dedicated connection; same get()/close() surface as the in-process
+    AssignmentStream."""
+
+    def __init__(self, conn_factory, node_id: str, session_id: str):
+        self._sock = conn_factory()
+        send_frame(self._sock, {"id": 1, "method": "open_assignments",
+                                "params": {"node_id": node_id,
+                                           "session_id": session_id}})
+        resp = recv_frame(self._sock)
+        if resp.get("error"):
+            self._sock.close()
+            raise RemoteError(resp["error"])
+        self._buf: List[Any] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self.error: Optional[Exception] = None
+        self._thread = threading.Thread(target=self._reader,
+                                        name="assignments-reader",
+                                        daemon=True)
+        self._thread.start()
+
+    def _reader(self) -> None:
+        from ..manager.dispatcher import AssignmentsMessage
+        try:
+            while True:
+                frame = recv_frame(self._sock)
+                if frame.get("push") == "closed":
+                    raise ConnectionError(frame.get("error")
+                                          or "stream closed by server")
+                changes = [
+                    (c["action"], c["kind"],
+                     serde.from_dict(_COLLECTIONS[
+                         "tasks" if c["kind"] == "task"
+                         else c["kind"] + "s"], c["obj"]))
+                    for c in frame["changes"]]
+                msg = AssignmentsMessage(frame["type"], frame["applies_to"],
+                                         frame["results_in"], changes)
+                with self._cond:
+                    self._buf.append(msg)
+                    self._cond.notify()
+        except Exception as e:
+            with self._cond:
+                self.error = e
+                self._closed = True
+                self._cond.notify_all()
+
+    def get(self, timeout: Optional[float] = None):
+        with self._cond:
+            if not self._buf and not self._closed:
+                self._cond.wait(timeout)
+            if self._buf:
+                return self._buf.pop(0)
+            if self._closed:
+                raise Closed()
+            raise TimeoutError()
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    def close(self, error: Optional[Exception] = None) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RemoteDispatcherClient:
+    """The agent's client surface over TCP."""
+
+    def __init__(self, addr: Tuple[str, int], certificate: Certificate):
+        self.addr = addr
+        self.certificate = certificate
+        self._conn = _Connection(addr, certificate)
+
+    def register(self, node_id: str, description=None):
+        result = self._conn.call("register", {
+            "node_id": node_id,
+            "description": serde.to_dict(description)
+            if description is not None else None})
+        return result["session_id"], result["period"]
+
+    def heartbeat(self, node_id: str, session_id: str) -> float:
+        return self._conn.call("heartbeat", {"node_id": node_id,
+                                             "session_id": session_id})
+
+    def update_task_status(self, node_id: str, session_id: str,
+                           updates: List[Tuple[str, TaskStatus]]) -> None:
+        self._conn.call("update_task_status", {
+            "node_id": node_id, "session_id": session_id,
+            "updates": [{"task_id": tid, "status": serde.to_dict(st)}
+                        for tid, st in updates]})
+
+    def open_assignments(self, node_id: str,
+                         session_id: str) -> RemoteAssignmentStream:
+        return RemoteAssignmentStream(
+            lambda: self._conn._connect(), node_id, session_id)
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class RemoteControlClient:
+    """ControlAPI surface over TCP (for remote swarmctl)."""
+
+    def __init__(self, addr: Tuple[str, int], certificate: Certificate):
+        self._conn = _Connection(addr, certificate)
+
+    def _call(self, method, **params):
+        return self._conn.call(f"control.{method}", params)
+
+    def create_service(self, spec):
+        return _obj_in(self._call("create_service",
+                                  spec=serde.to_dict(spec)))
+
+    def update_service(self, service_id, version, spec):
+        return _obj_in(self._call("update_service", service_id=service_id,
+                                  version=version,
+                                  spec=serde.to_dict(spec)))
+
+    def remove_service(self, service_id):
+        self._call("remove_service", service_id=service_id)
+
+    def get_service(self, service_id):
+        return _obj_in(self._call("get_service", service_id=service_id))
+
+    def list_services(self, name_prefix: str = ""):
+        return [_obj_in(o) for o in self._call(
+            "list_services", name_prefix=name_prefix)]
+
+    def list_nodes(self):
+        return [_obj_in(o) for o in self._call("list_nodes")]
+
+    def update_node(self, node_id, version, spec):
+        return _obj_in(self._call("update_node", node_id=node_id,
+                                  version=version,
+                                  spec=serde.to_dict(spec)))
+
+    def remove_node(self, node_id, force=False):
+        self._call("remove_node", node_id=node_id, force=force)
+
+    def list_tasks(self, service_id: str = "", node_id: str = ""):
+        return [_obj_in(o) for o in self._call(
+            "list_tasks", service_id=service_id, node_id=node_id)]
+
+    def create_secret(self, spec):
+        return _obj_in(self._call("create_secret",
+                                  spec=serde.to_dict(spec)))
+
+    def list_secrets(self):
+        return [_obj_in(o) for o in self._call("list_secrets")]
+
+    def remove_secret(self, secret_id):
+        self._call("remove_secret", secret_id=secret_id)
+
+    def close(self) -> None:
+        self._conn.close()

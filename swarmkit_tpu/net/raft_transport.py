@@ -1,0 +1,147 @@
+"""TCP raft transport: manager↔manager consensus traffic over the network.
+
+Reference: manager/state/raft/transport/ (per-peer gRPC streams with
+ordered delivery).  Each member listens on a TCP port; sends go over one
+persistent, ordered connection per peer with automatic reconnect.
+Implements the same two-method surface as transport.LocalNetwork, so
+RaftNode is transport-agnostic.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import logging
+import queue
+import socket
+import socketserver
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from ..state import serde
+from ..state.raft.core import Message
+from .wire import recv_frame, send_frame
+
+log = logging.getLogger("net.raft")
+
+
+class TCPRaftTransport:
+    def __init__(self, node_id: str, host: str = "127.0.0.1",
+                 port: int = 0, auth_key: Optional[bytes] = None):
+        """``auth_key``: shared cluster secret (the root CA key); peers
+        must open connections with a matching HMAC hello or their frames
+        are rejected — consensus traffic is manager-only."""
+        self.node_id = node_id
+        self.auth_key = auth_key
+        self._handler: Optional[Callable[[Message], None]] = None
+        self._peers: Dict[str, Tuple[str, int]] = {}
+        self._send_queues: Dict[str, "queue.Queue"] = {}
+        self._senders: Dict[str, threading.Thread] = {}
+        self._stop = threading.Event()
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    if outer.auth_key is not None:
+                        hello = recv_frame(self.request)
+                        sig = hello.get("hello", "")
+                        if not hmac.compare_digest(sig, outer._hello_sig()):
+                            log.warning("rejected unauthenticated raft peer")
+                            return
+                    while True:
+                        frame = recv_frame(self.request)
+                        handler = outer._handler
+                        if handler is not None:
+                            handler(serde.from_dict(Message, frame))
+                except (ConnectionError, OSError):
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), _Handler)
+        self.addr = self._server.server_address
+        threading.Thread(target=self._server.serve_forever,
+                         name=f"raft-listen-{node_id}",
+                         daemon=True).start()
+
+    def _hello_sig(self) -> str:
+        return hmac.new(self.auth_key or b"", b"raft-transport-v1",
+                        hashlib.sha256).hexdigest()
+
+    # ------------------------------------------------------------- topology
+
+    def set_peer(self, node_id: str, addr: Tuple[str, int]) -> None:
+        """reference: transport.go:157 AddPeer / UpdatePeer."""
+        self._peers[node_id] = tuple(addr)
+
+    def remove_peer(self, node_id: str) -> None:
+        self._peers.pop(node_id, None)
+        q = self._send_queues.pop(node_id, None)
+        if q is not None:
+            q.put(None)
+
+    # ------------------------------------------------------ RaftNode surface
+
+    def register(self, node_id: str,
+                 handler: Callable[[Message], None]) -> None:
+        self._handler = handler
+
+    def unregister(self, node_id: str) -> None:
+        self._handler = None
+        self._stop.set()
+        for q in self._send_queues.values():
+            q.put(None)
+        self._server.shutdown()
+        self._server.server_close()
+
+    def send(self, msg: Message) -> None:
+        """Ordered, best-effort delivery per peer (raft tolerates loss)."""
+        q = self._send_queues.get(msg.dst)
+        if q is None:
+            if msg.dst not in self._peers:
+                return
+            q = self._send_queues.setdefault(msg.dst, queue.Queue(
+                maxsize=1024))
+            t = threading.Thread(target=self._sender_loop,
+                                 args=(msg.dst, q),
+                                 name=f"raft-send-{msg.dst}", daemon=True)
+            self._senders[msg.dst] = t
+            t.start()
+        try:
+            q.put_nowait(msg)
+        except queue.Full:
+            pass  # drop under backpressure; raft retries
+
+    def _sender_loop(self, peer: str, q: "queue.Queue") -> None:
+        sock: Optional[socket.socket] = None
+        while not self._stop.is_set():
+            msg = q.get()
+            if msg is None:
+                break
+            addr = self._peers.get(peer)
+            if addr is None:
+                continue
+            for attempt in (1, 2):
+                try:
+                    if sock is None:
+                        sock = socket.create_connection(addr, timeout=5)
+                        if self.auth_key is not None:
+                            send_frame(sock, {"hello": self._hello_sig()})
+                    send_frame(sock, serde.to_dict(msg))
+                    break
+                except (ConnectionError, OSError):
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        sock = None
+                    # second attempt reconnects; then drop the message
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass

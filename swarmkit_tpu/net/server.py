@@ -1,0 +1,272 @@
+"""Manager network server: dispatcher + control API + CA over TCP.
+
+Reference role: the manager's gRPC servers (manager.go:475-563) — the
+worker-facing Dispatcher service, the user-facing Control service, and the
+NodeCA issuance service — behind certificate-verified connections.
+
+One thread per connection (the control plane is low-rate); the assignments
+stream switches its connection into push mode.  Certificate verification
+gates every method except ``issue_certificate`` (which is gated by a join
+token instead, like the reference's token-gated NodeCA.IssueNodeCertificate).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import threading
+from typing import Any, Dict, Optional
+
+from ..models.objects import STORE_OBJECT_TYPES
+from ..models.specs import NodeSpec, SecretSpec, ServiceSpec
+from ..models.types import NodeDescription, TaskStatus
+from ..security.ca import Certificate, InvalidCertificate, SecurityError
+from ..state import serde
+from ..state.watch import Closed
+from .wire import recv_frame, send_frame
+
+log = logging.getLogger("net.server")
+
+
+class ManagerServer:
+    def __init__(self, manager, host: str = "127.0.0.1", port: int = 0):
+        self.manager = manager
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                outer._handle_conn(self.request)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), _Handler)
+        self.addr = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="manager-server", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ---------------------------------------------------------- connections
+
+    def _handle_conn(self, sock: socket.socket) -> None:
+        cert: Optional[Certificate] = None
+        try:
+            hello = recv_frame(sock)
+            if hello.get("method") != "hello":
+                send_frame(sock, {"id": hello.get("id"),
+                                  "error": "expected hello"})
+                return
+            cert_data = hello.get("params", {}).get("certificate")
+            if cert_data:
+                try:
+                    cert = Certificate.from_bytes(cert_data.encode())
+                    self.manager.root_ca.verify(cert)
+                except SecurityError as e:
+                    send_frame(sock, {"id": hello.get("id"),
+                                      "error": str(e),
+                                      "code": "unauthenticated"})
+                    return
+            send_frame(sock, {"id": hello.get("id"), "result": "ok"})
+
+            while True:
+                req = recv_frame(sock)
+                method = req.get("method", "")
+                params = req.get("params", {}) or {}
+                rid = req.get("id")
+                if method == "open_assignments":
+                    # stream mode: this connection now only pushes
+                    try:
+                        self._stream_assignments(sock, cert, params, rid)
+                    except (ConnectionError, OSError):
+                        pass
+                    except Exception as e:
+                        send_frame(sock, {
+                            "id": rid, "error": str(e),
+                            "code": getattr(e, "code", "internal")})
+                    return
+                try:
+                    result = self._dispatch(method, params, cert)
+                    send_frame(sock, {"id": rid, "result": result})
+                except Exception as e:
+                    send_frame(sock, {"id": rid, "error": str(e),
+                                      "code": getattr(e, "code", "internal")})
+        except (ConnectionError, OSError):
+            pass
+        except Exception:
+            log.exception("connection handler failed")
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _require_cert(cert: Optional[Certificate], node_id: str = "") -> None:
+        if cert is None:
+            raise SecurityError("certificate required")
+        if node_id and cert.node_id != node_id:
+            raise SecurityError("certificate/node mismatch")
+
+    # -------------------------------------------------------------- methods
+
+    def _dispatch(self, method: str, params: Dict[str, Any],
+                  cert: Optional[Certificate]) -> Any:
+        m = self.manager
+
+        # ---- CA (token-gated, no cert needed)
+        if method == "issue_certificate":
+            issued = m.ca_server.issue_node_certificate(
+                params["node_id"], params["token"])
+            return issued.to_bytes().decode()
+
+        # ---- dispatcher surface (cert-gated to the calling node)
+        if method == "register":
+            self._require_cert(cert, params["node_id"])
+            description = serde.from_dict(
+                NodeDescription, params.get("description"))
+            self._ensure_node_registered(params["node_id"], cert,
+                                         description)
+            session, period = m.dispatcher.register(
+                params["node_id"], description=description)
+            return {"session_id": session, "period": period}
+        if method == "heartbeat":
+            self._require_cert(cert, params["node_id"])
+            return m.dispatcher.heartbeat(params["node_id"],
+                                          params["session_id"])
+        if method == "update_task_status":
+            self._require_cert(cert, params["node_id"])
+            updates = [(u["task_id"],
+                        serde.from_dict(TaskStatus, u["status"]))
+                       for u in params["updates"]]
+            m.dispatcher.update_task_status(
+                params["node_id"], params["session_id"], updates)
+            return "ok"
+
+        # ---- control surface (cert-gated; the reference gates on the
+        # manager/user role — here any valid cluster cert)
+        api = m.control_api
+        if method.startswith("control."):
+            self._require_cert(cert)
+            return self._dispatch_control(api, method[len("control."):],
+                                          params)
+        raise ValueError(f"unknown method {method!r}")
+
+    def _ensure_node_registered(self, node_id: str, cert: Certificate,
+                                description) -> None:
+        """Self-registration of joined nodes (in-process mode does this in
+        Node.start; over the network the manager does it on first
+        register, reference: dispatcher register + node store)."""
+        from ..models.objects import Node as NodeObject
+        from ..models.types import Annotations, NodeRole
+
+        def cb(tx):
+            if tx.get(NodeObject, node_id) is not None:
+                return
+            name = description.hostname if description else node_id[:8]
+            tx.create(NodeObject(
+                id=node_id,
+                spec=NodeSpec(annotations=Annotations(name=name),
+                              desired_role=NodeRole(cert.role)),
+                description=description,
+                role=int(cert.role)))
+
+        self.manager.store.update(cb)
+
+    def _dispatch_control(self, api, method: str,
+                          params: Dict[str, Any]) -> Any:
+        def obj_out(obj):
+            return None if obj is None else {
+                "collection": obj.collection, "obj": serde.to_dict(obj)}
+
+        if method == "create_service":
+            return obj_out(api.create_service(
+                serde.from_dict(ServiceSpec, params["spec"])))
+        if method == "update_service":
+            return obj_out(api.update_service(
+                params["service_id"], params["version"],
+                serde.from_dict(ServiceSpec, params["spec"])))
+        if method == "remove_service":
+            api.remove_service(params["service_id"])
+            return "ok"
+        if method == "get_service":
+            return obj_out(api.get_service(params["service_id"]))
+        if method == "list_services":
+            return [obj_out(s) for s in api.list_services(
+                name_prefix=params.get("name_prefix", ""))]
+        if method == "list_nodes":
+            return [obj_out(n) for n in api.list_nodes()]
+        if method == "update_node":
+            return obj_out(api.update_node(
+                params["node_id"], params["version"],
+                serde.from_dict(NodeSpec, params["spec"])))
+        if method == "remove_node":
+            api.remove_node(params["node_id"],
+                            force=params.get("force", False))
+            return "ok"
+        if method == "list_tasks":
+            return [obj_out(t) for t in api.list_tasks(
+                service_id=params.get("service_id", ""),
+                node_id=params.get("node_id", ""))]
+        if method == "create_secret":
+            return obj_out(api.create_secret(
+                serde.from_dict(SecretSpec, params["spec"])))
+        if method == "list_secrets":
+            return [obj_out(s) for s in api.list_secrets()]
+        if method == "remove_secret":
+            api.remove_secret(params["secret_id"])
+            return "ok"
+        raise ValueError(f"unknown control method {method!r}")
+
+    # ------------------------------------------------------------- streaming
+
+    def _stream_assignments(self, sock: socket.socket,
+                            cert: Optional[Certificate],
+                            params: Dict[str, Any], rid) -> None:
+        self._require_cert(cert, params["node_id"])
+        stream = self.manager.dispatcher.open_assignments(
+            params["node_id"], params["session_id"])
+        send_frame(sock, {"id": rid, "result": "streaming"})
+        try:
+            while True:
+                try:
+                    msg = stream.get(timeout=0.5)
+                except TimeoutError:
+                    # liveness probe: a vanished peer would otherwise leak
+                    # this thread + its dispatcher stream until the next
+                    # push attempt
+                    sock.setblocking(False)
+                    try:
+                        if sock.recv(1) == b"":
+                            return  # peer closed
+                    except (BlockingIOError, InterruptedError):
+                        pass
+                    finally:
+                        sock.setblocking(True)
+                    continue
+                except Closed:
+                    send_frame(sock, {"push": "closed",
+                                      "error": str(stream.error or "")})
+                    return
+                send_frame(sock, {
+                    "push": "assignments",
+                    "type": msg.type,
+                    "applies_to": msg.applies_to,
+                    "results_in": msg.results_in,
+                    "changes": [
+                        {"action": action, "kind": kind,
+                         "obj": serde.to_dict(obj)}
+                        for action, kind, obj in msg.changes],
+                })
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            stream.close()

@@ -24,7 +24,7 @@ def dump_wal(state_dir: str) -> List[dict]:
     """Decoded WAL records: hard-state changes and entries with their
     store actions."""
     logger = RaftLogger(state_dir)
-    hs, entries, _ = logger._load_wal()
+    hs, entries = logger.read_wal()
     out: List[dict] = []
     if hs is not None:
         out.append({"type": "hardstate", "term": hs.term,

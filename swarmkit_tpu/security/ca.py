@@ -30,7 +30,7 @@ TOKEN_VERSION = "SWMTKN-1"
 
 
 class SecurityError(Exception):
-    pass
+    code = "unauthenticated"   # wire-error mapping (net/client.py)
 
 
 class InvalidToken(SecurityError):

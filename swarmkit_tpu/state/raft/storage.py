@@ -147,6 +147,12 @@ class RaftLogger:
                     entries.append(e)
         return hs, entries, count
 
+    def read_wal(self):
+        """Public read of the WAL: (hard_state | None, entries) — used by
+        rafttool and diagnostics."""
+        hs, entries, _ = self._load_wal()
+        return hs, entries
+
     def load_snapshot(self) -> Optional[Snapshot]:
         if not os.path.exists(self._snap_path):
             return None
