@@ -19,8 +19,13 @@ oracle path** (the faithful reimplementation of the reference algorithm on
 the same store) measured in this process on a proportionally scaled
 workload, normalized per decision.  See BASELINE.md.
 
+An end-to-end "phone-home" measurement (reference: cmd/swarm-bench) runs
+the full pipeline — control API -> orchestrator -> device scheduler ->
+dispatcher -> agents -> RUNNING status writeback — and reports
+time-to-RUNNING percentiles per task.
+
 Env overrides: BENCH_NODES, BENCH_TASKS, BENCH_BASELINE_TASKS,
-BENCH_SKIP_HOST, BENCH_TRIALS, BENCH_SKIP_CONFIGS.
+BENCH_SKIP_HOST, BENCH_TRIALS, BENCH_SKIP_CONFIGS, BENCH_SKIP_E2E.
 """
 
 import gc
@@ -37,6 +42,7 @@ N_TASKS = int(os.environ.get("BENCH_TASKS", 100_000))
 BASELINE_TASKS = int(os.environ.get("BENCH_BASELINE_TASKS", 5_000))
 SKIP_HOST = os.environ.get("BENCH_SKIP_HOST", "") == "1"
 SKIP_CONFIGS = os.environ.get("BENCH_SKIP_CONFIGS", "") == "1"
+SKIP_E2E = os.environ.get("BENCH_SKIP_E2E", "") == "1"
 TRIALS = int(os.environ.get("BENCH_TRIALS", 3))
 
 
@@ -241,6 +247,88 @@ def run_storm(planner_factory):
     }
 
 
+def run_e2e(n_agents=5, n_replicas=500):
+    """swarm-bench equivalent: create an N-replica service and measure
+    per-task time from service creation to RUNNING status committed
+    (reference: cmd/swarm-bench collector.go percentiles)."""
+    import time as time_mod
+
+    from swarmkit_tpu.agent import Agent
+    from swarmkit_tpu.agent.testutils import TestExecutor
+    from swarmkit_tpu.manager import Manager
+    from swarmkit_tpu.manager.dispatcher import Config_
+    from swarmkit_tpu.models import TaskState
+
+    mgr = Manager(dispatcher_config=Config_(
+        heartbeat_period=2.0, process_updates_interval=0.05,
+        assignment_batching_wait=0.05))
+    mgr.run()
+    agents = []
+    try:
+        from swarmkit_tpu.models import (
+            Annotations, Node, NodeDescription, NodeSpec, NodeState,
+            NodeStatus, Resources,
+        )
+        from swarmkit_tpu.utils import new_id
+        for i in range(n_agents):
+            node = Node(
+                id=new_id(),
+                spec=NodeSpec(annotations=Annotations(name=f"bench-w{i}")),
+                status=NodeStatus(state=NodeState.READY),
+                description=NodeDescription(
+                    hostname=f"bench-w{i}",
+                    resources=Resources(nano_cpus=64 * 10**9,
+                                        memory_bytes=256 << 30)))
+            mgr.store.update(lambda tx, node=node: tx.create(node))
+            a = Agent(node.id, TestExecutor(hostname=f"bench-w{i}"),
+                      mgr.dispatcher)
+            a.start()
+            agents.append(a)
+
+        from swarmkit_tpu.models import (
+            ReplicatedService, ServiceMode, ServiceSpec, TaskSpec,
+        )
+        from swarmkit_tpu.models.specs import ContainerSpec
+
+        spec = ServiceSpec(
+            annotations=Annotations(name="e2e-bench"),
+            task=TaskSpec(container=ContainerSpec(image="bench")),
+            mode=ServiceMode.REPLICATED,
+            replicated=ReplicatedService(replicas=n_replicas))
+        t_create = time_mod.time()
+        svc = mgr.control_api.create_service(spec)
+
+        deadline = time_mod.time() + 120
+        latencies = []
+        while time_mod.time() < deadline:
+            tasks = mgr.control_api.list_tasks(service_id=svc.id)
+            done = [t for t in tasks
+                    if t.status.state == TaskState.RUNNING
+                    and t.desired_state == TaskState.RUNNING]
+            if len(done) >= n_replicas:
+                # applied_at is stamped by the dispatcher on status commit
+                latencies = sorted(
+                    (t.status.applied_at or t.status.timestamp) - t_create
+                    for t in done)
+                break
+            time_mod.sleep(0.1)
+        if not latencies:
+            return {"error": "did not converge"}
+
+        def pct(p):
+            return round(latencies[min(len(latencies) - 1,
+                                       int(p * len(latencies)))], 3)
+        return {
+            "agents": n_agents, "replicas": n_replicas,
+            "p50_s": pct(0.50), "p90_s": pct(0.90), "p99_s": pct(0.99),
+            "max_s": round(latencies[-1], 3),
+        }
+    finally:
+        for a in agents:
+            a.stop()
+        mgr.stop()
+
+
 def main():
     from swarmkit_tpu.models import Platform, PlacementPreference, Resources, SpreadOver
     from swarmkit_tpu.ops import TPUPlanner
@@ -313,6 +401,7 @@ def main():
                 spread=SpreadOver(spread_descriptor="node.labels.rack"))],
             global_share=0.2)
         configs["5_reschedule_storm"] = run_storm(tpu)
+    e2e = None if SKIP_E2E else run_e2e()
 
     print(json.dumps({
         "metric": f"scheduling decisions/sec, {N_TASKS // 1000}k tasks x "
@@ -332,6 +421,7 @@ def main():
         "baseline_decisions_per_sec": round(host_dps, 1) if host_dps
         else None,
         "configs": configs,
+        "e2e_time_to_running": e2e,
     }))
 
 

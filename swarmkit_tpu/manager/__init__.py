@@ -1,5 +1,6 @@
 from .allocator import Allocator, PortAllocator
 from .controlapi import ControlAPI
+from .csi import CSIPlugin, InMemoryCSIPlugin, Manager as CSIManager
 from .dispatcher import (
     AssignmentsMessage, AssignmentStream, DefaultConfig, Dispatcher,
 )
@@ -10,6 +11,7 @@ from .metrics import Collector
 from .watchapi import WatchRequest, WatchServer
 
 __all__ = ["Allocator", "AssignmentsMessage", "AssignmentStream",
-           "Collector", "ControlAPI", "DefaultConfig", "Dispatcher",
+           "CSIManager", "CSIPlugin", "Collector", "ControlAPI",
+           "InMemoryCSIPlugin", "DefaultConfig", "Dispatcher",
            "KeyManager", "LogBroker", "LogMessage", "LogSelector",
            "Manager", "PortAllocator", "WatchRequest", "WatchServer"]

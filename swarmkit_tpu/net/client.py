@@ -56,6 +56,9 @@ class _Connection:
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(self.addr, timeout=10)
+        # the connect timeout must not persist: streams block in recv for
+        # arbitrarily long idle periods
+        sock.settimeout(None)
         cert_data = (self.certificate.to_bytes().decode()
                      if self.certificate else None)
         send_frame(sock, {"id": 0, "method": "hello",

@@ -21,7 +21,7 @@ from typing import Any, Dict, Optional
 from ..models.objects import STORE_OBJECT_TYPES
 from ..models.specs import NodeSpec, SecretSpec, ServiceSpec
 from ..models.types import NodeDescription, TaskStatus
-from ..security.ca import Certificate, InvalidCertificate, SecurityError
+from ..security.ca import Certificate, SecurityError
 from ..state import serde
 from ..state.watch import Closed
 from .wire import recv_frame, send_frame
