@@ -8,10 +8,11 @@ from .keymanager import KeyManager
 from .logbroker import LogBroker, LogMessage, LogSelector
 from .manager import Manager
 from .metrics import Collector
+from .resourceapi import ResourceAPI
 from .watchapi import WatchRequest, WatchServer
 
 __all__ = ["Allocator", "AssignmentsMessage", "AssignmentStream",
            "CSIManager", "CSIPlugin", "Collector", "ControlAPI",
            "InMemoryCSIPlugin", "DefaultConfig", "Dispatcher",
            "KeyManager", "LogBroker", "LogMessage", "LogSelector",
-           "Manager", "PortAllocator", "WatchRequest", "WatchServer"]
+           "Manager", "PortAllocator", "ResourceAPI", "WatchRequest", "WatchServer"]

@@ -56,9 +56,6 @@ class _Connection:
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(self.addr, timeout=10)
-        # the connect timeout must not persist: streams block in recv for
-        # arbitrarily long idle periods
-        sock.settimeout(None)
         cert_data = (self.certificate.to_bytes().decode()
                      if self.certificate else None)
         send_frame(sock, {"id": 0, "method": "hello",
@@ -68,6 +65,10 @@ class _Connection:
             sock.close()
             raise _ERROR_TYPES.get(resp.get("code"), RemoteError)(
                 resp["error"])
+        # only after a successful hello: streams may then block in recv
+        # for arbitrarily long idle periods (the 10s timeout still bounds
+        # the connect + handshake against half-open servers)
+        sock.settimeout(None)
         return sock
 
     def call(self, method: str, params: Dict[str, Any]) -> Any:

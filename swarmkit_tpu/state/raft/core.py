@@ -29,6 +29,7 @@ LEADER = "leader"
 
 ENTRY_NORMAL = 0
 ENTRY_NOOP = 1
+ENTRY_CONF = 2   # data = JSON {"op": "add"|"remove", "id": member id}
 
 
 @dataclass
@@ -53,6 +54,9 @@ class Snapshot:
     index: int = 0
     term: int = 0
     data: bytes = b""
+    # the peer set as of `index`: conf entries before the snapshot are
+    # compacted away, so membership must travel with it (etcd ConfState)
+    peers: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -127,6 +131,11 @@ class RaftCore:
         # its blocked proposals fail fast (etcd-raft CheckQuorum behavior)
         self._quorum_elapsed = 0
         self._recent_active: set = set()
+        # set once a committed conf change removes this member: the node
+        # stops ticking/voting so it cannot disrupt the remaining cluster
+        self.removed = False
+        # single-conf-change-at-a-time guard (etcd pendingConfIndex)
+        self.pending_conf_index = 0
 
     # ------------------------------------------------------------- log utils
 
@@ -163,6 +172,8 @@ class RaftCore:
             self.snap_term = snapshot.term
             self.commit_index = snapshot.index
             self.applied_index = snapshot.index
+            if snapshot.peers:
+                self.peers = set(snapshot.peers)
         self.term = hard_state.term
         self.voted_for = hard_state.voted_for
         self.commit_index = max(self.commit_index, hard_state.commit)
@@ -172,6 +183,8 @@ class RaftCore:
     # ----------------------------------------------------------------- ticks
 
     def tick(self) -> None:
+        if self.removed:
+            return
         if self.role == LEADER:
             self._elapsed += 1
             if self._elapsed >= self.heartbeat_tick:
@@ -256,6 +269,43 @@ class RaftCore:
         self._broadcast_append()
         return index
 
+    def propose_conf_change(self, op: str, member_id: str) -> int:
+        """Leader-only membership change (reference: raft.go Join :926 /
+        Leave :1138 propose ConfChange entries).  Single-change-at-a-time
+        semantics: a second change is refused until the first has been
+        APPLIED (etcd pendingConfIndex)."""
+        import json as _json
+        assert self.role == LEADER, "conf change on non-leader"
+        if self.pending_conf_index > self.applied_index:
+            raise RuntimeError(
+                "a membership change is already in flight")
+        index = self.last_index() + 1
+        self.pending_conf_index = index
+        self._append(Entry(term=self.term, index=index,
+                           data=_json.dumps({"op": op,
+                                             "id": member_id}).encode(),
+                           type=ENTRY_CONF))
+        self._broadcast_append()
+        return index
+
+    def apply_conf_change(self, op: str, member_id: str) -> None:
+        """Called by the driver when an ENTRY_CONF commits."""
+        if op == "add":
+            self.peers.add(member_id)
+            if self.role == LEADER and member_id not in self.next_index:
+                self.next_index[member_id] = self.last_index() + 1
+                self.match_index[member_id] = 0
+        elif op == "remove":
+            self.peers.discard(member_id)
+            self.next_index.pop(member_id, None)
+            self.match_index.pop(member_id, None)
+            if member_id == self.id:
+                # we were removed: stop participating entirely
+                self.removed = True
+                self._become_follower(self.term)
+            elif self.role == LEADER:
+                self._maybe_commit()  # quorum shrank
+
     def _append(self, entry: Entry) -> None:
         self.log.append(entry)
         self.match_index[self.id] = self.last_index()
@@ -265,6 +315,12 @@ class RaftCore:
     # -------------------------------------------------------------- messages
 
     def step(self, m: Message) -> None:
+        if self.removed:
+            return
+        if m.src != self.id and m.src not in self.peers:
+            # not (or no longer) a member: ignore — a removed node's
+            # campaigns must not depose live leaders
+            return
         if self.role == LEADER and m.src in self.peers:
             self._recent_active.add(m.src)
         if m.term > self.term:
@@ -382,6 +438,8 @@ class RaftCore:
         self._pending_snapshot = snap
         self.snap_index = snap.index
         self.snap_term = snap.term
+        if snap.peers:
+            self.peers = set(snap.peers)
         self.log = []
         self.commit_index = snap.index
         self.applied_index = snap.index

@@ -25,7 +25,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 from .. import serde
 from ..store import MemoryStore, Proposer, StoreAction
 from .core import (
-    ENTRY_NOOP, Entry, HardState, LEADER, Message, RaftCore, Snapshot,
+    ENTRY_CONF, ENTRY_NOOP, Entry, HardState, LEADER, Message, RaftCore,
+    Snapshot,
 )
 from .storage import RaftLogger
 
@@ -148,7 +149,21 @@ class RaftNode(Proposer):
         finally:
             self._done.set()
 
-    def _handle_proposal(self, data, waiter) -> None:
+    def _handle_proposal(self, *item) -> None:
+        if item[0] == "conf":
+            _, op, member_id, waiter = item
+            if not self.core.leader_ready:
+                waiter.ok = False
+                waiter.event.set()
+                return
+            index = self.core.propose_conf_change(op, member_id)
+            waiter.term = self.core.term
+            waiter.index = index
+            self._local_indices.add(index)
+            with self._waiters_lock:
+                self._waiters[index] = waiter
+            return
+        data, waiter = item
         if not self.core.leader_ready:
             waiter.ok = False
             waiter.event.set()
@@ -189,6 +204,22 @@ class RaftNode(Proposer):
     # -------------------------------------------------------------- applying
 
     def _apply_entry(self, e: Entry, replay: bool = False) -> None:
+        if e.type == ENTRY_CONF:
+            import json as _json
+            try:
+                change = _json.loads(e.data)
+                self.core.apply_conf_change(change["op"], change["id"])
+                log.info("membership change applied: %s %s",
+                         change["op"], change["id"])
+            except Exception:
+                log.exception("applying conf change failed")
+            with self._waiters_lock:
+                waiter = self._waiters.pop(e.index, None)
+            self._local_indices.discard(e.index)
+            if waiter is not None and not replay:
+                waiter.ok = True
+                waiter.event.set()
+            return
         if e.type == ENTRY_NOOP or not e.data:
             return
         self.stats["applied"] += 1
@@ -234,7 +265,8 @@ class RaftNode(Proposer):
             return
         index = self.core.applied_index
         snap = Snapshot(index=index, term=self.core._term_at(index) or 0,
-                        data=self.store.save_bytes())
+                        data=self.store.save_bytes(),
+                        peers=sorted(self.core.peers))
         self.logger.save_snapshot(snap, index)
         self.core.compact(index, snap.term)
         self.stats["snapshots"] += 1
@@ -257,6 +289,26 @@ class RaftNode(Proposer):
         for w in waiters.values():
             w.ok = False
             w.event.set()
+
+    # ------------------------------------------------------------ membership
+
+    def _propose_conf(self, op: str, member_id: str) -> None:
+        if not self.core.leader_ready:
+            raise NotLeader(f"{self.id} is not a ready leader")
+        waiter = _Waiter(event=threading.Event(), term=self.core.term,
+                        index=0)
+        self._inbox.put(("conf", op, member_id, waiter))
+        waiter.event.wait(timeout=30)
+        if not waiter.ok:
+            raise ProposalDropped("membership change dropped")
+
+    def add_member(self, member_id: str) -> None:
+        """Leader-side join (reference: raft.go:926 Join)."""
+        self._propose_conf("add", member_id)
+
+    def remove_member(self, member_id: str) -> None:
+        """Leader-side leave/demote (reference: raft.go:1138 Leave)."""
+        self._propose_conf("remove", member_id)
 
     # -------------------------------------------------------------- proposer
 

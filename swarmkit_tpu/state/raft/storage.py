@@ -84,6 +84,7 @@ class RaftLogger:
         tmp = self._snap_path + ".tmp"
         record = json.dumps({
             "index": snapshot.index, "term": snapshot.term,
+            "peers": list(snapshot.peers),
             "data": base64.b64encode(
                 self.encoder.encode(snapshot.data)).decode("ascii"),
         }, sort_keys=True).encode()
@@ -161,6 +162,7 @@ class RaftLogger:
                 rec = json.loads(f.read())
             return Snapshot(
                 index=rec["index"], term=rec["term"],
+                peers=list(rec.get("peers", [])),
                 data=self.encoder.decode(base64.b64decode(rec["data"])))
         except Exception:
             return None

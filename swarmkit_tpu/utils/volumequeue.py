@@ -61,8 +61,11 @@ class VolumeQueue:
                     return None
                 now = time.monotonic()
                 while self._heap and self._heap[0][0] <= now:
-                    _, _, id = heapq.heappop(self._heap)
-                    if self._pending.get(id) is not None:
+                    ready, _, id = heapq.heappop(self._heap)
+                    # deliver only the entry matching the CURRENT deadline:
+                    # superseded entries (e.g. pre-backoff ones) are stale
+                    # and must not fire a retry early
+                    if self._pending.get(id) == ready:
                         self._pending.pop(id, None)
                         return id
                 if self._heap:

@@ -274,3 +274,107 @@ def test_leader_failover_preserves_scheduler_input(tmp_path):
             s.stop()
         for rn in nodes.values():
             rn.stop()
+
+
+def test_membership_add_and_remove(tmp_path):
+    """Dynamic membership: a new member joins via a conf change and
+    catches up; a removed member stops participating (reference:
+    raft.go:926 Join / :1138 Leave)."""
+    net, nodes = make_cluster(tmp_path, n=3)
+    m3 = None
+    try:
+        leader = wait_leader(nodes)
+        leader.store.update(lambda tx: tx.create(mk_node_obj("a")))
+        stores_converged(nodes, {"a"})
+
+        # join a 4th member: leader proposes the conf change, then the new
+        # member starts with the expanded peer set and catches up
+        leader.add_member("m3")
+        poll(lambda: all("m3" in rn.core.peers for rn in nodes.values()),
+             timeout=10, msg="all members should learn the new peer")
+
+        store3 = MemoryStore()
+        logger3 = RaftLogger(os.path.join(tmp_path, "m3"))
+        m3 = RaftNode("m3", ["m0", "m1", "m2", "m3"], store3, logger3, net)
+        store3._proposer = m3
+        m3.start()
+        all_nodes = dict(nodes)
+        all_nodes["m3"] = m3
+        stores_converged(all_nodes, {"a"}, timeout=15)
+
+        leader2 = wait_leader(all_nodes)
+        leader2.store.update(lambda tx: tx.create(mk_node_obj("b")))
+        stores_converged(all_nodes, {"a", "b"}, timeout=15)
+
+        # remove m3 again: cluster keeps committing with 3 members
+        leader2.remove_member("m3")
+        poll(lambda: all("m3" not in rn.core.peers
+                         for rn in nodes.values()),
+             timeout=10, msg="members should drop the removed peer")
+        leader3 = wait_leader(nodes, timeout=15)
+        leader3.store.update(lambda tx: tx.create(mk_node_obj("c")))
+        stores_converged(nodes, {"a", "b", "c"}, timeout=15)
+    finally:
+        if m3 is not None:
+            m3.stop()
+        for rn in nodes.values():
+            rn.stop()
+
+
+def test_removed_member_cannot_disrupt(tmp_path):
+    """A removed member stops participating, and live members ignore its
+    messages — it can never depose the leader (check against the
+    removed-node disruption raft failure mode)."""
+    net, nodes = make_cluster(tmp_path, n=3)
+    try:
+        leader = wait_leader(nodes)
+        removed = next(rn for rn in nodes.values() if rn is not leader)
+        leader.remove_member(removed.id)
+        poll(lambda: all(removed.id not in rn.core.peers
+                         for rn in nodes.values() if rn is not removed),
+             timeout=10, msg="members should drop the removed peer")
+
+        # the removed node may never learn of its own removal (the leader
+        # stops talking to it), so it will campaign at rising terms — live
+        # members must IGNORE it: stable leader, same term, still committing
+        term_before = leader.core.term
+        time.sleep(1.5)
+        survivors = {k: v for k, v in nodes.items() if v is not removed}
+        cur_leader = wait_leader(survivors, timeout=10)
+        assert cur_leader.core.term == term_before, \
+            "removed member must not force elections"
+        cur_leader.store.update(lambda tx: tx.create(mk_node_obj("post")))
+        stores_converged(survivors, {"post"})
+    finally:
+        for rn in nodes.values():
+            rn.stop()
+
+
+def test_membership_survives_snapshot_and_restart(tmp_path):
+    """Conf changes compacted into a snapshot must still be in effect
+    after a restart (snapshot carries the peer set)."""
+    net, nodes = make_cluster(tmp_path, n=3, snapshot_interval=5)
+    try:
+        leader = wait_leader(nodes)
+        leader.add_member("m9")
+        poll(lambda: "m9" in leader.core.peers, timeout=10)
+        # churn past the snapshot interval so the conf entry is compacted
+        for i in range(10):
+            leader.store.update(lambda tx, i=i: tx.create(
+                mk_node_obj(f"x{i}")))
+        assert leader.core.snap_index > 0, "should have snapshotted"
+        follower = next(rn for rn in nodes.values() if rn is not leader)
+        fid = follower.id
+        follower.stop()
+
+        # restart with the ORIGINAL 3-member constructor list: membership
+        # must come back from the snapshot (4 members incl. m9)
+        store2 = MemoryStore()
+        rn2 = RaftNode(fid, ["m0", "m1", "m2"], store2,
+                       RaftLogger(os.path.join(tmp_path, fid)), net)
+        store2._proposer = rn2
+        assert "m9" in rn2.core.peers, rn2.core.peers
+        rn2.stop()
+    finally:
+        for rn in nodes.values():
+            rn.stop()
