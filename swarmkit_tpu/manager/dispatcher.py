@@ -32,9 +32,9 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..models.objects import Config, Node, Secret, Task
+from ..models.objects import Cluster, Config, Node, Secret, Task
 from ..models.types import NodeState, NodeStatus, TaskState, TaskStatus, now
-from ..state.events import Event
+from ..state.events import Event, EventSnapshotRestore
 from ..state.store import Batch, ByNode, MemoryStore
 from ..state.watch import Closed, Subscription
 from ..utils import new_id
@@ -255,6 +255,14 @@ class Dispatcher:
                 return
             self._running = True
             self._stop.clear()
+            # cluster-spec changes (e.g. heartbeat period) take effect
+            # live; the current spec applies at startup too (reference:
+            # manager.go:801 watchForClusterChanges does an initial read)
+            self._cluster_sub = self.store.queue.subscribe(
+                lambda ev: isinstance(ev, EventSnapshotRestore)
+                or (isinstance(ev, Event) and isinstance(ev.obj, Cluster)
+                    and ev.action == "update"))
+            self._load_cluster_config()
             self._worker = threading.Thread(target=self._worker_loop,
                                             name="dispatcher", daemon=True)
             self._worker.start()
@@ -271,7 +279,29 @@ class Dispatcher:
         if self._worker is not None:
             self._worker.join(timeout=5)
             self._worker = None
+        if getattr(self, "_cluster_sub", None) is not None:
+            self.store.queue.unsubscribe(self._cluster_sub)
+            self._cluster_sub = None
         self._flush_updates()
+
+    def _load_cluster_config(self) -> None:
+        from ..state.store import ByName
+        clusters = self.store.view(
+            lambda tx: tx.find(Cluster, ByName("default")))
+        if clusters:
+            self._apply_cluster_config(clusters[0], initial=True)
+
+    def _apply_cluster_config(self, cluster: Cluster,
+                              initial: bool = False) -> None:
+        from ..models.types import DispatcherConfig as _SpecDefault
+        period = cluster.spec.dispatcher.heartbeat_period
+        if initial and period == _SpecDefault().heartbeat_period:
+            # a never-customized spec must not override the operator's
+            # constructor config at startup; explicit updates always win
+            return
+        if period and period != self.config.heartbeat_period:
+            log.info("heartbeat period now %.1fs (cluster spec)", period)
+            self.config.heartbeat_period = period
 
     # -------------------------------------------------------------- register
 
@@ -469,6 +499,16 @@ class Dispatcher:
                 max(0.0, min(interval, deadline - now()))
             self._stop.wait(timeout=timeout)
             ts = now()
+            # apply live cluster-config changes (and resync on restore)
+            sub = getattr(self, "_cluster_sub", None)
+            while sub is not None:
+                ev = sub.poll()
+                if ev is None:
+                    break
+                if isinstance(ev, EventSnapshotRestore):
+                    self._load_cluster_config()
+                else:
+                    self._apply_cluster_config(ev.obj)
             # heartbeat expirations + orphan deadlines
             while True:
                 with self._mu:

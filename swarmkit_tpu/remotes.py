@@ -1,0 +1,171 @@
+"""Remotes tracker + connection broker: weighted manager-peer selection.
+
+Reference: remotes/remotes.go (observation-based weights) and
+connectionbroker/broker.go (local vs remote pick).
+
+Agents track the set of known managers; every successful interaction
+raises a peer's weight toward the maximum, every failure collapses it
+toward the minimum, and selection samples proportionally to weight — so
+traffic drains away from flapping managers without ever blacklisting them
+completely (they can recover).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# reference: remotes.go DefaultObservationWeight and bounds
+DEFAULT_OBSERVATION_WEIGHT = 10
+REMOTE_WEIGHT_MAX = 1 << 8
+REMOTE_WEIGHT_MIN = -(1 << 8)
+
+Addr = Tuple[str, int]
+
+
+class NoSuchRemote(Exception):
+    pass
+
+
+class Remotes:
+    def __init__(self, *addrs: Addr):
+        self._mu = threading.Lock()
+        self._weights: Dict[Addr, int] = {
+            tuple(a): DEFAULT_OBSERVATION_WEIGHT for a in addrs}
+        self._rng = random.Random()
+
+    def observe(self, addr: Addr, weight: int = DEFAULT_OBSERVATION_WEIGHT
+                ) -> None:
+        """Positive observations move toward max, negative toward min
+        (reference: remotes.go Observe / ObserveIfExists)."""
+        addr = tuple(addr)
+        with self._mu:
+            cur = self._weights.get(addr, 0)
+            if weight >= 0:
+                self._weights[addr] = min(
+                    REMOTE_WEIGHT_MAX, cur + weight)
+            else:
+                self._weights[addr] = max(
+                    REMOTE_WEIGHT_MIN, cur + weight)
+
+    def remove(self, addr: Addr) -> None:
+        with self._mu:
+            self._weights.pop(tuple(addr), None)
+
+    def weights(self) -> Dict[Addr, int]:
+        with self._mu:
+            return dict(self._weights)
+
+    def select(self, *excludes: Addr) -> Addr:
+        """Weighted random pick (reference: remotes.go Select)."""
+        excluded = {tuple(e) for e in excludes}
+        with self._mu:
+            candidates = [(a, w) for a, w in self._weights.items()
+                          if a not in excluded]
+            if not candidates:
+                raise NoSuchRemote("no remote managers available")
+            # shift weights positive; +1 keeps dead peers selectable so
+            # they can recover
+            lowest = min(w for _, w in candidates)
+            total = sum(w - lowest + 1 for _, w in candidates)
+            pick = self._rng.uniform(0, total)
+            acc = 0.0
+            for addr, w in candidates:
+                acc += w - lowest + 1
+                if pick <= acc:
+                    return addr
+            return candidates[-1][0]
+
+
+class ConnectionBroker:
+    """Picks a manager connection for CA/dispatcher clients: the local
+    manager when this node runs one, a weighted remote otherwise
+    (reference: connectionbroker/broker.go)."""
+
+    def __init__(self, remotes: Remotes, local_addr: Optional[Addr] = None):
+        self.remotes = remotes
+        self.local_addr = tuple(local_addr) if local_addr else None
+
+    def select(self, prefer_local: bool = True, *excludes: Addr) -> Addr:
+        if prefer_local and self.local_addr is not None:
+            return self.local_addr
+        try:
+            return self.remotes.select(*excludes)
+        except NoSuchRemote:
+            if excludes:
+                return self.remotes.select()  # everything failed: any
+            raise
+
+    def observe_success(self, addr: Addr) -> None:
+        self.remotes.observe(addr, DEFAULT_OBSERVATION_WEIGHT)
+
+    def observe_failure(self, addr: Addr) -> None:
+        self.remotes.observe(addr, -DEFAULT_OBSERVATION_WEIGHT)
+
+
+class FailoverDispatcherClient:
+    """Dispatcher-surface client that fails over between managers using
+    the broker: each call picks the current remote; errors down-weight it
+    and the next call tries another (the agent's session loop handles the
+    re-registration)."""
+
+    def __init__(self, broker: ConnectionBroker, certificate,
+                 client_factory=None):
+        from .net.client import RemoteDispatcherClient
+        self.broker = broker
+        self.certificate = certificate
+        self._factory = client_factory or (
+            lambda addr: RemoteDispatcherClient(addr, self.certificate))
+        self._mu = threading.Lock()
+        self._current: Optional[Addr] = None
+        self._client = None
+        self._last_failed: Optional[Addr] = None
+
+    def _get(self):
+        with self._mu:
+            if self._client is None:
+                excludes = (self._last_failed,) if self._last_failed \
+                    else ()
+                self._current = self.broker.select(
+                    False, *excludes)
+                self._client = self._factory(self._current)
+            return self._current, self._client
+
+    def _fail(self, addr: Addr) -> None:
+        self.broker.observe_failure(addr)
+        with self._mu:
+            self._last_failed = addr   # next pick avoids the failed peer
+            if self._current == addr:
+                try:
+                    self._client.close()
+                except Exception:
+                    pass
+                self._client = None
+                self._current = None
+
+    def _call(self, method: str, *args, **kwargs):
+        addr, client = self._get()
+        try:
+            result = getattr(client, method)(*args, **kwargs)
+            self.broker.observe_success(addr)
+            return result
+        except (ConnectionError, OSError, TimeoutError):
+            # only transport failures indict the manager's health;
+            # application errors (invalid session etc.) travelled over a
+            # perfectly healthy link and must not shift weights
+            self._fail(addr)
+            raise
+
+    def register(self, node_id, description=None):
+        return self._call("register", node_id, description=description)
+
+    def heartbeat(self, node_id, session_id):
+        return self._call("heartbeat", node_id, session_id)
+
+    def update_task_status(self, node_id, session_id, updates):
+        return self._call("update_task_status", node_id, session_id,
+                          updates)
+
+    def open_assignments(self, node_id, session_id):
+        return self._call("open_assignments", node_id, session_id)

@@ -1,0 +1,158 @@
+"""swarmd: the node daemon — run a manager, join as a worker, or both.
+
+Reference: swarmd/cmd/swarmd/main.go (state-dir, join-addr/token,
+listen-remote-api flags; node.New/Start wiring).
+
+    # first manager (bootstraps the cluster, prints join tokens)
+    python -m swarmkit_tpu.swarmd --manager --state-dir /tmp/m0 \
+        --listen-remote-api 127.0.0.1:4242
+
+    # worker joining it
+    python -m swarmkit_tpu.swarmd --state-dir /tmp/w0 \
+        --join-addr 127.0.0.1:4242 --join-token SWMTKN-1-...
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+import time
+from typing import Optional, Tuple
+
+log = logging.getLogger("swarmd")
+
+
+def parse_addr(text: str) -> Tuple[str, int]:
+    host, _, port = text.rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+class Swarmd:
+    """One node process: always an agent; a manager when --manager."""
+
+    def __init__(self, state_dir: str, hostname: str = "",
+                 manager: bool = False,
+                 listen_remote_api: Optional[Tuple[str, int]] = None,
+                 join_addr: Optional[Tuple[str, int]] = None,
+                 join_token: str = "",
+                 executor=None,
+                 use_device_scheduler: bool = True):
+        from .agent.testutils import TestExecutor
+
+        self.state_dir = state_dir
+        self.hostname = hostname or state_dir.rstrip("/").rsplit("/", 1)[-1]
+        self.is_manager = manager
+        self.listen_remote_api = listen_remote_api
+        self.join_addr = join_addr
+        self.join_token = join_token
+        self.executor = executor or TestExecutor(hostname=self.hostname)
+        self.use_device_scheduler = use_device_scheduler
+        self.manager = None
+        self.server = None
+        self.node = None
+
+    def start(self) -> None:
+        from .node import Node
+
+        if self.is_manager:
+            from .manager import Manager
+            from .net import ManagerServer
+
+            self.manager = Manager(
+                use_device_scheduler=self.use_device_scheduler)
+            self.manager.run()
+            if self.listen_remote_api is not None:
+                self.server = ManagerServer(
+                    self.manager, host=self.listen_remote_api[0],
+                    port=self.listen_remote_api[1])
+                self.server.start()
+                log.info("remote API on %s:%d", *self.server.addr)
+
+            # the manager node also runs an agent against itself
+            self.node = Node(self.executor, self.state_dir)
+            token = self.manager.root_ca.join_token(0)
+            self.node.load_or_join(self.manager.ca_server, token)
+            self.node.start(self.manager.dispatcher,
+                            store=self.manager.store,
+                            hostname=self.hostname)
+            log.info("manager up; worker join token: %s",
+                     self.manager.root_ca.join_token(0))
+            log.info("manager join token: %s",
+                     self.manager.root_ca.join_token(1))
+            return
+
+        if self.join_addr is None or not self.join_token:
+            raise SystemExit(
+                "worker mode needs --join-addr and --join-token")
+        from .net import issue_certificate
+        from .remotes import (
+            ConnectionBroker, FailoverDispatcherClient, Remotes,
+        )
+        from .security.ca import SecurityError
+
+        # reuse a persisted identity when present, else join with the token
+        self.node = Node(self.executor, self.state_dir)
+        cert = None
+        try:
+            cert, _ = self.node.key_rw.read()
+        except (FileNotFoundError, SecurityError):
+            pass
+        if cert is None:
+            cert = issue_certificate(self.join_addr, self.node.node_id,
+                                     self.join_token)
+            self.node.key_rw.write(cert, b"")
+        self.node.certificate = cert
+        self.node.node_id = cert.node_id
+        # weighted failover across known managers (seeded with the join
+        # address; more managers can be observed into self.remotes)
+        self.remotes = Remotes(self.join_addr)
+        client = FailoverDispatcherClient(
+            ConnectionBroker(self.remotes), cert)
+        self.node.start(client, hostname=self.hostname)
+        log.info("worker %s joined %s", self.node.node_id[:8],
+                 self.join_addr)
+
+    def stop(self) -> None:
+        if self.node is not None:
+            self.node.stop()
+        if self.server is not None:
+            self.server.stop()
+        if self.manager is not None:
+            self.manager.stop()
+
+
+def main(argv=None) -> int:   # pragma: no cover - thin CLI shell
+    parser = argparse.ArgumentParser(prog="swarmd")
+    parser.add_argument("--state-dir", required=True)
+    parser.add_argument("--hostname", default="")
+    parser.add_argument("--manager", action="store_true")
+    parser.add_argument("--listen-remote-api", default="")
+    parser.add_argument("--join-addr", default="")
+    parser.add_argument("--join-token", default="")
+    parser.add_argument("--no-device-scheduler", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    daemon = Swarmd(
+        state_dir=args.state_dir, hostname=args.hostname,
+        manager=args.manager,
+        listen_remote_api=parse_addr(args.listen_remote_api)
+        if args.listen_remote_api else None,
+        join_addr=parse_addr(args.join_addr) if args.join_addr else None,
+        join_token=args.join_token,
+        use_device_scheduler=not args.no_device_scheduler)
+    daemon.start()
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":   # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,141 @@
+"""Remotes tracker, connection broker, manager failover client, swarmd
+daemon wiring, and live cluster-config reload."""
+
+import tempfile
+import time
+
+import pytest
+
+from swarmkit_tpu.manager import Manager
+from swarmkit_tpu.manager.dispatcher import Config_
+from swarmkit_tpu.models import Cluster, Task, TaskState
+from swarmkit_tpu.remotes import (
+    ConnectionBroker, FailoverDispatcherClient, NoSuchRemote, Remotes,
+)
+from swarmkit_tpu.state.store import ByName
+from swarmkit_tpu.swarmd import Swarmd
+from swarmkit_tpu.utils import new_id
+
+from test_orchestrator import make_replicated, poll
+
+
+def test_remotes_weighted_selection():
+    r = Remotes(("a", 1), ("b", 2))
+    # both selectable initially
+    seen = {r.select() for _ in range(100)}
+    assert seen == {("a", 1), ("b", 2)}
+
+    # hammer failures on a: selection should strongly prefer b
+    for _ in range(50):
+        r.observe(("a", 1), -10)
+    picks = [r.select() for _ in range(300)]
+    b_share = picks.count(("b", 2)) / len(picks)
+    assert b_share > 0.9, b_share
+
+    # exclusion and exhaustion
+    assert r.select(("a", 1)) == ("b", 2)
+    r.remove(("b", 2))
+    r.remove(("a", 1))
+    with pytest.raises(NoSuchRemote):
+        r.select()
+
+
+def test_connection_broker_prefers_local():
+    r = Remotes(("remote", 1))
+    broker = ConnectionBroker(r, local_addr=("local", 9))
+    assert broker.select() == ("local", 9)
+    assert broker.select(prefer_local=False) == ("remote", 1)
+
+
+def test_failover_client_switches_managers():
+    calls = []
+
+    class FakeClient:
+        def __init__(self, addr, fail=False):
+            self.addr = addr
+            self.fail = fail
+
+        def heartbeat(self, node_id, session_id):
+            calls.append(self.addr)
+            if self.fail:
+                raise ConnectionError("down")
+            return 1.0
+
+        def close(self):
+            pass
+
+    r = Remotes(("m1", 1), ("m2", 2))
+    # make m1 the overwhelming favorite so the first pick is deterministic
+    for _ in range(30):
+        r.observe(("m1", 1), 10)
+        r.observe(("m2", 2), -10)
+    broker = ConnectionBroker(r)
+    clients = {("m1", 1): FakeClient(("m1", 1), fail=True),
+               ("m2", 2): FakeClient(("m2", 2))}
+    fc = FailoverDispatcherClient(broker, None,
+                                  client_factory=lambda a: clients[a])
+
+    # first call hits m1 (favorite), fails, down-weights it
+    with pytest.raises(ConnectionError):
+        fc.heartbeat("n", "s")
+    # retries eventually land on m2 and succeed
+    for _ in range(20):
+        try:
+            assert fc.heartbeat("n", "s") == 1.0
+            break
+        except ConnectionError:
+            continue
+    else:
+        raise AssertionError("failover never reached m2")
+    assert ("m2", 2) in calls
+
+
+def test_swarmd_manager_and_remote_worker():
+    """Full daemon wiring: a manager swarmd serving the remote API, a
+    worker swarmd joining over TCP with the printed token."""
+    mgr_daemon = Swarmd(state_dir=tempfile.mkdtemp(), hostname="m0",
+                        manager=True, listen_remote_api=("127.0.0.1", 0),
+                        use_device_scheduler=False)
+    mgr_daemon.start()
+    worker = None
+    try:
+        token = mgr_daemon.manager.root_ca.join_token(0)
+        worker = Swarmd(state_dir=tempfile.mkdtemp(), hostname="w0",
+                        join_addr=mgr_daemon.server.addr,
+                        join_token=token)
+        worker.start()
+
+        api = mgr_daemon.manager.control_api
+        poll(lambda: len(api.list_nodes()) == 2,
+             msg="both swarmd nodes should register")
+
+        svc = api.create_service(make_replicated("web", 4).spec)
+        poll(lambda: len([t for t in api.list_tasks(service_id=svc.id)
+                          if t.status.state == TaskState.RUNNING
+                          and t.desired_state == TaskState.RUNNING]) == 4,
+             timeout=30, msg="replicas should run across both daemons")
+        nodes_used = {t.node_id for t in api.list_tasks(service_id=svc.id)}
+        assert len(nodes_used) == 2, "both nodes should receive tasks"
+    finally:
+        if worker is not None:
+            worker.stop()
+        mgr_daemon.stop()
+
+
+def test_dispatcher_live_heartbeat_reload():
+    mgr = Manager(dispatcher_config=Config_(heartbeat_period=5.0,
+                                            process_updates_interval=0.02),
+                  use_device_scheduler=False)
+    mgr.run()
+    try:
+        assert mgr.dispatcher.config.heartbeat_period == 5.0
+
+        def bump(tx):
+            c = tx.find(Cluster, ByName("default"))[0].copy()
+            c.spec.dispatcher.heartbeat_period = 1.5
+            tx.update(c)
+        mgr.store.update(bump)
+        poll(lambda: mgr.dispatcher.config.heartbeat_period == 1.5,
+             msg="heartbeat period should reload from cluster spec")
+    finally:
+        mgr.stop()
