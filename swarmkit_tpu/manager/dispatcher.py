@@ -25,6 +25,7 @@ deadlines, status-update batching) run on one worker thread.
 
 from __future__ import annotations
 
+import dataclasses
 import heapq
 import logging
 import random
@@ -231,7 +232,12 @@ class Dispatcher:
     def __init__(self, store: MemoryStore,
                  config: Optional[Config_] = None):
         self.store = store
-        self.config = config or Config_()
+        # private copy: cluster-spec reloads must not mutate the caller's
+        # (e.g. the Manager's) config object, which seeds future
+        # dispatchers on later leadership cycles
+        self.config = dataclasses.replace(config) if config else Config_()
+        # the configured default, restored when the spec unsets its value
+        self._default_heartbeat = self.config.heartbeat_period
         self._mu = threading.Lock()
         self._nodes: Dict[str, _RegisteredNode] = {}
         self._down_nodes: Dict[str, float] = {}  # node_id -> down since
@@ -263,9 +269,28 @@ class Dispatcher:
                 or (isinstance(ev, Event) and isinstance(ev.obj, Cluster)
                     and ev.action == "update"))
             self._load_cluster_config()
+            self._mark_nodes_unknown()
             self._worker = threading.Thread(target=self._worker_loop,
                                             name="dispatcher", daemon=True)
             self._worker.start()
+
+    def _mark_nodes_unknown(self) -> None:
+        """A fresh dispatcher (new leader) inherits store nodes that
+        registered with the OLD leader's dispatcher: give each a
+        registration grace window; whoever doesn't open a session by then
+        is marked DOWN so its tasks heal elsewhere (reference:
+        dispatcher.go markNodesUnknown on Run)."""
+        try:
+            nodes = self.store.view(lambda tx: tx.find(Node))
+        except Exception:
+            log.exception("markNodesUnknown scan failed")
+            return
+        grace = self._heartbeat_period() * self.config.grace_multiplier
+        deadline = now() + grace
+        # caller (start) already holds self._mu
+        for n in nodes:
+            if n.status.state != NodeState.DOWN:
+                self._push_deadline(deadline, "reg", n.id)
 
     def stop(self) -> None:
         self._stop.set()
@@ -289,19 +314,17 @@ class Dispatcher:
         clusters = self.store.view(
             lambda tx: tx.find(Cluster, ByName("default")))
         if clusters:
-            self._apply_cluster_config(clusters[0], initial=True)
+            self._apply_cluster_config(clusters[0])
 
-    def _apply_cluster_config(self, cluster: Cluster,
-                              initial: bool = False) -> None:
-        from ..models.types import DispatcherConfig as _SpecDefault
+    def _apply_cluster_config(self, cluster: Cluster) -> None:
+        # spec value 0 means unset -> the configured default applies;
+        # this holds on the initial read, live updates, AND snapshot
+        # restores, and lets an operator RESET to the default by writing 0
         period = cluster.spec.dispatcher.heartbeat_period
-        if initial and period == _SpecDefault().heartbeat_period:
-            # a never-customized spec must not override the operator's
-            # constructor config at startup; explicit updates always win
-            return
-        if period and period != self.config.heartbeat_period:
-            log.info("heartbeat period now %.1fs (cluster spec)", period)
-            self.config.heartbeat_period = period
+        target = period if period > 0 else self._default_heartbeat
+        if target != self.config.heartbeat_period:
+            log.info("heartbeat period now %.1fs (cluster spec)", target)
+            self.config.heartbeat_period = target
 
     # -------------------------------------------------------------- register
 
@@ -518,6 +541,9 @@ class Dispatcher:
                     if kind == "hb":
                         rn = self._nodes.get(node_id)
                         expired = rn is not None and rn.deadline <= ts
+                    elif kind == "reg":
+                        # registration grace after a leadership change
+                        expired = node_id not in self._nodes
                     else:
                         down_since = self._down_nodes.get(node_id)
                         expired = (down_since is not None
@@ -528,6 +554,12 @@ class Dispatcher:
                 if kind == "hb" and expired:
                     log.info("heartbeat expiration for worker %s", node_id)
                     self._mark_node_not_ready(node_id, "heartbeat failure")
+                elif kind == "reg" and expired:
+                    log.info("node %s never registered after leadership "
+                             "change", node_id)
+                    self._mark_node_not_ready(
+                        node_id, "node did not re-register after "
+                        "leadership change")
                 elif kind == "orphan" and expired:
                     self._move_tasks_to_orphaned(node_id)
             if ts - last_flush >= interval:

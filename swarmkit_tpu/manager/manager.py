@@ -27,6 +27,7 @@ from ..orchestrator import (
 )
 from ..scheduler import Scheduler
 from ..security.ca import CAServer, RootCA
+from ..state.events import Event, EventSnapshotRestore
 from ..state.store import ByName, MemoryStore
 from ..utils import new_id
 from .allocator import Allocator
@@ -35,6 +36,7 @@ from .dispatcher import Config_ as DispatcherConfig, Dispatcher
 from .keymanager import KeyManager
 from .logbroker import LogBroker
 from .metrics import Collector
+from .rolemanager import RoleManager
 from .watchapi import WatchServer
 
 log = logging.getLogger("manager")
@@ -77,10 +79,17 @@ class Manager:
         self.constraint_enforcer: Optional[ConstraintEnforcer] = None
         self.volume_enforcer: Optional[VolumeEnforcer] = None
         self.keymanager: Optional[KeyManager] = None
+        self.role_manager: Optional[RoleManager] = None
 
         self._mu = threading.Lock()
         self._running = False
         self._is_leader = False
+        # advertised raft-transport addresses of known managers, exchanged
+        # through the raft_join RPC so joining managers can dial peers
+        self.raft_peer_addrs: dict = {}
+        # this manager's own (and any locally-known) remote-API addresses;
+        # merged with the raft-replicated set in manager_api_addrs()
+        self.api_addrs: dict = {}
         # leadership transitions apply strictly in arrival order: raft can
         # flap faster than loops start/stop, and out-of-order application
         # would leave a live leader with its control loops stopped
@@ -100,10 +109,50 @@ class Manager:
             self.raft.on_leadership = self._on_leadership
             if self.raft.is_leader:
                 self._on_leadership(True)
+            # followers adopt replicated CA state (key + join tokens) as
+            # the cluster object arrives/changes, so they can validate
+            # join tokens and certs without ever having led (reference:
+            # every manager loads the cluster's security config)
+            self._ca_sub = self.store.queue.subscribe(
+                lambda ev: isinstance(ev, EventSnapshotRestore)
+                or (isinstance(ev, Event) and isinstance(ev.obj, Cluster)))
+            self._adopt_ca_state()
+            self._ca_worker = threading.Thread(
+                target=self._ca_adoption_loop, name="ca-adoption",
+                daemon=True)
+            self._ca_worker.start()
         self._running = True
+
+    def _adopt_ca_state(self) -> None:
+        clusters = self.store.view(
+            lambda tx: tx.find(Cluster, ByName(DEFAULT_CLUSTER_NAME)))
+        if not clusters:
+            return
+        state = clusters[0].root_ca
+        if state is not None and state.ca_key:
+            self.root_ca.key = state.ca_key
+            self.root_ca.restore_join_tokens(state.join_tokens)
+
+    def _ca_adoption_loop(self) -> None:
+        while self._running:
+            try:
+                ev = self._ca_sub.get(timeout=0.5)
+            except TimeoutError:
+                continue
+            except Exception:
+                return   # queue closed (Closed) or shutdown
+            if ev is None:
+                continue
+            try:
+                self._adopt_ca_state()
+            except Exception:
+                log.exception("CA state adoption failed")
 
     def stop(self) -> None:
         self._running = False
+        if getattr(self, "_ca_sub", None) is not None:
+            self.store.queue.unsubscribe(self._ca_sub)
+            self._ca_sub = None
         self._become_follower()
         self.collector.stop()
         self.logbroker.close()
@@ -204,11 +253,64 @@ class Manager:
             self.constraint_enforcer = ConstraintEnforcer(self.store)
             self.volume_enforcer = VolumeEnforcer(self.store)
             self.keymanager = KeyManager(self.store)
+            self.role_manager = RoleManager(self.store,
+                                            raft_node=self.raft)
             for loop in (self.allocator, self.scheduler, self.replicated,
                          self.global_, self.jobs, self.reaper,
                          self.constraint_enforcer, self.volume_enforcer,
-                         self.keymanager):
+                         self.keymanager, self.role_manager):
                 loop.start()
+
+    def manager_api_addrs(self) -> list:
+        """Remote-API addresses of all known managers (replicated via
+        conf entries), distributed to agents in heartbeat responses so
+        they can fail over (reference: session Message.Managers)."""
+        addrs = {}
+        if self.raft is not None:
+            addrs.update(self.raft.core.api_addrs)
+        addrs.update(self.api_addrs)
+        return [list(a) for a in addrs.values()]
+
+    def join_raft(self, node_id: str, addr=None, api_addr=None) -> dict:
+        """Leader-side manager join: adds the caller to the raft group
+        and returns the known peer transport addresses (reference:
+        raft.go:926 Join RPC; called by a promoted node's manager at
+        startup).  The caller must hold a MANAGER certificate — enforced
+        by the network layer."""
+        import base64
+        if self.raft is None:
+            raise RuntimeError("standalone manager has no raft group")
+        if not self.raft.is_leader:
+            # only the leader can change membership; hand the caller the
+            # leader's API address when we know it (reference: raft.go
+            # Join forwards to the leader)
+            leader = self.raft.leader_id
+            redirect = self.raft.core.api_addrs.get(leader)
+            if redirect is not None:
+                return {"redirect": list(redirect)}
+            raise RuntimeError(
+                "not the raft leader and the leader's API address is "
+                "unknown; retry against the leader")
+        # membership only changes on the hop that carries the joiner's
+        # transport address: the address-less first hop (which fetches the
+        # CA key before the joiner can even bind its transport) must not
+        # add a member that may never start — a dead phantom peer would
+        # wedge quorum permanently on small clusters
+        if addr is not None and node_id not in self.raft.core.peers:
+            self.raft.add_member(node_id, tuple(addr),
+                                 tuple(api_addr) if api_addr else None)
+        members = {k: list(v) for k, v in self.raft_peer_addrs.items()}
+        # replicated addresses (conf entries/snapshots) are authoritative
+        members.update({k: list(v)
+                        for k, v in self.raft.core.peer_addrs.items()})
+        if addr is not None:
+            members[node_id] = list(addr)
+            self.raft_peer_addrs[node_id] = tuple(addr)
+        # managers co-hold the cluster root key (the reference ships CA
+        # material to joining managers via the certificate response,
+        # ca/certificates.go); the RPC is MANAGER-cert gated
+        return {"members": members,
+                "ca_key": base64.b64encode(self.root_ca.key).decode()}
 
     def _become_follower(self) -> None:
         """reference: manager.go:1150 becomeFollower."""
@@ -217,7 +319,8 @@ class Manager:
                 return
             self._is_leader = False
             log.info("manager %s lost leadership", self.node_id[:8])
-            loops = [self.keymanager, self.volume_enforcer,
+            loops = [self.role_manager, self.keymanager,
+                     self.volume_enforcer,
                      self.constraint_enforcer, self.reaper, self.jobs,
                      self.global_, self.replicated, self.scheduler,
                      self.allocator, self.dispatcher]
@@ -232,3 +335,4 @@ class Manager:
             self.reaper = None
             self.constraint_enforcer = self.volume_enforcer = None
             self.keymanager = None
+            self.role_manager = None

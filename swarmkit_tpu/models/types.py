@@ -453,7 +453,9 @@ class OrchestrationConfig:
 
 @dataclass
 class DispatcherConfig:
-    heartbeat_period: float = 5.0
+    # 0 = unset: the manager's configured default applies (reference:
+    # api/types.proto DispatcherConfig.heartbeat_period, 0 means default)
+    heartbeat_period: float = 0.0
 
     def copy(self) -> "DispatcherConfig":
         return dataclasses.replace(self)

@@ -1,9 +1,10 @@
 from .client import (
     RemoteControlClient, RemoteDispatcherClient, issue_certificate,
+    join_raft,
 )
 from .raft_transport import TCPRaftTransport
 from .server import ManagerServer
 
 __all__ = ["ManagerServer", "RemoteControlClient",
            "RemoteDispatcherClient", "TCPRaftTransport",
-           "issue_certificate"]
+           "issue_certificate", "join_raft"]

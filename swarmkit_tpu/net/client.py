@@ -25,7 +25,13 @@ from .wire import recv_frame, send_frame
 
 _COLLECTIONS = {t.collection: t for t in STORE_OBJECT_TYPES}
 
+class NotLeader(Exception):
+    """The contacted manager is not the leader (server code
+    'not_leader'); callers should rotate to another manager."""
+
+
 _ERROR_TYPES = {
+    "not_leader": NotLeader,
     "invalid_argument": InvalidArgument,
     "not_found": NotFound,
     "already_exists": AlreadyExists,
@@ -113,6 +119,29 @@ def issue_certificate(addr: Tuple[str, int], node_id: str,
         return Certificate.from_bytes(data.encode())
     finally:
         conn.close()
+
+
+def join_raft(addr: Tuple[str, int], certificate: Certificate,
+              node_id: str, raft_addr: Optional[Tuple[str, int]] = None,
+              api_addr: Optional[Tuple[str, int]] = None
+              ) -> Dict[str, Any]:
+    """Manager join: ask the leader to add us to the raft group; returns
+    the known peer transport addresses.  A follower answers with a
+    redirect to the leader's API address, which we chase (bounded)."""
+    for _ in range(3):
+        conn = _Connection(addr, certificate)
+        try:
+            resp = conn.call("raft_join", {
+                "node_id": node_id,
+                "addr": list(raft_addr) if raft_addr else None,
+                "api_addr": list(api_addr) if api_addr else None})
+        finally:
+            conn.close()
+        if "redirect" in resp:
+            addr = tuple(resp["redirect"])
+            continue
+        return resp
+    raise RemoteError("raft join kept getting redirected")
 
 
 class RemoteAssignmentStream:
@@ -204,8 +233,16 @@ class RemoteDispatcherClient:
         return result["session_id"], result["period"]
 
     def heartbeat(self, node_id: str, session_id: str) -> float:
-        return self._conn.call("heartbeat", {"node_id": node_id,
+        resp = self._conn.call("heartbeat", {"node_id": node_id,
                                              "session_id": session_id})
+        if isinstance(resp, dict):
+            # the server piggybacks the current manager list on heartbeats
+            # (reference: session Message.Managers); stash it for the
+            # failover layer to feed into its Remotes tracker
+            self.last_managers = [tuple(a) for a in
+                                  resp.get("managers", [])]
+            return resp["period"]
+        return resp
 
     def update_task_status(self, node_id: str, session_id: str,
                            updates: List[Tuple[str, TaskStatus]]) -> None:

@@ -29,6 +29,13 @@ from .wire import recv_frame, send_frame
 log = logging.getLogger("net.server")
 
 
+class NotLeaderError(Exception):
+    """This manager is not the leader: the dispatcher/control surface
+    lives on the leader (agents should rotate to another manager)."""
+
+    code = "not_leader"
+
+
 class ManagerServer:
     def __init__(self, manager, host: str = "127.0.0.1", port: int = 0):
         self.manager = manager
@@ -109,6 +116,13 @@ class ManagerServer:
             except OSError:
                 pass
 
+    def _dispatcher(self):
+        d = self.manager.dispatcher
+        if d is None:
+            raise NotLeaderError(
+                "this manager is not the leader; retry another manager")
+        return d
+
     @staticmethod
     def _require_cert(cert: Optional[Certificate], node_id: str = "") -> None:
         if cert is None:
@@ -124,6 +138,11 @@ class ManagerServer:
 
         # ---- CA (token-gated, no cert needed)
         if method == "issue_certificate":
+            # a follower validates against replicated cluster state; pull
+            # the latest adoption synchronously so a token minted on the
+            # leader moments ago is honored here too
+            if hasattr(m, "_adopt_ca_state"):
+                m._adopt_ca_state()
             issued = m.ca_server.issue_node_certificate(
                 params["node_id"], params["token"])
             return issued.to_bytes().decode()
@@ -131,25 +150,42 @@ class ManagerServer:
         # ---- dispatcher surface (cert-gated to the calling node)
         if method == "register":
             self._require_cert(cert, params["node_id"])
+            # leader check FIRST: the node-record write below proposes
+            # through raft, and a follower would surface that as an
+            # opaque internal error instead of a not_leader the client
+            # can rotate on
+            dispatcher = self._dispatcher()
             description = serde.from_dict(
                 NodeDescription, params.get("description"))
             self._ensure_node_registered(params["node_id"], cert,
                                          description)
-            session, period = m.dispatcher.register(
+            session, period = dispatcher.register(
                 params["node_id"], description=description)
             return {"session_id": session, "period": period}
         if method == "heartbeat":
             self._require_cert(cert, params["node_id"])
-            return m.dispatcher.heartbeat(params["node_id"],
-                                          params["session_id"])
+            period = self._dispatcher().heartbeat(params["node_id"],
+                                                  params["session_id"])
+            return {"period": period, "managers": m.manager_api_addrs()}
         if method == "update_task_status":
             self._require_cert(cert, params["node_id"])
             updates = [(u["task_id"],
                         serde.from_dict(TaskStatus, u["status"]))
                        for u in params["updates"]]
-            m.dispatcher.update_task_status(
+            self._dispatcher().update_task_status(
                 params["node_id"], params["session_id"], updates)
             return "ok"
+
+        # ---- manager join (MANAGER-cert gated)
+        if method == "raft_join":
+            self._require_cert(cert, params["node_id"])
+            from ..models.types import NodeRole
+            if NodeRole(cert.role) != NodeRole.MANAGER:
+                raise SecurityError(
+                    "a manager certificate is required to join raft")
+            return m.join_raft(params["node_id"],
+                               addr=params.get("addr"),
+                               api_addr=params.get("api_addr"))
 
         # ---- control surface (cert-gated; the reference gates on the
         # manager/user role — here any valid cluster cert)
@@ -232,7 +268,7 @@ class ManagerServer:
                             cert: Optional[Certificate],
                             params: Dict[str, Any], rid) -> None:
         self._require_cert(cert, params["node_id"])
-        stream = self.manager.dispatcher.open_assignments(
+        stream = self._dispatcher().open_assignments(
             params["node_id"], params["session_id"])
         send_frame(sock, {"id": rid, "result": "streaming"})
         try:

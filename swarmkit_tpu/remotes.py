@@ -41,6 +41,11 @@ class Remotes:
         (reference: remotes.go Observe / ObserveIfExists)."""
         addr = tuple(addr)
         with self._mu:
+            if addr not in self._weights and weight < 0:
+                # ObserveIfExists semantics: a failure against a peer we
+                # no longer track (e.g. just removed after demotion) must
+                # not resurrect it into the selection pool
+                return
             cur = self._weights.get(addr, 0)
             if weight >= 0:
                 self._weights[addr] = min(
@@ -132,10 +137,11 @@ class FailoverDispatcherClient:
                 self._client = self._factory(self._current)
             return self._current, self._client
 
-    def _fail(self, addr: Addr) -> None:
-        self.broker.observe_failure(addr)
+    def _rotate(self, addr: Addr) -> None:
+        """Drop the cached client so the next call picks a different
+        manager (does not itself touch health weights)."""
         with self._mu:
-            self._last_failed = addr   # next pick avoids the failed peer
+            self._last_failed = addr   # next pick avoids this peer
             if self._current == addr:
                 try:
                     self._client.close()
@@ -144,17 +150,42 @@ class FailoverDispatcherClient:
                 self._client = None
                 self._current = None
 
+    def _fail(self, addr: Addr) -> None:
+        self.broker.observe_failure(addr)
+        self._rotate(addr)
+
     def _call(self, method: str, *args, **kwargs):
         addr, client = self._get()
         try:
             result = getattr(client, method)(*args, **kwargs)
             self.broker.observe_success(addr)
+            # heartbeat responses piggyback the live manager list: add
+            # newcomers so we can fail over to managers that joined after
+            # we did, and prune departed ones so removed/demoted managers
+            # stop receiving failover picks (reference: session
+            # Message.Managers drives the agent's remotes the same way)
+            managers = getattr(client, "last_managers", None)
+            if managers:
+                desired = {tuple(m) for m in managers}
+                tracked = self.broker.remotes.weights()
+                for m in desired - set(tracked):
+                    self.broker.remotes.observe(
+                        m, DEFAULT_OBSERVATION_WEIGHT)
+                for m in set(tracked) - desired:
+                    self.broker.remotes.remove(m)
             return result
         except (ConnectionError, OSError, TimeoutError):
             # only transport failures indict the manager's health;
             # application errors (invalid session etc.) travelled over a
             # perfectly healthy link and must not shift weights
             self._fail(addr)
+            raise
+        except Exception as e:
+            from .net.client import NotLeader
+            if isinstance(e, NotLeader):
+                # a healthy follower: rotate to another manager without
+                # down-weighting it (it may become leader any moment)
+                self._rotate(addr)
             raise
 
     def register(self, node_id, description=None):

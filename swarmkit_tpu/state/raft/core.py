@@ -29,7 +29,8 @@ LEADER = "leader"
 
 ENTRY_NORMAL = 0
 ENTRY_NOOP = 1
-ENTRY_CONF = 2   # data = JSON {"op": "add"|"remove", "id": member id}
+ENTRY_CONF = 2   # data = JSON {"op": "add"|"remove", "id": member id,
+                 #                "addr": optional [host, port]}
 
 
 @dataclass
@@ -57,6 +58,14 @@ class Snapshot:
     # the peer set as of `index`: conf entries before the snapshot are
     # compacted away, so membership must travel with it (etcd ConfState)
     peers: List[str] = field(default_factory=list)
+    # transport addresses learned through conf entries: every member must
+    # be able to dial every other even if it never served their join RPC
+    # (the reference stores member addrs in the raft member list itself,
+    # membership/cluster.go)
+    peer_addrs: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    # managers' remote-API addresses: distributed to agents via heartbeat
+    # responses so they can fail over (reference: session Message.Managers)
+    api_addrs: Dict[str, Tuple[str, int]] = field(default_factory=dict)
 
 
 @dataclass
@@ -97,6 +106,8 @@ class RaftCore:
                  rng: Optional[random.Random] = None):
         self.id = node_id
         self.peers = set(peers) | {node_id}
+        self.peer_addrs: Dict[str, Tuple[str, int]] = {}
+        self.api_addrs: Dict[str, Tuple[str, int]] = {}
         self.election_tick = election_tick
         self.heartbeat_tick = heartbeat_tick
         self._rng = rng or random.Random()
@@ -114,6 +125,7 @@ class RaftCore:
         self.applied_index = 0
 
         self._elapsed = 0
+        self._stepdown_ticks = 0
         self._timeout = self._rand_timeout()
         self._votes: Dict[str, bool] = {}
         self.next_index: Dict[str, int] = {}
@@ -162,6 +174,18 @@ class RaftCore:
     def _rand_timeout(self) -> int:
         return self.election_tick + self._rng.randrange(self.election_tick)
 
+    def step_down(self) -> None:
+        """Leader voluntarily abdicates (demotion path).  Its own
+        campaigns are suppressed for a bounded window so another peer wins
+        the next election instead of this node flapping straight back into
+        leadership (it usually has the most up-to-date log); the bound
+        keeps a lone up-to-date survivor able to recover leadership if no
+        other peer can win (reference: raft.go:1134 TransferLeadership
+        targets a peer for the same reason)."""
+        if self.role == LEADER:
+            self._become_follower(self.term)
+        self._stepdown_ticks = 10 * self.election_tick
+
     # --------------------------------------------------------------- loading
 
     def load(self, hard_state: HardState, entries: List[Entry],
@@ -174,6 +198,12 @@ class RaftCore:
             self.applied_index = snapshot.index
             if snapshot.peers:
                 self.peers = set(snapshot.peers)
+            if snapshot.peer_addrs:
+                self.peer_addrs = {k: tuple(v)
+                                   for k, v in snapshot.peer_addrs.items()}
+            if snapshot.api_addrs:
+                self.api_addrs = {k: tuple(v)
+                                  for k, v in snapshot.api_addrs.items()}
         self.term = hard_state.term
         self.voted_for = hard_state.voted_for
         self.commit_index = max(self.commit_index, hard_state.commit)
@@ -198,6 +228,10 @@ class RaftCore:
                 if active <= len(self.peers) // 2:
                     self._become_follower(self.term)
         else:
+            if self._stepdown_ticks > 0:
+                self._stepdown_ticks -= 1
+                self._elapsed = 0
+                return
             self._elapsed += 1
             if self._elapsed >= self._timeout:
                 self._campaign()
@@ -269,7 +303,10 @@ class RaftCore:
         self._broadcast_append()
         return index
 
-    def propose_conf_change(self, op: str, member_id: str) -> int:
+    def propose_conf_change(self, op: str, member_id: str,
+                            addr: Optional[Tuple[str, int]] = None,
+                            api_addr: Optional[Tuple[str, int]] = None
+                            ) -> int:
         """Leader-only membership change (reference: raft.go Join :926 /
         Leave :1138 propose ConfChange entries).  Single-change-at-a-time
         semantics: a second change is refused until the first has been
@@ -281,22 +318,35 @@ class RaftCore:
                 "a membership change is already in flight")
         index = self.last_index() + 1
         self.pending_conf_index = index
+        change = {"op": op, "id": member_id}
+        if addr is not None:
+            change["addr"] = list(addr)
+        if api_addr is not None:
+            change["api_addr"] = list(api_addr)
         self._append(Entry(term=self.term, index=index,
-                           data=_json.dumps({"op": op,
-                                             "id": member_id}).encode(),
+                           data=_json.dumps(change).encode(),
                            type=ENTRY_CONF))
         self._broadcast_append()
         return index
 
-    def apply_conf_change(self, op: str, member_id: str) -> None:
+    def apply_conf_change(self, op: str, member_id: str,
+                          addr: Optional[Tuple[str, int]] = None,
+                          api_addr: Optional[Tuple[str, int]] = None
+                          ) -> None:
         """Called by the driver when an ENTRY_CONF commits."""
         if op == "add":
             self.peers.add(member_id)
+            if addr is not None:
+                self.peer_addrs[member_id] = tuple(addr)
+            if api_addr is not None:
+                self.api_addrs[member_id] = tuple(api_addr)
             if self.role == LEADER and member_id not in self.next_index:
                 self.next_index[member_id] = self.last_index() + 1
                 self.match_index[member_id] = 0
         elif op == "remove":
             self.peers.discard(member_id)
+            self.peer_addrs.pop(member_id, None)
+            self.api_addrs.pop(member_id, None)
             self.next_index.pop(member_id, None)
             self.match_index.pop(member_id, None)
             if member_id == self.id:

@@ -91,6 +91,7 @@ class RaftNode(Proposer):
             self._apply_entry(e, replay=True)
             self.core.applied_index = e.index
 
+        self._sync_transport_from_core()
         transport.register(node_id, self._inbox.put)
 
     # ------------------------------------------------------------- lifecycle
@@ -126,7 +127,7 @@ class RaftNode(Proposer):
                     self.core.tick()
                 elif isinstance(item, Message):
                     self.core.step(item)
-                elif isinstance(item, tuple):   # local proposal
+                elif isinstance(item, tuple):   # local proposal/command
                     self._handle_proposal(*item)
                 # drain any further queued items before processing ready
                 while True:
@@ -150,13 +151,26 @@ class RaftNode(Proposer):
             self._done.set()
 
     def _handle_proposal(self, *item) -> None:
+        if item[0] == "stepdown":
+            if self.core.role == LEADER:
+                self.core.step_down()
+            return
         if item[0] == "conf":
-            _, op, member_id, waiter = item
+            _, op, member_id, addr, api_addr, waiter = item
             if not self.core.leader_ready:
                 waiter.ok = False
                 waiter.event.set()
                 return
-            index = self.core.propose_conf_change(op, member_id)
+            try:
+                index = self.core.propose_conf_change(op, member_id, addr,
+                                                      api_addr)
+            except RuntimeError:
+                # a membership change is already in flight: fail this
+                # waiter (callers retry); never let the error kill the
+                # raft event loop
+                waiter.ok = False
+                waiter.event.set()
+                return
             waiter.term = self.core.term
             waiter.index = index
             self._local_indices.add(index)
@@ -185,6 +199,7 @@ class RaftNode(Proposer):
                 self.store.restore_bytes(rd.snapshot.data)
                 self._last_snap_applied = rd.snapshot.index
                 self.stats["snapshots"] += 1
+                self._sync_transport_from_core()
             # 2. send messages (attach snapshot payloads)
             for m in rd.messages:
                 if m.type == "snap" and m.snapshot is not None \
@@ -203,12 +218,36 @@ class RaftNode(Proposer):
 
     # -------------------------------------------------------------- applying
 
+    def _sync_transport_peer(self, op: str, member_id: str, addr) -> None:
+        """Keep the transport's dialing table in lockstep with replicated
+        membership, so every member can reach every other after leader
+        failures and restarts (addresses arrive via conf entries and
+        snapshots, not just via whoever served the join RPC)."""
+        if member_id == self.id:
+            return
+        if op == "add" and addr and hasattr(self.transport, "set_peer"):
+            self.transport.set_peer(member_id, tuple(addr))
+        elif op == "remove" and hasattr(self.transport, "remove_peer"):
+            self.transport.remove_peer(member_id)
+
+    def _sync_transport_from_core(self) -> None:
+        if hasattr(self.transport, "set_peer"):
+            for nid, addr in self.core.peer_addrs.items():
+                if nid != self.id:
+                    self.transport.set_peer(nid, tuple(addr))
+
     def _apply_entry(self, e: Entry, replay: bool = False) -> None:
         if e.type == ENTRY_CONF:
             import json as _json
             try:
                 change = _json.loads(e.data)
-                self.core.apply_conf_change(change["op"], change["id"])
+                addr = change.get("addr")
+                api_addr = change.get("api_addr")
+                self.core.apply_conf_change(
+                    change["op"], change["id"],
+                    tuple(addr) if addr else None,
+                    tuple(api_addr) if api_addr else None)
+                self._sync_transport_peer(change["op"], change["id"], addr)
                 log.info("membership change applied: %s %s",
                          change["op"], change["id"])
             except Exception:
@@ -266,7 +305,9 @@ class RaftNode(Proposer):
         index = self.core.applied_index
         snap = Snapshot(index=index, term=self.core._term_at(index) or 0,
                         data=self.store.save_bytes(),
-                        peers=sorted(self.core.peers))
+                        peers=sorted(self.core.peers),
+                        peer_addrs=dict(self.core.peer_addrs),
+                        api_addrs=dict(self.core.api_addrs))
         self.logger.save_snapshot(snap, index)
         self.core.compact(index, snap.term)
         self.stats["snapshots"] += 1
@@ -292,19 +333,29 @@ class RaftNode(Proposer):
 
     # ------------------------------------------------------------ membership
 
-    def _propose_conf(self, op: str, member_id: str) -> None:
+    def _propose_conf(self, op: str, member_id: str, addr=None,
+                      api_addr=None) -> None:
         if not self.core.leader_ready:
             raise NotLeader(f"{self.id} is not a ready leader")
         waiter = _Waiter(event=threading.Event(), term=self.core.term,
                         index=0)
-        self._inbox.put(("conf", op, member_id, waiter))
-        waiter.event.wait(timeout=30)
+        self._inbox.put(("conf", op, member_id, addr, api_addr, waiter))
+        waiter.event.wait(timeout=10)
         if not waiter.ok:
             raise ProposalDropped("membership change dropped")
 
-    def add_member(self, member_id: str) -> None:
-        """Leader-side join (reference: raft.go:926 Join)."""
-        self._propose_conf("add", member_id)
+    def step_down(self) -> None:
+        """Voluntarily relinquish leadership (used before self-demotion;
+        reference: raft.go:1225 TransferLeadership)."""
+        self._inbox.put(("stepdown",))
+
+    def add_member(self, member_id: str, addr=None,
+                   api_addr=None) -> None:
+        """Leader-side join (reference: raft.go:926 Join).  ``addr`` is
+        the member's raft transport address and ``api_addr`` its remote
+        API address; both replicate with the conf entry so every member
+        can dial the newcomer and agents can fail over to it."""
+        self._propose_conf("add", member_id, addr, api_addr)
 
     def remove_member(self, member_id: str) -> None:
         """Leader-side leave/demote (reference: raft.go:1138 Leave)."""

@@ -85,6 +85,10 @@ class RaftLogger:
         record = json.dumps({
             "index": snapshot.index, "term": snapshot.term,
             "peers": list(snapshot.peers),
+            "peer_addrs": {k: list(v)
+                           for k, v in snapshot.peer_addrs.items()},
+            "api_addrs": {k: list(v)
+                          for k, v in snapshot.api_addrs.items()},
             "data": base64.b64encode(
                 self.encoder.encode(snapshot.data)).decode("ascii"),
         }, sort_keys=True).encode()
@@ -163,6 +167,10 @@ class RaftLogger:
             return Snapshot(
                 index=rec["index"], term=rec["term"],
                 peers=list(rec.get("peers", [])),
+                peer_addrs={k: tuple(v) for k, v in
+                            rec.get("peer_addrs", {}).items()},
+                api_addrs={k: tuple(v) for k, v in
+                           rec.get("api_addrs", {}).items()},
                 data=self.encoder.decode(base64.b64decode(rec["data"])))
         except Exception:
             return None

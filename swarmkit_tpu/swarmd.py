@@ -10,6 +10,11 @@ listen-remote-api flags; node.New/Start wiring).
     # worker joining it
     python -m swarmkit_tpu.swarmd --state-dir /tmp/w0 \
         --join-addr 127.0.0.1:4242 --join-token SWMTKN-1-...
+
+    # second manager joining the raft group (manager token)
+    python -m swarmkit_tpu.swarmd --manager --state-dir /tmp/m1 \
+        --join-addr 127.0.0.1:4242 --join-token SWMTKN-1-<manager> \
+        --listen-remote-api 127.0.0.1:4243
 """
 
 from __future__ import annotations
@@ -25,7 +30,11 @@ log = logging.getLogger("swarmd")
 
 def parse_addr(text: str) -> Tuple[str, int]:
     host, _, port = text.rpartition(":")
-    return (host or "127.0.0.1", int(port))
+    try:
+        return (host or "127.0.0.1", int(port))
+    except ValueError:
+        raise SystemExit(
+            f"invalid address {text!r}: expected host:port")
 
 
 class Swarmd:
@@ -51,35 +60,57 @@ class Swarmd:
         self.manager = None
         self.server = None
         self.node = None
+        self.raft_node = None
+        self.raft_transport = None
 
     def start(self) -> None:
         from .node import Node
 
+        if self.is_manager and self.join_addr is not None:
+            self._start_joining_manager()
+            return
+
         if self.is_manager:
-            from .manager import Manager
-            from .net import ManagerServer
+            from .security import RootCA
 
-            self.manager = Manager(
-                use_device_scheduler=self.use_device_scheduler)
-            self.manager.run()
-            if self.listen_remote_api is not None:
-                self.server = ManagerServer(
-                    self.manager, host=self.listen_remote_api[0],
-                    port=self.listen_remote_api[1])
-                self.server.start()
-                log.info("remote API on %s:%d", *self.server.addr)
-
-            # the manager node also runs an agent against itself
-            self.node = Node(self.executor, self.state_dir)
-            token = self.manager.root_ca.join_token(0)
-            self.node.load_or_join(self.manager.ca_server, token)
-            self.node.start(self.manager.dispatcher,
-                            store=self.manager.store,
-                            hostname=self.hostname)
-            log.info("manager up; worker join token: %s",
-                     self.manager.root_ca.join_token(0))
-            log.info("manager join token: %s",
-                     self.manager.root_ca.join_token(1))
+            # a manager is raft-backed from the start so later managers
+            # can join its group over the raft_join RPC (reference:
+            # manager.go:217 becomes the raft founder).  A restart reuses
+            # the persisted CA key + raft listen port: peers know us by
+            # that address, and the transport HMAC key must match theirs.
+            state = self._load_manager_state()
+            ca = RootCA(state["ca_key"]) if state else RootCA()
+            raft_port = state["raft_port"] if state else 0
+            api_port = state["api_port"] if state else 0
+            self._build_raft_manager(ca, raft_port=raft_port)
+            # fresh bootstrap (or lone survivor): we must become leader;
+            # a restarted member of a larger group follows whoever leads
+            if len(self.raft_node.core.peers) == 1:
+                self._wait(lambda: self.raft_node.is_leader
+                           and self.raft_node.core.leader_ready,
+                           "bootstrap raft never elected")
+                self._wait(lambda: self.manager.is_leader
+                           and self.manager.dispatcher is not None,
+                           "manager never took leadership")
+                # restart adoption may swap in the persisted cluster's key
+                self.raft_transport.auth_key = self.manager.root_ca.key
+            self._start_remote_api(port_override=api_port)
+            if self.server is not None:
+                self.manager.api_addrs["m-" + self.hostname] = \
+                    self.server.addr
+                if self.raft_node.is_leader:
+                    # replicate our API address so agents can fail over
+                    # to us and followers can redirect joins
+                    self.raft_node.add_member(
+                        "m-" + self.hostname, self.raft_transport.addr,
+                        self.server.addr)
+            self._save_manager_state()
+            self._start_manager_agent()
+            if self.manager.is_leader:
+                log.info("manager up; worker join token: %s",
+                         self.manager.root_ca.join_token(0))
+                log.info("manager join token: %s",
+                         self.manager.root_ca.join_token(1))
             return
 
         if self.join_addr is None or not self.join_token:
@@ -98,6 +129,13 @@ class Swarmd:
             cert, _ = self.node.key_rw.read()
         except (FileNotFoundError, SecurityError):
             pass
+        if cert is not None and not self._cert_accepted(cert):
+            # a cert from a rebuilt/foreign cluster would make every
+            # register() fail with an application-level SecurityError the
+            # failover client rightly never retries around — fall back to
+            # the operator's join token instead (node.py load_or_join does
+            # the same verify-then-rejoin dance against a local CA)
+            cert = None
         if cert is None:
             cert = issue_certificate(self.join_addr, self.node.node_id,
                                      self.join_token)
@@ -113,6 +151,253 @@ class Swarmd:
         log.info("worker %s joined %s", self.node.node_id[:8],
                  self.join_addr)
 
+    def _wait(self, cond, err: str, timeout: float = 20.0) -> None:
+        deadline = time.time() + timeout
+        while not cond():
+            if time.time() > deadline:
+                raise RuntimeError(err)
+            time.sleep(0.02)
+
+    def _cert_accepted(self, cert) -> bool:
+        """Probe the remote hello with the persisted cert: the server
+        verifies certificates during the handshake, so a SecurityError
+        here means the cert does not belong to this cluster."""
+        from .net.client import RemoteDispatcherClient
+        from .security.ca import SecurityError
+        try:
+            probe = RemoteDispatcherClient(self.join_addr, cert)
+            try:
+                probe.heartbeat(cert.node_id, "")
+            finally:
+                probe.close()
+        except (SecurityError, PermissionError):
+            # the wire client surfaces the server's "unauthenticated"
+            # hello rejection as PermissionError (net/client.py error map)
+            return False
+        except Exception:
+            pass   # app-level errors arrive only after an accepted hello
+        return True
+
+    def _start_joining_manager(self) -> None:
+        """Join an existing cluster as an additional manager: manager
+        cert via the join token, CA key + peer addresses via an
+        address-less first raft_join hop, membership via the second hop
+        that advertises our transport address, then a raft-backed Manager
+        that follows the current leader (reference: manager.go
+        JoinAndStart -> Join RPC).  A restart skips the RPCs entirely:
+        membership and addresses replay from the WAL."""
+        import base64
+
+        from .net import issue_certificate, join_raft
+        from .node import Node
+        from .remotes import (
+            ConnectionBroker, FailoverDispatcherClient, Remotes,
+        )
+        from .security import RootCA
+
+        raft_id = "m-" + self.hostname
+        state = self._load_manager_state()
+        if state is not None:
+            # restart: peers + addresses replay from the raft WAL
+            self._build_raft_manager(RootCA(state["ca_key"]),
+                                     raft_port=state["raft_port"])
+            self.node = Node(self.executor, self.state_dir,
+                             node_id=raft_id)
+            cert, _ = self.node.key_rw.read()
+            self._start_remote_api(port_override=state["api_port"])
+        else:
+            if not self.join_token:
+                raise SystemExit("manager join needs --join-token")
+            cert = None
+            for attempt in range(10):
+                try:
+                    cert = issue_certificate(self.join_addr, raft_id,
+                                             self.join_token)
+                    break
+                except PermissionError:
+                    # a follower that has not yet adopted the replicated
+                    # cluster state rejects fresh tokens momentarily
+                    if attempt == 9:
+                        raise
+                    time.sleep(0.5)
+            # first hop: fetch the cluster CA key (authenticates the raft
+            # transport) WITHOUT advertising an address — membership only
+            # changes on the second hop, so dying here leaves no phantom
+            # peer wedging quorum
+            boot = join_raft(self.join_addr, cert, raft_id)
+            ca_key = base64.b64decode(boot["ca_key"])
+            self._build_raft_manager(RootCA(ca_key), raft_port=0,
+                                     defer_start=True)
+            self._start_remote_api()
+            resp = None
+            for attempt in range(20):
+                try:
+                    resp = join_raft(
+                        self.join_addr, cert, raft_id,
+                        raft_addr=self.raft_transport.addr,
+                        api_addr=self.server.addr if self.server else None)
+                    break
+                except Exception as e:
+                    # the leader serializes membership changes; concurrent
+                    # joins are a normal, momentary condition
+                    log.info("raft join attempt %d failed (%s); retrying",
+                             attempt + 1, e)
+                    time.sleep(0.5)
+            if resp is None:
+                raise RuntimeError("could not join the raft group")
+            for nid, addr in resp["members"].items():
+                if nid != raft_id and addr is not None:
+                    self.raft_transport.set_peer(nid, tuple(addr))
+                    self.raft_node.core.peers.add(nid)
+                    self.raft_node.core.peer_addrs[nid] = tuple(addr)
+            self.raft_node.start()
+            self.manager.run()
+            self._save_manager_state()
+        if self.server is not None:
+            self.manager.api_addrs[raft_id] = self.server.addr
+
+        # this manager's agent talks to whichever manager leads, like any
+        # worker (a follower manager runs no dispatcher)
+        if self.node is None:
+            self.node = Node(self.executor, self.state_dir,
+                             node_id=raft_id)
+        self.node.certificate = cert
+        self.node.node_id = cert.node_id
+        self.node.key_rw.write(cert, b"")
+        self._start_agent_with_failover(cert, seed=self.join_addr)
+        log.info("manager %s joined raft group %s", raft_id,
+                 sorted(self.raft_node.core.peers))
+
+    # ------------------------------------------------------- manager wiring
+
+    def _start_manager_agent(self) -> None:
+        """Run this manager node's own agent.  Preferred wiring is the
+        failover client over the remote API (it survives leadership
+        moves — the in-process dispatcher dies with leadership); only an
+        API-less in-process leader binds its dispatcher directly."""
+        from .node import Node
+        from .security.ca import SecurityError
+
+        # the manager node's cluster identity IS its raft member id, so
+        # RoleManager can map Node records to raft voters (the reference
+        # uses one node id for both)
+        self.node = Node(self.executor, self.state_dir,
+                         node_id="m-" + self.hostname)
+        cert = None
+        try:
+            cert, _ = self.node.key_rw.read()
+        except (FileNotFoundError, SecurityError):
+            pass
+        if cert is None:
+            if self.manager.dispatcher is None:
+                # restarted follower with no persisted identity: nothing
+                # local can issue a cert (the CA serves on the leader)
+                log.warning("no persisted identity and not the leader; "
+                            "manager-node agent not started")
+                return
+            # a MANAGER certificate: this node's store record must carry
+            # the manager role or promotion/demotion can't act on it
+            from .models.types import NodeRole
+            token = self.manager.root_ca.join_token(NodeRole.MANAGER)
+            self.node.load_or_join(self.manager.ca_server, token)
+            cert = self.node.certificate
+        else:
+            self.node.certificate = cert
+            self.node.node_id = cert.node_id
+        if self.server is None:
+            self.node.start(self.manager.dispatcher,
+                            store=self.manager.store,
+                            hostname=self.hostname)
+            return
+        seeds = [self.server.addr]
+        seeds += [tuple(a) for a in self.raft_node.core.api_addrs.values()]
+        self._start_agent_with_failover(cert, *seeds)
+
+    def _start_agent_with_failover(self, cert, seed=None, *extra) -> None:
+        from .remotes import (
+            ConnectionBroker, FailoverDispatcherClient, Remotes,
+        )
+
+        addrs = ([tuple(seed)] if seed else []) + [tuple(a) for a in extra]
+        self.remotes = Remotes(*addrs)
+        client = FailoverDispatcherClient(
+            ConnectionBroker(self.remotes), cert)
+        self.node.start(client, hostname=self.hostname)
+
+    def _build_raft_manager(self, ca, raft_port: int = 0,
+                            defer_start: bool = False) -> None:
+        """Shared wiring for bootstrap and joining managers: TCP raft
+        transport, raft-backed store, and the Manager composition."""
+        import os
+
+        from .manager import Manager
+        from .net.raft_transport import TCPRaftTransport
+        from .state import MemoryStore
+        from .state.raft import RaftLogger, RaftNode
+
+        raft_id = "m-" + self.hostname
+        self.raft_transport = TCPRaftTransport(raft_id, port=raft_port,
+                                               auth_key=ca.key)
+        store = MemoryStore()
+        self.raft_node = RaftNode(
+            raft_id, [raft_id], store,
+            RaftLogger(os.path.join(self.state_dir, "raft")),
+            self.raft_transport)
+        store._proposer = self.raft_node
+        self.manager = Manager(
+            store=store, raft_node=self.raft_node, root_ca=ca,
+            use_device_scheduler=self.use_device_scheduler)
+        self.manager.raft_peer_addrs[raft_id] = self.raft_transport.addr
+        if not defer_start:
+            self.raft_node.start()
+            self.manager.run()
+
+    def _start_remote_api(self, port_override: int = 0) -> None:
+        from .net import ManagerServer
+
+        if self.listen_remote_api is not None:
+            port = self.listen_remote_api[1] or port_override
+            self.server = ManagerServer(
+                self.manager, host=self.listen_remote_api[0], port=port)
+            self.server.start()
+            log.info("remote API on %s:%d", *self.server.addr)
+
+    def _manager_state_path(self) -> str:
+        import os
+        return os.path.join(self.state_dir, "manager-state.json")
+
+    def _load_manager_state(self):
+        import json
+        try:
+            with open(self._manager_state_path()) as f:
+                rec = json.load(f)
+            return {"ca_key": bytes.fromhex(rec["ca_key"]),
+                    "raft_port": rec["raft_port"],
+                    "api_port": rec.get("api_port", 0)}
+        except (FileNotFoundError, KeyError, ValueError):
+            return None
+
+    def _save_manager_state(self) -> None:
+        """Persist what a restart cannot recover from the WAL: the CA
+        key that authenticates the raft transport (the reference keeps CA
+        material in the state dir too, node.go loadSecurityConfig) and our
+        raft listen port, which peers know us by."""
+        import json
+        import os
+
+        os.makedirs(self.state_dir, exist_ok=True)
+        tmp = self._manager_state_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "ca_key": self.manager.root_ca.key.hex(),
+                "raft_port": self.raft_transport.addr[1],
+                # the API port must survive restarts too: it replicated
+                # to the whole cluster via the join conf entry, and a
+                # follower cannot re-propose a changed address
+                "api_port": self.server.addr[1] if self.server else 0,
+            }, f)
+        os.replace(tmp, self._manager_state_path())
+
     def stop(self) -> None:
         if self.node is not None:
             self.node.stop()
@@ -120,6 +405,8 @@ class Swarmd:
             self.server.stop()
         if self.manager is not None:
             self.manager.stop()
+        if self.raft_node is not None:
+            self.raft_node.stop()
 
 
 def main(argv=None) -> int:   # pragma: no cover - thin CLI shell

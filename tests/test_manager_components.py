@@ -240,3 +240,68 @@ def test_manager_leadership_lifecycle():
         assert manager.scheduler is not None
     finally:
         manager.stop()
+
+
+def test_role_manager_promote_demote():
+    """Promotion joins raft then flips the observed role; demotion leaves
+    raft FIRST (reference: role_manager.go, design/raft.md:136-158)."""
+    from swarmkit_tpu.manager.rolemanager import RoleManager
+    from swarmkit_tpu.models.specs import NodeSpec
+
+    calls = []
+
+    class FakeRaft:
+        id = "m0"
+        is_leader = True
+
+        def __init__(self):
+            class Core:
+                peers = {"m0"}
+            self.core = Core()
+
+        def step_down(self):
+            calls.append(("stepdown",))
+
+        def add_member(self, nid):
+            calls.append(("add", nid))
+            self.core.peers.add(nid)
+
+        def remove_member(self, nid):
+            calls.append(("remove", nid))
+            self.core.peers.discard(nid)
+
+    store = MemoryStore()
+    raft = FakeRaft()
+    rm = RoleManager(store, raft_node=raft)
+    n = Node(id=new_id(),
+             spec=NodeSpec(annotations=Annotations(name="w1"),
+                           desired_role=NodeRole.WORKER),
+             role=int(NodeRole.WORKER))
+    store.update(lambda tx: tx.create(n))
+    rm.start()
+    try:
+        # promote
+        def promote(tx):
+            cur = tx.get(Node, n.id).copy()
+            cur.spec.desired_role = NodeRole.MANAGER
+            tx.update(cur)
+        store.update(promote)
+        poll(lambda: store.view(lambda tx: tx.get(Node, n.id)).role
+             == int(NodeRole.MANAGER))
+        # membership is NOT added eagerly: the promoted node's manager
+        # process joins raft itself when it starts
+        assert ("add", n.id) not in calls
+        raft.core.peers.add(n.id)   # simulate its manager joining
+
+        # demote: raft removal precedes the role flip
+        def demote(tx):
+            cur = tx.get(Node, n.id).copy()
+            cur.spec.desired_role = NodeRole.WORKER
+            tx.update(cur)
+        store.update(demote)
+        poll(lambda: store.view(lambda tx: tx.get(Node, n.id)).role
+             == int(NodeRole.WORKER))
+        assert ("remove", n.id) in calls
+        assert n.id not in raft.core.peers
+    finally:
+        rm.stop()

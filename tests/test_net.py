@@ -156,3 +156,62 @@ def test_raft_over_tcp(tmp_path):
     finally:
         for m in members.values():
             m.stop()
+
+
+def test_manager_raft_join_rpc(tmp_path):
+    """A promoted node's manager joins the raft group over the network:
+    manager-cert gated, returns peer addresses, membership grows."""
+    import os
+
+    from swarmkit_tpu.models.types import NodeRole
+    from swarmkit_tpu.net import join_raft
+    from swarmkit_tpu.state.raft import LocalNetwork, RaftLogger, RaftNode
+
+    net = LocalNetwork()
+    store = MemoryStore()
+    rn = RaftNode("m0", ["m0"], store,
+                  RaftLogger(os.path.join(tmp_path, "m0")), net)
+    store._proposer = rn
+    rn.start()
+    poll(lambda: rn.is_leader, timeout=10)
+
+    manager = Manager(store=store, raft_node=rn,
+                      dispatcher_config=fast_cfg(),
+                      use_device_scheduler=False)
+    manager.raft_peer_addrs["m0"] = ("127.0.0.1", 12345)
+    manager.run()
+    server = ManagerServer(manager)
+    server.start()
+    try:
+        poll(lambda: manager.is_leader, timeout=10)
+        worker_cert = manager.root_ca.issue("joiner", NodeRole.WORKER)
+        with pytest.raises(Exception):
+            join_raft(server.addr, worker_cert, "joiner")
+
+        mgr_cert = manager.root_ca.issue("m1", NodeRole.MANAGER)
+        # the join wedges quorum until the member starts, so start it
+        # right after the RPC
+        import threading
+
+        def start_member():
+            store2 = MemoryStore()
+            rn2 = RaftNode("m1", ["m0", "m1"], store2,
+                           RaftLogger(os.path.join(tmp_path, "m1")), net)
+            store2._proposer = rn2
+            rn2.start()
+            return rn2
+
+        result = join_raft(server.addr, mgr_cert, "m1",
+                           raft_addr=("127.0.0.1", 23456))
+        assert "m0" in result["members"]
+        assert "m1" in rn.core.peers
+        rn2 = start_member()
+        try:
+            poll(lambda: rn2.core.commit_index > 0, timeout=15,
+                 msg="joined manager should replicate")
+        finally:
+            rn2.stop()
+    finally:
+        server.stop()
+        manager.stop()
+        rn.stop()

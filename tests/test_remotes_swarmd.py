@@ -106,8 +106,10 @@ def test_swarmd_manager_and_remote_worker():
         worker.start()
 
         api = mgr_daemon.manager.control_api
-        poll(lambda: len(api.list_nodes()) == 2,
-             msg="both swarmd nodes should register")
+        from swarmkit_tpu.models.types import NodeState
+        poll(lambda: [n.status.state for n in api.list_nodes()]
+             == [NodeState.READY] * 2,
+             msg="both swarmd nodes should register and turn READY")
 
         svc = api.create_service(make_replicated("web", 4).spec)
         poll(lambda: len([t for t in api.list_tasks(service_id=svc.id)
@@ -139,3 +141,175 @@ def test_dispatcher_live_heartbeat_reload():
              msg="heartbeat period should reload from cluster spec")
     finally:
         mgr.stop()
+
+
+def test_swarmd_manager_join_forms_raft_group():
+    """A second swarmd --manager with --join-addr + manager token joins the
+    bootstrap manager's raft group and replicates its state."""
+    from swarmkit_tpu.models.types import NodeRole
+
+    m0 = Swarmd(state_dir=tempfile.mkdtemp(), hostname="m0",
+                manager=True, listen_remote_api=("127.0.0.1", 0),
+                use_device_scheduler=False)
+    m0.start()
+    m1 = None
+    try:
+        assert m0.raft_node is not None, "bootstrap manager is raft-backed"
+        token = m0.manager.root_ca.join_token(NodeRole.MANAGER)
+        m1 = Swarmd(state_dir=tempfile.mkdtemp(), hostname="m1",
+                    manager=True, join_addr=m0.server.addr,
+                    join_token=token, use_device_scheduler=False)
+        m1.start()
+
+        assert "m-m1" in m0.raft_node.core.peers
+        assert not m1.manager.is_leader    # follower of m0
+
+        api = m0.manager.control_api
+        poll(lambda: len(api.list_nodes()) == 2,
+             msg="both manager-node agents should register")
+        svc = api.create_service(make_replicated("ha", 2).spec)
+        # replicated through raft into the joined manager's store
+        from swarmkit_tpu.models import Service
+        poll(lambda: m1.manager.store.view(
+            lambda tx: tx.get(Service, svc.id)) is not None,
+             msg="service should replicate to the joined manager")
+        poll(lambda: len([t for t in api.list_tasks(service_id=svc.id)
+                          if t.status.state == TaskState.RUNNING
+                          and t.desired_state == TaskState.RUNNING]) == 2,
+             timeout=30, msg="replicas run across both manager nodes")
+    finally:
+        if m1 is not None:
+            m1.stop()
+        m0.stop()
+
+
+def test_swarmd_three_managers_survive_leader_death():
+    """m1 and m2 both join via m0; their transport addresses replicate
+    through conf entries, so when m0 dies the survivors can still dial
+    each other and elect a new leader (2-of-3 quorum)."""
+    from swarmkit_tpu.models.types import NodeRole
+
+    m0 = Swarmd(state_dir=tempfile.mkdtemp(), hostname="m0",
+                manager=True, listen_remote_api=("127.0.0.1", 0),
+                use_device_scheduler=False)
+    m0.start()
+    token = m0.manager.root_ca.join_token(NodeRole.MANAGER)
+    joiners = []
+    try:
+        for h in ("m1", "m2"):
+            d = Swarmd(state_dir=tempfile.mkdtemp(), hostname=h,
+                       manager=True, join_addr=m0.server.addr,
+                       join_token=token, use_device_scheduler=False)
+            d.start()
+            joiners.append(d)
+        m1, m2 = joiners
+        # the address of m2 (joined later) must have replicated to m1
+        poll(lambda: "m-m2" in m1.raft_node.core.peer_addrs,
+             msg="later joiner's address replicates to earlier joiner")
+
+        m0.stop()
+        new_leader = poll(
+            lambda: next((d for d in joiners if d.raft_node.is_leader),
+                         None),
+            timeout=30, msg="survivors should elect a leader without m0")
+        poll(lambda: new_leader.manager.is_leader, timeout=20,
+             msg="manager leadership follows raft")
+        # the new leader can still commit (quorum = itself + the other
+        # survivor)
+        svc = new_leader.manager.control_api.create_service(
+            make_replicated("post-failover", 1).spec)
+        assert svc.id
+    finally:
+        for d in joiners:
+            d.stop()
+
+
+def test_swarmd_bootstrap_manager_restart(tmp_path):
+    """A raft-backed bootstrap manager restarted on the same state dir
+    reuses its CA key and raft port and recovers its cluster state."""
+    state_dir = str(tmp_path)
+    m = Swarmd(state_dir=state_dir, hostname="m0", manager=True,
+               listen_remote_api=("127.0.0.1", 0),
+               use_device_scheduler=False)
+    m.start()
+    api = m.manager.control_api
+    svc = api.create_service(make_replicated("durable", 1).spec)
+    key1 = m.manager.root_ca.key
+    port1 = m.raft_transport.addr[1]
+    m.stop()
+
+    m2 = Swarmd(state_dir=state_dir, hostname="m0", manager=True,
+                listen_remote_api=("127.0.0.1", 0),
+                use_device_scheduler=False)
+    m2.start()
+    try:
+        assert m2.manager.root_ca.key == key1, "CA key persists"
+        assert m2.raft_transport.addr[1] == port1, "raft port persists"
+        from swarmkit_tpu.models import Service
+        poll(lambda: m2.manager.store.view(
+            lambda tx: tx.get(Service, svc.id)) is not None,
+             msg="service survives the restart via the WAL")
+    finally:
+        m2.stop()
+
+
+def test_swarmd_agents_follow_leader_after_death():
+    """Agents learn the full manager list from heartbeat responses, so
+    when the manager they joined through dies they fail over to the new
+    leader and their tasks keep running."""
+    from swarmkit_tpu.models.types import NodeRole
+
+    m0 = Swarmd(state_dir=tempfile.mkdtemp(), hostname="m0",
+                manager=True, listen_remote_api=("127.0.0.1", 0),
+                use_device_scheduler=False)
+    m0.start()
+    token = m0.manager.root_ca.join_token(NodeRole.MANAGER)
+    joiners = []
+    for h in ("m1", "m2"):
+        d = Swarmd(state_dir=tempfile.mkdtemp(), hostname=h,
+                   manager=True, join_addr=m0.server.addr,
+                   join_token=token, listen_remote_api=("127.0.0.1", 0),
+                   use_device_scheduler=False)
+        d.start()
+        joiners.append(d)
+    m1, m2 = joiners
+    worker = Swarmd(state_dir=tempfile.mkdtemp(), hostname="w0",
+                    join_addr=m0.server.addr,
+                    join_token=m0.manager.root_ca.join_token(0))
+    worker.start()
+    try:
+        # the worker's tracker must learn the other managers' API
+        # addresses via heartbeats
+        poll(lambda: len(worker.remotes.weights()) >= 3, timeout=20,
+             msg="worker should learn all managers from heartbeats")
+
+        m0.stop()   # 2-of-3 quorum survives
+        new = poll(lambda: next(
+            (d for d in joiners
+             if d.raft_node.is_leader and d.manager.is_leader), None),
+            timeout=30, msg="a surviving manager takes leadership")
+        # the worker re-sessions against the new leader and turns READY
+        from swarmkit_tpu.models.types import NodeState
+        api = new.manager.control_api
+
+        def worker_ready():
+            nodes = [n for n in api.list_nodes()
+                     if n.description
+                     and n.description.hostname == "w0"]
+            return nodes and nodes[0].status.state == NodeState.READY
+        poll(worker_ready, timeout=30,
+             msg="worker should fail over to the new leader")
+
+        svc = api.create_service(make_replicated("after-failover", 2).spec)
+        # a replica may first land on the dead m0's agent node; it heals
+        # once the heartbeat TTL marks that node DOWN (default 5s period
+        # x grace), hence the generous timeout
+        poll(lambda: len([t for t in api.list_tasks(service_id=svc.id)
+                          if t.status.state == TaskState.RUNNING
+                          and t.desired_state == TaskState.RUNNING]) == 2,
+             timeout=90, msg="new leader schedules onto failed-over agents")
+    finally:
+        worker.stop()
+        m1.stop()
+        m2.stop()
+        m0.stop()
