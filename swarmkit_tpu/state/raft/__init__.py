@@ -1,7 +1,8 @@
 from .core import RaftCore
 from .node import NotLeader, ProposalDropped, RaftNode
-from .storage import Encoder, RaftLogger
+from .storage import DecryptionError, Encoder, KeyEncoder, RaftLogger
 from .transport import LocalNetwork
 
-__all__ = ["Encoder", "LocalNetwork", "NotLeader", "ProposalDropped",
-           "RaftCore", "RaftLogger", "RaftNode"]
+__all__ = ["DecryptionError", "Encoder", "KeyEncoder",
+           "LocalNetwork", "NotLeader",
+           "ProposalDropped", "RaftCore", "RaftLogger", "RaftNode"]

@@ -190,9 +190,6 @@ class Swarmd:
 
         from .net import issue_certificate, join_raft
         from .node import Node
-        from .remotes import (
-            ConnectionBroker, FailoverDispatcherClient, Remotes,
-        )
         from .security import RootCA
 
         raft_id = "m-" + self.hostname
@@ -203,7 +200,20 @@ class Swarmd:
                                      raft_port=state["raft_port"])
             self.node = Node(self.executor, self.state_dir,
                              node_id=raft_id)
-            cert, _ = self.node.key_rw.read()
+            from .security.ca import SecurityError
+            try:
+                cert, _ = self.node.key_rw.read()
+            except (FileNotFoundError, SecurityError) as e:
+                # state file exists but the cert doesn't (crash between
+                # the two writes) — re-issue with the operator's token
+                # rather than crash-looping forever
+                if not self.join_token:
+                    raise RuntimeError(
+                        "persisted manager state has no certificate and "
+                        "no --join-token was given") from e
+                cert = issue_certificate(self.join_addr, raft_id,
+                                         self.join_token)
+                self.node.key_rw.write(cert, b"")
             self._start_remote_api(port_override=state["api_port"])
         else:
             if not self.join_token:
@@ -264,7 +274,11 @@ class Swarmd:
         self.node.certificate = cert
         self.node.node_id = cert.node_id
         self.node.key_rw.write(cert, b"")
-        self._start_agent_with_failover(cert, seed=self.join_addr)
+        # seed with every manager API address we know — on a restart the
+        # original join address may be long dead, but the WAL replayed
+        # the current members' addresses
+        extra = [tuple(a) for a in self.raft_node.core.api_addrs.values()]
+        self._start_agent_with_failover(cert, self.join_addr, *extra)
         log.info("manager %s joined raft group %s", raft_id,
                  sorted(self.raft_node.core.peers))
 
@@ -333,7 +347,7 @@ class Swarmd:
         from .manager import Manager
         from .net.raft_transport import TCPRaftTransport
         from .state import MemoryStore
-        from .state.raft import RaftLogger, RaftNode
+        from .state.raft import KeyEncoder, RaftLogger, RaftNode
 
         raft_id = "m-" + self.hostname
         self.raft_transport = TCPRaftTransport(raft_id, port=raft_port,
@@ -341,7 +355,8 @@ class Swarmd:
         store = MemoryStore()
         self.raft_node = RaftNode(
             raft_id, [raft_id], store,
-            RaftLogger(os.path.join(self.state_dir, "raft")),
+            RaftLogger(os.path.join(self.state_dir, "raft"),
+                       encoder=KeyEncoder(ca.key)),
             self.raft_transport)
         store._proposer = self.raft_node
         self.manager = Manager(
