@@ -348,6 +348,14 @@ def main():
         warm_planner = TPUPlanner()
         warm_planner.enable_small_group_routing = False  # compile shapes
         one_tick(store, warm_planner)
+    if not SKIP_CONFIGS:
+        # warm the preassigned-validation kernel (global-service share of
+        # config 4) at its node-bucket shape
+        store, svc, nodes, tasks = build_cluster(
+            N_NODES, 64, prefs=rack_pref, global_share=1.0)
+        warm_planner = TPUPlanner()
+        warm_planner.enable_small_group_routing = False
+        one_tick(store, warm_planner, preassigned=True)
 
     # ---- headline: config 4 scale, median of TRIALS
     trials = []

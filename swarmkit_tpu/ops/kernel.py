@@ -331,3 +331,14 @@ def plan_group(nodes: NodeInputs, group: GroupInputs, L: int,
 def plan_group_jit(nodes: NodeInputs, group: GroupInputs, L: int,
                    hier: Tuple = ()) -> jnp.ndarray:
     return plan_group(nodes, group, L, hier=hier)
+
+
+@jax.jit
+def feasibility_jit(nodes: NodeInputs, group: GroupInputs):
+    """Mask + capacity only — validates preassigned (global-service)
+    tasks against their fixed nodes in one fused call instead of a
+    per-task host filter walk (reference: scheduler.go:646
+    taskFitNode runs the same pipeline the planner does)."""
+    mask, cap, fail_counts = feasibility_and_capacity(
+        nodes, group, lambda v: v)
+    return mask, cap, fail_counts

@@ -311,13 +311,26 @@ class TPUPlanner:
 
         import time as _time
         _plan_t0 = _time.perf_counter()
-        infos, n, nb, valid, ready, cpu, mem, total = self._densify(sched, t)
-        if n == 0:
-            return False
-
         k = len(task_group)
         if k > K_CLAMP:  # beyond the kernel's 32-bit budget (see kernel.py)
             return self._fallback()
+        built = self._build_device_inputs(sched, t, k)
+        if built is None:
+            return self._fallback()
+        if built[1] == 0:   # no valid nodes densified
+            return False
+        return self._plan_on_device(sched, t, task_group, decisions,
+                                    built, _plan_t0)
+
+    def _build_device_inputs(self, sched, t, k):
+        """Densify the cluster + one task-group spec into kernel inputs.
+        Shared by group planning and preassigned validation.  Returns None
+        when a static bucket overflows (caller falls back to the host
+        path)."""
+        infos, n, nb, valid, ready, cpu, mem, total = self._densify(sched, t)
+        if n == 0:
+            return (infos, 0, nb, valid, cpu, mem, total, None, None, 1,
+                    (), 0, 0, [], False)
 
         # ---- per-service arrays
         svc_tasks = np.zeros(nb, np.int32)
@@ -339,7 +352,7 @@ class TPUPlanner:
                 constraints = []
         cc = _bucket(len(constraints), _CC_BUCKETS)
         if cc is None:
-            return self._fallback()
+            return None
         con_hash = np.zeros((cc, 2, nb), np.int32)
         con_op = np.full(cc, 2, np.int32)     # 2 = disabled
         con_exp = np.zeros((cc, 2), np.int32)
@@ -360,7 +373,7 @@ class TPUPlanner:
         platforms = placement.platforms if placement else []
         pb = _bucket(max(len(platforms), 1), _P_BUCKETS)
         if pb is None:
-            return self._fallback()
+            return None
         plat = np.full((pb, 4), -1, np.int32)
         for pi, p in enumerate(platforms):
             os_h = _split_hash(str_hash(p.os)) if p.os else (0, 0)
@@ -500,7 +513,129 @@ class TPUPlanner:
             plat=plat, maxrep=np.int32(
                 placement.max_replicas if placement else 0),
             port_limited=np.bool_(port_limited))
+        return (infos, n, nb, valid, cpu, mem, total, nodes_in, group_in,
+                L, hier, cpu_d, mem_d, gen_wanted, port_limited)
 
+    def _apply_assignments(self, sched, t, items, slots, infos,
+                           decisions, cpu_d, mem_d, counts,
+                           cpu, mem, total,
+                           message="scheduler assigned task to node"
+                           ) -> None:
+        """Shared apply: clone+register the assigned tasks (C hot path
+        when available) and do the per-NODE mirror arithmetic in batch.
+        ``counts``: i32[nb] tasks placed per node column."""
+        from ..scheduler.scheduler import SchedulingDecision
+
+        shared_status = TaskStatus(
+            state=TaskState.ASSIGNED, timestamp=now(), message=message)
+        from .. import native
+        hp = native.get()
+        all_tasks = sched.all_tasks
+        if hp is not None:
+            node_id_by_i = [info.node.id for info in infos]
+            task_dict_by_i = [info.tasks for info in infos]
+            hp.plan_apply(items, slots, node_id_by_i, task_dict_by_i,
+                          shared_status, all_tasks, decisions,
+                          SchedulingDecision)
+        else:
+            for (task_id, task), i in zip(items, slots):
+                info = infos[i]
+                new_t = _fast_assign(task, info.id, shared_status)
+                all_tasks[task_id] = new_t
+                info.tasks[task_id] = new_t
+                decisions[task_id] = SchedulingDecision(task, new_t)
+        service_id = t.service_id
+        cached = self._cache is not None
+        for i in np.nonzero(counts)[0].tolist():
+            cnt = int(counts[i])
+            info = infos[i]
+            info.active_tasks_count += cnt
+            svc_map = info.active_tasks_count_by_service
+            svc_map[service_id] = svc_map.get(service_id, 0) + cnt
+            ar = info.available_resources
+            ar.nano_cpus -= cnt * cpu_d
+            ar.memory_bytes -= cnt * mem_d
+            if cached:
+                total[i] += cnt
+                cpu[i] -= cnt * cpu_d
+                mem[i] -= cnt * mem_d
+
+    def validate_preassigned(self, sched, tasks, decisions) -> list:
+        """Validate preassigned tasks (same service) against their FIXED
+        nodes in one fused device call (reference: scheduler.go:646
+        taskFitNode, which walks the same filter pipeline per task).
+
+        Admits each task iff its node passes the feasibility mask and has
+        remaining capacity after earlier tasks in this batch claimed it.
+        Admitted tasks are written into ``decisions`` (mirrors updated,
+        ASSIGNED status); the remaining tasks are returned for the host
+        path to handle (rejections need its per-filter explanations).
+        """
+        from ..scheduler.scheduler import SchedulingDecision
+        from .kernel import feasibility_jit
+
+        t = tasks[0]
+        if not self._supported(t):
+            return tasks
+        c = t.spec.container
+        if c is not None and (c.mounts or getattr(c, "volumes", None)):
+            return tasks   # volume selection is host-path logic
+        if any(tk.desired_state > TaskState.COMPLETE for tk in tasks):
+            # batched mirror counting assumes every admitted task counts
+            # toward active totals (nodeinfo.py:132 addTask guard) —
+            # shutdown-marked stragglers take the host path
+            return tasks
+        if self.enable_small_group_routing:
+            if self._launch_overhead is None:
+                self._measure_launch_overhead()
+            if len(tasks) * self.host_cost_per_task < \
+                    0.8 * self._launch_overhead:
+                return tasks   # below device break-even: host loop
+        import time as _time
+        _plan_t0 = _time.perf_counter()
+        built = self._build_device_inputs(sched, t, len(tasks))
+        if built is None or built[1] == 0:
+            return tasks
+        (infos, n, nb, valid, cpu, mem, total, nodes_in, group_in, L,
+         hier, cpu_d, mem_d, gen_wanted, port_limited) = built
+        if gen_wanted or port_limited:
+            return tasks   # per-task claim bookkeeping: host path
+
+        import jax as _jax
+        mask, cap, _ = _jax.device_get(
+            feasibility_jit(nodes_in, group_in))
+        col = {info.node.id: i for i, info in enumerate(infos)}
+
+        items = []      # (task_id, task) admitted
+        slots = []      # node column per admitted task
+        remaining = []
+        used = np.zeros(nb, np.int32)
+        for task in tasks:
+            i = col.get(task.node_id)
+            if i is None or not mask[i] or used[i] >= cap[i]:
+                remaining.append(task)
+                continue
+            used[i] += 1
+            items.append((task.id, task))
+            slots.append(i)
+        self.stats["plan_seconds"] += _time.perf_counter() - _plan_t0
+        if not items:
+            return remaining
+
+        self._apply_assignments(
+            sched, t, items, slots, infos, decisions, cpu_d, mem_d, used,
+            cpu, mem, total,
+            message="scheduler confirmed task can run on preassigned node")
+        self.stats["tasks_planned"] += len(items)
+        return remaining
+
+    def _plan_on_device(self, sched, t, task_group, decisions, built,
+                        _plan_t0):
+        import time as _time
+
+        (infos, n, nb, valid, cpu, mem, total, nodes_in, group_in, L,
+         hier, cpu_d, mem_d, gen_wanted, port_limited) = built
+        k = len(task_group)
         import jax as _jax
         x, fail_counts = self._plan_fn(nodes_in, group_in, L, hier)
         # one round-trip for both outputs: D2H latency dominates over
@@ -528,45 +663,18 @@ class TPUPlanner:
                               for _, tk in items))
         if simple:
             # batched mirror update: per-task dict entries, per-*node*
-            # counter/resource arithmetic (NodeInfo.add_task is O(1) but its
-            # Python cost dominates large groups when run per task)
-            from .. import native
-            hp = native.get()
+            # counter/resource arithmetic (NodeInfo.add_task is O(1) but
+            # its Python cost dominates large groups when run per task)
             placed = min(len(items), len(slots))
-            if hp is not None:
-                node_id_by_i = [info.node.id for info in infos]
-                task_dict_by_i = [info.tasks for info in infos]
-                hp.plan_apply(items, slots, node_id_by_i, task_dict_by_i,
-                              shared_status, all_tasks, decisions,
-                              SchedulingDecision)
-            else:
-                for (task_id, task), node_i in zip(items, slots):
-                    info = infos[node_i]
-                    new_t = _fast_assign(task, info.id, shared_status)
-                    all_tasks[task_id] = new_t
-                    info.tasks[task_id] = new_t
-                    decisions[task_id] = SchedulingDecision(task, new_t)
+            counts = np.asarray(x)
+            self._apply_assignments(sched, t, items[:placed],
+                                    slots[:placed], infos, decisions,
+                                    cpu_d, mem_d, counts, cpu, mem, total)
             if placed == len(task_group):
                 task_group.clear()
             else:
                 for task_id, _ in items[:placed]:
                     del task_group[task_id]
-            service_id = t.service_id
-            cached = self._cache is not None
-            for ni in np.nonzero(x)[0].tolist():
-                c = int(x[ni])
-                info = infos[ni]
-                info.active_tasks_count += c
-                svc_map = info.active_tasks_count_by_service
-                svc_map[service_id] = svc_map.get(service_id, 0) + c
-                ar = info.available_resources
-                ar.nano_cpus -= c * cpu_d
-                ar.memory_bytes -= c * mem_d
-                if cached:
-                    # keep the per-tick columns in sync for later groups
-                    total[ni] += c
-                    cpu[ni] -= c * cpu_d
-                    mem[ni] -= c * mem_d
         else:
             # generic resources / host ports need per-task claim bookkeeping
             self._cache = None   # add_task mutates behind the columns

@@ -313,7 +313,26 @@ class Scheduler:
 
     def _process_preassigned_tasks(self) -> None:
         decisions: Dict[str, SchedulingDecision] = {}
-        for t in list(self.pending_preassigned_tasks.values()):
+        pending = list(self.pending_preassigned_tasks.values())
+        planner = self.batch_planner
+        if planner is not None and hasattr(planner, "validate_preassigned"):
+            # large same-spec batches (global services during a storm)
+            # validate in one fused device call; whatever the device path
+            # can't model (volumes, ports, small batches, rejections
+            # needing per-filter explanations) falls through to the host
+            # loop below.  Keyed like the group queues (_enqueue): tasks
+            # of different spec versions have different constraints and
+            # reservations and must not share one densified group
+            by_spec: Dict[tuple, list] = {}
+            for t in pending:
+                key = (t.service_id,
+                       t.spec_version.index if t.spec_version else -1)
+                by_spec.setdefault(key, []).append(t)
+            pending = []
+            for group in by_spec.values():
+                pending.extend(
+                    planner.validate_preassigned(self, group, decisions))
+        for t in pending:
             new_t = self._task_fit_node(t, t.node_id)
             if new_t is None:
                 continue

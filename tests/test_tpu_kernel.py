@@ -485,3 +485,45 @@ def test_differential_fuzz_random_clusters():
             nodes, None,
             lambda kwargs=kwargs, n_tasks=n_tasks:
             make_service_with_tasks(n_tasks, **kwargs))
+
+
+def test_preassigned_validation_device_matches_host():
+    """Preassigned (global-service) validation through the device mask
+    admits/rejects exactly like the host pipeline, including per-node
+    capacity exhaustion within one batch."""
+    from swarmkit_tpu.models import Resources
+
+    def build(planner):
+        # node big: 2 tasks fit; node small: 1 fits; node drained: 0
+        nodes = [make_ready_node("big", cpus=2),
+                 make_ready_node("small", cpus=1),
+                 make_ready_node("down", cpus=8)]
+        from swarmkit_tpu.models import NodeState
+        nodes[2].status.state = NodeState.DOWN
+        svc, tasks = make_service_with_tasks(
+            6, reservations=Resources(nano_cpus=10**9))
+        # preassign: 3 to big (one must fail), 2 to small (one must fail),
+        # 1 to the down node (must fail)
+        for t, nid in zip(tasks, ["big", "big", "big",
+                                  "small", "small", "down"]):
+            t.node_id = next(n.id for n in nodes
+                             if n.spec.annotations.name == nid)
+        store = MemoryStore()
+        store.update(lambda tx: ([tx.create(n) for n in nodes],
+                                 tx.create(svc),
+                                 [tx.create(t) for t in tasks]))
+        sched = Scheduler(store, batch_planner=planner)
+        store.view(sched._setup_tasks_list)
+        sched._process_preassigned_tasks()
+        got = store.view(lambda tx: tx.find(Task, ByService(svc.id)))
+        return len([t for t in got
+                    if t.status.state == TaskState.ASSIGNED]), sched
+
+    n_host, _ = build(None)
+    planner = TPUPlanner()
+    planner.enable_small_group_routing = False
+    planner._launch_overhead = 0.0   # force the device path at any size
+    n_dev, sched = build(planner)
+    assert n_dev == n_host == 3
+    assert sched.batch_planner.stats["tasks_planned"] >= 1, \
+        "device path must have validated the batch"
