@@ -1,0 +1,233 @@
+"""Process executor: runs tasks as real OS processes.
+
+Reference role: swarmd's container executor (agent/exec/dockerapi/
+controller.go, executor.go) — the production runtime backend behind the
+Executor/Controller seam.  This image has no container runtime, so the
+native backend supervises plain processes instead: ``ContainerSpec.command
++ args`` become the argv, ``env`` is merged over the parent environment,
+``dir`` is the working directory, and the "image" is informational.
+
+Lifecycle mapping (controller.go:142 Do):
+  prepare  -> resolve argv + stage a log file
+  start    -> subprocess.Popen (new session, so shutdown can signal the
+              whole process group)
+  wait     -> poll the process (interruptible, like a cancelled context)
+  shutdown -> SIGTERM to the group, escalating to SIGKILL after a grace
+              period (dockerapi stop-grace equivalent)
+  terminate-> SIGKILL immediately
+  remove   -> delete the log file
+
+Exit status: code 0 completes the task; non-zero raises with the tail of
+the captured output as the error message (surfacing in Task.status.err,
+like the reference's exit-code ExitError).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import threading
+import time
+from typing import Dict, Optional
+
+from ..models.objects import Task
+from ..models.types import NodeDescription, Platform, Resources
+from .exec import Controller, Executor, TaskError, TemporaryError
+
+log = logging.getLogger("procexec")
+
+STOP_GRACE_PERIOD = 10.0     # SIGTERM -> SIGKILL escalation
+WAIT_POLL_INTERVAL = 0.05
+ERR_TAIL_BYTES = 512
+
+
+class ProcessController(Controller):
+    """Supervises one task's process (reference: dockerapi/controller.go)."""
+
+    def __init__(self, task: Task, log_dir: str,
+                 stop_grace: float = STOP_GRACE_PERIOD):
+        self.task = task
+        self.log_dir = log_dir
+        self.stop_grace = stop_grace
+        self.proc: Optional[subprocess.Popen] = None
+        self.log_path = os.path.join(log_dir, f"{task.id}.log")
+        self._argv: Optional[list] = None
+        self._env: Optional[dict] = None
+        self._cwd: Optional[str] = None
+        self._interrupted = threading.Event()
+        self._log_file = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def update(self, t: Task) -> None:
+        self.task = t
+
+    def interrupt(self) -> None:
+        self._interrupted.set()
+
+    def prepare(self) -> None:
+        spec = self.task.spec.container
+        if spec is None:
+            raise TaskError("task has no container spec")
+        argv = list(spec.command) + list(spec.args)
+        if not argv:
+            raise TaskError("no command to run (container.command/args)")
+        env = dict(os.environ)
+        for kv in spec.env:
+            key, _, value = kv.partition("=")
+            env[key] = value
+        self._argv = argv
+        self._env = env
+        self._cwd = spec.dir or None
+        os.makedirs(self.log_dir, exist_ok=True)
+
+    def start(self) -> None:
+        if self.proc is not None:
+            return
+        assert self._argv is not None, "start before prepare"
+        self._close_log()   # a failed spawn retry must not leak the fd
+        self._log_file = open(self.log_path, "ab")
+        try:
+            # own session: signals reach the whole process group, so a
+            # task that spawns children cannot leak them past shutdown
+            self.proc = subprocess.Popen(
+                self._argv, env=self._env, cwd=self._cwd,
+                stdout=self._log_file, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        except FileNotFoundError as e:
+            raise TaskError(f"executable not found: {e.filename}")
+        except OSError as e:
+            raise TemporaryError(f"spawn failed: {e}")
+
+    def wait(self) -> None:
+        proc = self.proc
+        if proc is None:
+            raise TaskError("wait before start")
+        while proc.poll() is None:
+            if self._interrupted.is_set():
+                # one-shot: the retried wait() must be able to block again
+                # (a sticky event would spin the task in retries forever)
+                self._interrupted.clear()
+                raise TemporaryError("wait interrupted by task update")
+            time.sleep(WAIT_POLL_INTERVAL)
+        code = proc.returncode
+        if code != 0:
+            raise TaskError(
+                f"process exited with {code}: {self._err_tail()}")
+
+    def _err_tail(self) -> str:
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - ERR_TAIL_BYTES))
+                return f.read().decode("utf-8", "replace").strip()
+        except OSError:
+            return ""
+
+    def _signal_group(self, sig: int) -> bool:
+        proc = self.proc
+        if proc is None or proc.poll() is not None:
+            return False
+        try:
+            os.killpg(os.getpgid(proc.pid), sig)
+            return True
+        except (ProcessLookupError, PermissionError):
+            return False
+
+    def shutdown(self) -> None:
+        """Graceful stop: SIGTERM, then SIGKILL after the grace period."""
+        if self._signal_group(signal.SIGTERM):
+            deadline = time.monotonic() + self.stop_grace
+            proc = self.proc
+            while proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(WAIT_POLL_INTERVAL)
+            if proc.poll() is None:
+                self._signal_group(signal.SIGKILL)
+                proc.wait(timeout=self.stop_grace)
+        self._close_log()
+
+    def terminate(self) -> None:
+        if self._signal_group(signal.SIGKILL):
+            self.proc.wait(timeout=self.stop_grace)
+        self._close_log()
+
+    def remove(self) -> None:
+        self._close_log()
+        try:
+            os.unlink(self.log_path)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self._close_log()
+
+    def _close_log(self) -> None:
+        if self._log_file is not None:
+            try:
+                self._log_file.close()
+            except OSError:
+                pass
+            self._log_file = None
+
+    # -------------------------------------------------------------- logs
+
+    def read_logs(self) -> bytes:
+        try:
+            with open(self.log_path, "rb") as f:
+                return f.read()
+        except OSError:
+            return b""
+
+
+class ProcessExecutor(Executor):
+    """Runtime backend running tasks as supervised OS processes."""
+
+    def __init__(self, hostname: str = "", log_dir: str = "",
+                 stop_grace: float = STOP_GRACE_PERIOD):
+        import socket
+        import tempfile
+        self.hostname = hostname or socket.gethostname()
+        self.log_dir = log_dir or os.path.join(
+            tempfile.gettempdir(), "swarmkit-tpu-tasks")
+        self.stop_grace = stop_grace
+        self.controllers: Dict[str, ProcessController] = {}
+        self._mu = threading.Lock()
+
+    def describe(self) -> NodeDescription:
+        cpus = os.cpu_count() or 1
+        mem = 0
+        try:
+            mem = (os.sysconf("SC_PAGE_SIZE")
+                   * os.sysconf("SC_PHYS_PAGES"))
+        except (ValueError, OSError):
+            pass
+        uname = os.uname()
+        return NodeDescription(
+            hostname=self.hostname,
+            platform=Platform(architecture=uname.machine,
+                              os=uname.sysname.lower()),
+            resources=Resources(nano_cpus=cpus * 10 ** 9,
+                                memory_bytes=mem))
+
+    MAX_EXITED_CONTROLLERS = 256
+
+    def controller(self, t: Task) -> ProcessController:
+        ctlr = ProcessController(t, self.log_dir,
+                                 stop_grace=self.stop_grace)
+        with self._mu:
+            self.controllers[t.id] = ctlr
+            self._sweep_locked()
+        return ctlr
+
+    def _sweep_locked(self) -> None:
+        """Drop the oldest exited controllers beyond a bound (a long-
+        running daemon must not grow memory/log references linearly with
+        every task ever run; recent ones stay reachable for log reads)."""
+        exited = [tid for tid, c in self.controllers.items()
+                  if c.proc is not None and c.proc.poll() is not None]
+        for tid in exited[:max(0, len(exited)
+                               - self.MAX_EXITED_CONTROLLERS)]:
+            self.controllers.pop(tid).close()

@@ -47,10 +47,20 @@ class Swarmd:
                  join_token: str = "",
                  executor=None,
                  use_device_scheduler: bool = True):
+        import os
+
         from .agent.testutils import TestExecutor
 
         self.state_dir = state_dir
         self.hostname = hostname or state_dir.rstrip("/").rsplit("/", 1)[-1]
+        if executor == "process":
+            from .agent.procexec import ProcessExecutor
+            # task logs live under the state dir, cleaned with node state
+            executor = ProcessExecutor(
+                hostname=self.hostname,
+                log_dir=os.path.join(state_dir, "task-logs"))
+        elif executor == "test":
+            executor = TestExecutor(hostname=self.hostname)
         self.is_manager = manager
         self.listen_remote_api = listen_remote_api
         self.join_addr = join_addr
@@ -433,6 +443,10 @@ def main(argv=None) -> int:   # pragma: no cover - thin CLI shell
     parser.add_argument("--join-addr", default="")
     parser.add_argument("--join-token", default="")
     parser.add_argument("--no-device-scheduler", action="store_true")
+    parser.add_argument("--executor", default="process",
+                        choices=["process", "test"],
+                        help="task runtime backend: real OS processes "
+                             "(default) or the in-memory test executor")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO,
@@ -444,6 +458,7 @@ def main(argv=None) -> int:   # pragma: no cover - thin CLI shell
         if args.listen_remote_api else None,
         join_addr=parse_addr(args.join_addr) if args.join_addr else None,
         join_token=args.join_token,
+        executor=args.executor,
         use_device_scheduler=not args.no_device_scheduler)
     daemon.start()
     try:

@@ -1,0 +1,118 @@
+"""Process executor: tasks run as real OS processes through the full
+manager/dispatcher/agent pipeline."""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+from swarmkit_tpu.agent import ProcessExecutor
+from swarmkit_tpu.manager import Manager
+from swarmkit_tpu.manager.dispatcher import Config_
+from swarmkit_tpu.models import (
+    Annotations, ContainerSpec, ReplicatedService, RestartCondition,
+    RestartPolicy, Service, ServiceMode, ServiceSpec, TaskSpec, TaskState,
+)
+from swarmkit_tpu.node import Node as ClusterNode
+from swarmkit_tpu.utils import new_id
+
+from test_orchestrator import poll
+
+
+def fast_cfg():
+    return Config_(heartbeat_period=0.3, heartbeat_epsilon=0.02,
+                   process_updates_interval=0.02,
+                   assignment_batching_wait=0.02)
+
+
+def proc_service(name, replicas, command, restart=None):
+    return ServiceSpec(
+        annotations=Annotations(name=name),
+        task=TaskSpec(container=ContainerSpec(
+            image="process", command=command),
+            restart=restart or RestartPolicy(
+                condition=RestartCondition.NONE)),
+        mode=ServiceMode.REPLICATED,
+        replicated=ReplicatedService(replicas=replicas))
+
+
+@pytest.fixture
+def cluster():
+    manager = Manager(dispatcher_config=fast_cfg(),
+                      use_device_scheduler=False)
+    manager.run()
+    log_dir = tempfile.mkdtemp()
+    executor = ProcessExecutor(hostname="proc1", log_dir=log_dir,
+                               stop_grace=2.0)
+    node = ClusterNode(executor, tempfile.mkdtemp())
+    from swarmkit_tpu.models import Cluster
+    from swarmkit_tpu.state.store import ByName
+    cl = manager.store.view(
+        lambda tx: tx.find(Cluster, ByName("default")))[0]
+    node.load_or_join(manager.ca_server, cl.root_ca.join_tokens.worker)
+    node.start(manager.dispatcher, store=manager.store, hostname="proc1")
+    yield manager, node, executor
+    node.stop()
+    manager.stop()
+
+
+def test_process_tasks_run_and_complete(cluster):
+    manager, node, executor = cluster
+    api = manager.control_api
+    marker = os.path.join(tempfile.mkdtemp(), "ran")
+    svc = api.create_service(proc_service(
+        "toucher", 2, ["sh", "-c", f"echo done >> {marker}"]))
+    poll(lambda: len([t for t in api.list_tasks(service_id=svc.id)
+                      if t.status.state == TaskState.COMPLETE]) == 2,
+         timeout=20, msg="both process replicas should COMPLETE")
+    with open(marker) as f:
+        assert f.read().count("done") == 2
+    # stdout captured per task
+    svc2 = api.create_service(proc_service(
+        "talker", 1, ["sh", "-c", "echo captured-output"]))
+    poll(lambda: [t for t in api.list_tasks(service_id=svc2.id)
+                  if t.status.state == TaskState.COMPLETE] or None,
+         timeout=20)
+    t = [t for t in api.list_tasks(service_id=svc2.id)][0]
+    ctlr = executor.controllers[t.id]
+    assert b"captured-output" in ctlr.read_logs()
+
+
+def test_process_failure_surfaces_exit_code(cluster):
+    manager, node, executor = cluster
+    api = manager.control_api
+    svc = api.create_service(proc_service(
+        "failer", 1, ["sh", "-c", "echo boom >&2; exit 3"]))
+
+    def failed():
+        ts = api.list_tasks(service_id=svc.id)
+        return [t for t in ts if t.status.state == TaskState.FAILED]
+    got = poll(lambda: failed() or None, timeout=20,
+               msg="failing process should reach FAILED")
+    assert "exited with 3" in got[0].status.err
+    assert "boom" in got[0].status.err
+
+
+def test_process_shutdown_terminates_group(cluster):
+    manager, node, executor = cluster
+    api = manager.control_api
+    svc = api.create_service(proc_service(
+        "sleeper", 1, ["sh", "-c", "sleep 300 & wait"]))
+    poll(lambda: [t for t in api.list_tasks(service_id=svc.id)
+                  if t.status.state == TaskState.RUNNING] or None,
+         timeout=20, msg="long-running process should reach RUNNING")
+    tasks = api.list_tasks(service_id=svc.id)
+    pid = executor.controllers[tasks[0].id].proc.pid
+    api.remove_service(svc.id)
+    poll(lambda: not any(t.status.state == TaskState.RUNNING
+                         for t in api.list_tasks(service_id=svc.id)),
+         timeout=20, msg="removal should stop the process task")
+
+    def proc_gone():
+        try:
+            os.kill(pid, 0)
+            return False
+        except ProcessLookupError:
+            return True
+    poll(proc_gone, timeout=15, msg="the OS process group must die")
