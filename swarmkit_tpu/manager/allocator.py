@@ -19,16 +19,18 @@ host-port filter sees published ports.
 
 from __future__ import annotations
 
+import ipaddress
 import logging
 import threading
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..models.objects import Network, Service, Task
 from ..models.types import (
-    Endpoint, PortConfig, PublishMode, TaskState, TaskStatus, now,
+    Endpoint, EndpointSpec, EndpointVIP, IPAMConfig, IPAMOptions,
+    NetworkAttachment, PortConfig, PublishMode, TaskState, TaskStatus, now,
 )
 from ..state.events import Event, EventCommit, EventSnapshotRestore
-from ..state.store import Batch, MemoryStore
+from ..state.store import Batch, ByName, MemoryStore
 from ..state.watch import Closed
 
 log = logging.getLogger("allocator")
@@ -104,17 +106,126 @@ class PortAllocator:
         raise ValueError("dynamic port space exhausted")
 
 
+
+class IPAM:
+    """Subnet + address allocator over the cluster's default address pool
+    (reference: manager/allocator/cnmallocator + ipamapi default-addr-pool
+    semantics: carve /subnet_size subnets out of the pool, hand out VIPs
+    and per-task addresses from each network's subnet; .1 is the
+    gateway)."""
+
+    def __init__(self, pools: Optional[List[str]] = None,
+                 subnet_size: int = 24):
+        self.pools = [ipaddress.ip_network(p)
+                      for p in (pools or ["10.0.0.0/8"])]
+        self.subnet_size = subnet_size
+        self.subnets: Dict[str, object] = {}      # network_id -> IPv4Network
+        self._used_ips: Dict[str, set] = {}       # network_id -> {int, ...}
+
+    # ------------------------------------------------------------- networks
+
+    def allocate_network(self, net: Network) -> IPAMOptions:
+        """Pick the network's subnet: the spec's explicit one when given,
+        else the next free slice of the pool."""
+        spec_ipam = getattr(net.spec, "ipam", None)
+        subnet = None
+        gateway = ""
+        if spec_ipam and spec_ipam.configs:
+            cfg = spec_ipam.configs[0]
+            if cfg.subnet:
+                subnet = ipaddress.ip_network(cfg.subnet)
+                gateway = cfg.gateway
+        taken = list(self.subnets.values())
+        if subnet is not None:
+            # explicit subnet: reject overlap with any registered network
+            if any(subnet.overlaps(sn) for sn in taken):
+                raise ValueError(
+                    f"subnet {subnet} overlaps an allocated network")
+        else:
+            for pool in self.pools:
+                for cand in pool.subnets(new_prefix=self.subnet_size):
+                    if not any(cand.overlaps(sn) for sn in taken):
+                        subnet = cand
+                        break
+                if subnet is not None:
+                    break
+            if subnet is None:
+                raise ValueError("address pool exhausted")
+        if not gateway:
+            gateway = str(next(subnet.hosts()))
+        self.subnets[net.id] = subnet
+        used = self._used_ips.setdefault(net.id, set())
+        used.add(int(ipaddress.ip_address(gateway)))
+        return IPAMOptions(configs=[IPAMConfig(
+            subnet=str(subnet), gateway=gateway)])
+
+    def restore_network(self, net: Network) -> None:
+        if net.ipam and net.ipam.configs and net.ipam.configs[0].subnet:
+            cfg = net.ipam.configs[0]
+            self.subnets[net.id] = ipaddress.ip_network(cfg.subnet)
+            used = self._used_ips.setdefault(net.id, set())
+            if cfg.gateway:
+                used.add(int(ipaddress.ip_address(cfg.gateway)))
+
+    def release_network(self, network_id: str) -> None:
+        self.subnets.pop(network_id, None)
+        self._used_ips.pop(network_id, None)
+
+    # ------------------------------------------------------------ addresses
+
+    def allocate_ip(self, network_id: str) -> str:
+        """Next free address in the network's subnet, in CIDR form."""
+        subnet = self.subnets.get(network_id)
+        if subnet is None:
+            raise ValueError(f"network {network_id} has no subnet")
+        used = self._used_ips.setdefault(network_id, set())
+        first = int(subnet.network_address) + 1
+        last = int(subnet.broadcast_address) - 1
+        for ip in range(first, last + 1):
+            if ip not in used:
+                used.add(ip)
+                return (f"{ipaddress.ip_address(ip)}"
+                        f"/{subnet.prefixlen}")
+        raise ValueError(f"subnet {subnet} exhausted")
+
+    def restore_ip(self, network_id: str, addr: str) -> None:
+        if not addr:
+            return
+        used = self._used_ips.setdefault(network_id, set())
+        ip = addr.split("/")[0]
+        try:
+            used.add(int(ipaddress.ip_address(ip)))
+        except ValueError:
+            pass
+
+    def release_ip(self, network_id: str, addr: str) -> None:
+        if not addr:
+            return
+        used = self._used_ips.get(network_id)
+        if used is None:
+            return
+        try:
+            used.discard(
+                int(ipaddress.ip_address(addr.split("/")[0])))
+        except ValueError:
+            pass
+
+
 class Allocator:
     """Event-loop allocator (reference: allocator.go:82 Run)."""
 
-    def __init__(self, store: MemoryStore):
+    def __init__(self, store: MemoryStore,
+                 address_pools: Optional[List[str]] = None,
+                 subnet_size: int = 24):
         self.store = store
         self.ports = PortAllocator()
+        self.ipam = IPAM(address_pools, subnet_size)
         self._stop = threading.Event()
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._pending_tasks: Dict[str, Task] = {}
         self._pending_services: Dict[str, Service] = {}
+        self._pending_networks: Dict[str, Network] = {}
 
     # ------------------------------------------------------------- lifecycle
 
@@ -130,6 +241,7 @@ class Allocator:
     def run(self) -> None:
         try:
             def init(tx):
+                self._restore_ipam(tx)
                 for s in tx.find(Service):
                     self.ports.restore(s.endpoint)
                 for s in tx.find(Service):
@@ -160,12 +272,31 @@ class Allocator:
         finally:
             self._done.set()
 
+    def _restore_ipam(self, tx) -> None:
+        for net in tx.find(Network):
+            if net.ipam is not None:
+                self.ipam.restore_network(net)
+            else:
+                self._pending_networks[net.id] = net
+        for s in tx.find(Service):
+            if s.endpoint is not None:
+                for vip in s.endpoint.virtual_ips:
+                    self.ipam.restore_ip(vip.network_id, vip.addr)
+        for t in tx.find(Task):
+            for att in t.networks:
+                for addr in att.addresses:
+                    self.ipam.restore_ip(att.network_id, addr)
+
     def _resync(self) -> None:
         self._pending_tasks.clear()
         self._pending_services.clear()
+        self._pending_networks.clear()
         self.ports = PortAllocator()
+        self.ipam = IPAM([str(p) for p in self.ipam.pools],
+                         self.ipam.subnet_size)
 
         def init(tx):
+            self._restore_ipam(tx)
             for s in tx.find(Service):
                 self.ports.restore(s.endpoint)
                 if self._service_needs_allocation(s):
@@ -184,18 +315,39 @@ class Allocator:
         if isinstance(obj, Task):
             if ev.action == "delete":
                 self._pending_tasks.pop(obj.id, None)
+                for att in obj.networks:
+                    for addr in att.addresses:
+                        self.ipam.release_ip(att.network_id, addr)
             elif obj.status.state == TaskState.NEW:
                 self._pending_tasks[obj.id] = obj
         elif isinstance(obj, Service):
             if ev.action == "delete":
                 self.ports.release(obj.endpoint)
+                if obj.endpoint is not None:
+                    for vip in obj.endpoint.virtual_ips:
+                        self.ipam.release_ip(vip.network_id, vip.addr)
                 self._pending_services.pop(obj.id, None)
             elif self._service_needs_allocation(obj):
                 self._pending_services[obj.id] = obj
+        elif isinstance(obj, Network):
+            if ev.action == "delete":
+                self.ipam.release_network(obj.id)
+                self._pending_networks.pop(obj.id, None)
+            elif obj.ipam is None:
+                self._pending_networks[obj.id] = obj
 
     @staticmethod
     def _service_needs_allocation(s: Service) -> bool:
         spec_ep = s.spec.endpoint
+        have_vips = {v.network_id for v in (s.endpoint.virtual_ips
+                                            if s.endpoint else [])}
+        if s.spec.task.networks or have_vips:
+            # target may be a name; distinct-count suffices for the needs
+            # check (exact resolution happens at allocation time) — and a
+            # spec with NO networks must shed any lingering VIPs
+            want = {c.target for c in s.spec.task.networks}
+            if len(have_vips) != len(want):
+                return True
         if s.endpoint is None:
             return spec_ep is not None
         spec_ports = list(spec_ep.ports) if spec_ep else []
@@ -222,12 +374,58 @@ class Allocator:
     # ----------------------------------------------------------------- ticks
 
     def _tick(self) -> None:
+        if self._pending_networks:
+            networks, self._pending_networks = self._pending_networks, {}
+            self._allocate_networks(networks)
         if self._pending_services:
             services, self._pending_services = self._pending_services, {}
             self._allocate_services(services)
         if self._pending_tasks:
             tasks, self._pending_tasks = self._pending_tasks, {}
             self._allocate_tasks(tasks)
+
+    def _allocate_networks(self, networks: Dict[str, Network]) -> None:
+        def cb(batch: Batch) -> None:
+            for network in networks.values():
+                def one(tx, network=network):
+                    cur = tx.get(Network, network.id)
+                    if cur is None or cur.ipam is not None:
+                        return
+                    cur = cur.copy()
+                    try:
+                        cur.ipam = self.ipam.allocate_network(cur)
+                    except ValueError as e:
+                        log.warning("network %s allocation failed: %s",
+                                    network.id, e)
+                        return
+                    tx.update(cur)
+                try:
+                    batch.update(one)
+                except Exception:
+                    log.exception("network allocation failed")
+
+        try:
+            self.store.batch(cb)
+        except Exception:
+            log.exception("network allocation batch failed")
+
+    def _resolve_network_ids(self, tx, attachment_configs):
+        """Resolve attachment targets (id or name) to allocated network
+        ids; returns None if any referenced network has no subnet yet (the
+        commit event for its allocation re-triggers the caller)."""
+        ids = []
+        for cfg in attachment_configs:
+            net = tx.get(Network, cfg.target)
+            if net is None:
+                found = tx.find(Network, ByName(cfg.target))
+                net = found[0] if found else None
+            if net is None:
+                log.warning("unknown network %r referenced", cfg.target)
+                return None
+            if net.ipam is None:
+                return None   # subnet not carved yet
+            ids.append(net.id)
+        return ids
 
     def _allocate_services(self, services: Dict[str, Service]) -> None:
         def cb(batch: Batch) -> None:
@@ -251,9 +449,67 @@ class Allocator:
                         log.warning("service %s port allocation failed: %s",
                                     service.id, e)
                         return
+                    def unwind_ports():
+                        # the freshly allocated ports must not stay
+                        # registered when we requeue, or retries
+                        # self-conflict on fixed ports / leak dynamics
+                        self.ports.release(Endpoint(ports=ports))
+                        self.ports.restore(old_endpoint)
+
+                    # virtual IPs on every attached network (reference:
+                    # allocator/network.go allocateVIPs; VIP mode only).
+                    # Duplicate spec entries resolve to one VIP.
+                    net_ids = self._resolve_network_ids(
+                        tx, cur.spec.task.networks)
+                    if net_ids is None and cur.spec.task.networks:
+                        unwind_ports()
+                        self._pending_services[cur.id] = cur
+                        return
+                    net_ids = list(dict.fromkeys(net_ids or []))
+                    vips = []
+                    fresh = []
+                    old_vips = {v.network_id: v
+                                for v in (old_endpoint.virtual_ips
+                                          if old_endpoint else [])}
+                    try:
+                        for nid in net_ids:
+                            if nid in old_vips:
+                                vips.append(old_vips.pop(nid))
+                                continue
+                            vip = EndpointVIP(
+                                network_id=nid,
+                                addr=self.ipam.allocate_ip(nid))
+                            vips.append(vip)
+                            fresh.append(vip)
+                    except ValueError as e:
+                        # exhausted subnet: requeue WITHOUT writing a
+                        # partial endpoint (a partial write re-triggers
+                        # allocation on its own commit — a hot loop)
+                        for vip in fresh:
+                            self.ipam.release_ip(vip.network_id, vip.addr)
+                        unwind_ports()
+                        log.warning("service %s VIP allocation failed: "
+                                    "%s", cur.id, e)
+                        return
+                    for stale in old_vips.values():
+                        self.ipam.release_ip(stale.network_id, stale.addr)
+                    if old_endpoint is not None and not old_vips and \
+                            [(p.protocol, p.target_port, p.published_port,
+                              p.publish_mode) for p in ports] == \
+                            [(p.protocol, p.target_port, p.published_port,
+                              p.publish_mode)
+                             for p in old_endpoint.ports] and \
+                            {(v.network_id, v.addr) for v in vips} == \
+                            {(v.network_id, v.addr)
+                             for v in old_endpoint.virtual_ips}:
+                        # nothing actually changed (e.g. the intake
+                        # count-check misfires on duplicate name+id
+                        # targets): writing an identical endpoint would
+                        # re-trigger allocation on its own commit forever
+                        return
                     cur.endpoint = Endpoint(
-                        spec=spec_ep.copy() if spec_ep else None,
-                        ports=ports)
+                        spec=spec_ep.copy() if spec_ep else EndpointSpec(),
+                        ports=ports, virtual_ips=vips)
                     tx.update(cur)
                 try:
                     batch.update(one)
@@ -285,6 +541,31 @@ class Allocator:
                                 return
                             if service.endpoint is not None:
                                 t.endpoint = service.endpoint.copy()
+                    # per-task addresses on each attached network
+                    # (reference: allocator/network.go allocateTask)
+                    net_cfgs = t.spec.networks
+                    if net_cfgs and not t.networks:
+                        net_ids = self._resolve_network_ids(tx, net_cfgs)
+                        if net_ids is None:
+                            self._pending_tasks[t.id] = t
+                            return
+                        pairs = list({nid: (nid, cfg) for nid, cfg in
+                                      zip(net_ids, net_cfgs)}.values())
+                        attachments = []
+                        try:
+                            for nid, cfg in pairs:
+                                attachments.append(NetworkAttachment(
+                                    network_id=nid,
+                                    addresses=[self.ipam.allocate_ip(nid)],
+                                    aliases=list(cfg.aliases)))
+                        except ValueError as e:
+                            for att in attachments:
+                                for a in att.addresses:
+                                    self.ipam.release_ip(att.network_id, a)
+                            log.warning("task %s address allocation "
+                                        "failed: %s", t.id, e)
+                            return
+                        t.networks = attachments
                     t.status = TaskStatus(
                         state=TaskState.PENDING, timestamp=now(),
                         message=ALLOCATED_STATUS_MESSAGE)

@@ -220,3 +220,82 @@ def test_update_config_validation(api):
     s.update = UpdateConfig(max_failure_ratio=1.5)
     with pytest.raises(InvalidArgument, match="maxfailureratio"):
         api.create_service(s)
+
+
+def test_network_ipam_allocation():
+    """Networks get subnets carved from the default pool; services on
+    them get VIPs; tasks get per-network addresses (reference:
+    manager/allocator network allocation)."""
+    import time
+
+    from swarmkit_tpu.manager.allocator import Allocator
+    from swarmkit_tpu.models import (
+        Annotations, Network, NetworkAttachmentConfig, Task, TaskState,
+    )
+    from swarmkit_tpu.models.specs import NetworkSpec
+    from swarmkit_tpu.state import ByService
+
+    from test_orchestrator import poll
+
+    store = MemoryStore()
+    api = ControlAPI(store)
+    alloc = Allocator(store)
+    alloc.start()
+    try:
+        n1 = api.create_network(NetworkSpec(
+            annotations=Annotations(name="backend")))
+        n2 = api.create_network(NetworkSpec(
+            annotations=Annotations(name="frontend")))
+        poll(lambda: store.view(
+            lambda tx: tx.get(Network, n1.id)).ipam is not None,
+            msg="subnet allocated")
+        nets = store.view(lambda tx: [tx.get(Network, i)
+                                      for i in (n1.id, n2.id)])
+        subnets = [n.ipam.configs[0].subnet for n in nets]
+        assert len(set(subnets)) == 2, "distinct subnets"
+        assert all(s.endswith("/24") for s in subnets), subnets
+        gws = [n.ipam.configs[0].gateway for n in nets]
+        assert all(g.endswith(".1") for g in gws), gws
+
+        # service attached to both networks: VIP per network
+        svc_spec = spec("webnet", replicas=2)
+        svc_spec.task.networks = [
+            NetworkAttachmentConfig(target="backend"),
+            NetworkAttachmentConfig(target=n2.id)]
+        svc = api.create_service(svc_spec)
+        poll(lambda: (api.get_service(svc.id).endpoint is not None
+                      and len(api.get_service(svc.id)
+                              .endpoint.virtual_ips) == 2),
+             msg="VIPs on both networks")
+        vips = api.get_service(svc.id).endpoint.virtual_ips
+        assert {v.network_id for v in vips} == {n1.id, n2.id}
+        assert all(v.addr for v in vips)
+
+        # tasks carry per-network addresses, all distinct (created
+        # directly: no orchestrator runs in this test)
+        from swarmkit_tpu.models.types import TaskStatus
+        from swarmkit_tpu.utils import new_id
+
+        def mk(tx):
+            for slot in (1, 2):
+                tx.create(Task(
+                    id=new_id(), service_id=svc.id, slot=slot,
+                    spec=svc_spec.task.copy(),
+                    status=TaskStatus(state=TaskState.NEW),
+                    desired_state=TaskState.RUNNING))
+        store.update(mk)
+
+        def task_addrs():
+            ts = store.view(lambda tx: tx.find(Task, ByService(svc.id)))
+            if len(ts) < 2 or any(
+                    t.status.state < TaskState.PENDING for t in ts):
+                return None
+            return [a for t in ts for att in t.networks
+                    for a in att.addresses]
+        addrs = poll(task_addrs, msg="task addresses allocated")
+        assert len(addrs) == 4                     # 2 tasks x 2 networks
+        assert len(set(addrs)) == 4, "addresses must be unique"
+        vip_addrs = {v.addr for v in vips}
+        assert not vip_addrs & set(addrs), "VIPs never reused for tasks"
+    finally:
+        alloc.stop()
