@@ -48,7 +48,10 @@ class Agent:
         self._statuses: Dict[str, TaskStatus] = {}
         self._statuses_cond = threading.Condition(self._statuses_mu)
         self._reporter_thread: Optional[threading.Thread] = None
-        self.stats = {"sessions": 0, "reports": 0}
+        self._log_thread: Optional[threading.Thread] = None
+        self._log_offsets: Dict[str, int] = {}
+        self.log_ship_interval = 0.5
+        self.stats = {"sessions": 0, "reports": 0, "log_batches": 0}
 
     # ------------------------------------------------------------- lifecycle
 
@@ -64,9 +67,61 @@ class Agent:
         self.worker.close()
         self._done.wait(timeout=10)
 
+    def _log_shipper(self) -> None:
+        """Ship new task-log bytes to the manager's log broker
+        (reference: agent/reporter + log publisher; executors that expose
+        per-task ``read_logs`` — e.g. the process executor — feed it).
+        Offsets are tracked per task so only deltas travel."""
+        while not self._stop.wait(self.log_ship_interval):
+            try:
+                self._ship_logs_once()
+            except Exception:
+                # nothing here may kill the shipper thread: a transient
+                # error just means this interval's batch waits
+                log.exception("log shipping pass failed")
+
+    def _ship_logs_once(self) -> None:
+        publish = getattr(self.client, "publish_logs", None)
+        controllers = getattr(self.executor, "controllers", None)
+        if publish is None or not controllers:
+            return
+        snapshot = dict(controllers)   # racing the worker thread is fine;
+        # a task missed this pass ships next interval
+        batch = []
+        for task_id, ctlr in snapshot.items():
+            read = getattr(ctlr, "read_logs", None)
+            if read is None:
+                continue
+            data = read()
+            start = self._log_offsets.get(task_id, 0)
+            if len(data) > start:
+                batch.append({"task_id": task_id,
+                              "node_id": self.node_id,
+                              "stream": "stdout",
+                              "data": data[start:]})
+                self._log_offsets[task_id] = len(data)
+        # prune offsets for tasks the executor no longer tracks, or a
+        # long-lived agent grows one entry per historical task forever
+        for task_id in list(self._log_offsets):
+            if task_id not in snapshot:
+                del self._log_offsets[task_id]
+        if not batch:
+            return
+        try:
+            publish(self.node_id, self.session_id or "", batch)
+            self.stats["log_batches"] += 1
+        except Exception:
+            # transient transport trouble: offsets were advanced, so
+            # roll them back for a retry next interval (at-least-once)
+            for m in batch:
+                self._log_offsets[m["task_id"]] -= len(m["data"])
+
     def run(self) -> None:
         backoff = 0.1
         try:
+            self._log_thread = threading.Thread(
+                target=self._log_shipper, name="agent-logs", daemon=True)
+            self._log_thread.start()
             self._reporter_thread = threading.Thread(
                 target=self._reporter_loop, name="agent-reporter",
                 daemon=True)

@@ -360,6 +360,29 @@ class Dispatcher:
         return base + random.uniform(-self.config.heartbeat_epsilon,
                                      self.config.heartbeat_epsilon)
 
+    def publish_logs(self, node_id: str, session_id: str,
+                     messages) -> None:
+        """Agent-side log publishing passthrough to the log broker
+        (reference: logbroker.go PublishLogs; the broker is attached by
+        the Manager).  Session-gated like every other agent-facing
+        method: expired/orphaned agents must not keep injecting logs."""
+        with self._mu:
+            rn = self._nodes.get(node_id)
+            if rn is None:
+                raise ErrNodeNotRegistered(node_id)
+            if rn.session_id != session_id:
+                raise ErrSessionInvalid(node_id)
+        broker = getattr(self, "log_broker", None)
+        if broker is None:
+            return
+        from .logbroker import LogMessage
+        broker.publish_logs([
+            LogMessage(task_id=m["task_id"], node_id=m["node_id"],
+                       stream=m.get("stream", "stdout"),
+                       data=m["data"] if isinstance(m["data"], bytes)
+                       else m["data"].encode())
+            for m in messages])
+
     def heartbeat(self, node_id: str, session_id: str) -> float:
         """TTL refresh; returns the next period
         (reference: dispatcher.go:1317)."""

@@ -241,6 +241,8 @@ class Manager:
             restarts = RestartSupervisor(self.store)
             self.dispatcher = Dispatcher(self.store,
                                          self._dispatcher_config)
+            # agents publish task logs through their dispatcher surface
+            self.dispatcher.log_broker = self.logbroker
             self.dispatcher.run()
             self.allocator = Allocator(self.store)
             planner = TPUPlanner() if self.use_device_scheduler else None

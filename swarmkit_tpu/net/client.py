@@ -244,6 +244,14 @@ class RemoteDispatcherClient:
             return resp["period"]
         return resp
 
+    def publish_logs(self, node_id: str, session_id: str,
+                     messages) -> None:
+        import base64 as _b64
+        self._conn.call("publish_logs", {
+            "node_id": node_id, "session_id": session_id,
+            "messages": [dict(m, data=_b64.b64encode(
+                m["data"]).decode("ascii")) for m in messages]})
+
     def update_task_status(self, node_id: str, session_id: str,
                            updates: List[Tuple[str, TaskStatus]]) -> None:
         self._conn.call("update_task_status", {

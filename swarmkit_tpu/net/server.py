@@ -176,6 +176,18 @@ class ManagerServer:
                 params["node_id"], params["session_id"], updates)
             return "ok"
 
+        if method == "publish_logs":
+            self._require_cert(cert, params["node_id"])
+            import base64 as _b64
+            # the sender's identity is the CERT's, not whatever the
+            # payload claims — prevents cross-node log spoofing
+            msgs = [dict(m, data=_b64.b64decode(m["data"]),
+                         node_id=params["node_id"])
+                    for m in params["messages"]]
+            self._dispatcher().publish_logs(
+                params["node_id"], params["session_id"], msgs)
+            return "ok"
+
         # ---- manager join (MANAGER-cert gated)
         if method == "raft_join":
             self._require_cert(cert, params["node_id"])

@@ -200,3 +200,6 @@ class FailoverDispatcherClient:
 
     def open_assignments(self, node_id, session_id):
         return self._call("open_assignments", node_id, session_id)
+
+    def publish_logs(self, node_id, session_id, messages):
+        return self._call("publish_logs", node_id, session_id, messages)

@@ -116,3 +116,80 @@ def test_process_shutdown_terminates_group(cluster):
         except ProcessLookupError:
             return True
     poll(proc_gone, timeout=15, msg="the OS process group must die")
+
+
+def test_task_logs_ship_to_broker(cluster):
+    """Process stdout flows agent → dispatcher → log broker subscribers
+    (reference: agent log publisher + logbroker.PublishLogs)."""
+    from swarmkit_tpu.manager.logbroker import LogSelector
+
+    manager, node, executor = cluster
+    node.agent.log_ship_interval = 0.1
+    api = manager.control_api
+    svc = api.create_service(proc_service(
+        "chatty", 1,
+        ["sh", "-c", "echo hello-from-task; echo second-line"]))
+    sub = manager.logbroker.subscribe_logs(
+        LogSelector(service_ids=[svc.id]))
+    poll(lambda: [t for t in api.list_tasks(service_id=svc.id)
+                  if t.status.state == TaskState.COMPLETE] or None,
+         timeout=20)
+    got = b""
+    deadline = time.time() + 10
+    while time.time() < deadline and b"second-line" not in got:
+        try:
+            got += sub.get(timeout=1.0).data
+        except TimeoutError:
+            pass
+    assert b"hello-from-task" in got and b"second-line" in got
+    sub.close()
+
+
+def test_task_logs_ship_over_tcp():
+    """Same flow over the wire: remote agent publishes log bytes through
+    the TCP dispatcher surface."""
+    from swarmkit_tpu.agent import Agent
+    from swarmkit_tpu.manager.logbroker import LogSelector
+    from swarmkit_tpu.models import Cluster
+    from swarmkit_tpu.net import ManagerServer, RemoteDispatcherClient, \
+        issue_certificate
+    from swarmkit_tpu.state.store import ByName
+
+    manager = Manager(dispatcher_config=fast_cfg(),
+                      use_device_scheduler=False)
+    manager.run()
+    server = ManagerServer(manager)
+    server.start()
+    agent = None
+    try:
+        cl = manager.store.view(
+            lambda tx: tx.find(Cluster, ByName("default")))[0]
+        node_id = new_id()
+        cert = issue_certificate(server.addr, node_id,
+                                 cl.root_ca.join_tokens.worker)
+        client = RemoteDispatcherClient(server.addr, cert)
+        executor = ProcessExecutor(hostname="tcp-proc",
+                                   log_dir=tempfile.mkdtemp())
+        agent = Agent(node_id, executor, client)
+        agent.log_ship_interval = 0.1
+        agent.start()
+
+        api = manager.control_api
+        svc = api.create_service(proc_service(
+            "tcp-chatty", 1, ["sh", "-c", "echo over-the-wire"]))
+        sub = manager.logbroker.subscribe_logs(
+            LogSelector(service_ids=[svc.id]))
+        got = b""
+        deadline = time.time() + 15
+        while time.time() < deadline and b"over-the-wire" not in got:
+            try:
+                got += sub.get(timeout=1.0).data
+            except TimeoutError:
+                pass
+        assert b"over-the-wire" in got
+        sub.close()
+    finally:
+        if agent is not None:
+            agent.stop()
+        server.stop()
+        manager.stop()
