@@ -55,6 +55,10 @@ def _build_parser() -> argparse.ArgumentParser:
     scale.add_argument("target")  # name=replicas
     rm = svc.add_parser("rm")
     rm.add_argument("service")
+    logs = svc.add_parser("logs")
+    logs.add_argument("service")
+    logs.add_argument("--duration", type=float, default=2.0,
+                      help="seconds to collect live log output for")
 
     node = sub.add_parser("node").add_subparsers(dest="verb", required=True)
     node.add_parser("ls")
@@ -147,6 +151,20 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
             s = _resolve(api.list_services(), args.service, "service")
             api.remove_service(s.id)
             return s.id
+        if args.verb == "logs":
+            # live log collection through the control surface, so it
+            # works identically in-process and over TCP (reference:
+            # swarmctl service logs over the log broker)
+            s = _resolve(api.list_services(), args.service, "service")
+            lines = []
+            for msg in api.collect_logs(s.id, duration=args.duration):
+                text = msg["data"].decode("utf-8", "replace").rstrip()
+                for line in text.splitlines():
+                    lines.append(
+                        f"{s.spec.annotations.name}"
+                        f".{msg['task_id'][:8]}@{msg['node_id'][:8]}"
+                        f" | {line}")
+            return "\n".join(lines)
 
     if args.noun == "node":
         if args.verb == "ls":

@@ -627,6 +627,43 @@ class ControlAPI:
             raise NotFound(f"task {task_id} not found")
         return t
 
+    def collect_logs(self, service_id: str, duration: float = 2.0
+                     ) -> List[dict]:
+        """Collect live log output for a service for up to ``duration``
+        seconds (reference: swarmctl service logs over the log broker).
+        Returns [{task_id, node_id, stream, data(bytes)}], in arrival
+        order.  Only meaningful on the leader (the broker agents publish
+        to); bounded so one call can't pin a server thread forever."""
+        import time as _time
+
+        broker = getattr(self, "log_broker", None)
+        if broker is None:
+            raise APIError("log broker unavailable on this manager")
+        from .logbroker import LogSelector
+        duration = min(max(duration, 0.0), 30.0)
+        stream = broker.subscribe_logs(LogSelector(
+            service_ids=[service_id]))
+        out: List[dict] = []
+        deadline = _time.time() + duration
+        try:
+            while _time.time() < deadline:
+                try:
+                    msg = stream.get(timeout=max(
+                        0.05, deadline - _time.time()))
+                except TimeoutError:
+                    break
+                except Exception:      # broker closed mid-collection
+                    break
+                out.append({"task_id": msg.task_id,
+                            "node_id": msg.node_id,
+                            "stream": msg.stream, "data": msg.data})
+        finally:
+            try:
+                stream.close()
+            except Exception:
+                pass
+        return out
+
     def list_tasks(self, service_id: str = "", node_id: str = "") -> List[Task]:
         from ..state.store import All, ByNode, ByService
         if service_id:

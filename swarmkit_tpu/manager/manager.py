@@ -241,8 +241,10 @@ class Manager:
             restarts = RestartSupervisor(self.store)
             self.dispatcher = Dispatcher(self.store,
                                          self._dispatcher_config)
-            # agents publish task logs through their dispatcher surface
+            # agents publish task logs through their dispatcher surface;
+            # the CLI reads them back via the control api
             self.dispatcher.log_broker = self.logbroker
+            self.control_api.log_broker = self.logbroker
             self.dispatcher.run()
             self.allocator = Allocator(self.store)
             planner = TPUPlanner() if self.use_device_scheduler else None
@@ -320,6 +322,10 @@ class Manager:
             if not self._is_leader:
                 return
             self._is_leader = False
+            # a follower's broker receives nothing (agents publish to
+            # the leader): collect_logs must fail loudly, not block then
+            # return empty
+            self.control_api.log_broker = None
             log.info("manager %s lost leadership", self.node_id[:8])
             loops = [self.role_manager, self.keymanager,
                      self.volume_enforcer,

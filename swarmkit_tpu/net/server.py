@@ -247,6 +247,12 @@ class ManagerServer:
             return "ok"
         if method == "get_service":
             return obj_out(api.get_service(params["service_id"]))
+        if method == "collect_logs":
+            import base64 as _b64
+            return [dict(m, data=_b64.b64encode(m["data"]).decode())
+                    for m in api.collect_logs(
+                        params["service_id"],
+                        duration=params.get("duration", 2.0))]
         if method == "list_services":
             return [obj_out(s) for s in api.list_services(
                 name_prefix=params.get("name_prefix", ""))]
