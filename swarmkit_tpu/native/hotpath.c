@@ -446,6 +446,20 @@ fail:
     return NULL;
 }
 
+/* Equality of two borrowed dict values where either may be NULL (missing
+ * key).  Returns 1/0, or -1 with an exception set.  Kept out of the `||`
+ * short-circuit form: in C, `x || rich_compare()` turns an error return of
+ * -1 into truthy 1, silently swallowing the pending exception. */
+static int
+dict_vals_equal(PyObject *a, PyObject *b)
+{
+    if (a == b)
+        return 1;
+    if (a == NULL || b == NULL)
+        return 0;
+    return PyObject_RichCompareBool(a, b, Py_EQ);
+}
+
 /* commit_apply(stamped, objects, by_node, reindex_cb) -> None
  *
  * Install each stamped task into the objects table; maintain the by_node
@@ -465,6 +479,11 @@ commit_apply(PyObject *self, PyObject *args)
         if (!d)
             return NULL;
         PyObject *tid = PyDict_GetItem(d, s_id);
+        if (!tid) {
+            PyErr_SetString(PyExc_KeyError, "stamped task has no id");
+            Py_DECREF(d);
+            return NULL;
+        }
         PyObject *old = PyDict_GetItem(objects, tid); /* borrowed */
         Py_XINCREF(old);
         if (PyDict_SetItem(objects, tid, obj) < 0) {
@@ -483,10 +502,9 @@ commit_apply(PyObject *self, PyObject *args)
             PyObject *nsid = PyDict_GetItem(d, s_service_id);
             PyObject *oslot = PyDict_GetItem(od, s_slot);
             PyObject *nslot = PyDict_GetItem(d, s_slot);
-            int same_sid = (osid == nsid) ||
-                           PyObject_RichCompareBool(osid, nsid, Py_EQ);
-            int same_slot = (oslot == nslot) ||
-                            PyObject_RichCompareBool(oslot, nslot, Py_EQ);
+            int same_sid = dict_vals_equal(osid, nsid);
+            int same_slot = same_sid < 0 ? 0
+                            : dict_vals_equal(oslot, nslot);
             if (same_sid < 0 || same_slot < 0) {
                 Py_DECREF(od);
                 Py_DECREF(old);
@@ -507,8 +525,7 @@ commit_apply(PyObject *self, PyObject *args)
             else {
                 PyObject *onid = PyDict_GetItem(od, s_node_id);
                 PyObject *nnid = PyDict_GetItem(d, s_node_id);
-                int eq = (onid == nnid) ||
-                         PyObject_RichCompareBool(onid, nnid, Py_EQ);
+                int eq = dict_vals_equal(onid, nnid);
                 if (eq < 0) {
                     Py_DECREF(od);
                     Py_DECREF(old);

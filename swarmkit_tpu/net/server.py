@@ -130,6 +130,15 @@ class ManagerServer:
         if node_id and cert.node_id != node_id:
             raise SecurityError("certificate/node mismatch")
 
+    @staticmethod
+    def _require_manager_cert(cert: Optional[Certificate],
+                              what: str) -> None:
+        from ..models.types import NodeRole
+        ManagerServer._require_cert(cert)
+        if NodeRole(cert.role) != NodeRole.MANAGER:
+            raise SecurityError(
+                f"a manager certificate is required {what}")
+
     # -------------------------------------------------------------- methods
 
     def _dispatch(self, method: str, params: Dict[str, Any],
@@ -191,19 +200,18 @@ class ManagerServer:
         # ---- manager join (MANAGER-cert gated)
         if method == "raft_join":
             self._require_cert(cert, params["node_id"])
-            from ..models.types import NodeRole
-            if NodeRole(cert.role) != NodeRole.MANAGER:
-                raise SecurityError(
-                    "a manager certificate is required to join raft")
+            self._require_manager_cert(cert, "to join raft")
             return m.join_raft(params["node_id"],
                                addr=params.get("addr"),
                                api_addr=params.get("api_addr"))
 
-        # ---- control surface (cert-gated; the reference gates on the
-        # manager/user role — here any valid cluster cert)
+        # ---- control surface (MANAGER-cert gated: the reference serves the
+        # control API only on the operator socket / to manager-role mTLS
+        # identities — a worker cert must NOT be able to mutate cluster
+        # state, or any compromised worker could promote itself)
         api = m.control_api
         if method.startswith("control."):
-            self._require_cert(cert)
+            self._require_manager_cert(cert, "for the control API")
             return self._dispatch_control(api, method[len("control."):],
                                           params)
         raise ValueError(f"unknown method {method!r}")

@@ -53,11 +53,16 @@ class KeyEncoder(Encoder):
 
     MAGIC = b"ENCR1:"
 
-    def __init__(self, dek: bytes):
+    def __init__(self, dek: bytes, allow_plaintext: bool = False):
         if not dek:
             raise ValueError("a non-empty data encryption key is required")
         self._enc_key = hashlib.sha256(b"enc" + dek).digest()
         self._mac_key = hashlib.sha256(b"mac" + dek).digest()
+        # migration-only escape hatch: replaying a WAL written before
+        # encryption was enabled.  Steady-state decode fails closed —
+        # otherwise an attacker with state-dir write access could inject
+        # unauthenticated plaintext records that replay as raft state.
+        self.allow_plaintext = allow_plaintext
 
     def _stream(self, data: bytes, nonce: bytes) -> bytes:
         out = bytearray()
@@ -77,9 +82,13 @@ class KeyEncoder(Encoder):
 
     def decode(self, data: bytes) -> bytes:
         if not data.startswith(self.MAGIC):
-            # plaintext record (pre-encryption WAL): pass through so
-            # enabling encryption on an existing state dir still replays
-            return data
+            if self.allow_plaintext:
+                # pre-encryption WAL migration replay, explicitly opted in
+                return data
+            raise DecryptionError(
+                "unencrypted record in an encrypted raft log (pass "
+                "allow_plaintext=True only for a one-time migration "
+                "replay of a pre-encryption state dir)")
         tag, body = data[6:38], data[38:]
         want = _hmac.new(self._mac_key, body, hashlib.sha256).digest()
         if not _hmac.compare_digest(tag, want):

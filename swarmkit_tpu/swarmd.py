@@ -46,7 +46,8 @@ class Swarmd:
                  join_addr: Optional[Tuple[str, int]] = None,
                  join_token: str = "",
                  executor=None,
-                 use_device_scheduler: bool = True):
+                 use_device_scheduler: bool = True,
+                 migrate_plaintext_wal: bool = False):
         import os
 
         from .agent.testutils import TestExecutor
@@ -67,6 +68,9 @@ class Swarmd:
         self.join_token = join_token
         self.executor = executor or TestExecutor(hostname=self.hostname)
         self.use_device_scheduler = use_device_scheduler
+        # one-time replay of a state dir written before WAL encryption
+        # existed (--migrate-plaintext-wal); steady state fails closed
+        self.migrate_plaintext_wal = migrate_plaintext_wal
         self.manager = None
         self.server = None
         self.node = None
@@ -366,7 +370,9 @@ class Swarmd:
         self.raft_node = RaftNode(
             raft_id, [raft_id], store,
             RaftLogger(os.path.join(self.state_dir, "raft"),
-                       encoder=KeyEncoder(ca.key)),
+                       encoder=KeyEncoder(
+                           ca.key,
+                           allow_plaintext=self.migrate_plaintext_wal)),
             self.raft_transport)
         store._proposer = self.raft_node
         self.manager = Manager(

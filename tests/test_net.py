@@ -67,7 +67,13 @@ def test_remote_agent_and_control_over_tcp():
                            fromlist=["Node"]).Node, node_id)) is not None,
             msg="remote node should self-register")
 
-        control = RemoteControlClient(server.addr, cert)
+        # the control surface is manager-role gated: a worker cert is
+        # rejected, an operator needs a manager-token-issued cert
+        with pytest.raises(PermissionError):
+            RemoteControlClient(server.addr, cert).list_nodes()
+        op_cert = issue_certificate(server.addr, new_id(),
+                                    cluster.root_ca.join_tokens.manager)
+        control = RemoteControlClient(server.addr, op_cert)
         svc = control.create_service(make_replicated("web", 3).spec)
 
         def running():
@@ -241,7 +247,9 @@ def test_collect_logs_over_tcp():
         agent.log_ship_interval = 0.1
         agent.start()
 
-        control = RemoteControlClient(server.addr, cert)
+        op_cert = issue_certificate(server.addr, new_id(),
+                                    cluster.root_ca.join_tokens.manager)
+        control = RemoteControlClient(server.addr, op_cert)
         from swarmkit_tpu.models import (
             Annotations, ContainerSpec, ReplicatedService,
             RestartCondition, RestartPolicy, ServiceMode, ServiceSpec,

@@ -435,7 +435,9 @@ def test_wal_at_rest_encryption(tmp_path):
                  RaftLogger(d, encoder=KeyEncoder(b"wrong")),
                  LocalNetwork())
 
-    # plaintext (pre-encryption) records replay through KeyEncoder
+    # plaintext (pre-encryption) records: steady-state decode fails closed
+    # (unauthenticated records must not replay as raft state); the
+    # explicit one-time migration flag allows the replay
     d2 = os.path.join(tmp_path, "plain")
     store3 = MemoryStore()
     rn3 = RaftNode("n1", ["n1"], store3, RaftLogger(d2), LocalNetwork())
@@ -445,8 +447,14 @@ def test_wal_at_rest_encryption(tmp_path):
     svc2 = make_service("plain")
     store3.update(lambda tx: tx.create(svc2))
     rn3.stop()
+    from swarmkit_tpu.state.raft.storage import DecryptionError
+    with pytest.raises(DecryptionError):
+        RaftNode("n1", ["n1"], MemoryStore(),
+                 RaftLogger(d2, encoder=KeyEncoder(dek)), LocalNetwork())
     store4 = MemoryStore()
-    rn4 = RaftNode("n1", ["n1"], store4,
-                   RaftLogger(d2, encoder=KeyEncoder(dek)), LocalNetwork())
+    rn4 = RaftNode(
+        "n1", ["n1"], store4,
+        RaftLogger(d2, encoder=KeyEncoder(dek, allow_plaintext=True)),
+        LocalNetwork())
     assert store4.view(lambda tx: tx.get(Service, svc2.id)) is not None
     rn4.logger.close()
