@@ -130,7 +130,7 @@ class Manager:
             return
         state = clusters[0].root_ca
         if state is not None and state.ca_key:
-            self.root_ca.key = state.ca_key
+            self.root_ca.restore(state.ca_key, state.ca_cert)
             self.root_ca.restore_join_tokens(state.join_tokens)
 
     def _ca_adoption_loop(self) -> None:
@@ -210,7 +210,7 @@ class Manager:
                 # would invalidate every issued cert and join token
                 state = existing[0].root_ca
                 if state is not None and state.ca_key:
-                    self.root_ca.key = state.ca_key
+                    self.root_ca.restore(state.ca_key, state.ca_cert)
                     self.root_ca.restore_join_tokens(state.join_tokens)
                 return
             cluster = Cluster(
@@ -221,6 +221,7 @@ class Manager:
             from ..models.types import JoinTokens
             cluster.root_ca = RootCAState(
                 ca_key=self.root_ca.key,
+                ca_cert=self.root_ca.cert_pem,
                 join_tokens=JoinTokens(
                     worker=self.root_ca.join_token(NodeRole.WORKER),
                     manager=self.root_ca.join_token(NodeRole.MANAGER)))
@@ -314,7 +315,9 @@ class Manager:
         # material to joining managers via the certificate response,
         # ca/certificates.go); the RPC is MANAGER-cert gated
         return {"members": members,
-                "ca_key": base64.b64encode(self.root_ca.key).decode()}
+                "ca_key": base64.b64encode(self.root_ca.key).decode(),
+                "ca_cert": base64.b64encode(
+                    self.root_ca.cert_pem).decode()}
 
     def _become_follower(self) -> None:
         """reference: manager.go:1150 becomeFollower."""

@@ -18,7 +18,10 @@ from ..manager.controlapi import (
 )
 from ..models.objects import STORE_OBJECT_TYPES
 from ..models.types import TaskStatus
-from ..security.ca import Certificate
+from ..security.ca import (
+    Certificate, InvalidToken, SecurityError, generate_key_pem, make_csr,
+)
+from ..security.tls import client_context, require_server_role
 from ..state import serde
 from ..state.watch import Closed
 from .wire import recv_frame, send_frame
@@ -52,18 +55,48 @@ def _obj_in(data):
 
 
 class _Connection:
+    """One mTLS link to a manager.  With ``tls`` (default) the client
+    presents its certificate in the handshake and verifies the server
+    chains to the cluster root AND carries the manager role; ``tls=False``
+    falls back to plaintext hello-frame attestation (debug knob);
+    ``insecure=True`` skips server verification for the join bootstrap."""
+
     def __init__(self, addr: Tuple[str, int],
-                 certificate: Optional[Certificate]):
+                 certificate: Optional[Certificate],
+                 tls: bool = True, insecure: bool = False):
         self.addr = addr
         self.certificate = certificate
+        self.tls = tls
+        self.insecure = insecure
         self._sock: Optional[socket.socket] = None
         self._mu = threading.Lock()
         self._next_id = 0
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(self.addr, timeout=10)
-        cert_data = (self.certificate.to_bytes().decode()
-                     if self.certificate else None)
+        cert_data = None
+        if self.tls:
+            identity = (self.certificate
+                        if (self.certificate
+                            and self.certificate.key_pem
+                            and self.certificate.cert_pem) else None)
+            ctx = client_context(
+                identity,
+                ca_cert_pem=(self.certificate.ca_cert_pem
+                             if self.certificate else b""),
+                insecure=self.insecure)
+            try:
+                sock = ctx.wrap_socket(sock)
+                if not self.insecure:
+                    require_server_role(sock, "swarm-manager")
+            except SecurityError:
+                sock.close()
+                raise
+            except Exception as e:
+                sock.close()
+                raise PermissionError(f"TLS handshake failed: {e}")
+        elif self.certificate:
+            cert_data = self.certificate.to_bytes().decode()
         send_frame(sock, {"id": 0, "method": "hello",
                           "params": {"certificate": cert_data}})
         resp = recv_frame(sock)
@@ -110,13 +143,57 @@ class _Connection:
 
 
 def issue_certificate(addr: Tuple[str, int], node_id: str,
-                      token: str) -> Certificate:
-    """Join: obtain a certificate with a join token (no cert needed)."""
-    conn = _Connection(addr, None)
+                      token: str, tls: bool = True) -> Certificate:
+    """Join: obtain a certificate with a join token (no cert needed).
+
+    Bootstrap has no trust root yet, so the root fetch runs over an
+    unverified connection and the downloaded root CA cert is checked
+    against the digest embedded in the join token.  The secret token +
+    CSR are then sent over a NEW connection with that root pinned — the
+    digest check validates bytes, not the channel, so sending the token
+    on the unverified link would hand it to an active MITM (reference:
+    ca.DownloadRootCA then a verified NodeCA connection; the private key
+    is generated locally and never travels)."""
+    boot = _Connection(addr, None, tls=tls, insecure=True)
     try:
-        data = conn.call("issue_certificate",
-                         {"node_id": node_id, "token": token})
-        return Certificate.from_bytes(data.encode())
+        root = boot.call("fetch_root_ca", {})
+    finally:
+        boot.close()
+    ca_cert_pem = root["ca_cert"].encode()
+    parts = token.split("-")
+    if len(parts) != 4:
+        raise InvalidToken("invalid join token")
+    from ..security.ca import cert_digest
+    if cert_digest(ca_cert_pem) != parts[2]:
+        raise InvalidToken(
+            "downloaded root CA does not match the join token digest")
+    key_pem = generate_key_pem()
+    conn = _Connection(addr, Certificate(cert_pem=b"", key_pem=b"",
+                                         ca_cert_pem=ca_cert_pem),
+                       tls=tls)
+    try:
+        resp = conn.call("issue_certificate", {
+            "node_id": node_id, "token": token,
+            "csr": make_csr(node_id, key_pem).decode()})
+        return Certificate(cert_pem=resp["cert"].encode(),
+                           key_pem=key_pem, ca_cert_pem=ca_cert_pem)
+    finally:
+        conn.close()
+
+
+def renew_certificate(addr: Tuple[str, int],
+                      certificate: Certificate,
+                      tls: bool = True) -> Certificate:
+    """Cert-gated renewal over the wire: fresh local key + CSR, same
+    identity/role (reference: ca/renewer.go RequestAndSaveNewCertificates)."""
+    conn = _Connection(addr, certificate, tls=tls)
+    try:
+        key_pem = generate_key_pem()
+        resp = conn.call("renew_certificate", {
+            "csr": make_csr(certificate.node_id, key_pem).decode()})
+        return Certificate(cert_pem=resp["cert"].encode(),
+                           key_pem=key_pem,
+                           ca_cert_pem=resp["ca_cert"].encode())
     finally:
         conn.close()
 

@@ -1,10 +1,14 @@
 """TCP raft transport: manager↔manager consensus traffic over the network.
 
 Reference: manager/state/raft/transport/ (per-peer gRPC streams with
-ordered delivery).  Each member listens on a TCP port; sends go over one
-persistent, ordered connection per peer with automatic reconnect.
-Implements the same two-method surface as transport.LocalNetwork, so
-RaftNode is transport-agnostic.
+ordered delivery, mTLS via ca/transport.go).  Each member listens on a
+TCP port; sends go over one persistent, ordered connection per peer with
+automatic reconnect.  Implements the same two-method surface as
+transport.LocalNetwork, so RaftNode is transport-agnostic.
+
+Security: with ``tls_identity`` (a manager Certificate) every link is
+mutual TLS — both sides must present manager-role certs chaining to the
+cluster root.  The ``auth_key`` HMAC-hello is the plaintext fallback knob.
 """
 
 from __future__ import annotations
@@ -15,9 +19,11 @@ import logging
 import queue
 import socket
 import socketserver
+import ssl
 import threading
 from typing import Callable, Dict, Optional, Tuple
 
+from ..security.ca import SecurityError
 from ..state import serde
 from ..state.raft.core import Message
 from .wire import recv_frame, send_frame
@@ -27,12 +33,20 @@ log = logging.getLogger("net.raft")
 
 class TCPRaftTransport:
     def __init__(self, node_id: str, host: str = "127.0.0.1",
-                 port: int = 0, auth_key: Optional[bytes] = None):
-        """``auth_key``: shared cluster secret (the root CA key); peers
-        must open connections with a matching HMAC hello or their frames
-        are rejected — consensus traffic is manager-only."""
+                 port: int = 0, auth_key: Optional[bytes] = None,
+                 tls_identity=None):
+        """``tls_identity``: this manager's Certificate (with key + trust
+        root) — enables mutual TLS with CERT_REQUIRED and manager-role
+        authorization both ways.  ``auth_key``: shared-secret HMAC hello,
+        the plaintext fallback — consensus traffic is manager-only either
+        way."""
         self.node_id = node_id
         self.auth_key = auth_key
+        self.tls_identity = None
+        self._server_ctx = None
+        self._client_ctx = None
+        if tls_identity is not None:
+            self.set_identity(tls_identity)
         self._handler: Optional[Callable[[Message], None]] = None
         self._peers: Dict[str, Tuple[str, int]] = {}
         self._send_queues: Dict[str, "queue.Queue"] = {}
@@ -42,15 +56,24 @@ class TCPRaftTransport:
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                sock = self.request
                 try:
-                    if outer.auth_key is not None:
-                        hello = recv_frame(self.request)
+                    ctx = outer._server_ctx
+                    if ctx is not None:
+                        try:
+                            sock = ctx.wrap_socket(sock, server_side=True)
+                            outer._authorize_peer(sock)
+                        except Exception as e:
+                            log.warning("rejected raft peer: %s", e)
+                            return
+                    elif outer.auth_key is not None:
+                        hello = recv_frame(sock)
                         sig = hello.get("hello", "")
                         if not hmac.compare_digest(sig, outer._hello_sig()):
                             log.warning("rejected unauthenticated raft peer")
                             return
                     while True:
-                        frame = recv_frame(self.request)
+                        frame = recv_frame(sock)
                         handler = outer._handler
                         if handler is not None:
                             handler(serde.from_dict(Message, frame))
@@ -70,6 +93,21 @@ class TCPRaftTransport:
     def _hello_sig(self) -> str:
         return hmac.new(self.auth_key or b"", b"raft-transport-v1",
                         hashlib.sha256).hexdigest()
+
+    def set_identity(self, tls_identity) -> None:
+        """(Re)build TLS contexts — also used when a restarted bootstrap
+        manager adopts the replicated cluster's CA."""
+        from ..security.tls import client_context, server_context
+        self.tls_identity = tls_identity
+        self._server_ctx = server_context(tls_identity,
+                                          require_client_cert=True)
+        self._client_ctx = client_context(tls_identity)
+
+    @staticmethod
+    def _authorize_peer(ssl_sock) -> None:
+        """Both raft-link directions require the manager role."""
+        from ..security.tls import require_server_role
+        require_server_role(ssl_sock, "swarm-manager")
 
     # ------------------------------------------------------------- topology
 
@@ -128,11 +166,15 @@ class TCPRaftTransport:
                 try:
                     if sock is None:
                         sock = socket.create_connection(addr, timeout=5)
-                        if self.auth_key is not None:
+                        if self._client_ctx is not None:
+                            sock = self._client_ctx.wrap_socket(sock)
+                            self._authorize_peer(sock)
+                        elif self.auth_key is not None:
                             send_frame(sock, {"hello": self._hello_sig()})
                     send_frame(sock, serde.to_dict(msg))
                     break
-                except (ConnectionError, OSError):
+                except (ssl.SSLError, ConnectionError, OSError,
+                        SecurityError):
                     if sock is not None:
                         try:
                             sock.close()

@@ -1,13 +1,18 @@
-"""Manager network server: dispatcher + control API + CA over TCP.
+"""Manager network server: dispatcher + control API + CA over mTLS TCP.
 
 Reference role: the manager's gRPC servers (manager.go:475-563) — the
 worker-facing Dispatcher service, the user-facing Control service, and the
-NodeCA issuance service — behind certificate-verified connections.
+NodeCA issuance service — all behind mutual TLS rooted at the cluster CA
+(reference: ca/transport.go).
 
 One thread per connection (the control plane is low-rate); the assignments
-stream switches its connection into push mode.  Certificate verification
-gates every method except ``issue_certificate`` (which is gated by a join
-token instead, like the reference's token-gated NodeCA.IssueNodeCertificate).
+stream switches its connection into push mode.  The TLS handshake
+authenticates the peer: its verified client certificate is the identity
+every method is gated on.  ``fetch_root_ca``/``issue_certificate`` remain
+reachable without a client cert (gated by join token instead, like the
+reference's token-gated NodeCA.IssueNodeCertificate).  ``tls=False`` falls
+back to hello-frame certificate attestation over plaintext — a debugging
+knob only, since a bearer attestation is replayable.
 """
 
 from __future__ import annotations
@@ -15,6 +20,7 @@ from __future__ import annotations
 import logging
 import socket
 import socketserver
+import ssl
 import threading
 from typing import Any, Dict, Optional
 
@@ -22,6 +28,7 @@ from ..models.objects import STORE_OBJECT_TYPES
 from ..models.specs import NodeSpec, SecretSpec, ServiceSpec
 from ..models.types import NodeDescription, TaskStatus
 from ..security.ca import Certificate, SecurityError
+from ..security.tls import peer_certificate, server_context
 from ..state import serde
 from ..state.watch import Closed
 from .wire import recv_frame, send_frame
@@ -37,8 +44,21 @@ class NotLeaderError(Exception):
 
 
 class ManagerServer:
-    def __init__(self, manager, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, manager, host: str = "127.0.0.1", port: int = 0,
+                 tls: bool = True,
+                 tls_identity: Optional[Certificate] = None):
         self.manager = manager
+        self.tls = tls
+        if tls:
+            if tls_identity is None or not tls_identity.key_pem:
+                # self-issue the API server's identity from the cluster CA
+                # (the reference manager serves with its own node cert)
+                from ..models.types import NodeRole
+                from ..utils import new_id
+                tls_identity = manager.root_ca.issue(
+                    "manager-api-" + new_id()[:8], NodeRole.MANAGER)
+            self.tls_identity = tls_identity
+            self._ssl_ctx = server_context(tls_identity)
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -58,6 +78,12 @@ class ManagerServer:
                                         name="manager-server", daemon=True)
         self._thread.start()
 
+    def set_tls_identity(self, tls_identity: Certificate) -> None:
+        """Swap the serving identity (renewal / root rotation); new
+        connections handshake with the fresh cert."""
+        self.tls_identity = tls_identity
+        self._ssl_ctx = server_context(tls_identity)
+
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
@@ -67,13 +93,31 @@ class ManagerServer:
     def _handle_conn(self, sock: socket.socket) -> None:
         cert: Optional[Certificate] = None
         try:
+            if self.tls:
+                try:
+                    sock = self._ssl_ctx.wrap_socket(sock,
+                                                     server_side=True)
+                except (ssl.SSLError, ConnectionError, OSError) as e:
+                    log.debug("TLS handshake failed: %s", e)
+                    return
+                # identity = the TLS-authenticated client cert (chain and
+                # validity checked by the handshake; issuer re-checked
+                # against the *current* root in case of rotation)
+                cert = peer_certificate(sock)
+                if cert is not None:
+                    try:
+                        self.manager.root_ca.verify(cert)
+                    except SecurityError:
+                        cert = None
             hello = recv_frame(sock)
             if hello.get("method") != "hello":
                 send_frame(sock, {"id": hello.get("id"),
                                   "error": "expected hello"})
                 return
             cert_data = hello.get("params", {}).get("certificate")
-            if cert_data:
+            if cert_data and not self.tls:
+                # plaintext fallback: hello-frame attestation (replayable
+                # bearer — debugging only)
                 try:
                     cert = Certificate.from_bytes(cert_data.encode())
                     self.manager.root_ca.verify(cert)
@@ -145,16 +189,38 @@ class ManagerServer:
                   cert: Optional[Certificate]) -> Any:
         m = self.manager
 
-        # ---- CA (token-gated, no cert needed)
+        # ---- CA (token-gated, no client cert needed)
+        if method == "fetch_root_ca":
+            # bootstrap: the joiner verifies this against its token digest
+            # (reference: ca.DownloadRootCA GetRootCACertificate)
+            return {"ca_cert": m.root_ca.cert_pem.decode()}
         if method == "issue_certificate":
             # a follower validates against replicated cluster state; pull
             # the latest adoption synchronously so a token minted on the
             # leader moments ago is honored here too
             if hasattr(m, "_adopt_ca_state"):
                 m._adopt_ca_state()
+            csr = params.get("csr")
+            if csr:
+                cert_pem = m.ca_server.issue_node_certificate(
+                    params["node_id"], params["token"],
+                    csr_pem=csr.encode())
+                return {"cert": cert_pem.decode(),
+                        "ca_cert": m.root_ca.cert_pem.decode()}
+            # certless legacy path: key generated server-side
             issued = m.ca_server.issue_node_certificate(
                 params["node_id"], params["token"])
-            return issued.to_bytes().decode()
+            return {"cert": issued.cert_pem.decode(),
+                    "key": issued.key_pem.decode(),
+                    "ca_cert": m.root_ca.cert_pem.decode()}
+        if method == "renew_certificate":
+            # gated on the caller's valid cert: same identity + role,
+            # fresh validity (reference: ca/renewer.go)
+            self._require_cert(cert)
+            cert_pem = m.ca_server.renew(cert,
+                                         csr_pem=params["csr"].encode())
+            return {"cert": cert_pem.decode(),
+                    "ca_cert": m.root_ca.cert_pem.decode()}
 
         # ---- dispatcher surface (cert-gated to the calling node)
         if method == "register":
@@ -304,12 +370,14 @@ class ManagerServer:
                 except TimeoutError:
                     # liveness probe: a vanished peer would otherwise leak
                     # this thread + its dispatcher stream until the next
-                    # push attempt
+                    # push attempt.  On TLS sockets a would-block read
+                    # surfaces as SSLWantReadError, not BlockingIOError.
                     sock.setblocking(False)
                     try:
                         if sock.recv(1) == b"":
                             return  # peer closed
-                    except (BlockingIOError, InterruptedError):
+                    except (BlockingIOError, InterruptedError,
+                            ssl.SSLWantReadError):
                         pass
                     finally:
                         sock.setblocking(True)

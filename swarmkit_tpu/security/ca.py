@@ -1,20 +1,28 @@
-"""Cluster CA: node identity, join tokens, certificate issuance/rotation.
+"""Cluster CA: real x509 node identity, join tokens, issuance/rotation.
 
 Reference: ca/{certificates.go,server.go,keyreadwriter.go} and
 manager/encryption.
 
-Scope note: the baked-in environment has no x509/TLS certificate library,
-so certificates here are HMAC-signed identity attestations over the
-cluster's root key — the full trust machinery (root CA material, join
-tokens in the reference's SWMTKN format, role-gated issuance, renewal,
-rotation with cross-trust, KEK-encrypted key storage) with the signature
-primitive swapped.  A TLS transport can replace the primitive 1:1 at the
-``RootCA.issue``/``verify`` seam.
+Certificates are real x509 (EC P-256, ECDSA-SHA256) built with the
+``cryptography`` library, mirroring the reference's layout
+(ca/certificates.go:167 RootCA; signNodeCert server.go:764):
+
+  - root: self-signed CA cert, 20y validity, CN=swarm-ca, O=<cluster id>
+  - node: CN=<node id>, OU=<role: swarm-manager|swarm-worker>,
+    O=<cluster id>, signed by the root, default 90d validity
+
+The same PEM material feeds the TLS transports (security/tls.py); the
+``Certificate`` dataclass carries the cert PEM (wire form) plus the
+private key and trust-root PEM locally (never serialized).  Join tokens
+follow the reference's SWMTKN-1-<root cert digest>-<role secret> shape,
+so a joiner can bootstrap-verify the downloaded root against its token
+(reference: ca.DownloadRootCA digest check).
 """
 
 from __future__ import annotations
 
 import base64
+import datetime
 import hashlib
 import hmac
 import json
@@ -23,10 +31,20 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
 from ..models.types import NodeRole
 
 DEFAULT_NODE_CERT_EXPIRY = 90 * 24 * 3600.0  # reference: ca/certificates.go
+ROOT_CA_EXPIRY = 20 * 365 * 24 * 3600.0
 TOKEN_VERSION = "SWMTKN-1"
+
+# role <-> OU mapping (reference: ca/certificates.go ManagerRole/WorkerRole)
+ROLE_OU = {NodeRole.MANAGER: "swarm-manager", NodeRole.WORKER: "swarm-worker"}
+OU_ROLE = {v: k for k, v in ROLE_OU.items()}
 
 
 class SecurityError(Exception):
@@ -45,50 +63,133 @@ def _b32(data: bytes) -> str:
     return base64.b32encode(data).decode("ascii").strip("=").lower()
 
 
+def _ts(dt: datetime.datetime) -> float:
+    return dt.replace(tzinfo=datetime.timezone.utc).timestamp() \
+        if dt.tzinfo is None else dt.timestamp()
+
+
+def _utc(ts: float) -> datetime.datetime:
+    return datetime.datetime.fromtimestamp(ts, datetime.timezone.utc)
+
+
+def cert_digest(cert_pem: bytes) -> str:
+    """Digest of a certificate's DER bytes — the token-embedded root
+    fingerprint (must match RootCA.digest; both sides call this)."""
+    der = x509.load_pem_x509_certificate(cert_pem).public_bytes(
+        serialization.Encoding.DER)
+    return hashlib.sha256(der).hexdigest()[:32]
+
+
+def generate_key_pem() -> bytes:
+    key = ec.generate_private_key(ec.SECP256R1())
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+
+
+def make_csr(node_id: str, key_pem: bytes) -> bytes:
+    """Client-side CSR for network issuance: the private key never leaves
+    the node (reference: ca/certificates.go CreateCSR)."""
+    key = serialization.load_pem_private_key(key_pem, password=None)
+    csr = x509.CertificateSigningRequestBuilder().subject_name(
+        x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, node_id)])
+    ).sign(key, hashes.SHA256())
+    return csr.public_bytes(serialization.Encoding.PEM)
+
+
 @dataclass
 class Certificate:
-    """A signed node identity (role + expiry) — the mTLS cert stand-in."""
+    """A node's x509 identity.  ``cert_pem`` is the wire form; the private
+    key and the cluster trust root travel only inside the process / the
+    node's key file."""
 
-    node_id: str
-    role: int
-    issued_at: float
-    expires_at: float
-    issuer_digest: str
-    signature: str = ""
+    cert_pem: bytes
+    key_pem: bytes = b""       # node private key (local only)
+    ca_cert_pem: bytes = b""   # trust root bundle (local only)
 
-    def payload(self) -> bytes:
-        return json.dumps({
-            "node_id": self.node_id, "role": self.role,
-            "issued_at": self.issued_at, "expires_at": self.expires_at,
-            "issuer": self.issuer_digest,
-        }, sort_keys=True).encode()
+    def _x509(self) -> x509.Certificate:
+        cached = self.__dict__.get("_parsed")
+        if cached is None or self.__dict__.get("_parsed_src") != self.cert_pem:
+            try:
+                cached = x509.load_pem_x509_certificate(self.cert_pem)
+            except Exception as e:
+                raise InvalidCertificate(f"bad certificate PEM: {e}")
+            self.__dict__["_parsed"] = cached
+            self.__dict__["_parsed_src"] = self.cert_pem
+        return cached
+
+    @staticmethod
+    def _name_attr(name: x509.Name, oid) -> str:
+        attrs = name.get_attributes_for_oid(oid)
+        return attrs[0].value if attrs else ""
+
+    @property
+    def node_id(self) -> str:
+        return self._name_attr(self._x509().subject, NameOID.COMMON_NAME)
+
+    @property
+    def role(self) -> int:
+        ou = self._name_attr(self._x509().subject,
+                             NameOID.ORGANIZATIONAL_UNIT_NAME)
+        return int(OU_ROLE.get(ou, NodeRole.WORKER))
+
+    @property
+    def org(self) -> str:
+        return self._name_attr(self._x509().subject,
+                               NameOID.ORGANIZATION_NAME)
+
+    @property
+    def issued_at(self) -> float:
+        return _ts(self._x509().not_valid_before_utc)
+
+    @property
+    def expires_at(self) -> float:
+        return _ts(self._x509().not_valid_after_utc)
 
     def to_bytes(self) -> bytes:
-        return json.dumps({
-            "node_id": self.node_id, "role": self.role,
-            "issued_at": self.issued_at, "expires_at": self.expires_at,
-            "issuer": self.issuer_digest, "sig": self.signature,
-        }, sort_keys=True).encode()
+        return self.cert_pem
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Certificate":
+        cert = cls(cert_pem=data)
+        cert._x509()   # validate eagerly: wire data may be garbage
+        return cert
+
+    @classmethod
+    def from_der(cls, der: bytes) -> "Certificate":
         try:
-            d = json.loads(data)
-            return cls(node_id=d["node_id"], role=d["role"],
-                       issued_at=d["issued_at"],
-                       expires_at=d["expires_at"],
-                       issuer_digest=d["issuer"], signature=d["sig"])
+            parsed = x509.load_der_x509_certificate(der)
         except Exception as e:
-            raise InvalidCertificate(str(e))
+            raise InvalidCertificate(f"bad certificate DER: {e}")
+        return cls(cert_pem=parsed.public_bytes(serialization.Encoding.PEM))
 
 
 class RootCA:
-    """Cluster trust root (reference: ca/certificates.go:167 RootCA)."""
+    """Cluster trust root (reference: ca/certificates.go:167 RootCA).
+
+    ``key`` is the CA private key PEM — also used as the cluster's opaque
+    secret for the WAL DEK and HMAC-transport fallback, matching the
+    reference's use of the CA key material as the root of the key
+    hierarchy (KEK -> DEK chain, manager/deks.go)."""
 
     def __init__(self, key: Optional[bytes] = None,
+                 cert: Optional[bytes] = None,
                  node_cert_expiry: float = DEFAULT_NODE_CERT_EXPIRY):
-        self.key = key or os.urandom(32)
         self.node_cert_expiry = node_cert_expiry
+        if key is not None and not key.lstrip().startswith(b"-----"):
+            raise ValueError(
+                "RootCA key must be a private-key PEM (legacy raw-secret "
+                "roots are not supported)")
+        if key is None:
+            key = generate_key_pem()
+            cert = None
+        self.key = key
+        self._ca_key = serialization.load_pem_private_key(key, password=None)
+        if cert is None:
+            cert = self._self_sign()
+        self.cert_pem = cert
+        self._ca_cert = x509.load_pem_x509_certificate(cert)
         # secrets from which join tokens derive; rotating tokens replaces
         # these without touching the root key (reference: JoinTokens)
         self._token_secrets = {
@@ -96,9 +197,43 @@ class RootCA:
             NodeRole.MANAGER: os.urandom(16),
         }
 
+    def _self_sign(self) -> bytes:
+        now = time.time()
+        org = _b32(os.urandom(10))   # cluster identity, baked into certs
+        name = x509.Name([
+            x509.NameAttribute(NameOID.COMMON_NAME, "swarm-ca"),
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+        ])
+        cert = (x509.CertificateBuilder()
+                .subject_name(name).issuer_name(name)
+                .public_key(self._ca_key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(_utc(now - 60))
+                .not_valid_after(_utc(now + ROOT_CA_EXPIRY))
+                .add_extension(x509.BasicConstraints(ca=True,
+                                                     path_length=None),
+                               critical=True)
+                .sign(self._ca_key, hashes.SHA256()))
+        return cert.public_bytes(serialization.Encoding.PEM)
+
+    def restore(self, key: bytes, cert: bytes) -> None:
+        """Adopt persisted trust-root material (cluster restart)."""
+        self.key = key
+        self.cert_pem = cert
+        self._ca_key = serialization.load_pem_private_key(key, password=None)
+        self._ca_cert = x509.load_pem_x509_certificate(cert)
+
+    @property
+    def org(self) -> str:
+        attrs = self._ca_cert.subject.get_attributes_for_oid(
+            NameOID.ORGANIZATION_NAME)
+        return attrs[0].value if attrs else ""
+
     @property
     def digest(self) -> str:
-        return hashlib.sha256(self.key).hexdigest()[:32]
+        """Digest of the root certificate (token-embedded so joiners can
+        verify a downloaded root, reference: ca/certificates.go digests)."""
+        return cert_digest(self.cert_pem)
 
     # ---------------------------------------------------------- join tokens
 
@@ -142,65 +277,103 @@ class RootCA:
 
     # --------------------------------------------------------- certificates
 
+    def _build_cert(self, node_id: str, role: int, public_key,
+                    expiry: Optional[float]) -> bytes:
+        now = time.time()
+        subject = x509.Name([
+            x509.NameAttribute(NameOID.COMMON_NAME, node_id),
+            x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME,
+                               ROLE_OU[NodeRole(role)]),
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, self.org),
+        ])
+        cert = (x509.CertificateBuilder()
+                .subject_name(subject)
+                .issuer_name(self._ca_cert.subject)
+                .public_key(public_key)
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(_utc(now - 60))
+                .not_valid_after(_utc(now + (expiry
+                                             or self.node_cert_expiry)))
+                .add_extension(x509.BasicConstraints(ca=False,
+                                                     path_length=None),
+                               critical=True)
+                .sign(self._ca_key, hashes.SHA256()))
+        return cert.public_bytes(serialization.Encoding.PEM)
+
     def issue(self, node_id: str, role: int,
               expiry: Optional[float] = None) -> Certificate:
-        """reference: ca/server.go:234 IssueNodeCertificate +
-        signNodeCert :764."""
-        now = time.time()
-        cert = Certificate(
-            node_id=node_id, role=int(role), issued_at=now,
-            expires_at=now + (expiry or self.node_cert_expiry),
-            issuer_digest=self.digest)
-        cert.signature = hmac.new(self.key, cert.payload(),
-                                  hashlib.sha256).hexdigest()
-        return cert
+        """In-process issuance: keypair generated here (reference:
+        ca/server.go:234 IssueNodeCertificate + signNodeCert :764; network
+        joiners instead send a CSR so their key never travels)."""
+        key_pem = generate_key_pem()
+        key = serialization.load_pem_private_key(key_pem, password=None)
+        cert_pem = self._build_cert(node_id, role, key.public_key(), expiry)
+        return Certificate(cert_pem=cert_pem, key_pem=key_pem,
+                           ca_cert_pem=self.cert_pem)
+
+    def sign_csr(self, csr_pem: bytes, node_id: str, role: int,
+                 expiry: Optional[float] = None) -> bytes:
+        """Sign a joiner's CSR.  The CN/OU are chosen by the CA (from the
+        validated token/identity), never trusted from the CSR subject."""
+        try:
+            csr = x509.load_pem_x509_csr(csr_pem)
+        except Exception as e:
+            raise InvalidCertificate(f"bad CSR: {e}")
+        return self._build_cert(node_id, role, csr.public_key(), expiry)
 
     def verify(self, cert: Certificate) -> None:
-        if cert.issuer_digest != self.digest:
+        parsed = cert._x509()
+        if parsed.issuer != self._ca_cert.subject:
             raise InvalidCertificate("certificate from unknown issuer")
-        expect = hmac.new(self.key, cert.payload(),
-                          hashlib.sha256).hexdigest()
-        if not hmac.compare_digest(expect, cert.signature):
+        try:
+            self._ca_cert.public_key().verify(
+                parsed.signature, parsed.tbs_certificate_bytes,
+                ec.ECDSA(parsed.signature_hash_algorithm))
+        except Exception:
             raise InvalidCertificate("bad certificate signature")
-        if cert.expires_at < time.time():
+        now = time.time()
+        if _ts(parsed.not_valid_after_utc) < now:
             raise InvalidCertificate("certificate expired")
+        if _ts(parsed.not_valid_before_utc) > now + 300:
+            raise InvalidCertificate("certificate not yet valid")
 
     def needs_renewal(self, cert: Certificate,
                       threshold: float = 0.5) -> bool:
-        """Renew past half of validity (the reference renews in a jittered
-        window before expiry, ca/renewer.go)."""
-        lifetime = cert.expires_at - cert.issued_at
-        return time.time() > cert.issued_at + lifetime * threshold
+        return needs_renewal(cert, threshold)
+
+
+def needs_renewal(cert: Certificate, threshold: float = 0.5) -> bool:
+    """Renew past half of validity (the reference renews in a jittered
+    window before expiry, ca/renewer.go).  Needs no CA material, so
+    nodes can decide locally."""
+    lifetime = cert.expires_at - cert.issued_at
+    return time.time() > cert.issued_at + lifetime * threshold
 
 
 class KeyReadWriter:
     """Node key-material persistence with a KEK encryption seam
-    (reference: ca/keyreadwriter.go; encryption: manager/encryption)."""
+    (reference: ca/keyreadwriter.go; encryption: manager/encryption).
+    Sealed with the same nonce + encrypt-then-MAC construction the raft
+    WAL uses (state/raft/storage.KeyEncoder) — a fixed-pad XOR would leak
+    plaintext across rewrites and allow undetected tampering."""
 
     def __init__(self, path: str, kek: Optional[bytes] = None):
         self.path = path
         self.kek = kek
 
-    def _stream(self, data: bytes, key: bytes) -> bytes:
-        # XOR keystream from SHA256(kek || counter): stdlib-only symmetric
-        # encryption stand-in behind the same seam nacl/fernet fill in the
-        # reference
-        out = bytearray()
-        counter = 0
-        while len(out) < len(data):
-            block = hashlib.sha256(
-                key + counter.to_bytes(8, "big")).digest()
-            out.extend(block)
-            counter += 1
-        return bytes(a ^ b for a, b in zip(data, out[:len(data)]))
+    def _encoder(self, kek: bytes):
+        from ..state.raft.storage import KeyEncoder
+        return KeyEncoder(kek)
 
     def write(self, cert: Certificate, ca_key: bytes) -> None:
         payload = json.dumps({
-            "cert": cert.to_bytes().decode(),
+            "cert": cert.cert_pem.decode(),
+            "node_key": cert.key_pem.decode(),
+            "ca_cert": cert.ca_cert_pem.decode(),
             "key": base64.b64encode(ca_key).decode(),
         }).encode()
         if self.kek:
-            payload = b"ENC1" + self._stream(payload, self.kek)
+            payload = b"ENC2" + self._encoder(self.kek).encode(payload)
         tmp = self.path + ".tmp"
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         with open(tmp, "wb") as f:
@@ -210,16 +383,24 @@ class KeyReadWriter:
     def read(self) -> Tuple[Certificate, bytes]:
         with open(self.path, "rb") as f:
             payload = f.read()
-        if payload.startswith(b"ENC1"):
+        if payload.startswith(b"ENC2"):
             if not self.kek:
                 raise SecurityError("key material is locked (no KEK)")
-            payload = self._stream(payload[4:], self.kek)
+            from ..state.raft.storage import DecryptionError
+            try:
+                payload = self._encoder(self.kek).decode(payload[4:])
+            except DecryptionError:
+                raise SecurityError(
+                    "key material is corrupt or KEK is wrong")
         try:
             d = json.loads(payload)
         except Exception:
             raise SecurityError("key material is corrupt or KEK is wrong")
-        return (Certificate.from_bytes(d["cert"].encode()),
-                base64.b64decode(d["key"]))
+        cert = Certificate(
+            cert_pem=d["cert"].encode(),
+            key_pem=d.get("node_key", "").encode(),
+            ca_cert_pem=d.get("ca_cert", "").encode())
+        return cert, base64.b64decode(d["key"])
 
     def rotate_kek(self, new_kek: Optional[bytes]) -> None:
         cert, key = self.read()
@@ -234,11 +415,21 @@ class CAServer:
     def __init__(self, root_ca: RootCA):
         self.root_ca = root_ca
 
-    def issue_node_certificate(self, node_id: str,
-                               token: str) -> Certificate:
+    def issue_node_certificate(self, node_id: str, token: str,
+                               csr_pem: Optional[bytes] = None):
+        """Token-gated issuance.  With a CSR (network join) returns the
+        signed cert PEM; without (in-process) returns a full Certificate
+        incl. a server-generated key."""
         role = self.root_ca.role_for_token(token)
+        if csr_pem is not None:
+            return self.root_ca.sign_csr(csr_pem, node_id, role)
         return self.root_ca.issue(node_id, role)
 
-    def renew(self, cert: Certificate) -> Certificate:
+    def renew(self, cert: Certificate,
+              csr_pem: Optional[bytes] = None):
+        """Cert-gated renewal: same identity and role, fresh validity
+        (reference: ca/server.go NodeCertificateStatus + renewer)."""
         self.root_ca.verify(cert)
+        if csr_pem is not None:
+            return self.root_ca.sign_csr(csr_pem, cert.node_id, cert.role)
         return self.root_ca.issue(cert.node_id, cert.role)

@@ -47,7 +47,8 @@ class Swarmd:
                  join_token: str = "",
                  executor=None,
                  use_device_scheduler: bool = True,
-                 migrate_plaintext_wal: bool = False):
+                 migrate_plaintext_wal: bool = False,
+                 cert_renew_interval: float = 60.0):
         import os
 
         from .agent.testutils import TestExecutor
@@ -71,6 +72,10 @@ class Swarmd:
         # one-time replay of a state dir written before WAL encryption
         # existed (--migrate-plaintext-wal); steady state fails closed
         self.migrate_plaintext_wal = migrate_plaintext_wal
+        # how often the renewer thread re-checks cert lifetime (the
+        # renewal itself triggers past half of validity)
+        self.cert_renew_interval = cert_renew_interval
+        self._stop_event = threading.Event()
         self.manager = None
         self.server = None
         self.node = None
@@ -93,7 +98,8 @@ class Swarmd:
             # the persisted CA key + raft listen port: peers know us by
             # that address, and the transport HMAC key must match theirs.
             state = self._load_manager_state()
-            ca = RootCA(state["ca_key"]) if state else RootCA()
+            ca = (RootCA(state["ca_key"], state["ca_cert"])
+                  if state else RootCA())
             raft_port = state["raft_port"] if state else 0
             api_port = state["api_port"] if state else 0
             self._build_raft_manager(ca, raft_port=raft_port)
@@ -106,8 +112,15 @@ class Swarmd:
                 self._wait(lambda: self.manager.is_leader
                            and self.manager.dispatcher is not None,
                            "manager never took leadership")
-                # restart adoption may swap in the persisted cluster's key
+                # restart adoption may swap in the persisted cluster's
+                # trust root: re-key both the HMAC fallback and the TLS
+                # identity so peers on the adopted root accept us
                 self.raft_transport.auth_key = self.manager.root_ca.key
+                if self.raft_transport.tls_identity is not None:
+                    from .models.types import NodeRole as _NR
+                    self.raft_transport.set_identity(
+                        self.manager.root_ca.issue(
+                            "m-" + self.hostname, _NR.MANAGER))
             self._start_remote_api(port_override=api_port)
             if self.server is not None:
                 self.manager.api_addrs["m-" + self.hostname] = \
@@ -120,6 +133,7 @@ class Swarmd:
                         self.server.addr)
             self._save_manager_state()
             self._start_manager_agent()
+            self._start_manager_identity_renewer()
             if self.manager.is_leader:
                 log.info("manager up; worker join token: %s",
                          self.manager.root_ca.join_token(0))
@@ -162,8 +176,71 @@ class Swarmd:
         client = FailoverDispatcherClient(
             ConnectionBroker(self.remotes), cert)
         self.node.start(client, hostname=self.hostname)
+        self._start_cert_renewer(client)
         log.info("worker %s joined %s", self.node.node_id[:8],
                  self.join_addr)
+
+    def _start_cert_renewer(self, client) -> None:
+        """Client-side certificate renewal loop (reference: ca/renewer.go
+        + certificates.go RequestAndSaveNewCertificates): past half of
+        validity, send a fresh CSR to a live manager, persist the new
+        identity and swap it in for future connections."""
+        from .security.ca import needs_renewal
+
+        def loop():
+            from .net.client import renew_certificate
+            while not self._stop_event.wait(self.cert_renew_interval):
+                cert = self.node.certificate
+                if cert is None or not needs_renewal(cert):
+                    continue
+                targets = list(self.remotes.weights()) + [self.join_addr]
+                for addr in targets:
+                    try:
+                        fresh = renew_certificate(addr, cert)
+                    except Exception as e:
+                        log.info("cert renewal via %s failed: %s", addr, e)
+                        continue
+                    self.node.key_rw.write(fresh, b"")
+                    self.node.certificate = fresh
+                    # future connections present the fresh cert (the
+                    # factory closes over client.certificate)
+                    client.certificate = fresh
+                    log.info("renewed certificate for %s (expires %.0f)",
+                             fresh.node_id[:8], fresh.expires_at)
+                    break
+
+        threading.Thread(target=loop, name="cert-renewer",
+                         daemon=True).start()
+
+    def _start_manager_identity_renewer(self) -> None:
+        """Managers hold the CA, so their serving identities (raft link,
+        API server) renew by local re-issue at half of validity — without
+        this a long-lived manager's certs expire and every CERT_REQUIRED
+        peer handshake starts failing cluster-wide."""
+        from .models.types import NodeRole
+        from .security.ca import needs_renewal
+
+        def loop():
+            while not self._stop_event.wait(self.cert_renew_interval):
+                mgr = self.manager
+                if mgr is None:
+                    continue
+                ca = mgr.root_ca
+                t = self.raft_transport
+                if (t is not None and t.tls_identity is not None
+                        and needs_renewal(t.tls_identity)):
+                    t.set_identity(ca.issue(t.node_id, NodeRole.MANAGER))
+                    log.info("renewed raft TLS identity for %s",
+                             t.node_id)
+                s = self.server
+                if (s is not None and getattr(s, "tls_identity", None)
+                        is not None and needs_renewal(s.tls_identity)):
+                    s.set_tls_identity(ca.issue(
+                        s.tls_identity.node_id, NodeRole.MANAGER))
+                    log.info("renewed API TLS identity")
+
+        threading.Thread(target=loop, name="manager-identity-renewer",
+                         daemon=True).start()
 
     def _wait(self, cond, err: str, timeout: float = 20.0) -> None:
         deadline = time.time() + timeout
@@ -210,8 +287,9 @@ class Swarmd:
         state = self._load_manager_state()
         if state is not None:
             # restart: peers + addresses replay from the raft WAL
-            self._build_raft_manager(RootCA(state["ca_key"]),
-                                     raft_port=state["raft_port"])
+            self._build_raft_manager(
+                RootCA(state["ca_key"], state["ca_cert"]),
+                raft_port=state["raft_port"])
             self.node = Node(self.executor, self.state_dir,
                              node_id=raft_id)
             from .security.ca import SecurityError
@@ -250,7 +328,8 @@ class Swarmd:
             # peer wedging quorum
             boot = join_raft(self.join_addr, cert, raft_id)
             ca_key = base64.b64decode(boot["ca_key"])
-            self._build_raft_manager(RootCA(ca_key), raft_port=0,
+            ca_cert = base64.b64decode(boot["ca_cert"])
+            self._build_raft_manager(RootCA(ca_key, ca_cert), raft_port=0,
                                      defer_start=True)
             self._start_remote_api()
             resp = None
@@ -293,6 +372,7 @@ class Swarmd:
         # the current members' addresses
         extra = [tuple(a) for a in self.raft_node.core.api_addrs.values()]
         self._start_agent_with_failover(cert, self.join_addr, *extra)
+        self._start_manager_identity_renewer()
         log.info("manager %s joined raft group %s", raft_id,
                  sorted(self.raft_node.core.peers))
 
@@ -364,8 +444,12 @@ class Swarmd:
         from .state.raft import KeyEncoder, RaftLogger, RaftNode
 
         raft_id = "m-" + self.hostname
-        self.raft_transport = TCPRaftTransport(raft_id, port=raft_port,
-                                               auth_key=ca.key)
+        # raft links run mutual TLS on a manager cert self-issued from
+        # the cluster CA (reference: ca/transport.go for raft peers)
+        from .models.types import NodeRole
+        self.raft_transport = TCPRaftTransport(
+            raft_id, port=raft_port, auth_key=ca.key,
+            tls_identity=ca.issue(raft_id, NodeRole.MANAGER))
         store = MemoryStore()
         self.raft_node = RaftNode(
             raft_id, [raft_id], store,
@@ -402,11 +486,21 @@ class Swarmd:
         try:
             with open(self._manager_state_path()) as f:
                 rec = json.load(f)
+        except FileNotFoundError:
+            return None
+        try:
             return {"ca_key": bytes.fromhex(rec["ca_key"]),
+                    "ca_cert": bytes.fromhex(rec["ca_cert"]),
                     "raft_port": rec["raft_port"],
                     "api_port": rec.get("api_port", 0)}
-        except (FileNotFoundError, KeyError, ValueError):
-            return None
+        except (KeyError, ValueError, TypeError) as e:
+            # a partial/old-format state file must NOT silently bootstrap
+            # a brand-new cluster (fresh CA = every cert and token in the
+            # fleet invalidated); make the operator decide
+            raise RuntimeError(
+                f"manager state file {self._manager_state_path()!r} is "
+                f"unreadable or from an incompatible version ({e}); "
+                "remove it to bootstrap a new cluster") from e
 
     def _save_manager_state(self) -> None:
         """Persist what a restart cannot recover from the WAL: the CA
@@ -421,6 +515,7 @@ class Swarmd:
         with open(tmp, "w") as f:
             json.dump({
                 "ca_key": self.manager.root_ca.key.hex(),
+                "ca_cert": self.manager.root_ca.cert_pem.hex(),
                 "raft_port": self.raft_transport.addr[1],
                 # the API port must survive restarts too: it replicated
                 # to the whole cluster via the join conf entry, and a
@@ -430,6 +525,7 @@ class Swarmd:
         os.replace(tmp, self._manager_state_path())
 
     def stop(self) -> None:
+        self._stop_event.set()
         if self.node is not None:
             self.node.stop()
         if self.server is not None:
@@ -453,6 +549,9 @@ def main(argv=None) -> int:   # pragma: no cover - thin CLI shell
                         choices=["process", "test"],
                         help="task runtime backend: real OS processes "
                              "(default) or the in-memory test executor")
+    parser.add_argument("--migrate-plaintext-wal", action="store_true",
+                        help="one-time replay of a state dir written "
+                             "before WAL encryption existed")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO,
@@ -465,7 +564,8 @@ def main(argv=None) -> int:   # pragma: no cover - thin CLI shell
         join_addr=parse_addr(args.join_addr) if args.join_addr else None,
         join_token=args.join_token,
         executor=args.executor,
-        use_device_scheduler=not args.no_device_scheduler)
+        use_device_scheduler=not args.no_device_scheduler,
+        migrate_plaintext_wal=args.migrate_plaintext_wal)
     daemon.start()
     try:
         while True:

