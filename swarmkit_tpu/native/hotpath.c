@@ -574,9 +574,156 @@ commit_apply(PyObject *self, PyObject *args)
     Py_RETURN_NONE;
 }
 
+/* block_commit(old_tasks, node_ids, objects, overlay, by_node,
+ *              ts, state, message, start_seq, guard_state)
+ *   -> (committed, slow, new_seq)
+ *
+ * Fast path of MemoryStore.commit_task_block: items whose mirror object
+ * IS the stored object (pointer identity), with no pending overlay entry
+ * and a stored state below guard_state, commit by writing an overlay
+ * tuple (node_id, version, ts, state, message) and maintaining the
+ * by_node index.  Everything else lands in `slow` (list of indices) for
+ * the Python caller's full-semantics loop.  No Task objects are built.
+ */
+static PyObject *
+block_commit(PyObject *self, PyObject *args)
+{
+    PyObject *old_tasks, *node_ids, *objects, *overlay, *by_node;
+    PyObject *ts, *state, *message, *guard_state;
+    long long seq;
+    if (!PyArg_ParseTuple(args, "O!O!O!O!O!OOOLO", &PyList_Type,
+                          &old_tasks, &PyList_Type, &node_ids,
+                          &PyDict_Type, &objects, &PyDict_Type, &overlay,
+                          &PyDict_Type, &by_node, &ts, &state, &message,
+                          &seq, &guard_state))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(old_tasks);
+    if (PyList_GET_SIZE(node_ids) != n) {
+        PyErr_SetString(PyExc_ValueError, "old_tasks/node_ids mismatch");
+        return NULL;
+    }
+    PyObject *committed = PyList_New(0);
+    PyObject *slow = PyList_New(0);
+    if (!committed || !slow)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *old = PyList_GET_ITEM(old_tasks, i);
+        PyObject *d = PyObject_GetAttr(old, s_dict);
+        if (!d)
+            goto fail;
+        PyObject *tid = PyDict_GetItem(d, s_id);
+        int take_slow = 0;
+        if (!tid) {
+            take_slow = 1;
+        } else {
+            PyObject *cur = PyDict_GetItem(objects, tid);
+            int in_overlay = PyDict_Contains(overlay, tid);
+            if (in_overlay < 0) {
+                Py_DECREF(d);
+                goto fail;
+            }
+            if (cur != old || in_overlay) {
+                take_slow = 1;
+            } else {
+                PyObject *status = PyDict_GetItem(d, s_status);
+                PyObject *st = status ? PyObject_GetAttr(status, s_state)
+                                      : NULL;
+                if (!st) {
+                    PyErr_Clear();
+                    take_slow = 1;
+                } else {
+                    int ge = PyObject_RichCompareBool(st, guard_state,
+                                                      Py_GE);
+                    Py_DECREF(st);
+                    if (ge < 0) {
+                        Py_DECREF(d);
+                        goto fail;
+                    }
+                    take_slow = ge;   /* guard conflict: Python decides */
+                }
+            }
+        }
+        if (take_slow) {
+            PyObject *idx = PyLong_FromSsize_t(i);
+            int r = idx ? PyList_Append(slow, idx) : -1;
+            Py_XDECREF(idx);
+            Py_DECREF(d);
+            if (r < 0)
+                goto fail;
+            continue;
+        }
+        /* accept: overlay entry + by_node index + version */
+        seq++;
+        PyObject *nid = PyList_GET_ITEM(node_ids, i);
+        PyObject *ver = PyLong_FromLongLong(seq);
+        if (!ver) {
+            Py_DECREF(d);
+            goto fail;
+        }
+        PyObject *entry = PyTuple_Pack(5, nid, ver, ts, state, message);
+        Py_DECREF(ver);
+        if (!entry || PyDict_SetItem(overlay, tid, entry) < 0) {
+            Py_XDECREF(entry);
+            Py_DECREF(d);
+            goto fail;
+        }
+        Py_DECREF(entry);
+        PyObject *onid = PyDict_GetItem(d, s_node_id);
+        if (onid && PyObject_IsTrue(onid) && onid != nid) {
+            int eq = dict_vals_equal(onid, nid);
+            if (eq < 0) {
+                Py_DECREF(d);
+                goto fail;
+            }
+            if (!eq) {
+                PyObject *os = PyDict_GetItem(by_node, onid);
+                if (os && PySet_Discard(os, tid) < 0) {
+                    Py_DECREF(d);
+                    goto fail;
+                }
+            }
+        }
+        if (PyObject_IsTrue(nid)) {
+            PyObject *ns = PyDict_GetItem(by_node, nid);
+            if (!ns) {
+                PyObject *fresh = PySet_New(NULL);
+                if (!fresh || PyDict_SetItem(by_node, nid, fresh) < 0) {
+                    Py_XDECREF(fresh);
+                    Py_DECREF(d);
+                    goto fail;
+                }
+                Py_DECREF(fresh);
+                ns = PyDict_GetItem(by_node, nid);
+            }
+            if (PySet_Add(ns, tid) < 0) {
+                Py_DECREF(d);
+                goto fail;
+            }
+        }
+        PyObject *idx = PyLong_FromSsize_t(i);
+        int r = idx ? PyList_Append(committed, idx) : -1;
+        Py_XDECREF(idx);
+        Py_DECREF(d);
+        if (r < 0)
+            goto fail;
+    }
+    {
+        PyObject *out = Py_BuildValue("(OOL)", committed, slow, seq);
+        Py_DECREF(committed);
+        Py_DECREF(slow);
+        return out;
+    }
+fail:
+    Py_XDECREF(committed);
+    Py_XDECREF(slow);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"plan_apply", plan_apply, METH_VARARGS,
      "Clone and register planner decisions."},
+    {"block_commit", block_commit, METH_VARARGS,
+     "Columnar task-block commit fast path (overlay + by_node index)."},
     {"commit_prepare", commit_prepare, METH_VARARGS,
      "Validate, version-check, and stamp one commit chunk."},
     {"commit_apply", commit_apply, METH_VARARGS,

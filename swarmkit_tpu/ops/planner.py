@@ -246,18 +246,31 @@ class TPUPlanner:
             self._cache = cols
         return cols
 
+    _launch_overhead_shared: Optional[float] = None  # per-process link cost
+
     def _measure_launch_overhead(self) -> None:
         """Time a minimal warm launch: dispatch + compute-epsilon + D2H
         round-trip.  ~100ms over a tunneled TPU, ~1ms locally; this is the
-        fixed cost a group must amortize to be worth the device."""
+        fixed cost a group must amortize to be worth the device.  The
+        result is a property of the process's device link, so it is
+        measured once and shared across planner instances — re-measuring
+        per instance would spend two round-trips inside every tick that
+        builds a fresh planner."""
         import time as _time
         import jax as _jax
+        cls = type(self)
+        if cls._launch_overhead_shared is not None:
+            self._launch_overhead = cls._launch_overhead_shared
+            return
         nodes_in, group_in = _probe_inputs()
         try:
             _jax.device_get(self._plan_fn(nodes_in, group_in, 1, ()))
             t0 = _time.perf_counter()
             _jax.device_get(self._plan_fn(nodes_in, group_in, 1, ()))
             self._launch_overhead = _time.perf_counter() - t0
+            # only successful measurements are shared: caching a failed
+            # probe (0.0) would poison every future planner's break-even
+            cls._launch_overhead_shared = self._launch_overhead
         except Exception:
             log.exception("launch-overhead probe failed")
             self._launch_overhead = 0.0
@@ -526,18 +539,30 @@ class TPUPlanner:
         ``counts``: i32[nb] tasks placed per node column."""
         from ..scheduler.scheduler import SchedulingDecision
 
-        shared_status = TaskStatus(
-            state=TaskState.ASSIGNED, timestamp=now(), message=message)
         from .. import native
         hp = native.get()
         all_tasks = sched.all_tasks
-        if hp is not None:
+        if getattr(sched, "block_mode", False):
+            # columnar end-to-end: no per-task object materialization —
+            # the draft commits as one array-shaped store call
+            # (store.commit_task_block); mirrors keep the pre-assignment
+            # object (membership + reservations are what they serve)
+            node_id_by_i = [info.node.id for info in infos]
+            draft = sched.block_draft
+            for (task_id, task), i in zip(items, slots):
+                draft.append((task, node_id_by_i[i], message))
+                infos[i].tasks[task_id] = task
+        elif hp is not None:
+            shared_status = TaskStatus(
+                state=TaskState.ASSIGNED, timestamp=now(), message=message)
             node_id_by_i = [info.node.id for info in infos]
             task_dict_by_i = [info.tasks for info in infos]
             hp.plan_apply(items, slots, node_id_by_i, task_dict_by_i,
                           shared_status, all_tasks, decisions,
                           SchedulingDecision)
         else:
+            shared_status = TaskStatus(
+                state=TaskState.ASSIGNED, timestamp=now(), message=message)
             for (task_id, task), i in zip(items, slots):
                 info = infos[i]
                 new_t = _fast_assign(task, info.id, shared_status)

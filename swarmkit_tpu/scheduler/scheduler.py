@@ -65,6 +65,12 @@ class Scheduler:
         self.pipeline = Pipeline()
         self.volumes = VolumeSet()
         self.batch_planner = batch_planner
+        # columnar commit draft: (mirror task, node_id, status message)
+        # triples accumulated by the device planner when the store allows
+        # block commits (store.commit_task_block); committed in one
+        # array-shaped call per tick instead of per-task objects
+        self.block_draft: List[Tuple[Task, str, str]] = []
+        self.block_mode = False
 
         self._stop = threading.Event()
         self._done = threading.Event()
@@ -315,6 +321,7 @@ class Scheduler:
         decisions: Dict[str, SchedulingDecision] = {}
         pending = list(self.pending_preassigned_tasks.values())
         planner = self.batch_planner
+        self.block_mode = self.store.supports_block_commit
         if planner is not None and hasattr(planner, "validate_preassigned"):
             # large same-spec batches (global services during a storm)
             # validate in one fused device call; whatever the device path
@@ -332,6 +339,14 @@ class Scheduler:
             for group in by_spec.values():
                 pending.extend(
                     planner.validate_preassigned(self, group, decisions))
+        committed_ids, block_failed = self._commit_block_draft()
+        for tid in committed_ids:
+            self.pending_preassigned_tasks.pop(tid, None)
+        for old, nid in block_failed:
+            self.all_tasks[old.id] = old
+            info = self.node_set.node_info(nid)
+            if info is not None:
+                info.remove_task(old)
         for t in pending:
             new_t = self._task_fit_node(t, t.node_id)
             if new_t is None:
@@ -358,6 +373,7 @@ class Scheduler:
     def _tick_inner(self) -> int:
         t0 = now()
         self.stats["ticks"] += 1
+        self.block_mode = self.store.supports_block_commit
         decisions: Dict[str, SchedulingDecision] = {}
 
         # groups are maintained incrementally by _enqueue/_dequeue; take
@@ -387,7 +403,19 @@ class Scheduler:
             if planner is not None and hasattr(planner, "end_tick"):
                 planner.end_tick()
 
-        n_decisions = len(decisions)
+        n_decisions = len(decisions) + len(self.block_draft)
+        t_commit = now()
+        committed_ids, block_failed = self._commit_block_draft()
+        for old, nid in block_failed:
+            # mirror rollback (remove_task never reads node_id, so the
+            # pre-assignment object works) + requeue for the next tick
+            self.all_tasks[old.id] = old
+            info = self.node_set.node_info(nid)
+            if info is not None:
+                info.remove_task(old)
+            self._enqueue(old)
+        if committed_ids or block_failed:
+            self.stats["commit_seconds"] += now() - t_commit
         _, failed = self._apply_scheduling_decisions(decisions)
         for d in failed:
             self.all_tasks[d.old.id] = d.old
@@ -401,6 +429,59 @@ class Scheduler:
         self.stats["decisions"] += n_decisions
         self.stats["tick_seconds"].append(now() - t0)
         return n_decisions
+
+    def _commit_block_draft(self) -> Tuple[List[str],
+                                           List[Tuple[Task, str]]]:
+        """Commit the columnar assignment draft through
+        store.commit_task_block — arrays end-to-end, no per-task objects
+        (they materialize lazily on read).  Returns (committed task ids,
+        failed (mirror task, node_id) pairs for rollback)."""
+        draft = self.block_draft
+        if not draft:
+            return [], []
+        self.block_draft = []
+        node_info = self.node_set.node_info
+        raw_get = self.store.raw_get
+
+        def on_missing(old: Task, nid: str) -> None:
+            # the draft already planted the task on the assigned node's
+            # mirror (membership + reservations) — clean THAT node, not
+            # old.node_id (which is empty pre-assignment)
+            info = node_info(nid)
+            if info is not None:
+                info.remove_task(old)
+            self._delete_task(self.all_tasks.get(old.id, old))
+
+        def on_assigned(old: Task, nid: str) -> bool:
+            # stored task already >= ASSIGNED: commit only if our view of
+            # the node is current (node-version conflict check)
+            info = node_info(nid)
+            if info is None:
+                return False
+            node = raw_get(Node, nid)
+            return (node is not None and node.meta.version.index
+                    == info.node.meta.version.index)
+
+        by_msg: Dict[str, Tuple[List[Task], List[str]]] = {}
+        for old, nid, msg in draft:
+            olds, nids = by_msg.setdefault(msg, ([], []))
+            olds.append(old)
+            nids.append(nid)
+        committed_ids: List[str] = []
+        failed: List[Tuple[Task, str]] = []
+        for msg, (olds, nids) in by_msg.items():
+            try:
+                c, f = self.store.commit_task_block(
+                    olds, nids, int(TaskState.ASSIGNED), msg,
+                    on_missing, on_assigned,
+                    guard_state=int(TaskState.ASSIGNED))
+            except Exception:
+                log.exception("scheduler block commit failed")
+                failed.extend(zip(olds, nids))
+                continue
+            committed_ids.extend(olds[i].id for i in c)
+            failed.extend((olds[i], nids[i]) for i in f)
+        return committed_ids, failed
 
     def _apply_scheduling_decisions(
             self, decisions: Dict[str, SchedulingDecision]

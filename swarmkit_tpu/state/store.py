@@ -297,6 +297,12 @@ class _Table:
         self.by_service: Dict[str, set] = {}          # tasks/volumes refcounts
         self.by_node: Dict[str, set] = {}
         self.by_slot: Dict[Tuple[str, int], set] = {}
+        # columnar task-block overlay: id -> (node_id, version, ts, state,
+        # message).  A block commit records assignments here instead of
+        # materializing per-task objects; reads materialize lazily (see
+        # MemoryStore._materialize_locked).  Indexes are maintained
+        # eagerly, so only `objects` values can be stale.
+        self.overlay: Dict[str, tuple] = {}
 
     def snapshot(self) -> Dict[str, Any]:
         return dict(self.objects)
@@ -456,8 +462,38 @@ class MemoryStore:
         """Lock-free point read: a single GIL-atomic dict lookup of an
         immutable stored object.  The supported fast-read API for hot-path
         friends (scheduler commit checks); everything else should use
-        ``view``."""
-        return self._tables[kind.collection].objects.get(id)
+        ``view``.  Block-committed tasks materialize on first access."""
+        table = self._tables[kind.collection]
+        if table.overlay and id in table.overlay:
+            with self._lock:
+                return self._materialize_locked(table, id)
+        return table.objects.get(id)
+
+    # ------------------------------------------- task-block lazy materialization
+
+    def _materialize_locked(self, table: _Table, tid: str) -> Optional[Any]:
+        """Turn an overlay entry into a real stored Task (caller holds
+        ``_lock``).  Idempotent: a concurrent reader may have materialized
+        the id between the overlay check and lock acquisition."""
+        entry = table.overlay.pop(tid, None)
+        old = table.objects.get(tid)
+        if entry is None or old is None:
+            return old
+        node_id, version, ts, state, message = entry
+        from ..models.types import TaskState, TaskStatus
+        new = old.copy()
+        new.node_id = node_id
+        new.status = TaskStatus(state=TaskState(state), timestamp=ts,
+                                message=message)
+        new.meta.version.index = version
+        new.meta.updated_at = ts
+        table.objects[tid] = new
+        return new
+
+    def _materialize_all_locked(self, table: _Table) -> None:
+        if table.overlay:
+            for tid in list(table.overlay):
+                self._materialize_locked(table, tid)
 
     def view(self, cb: Optional[Callable[[ReadTx], Any]] = None) -> Any:
         tx = ReadTx(self)
@@ -546,6 +582,9 @@ class MemoryStore:
     def _apply_locked(self, change: StoreAction) -> None:
         obj = change.obj
         table = self._tables[obj.collection]
+        if table.overlay and obj.id in table.overlay:
+            # the unindex below must see the materialized (assigned) form
+            self._materialize_locked(table, obj.id)
         old = table.objects.get(obj.id)
         # name index maintenance
         if old is not None:
@@ -638,11 +677,6 @@ class MemoryStore:
     def _find_locked(self, kind: Type, by: By) -> List[Any]:
         table = self._tables[kind.collection]
         # fast paths via indexes
-        if isinstance(by, All):
-            return list(table.objects.values())
-        if isinstance(by, ByName) and kind.collection != "tasks":
-            oid = table.by_name.get(by.name.lower())
-            return [table.objects[oid]] if oid in table.objects else []
         if kind is Task:
             ids: Optional[set] = None
             if isinstance(by, ByService):
@@ -652,7 +686,21 @@ class MemoryStore:
             elif isinstance(by, BySlot):
                 ids = table.by_slot.get((by.service_id, by.slot), set())
             if ids is not None:
-                return [table.objects[i] for i in ids if i in table.objects]
+                if table.overlay:
+                    # index-driven query: materialize only touched ids
+                    return [self._materialize_locked(table, i)
+                            if i in table.overlay else table.objects[i]
+                            for i in ids if i in table.objects]
+                return [table.objects[i] for i in ids
+                        if i in table.objects]
+            if table.overlay:
+                # scan query: the predicate may read node_id/status
+                self._materialize_all_locked(table)
+        if isinstance(by, All):
+            return list(table.objects.values())
+        if isinstance(by, ByName) and kind.collection != "tasks":
+            oid = table.by_name.get(by.name.lower())
+            return [table.objects[oid]] if oid in table.objects else []
         pred = self._predicate_for(kind, by)
         return [o for o in table.objects.values() if pred(o)]
 
@@ -700,6 +748,13 @@ class MemoryStore:
         with self._update_lock:
             table = self._tables["tasks"]
             objects = table.objects
+            if table.overlay:
+                # the C prepare loop reads `objects` directly: flush the
+                # lazily-committed ids it may touch
+                with self._lock:
+                    for t in new_tasks:
+                        if t.id in table.overlay:
+                            self._materialize_locked(table, t.id)
             want_actions = self._proposer is not None
             want_events = self.queue.has_subscribers()
             i = 0
@@ -757,6 +812,124 @@ class MemoryStore:
                     for ev in events:
                         publish(ev)
                 self.queue.publish(EventCommit(self._version))
+        return committed_idx, failed_idx
+
+    @property
+    def supports_block_commit(self) -> bool:
+        """True when scheduler assignments may commit as a columnar block
+        (arrays end-to-end, objects materialized lazily on read).  With a
+        proposer or live watchers the per-object path runs instead: raft
+        replication and event payloads need the materialized objects (the
+        block StoreAction / block event extensions lift this in the
+        dispatcher integration)."""
+        return self._proposer is None and not self.queue.has_subscribers()
+
+    def commit_task_block(self, old_tasks: Sequence[Task],
+                          node_ids: Sequence[str],
+                          state: int, message: str,
+                          on_missing, on_assigned,
+                          guard_state: int = 192,  # TaskState.ASSIGNED
+                          ) -> Tuple[List[int], List[int]]:
+        """Columnar scheduler commit: assignments stay arrays end-to-end.
+
+        Same per-item semantics as ``bulk_update_tasks`` (scheduler.go:490
+        applySchedulingDecisions), but instead of installing pre-built Task
+        objects it records (node_id, version, status) in the task table's
+        overlay; per-task objects materialize lazily on first read.
+        ``old_tasks[i]`` must be the scheduler's mirror of the stored task
+        — when it is the stored instance itself (the common case; mirrors
+        hold store references), validation is one identity check.
+
+        by_node indexes update eagerly, so index-driven queries stay
+        correct without materializing.  Only valid when
+        ``supports_block_commit`` (no proposer, no watchers).
+
+        Returns (committed_indices, failed_indices); skipped items appear
+        in neither.
+        """
+        if not self.supports_block_commit:
+            # a subscriber/proposer appeared after the caller's check: a
+            # block commit would rob it of per-task events/actions
+            raise InvalidStoreAction(
+                "block commit requires the no-proposer/no-watcher store "
+                "shape; use bulk_update_tasks")
+        from .. import native
+        ts = now()
+        committed_idx: List[int] = []
+        failed_idx: List[int] = []
+        missing: List[Tuple[Task, str]] = []
+        if not isinstance(old_tasks, list):
+            old_tasks = list(old_tasks)
+        if not isinstance(node_ids, list):
+            node_ids = list(node_ids)
+        with self._update_lock:
+            table = self._tables["tasks"]
+            objects = table.objects
+            overlay = table.overlay
+            by_node = table.by_node
+            hp = native.get()
+            with self._lock:
+                seq = self._version
+                try:
+                    slow: Sequence[int] = range(len(old_tasks))
+                    if hp is not None:
+                        fast, slow, seq = hp.block_commit(
+                            old_tasks, node_ids, objects, overlay,
+                            by_node, ts, int(state), message, seq,
+                            int(guard_state))
+                        committed_idx.extend(fast)
+                    for i in slow:
+                        old = old_tasks[i]
+                        tid = old.id
+                        cur = objects.get(tid)
+                        if cur is not old or tid in overlay:
+                            # mirror is not the stored instance: run the
+                            # full bulk-path checks against the stored one
+                            if cur is not None and tid in overlay:
+                                cur = self._materialize_locked(table, tid)
+                            if cur is None:
+                                # callbacks run after the loop: an
+                                # exception here must not strand
+                                # committed versions (see finally)
+                                missing.append((old, node_ids[i]))
+                                continue
+                            cs = cur.status
+                            if cs.state == state \
+                                    and cs.message == message:
+                                continue
+                            if cs.state >= guard_state and \
+                                    not on_assigned(old, node_ids[i]):
+                                failed_idx.append(i)
+                                continue
+                            if cur.meta.version.index != \
+                                    old.meta.version.index:
+                                failed_idx.append(i)
+                                continue
+                        elif cur.status.state >= guard_state and \
+                                not on_assigned(old, node_ids[i]):
+                            failed_idx.append(i)
+                            continue
+                        seq += 1
+                        nid = node_ids[i]
+                        overlay[tid] = (nid, seq, ts, state, message)
+                        old_nid = old.node_id
+                        if old_nid and old_nid != nid:
+                            by_node.get(old_nid, set()).discard(tid)
+                        if nid:
+                            s = by_node.get(nid)
+                            if s is None:
+                                s = by_node[nid] = set()
+                            s.add(tid)
+                        committed_idx.append(i)
+                finally:
+                    # already-written overlay entries carry versions up to
+                    # seq — the counter must advance past them even if a
+                    # callback raised, or the next commit would reissue
+                    # duplicate version indices
+                    self._version = seq
+            self.queue.publish(EventCommit(self._version))
+        for old, nid in missing:
+            on_missing(old, nid)
         return committed_idx, failed_idx
 
     def _reindex_pair(self, old: Task, new: Task) -> None:
@@ -857,6 +1030,7 @@ class MemoryStore:
     def save(self) -> Dict[str, Any]:
         """Full-store snapshot (reference: snapshot.proto StoreSnapshot)."""
         with self._lock:
+            self._materialize_all_locked(self._tables["tasks"])
             return {
                 "version": self._version,
                 "tables": {

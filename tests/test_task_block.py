@@ -1,0 +1,239 @@
+"""Columnar task-block store: array-shaped scheduler commits with lazy
+per-task materialization (reference: memory.go:531 Batch semantics +
+scheduler.go:490 applySchedulingDecisions, re-shaped for the TPU path)."""
+
+import pytest
+
+from swarmkit_tpu.models import (
+    Annotations, Node, NodeSpec, Service, ServiceSpec, Task, TaskState,
+    TaskStatus,
+)
+from swarmkit_tpu.state import MemoryStore
+from swarmkit_tpu.state.store import ByNode, ByService, SequenceConflict
+from swarmkit_tpu.utils import new_id
+
+from test_scheduler import make_ready_node, make_service_with_tasks
+
+
+def _mk_store_with_tasks(n_tasks=10, n_nodes=3):
+    store = MemoryStore()
+    svc, tasks = make_service_with_tasks(n_tasks)
+    nodes = [make_ready_node(f"n{i}") for i in range(n_nodes)]
+
+    def cb(tx):
+        tx.create(svc)
+        for n in nodes:
+            tx.create(n)
+        for t in tasks:
+            tx.create(t)
+    store.update(cb)
+    stored = store.view(lambda tx: tx.find(Task, ByService(svc.id)))
+    return store, svc, nodes, sorted(stored, key=lambda t: t.slot)
+
+
+def _noop_missing(t, nid):
+    raise AssertionError("on_missing should not fire")
+
+
+def _no_conflict(t, nid):
+    raise AssertionError("on_assigned should not fire")
+
+
+def test_block_commit_lazy_materialization():
+    store, svc, nodes, tasks = _mk_store_with_tasks(6)
+    node_ids = [nodes[i % 3].id for i in range(6)]
+    v0 = store.version
+    committed, failed = store.commit_task_block(
+        tasks, node_ids, int(TaskState.ASSIGNED), "assigned",
+        _noop_missing, _no_conflict)
+    assert committed == list(range(6)) and failed == []
+    table = store._tables["tasks"]
+    assert len(table.overlay) == 6          # nothing materialized yet
+    assert store.version == v0 + 6
+
+    # point read materializes exactly that id, with stamped version
+    t0 = store.raw_get(Task, tasks[0].id)
+    assert t0.node_id == node_ids[0]
+    assert t0.status.state == TaskState.ASSIGNED
+    assert t0.status.message == "assigned"
+    assert t0.meta.version.index == v0 + 1
+    assert len(table.overlay) == 5
+
+    # index-driven find materializes only the touched ids
+    on_n1 = store.view(lambda tx: tx.find(Task, ByNode(nodes[1].id)))
+    assert {t.id for t in on_n1} == {tasks[1].id, tasks[4].id}
+    assert all(t.node_id == nodes[1].id for t in on_n1)
+    assert len(table.overlay) == 3
+
+    # scan queries flush the remainder
+    all_tasks = store.view(lambda tx: tx.find(Task))
+    assert all(t.node_id for t in all_tasks if t.service_id == svc.id)
+    assert len(table.overlay) == 0
+
+
+def test_block_commit_conflict_semantics():
+    store, svc, nodes, tasks = _mk_store_with_tasks(4)
+    nid = nodes[0].id
+
+    # stale mirror version -> failed
+    stale = tasks[0].copy()
+    stale.meta.version.index -= 1
+    committed, failed = store.commit_task_block(
+        [stale], [nid], int(TaskState.ASSIGNED), "assigned",
+        _noop_missing, lambda t, n: False)
+    assert committed == [] and failed == [0]
+
+    # missing task -> on_missing, appears in neither list
+    ghost = tasks[1].copy()
+    ghost.id = new_id()
+    seen = []
+    committed, failed = store.commit_task_block(
+        [ghost], [nid], int(TaskState.ASSIGNED), "assigned",
+        lambda t, n: seen.append(t), lambda t, n: False)
+    assert committed == [] and failed == [] and seen == [ghost]
+
+    # guard: stored state >= ASSIGNED consults on_assigned
+    committed, _ = store.commit_task_block(
+        [tasks[2]], [nid], int(TaskState.ASSIGNED), "assigned",
+        _noop_missing, _no_conflict)
+    assert committed == [0]
+    # recommit of the same (still-unmaterialized) task: slow path runs,
+    # same state+message -> skipped, no duplicate version burn
+    v = store.version
+    committed, failed = store.commit_task_block(
+        [tasks[2]], [nid], int(TaskState.ASSIGNED), "assigned",
+        _noop_missing, lambda t, n: True)
+    assert committed == [] and failed == []
+    assert store.version == v
+
+
+def test_block_commit_interops_with_tx_update_and_snapshot():
+    store, svc, nodes, tasks = _mk_store_with_tasks(3)
+    node_ids = [nodes[0].id] * 3
+    store.commit_task_block(
+        tasks, node_ids, int(TaskState.ASSIGNED), "assigned",
+        _noop_missing, _no_conflict)
+
+    # a transactional update sees the materialized form and its version
+    def bump(tx):
+        t = tx.get(Task, tasks[0].id)
+        assert t.node_id == nodes[0].id
+        cur = t.copy()
+        cur.status = TaskStatus(state=TaskState.RUNNING)
+        tx.update(cur)
+    store.update(bump)
+    got = store.raw_get(Task, tasks[0].id)
+    assert got.status.state == TaskState.RUNNING
+
+    # stale-version updates still conflict
+    def stale(tx):
+        t = tx.get(Task, tasks[1].id).copy()
+        t.meta.version.index -= 1
+        tx.update(t)
+    with pytest.raises(SequenceConflict):
+        store.update(stale)
+
+    # snapshots contain materialized tasks (save flushes the overlay)
+    snap = store.save()
+    by_id = {t.id: t for t in snap["tables"]["tasks"]}
+    assert all(by_id[t.id].node_id == nodes[0].id for t in tasks)
+
+    s2 = MemoryStore()
+    s2.restore(snap)
+    assert s2.raw_get(Task, tasks[2].id).node_id == nodes[0].id
+
+
+def test_block_commit_gated_by_consumers():
+    store, svc, nodes, tasks = _mk_store_with_tasks(2)
+    assert store.supports_block_commit
+    sub = store.watch_queue().subscribe()
+    assert not store.supports_block_commit
+    store.watch_queue().unsubscribe(sub)
+
+    class P:
+        def propose(self, actions, cb):
+            cb()
+    store._proposer = P()
+    assert not store.supports_block_commit
+
+
+def test_block_commit_native_matches_python(monkeypatch):
+    """Differential: the C block_commit fast path and the pure-Python
+    loop produce identical overlays, indexes, and results."""
+    import swarmkit_tpu.native as native
+
+    def run(force_python):
+        store, svc, nodes, tasks = _mk_store_with_tasks(8)
+        if force_python:
+            monkeypatch.setattr(native, "get", lambda: None)
+        else:
+            monkeypatch.undo()
+        # mix: 5 clean, 1 stale-version, 1 missing, 1 already-assigned
+        olds = list(tasks[:5])
+        nids = [nodes[i % 3].id for i in range(5)]
+        stale = tasks[5].copy()
+        stale.meta.version.index -= 1
+        olds.append(stale)
+        nids.append(nodes[0].id)
+        ghost = tasks[6].copy()
+        ghost.id = new_id()
+        olds.append(ghost)
+        nids.append(nodes[0].id)
+        store.commit_task_block(
+            [tasks[7]], [nodes[2].id], int(TaskState.ASSIGNED),
+            "assigned", _noop_missing, _no_conflict)
+        olds.append(tasks[7])
+        nids.append(nodes[1].id)   # conflicting re-assignment
+        missing = []
+        committed, failed = store.commit_task_block(
+            olds, nids, int(TaskState.ASSIGNED), "assigned",
+            lambda t, n: missing.append(t), lambda t, n: False)
+        table = store._tables["tasks"]
+        names = {nd.id: nd.description.hostname for nd in nodes}
+        tasks_by_id = {t.id: t for t in tasks}
+        overlay_shape = sorted(
+            (tasks_by_id[tid].slot, names[e[0]], int(e[3]))
+            for tid, e in table.overlay.items())
+        return (sorted(committed), sorted(failed), len(missing),
+                overlay_shape)
+
+    a = run(force_python=False)
+    b = run(force_python=True)
+    assert a == b
+    assert a[0] == [0, 1, 2, 3, 4]
+    # 5 = stale version -> failed; 7 = same status already committed ->
+    # skipped (status-equality short-circuit precedes the guard, matching
+    # bulk_update_tasks); 6 = missing -> on_missing only
+    assert a[1] == [5] and a[2] == 1
+
+
+def test_scheduler_block_path_matches_eager_path():
+    """Same cluster, same tick through the device planner: block-mode
+    assignments equal the eager per-object path's."""
+    from swarmkit_tpu.ops import TPUPlanner
+    from swarmkit_tpu.scheduler import Scheduler
+
+    def run(block: bool):
+        store, svc, nodes, tasks = _mk_store_with_tasks(30, 5)
+        sub = None
+        if not block:
+            # a subscriber forces the eager path
+            sub = store.watch_queue().subscribe()
+        planner = TPUPlanner()
+        planner.enable_small_group_routing = False
+        sched = Scheduler(store, batch_planner=planner)
+        store.view(sched._setup_tasks_list)
+        n = sched.tick()
+        assert n == 30
+        if block:
+            assert planner.stats["tasks_planned"] == 30
+            assert sched.block_mode
+        placed = store.view(lambda tx: tx.find(Task, ByService(svc.id)))
+        if sub is not None:
+            store.watch_queue().unsubscribe(sub)
+        names = {nd.id: nd.description.hostname for nd in nodes}
+        assert all(t.status.state == TaskState.ASSIGNED for t in placed)
+        # node ids are random per cluster: compare hostname placements
+        return sorted(names[t.node_id] for t in placed)
+
+    assert run(block=True) == run(block=False)
