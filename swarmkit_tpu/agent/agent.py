@@ -38,7 +38,21 @@ class Agent:
         if task_db_path:
             from .storage import TaskDB
             db = TaskDB(task_db_path)
-        self.worker = Worker(executor, self._report, db=db)
+        # node-side CSI: volumes arrive as assignment dependencies; they
+        # stage/publish under a local dir and unpublish reports flow back
+        # through the dispatcher (reference: agent/csi/volumes.go)
+        import os as _os
+        import tempfile as _tempfile
+        vol_dir = (_os.path.join(_os.path.dirname(task_db_path), "csi")
+                   if task_db_path else
+                   _tempfile.mkdtemp(prefix="swarm-csi-"))
+        from .csivol import NodeVolumesManager
+        self.volumes = NodeVolumesManager(
+            vol_dir, on_unpublished=self._report_volume_unpublished)
+        self._unpublished_mu = threading.Lock()
+        self._unpublished: list = []
+        self.worker = Worker(executor, self._report, db=db,
+                             volumes=self.volumes)
         self.session_id: Optional[str] = None
         self._stop = threading.Event()
         self._done = threading.Event()
@@ -181,6 +195,8 @@ class Agent:
         stream = self.client.open_assignments(self.node_id, session_id)
         try:
             while not self._stop.is_set() and not failed.is_set():
+                self._flush_volume_reports(session_id)
+                self.volumes.retry_pending()
                 try:
                     msg = stream.get(timeout=0.2)
                 except TimeoutError:
@@ -191,6 +207,7 @@ class Agent:
                     self.worker.assign(msg.changes)
                 else:
                     self.worker.update(msg.changes)
+                self._flush_volume_reports(session_id)
             if failed.is_set():
                 raise ConnectionError("heartbeat failed")
         finally:
@@ -199,6 +216,26 @@ class Agent:
             hb.join(timeout=2)
 
     # -------------------------------------------------------------- reporter
+
+    def _report_volume_unpublished(self, volume_id: str) -> None:
+        with self._unpublished_mu:
+            self._unpublished.append(volume_id)
+
+    def _flush_volume_reports(self, session_id: str) -> None:
+        with self._unpublished_mu:
+            pending, self._unpublished = self._unpublished, []
+        if not pending:
+            return
+        update = getattr(self.client, "update_volume_status", None)
+        if update is None:
+            return
+        try:
+            update(self.node_id, session_id,
+                   [(vid, True) for vid in pending])
+        except Exception:
+            # report again on the next heartbeat; unpublish is idempotent
+            with self._unpublished_mu:
+                self._unpublished = pending + self._unpublished
 
     def _report(self, task_id: str, status: TaskStatus) -> None:
         if self.worker.db is not None:

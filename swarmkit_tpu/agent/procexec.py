@@ -34,7 +34,8 @@ from typing import Dict, Optional
 
 from ..models.objects import Task
 from ..models.types import NodeDescription, Platform, Resources
-from .exec import Controller, Executor, TaskError, TemporaryError
+from .exec import (Controller, ErrTaskRetry, Executor, TaskError,
+                   TemporaryError)
 
 log = logging.getLogger("procexec")
 
@@ -47,10 +48,11 @@ class ProcessController(Controller):
     """Supervises one task's process (reference: dockerapi/controller.go)."""
 
     def __init__(self, task: Task, log_dir: str,
-                 stop_grace: float = STOP_GRACE_PERIOD):
+                 stop_grace: float = STOP_GRACE_PERIOD, volumes=None):
         self.task = task
         self.log_dir = log_dir
         self.stop_grace = stop_grace
+        self.volumes = volumes   # node-side CSI manager (paths by id)
         self.proc: Optional[subprocess.Popen] = None
         self.log_path = os.path.join(log_dir, f"{task.id}.log")
         self._argv: Optional[list] = None
@@ -78,6 +80,25 @@ class ProcessController(Controller):
         for kv in spec.env:
             key, _, value = kv.partition("=")
             env[key] = value
+        # published CSI volume paths surface as SWARM_VOLUME_<TARGET>
+        # env vars (process tasks have no mount namespace to bind into);
+        # a task with an unpublished volume must not start yet
+        if self.volumes is not None:
+            used_keys = set()
+            for va in self.task.volumes:
+                path = self.volumes.get(va.id)
+                if path is None:
+                    raise ErrTaskRetry(
+                        f"volume {va.id[:8]} not yet published on node")
+                mangled = "".join(ch if ch.isalnum() else "_"
+                                  for ch in va.target.strip("/")).upper()
+                key = "SWARM_VOLUME_" + (mangled or "ROOT")
+                if key in used_keys:
+                    # distinct targets can mangle identically
+                    # (/data-1 vs /data.1): disambiguate by volume id
+                    key = f"{key}_{va.id[:6].upper()}"
+                used_keys.add(key)
+                env[key] = path
         self._argv = argv
         self._env = env
         self._cwd = spec.dir or None
@@ -189,6 +210,9 @@ class ProcessExecutor(Executor):
                  stop_grace: float = STOP_GRACE_PERIOD):
         import socket
         import tempfile
+        # node-side CSI manager, injected by the Worker so controllers
+        # can hand tasks their published volume paths
+        self.volumes = None
         self.hostname = hostname or socket.gethostname()
         self.log_dir = log_dir or os.path.join(
             tempfile.gettempdir(), "swarmkit-tpu-tasks")
@@ -216,7 +240,8 @@ class ProcessExecutor(Executor):
 
     def controller(self, t: Task) -> ProcessController:
         ctlr = ProcessController(t, self.log_dir,
-                                 stop_grace=self.stop_grace)
+                                 stop_grace=self.stop_grace,
+                                 volumes=self.volumes)
         with self._mu:
             self.controllers[t.id] = ctlr
             self._sweep_locked()

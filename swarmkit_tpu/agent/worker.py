@@ -34,10 +34,11 @@ class TaskManager:
     RETRY_BACKOFF = 0.1
 
     def __init__(self, task: Task, ctlr: exec_mod.Controller,
-                 reporter: Reporter):
+                 reporter: Reporter, on_exit=None):
         self.task = task.copy()
         self.ctlr = ctlr
         self.reporter = reporter
+        self.on_exit = on_exit   # fires after ctlr.close() completes
         self._update_cond = threading.Condition()
         self._pending_update: Optional[Task] = None
         self._closed = threading.Event()
@@ -107,20 +108,37 @@ class TaskManager:
             self.ctlr.close()
         except Exception:
             pass
+        if self.on_exit is not None:
+            try:
+                self.on_exit(self.task.id)
+            except Exception:
+                log.exception("task-manager exit hook failed")
 
 
 class Worker:
     """reference: agent/worker.go:30."""
 
     def __init__(self, executor: exec_mod.Executor, reporter: Reporter,
-                 db=None):
+                 db=None, volumes=None):
         self.executor = executor
         self.reporter = reporter
         self.db = db   # agent/storage.py TaskDB (optional persistence)
+        # node-side CSI manager (agent/csivol.py); volumes ship as
+        # assignment dependencies like secrets/configs
+        self.volumes = volumes
+        if volumes is not None:
+            # executors read published volume paths from here (the
+            # reference hands controllers a restricted volume getter)
+            executor.volumes = volumes
         self._mu = threading.Lock()
         self.task_managers: Dict[str, TaskManager] = {}
         self.secrets: Dict[str, Secret] = {}
         self.configs: Dict[str, Config] = {}
+        # volume removals wait until no live/closing task references the
+        # volume: unstaging under a running process would rip its data
+        # directory away mid-write
+        self._pending_volume_removals: set = set()
+        self._closing_tasks: Dict[str, Task] = {}
         self._closed = False
 
     def init_from_db(self) -> None:
@@ -142,6 +160,7 @@ class Worker:
                 return
             self._reconcile_deps(changes, full=True)
             self._reconcile_tasks(changes, full=True)
+            self._process_volume_removals_locked()
 
     def update(self, changes: List[tuple]) -> None:
         """Apply an INCREMENTAL assignment set
@@ -151,9 +170,33 @@ class Worker:
                 return
             self._reconcile_deps(changes, full=False)
             self._reconcile_tasks(changes, full=False)
+            self._process_volume_removals_locked()
+
+    def _process_volume_removals_locked(self) -> None:
+        if self.volumes is None or not self._pending_volume_removals:
+            return
+        referenced = set()
+        for holder in (self.task_managers, self._closing_tasks):
+            for mgr_or_task in holder.values():
+                t = getattr(mgr_or_task, "task", mgr_or_task)
+                for va in t.volumes:
+                    referenced.add(va.id)
+        for vid in list(self._pending_volume_removals):
+            if vid in referenced:
+                continue
+            self._pending_volume_removals.discard(vid)
+            self.volumes.remove(vid)
+
+    def _on_manager_exit(self, task_id: str) -> None:
+        """Runs on the task manager's thread once its controller has
+        fully closed (the process is gone): deferred volume removals for
+        volumes this task referenced can proceed now."""
+        with self._mu:
+            self._closing_tasks.pop(task_id, None)
+            self._process_volume_removals_locked()
 
     def _reconcile_deps(self, changes: List[tuple], full: bool) -> None:
-        seen_secrets, seen_configs = set(), set()
+        seen_secrets, seen_configs, seen_volumes = set(), set(), set()
         for action, kind, obj in changes:
             if kind == "secret":
                 if action == "update":
@@ -167,6 +210,15 @@ class Worker:
                     seen_configs.add(obj.id)
                 else:
                     self.configs.pop(obj.id, None)
+            elif kind == "volume" and self.volumes is not None:
+                # adds stage+publish before tasks in the same message
+                # start (deps precede task changes); removals defer until
+                # no referencing task is live (_process_volume_removals)
+                if action == "update":
+                    self.volumes.add(obj)
+                    seen_volumes.add(obj.id)
+                else:
+                    self._pending_volume_removals.add(obj.id)
         if full:
             for sid in list(self.secrets):
                 if sid not in seen_secrets:
@@ -174,6 +226,10 @@ class Worker:
             for cid in list(self.configs):
                 if cid not in seen_configs:
                     del self.configs[cid]
+            if self.volumes is not None:
+                for vid in list(self.volumes._paths):
+                    if vid not in seen_volumes:
+                        self._pending_volume_removals.add(vid)
 
     def _reconcile_tasks(self, changes: List[tuple], full: bool) -> None:
         updated: List[Task] = []
@@ -233,11 +289,15 @@ class Worker:
                 state=TaskState.REJECTED, timestamp=now(),
                 err="controller resolution failed"))
             return
-        self.task_managers[t.id] = TaskManager(t, ctlr, self.reporter)
+        self.task_managers[t.id] = TaskManager(
+            t, ctlr, self.reporter, on_exit=self._on_manager_exit)
 
     def _close_manager(self, task_id: str) -> None:
         mgr = self.task_managers.pop(task_id, None)
         if mgr is not None:
+            # keep the task visible to volume-removal gating until the
+            # controller has fully closed (on_exit fires)
+            self._closing_tasks[task_id] = mgr.task
             mgr.close()
         if self.db is not None:
             self.db.remove(task_id)

@@ -48,6 +48,10 @@ def _build_parser() -> argparse.ArgumentParser:
     create.add_argument("--image", required=True)
     create.add_argument("--replicas", type=int, default=1)
     create.add_argument("--constraint", action="append", default=[])
+    create.add_argument("--csi-volume", action="append", default=[],
+                        metavar="SOURCE:TARGET",
+                        help="mount a CSI volume (source = volume name or "
+                             "group:<group>) at TARGET in the container")
     svc.add_parser("ls")
     inspect = svc.add_parser("inspect")
     inspect.add_argument("service")
@@ -91,6 +95,63 @@ def _build_parser() -> argparse.ArgumentParser:
     config.add_parser("ls")
     crm = config.add_parser("rm")
     crm.add_argument("config")
+
+    network = sub.add_parser("network").add_subparsers(dest="verb",
+                                                       required=True)
+    ncreate = network.add_parser("create")
+    ncreate.add_argument("name")
+    ncreate.add_argument("--driver", default="overlay")
+    ncreate.add_argument("--subnet", default="")
+    network.add_parser("ls")
+    ninspect = network.add_parser("inspect")
+    ninspect.add_argument("network")
+    netrm = network.add_parser("rm")
+    netrm.add_argument("network")
+
+    volume = sub.add_parser("volume").add_subparsers(dest="verb",
+                                                     required=True)
+    vcreate = volume.add_parser("create")
+    vcreate.add_argument("name")
+    vcreate.add_argument("--driver", required=True)
+    vcreate.add_argument("--group", default="")
+    vcreate.add_argument("--sharing", default="none",
+                         choices=["none", "readonly", "onewriter", "all"])
+    vcreate.add_argument("--scope", default="single",
+                         choices=["single", "multi"])
+    volume.add_parser("ls")
+    vinspect = volume.add_parser("inspect")
+    vinspect.add_argument("volume")
+    vdrain = volume.add_parser("drain")
+    vdrain.add_argument("volume")
+    vrm = volume.add_parser("rm")
+    vrm.add_argument("volume")
+    vrm.add_argument("--force", action="store_true")
+
+    cluster = sub.add_parser("cluster").add_subparsers(dest="verb",
+                                                       required=True)
+    cluster.add_parser("inspect")
+    rotate = cluster.add_parser("rotate-token")
+    rotate.add_argument("role", choices=["worker", "manager"])
+
+    ext = sub.add_parser("extension").add_subparsers(dest="verb",
+                                                     required=True)
+    ecreate = ext.add_parser("create")
+    ecreate.add_argument("name")
+    ecreate.add_argument("--description", default="")
+    ext.add_parser("ls")
+    erm = ext.add_parser("rm")
+    erm.add_argument("extension")
+
+    res = sub.add_parser("resource").add_subparsers(dest="verb",
+                                                    required=True)
+    rcreate = res.add_parser("create")
+    rcreate.add_argument("name")
+    rcreate.add_argument("kind")
+    rcreate.add_argument("--payload", default="")
+    rls = res.add_parser("ls")
+    rls.add_argument("--kind", default="")
+    rrm = res.add_parser("rm")
+    rrm.add_argument("resource")
     return p
 
 
@@ -117,6 +178,15 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
                 replicated=ReplicatedService(replicas=args.replicas))
             if args.constraint:
                 spec.task.placement.constraints = list(args.constraint)
+            if args.csi_volume:
+                from .models.types import Mount, MountType
+                for m in args.csi_volume:
+                    source, sep, target = m.partition(":")
+                    if not sep or not source or not target:
+                        raise APIError(
+                            "--csi-volume must be SOURCE:TARGET")
+                    spec.task.container.mounts.append(Mount(
+                        type=MountType.CSI, source=source, target=target))
             service = api.create_service(spec)
             return service.id
         if args.verb == "ls":
@@ -221,6 +291,145 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
             s = _resolve(api.list_secrets(), args.secret, "secret")
             api.remove_secret(s.id)
             return s.id
+
+    if args.noun == "network":
+        from .models.specs import NetworkSpec
+        from .models.types import Driver, IPAMConfig, IPAMOptions
+        if args.verb == "create":
+            ipam = (IPAMOptions(configs=[IPAMConfig(subnet=args.subnet)])
+                    if args.subnet else None)
+            net = api.create_network(NetworkSpec(
+                annotations=Annotations(name=args.name),
+                driver_config=Driver(name=args.driver), ipam=ipam))
+            return net.id
+        if args.verb == "ls":
+            rows = []
+            for n in api.list_networks():
+                driver = (n.spec.driver_config.name
+                          if n.spec.driver_config else "-")
+                subnets = ",".join(
+                    c.subnet for c in (n.spec.ipam.configs
+                                       if n.spec.ipam else []) if c.subnet)
+                rows.append([n.id[:12], n.spec.annotations.name, driver,
+                             subnets or "-"])
+            return _fmt_table(["ID", "NAME", "DRIVER", "SUBNETS"], rows)
+        if args.verb == "inspect":
+            n = _resolve(api.list_networks(), args.network, "network")
+            subnets = ",".join(
+                c.subnet for c in (n.spec.ipam.configs
+                                   if n.spec.ipam else []) if c.subnet)
+            return "\n".join([
+                f"ID\t\t: {n.id}",
+                f"Name\t\t: {n.spec.annotations.name}",
+                f"Driver\t\t: "
+                f"{n.spec.driver_config.name if n.spec.driver_config else '-'}",
+                f"Subnets\t\t: {subnets or '-'}"])
+        if args.verb == "rm":
+            n = _resolve(api.list_networks(), args.network, "network")
+            api.remove_network(n.id)
+            return n.id
+
+    if args.noun == "volume":
+        from .models.specs import VolumeSpec
+        from .models.types import (
+            Driver, VolumeAccessMode, VolumeAccessScope, VolumeSharing,
+        )
+        if args.verb == "create":
+            vol = api.create_volume(VolumeSpec(
+                annotations=Annotations(name=args.name),
+                group=args.group,
+                driver=Driver(name=args.driver),
+                access_mode=VolumeAccessMode(
+                    scope=(VolumeAccessScope.SINGLE_NODE
+                           if args.scope == "single"
+                           else VolumeAccessScope.MULTI_NODE),
+                    sharing=VolumeSharing[args.sharing.upper()])))
+            return vol.id
+        if args.verb == "ls":
+            rows = []
+            for v in api.list_volumes():
+                state = ("pending delete" if v.pending_delete
+                         else ("created" if v.volume_info
+                               and v.volume_info.volume_id else "pending"))
+                rows.append([
+                    v.id[:12], v.spec.annotations.name, v.spec.group or "-",
+                    v.spec.driver.name if v.spec.driver else "-",
+                    state, str(len(v.publish_status))])
+            return _fmt_table(
+                ["ID", "NAME", "GROUP", "DRIVER", "STATE", "PUBLISHED"],
+                rows)
+        if args.verb == "inspect":
+            v = _resolve(api.list_volumes(), args.volume, "volume")
+            pubs = ", ".join(
+                f"{p.node_id[:8]}={p.state.name.lower()}"
+                for p in v.publish_status) or "-"
+            return "\n".join([
+                f"ID\t\t: {v.id}",
+                f"Name\t\t: {v.spec.annotations.name}",
+                f"Group\t\t: {v.spec.group or '-'}",
+                f"Driver\t\t: "
+                f"{v.spec.driver.name if v.spec.driver else '-'}",
+                f"VolumeID\t: "
+                f"{v.volume_info.volume_id if v.volume_info else '-'}",
+                f"Published\t: {pubs}"])
+        if args.verb == "drain":
+            # availability=DRAIN: the volume enforcer evicts users and the
+            # CSI manager unpublishes (reference: VolumeAvailability)
+            from .models.types import VolumeAvailability
+            v = _resolve(api.list_volumes(), args.volume, "volume")
+            spec = v.spec.copy()
+            spec.availability = int(VolumeAvailability.DRAIN)
+            api.update_volume(v.id, v.meta.version.index, spec)
+            return f"{v.id} draining"
+        if args.verb == "rm":
+            v = _resolve(api.list_volumes(), args.volume, "volume")
+            api.remove_volume(v.id, force=args.force)
+            return v.id
+
+    if args.noun == "cluster":
+        c = api.get_default_cluster()
+        if args.verb == "inspect":
+            jt = c.root_ca.join_tokens if c.root_ca else None
+            return "\n".join([
+                f"ID\t\t: {c.id}",
+                f"Name\t\t: {c.spec.annotations.name}",
+                f"Worker token\t: {jt.worker if jt else '-'}",
+                f"Manager token\t: {jt.manager if jt else '-'}"])
+        if args.verb == "rotate-token":
+            from .models.types import NodeRole
+            token = api.rotate_join_token(
+                NodeRole.MANAGER if args.role == "manager"
+                else NodeRole.WORKER)
+            return token
+
+    if args.noun == "extension":
+        if args.verb == "create":
+            ext = api.create_extension(Annotations(name=args.name),
+                                       args.description)
+            return ext.id
+        if args.verb == "ls":
+            rows = [[e.id[:12], e.annotations.name, e.description or "-"]
+                    for e in api.list_extensions()]
+            return _fmt_table(["ID", "NAME", "DESCRIPTION"], rows)
+        if args.verb == "rm":
+            e = _resolve(api.list_extensions(), args.extension,
+                         "extension")
+            api.remove_extension(e.id)
+            return e.id
+
+    if args.noun == "resource":
+        if args.verb == "create":
+            r = api.create_resource(Annotations(name=args.name),
+                                    args.kind, args.payload.encode())
+            return r.id
+        if args.verb == "ls":
+            rows = [[r.id[:12], r.annotations.name, r.kind]
+                    for r in api.list_resources(kind=args.kind)]
+            return _fmt_table(["ID", "NAME", "KIND"], rows)
+        if args.verb == "rm":
+            r = _resolve(api.list_resources(), args.resource, "resource")
+            api.remove_resource(r.id)
+            return r.id
 
     if args.noun == "config":
         if args.verb == "create":

@@ -14,19 +14,21 @@ import re
 from typing import List, Optional
 
 from ..models.objects import (
-    Cluster, Config, Network, Node, Secret, Service, Task,
+    Cluster, Config, Extension, Network, Node, Resource, Secret, Service,
+    Task, Volume,
 )
 from ..models.specs import (
     ConfigSpec, NetworkSpec, NodeSpec, SecretSpec, ServiceMode, ServiceSpec,
+    VolumeSpec,
 )
 from ..models.types import (
     EndpointResolutionMode, NodeRole, PublishMode, TaskState, Version, now,
 )
 from ..scheduler import constraint as constraint_mod
 from ..state.store import (
-    AlreadyExists as StoreExists, ByName, ByReferencedSecret,
-    ByReferencedConfig, MemoryStore, NameConflict, NotFound as StoreNotFound,
-    SequenceConflict,
+    AlreadyExists as StoreExists, ByKind, ByName, ByNamePrefix,
+    ByReferencedSecret, ByReferencedConfig, MemoryStore, NameConflict,
+    NotFound as StoreNotFound, SequenceConflict,
 )
 from ..utils import new_id
 
@@ -603,6 +605,13 @@ class ControlAPI:
             raise NotFound(f"cluster {cluster_id} not found")
         return c
 
+    def get_default_cluster(self) -> Cluster:
+        clusters = self.store.view(
+            lambda tx: tx.find(Cluster, ByName("default")))
+        if not clusters:
+            raise NotFound("default cluster not found")
+        return clusters[0]
+
     def update_cluster(self, cluster_id: str, version: int, spec) -> Cluster:
         def cb(tx):
             cluster = tx.get(Cluster, cluster_id)
@@ -618,6 +627,263 @@ class ControlAPI:
             return self.store.update(cb)
         except SequenceConflict as e:
             raise FailedPrecondition(str(e))
+
+    # ---------------------------------------------------------------- volumes
+
+    def create_volume(self, spec: VolumeSpec) -> Volume:
+        """reference: manager/controlapi/volume.go:15 CreateVolume."""
+        if spec is None:
+            raise InvalidArgument("spec must not be nil")
+        if spec.driver is None or not spec.driver.name:
+            raise InvalidArgument("driver must be specified")
+        if not spec.annotations.name:
+            raise InvalidArgument("meta: name must be provided")
+        if spec.access_mode is None:
+            raise InvalidArgument("AccessMode must not be nil")
+        volume = Volume(id=new_id(), spec=spec.copy())
+
+        def cb(tx):
+            # report ALL missing secrets, not just the first
+            # (volume.go:41-60)
+            missing = [sid for sid in volume.spec.secrets.values()
+                       if tx.get(Secret, sid) is None]
+            if missing:
+                noun = "secret" if len(missing) == 1 else "secrets"
+                raise InvalidArgument(
+                    f"{noun} not found: {', '.join(missing)}")
+            tx.create(volume)
+
+        try:
+            self.store.update(cb)
+        except NameConflict:
+            raise AlreadyExists(
+                f"volume {spec.annotations.name} already exists")
+        return self.get_volume(volume.id)
+
+    def get_volume(self, volume_id: str) -> Volume:
+        v = self.store.view(lambda tx: tx.get(Volume, volume_id))
+        if v is None:
+            raise NotFound(f"volume {volume_id} not found")
+        return v
+
+    def update_volume(self, volume_id: str, version: int,
+                      spec: VolumeSpec) -> Volume:
+        """Only labels and availability are mutable
+        (reference: volume.go:73 UpdateVolume)."""
+        def cb(tx):
+            v = tx.get(Volume, volume_id)
+            if v is None:
+                raise NotFound(f"volume {volume_id} not found")
+            old = v.spec
+            if spec.annotations.name != old.annotations.name:
+                raise InvalidArgument("Name cannot be updated")
+            if spec.group != old.group:
+                raise InvalidArgument("Group cannot be updated")
+            if spec.accessibility_requirements != \
+                    old.accessibility_requirements:
+                raise InvalidArgument(
+                    "AccessibilityRequirements cannot be updated")
+            if spec.driver != old.driver:
+                raise InvalidArgument("Driver cannot be updated")
+            if spec.access_mode != old.access_mode:
+                raise InvalidArgument("AccessMode cannot be updated")
+            if spec.secrets != old.secrets:
+                raise InvalidArgument("Secrets cannot be updated")
+            if (spec.capacity_min, spec.capacity_max) != \
+                    (old.capacity_min, old.capacity_max):
+                raise InvalidArgument("CapacityRange cannot be updated")
+            v = v.copy()
+            # replace only the mutable fields, never the whole spec
+            v.spec.annotations.labels = dict(spec.annotations.labels)
+            v.spec.availability = spec.availability
+            v.meta.version.index = version
+            tx.update(v)
+            return tx.get(Volume, volume_id)
+
+        try:
+            return self.store.update(cb)
+        except SequenceConflict as e:
+            raise FailedPrecondition(str(e))
+
+    def list_volumes(self, name_prefix: str = "") -> List[Volume]:
+        by = ByNamePrefix(name_prefix) if name_prefix else None
+        return self.store.view(
+            lambda tx: tx.find(Volume, by) if by else tx.find(Volume))
+
+    def remove_volume(self, volume_id: str, force: bool = False) -> None:
+        """Mark for deletion (the CSI manager deletes plugin-side first);
+        force deletes outright (reference: volume.go:240 RemoveVolume)."""
+        def cb(tx):
+            v = tx.get(Volume, volume_id)
+            if v is None:
+                raise NotFound(f"volume {volume_id} not found")
+            if force:
+                tx.delete(Volume, volume_id)
+                return
+            if v.publish_status:
+                raise FailedPrecondition("volume is still in use")
+            v = v.copy()
+            v.pending_delete = True
+            tx.update(v)
+
+        self.store.update(cb)
+
+    # ------------------------------------------------------------- extensions
+
+    def create_extension(self, annotations, description: str = ""
+                         ) -> Extension:
+        """reference: manager/controlapi/extension.go:20 CreateExtension."""
+        if annotations is None or not annotations.name:
+            raise InvalidArgument("extension name must be provided")
+        ext = Extension(id=new_id(), annotations=annotations.copy(),
+                        description=description)
+        try:
+            self.store.update(lambda tx: tx.create(ext))
+        except NameConflict:
+            raise AlreadyExists(
+                f"extension {annotations.name} already exists")
+        return self.store.view(lambda tx: tx.get(Extension, ext.id))
+
+    def get_extension(self, extension_id: str) -> Extension:
+        if not extension_id:
+            raise InvalidArgument("extension ID must be provided")
+        e = self.store.view(lambda tx: tx.get(Extension, extension_id))
+        if e is None:
+            raise NotFound(f"extension {extension_id} not found")
+        return e
+
+    def list_extensions(self) -> List[Extension]:
+        return self.store.view(lambda tx: tx.find(Extension))
+
+    def remove_extension(self, extension_id: str) -> None:
+        """Refuses while resources of this kind exist
+        (reference: extension.go:76 RemoveExtension)."""
+        if not extension_id:
+            raise InvalidArgument("extension ID must be provided")
+
+        def cb(tx):
+            ext = tx.get(Extension, extension_id)
+            if ext is None:
+                raise NotFound(
+                    f"could not find extension {extension_id}")
+            in_use = tx.find(Resource, ByKind(ext.annotations.name))
+            if in_use:
+                names = ", ".join(
+                    r.annotations.name for r in in_use[:10])
+                raise InvalidArgument(
+                    f"extension {ext.annotations.name} is in use by "
+                    f"resources: {names}")
+            tx.delete(Extension, extension_id)
+
+        self.store.update(cb)
+
+    # -------------------------------------------------------------- resources
+
+    def create_resource(self, annotations, kind: str,
+                        payload: bytes = b"") -> Resource:
+        """reference: manager/controlapi/resource.go:20 CreateResource."""
+        if annotations is None or not annotations.name:
+            raise InvalidArgument("Resource must have a name")
+        if not kind:
+            raise InvalidArgument("Resource must belong to an Extension")
+
+        res = Resource(id=new_id(), annotations=annotations.copy(),
+                       kind=kind, payload=payload)
+
+        def cb(tx):
+            # kind must name a registered extension (store.ErrNoKind)
+            exts = tx.find(Extension, ByName(kind))
+            if not exts:
+                raise InvalidArgument(f"Kind {kind} is not registered")
+            tx.create(res)
+
+        try:
+            self.store.update(cb)
+        except NameConflict:
+            raise AlreadyExists(
+                f"A resource with name {annotations.name} already exists")
+        return self.store.view(lambda tx: tx.get(Resource, res.id))
+
+    def get_resource(self, resource_id: str) -> Resource:
+        if not resource_id:
+            raise InvalidArgument("resource ID must be present")
+        r = self.store.view(lambda tx: tx.get(Resource, resource_id))
+        if r is None:
+            raise NotFound(f"resource {resource_id} not found")
+        return r
+
+    def update_resource(self, resource_id: str, version: int,
+                        annotations=None,
+                        payload: Optional[bytes] = None) -> Resource:
+        """Annotations (same name) and payload are mutable
+        (reference: resource.go:190 UpdateResource)."""
+        def cb(tx):
+            r = tx.get(Resource, resource_id)
+            if r is None:
+                raise NotFound(f"resource {resource_id} not found")
+            r = r.copy()
+            if annotations is not None:
+                if annotations.name != r.annotations.name:
+                    raise InvalidArgument("Name cannot be updated")
+                r.annotations = annotations.copy()
+            if payload is not None:
+                r.payload = payload
+            r.meta.version.index = version
+            tx.update(r)
+            return tx.get(Resource, resource_id)
+
+        try:
+            return self.store.update(cb)
+        except SequenceConflict as e:
+            raise FailedPrecondition(str(e))
+
+    def list_resources(self, kind: str = "") -> List[Resource]:
+        by = ByKind(kind) if kind else None
+        return self.store.view(
+            lambda tx: tx.find(Resource, by) if by else tx.find(Resource))
+
+    def remove_resource(self, resource_id: str) -> None:
+        if not resource_id:
+            raise InvalidArgument("resource ID must be present")
+
+        def cb(tx):
+            if tx.get(Resource, resource_id) is None:
+                raise NotFound(f"resource {resource_id} not found")
+            tx.delete(Resource, resource_id)
+
+        self.store.update(cb)
+
+    # -------------------------------------------------------- token rotation
+
+    def rotate_join_token(self, role) -> str:
+        """Rotate the worker/manager join token: new role secret in the
+        CA plus the updated token persisted on the cluster object
+        (reference: controlapi/cluster.go UpdateCluster w/ rotation flags).
+        Requires a manager-bound API (``root_ca`` set)."""
+        from ..models.types import JoinTokens
+        ca = getattr(self, "root_ca", None)
+        if ca is None:
+            raise APIError("join-token rotation requires the manager CA")
+        role = NodeRole(role)
+        token = ca.rotate_join_token(role)
+
+        def cb(tx):
+            clusters = tx.find(Cluster, ByName("default"))
+            if not clusters:
+                raise NotFound("default cluster not found")
+            cluster = clusters[0].copy()
+            if cluster.root_ca is None:
+                raise FailedPrecondition("cluster has no trust root state")
+            jt = cluster.root_ca.join_tokens or JoinTokens()
+            if role == NodeRole.WORKER:
+                jt.worker = token
+            else:
+                jt.manager = token
+            cluster.root_ca.join_tokens = jt
+            tx.update(cluster)
+
+        self.store.update(cb)
+        return token
 
     # ----------------------------------------------------------------- tasks
 

@@ -33,7 +33,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..models.objects import Cluster, Config, Node, Secret, Task
+from ..models.objects import Cluster, Config, Node, Secret, Task, Volume
 from ..models.types import NodeState, NodeStatus, TaskState, TaskStatus, now
 from ..state.events import Event, EventSnapshotRestore
 from ..state.store import Batch, ByNode, MemoryStore
@@ -153,6 +153,8 @@ class _AssignmentSet:
 
     # --- dependencies
 
+    _DEP_TYPES = {"secret": Secret, "config": Config, "volume": Volume}
+
     def _task_deps(self, t: Task) -> List[Tuple[str, str]]:
         deps = []
         c = t.spec.container
@@ -161,6 +163,11 @@ class _AssignmentSet:
                 deps.append(("secret", ref.secret_id))
             for ref in c.configs:
                 deps.append(("config", ref.config_id))
+        # CSI volume attachments are worker dependencies too: the agent's
+        # node-volumes manager stages/publishes them before the task runs
+        # (reference: assignments.go volumes + agent/csi/volumes.go)
+        for va in t.volumes:
+            deps.append(("volume", va.id))
         return deps
 
     def _add_task_deps(self, tx, t: Task) -> None:
@@ -168,7 +175,7 @@ class _AssignmentSet:
             users = self.deps_use.setdefault(key, set())
             if not users:
                 kind, obj_id = key
-                obj = tx.get(Secret if kind == "secret" else Config, obj_id)
+                obj = tx.get(self._DEP_TYPES[kind], obj_id)
                 if obj is not None:
                     self.changes[key] = ("update", kind, obj)
             users.add(t.id)
@@ -183,11 +190,18 @@ class _AssignmentSet:
             if not users:
                 del self.deps_use[key]
                 kind, obj_id = key
-                stub = (Secret(id=obj_id) if kind == "secret"
-                        else Config(id=obj_id))
+                stub = self._DEP_TYPES[kind](id=obj_id)
                 self.changes[key] = ("remove", kind, stub)
                 modified = True
         return modified
+
+    def update_volume(self, v: Volume) -> bool:
+        """Forward updates of a tracked volume (publish context changes
+        etc.) to the node (reference: assignments.go addOrUpdateVolume)."""
+        if ("volume", v.id) not in self.deps_use:
+            return False
+        self.changes[("volume", v.id)] = ("update", "volume", v)
+        return True
 
     # --- tasks
 
@@ -242,6 +256,9 @@ class Dispatcher:
         self._nodes: Dict[str, _RegisteredNode] = {}
         self._down_nodes: Dict[str, float] = {}  # node_id -> down since
         self._task_updates: Dict[str, TaskStatus] = {}
+        # (volume_id, node_id) pairs reported node-unpublished by agents
+        # (reference: dispatcher.go:682 UpdateVolumeStatus)
+        self._unpublished_volumes: Set[Tuple[str, str]] = set()
         self._node_updates: Dict[str, tuple] = {}  # id->(status, description)
         self._updates_lock = threading.Lock()
         self._heap: List = []    # (deadline, seq, kind, node_id)
@@ -480,12 +497,27 @@ class Dispatcher:
         if n >= self.config.max_batch_items:
             self._flush_updates()
 
+    def update_volume_status(self, node_id: str, session_id: str,
+                             updates) -> None:
+        """Agents report node-side volume unpublish completion; the next
+        batch moves those volumes from PENDING_NODE_UNPUBLISH to
+        PENDING_UNPUBLISH so the CSI manager can controller-unpublish
+        (reference: dispatcher.go:682 UpdateVolumeStatus).
+        ``updates``: iterable of (volume_id, unpublished: bool)."""
+        self._check_session(node_id, session_id)
+        with self._updates_lock:
+            for volume_id, unpublished in updates:
+                if unpublished:
+                    self._unpublished_volumes.add((volume_id, node_id))
+
     def _flush_updates(self) -> None:
         """reference: dispatcher.go:726 processUpdates."""
         with self._updates_lock:
             task_updates, self._task_updates = self._task_updates, {}
             node_updates, self._node_updates = self._node_updates, {}
-        if not task_updates and not node_updates:
+            unpublished = self._unpublished_volumes
+            self._unpublished_volumes = set()
+        if not task_updates and not node_updates and not unpublished:
             return
 
         def cb(batch: Batch) -> None:
@@ -522,6 +554,35 @@ class Dispatcher:
                         n.description = description
                     tx.update(n)
                 batch.update(one_n)
+            for volume_id, v_node in unpublished:
+                def one_v(tx, volume_id=volume_id, v_node=v_node):
+                    from ..models.types import VolumePublishStatus
+                    v = tx.get(Volume, volume_id)
+                    if v is None:
+                        return
+                    changed = requeue = False
+                    v = v.copy()
+                    for ps in v.publish_status:
+                        if ps.node_id != v_node:
+                            continue
+                        if ps.state == (VolumePublishStatus.State
+                                        .PENDING_NODE_UNPUBLISH):
+                            ps.state = (VolumePublishStatus.State
+                                        .PENDING_UNPUBLISH)
+                            changed = True
+                        elif ps.state == \
+                                VolumePublishStatus.State.PUBLISHED:
+                            # agent reported before the scheduler freed
+                            # the volume: keep the report for a later
+                            # flush instead of losing it
+                            requeue = True
+                    if requeue:
+                        with self._updates_lock:
+                            self._unpublished_volumes.add(
+                                (volume_id, v_node))
+                    if changed:
+                        tx.update(v)
+                batch.update(one_v)
 
         try:
             self.store.batch(cb)
@@ -622,7 +683,11 @@ class Dispatcher:
             applies_to = results_in
 
         def pred(ev):
-            return (isinstance(ev, Event) and isinstance(ev.obj, Task)
+            if not isinstance(ev, Event):
+                return False
+            if isinstance(ev.obj, Volume):
+                return True   # filtered against tracked deps in the loop
+            return (isinstance(ev.obj, Task)
                     and ev.obj.node_id == node_id)
 
         def init(tx):
@@ -665,10 +730,13 @@ class Dispatcher:
                             break
                         continue
                     t = ev.obj
-                    tx = self.store.view()
-                    if ev.action == "delete":
+                    if isinstance(t, Volume):
+                        modified = (ev.action != "delete"
+                                    and aset.update_volume(t))
+                    elif ev.action == "delete":
                         modified = aset.remove_task(t)
                     else:
+                        tx = self.store.view()
                         modified = aset.add_or_update_task(tx, t)
                     if modified:
                         modifications += 1

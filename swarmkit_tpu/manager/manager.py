@@ -49,9 +49,12 @@ class Manager:
                  raft_node=None, node_id: Optional[str] = None,
                  root_ca: Optional[RootCA] = None,
                  dispatcher_config: Optional[DispatcherConfig] = None,
-                 use_device_scheduler: bool = True):
+                 use_device_scheduler: bool = True,
+                 csi_plugins: Optional[dict] = None):
         """``raft_node``: a state.raft.RaftNode already wired as the
-        store's proposer, or None for standalone single-manager mode."""
+        store's proposer, or None for standalone single-manager mode.
+        ``csi_plugins``: name -> CSIPlugin for the CSI controller manager
+        (an in-memory plugin named "inmem" is always available)."""
         self.node_id = node_id or new_id()
         self.raft = raft_node
         self.store = store if store is not None else (
@@ -63,6 +66,7 @@ class Manager:
         # always-on surfaces (follower-safe; mutations go through the
         # store's proposer so they fail on non-leaders)
         self.control_api = ControlAPI(self.store)
+        self.control_api.root_ca = self.root_ca
         self.watch_server = WatchServer(self.store)
         self.logbroker = LogBroker(self.store)
         self.ca_server = CAServer(self.root_ca)
@@ -80,6 +84,8 @@ class Manager:
         self.volume_enforcer: Optional[VolumeEnforcer] = None
         self.keymanager: Optional[KeyManager] = None
         self.role_manager: Optional[RoleManager] = None
+        self.csi_manager = None
+        self._csi_plugins = dict(csi_plugins or {})
 
         self._mu = threading.Lock()
         self._running = False
@@ -260,10 +266,20 @@ class Manager:
             self.keymanager = KeyManager(self.store)
             self.role_manager = RoleManager(self.store,
                                             raft_node=self.raft)
+            # CSI controller manager: drives volume create/publish/delete
+            # from store events (reference: manager.go:1077 csi manager).
+            # Plugins come from the constructor; an in-memory plugin named
+            # "inmem" is always registered so volume lifecycles are
+            # drivable out of the box (the image has no real CSI drivers).
+            from .csi import InMemoryCSIPlugin, Manager as CSIManager
+            plugins = dict(self._csi_plugins)
+            plugins.setdefault("inmem", InMemoryCSIPlugin("inmem"))
+            self.csi_manager = CSIManager(self.store, plugins=plugins)
             for loop in (self.allocator, self.scheduler, self.replicated,
                          self.global_, self.jobs, self.reaper,
                          self.constraint_enforcer, self.volume_enforcer,
-                         self.keymanager, self.role_manager):
+                         self.keymanager, self.role_manager,
+                         self.csi_manager):
                 loop.start()
 
     def manager_api_addrs(self) -> list:
@@ -330,8 +346,8 @@ class Manager:
             # return empty
             self.control_api.log_broker = None
             log.info("manager %s lost leadership", self.node_id[:8])
-            loops = [self.role_manager, self.keymanager,
-                     self.volume_enforcer,
+            loops = [self.csi_manager, self.role_manager,
+                     self.keymanager, self.volume_enforcer,
                      self.constraint_enforcer, self.reaper, self.jobs,
                      self.global_, self.replicated, self.scheduler,
                      self.allocator, self.dispatcher]
@@ -343,6 +359,7 @@ class Manager:
                         log.exception("stopping %r failed", loop)
             self.dispatcher = self.allocator = self.scheduler = None
             self.replicated = self.global_ = self.jobs = None
+            self.csi_manager = None
             self.reaper = None
             self.constraint_enforcer = self.volume_enforcer = None
             self.keymanager = None

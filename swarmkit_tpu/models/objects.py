@@ -205,9 +205,17 @@ class Cluster:
     vxlan_udp_port: int = 4789
 
     def copy(self) -> "Cluster":
+        # root_ca copies deeply: join_tokens is mutable and a shallow
+        # replace would alias the committed object's tokens, breaking the
+        # store's copy-on-write contract under token rotation
+        root_ca = None
+        if self.root_ca is not None:
+            root_ca = dataclasses.replace(
+                self.root_ca,
+                join_tokens=dataclasses.replace(self.root_ca.join_tokens))
         return Cluster(
             self.id, self.meta.copy(), self.spec.copy(),
-            dataclasses.replace(self.root_ca) if self.root_ca else None,
+            root_ca,
             list(self.network_bootstrap_keys),
             self.encryption_key_lamport_clock,
             list(self.unlock_keys), self.fips,

@@ -336,6 +336,12 @@ class RemoteDispatcherClient:
             "updates": [{"task_id": tid, "status": serde.to_dict(st)}
                         for tid, st in updates]})
 
+    def update_volume_status(self, node_id: str, session_id: str,
+                             updates) -> None:
+        self._conn.call("update_volume_status", {
+            "node_id": node_id, "session_id": session_id,
+            "updates": [[vid, bool(unpub)] for vid, unpub in updates]})
+
     def open_assignments(self, node_id: str,
                          session_id: str) -> RemoteAssignmentStream:
         return RemoteAssignmentStream(
@@ -404,6 +410,75 @@ class RemoteControlClient:
 
     def remove_secret(self, secret_id):
         self._call("remove_secret", secret_id=secret_id)
+
+    def create_config(self, spec):
+        return _obj_in(self._call("create_config",
+                                  spec=serde.to_dict(spec)))
+
+    def list_configs(self):
+        return [_obj_in(o) for o in self._call("list_configs")]
+
+    def remove_config(self, config_id):
+        self._call("remove_config", config_id=config_id)
+
+    def create_network(self, spec):
+        return _obj_in(self._call("create_network",
+                                  spec=serde.to_dict(spec)))
+
+    def list_networks(self):
+        return [_obj_in(o) for o in self._call("list_networks")]
+
+    def remove_network(self, network_id):
+        self._call("remove_network", network_id=network_id)
+
+    def create_volume(self, spec):
+        return _obj_in(self._call("create_volume",
+                                  spec=serde.to_dict(spec)))
+
+    def update_volume(self, volume_id, version, spec):
+        return _obj_in(self._call("update_volume", volume_id=volume_id,
+                                  version=version,
+                                  spec=serde.to_dict(spec)))
+
+    def get_volume(self, volume_id):
+        return _obj_in(self._call("get_volume", volume_id=volume_id))
+
+    def list_volumes(self, name_prefix: str = ""):
+        return [_obj_in(o) for o in self._call("list_volumes",
+                                               name_prefix=name_prefix)]
+
+    def remove_volume(self, volume_id, force=False):
+        self._call("remove_volume", volume_id=volume_id, force=force)
+
+    def create_extension(self, annotations, description=""):
+        return _obj_in(self._call("create_extension",
+                                  annotations=serde.to_dict(annotations),
+                                  description=description))
+
+    def list_extensions(self):
+        return [_obj_in(o) for o in self._call("list_extensions")]
+
+    def remove_extension(self, extension_id):
+        self._call("remove_extension", extension_id=extension_id)
+
+    def create_resource(self, annotations, kind, payload=b""):
+        import base64 as _b64
+        return _obj_in(self._call(
+            "create_resource", annotations=serde.to_dict(annotations),
+            kind=kind, payload=_b64.b64encode(payload).decode("ascii")))
+
+    def list_resources(self, kind: str = ""):
+        return [_obj_in(o) for o in self._call("list_resources",
+                                               kind=kind)]
+
+    def remove_resource(self, resource_id):
+        self._call("remove_resource", resource_id=resource_id)
+
+    def rotate_join_token(self, role):
+        return self._call("rotate_join_token", role=int(role))
+
+    def get_default_cluster(self):
+        return _obj_in(self._call("get_default_cluster"))
 
     def close(self) -> None:
         self._conn.close()

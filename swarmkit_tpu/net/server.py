@@ -251,6 +251,13 @@ class ManagerServer:
                 params["node_id"], params["session_id"], updates)
             return "ok"
 
+        if method == "update_volume_status":
+            self._require_cert(cert, params["node_id"])
+            self._dispatcher().update_volume_status(
+                params["node_id"], params["session_id"],
+                [(u[0], u[1]) for u in params["updates"]])
+            return "ok"
+
         if method == "publish_logs":
             self._require_cert(cert, params["node_id"])
             import base64 as _b64
@@ -352,6 +359,69 @@ class ManagerServer:
         if method == "remove_secret":
             api.remove_secret(params["secret_id"])
             return "ok"
+        if method == "create_config":
+            from ..models.specs import ConfigSpec
+            return obj_out(api.create_config(
+                serde.from_dict(ConfigSpec, params["spec"])))
+        if method == "list_configs":
+            return [obj_out(c) for c in api.list_configs()]
+        if method == "remove_config":
+            api.remove_config(params["config_id"])
+            return "ok"
+        if method == "create_network":
+            from ..models.specs import NetworkSpec
+            return obj_out(api.create_network(
+                serde.from_dict(NetworkSpec, params["spec"])))
+        if method == "list_networks":
+            return [obj_out(n) for n in api.list_networks()]
+        if method == "remove_network":
+            api.remove_network(params["network_id"])
+            return "ok"
+        if method == "create_volume":
+            from ..models.specs import VolumeSpec
+            return obj_out(api.create_volume(
+                serde.from_dict(VolumeSpec, params["spec"])))
+        if method == "update_volume":
+            from ..models.specs import VolumeSpec
+            return obj_out(api.update_volume(
+                params["volume_id"], params["version"],
+                serde.from_dict(VolumeSpec, params["spec"])))
+        if method == "get_volume":
+            return obj_out(api.get_volume(params["volume_id"]))
+        if method == "list_volumes":
+            return [obj_out(v) for v in api.list_volumes(
+                name_prefix=params.get("name_prefix", ""))]
+        if method == "remove_volume":
+            api.remove_volume(params["volume_id"],
+                              force=params.get("force", False))
+            return "ok"
+        if method == "create_extension":
+            from ..models.types import Annotations
+            return obj_out(api.create_extension(
+                serde.from_dict(Annotations, params["annotations"]),
+                params.get("description", "")))
+        if method == "list_extensions":
+            return [obj_out(e) for e in api.list_extensions()]
+        if method == "remove_extension":
+            api.remove_extension(params["extension_id"])
+            return "ok"
+        if method == "create_resource":
+            import base64 as _b64
+            from ..models.types import Annotations
+            return obj_out(api.create_resource(
+                serde.from_dict(Annotations, params["annotations"]),
+                params["kind"],
+                _b64.b64decode(params.get("payload", ""))))
+        if method == "list_resources":
+            return [obj_out(r) for r in api.list_resources(
+                kind=params.get("kind", ""))]
+        if method == "remove_resource":
+            api.remove_resource(params["resource_id"])
+            return "ok"
+        if method == "rotate_join_token":
+            return api.rotate_join_token(params["role"])
+        if method == "get_default_cluster":
+            return obj_out(api.get_default_cluster())
         raise ValueError(f"unknown control method {method!r}")
 
     # ------------------------------------------------------------- streaming

@@ -203,3 +203,7 @@ class FailoverDispatcherClient:
 
     def publish_logs(self, node_id, session_id, messages):
         return self._call("publish_logs", node_id, session_id, messages)
+
+    def update_volume_status(self, node_id, session_id, updates):
+        return self._call("update_volume_status", node_id, session_id,
+                          updates)

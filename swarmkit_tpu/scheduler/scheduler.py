@@ -426,6 +426,16 @@ class Scheduler:
                 self.volumes.release_volume(va.id, d.new.id)
             self._enqueue(d.old)
 
+        if not decisions and self.volumes.frees_pending:
+            # releases without new decisions (task shutdowns) must still
+            # queue node-unpublish for now-unused volumes (the decisions
+            # path runs free_volumes in its own finally)
+            self.volumes.frees_pending = False
+            try:
+                self.store.batch(self.volumes.free_volumes)
+            except Exception:
+                log.exception("freeing volumes failed")
+
         self.stats["decisions"] += n_decisions
         self.stats["tick_seconds"].append(now() - t0)
         return n_decisions

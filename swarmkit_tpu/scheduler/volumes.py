@@ -48,6 +48,7 @@ class VolumeSet:
         self.volumes: Dict[str, _VolumeInfo] = {}
         self.by_group: Dict[str, set] = {}
         self.by_name: Dict[str, str] = {}
+        self.frees_pending = False
 
     def clear(self) -> None:
         """Reset in place (holders of a reference — e.g. the pipeline's
@@ -55,6 +56,7 @@ class VolumeSet:
         self.volumes.clear()
         self.by_group.clear()
         self.by_name.clear()
+        self.frees_pending = False
 
     def add_or_update_volume(self, v: Volume) -> None:
         info = self.volumes.get(v.id)
@@ -88,6 +90,10 @@ class VolumeSet:
         usage = info.tasks.pop(task_id, None)
         if usage is not None and info.nodes.get(usage.node_id, 0) > 0:
             info.nodes[usage.node_id] -= 1
+            if info.nodes[usage.node_id] == 0:
+                # a node just went unused: the next tick must run
+                # free_volumes even if it commits no decisions
+                self.frees_pending = True
 
     def reserve_task_volumes(self, task: Task) -> None:
         c = task.spec.container

@@ -299,3 +299,372 @@ def test_network_ipam_allocation():
         assert not vip_addrs & set(addrs), "VIPs never reused for tasks"
     finally:
         alloc.stop()
+
+
+# ------------------------------------------------- volumes (volume.go parity)
+
+def _vol_spec(name="vol1", driver="csi.example", group="", sharing=None,
+              secrets=None):
+    from swarmkit_tpu.models.specs import VolumeSpec
+    from swarmkit_tpu.models.types import Driver, VolumeAccessMode
+
+    return VolumeSpec(
+        annotations=Annotations(name=name), group=group,
+        driver=Driver(name=driver),
+        access_mode=VolumeAccessMode(sharing=sharing or 0),
+        secrets=dict(secrets or {}))
+
+
+def test_volume_crud_lifecycle(api):
+    from swarmkit_tpu.models.types import VolumeAvailability
+
+    with pytest.raises(InvalidArgument, match="driver must be specified"):
+        api.create_volume(_vol_spec(driver=""))
+    with pytest.raises(InvalidArgument, match="name must be provided"):
+        api.create_volume(_vol_spec(name=""))
+
+    v = api.create_volume(_vol_spec())
+    assert api.get_volume(v.id).spec.annotations.name == "vol1"
+    with pytest.raises(AlreadyExists):
+        api.create_volume(_vol_spec())
+
+    # only labels + availability are mutable
+    spec2 = v.spec.copy()
+    spec2.group = "changed"
+    with pytest.raises(InvalidArgument, match="Group cannot be updated"):
+        api.update_volume(v.id, v.meta.version.index, spec2)
+    spec3 = v.spec.copy()
+    spec3.annotations.labels["tier"] = "fast"
+    spec3.availability = int(VolumeAvailability.DRAIN)
+    updated = api.update_volume(v.id, v.meta.version.index, spec3)
+    assert updated.spec.annotations.labels == {"tier": "fast"}
+    assert updated.spec.availability == int(VolumeAvailability.DRAIN)
+
+    assert [x.id for x in api.list_volumes()] == [v.id]
+    api.remove_volume(v.id)           # unused -> marked pending delete
+    assert api.get_volume(v.id).pending_delete
+    api.remove_volume(v.id, force=True)
+    with pytest.raises(NotFound):
+        api.get_volume(v.id)
+
+
+def test_volume_create_reports_all_missing_secrets(api):
+    with pytest.raises(InvalidArgument, match="secrets not found"):
+        api.create_volume(_vol_spec(secrets={"a": "sec-a", "b": "sec-b"}))
+
+
+def test_volume_in_use_refuses_remove(api):
+    from swarmkit_tpu.models.objects import Volume
+    from swarmkit_tpu.models.types import VolumePublishStatus
+
+    v = api.create_volume(_vol_spec())
+
+    def publish(tx):
+        cur = tx.get(Volume, v.id).copy()
+        cur.publish_status.append(VolumePublishStatus(node_id="n1"))
+        tx.update(cur)
+    api.store.update(publish)
+    with pytest.raises(FailedPrecondition, match="still in use"):
+        api.remove_volume(v.id)
+
+
+# -------------------------------- extensions + resources (extension.go parity)
+
+def test_extension_and_resource_lifecycle(api):
+    with pytest.raises(InvalidArgument, match="name must be provided"):
+        api.create_extension(Annotations(name=""))
+    ext = api.create_extension(Annotations(name="widgets"),
+                               "custom widget type")
+    with pytest.raises(AlreadyExists):
+        api.create_extension(Annotations(name="widgets"))
+
+    with pytest.raises(InvalidArgument, match="not registered"):
+        api.create_resource(Annotations(name="w1"), "gadgets")
+    r = api.create_resource(Annotations(name="w1"), "widgets",
+                            b"payload-1")
+    assert api.get_resource(r.id).payload == b"payload-1"
+    assert [x.id for x in api.list_resources(kind="widgets")] == [r.id]
+
+    # extension removal is refused while resources of its kind exist
+    with pytest.raises(InvalidArgument, match="in use by resources"):
+        api.remove_extension(ext.id)
+
+    # payload + labels mutable; renames rejected
+    ann = r.annotations.copy()
+    ann.name = "renamed"
+    with pytest.raises(InvalidArgument, match="Name cannot be updated"):
+        api.update_resource(r.id, r.meta.version.index, annotations=ann)
+    r2 = api.update_resource(r.id, r.meta.version.index,
+                             payload=b"payload-2")
+    assert r2.payload == b"payload-2"
+
+    api.remove_resource(r.id)
+    api.remove_extension(ext.id)
+    with pytest.raises(NotFound):
+        api.get_extension(ext.id)
+
+
+# ------------------------------------------------------------- join tokens
+
+def test_rotate_join_token_via_api():
+    from swarmkit_tpu.manager import Manager
+    from swarmkit_tpu.models import Cluster
+    from swarmkit_tpu.state.store import ByName
+
+    m = Manager(use_device_scheduler=False)
+    m.run()
+    try:
+        cluster = m.store.view(
+            lambda tx: tx.find(Cluster, ByName("default")))[0]
+        old = cluster.root_ca.join_tokens.worker
+        new = m.control_api.rotate_join_token(NodeRole.WORKER)
+        assert new != old
+        assert m.root_ca.join_token(NodeRole.WORKER) == new
+        cluster = m.store.view(
+            lambda tx: tx.find(Cluster, ByName("default")))[0]
+        assert cluster.root_ca.join_tokens.worker == new
+        with pytest.raises(Exception):
+            m.root_ca.role_for_token(old)
+    finally:
+        m.stop()
+
+
+# ------------------------------------------------------------------ CLI nouns
+
+def test_cli_volume_network_cluster_nouns():
+    from swarmkit_tpu.cli import run_command
+    from swarmkit_tpu.manager import Manager
+
+    m = Manager(use_device_scheduler=False)
+    m.run()
+    api2 = m.control_api
+    try:
+        vid = run_command(["volume", "create", "data1",
+                           "--driver", "csi.example",
+                           "--group", "fast"], api2)
+        out = run_command(["volume", "ls"], api2)
+        assert "data1" in out and "fast" in out
+        out = run_command(["volume", "inspect", "data1"], api2)
+        assert vid in out
+        run_command(["volume", "drain", "data1"], api2)
+        run_command(["volume", "rm", "data1", "--force"], api2)
+        assert "data1" not in run_command(["volume", "ls"], api2)
+
+        nid = run_command(["network", "create", "backend",
+                           "--subnet", "10.99.0.0/24"], api2)
+        assert "backend" in run_command(["network", "ls"], api2)
+        assert "10.99.0.0/24" in run_command(
+            ["network", "inspect", "backend"], api2)
+        run_command(["network", "rm", "backend"], api2)
+
+        out = run_command(["cluster", "inspect"], api2)
+        assert "SWMTKN-1-" in out
+        token = run_command(["cluster", "rotate-token", "worker"], api2)
+        assert token.startswith("SWMTKN-1-")
+        assert token in run_command(["cluster", "inspect"], api2)
+
+        run_command(["extension", "create", "widgets"], api2)
+        run_command(["resource", "create", "w1", "widgets"], api2)
+        assert "w1" in run_command(["resource", "ls"], api2)
+        run_command(["resource", "rm", "w1"], api2)
+        run_command(["extension", "rm", "widgets"], api2)
+    finally:
+        m.stop()
+
+
+def test_cli_nouns_over_remote_control_client():
+    """The same CLI nouns drive a remote manager through the mTLS control
+    client (reference: swarmctl against a live manager)."""
+    from swarmkit_tpu.cli import run_command
+    from swarmkit_tpu.manager import Manager
+    from swarmkit_tpu.models import Cluster
+    from swarmkit_tpu.net import ManagerServer, RemoteControlClient, issue_certificate
+    from swarmkit_tpu.state.store import ByName
+    from swarmkit_tpu.utils import new_id
+
+    m = Manager(use_device_scheduler=False)
+    m.run()
+    srv = ManagerServer(m)
+    srv.start()
+    try:
+        cluster = m.store.view(
+            lambda tx: tx.find(Cluster, ByName("default")))[0]
+        op = issue_certificate(srv.addr, new_id(),
+                               cluster.root_ca.join_tokens.manager)
+        ctl = RemoteControlClient(srv.addr, op)
+        run_command(["volume", "create", "rv", "--driver", "csi.x"], ctl)
+        assert "rv" in run_command(["volume", "ls"], ctl)
+        run_command(["volume", "rm", "rv", "--force"], ctl)
+        run_command(["network", "create", "rnet"], ctl)
+        assert "rnet" in run_command(["network", "ls"], ctl)
+        run_command(["network", "rm", "rnet"], ctl)
+        tok = run_command(["cluster", "rotate-token", "worker"], ctl)
+        assert tok.startswith("SWMTKN-1-")
+        run_command(["extension", "create", "kinds"], ctl)
+        run_command(["resource", "create", "k1", "kinds"], ctl)
+        assert "k1" in run_command(["resource", "ls"], ctl)
+        run_command(["resource", "rm", "k1"], ctl)
+        run_command(["extension", "rm", "kinds"], ctl)
+        ctl.close()
+    finally:
+        srv.stop()
+        m.stop()
+
+
+def test_csi_volume_lifecycle_e2e_from_cli():
+    """VERDICT r2 item 3 done-criterion: volume create -> schedule a task
+    using it -> publish -> drain -> unpublish, all driven from the CLI
+    (reference: volume.go + csi manager + VolumesFilter together)."""
+    import time
+
+    from swarmkit_tpu.agent import Agent
+    from swarmkit_tpu.agent.testutils import TestExecutor
+    from swarmkit_tpu.cli import run_command
+    from swarmkit_tpu.manager import Manager
+    from swarmkit_tpu.manager.dispatcher import Config_
+    from swarmkit_tpu.models import Task, TaskState
+    from swarmkit_tpu.models.types import VolumePublishStatus
+
+    from test_orchestrator import poll
+    from test_scheduler import make_ready_node
+
+    m = Manager(dispatcher_config=Config_(
+        heartbeat_period=0.3, heartbeat_epsilon=0.02,
+        process_updates_interval=0.02, assignment_batching_wait=0.02),
+        use_device_scheduler=False)
+    m.run()
+    api2 = m.control_api
+    n = make_ready_node("csi-n1")
+    m.store.update(lambda tx, n=n: tx.create(n))
+    agent = Agent(n.id, TestExecutor(hostname="csi-n1"), m.dispatcher)
+    agent.start()
+    try:
+        vid = run_command(["volume", "create", "data1",
+                           "--driver", "inmem"], api2)
+        # csi manager creates it plugin-side
+        poll(lambda: api2.get_volume(vid).volume_info is not None
+             and api2.get_volume(vid).volume_info.volume_id,
+             timeout=10, msg="csi manager should create the volume")
+
+        run_command(["service", "create", "--name", "dbsvc",
+                     "--image", "db", "--replicas", "1",
+                     "--csi-volume", "data1:/data"], api2)
+
+        def task_running_with_volume():
+            ts = [t for t in api2.list_tasks()
+                  if t.service_annotations.name == "dbsvc"
+                  and t.desired_state == TaskState.RUNNING]
+            return (ts and ts[0].status.state == TaskState.RUNNING
+                    and any(va.id == vid for va in ts[0].volumes))
+        poll(task_running_with_volume, timeout=20,
+             msg="task should run with the volume attached")
+
+        def published():
+            v = api2.get_volume(vid)
+            return any(p.node_id == n.id and p.state ==
+                       VolumePublishStatus.State.PUBLISHED
+                       for p in v.publish_status)
+        poll(published, timeout=10,
+             msg="csi manager should controller-publish on the node")
+        assert "published" in run_command(
+            ["volume", "inspect", "data1"], api2)
+
+        # drain: the volume enforcer evicts the task, the csi manager
+        # unpublishes once unused
+        run_command(["volume", "drain", "data1"], api2)
+
+        def unpublished():
+            v = api2.get_volume(vid)
+            return not v.publish_status
+        poll(unpublished, timeout=20,
+             msg="drained volume should unpublish after eviction")
+
+        # and now removable without force
+        run_command(["service", "rm", "dbsvc"], api2)
+        run_command(["volume", "rm", "data1"], api2)
+        poll(lambda: not [v for v in api2.list_volumes()
+                          if v.spec.annotations.name == "data1"],
+             timeout=10, msg="pending-delete volume should be deleted")
+    finally:
+        agent.stop()
+        m.stop()
+
+
+def test_node_side_csi_staging_with_process_executor(tmp_path):
+    """Worker-side CSI (reference: agent/csi/volumes.go): the agent
+    stages/publishes the volume to a local path before the process task
+    starts, exposes it via env, and unstages after shutdown."""
+    import os
+
+    from swarmkit_tpu.agent import Agent
+    from swarmkit_tpu.agent.procexec import ProcessExecutor
+    from swarmkit_tpu.cli import run_command
+    from swarmkit_tpu.manager import Manager
+    from swarmkit_tpu.manager.dispatcher import Config_
+    from swarmkit_tpu.models import TaskState
+    from swarmkit_tpu.models.specs import (
+        ContainerSpec, ServiceSpec,
+    )
+    from swarmkit_tpu.models import (
+        ReplicatedService, ServiceMode, TaskSpec,
+    )
+    from swarmkit_tpu.models.types import Mount, MountType
+
+    from test_orchestrator import poll
+    from test_scheduler import make_ready_node
+
+    m = Manager(dispatcher_config=Config_(
+        heartbeat_period=0.3, heartbeat_epsilon=0.02,
+        process_updates_interval=0.02, assignment_batching_wait=0.02),
+        use_device_scheduler=False)
+    m.run()
+    api2 = m.control_api
+    n = make_ready_node("csi-p1")
+    m.store.update(lambda tx, n=n: tx.create(n))
+    agent = Agent(n.id, ProcessExecutor(
+        hostname="csi-p1", log_dir=str(tmp_path / "logs")), m.dispatcher,
+        task_db_path=str(tmp_path / "node" / "tasks.db"))
+    agent.start()
+    try:
+        vid = run_command(["volume", "create", "pdata",
+                           "--driver", "inmem"], api2)
+        poll(lambda: api2.get_volume(vid).volume_info is not None
+             and api2.get_volume(vid).volume_info.volume_id, timeout=10)
+
+        marker = tmp_path / "proof"
+        svc = api2.create_service(ServiceSpec(
+            annotations=Annotations(name="vol-writer"),
+            task=TaskSpec(container=ContainerSpec(
+                image="process",
+                command=["sh", "-c",
+                         f'echo "$SWARM_VOLUME_DATA" > {marker}; '
+                         'touch "$SWARM_VOLUME_DATA/wrote"; sleep 30'],
+                mounts=[Mount(type=MountType.CSI, source="pdata",
+                              target="/data")])),
+            mode=ServiceMode.REPLICATED,
+            replicated=ReplicatedService(replicas=1)))
+
+        def running():
+            ts = [t for t in api2.list_tasks(service_id=svc.id)
+                  if t.desired_state == TaskState.RUNNING]
+            return ts and ts[0].status.state == TaskState.RUNNING
+        poll(running, timeout=20, msg="volume task should run")
+
+        poll(lambda: marker.exists() and marker.read_text().strip(),
+             timeout=10, msg="task should see the volume path env")
+        vol_path = marker.read_text().strip()
+        assert os.path.isdir(vol_path), vol_path
+        assert os.path.exists(os.path.join(vol_path, "wrote"))
+        assert agent.volumes.ready(vid)
+
+        # removal: task goes away, node unstages, path is gone
+        api2.remove_service(svc.id)
+        poll(lambda: not agent.volumes.ready(vid), timeout=20,
+             msg="volume should unstage after the task is removed")
+        poll(lambda: not os.path.exists(vol_path), timeout=10,
+             msg="published path should be cleaned up")
+        poll(lambda: not api2.get_volume(vid).publish_status, timeout=20,
+             msg="controller-unpublish should complete")
+    finally:
+        agent.stop()
+        m.stop()
