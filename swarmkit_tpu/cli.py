@@ -132,6 +132,10 @@ def _build_parser() -> argparse.ArgumentParser:
     cluster.add_parser("inspect")
     rotate = cluster.add_parser("rotate-token")
     rotate.add_argument("role", choices=["worker", "manager"])
+    cluster.add_parser("rotate-ca")
+    autolock = cluster.add_parser("autolock")
+    autolock.add_argument("mode", choices=["on", "off"])
+    cluster.add_parser("unlock-key")
 
     ext = sub.add_parser("extension").add_subparsers(dest="verb",
                                                      required=True)
@@ -401,6 +405,19 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
                 NodeRole.MANAGER if args.role == "manager"
                 else NodeRole.WORKER)
             return token
+        if args.verb == "rotate-ca":
+            digest = api.rotate_ca()
+            return (f"root CA rotation started (new root {digest}); "
+                    "nodes re-certify as they renew")
+        if args.verb == "autolock":
+            key = api.set_autolock(args.mode == "on")
+            if args.mode == "on":
+                return ("autolock enabled; unlock key (save it, shown "
+                        f"once): {key}")
+            return "autolock disabled"
+        if args.verb == "unlock-key":
+            key = api.get_unlock_key()
+            return key or "autolock is not enabled"
 
     if args.noun == "extension":
         if args.verb == "create":

@@ -885,6 +885,82 @@ class ControlAPI:
         self.store.update(cb)
         return token
 
+    # --------------------------------------------------------------- autolock
+
+    def set_autolock(self, enabled: bool) -> str:
+        """Enable/disable manager autolock (reference:
+        manager.go:116-120 UnlockKey + controlapi cluster update with
+        AutoLockManagers).  Enabling mints an unlock key, stores it in
+        the replicated cluster object (sealed at rest by the raft DEK),
+        and returns it — managers seal their local key material under it
+        and refuse to serve after a restart until unlocked."""
+        import os as _os
+
+        key = _os.urandom(32).hex() if enabled else ""
+
+        def cb(tx):
+            clusters = tx.find(Cluster, ByName("default"))
+            if not clusters:
+                raise NotFound("default cluster not found")
+            cluster = clusters[0].copy()
+            cluster.spec.encryption_config.auto_lock_managers = enabled
+            from ..models.types import EncryptionKey
+            cluster.unlock_keys = (
+                [EncryptionKey(subsystem="manager", key=key.encode())]
+                if enabled else [])
+            tx.update(cluster)
+
+        self.store.update(cb)
+        return key
+
+    def get_unlock_key(self) -> str:
+        """Current unlock key ('' when autolock is off) — operator-only
+        (reference: controlapi GetUnlockKey)."""
+        cluster = self.get_default_cluster()
+        for ek in cluster.unlock_keys:
+            if ek.subsystem == "manager":
+                return ek.key.decode()
+        return ""
+
+    # ------------------------------------------------------------ CA rotation
+
+    def rotate_ca(self) -> str:
+        """Begin a root CA rotation: mint a new root, cross-sign it with
+        the old one, switch issuance to the new key, and persist the
+        rotation state; the manager's reconciler finalizes once every
+        node's cert chains to the new root (reference:
+        controlapi/ca_rotation.go newRootRotationObject +
+        ca/reconciler.go).  Returns the new root's digest."""
+        ca = getattr(self, "root_ca", None)
+        if ca is None:
+            raise APIError("CA rotation requires the manager CA")
+        if ca.rotation is not None:
+            raise FailedPrecondition("a root rotation is already running")
+        new_key, new_cert, cross = ca.begin_rotation()
+
+        def cb(tx):
+            clusters = tx.find(Cluster, ByName("default"))
+            if not clusters:
+                raise NotFound("default cluster not found")
+            cluster = clusters[0].copy()
+            state = cluster.root_ca
+            if state is None:
+                raise FailedPrecondition("cluster has no trust root state")
+            state.root_rotation_in_progress = True
+            state.rotation_ca_key = new_key
+            state.rotation_ca_cert = new_cert
+            state.cross_signed_ca_cert = cross
+            state.last_forced_rotation += 1
+            tx.update(cluster)
+
+        try:
+            self.store.update(cb)
+        except Exception:
+            ca.rotation = None   # roll back the in-memory switch
+            raise
+        from ..security.ca import cert_digest
+        return cert_digest(new_cert)
+
     # ----------------------------------------------------------------- tasks
 
     def get_task(self, task_id: str) -> Task:

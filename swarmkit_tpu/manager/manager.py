@@ -102,6 +102,14 @@ class Manager:
         import queue as _queue
         self._leadership_q: "_queue.Queue" = _queue.Queue()
         self._leadership_worker: Optional[threading.Thread] = None
+        # fires after a root rotation finalizes (swarmd re-keys the WAL)
+        self.on_root_rotated = None
+        self._stop_event = threading.Event()
+        # fires on any cluster-object change (swarmd re-seals state when
+        # the autolock flag/unlock key changes)
+        self.on_cluster_changed = None
+        self._rotation_thread: Optional[threading.Thread] = None
+        self.ca_rotation_check_interval = 1.0
 
     # ------------------------------------------------------------- lifecycle
 
@@ -122,12 +130,28 @@ class Manager:
             self._ca_sub = self.store.queue.subscribe(
                 lambda ev: isinstance(ev, EventSnapshotRestore)
                 or (isinstance(ev, Event) and isinstance(ev.obj, Cluster)))
+            # baseline digest = the root the daemon booted with, so even
+            # the FIRST adoption fires the re-key hook when the replayed
+            # cluster state carries a different (rotated) root
+            self._adopted_root_digest = self.root_ca.digest
             self._adopt_ca_state()
             self._ca_worker = threading.Thread(
                 target=self._ca_adoption_loop, name="ca-adoption",
                 daemon=True)
             self._ca_worker.start()
         self._running = True
+
+    def _restore_root_from_state(self, state) -> None:
+        """Adopt persisted trust-root material incl. any in-progress
+        rotation (single source of truth for both adoption paths)."""
+        self.root_ca.restore(state.ca_key, state.ca_cert)
+        self.root_ca.restore_join_tokens(state.join_tokens)
+        if state.root_rotation_in_progress and state.rotation_ca_key:
+            self.root_ca.restore_rotation(
+                state.rotation_ca_key, state.rotation_ca_cert,
+                state.cross_signed_ca_cert)
+        elif self.root_ca.rotation is not None:
+            self.root_ca.rotation = None
 
     def _adopt_ca_state(self) -> None:
         clusters = self.store.view(
@@ -136,8 +160,19 @@ class Manager:
             return
         state = clusters[0].root_ca
         if state is not None and state.ca_key:
-            self.root_ca.restore(state.ca_key, state.ca_cert)
-            self.root_ca.restore_join_tokens(state.join_tokens)
+            # the baseline is the root the daemon BOOTED with (seeded in
+            # run()): a restart that replays an already-finalized
+            # rotation from the WAL must still re-key local material
+            prev_digest = getattr(self, "_adopted_root_digest", None)
+            self._restore_root_from_state(state)
+            self._adopted_root_digest = self.root_ca.digest
+            if (prev_digest is not None
+                    and prev_digest != self._adopted_root_digest
+                    and self.on_root_rotated is not None):
+                try:
+                    self.on_root_rotated()
+                except Exception:
+                    log.exception("root-rotation hook failed")
 
     def _ca_adoption_loop(self) -> None:
         while self._running:
@@ -153,9 +188,16 @@ class Manager:
                 self._adopt_ca_state()
             except Exception:
                 log.exception("CA state adoption failed")
+            hook = self.on_cluster_changed
+            if hook is not None:
+                try:
+                    hook()
+                except Exception:
+                    log.exception("cluster-change hook failed")
 
     def stop(self) -> None:
         self._running = False
+        self._stop_event.set()
         if getattr(self, "_ca_sub", None) is not None:
             self.store.queue.unsubscribe(self._ca_sub)
             self._ca_sub = None
@@ -216,8 +258,7 @@ class Manager:
                 # would invalidate every issued cert and join token
                 state = existing[0].root_ca
                 if state is not None and state.ca_key:
-                    self.root_ca.restore(state.ca_key, state.ca_cert)
-                    self.root_ca.restore_join_tokens(state.join_tokens)
+                    self._restore_root_from_state(state)
                 return
             cluster = Cluster(
                 id=new_id(),
@@ -281,6 +322,85 @@ class Manager:
                          self.keymanager, self.role_manager,
                          self.csi_manager):
                 loop.start()
+            if self._rotation_thread is None \
+                    or not self._rotation_thread.is_alive():
+                self._rotation_thread = threading.Thread(
+                    target=self._ca_rotation_loop, name="ca-rotation",
+                    daemon=True)
+                self._rotation_thread.start()
+
+    def _ca_rotation_loop(self) -> None:
+        """Root-rotation reconciler (reference: ca/reconciler.go): while
+        a rotation is in progress, wait for every live node's cert to
+        chain to the new root (issuer digests recorded from the agents'
+        TLS identities at heartbeat), then finalize — new root becomes
+        THE root, tokens re-derive, and persisted state flips over."""
+        while self._running and self._is_leader:
+            try:
+                if self.root_ca.rotation is not None:
+                    self._reconcile_ca_rotation()
+            except Exception:
+                log.exception("CA rotation reconciliation failed")
+            self._stop_event.wait(self.ca_rotation_check_interval)
+
+    def _reconcile_ca_rotation(self) -> None:
+        from ..models.types import NodeState
+        target = self.root_ca.active_digest
+        nodes = self.store.view(lambda tx: tx.find(Node))
+        for n in nodes:
+            if n.status.state == NodeState.DOWN:
+                continue   # down nodes cannot renew; operators remove them
+            if n.certificate_issuer != target:
+                return   # still waiting
+        log.info("root CA rotation complete; finalizing")
+        rotation = self.root_ca.rotation
+        if rotation is None:
+            return
+        new_key, new_cert, _ = rotation
+        # persist FIRST, then flip the in-memory root: the CA-adoption
+        # thread may interleave, and it must only ever observe either
+        # the in-progress state or the fully finalized one
+        from ..security.ca import cert_digest
+        new_digest = cert_digest(new_cert)
+
+        def new_token(role: NodeRole) -> str:
+            secret = self.root_ca._token_secrets[role]
+            import base64 as _b64
+            return "-".join([
+                "SWMTKN-1", new_digest,
+                _b64.b32encode(secret).decode("ascii")
+                .strip("=").lower()])
+
+        def cb(tx):
+            clusters = tx.find(Cluster, ByName(DEFAULT_CLUSTER_NAME))
+            if not clusters:
+                return
+            cluster = clusters[0].copy()
+            state = cluster.root_ca
+            if state is None:
+                return
+            state.ca_key = new_key
+            state.ca_cert = new_cert
+            state.rotation_ca_key = b""
+            state.rotation_ca_cert = b""
+            state.cross_signed_ca_cert = b""
+            state.root_rotation_in_progress = False
+            # token digests derive from the root cert: re-mint so the
+            # persisted strings match what role_for_token validates
+            from ..models.types import JoinTokens
+            state.join_tokens = JoinTokens(
+                worker=new_token(NodeRole.WORKER),
+                manager=new_token(NodeRole.MANAGER))
+            tx.update(cluster)
+
+        self.store.update(cb)
+        self.root_ca.finalize_rotation()
+        hook = self.on_root_rotated
+        if hook is not None:
+            try:
+                hook()
+            except Exception:
+                log.exception("root-rotation hook failed")
 
     def manager_api_addrs(self) -> list:
         """Remote-API addresses of all known managers (replicated via

@@ -75,6 +75,10 @@ class Node:
     certificate: Optional[bytes] = None
     role: int = 0               # observed role (reconciled towards spec)
     vxlan_udp_port: int = 0
+    # digest of the root this node's cert chains to, recorded at network
+    # issuance/renewal — drives the CA-rotation reconciler's progress
+    # tracking (reference: ca/reconciler.go node cert states)
+    certificate_issuer: str = ""
 
     def copy(self) -> "Node":
         return Node(
@@ -83,7 +87,8 @@ class Node:
             self.status.copy(),
             dataclasses.replace(self.manager_status) if self.manager_status else None,
             [a.copy() for a in self.attachments],
-            self.certificate, self.role, self.vxlan_udp_port)
+            self.certificate, self.role, self.vxlan_udp_port,
+            self.certificate_issuer)
 
 
 @dataclass
@@ -233,6 +238,9 @@ class RootCAState:
     join_tokens: JoinTokens = field(default_factory=JoinTokens)
     root_rotation_in_progress: bool = False
     last_forced_rotation: int = 0
+    # in-progress rotation target (reference: api.RootRotation)
+    rotation_ca_key: bytes = b""
+    rotation_ca_cert: bytes = b""
 
 
 @dataclass

@@ -318,6 +318,9 @@ class RemoteDispatcherClient:
             # failover layer to feed into its Remotes tracker
             self.last_managers = [tuple(a) for a in
                                   resp.get("managers", [])]
+            # ...and the active root digest, so the renewer reacts to a
+            # CA rotation without waiting for cert half-life
+            self.last_ca_digest = resp.get("ca_digest", "")
             return resp["period"]
         return resp
 
@@ -346,6 +349,13 @@ class RemoteDispatcherClient:
                          session_id: str) -> RemoteAssignmentStream:
         return RemoteAssignmentStream(
             lambda: self._conn._connect(), node_id, session_id)
+
+    def reset_connection(self) -> None:
+        """Next call re-handshakes with the current certificate."""
+        # sync a reassigned identity into the connection: it captured
+        # the Certificate object at construction time
+        self._conn.certificate = self.certificate
+        self._conn.close()
 
     def close(self) -> None:
         self._conn.close()
@@ -479,6 +489,15 @@ class RemoteControlClient:
 
     def get_default_cluster(self):
         return _obj_in(self._call("get_default_cluster"))
+
+    def rotate_ca(self):
+        return self._call("rotate_ca")
+
+    def set_autolock(self, enabled: bool):
+        return self._call("set_autolock", enabled=enabled)
+
+    def get_unlock_key(self):
+        return self._call("get_unlock_key")
 
     def close(self) -> None:
         self._conn.close()

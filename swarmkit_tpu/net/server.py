@@ -192,8 +192,10 @@ class ManagerServer:
         # ---- CA (token-gated, no client cert needed)
         if method == "fetch_root_ca":
             # bootstrap: the joiner verifies this against its token digest
-            # (reference: ca.DownloadRootCA GetRootCACertificate)
-            return {"ca_cert": m.root_ca.cert_pem.decode()}
+            # (reference: ca.DownloadRootCA GetRootCACertificate).  The
+            # bundle carries both roots during a rotation; the token
+            # digest matches the FIRST (current) root.
+            return {"ca_cert": m.root_ca.trust_bundle().decode()}
         if method == "issue_certificate":
             # a follower validates against replicated cluster state; pull
             # the latest adoption synchronously so a token minted on the
@@ -206,13 +208,13 @@ class ManagerServer:
                     params["node_id"], params["token"],
                     csr_pem=csr.encode())
                 return {"cert": cert_pem.decode(),
-                        "ca_cert": m.root_ca.cert_pem.decode()}
+                        "ca_cert": m.root_ca.trust_bundle().decode()}
             # certless legacy path: key generated server-side
             issued = m.ca_server.issue_node_certificate(
                 params["node_id"], params["token"])
             return {"cert": issued.cert_pem.decode(),
                     "key": issued.key_pem.decode(),
-                    "ca_cert": m.root_ca.cert_pem.decode()}
+                    "ca_cert": m.root_ca.trust_bundle().decode()}
         if method == "renew_certificate":
             # gated on the caller's valid cert: same identity + role,
             # fresh validity (reference: ca/renewer.go)
@@ -220,7 +222,7 @@ class ManagerServer:
             cert_pem = m.ca_server.renew(cert,
                                          csr_pem=params["csr"].encode())
             return {"cert": cert_pem.decode(),
-                    "ca_cert": m.root_ca.cert_pem.decode()}
+                    "ca_cert": m.root_ca.trust_bundle().decode()}
 
         # ---- dispatcher surface (cert-gated to the calling node)
         if method == "register":
@@ -236,12 +238,18 @@ class ManagerServer:
                                          description)
             session, period = dispatcher.register(
                 params["node_id"], description=description)
+            self._record_cert_issuer(cert)
             return {"session_id": session, "period": period}
         if method == "heartbeat":
             self._require_cert(cert, params["node_id"])
             period = self._dispatcher().heartbeat(params["node_id"],
                                                   params["session_id"])
-            return {"period": period, "managers": m.manager_api_addrs()}
+            self._record_cert_issuer(cert)
+            # the active root digest rides along so agents renew promptly
+            # when a rotation begins (reference: the session stream ships
+            # the RootCA; ca/renewer reacts)
+            return {"period": period, "managers": m.manager_api_addrs(),
+                    "ca_digest": m.root_ca.active_digest}
         if method == "update_task_status":
             self._require_cert(cert, params["node_id"])
             updates = [(u["task_id"],
@@ -288,6 +296,39 @@ class ManagerServer:
             return self._dispatch_control(api, method[len("control."):],
                                           params)
         raise ValueError(f"unknown method {method!r}")
+
+    def _record_cert_issuer(self, cert: Optional[Certificate]) -> None:
+        """Track which root this node's TLS identity chains to — the
+        CA-rotation reconciler's progress signal (reference:
+        ca/reconciler.go watching node cert states)."""
+        if cert is None:
+            return
+        m = self.manager
+        try:
+            digest = m.root_ca.issuer_digest(cert)
+        except Exception:
+            return
+        if not digest:
+            return
+        from ..models.objects import Node as NodeObject
+        node_id = cert.node_id
+        cur = m.store.raw_get(NodeObject, node_id)
+        if cur is None or cur.certificate_issuer == digest:
+            return
+
+        def cb(tx):
+            n = tx.get(NodeObject, node_id)
+            if n is None or n.certificate_issuer == digest:
+                return
+            n = n.copy()
+            n.certificate = cert.cert_pem
+            n.certificate_issuer = digest
+            tx.update(n)
+
+        try:
+            m.store.update(cb)
+        except Exception:
+            log.debug("recording cert issuer failed", exc_info=True)
 
     def _ensure_node_registered(self, node_id: str, cert: Certificate,
                                 description) -> None:
@@ -422,6 +463,12 @@ class ManagerServer:
             return api.rotate_join_token(params["role"])
         if method == "get_default_cluster":
             return obj_out(api.get_default_cluster())
+        if method == "rotate_ca":
+            return api.rotate_ca()
+        if method == "set_autolock":
+            return api.set_autolock(bool(params["enabled"]))
+        if method == "get_unlock_key":
+            return api.get_unlock_key()
         raise ValueError(f"unknown control method {method!r}")
 
     # ------------------------------------------------------------- streaming

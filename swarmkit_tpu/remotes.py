@@ -207,3 +207,22 @@ class FailoverDispatcherClient:
     def update_volume_status(self, node_id, session_id, updates):
         return self._call("update_volume_status", node_id, session_id,
                           updates)
+
+    @property
+    def last_ca_digest(self) -> str:
+        """Active root digest from the latest heartbeat (drives prompt
+        renewal when a CA rotation begins)."""
+        with self._mu:
+            return getattr(self._client, "last_ca_digest", "") or ""
+
+    def reset_connection(self) -> None:
+        """Drop the live connection so the next call re-handshakes with
+        the (possibly renewed) certificate."""
+        with self._mu:
+            if self._client is not None:
+                try:
+                    self._client.close()
+                except Exception:
+                    pass
+                self._client = None
+                self._current = None

@@ -80,6 +80,36 @@ def cert_digest(cert_pem: bytes) -> str:
     return hashlib.sha256(der).hexdigest()[:32]
 
 
+def split_pem_certs(pem: bytes) -> list:
+    """Individual certificate PEM blocks from a bundle."""
+    marker = b"-----BEGIN CERTIFICATE-----"
+    return [marker + part.split(b"-----END CERTIFICATE-----")[0]
+            + b"-----END CERTIFICATE-----\n"
+            for part in pem.split(marker)[1:]]
+
+
+def signing_root_digest(cert: "Certificate") -> str:
+    """Digest of the root (within the cert's own trust bundle) that
+    signed its leaf — how a node tells whether its identity chains to
+    the root a manager currently advertises ('' when undetermined)."""
+    try:
+        parsed = cert._x509()
+    except Exception:
+        return ""
+    for ca_pem in split_pem_certs(cert.ca_cert_pem):
+        try:
+            ca = x509.load_pem_x509_certificate(ca_pem)
+            if parsed.issuer != ca.subject:
+                continue
+            ca.public_key().verify(
+                parsed.signature, parsed.tbs_certificate_bytes,
+                ec.ECDSA(parsed.signature_hash_algorithm))
+            return cert_digest(ca_pem)
+        except Exception:
+            continue
+    return ""
+
+
 def generate_key_pem() -> bytes:
     key = ec.generate_private_key(ec.SECP256R1())
     return key.private_bytes(
@@ -196,25 +226,37 @@ class RootCA:
             NodeRole.WORKER: os.urandom(16),
             NodeRole.MANAGER: os.urandom(16),
         }
+        # in-progress root rotation (reference: api.RootRotation +
+        # ca/reconciler.go): (new_key_pem, new_cert_pem, cross_signed_pem)
+        self.rotation: Optional[Tuple[bytes, bytes, bytes]] = None
 
-    def _self_sign(self) -> bytes:
+    @staticmethod
+    def _self_sign_root(key, org: str) -> bytes:
+        """Self-signed root with a SubjectKeyIdentifier — rotation keeps
+        the subject name stable, so chains disambiguate issuers by key
+        id, not name."""
         now = time.time()
-        org = _b32(os.urandom(10))   # cluster identity, baked into certs
         name = x509.Name([
             x509.NameAttribute(NameOID.COMMON_NAME, "swarm-ca"),
             x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
         ])
         cert = (x509.CertificateBuilder()
                 .subject_name(name).issuer_name(name)
-                .public_key(self._ca_key.public_key())
+                .public_key(key.public_key())
                 .serial_number(x509.random_serial_number())
                 .not_valid_before(_utc(now - 60))
                 .not_valid_after(_utc(now + ROOT_CA_EXPIRY))
                 .add_extension(x509.BasicConstraints(ca=True,
                                                      path_length=None),
                                critical=True)
-                .sign(self._ca_key, hashes.SHA256()))
+                .add_extension(x509.SubjectKeyIdentifier.from_public_key(
+                    key.public_key()), critical=False)
+                .sign(key, hashes.SHA256()))
         return cert.public_bytes(serialization.Encoding.PEM)
+
+    def _self_sign(self) -> bytes:
+        org = _b32(os.urandom(10))   # cluster identity, baked into certs
+        return self._self_sign_root(self._ca_key, org)
 
     def restore(self, key: bytes, cert: bytes) -> None:
         """Adopt persisted trust-root material (cluster restart)."""
@@ -222,6 +264,94 @@ class RootCA:
         self.cert_pem = cert
         self._ca_key = serialization.load_pem_private_key(key, password=None)
         self._ca_cert = x509.load_pem_x509_certificate(cert)
+
+    # ----------------------------------------------------------- root rotation
+
+    def cross_sign(self, new_cert_pem: bytes) -> bytes:
+        """Old root signs a CA cert carrying the NEW root's subject and
+        public key: nodes that trust only the old root then accept certs
+        chaining through this intermediate (reference:
+        ca/certificates.go CrossSignCACertificate)."""
+        new_cert = x509.load_pem_x509_certificate(new_cert_pem)
+        now_ts = time.time()
+        cross = (x509.CertificateBuilder()
+                 .subject_name(new_cert.subject)
+                 .issuer_name(self._ca_cert.subject)
+                 .public_key(new_cert.public_key())
+                 .serial_number(x509.random_serial_number())
+                 .not_valid_before(_utc(now_ts - 60))
+                 .not_valid_after(new_cert.not_valid_after_utc)
+                 .add_extension(x509.BasicConstraints(ca=True,
+                                                      path_length=None),
+                                critical=True)
+                 .add_extension(x509.SubjectKeyIdentifier.from_public_key(
+                     new_cert.public_key()), critical=False)
+                 .add_extension(
+                     x509.AuthorityKeyIdentifier.from_issuer_public_key(
+                         self._ca_cert.public_key()), critical=False)
+                 .sign(self._ca_key, hashes.SHA256()))
+        return cross.public_bytes(serialization.Encoding.PEM)
+
+    def begin_rotation(self, new_key_pem: Optional[bytes] = None,
+                       new_cert_pem: Optional[bytes] = None
+                       ) -> Tuple[bytes, bytes, bytes]:
+        """Start a root rotation: mint (or adopt) a new root and a
+        cross-signed intermediate.  Issuance immediately switches to the
+        new key; verification accepts both roots until finalize
+        (reference: controlapi/ca_rotation.go newRootRotationObject)."""
+        if new_key_pem is None:
+            new_key_pem = generate_key_pem()
+        if new_cert_pem is None:
+            # same org (cluster identity), fresh root key + serial
+            new_key = serialization.load_pem_private_key(new_key_pem,
+                                                         password=None)
+            new_cert_pem = self._self_sign_root(new_key, self.org)
+        cross = self.cross_sign(new_cert_pem)
+        self.rotation = (new_key_pem, new_cert_pem, cross)
+        return self.rotation
+
+    def restore_rotation(self, new_key_pem: bytes, new_cert_pem: bytes,
+                         cross_pem: bytes) -> None:
+        self.rotation = (new_key_pem, new_cert_pem, cross_pem)
+
+    def finalize_rotation(self) -> None:
+        """The new root becomes THE root; old-root certs stop verifying
+        (the reconciler only finalizes once no node uses them)."""
+        if self.rotation is None:
+            return
+        new_key, new_cert, _ = self.rotation
+        self.rotation = None
+        self.restore(new_key, new_cert)
+
+    @property
+    def active_digest(self) -> str:
+        """Digest of the root nodes should be chaining to — the rotation
+        target while one is in progress."""
+        if self.rotation is not None:
+            return cert_digest(self.rotation[1])
+        return self.digest
+
+    def trust_bundle(self) -> bytes:
+        """PEM bundle clients should trust: both roots during rotation."""
+        if self.rotation is not None:
+            return self.cert_pem + self.rotation[1]
+        return self.cert_pem
+
+    def issuer_digest(self, cert: "Certificate") -> str:
+        """Which root a node cert chains to ('' if neither)."""
+        parsed = cert._x509()
+        for ca_pem in ([self.cert_pem]
+                       + ([self.rotation[1]] if self.rotation else [])):
+            ca = x509.load_pem_x509_certificate(ca_pem)
+            if parsed.issuer == ca.subject:
+                try:
+                    ca.public_key().verify(
+                        parsed.signature, parsed.tbs_certificate_bytes,
+                        ec.ECDSA(parsed.signature_hash_algorithm))
+                    return cert_digest(ca_pem)
+                except Exception:
+                    continue
+        return ""
 
     @property
     def org(self) -> str:
@@ -279,7 +409,18 @@ class RootCA:
 
     def _build_cert(self, node_id: str, role: int, public_key,
                     expiry: Optional[float]) -> bytes:
+        """Node cert under the active signer.  During a rotation the NEW
+        key signs and the cross-signed intermediate travels appended in
+        the PEM bundle, so peers trusting only the old root still verify
+        the chain (reference: ca/certificates.go intermediates)."""
         now = time.time()
+        signing_key, signing_cert, chain = self._ca_key, self._ca_cert, b""
+        if self.rotation is not None:
+            new_key_pem, new_cert_pem, cross = self.rotation
+            signing_key = serialization.load_pem_private_key(
+                new_key_pem, password=None)
+            signing_cert = x509.load_pem_x509_certificate(new_cert_pem)
+            chain = cross
         subject = x509.Name([
             x509.NameAttribute(NameOID.COMMON_NAME, node_id),
             x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME,
@@ -288,7 +429,7 @@ class RootCA:
         ])
         cert = (x509.CertificateBuilder()
                 .subject_name(subject)
-                .issuer_name(self._ca_cert.subject)
+                .issuer_name(signing_cert.subject)
                 .public_key(public_key)
                 .serial_number(x509.random_serial_number())
                 .not_valid_before(_utc(now - 60))
@@ -297,8 +438,11 @@ class RootCA:
                 .add_extension(x509.BasicConstraints(ca=False,
                                                      path_length=None),
                                critical=True)
-                .sign(self._ca_key, hashes.SHA256()))
-        return cert.public_bytes(serialization.Encoding.PEM)
+                .add_extension(
+                    x509.AuthorityKeyIdentifier.from_issuer_public_key(
+                        signing_cert.public_key()), critical=False)
+                .sign(signing_key, hashes.SHA256()))
+        return cert.public_bytes(serialization.Encoding.PEM) + chain
 
     def issue(self, node_id: str, role: int,
               expiry: Optional[float] = None) -> Certificate:
@@ -309,7 +453,7 @@ class RootCA:
         key = serialization.load_pem_private_key(key_pem, password=None)
         cert_pem = self._build_cert(node_id, role, key.public_key(), expiry)
         return Certificate(cert_pem=cert_pem, key_pem=key_pem,
-                           ca_cert_pem=self.cert_pem)
+                           ca_cert_pem=self.trust_bundle())
 
     def sign_csr(self, csr_pem: bytes, node_id: str, role: int,
                  expiry: Optional[float] = None) -> bytes:
@@ -323,14 +467,24 @@ class RootCA:
 
     def verify(self, cert: Certificate) -> None:
         parsed = cert._x509()
-        if parsed.issuer != self._ca_cert.subject:
-            raise InvalidCertificate("certificate from unknown issuer")
-        try:
-            self._ca_cert.public_key().verify(
-                parsed.signature, parsed.tbs_certificate_bytes,
-                ec.ECDSA(parsed.signature_hash_algorithm))
-        except Exception:
-            raise InvalidCertificate("bad certificate signature")
+        roots = [self._ca_cert]
+        if self.rotation is not None:
+            roots.append(x509.load_pem_x509_certificate(self.rotation[1]))
+        ok = False
+        for root in roots:
+            if parsed.issuer != root.subject:
+                continue
+            try:
+                root.public_key().verify(
+                    parsed.signature, parsed.tbs_certificate_bytes,
+                    ec.ECDSA(parsed.signature_hash_algorithm))
+                ok = True
+                break
+            except Exception:
+                continue
+        if not ok:
+            raise InvalidCertificate(
+                "certificate does not chain to a cluster root")
         now = time.time()
         if _ts(parsed.not_valid_after_utc) < now:
             raise InvalidCertificate("certificate expired")

@@ -23,6 +23,7 @@ import hmac as _hmac
 import json
 import os
 import secrets
+import threading
 from typing import List, Optional, Tuple
 
 from .core import Entry, HardState, Snapshot
@@ -53,7 +54,8 @@ class KeyEncoder(Encoder):
 
     MAGIC = b"ENCR1:"
 
-    def __init__(self, dek: bytes, allow_plaintext: bool = False):
+    def __init__(self, dek: bytes, allow_plaintext: bool = False,
+                 fallback: "Optional[KeyEncoder]" = None):
         if not dek:
             raise ValueError("a non-empty data encryption key is required")
         self._enc_key = hashlib.sha256(b"enc" + dek).digest()
@@ -63,6 +65,11 @@ class KeyEncoder(Encoder):
         # otherwise an attacker with state-dir write access could inject
         # unauthenticated plaintext records that replay as raft state.
         self.allow_plaintext = allow_plaintext
+        # decode-only second key: a crash mid-re-key (CA rotation) can
+        # leave snapshot/WAL/state-file under a mix of old and new keys;
+        # the reference's RotateEncryptionKey likewise decrypts with
+        # old-or-new until the snapshot barrier converges
+        self.fallback = fallback
 
     def _stream(self, data: bytes, nonce: bytes) -> bytes:
         out = bytearray()
@@ -92,6 +99,8 @@ class KeyEncoder(Encoder):
         tag, body = data[6:38], data[38:]
         want = _hmac.new(self._mac_key, body, hashlib.sha256).digest()
         if not _hmac.compare_digest(tag, want):
+            if self.fallback is not None:
+                return self.fallback.decode(data)
             raise DecryptionError(
                 "raft log record failed authentication (wrong key or "
                 "corrupted state)")
@@ -105,6 +114,9 @@ class RaftLogger:
         self.state_dir = state_dir
         self.encoder = encoder or Encoder()
         self.fsync = fsync
+        # serializes appends vs snapshot/re-key rewrites: rotate_encoder
+        # runs on reconciler/adoption threads while raft saves on its own
+        self._mu = threading.RLock()
         os.makedirs(state_dir, exist_ok=True)
         self._wal_path = os.path.join(state_dir, "wal.jsonl")
         self._snap_path = os.path.join(state_dir, "snapshot")
@@ -131,6 +143,10 @@ class RaftLogger:
              entries: List[Entry]) -> None:
         """Persist a Ready's durable parts; called before sending/applying
         (reference: raft.go:540 saveToStorage)."""
+        with self._mu:
+            self._save_locked(hard_state, entries)
+
+    def _save_locked(self, hard_state, entries) -> None:
         if hard_state is not None:
             self._write_record({
                 "t": "hs", "term": hard_state.term,
@@ -141,10 +157,7 @@ class RaftLogger:
                 "type": e.type,
                 "data": base64.b64encode(e.data).decode("ascii")})
 
-    def save_snapshot(self, snapshot: Snapshot,
-                      keep_entries_from: int) -> None:
-        """Atomically persist a snapshot and truncate the WAL to entries
-        after ``keep_entries_from`` (reference: storage.go:198)."""
+    def _write_snapshot_file(self, snapshot: Snapshot) -> None:
         tmp = self._snap_path + ".tmp"
         record = json.dumps({
             "index": snapshot.index, "term": snapshot.term,
@@ -163,8 +176,8 @@ class RaftLogger:
                 os.fsync(f.fileno())
         os.replace(tmp, self._snap_path)
 
-        # rewrite the WAL without pre-snapshot entries
-        hs, entries, _ = self._load_wal()
+    def _rewrite_wal(self, hs: Optional[HardState], entries: List[Entry],
+                     keep_entries_from: int) -> None:
         if self._wal is not None:
             self._wal.close()
             self._wal = None
@@ -183,6 +196,28 @@ class RaftLogger:
                         "data": base64.b64encode(e.data).decode("ascii")})
             self._wal = None
         os.replace(wal_tmp, self._wal_path)
+
+    def save_snapshot(self, snapshot: Snapshot,
+                      keep_entries_from: int) -> None:
+        """Atomically persist a snapshot and truncate the WAL to entries
+        after ``keep_entries_from`` (reference: storage.go:198)."""
+        with self._mu:
+            self._write_snapshot_file(snapshot)
+            # rewrite the WAL without pre-snapshot entries
+            hs, entries, _ = self._load_wal()
+            self._rewrite_wal(hs, entries, keep_entries_from)
+
+    def rotate_encoder(self, new_encoder: Encoder) -> None:
+        """Re-encrypt all persisted raft state under a new key: decode
+        with the old encoder, swap, rewrite snapshot + WAL (reference:
+        storage.go:175 RotateEncryptionKey + its snapshot barrier)."""
+        with self._mu:
+            hs, entries, _ = self._load_wal()   # decoded with the OLD key
+            snapshot = self.load_snapshot()
+            self.encoder = new_encoder
+            if snapshot is not None:
+                self._write_snapshot_file(snapshot)
+            self._rewrite_wal(hs, entries, keep_entries_from=0)
 
     # ----------------------------------------------------------------- read
 

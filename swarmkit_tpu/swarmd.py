@@ -28,6 +28,11 @@ from typing import Optional, Tuple
 log = logging.getLogger("swarmd")
 
 
+class ManagerLockedError(Exception):
+    """The manager's key material is sealed under an unlock key the
+    daemon was not given (reference: autolock, manager.go:116-120)."""
+
+
 def parse_addr(text: str) -> Tuple[str, int]:
     host, _, port = text.rpartition(":")
     try:
@@ -48,7 +53,8 @@ class Swarmd:
                  executor=None,
                  use_device_scheduler: bool = True,
                  migrate_plaintext_wal: bool = False,
-                 cert_renew_interval: float = 60.0):
+                 cert_renew_interval: float = 60.0,
+                 unlock_key: str = ""):
         import os
 
         from .agent.testutils import TestExecutor
@@ -75,6 +81,10 @@ class Swarmd:
         # how often the renewer thread re-checks cert lifetime (the
         # renewal itself triggers past half of validity)
         self.cert_renew_interval = cert_renew_interval
+        # operator-held unlock key (autolock): required to open a sealed
+        # manager state dir; '' means not provided
+        self.unlock_key = unlock_key
+        self.locked = False
         self._stop_event = threading.Event()
         self.manager = None
         self.server = None
@@ -97,9 +107,16 @@ class Swarmd:
             # manager.go:217 becomes the raft founder).  A restart reuses
             # the persisted CA key + raft listen port: peers know us by
             # that address, and the transport HMAC key must match theirs.
-            state = self._load_manager_state()
+            try:
+                state = self._load_manager_state()
+            except ManagerLockedError as e:
+                # autolock: refuse to serve anything until unlock()
+                self.locked = True
+                log.warning("manager locked: %s", e)
+                return
             ca = (RootCA(state["ca_key"], state["ca_cert"])
                   if state else RootCA())
+            self._prev_ca_key = state.get("prev_ca_key") if state else None
             raft_port = state["raft_port"] if state else 0
             api_port = state["api_port"] if state else 0
             self._build_raft_manager(ca, raft_port=raft_port)
@@ -189,9 +206,17 @@ class Swarmd:
 
         def loop():
             from .net.client import renew_certificate
+            from .security.ca import signing_root_digest
             while not self._stop_event.wait(self.cert_renew_interval):
                 cert = self.node.certificate
-                if cert is None or not needs_renewal(cert):
+                if cert is None:
+                    continue
+                # renew at half-life, or immediately when the managers
+                # advertise a different root (CA rotation in progress)
+                advertised = getattr(client, "last_ca_digest", "") or ""
+                rotated = (advertised
+                           and advertised != signing_root_digest(cert))
+                if not needs_renewal(cert) and not rotated:
                     continue
                 targets = list(self.remotes.weights()) + [self.join_addr]
                 for addr in targets:
@@ -203,8 +228,14 @@ class Swarmd:
                     self.node.key_rw.write(fresh, b"")
                     self.node.certificate = fresh
                     # future connections present the fresh cert (the
-                    # factory closes over client.certificate)
+                    # factory closes over client.certificate); drop the
+                    # live connection so the next heartbeat handshakes
+                    # with the new identity (the leader records its
+                    # issuer for rotation progress)
                     client.certificate = fresh
+                    reset = getattr(client, "reset_connection", None)
+                    if reset is not None:
+                        reset()
                     log.info("renewed certificate for %s (expires %.0f)",
                              fresh.node_id[:8], fresh.expires_at)
                     break
@@ -220,6 +251,12 @@ class Swarmd:
         from .models.types import NodeRole
         from .security.ca import needs_renewal
 
+        from .security.ca import signing_root_digest
+
+        def stale(ca, ident) -> bool:
+            return (needs_renewal(ident)
+                    or signing_root_digest(ident) != ca.active_digest)
+
         def loop():
             while not self._stop_event.wait(self.cert_renew_interval):
                 mgr = self.manager
@@ -228,16 +265,34 @@ class Swarmd:
                 ca = mgr.root_ca
                 t = self.raft_transport
                 if (t is not None and t.tls_identity is not None
-                        and needs_renewal(t.tls_identity)):
+                        and stale(ca, t.tls_identity)):
                     t.set_identity(ca.issue(t.node_id, NodeRole.MANAGER))
                     log.info("renewed raft TLS identity for %s",
                              t.node_id)
                 s = self.server
                 if (s is not None and getattr(s, "tls_identity", None)
-                        is not None and needs_renewal(s.tls_identity)):
+                        is not None and stale(ca, s.tls_identity)):
                     s.set_tls_identity(ca.issue(
                         s.tls_identity.node_id, NodeRole.MANAGER))
                     log.info("renewed API TLS identity")
+                # this manager's own agent identity: local re-issue from
+                # the CA we hold (managers never CSR themselves)
+                node = self.node
+                if (node is not None and node.certificate is not None
+                        and stale(ca, node.certificate)):
+                    fresh = ca.issue(node.certificate.node_id,
+                                     NodeRole(node.certificate.role))
+                    node.key_rw.write(fresh, b"")
+                    node.certificate = fresh
+                    agent = node.agent
+                    cli = agent.client if agent is not None else None
+                    if cli is not None and hasattr(cli, "certificate"):
+                        cli.certificate = fresh
+                        reset = getattr(cli, "reset_connection", None)
+                        if reset is not None:
+                            reset()
+                    log.info("renewed manager-agent identity for %s",
+                             fresh.node_id)
 
         threading.Thread(target=loop, name="manager-identity-renewer",
                          daemon=True).start()
@@ -284,9 +339,15 @@ class Swarmd:
         from .security import RootCA
 
         raft_id = "m-" + self.hostname
-        state = self._load_manager_state()
+        try:
+            state = self._load_manager_state()
+        except ManagerLockedError as e:
+            self.locked = True
+            log.warning("manager locked: %s", e)
+            return
         if state is not None:
             # restart: peers + addresses replay from the raft WAL
+            self._prev_ca_key = state.get("prev_ca_key")
             self._build_raft_manager(
                 RootCA(state["ca_key"], state["ca_cert"]),
                 raft_port=state["raft_port"])
@@ -451,21 +512,65 @@ class Swarmd:
             raft_id, port=raft_port, auth_key=ca.key,
             tls_identity=ca.issue(raft_id, NodeRole.MANAGER))
         store = MemoryStore()
-        self.raft_node = RaftNode(
-            raft_id, [raft_id], store,
-            RaftLogger(os.path.join(self.state_dir, "raft"),
-                       encoder=KeyEncoder(
-                           ca.key,
-                           allow_plaintext=self.migrate_plaintext_wal)),
-            self.raft_transport)
+        prev_key = getattr(self, "_prev_ca_key", None)
+        encoder = KeyEncoder(
+            ca.key, allow_plaintext=self.migrate_plaintext_wal,
+            fallback=KeyEncoder(prev_key) if prev_key else None)
+        logger = RaftLogger(os.path.join(self.state_dir, "raft"),
+                            encoder=encoder)
+        if prev_key:
+            # a crash interrupted the rotation re-key: converge all
+            # on-disk state to the current key now (decode via fallback)
+            logger.rotate_encoder(KeyEncoder(
+                ca.key, allow_plaintext=self.migrate_plaintext_wal))
+            self._prev_ca_key = None
+        self.raft_node = RaftNode(raft_id, [raft_id], store, logger,
+                                  self.raft_transport)
         store._proposer = self.raft_node
         self.manager = Manager(
             store=store, raft_node=self.raft_node, root_ca=ca,
             use_device_scheduler=self.use_device_scheduler)
         self.manager.raft_peer_addrs[raft_id] = self.raft_transport.addr
+        # after a root rotation finalizes (or is adopted from the leader),
+        # everything keyed off the CA key must re-key: the encrypted
+        # WAL/snapshots, the transport HMAC fallback, persisted state
+        self.manager.on_root_rotated = self._on_root_rotated
+        self.manager.on_cluster_changed = self._resave_manager_state
         if not defer_start:
             self.raft_node.start()
             self.manager.run()
+
+    def _resave_manager_state(self) -> None:
+        """Cluster changed (possibly the autolock flag / unlock key):
+        re-persist local state so sealing matches the cluster's will."""
+        if self.manager is None or self.raft_transport is None:
+            return
+        try:
+            self._save_manager_state()
+        except Exception:
+            log.exception("re-sealing manager state failed")
+
+    def _on_root_rotated(self) -> None:
+        """Re-key local material derived from the CA key after a root
+        rotation (reference: manager re-encrypts the raft DEK under the
+        new KEK, manager/deks.go + storage.go RotateEncryptionKey).
+
+        Crash-safe ordering: (1) persist the state file carrying BOTH
+        keys, (2) re-encrypt snapshot+WAL under the new key, (3) persist
+        again without the old key.  A crash at any point leaves a state
+        file whose key (plus optional prev key fallback) can decode
+        everything on disk."""
+        from .state.raft import KeyEncoder
+        ca = self.manager.root_ca
+        old_key = self.raft_transport.auth_key
+        try:
+            self._save_manager_state(prev_key=old_key)
+            self.raft_node.logger.rotate_encoder(KeyEncoder(ca.key))
+            self._save_manager_state()
+        except Exception:
+            log.exception("WAL re-key after CA rotation failed")
+        self.raft_transport.auth_key = ca.key
+        log.info("re-keyed raft storage under the rotated root CA")
 
     def _start_remote_api(self, port_override: int = 0) -> None:
         from .net import ManagerServer
@@ -484,13 +589,31 @@ class Swarmd:
     def _load_manager_state(self):
         import json
         try:
-            with open(self._manager_state_path()) as f:
-                rec = json.load(f)
+            with open(self._manager_state_path(), "rb") as f:
+                raw = f.read()
         except FileNotFoundError:
             return None
+        if raw.startswith(b"LOCK1"):
+            # sealed under the operator's unlock key (autolock)
+            from .state.raft.storage import DecryptionError, KeyEncoder
+            if not self.unlock_key:
+                raise ManagerLockedError(
+                    "manager state is locked; provide the unlock key")
+            try:
+                raw = KeyEncoder(self.unlock_key.encode()).decode(raw[5:])
+            except DecryptionError:
+                raise ManagerLockedError("invalid unlock key")
+        try:
+            rec = json.loads(raw)
+        except ValueError as e:
+            raise RuntimeError(
+                f"manager state file {self._manager_state_path()!r} is "
+                f"unreadable ({e})") from e
         try:
             return {"ca_key": bytes.fromhex(rec["ca_key"]),
                     "ca_cert": bytes.fromhex(rec["ca_cert"]),
+                    "prev_ca_key": bytes.fromhex(rec["prev_ca_key"])
+                    if rec.get("prev_ca_key") else None,
                     "raft_port": rec["raft_port"],
                     "api_port": rec.get("api_port", 0)}
         except (KeyError, ValueError, TypeError) as e:
@@ -502,7 +625,8 @@ class Swarmd:
                 f"unreadable or from an incompatible version ({e}); "
                 "remove it to bootstrap a new cluster") from e
 
-    def _save_manager_state(self) -> None:
+    def _save_manager_state(self, prev_key: Optional[bytes] = None
+                            ) -> None:
         """Persist what a restart cannot recover from the WAL: the CA
         key that authenticates the raft transport (the reference keeps CA
         material in the state dir too, node.go loadSecurityConfig) and our
@@ -511,18 +635,53 @@ class Swarmd:
         import os
 
         os.makedirs(self.state_dir, exist_ok=True)
+        payload = json.dumps({
+            "ca_key": self.manager.root_ca.key.hex(),
+            "ca_cert": self.manager.root_ca.cert_pem.hex(),
+            # present only mid-re-key: decode fallback for a crash
+            # between the WAL rewrite and this file converging
+            "prev_ca_key": prev_key.hex() if prev_key else "",
+            "raft_port": self.raft_transport.addr[1],
+            # the API port must survive restarts too: it replicated
+            # to the whole cluster via the join conf entry, and a
+            # follower cannot re-propose a changed address
+            "api_port": self.server.addr[1] if self.server else 0,
+        }).encode()
+        key = self._autolock_key()
+        if key:
+            # autolock: the CA key (root of every trust + encryption
+            # chain) only hits disk sealed under the operator's unlock
+            # key (reference: manager/deks.go KEK over the DEK)
+            from .state.raft.storage import KeyEncoder
+            payload = b"LOCK1" + KeyEncoder(key).encode(payload)
         tmp = self._manager_state_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({
-                "ca_key": self.manager.root_ca.key.hex(),
-                "ca_cert": self.manager.root_ca.cert_pem.hex(),
-                "raft_port": self.raft_transport.addr[1],
-                # the API port must survive restarts too: it replicated
-                # to the whole cluster via the join conf entry, and a
-                # follower cannot re-propose a changed address
-                "api_port": self.server.addr[1] if self.server else 0,
-            }, f)
+        with open(tmp, "wb") as f:
+            f.write(payload)
         os.replace(tmp, self._manager_state_path())
+
+    def _autolock_key(self):
+        """The cluster's manager unlock key when autolock is enabled
+        (bytes), else None."""
+        try:
+            cluster = self.manager.control_api.get_default_cluster()
+        except Exception:
+            return None
+        if not cluster.spec.encryption_config.auto_lock_managers:
+            return None
+        for ek in cluster.unlock_keys:
+            if ek.subsystem == "manager" and ek.key:
+                return ek.key
+        return None
+
+    def unlock(self, key: str) -> None:
+        """Unseal a locked manager and complete startup (reference:
+        swarm unlock)."""
+        if not self.locked:
+            return
+        self.unlock_key = key
+        self._load_manager_state()   # raises ManagerLockedError if wrong
+        self.locked = False
+        self.start()
 
     def stop(self) -> None:
         self._stop_event.set()
@@ -552,6 +711,9 @@ def main(argv=None) -> int:   # pragma: no cover - thin CLI shell
     parser.add_argument("--migrate-plaintext-wal", action="store_true",
                         help="one-time replay of a state dir written "
                              "before WAL encryption existed")
+    parser.add_argument("--unlock-key", default="",
+                        help="unlock key for an autolocked manager "
+                             "state dir")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO,
@@ -565,7 +727,8 @@ def main(argv=None) -> int:   # pragma: no cover - thin CLI shell
         join_token=args.join_token,
         executor=args.executor,
         use_device_scheduler=not args.no_device_scheduler,
-        migrate_plaintext_wal=args.migrate_plaintext_wal)
+        migrate_plaintext_wal=args.migrate_plaintext_wal,
+        unlock_key=args.unlock_key)
     daemon.start()
     try:
         while True:

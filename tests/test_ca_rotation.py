@@ -1,0 +1,162 @@
+"""Root CA rotation + autolock against live daemons.
+
+Reference: ca/reconciler.go (cross-signed root rotation),
+controlapi/ca_rotation.go, manager.go:116-120 autolock/UnlockKey.
+"""
+
+import tempfile
+import time
+
+import pytest
+
+from swarmkit_tpu.models import Cluster, TaskState
+from swarmkit_tpu.models.types import NodeRole
+from swarmkit_tpu.net import RemoteControlClient, issue_certificate
+from swarmkit_tpu.security.ca import cert_digest, signing_root_digest
+from swarmkit_tpu.state.store import ByName
+from swarmkit_tpu.swarmd import ManagerLockedError, Swarmd
+
+from test_orchestrator import make_replicated, poll
+
+
+def test_rootca_rotation_unit():
+    """Cross-sign + dual-trust issuance semantics on the RootCA itself."""
+    from swarmkit_tpu.security import RootCA
+
+    ca = RootCA()
+    old_digest = ca.digest
+    pre_cert = ca.issue("old-node", NodeRole.WORKER)
+
+    ca.begin_rotation()
+    assert ca.active_digest != old_digest
+    assert ca.digest == old_digest          # tokens stay on the old root
+
+    # new issuance signs with the new key + ships the cross-signed chain
+    mid_cert = ca.issue("mid-node", NodeRole.WORKER)
+    assert signing_root_digest(mid_cert) == ca.active_digest
+    assert len(ca.trust_bundle().split(b"-----BEGIN")) - 1 == 2
+    # both old- and new-root certs verify during rotation
+    ca.verify(pre_cert)
+    ca.verify(mid_cert)
+    assert ca.issuer_digest(pre_cert) == old_digest
+    assert ca.issuer_digest(mid_cert) == ca.active_digest
+
+    ca.finalize_rotation()
+    assert ca.digest != old_digest
+    ca.verify(mid_cert)
+    with pytest.raises(Exception):
+        ca.verify(pre_cert)   # old-root certs die with the old root
+
+
+def test_ca_rotation_live_cluster_no_task_disruption():
+    """Rotate the root on a live 2-manager + 1-worker cluster: nodes
+    re-certify via their renewers, the reconciler finalizes, tokens
+    re-derive from the new root, and running tasks never restart."""
+    m0 = Swarmd(state_dir=tempfile.mkdtemp(), hostname="m0",
+                manager=True, listen_remote_api=("127.0.0.1", 0),
+                use_device_scheduler=False, cert_renew_interval=0.3)
+    m0.start()
+    m0.manager.ca_rotation_check_interval = 0.3
+    mtoken = m0.manager.root_ca.join_token(NodeRole.MANAGER)
+    m1 = Swarmd(state_dir=tempfile.mkdtemp(), hostname="m1",
+                manager=True, join_addr=m0.server.addr, join_token=mtoken,
+                listen_remote_api=("127.0.0.1", 0),
+                use_device_scheduler=False, cert_renew_interval=0.3)
+    m1.start()
+    w = Swarmd(state_dir=tempfile.mkdtemp(), hostname="w0",
+               join_addr=m0.server.addr,
+               join_token=m0.manager.root_ca.join_token(NodeRole.WORKER),
+               cert_renew_interval=0.3)
+    w.start()
+    try:
+        op = issue_certificate(m0.server.addr, "op", mtoken)
+        ctl = RemoteControlClient(m0.server.addr, op)
+        svc = ctl.create_service(make_replicated("web", 3).spec)
+
+        def running_ids():
+            ts = [t for t in ctl.list_tasks(service_id=svc.id)
+                  if t.desired_state == TaskState.RUNNING
+                  and t.status.state == TaskState.RUNNING]
+            return sorted(t.id for t in ts) if len(ts) == 3 else None
+        poll(running_ids, timeout=40, msg="3 replicas running")
+        before = running_ids()
+
+        old_digest = m0.manager.root_ca.digest
+        new_digest = ctl.rotate_ca()
+        assert new_digest != old_digest
+
+        def finalized():
+            cluster = m0.manager.store.view(
+                lambda tx: tx.find(Cluster, ByName("default")))[0]
+            return (not cluster.root_ca.root_rotation_in_progress
+                    and m0.manager.root_ca.digest == new_digest)
+        poll(finalized, timeout=60,
+             msg="rotation should finalize once all nodes re-certify")
+
+        # zero task disruption: identical task ids still RUNNING
+        assert running_ids() == before
+
+        # the worker's live identity now chains to the new root
+        poll(lambda: signing_root_digest(w.node.certificate)
+             == new_digest, timeout=20,
+             msg="worker cert should chain to the new root")
+
+        # tokens re-derive: a brand-new worker joins with the NEW token
+        new_token = m0.manager.root_ca.join_token(NodeRole.WORKER)
+        fresh = issue_certificate(m0.server.addr, "late-joiner",
+                                  new_token)
+        assert signing_root_digest(fresh) == new_digest
+        # the API keeps serving under the rotated root
+        assert len(ctl.list_nodes()) >= 3
+        ctl.close()
+    finally:
+        w.stop()
+        m1.stop()
+        m0.stop()
+
+
+def test_autolock_manager_refuses_until_unlocked():
+    """Autolocked manager state: a restart cannot serve (or even read
+    its CA material) until the operator supplies the unlock key."""
+    state_dir = tempfile.mkdtemp()
+    m0 = Swarmd(state_dir=state_dir, hostname="m0", manager=True,
+                listen_remote_api=("127.0.0.1", 0),
+                use_device_scheduler=False)
+    m0.start()
+    api = m0.manager.control_api
+    from swarmkit_tpu.cli import run_command
+    out = run_command(["cluster", "autolock", "on"], api)
+    key = out.rsplit(" ", 1)[-1]
+    assert len(key) == 64
+    assert run_command(["cluster", "unlock-key"], api) == key
+    svc = api.create_service(make_replicated("locked-web", 1).spec)
+    poll(lambda: [t for t in api.list_tasks(service_id=svc.id)
+                  if t.status.state == TaskState.RUNNING], timeout=30)
+    # the re-seal hook fires on the cluster update; give it a beat
+    poll(lambda: open(m0._manager_state_path(), "rb").read()
+         .startswith(b"LOCK1"), timeout=10,
+         msg="state file should be sealed after autolock on")
+    m0.stop()
+
+    # restart without the key: locked, serving nothing
+    m1 = Swarmd(state_dir=state_dir, hostname="m0", manager=True,
+                listen_remote_api=("127.0.0.1", 0),
+                use_device_scheduler=False)
+    m1.start()
+    assert m1.locked
+    assert m1.manager is None and m1.server is None
+
+    # wrong key rejected
+    with pytest.raises(ManagerLockedError):
+        m1.unlock("00" * 32)
+    assert m1.locked
+
+    # right key: unseals, serves, state intact
+    m1.unlock(key)
+    assert not m1.locked
+    poll(lambda: m1.manager is not None and m1.manager.is_leader,
+         timeout=30, msg="unlocked manager should lead again")
+    names = [s.spec.annotations.name
+             for s in m1.manager.control_api.list_services()]
+    assert "locked-web" in names
+    m1.stop()
