@@ -1,11 +1,15 @@
 """Watch API: an external event-stream surface over the store's queue.
 
-Reference: manager/watchapi/watch.go:16.
+Reference: manager/watchapi/watch.go:16 (Watch) and :32 (WatchFrom).
 
 Clients subscribe with per-kind/action/field filters and receive committed
-change events; ``include_old_object`` mirrors the reference's option, and a
-``resume_from_version`` replays nothing (like the reference, resume needs
-the raft log — ChangesBetween) but fails explicitly instead of silently.
+change events; ``include_old_object`` mirrors the reference's option.
+``resume_from_version`` replays every change committed after that store
+version (backed by the store's changelog ring, the analogue of the
+reference's raft-log ChangesBetween, raft.go:1617) before going live; a
+version older than the retained window raises — the caller must re-list
+and watch from the current version, exactly like the reference when the
+raft log was compacted.
 """
 
 from __future__ import annotations
@@ -25,6 +29,8 @@ class WatchRequest:
     id_prefix: str = ""
     name_prefix: str = ""
     include_old_object: bool = False
+    # store version to resume from (0/None = live-only, no replay)
+    resume_from_version: Optional[int] = None
 
 
 @dataclass
@@ -32,6 +38,11 @@ class WatchEvent:
     action: str
     obj: Any
     old: Optional[Any] = None
+
+
+class ResumeCompacted(Exception):
+    """The requested resume version is older than the retained changelog;
+    re-list and watch from the current version."""
 
 
 class WatchServer:
@@ -59,19 +70,33 @@ class WatchServer:
                     return False
             return True
 
-        sub = self.store.queue.subscribe(pred)
-        return WatchStream(self, sub, request.include_old_object)
+        if request.resume_from_version is not None:
+            from ..state.store import InvalidStoreAction
+            try:
+                replay, sub = self.store.watch_from(
+                    request.resume_from_version, pred)
+            except InvalidStoreAction as e:
+                raise ResumeCompacted(str(e))
+            replay = [ev for ev in replay if pred(ev)]
+        else:
+            replay = []
+            sub = self.store.queue.subscribe(pred)
+        return WatchStream(self, sub, request.include_old_object, replay)
 
 
 class WatchStream:
     def __init__(self, server: WatchServer, sub: Subscription,
-                 include_old: bool):
+                 include_old: bool, replay: Optional[List[Event]] = None):
         self._server = server
         self._sub = sub
         self._include_old = include_old
+        self._replay = list(replay or [])
 
     def get(self, timeout: Optional[float] = None) -> WatchEvent:
-        ev = self._sub.get(timeout=timeout)
+        if self._replay:
+            ev = self._replay.pop(0)
+        else:
+            ev = self._sub.get(timeout=timeout)
         return WatchEvent(ev.action, ev.obj,
                           ev.old if self._include_old else None)
 

@@ -58,7 +58,8 @@ class RaftNode(Proposer):
     def __init__(self, node_id: str, peers: Sequence[str],
                  store: MemoryStore, logger: RaftLogger, transport,
                  snapshot_interval: int = 1000,
-                 on_leadership: Optional[Callable[[bool], None]] = None):
+                 on_leadership: Optional[Callable[[bool], None]] = None,
+                 force_new_cluster: bool = False):
         self.id = node_id
         self.store = store
         self.logger = logger
@@ -90,6 +91,46 @@ class RaftNode(Proposer):
                 break
             self._apply_entry(e, replay=True)
             self.core.applied_index = e.index
+
+        if force_new_cluster:
+            # quorum-loss recovery (reference: manager.go:99-101
+            # --force-new-cluster): keep the replayed store state but
+            # collapse membership to this node alone, then snapshot so a
+            # later restart cannot resurrect the dead peers from old
+            # conf entries
+            log.warning("force-new-cluster: collapsing membership "
+                        "%s -> {%s}", sorted(self.core.peers), node_id)
+            self.core.peers = {node_id}
+            self.core.peer_addrs = {
+                k: v for k, v in self.core.peer_addrs.items()
+                if k == node_id}
+            self.core.api_addrs = {
+                k: v for k, v in self.core.api_addrs.items()
+                if k == node_id}
+            self.core.removed = False
+            # drop uncommitted tail entries: as a sole leader this node
+            # would otherwise commit them next term, potentially
+            # re-adding the dead peers via stale conf changes
+            self.core.log = [e for e in self.core.log
+                             if e.index <= self.core.commit_index]
+            index = self.core.applied_index
+            snap = Snapshot(
+                index=index, term=self.core._term_at(index) or 0,
+                data=self.store.save_bytes(),
+                peers=sorted(self.core.peers),
+                peer_addrs=dict(self.core.peer_addrs),
+                api_addrs=dict(self.core.api_addrs))
+            self.logger.save_snapshot(snap, index)
+            # save_snapshot rewrites the WAL from DISK, which still
+            # carries the dropped tail; force the on-disk log to match
+            # the truncated in-memory one or a crash-before-next-append
+            # restart would resurrect the stale conf entries
+            from .core import HardState as _HS
+            self.logger.rewrite(
+                _HS(term=self.core.term, voted_for=self.core.voted_for,
+                    commit=self.core.commit_index),
+                self.core.log, keep_entries_from=index)
+            self.core.compact(index, snap.term)
 
         self._sync_transport_from_core()
         transport.register(node_id, self._inbox.put)

@@ -207,6 +207,14 @@ class RaftLogger:
             hs, entries, _ = self._load_wal()
             self._rewrite_wal(hs, entries, keep_entries_from)
 
+    def rewrite(self, hard_state: Optional[HardState],
+                entries: List[Entry], keep_entries_from: int = 0) -> None:
+        """Replace the on-disk WAL with exactly these records (used by
+        force-new-cluster to drop a stale uncommitted tail that the
+        snapshot rewrite would otherwise preserve)."""
+        with self._mu:
+            self._rewrite_wal(hard_state, entries, keep_entries_from)
+
     def rotate_encoder(self, new_encoder: Encoder) -> None:
         """Re-encrypt all persisted raft state under a new key: decode
         with the old encoder, swap, rewrite snapshot + WAL (reference:

@@ -278,6 +278,21 @@ def _service_network_ids(s: Service) -> Iterable[str]:
         yield n.target
 
 
+def _materialize_task(old: Task, node_id: str, version: int, ts: float,
+                      state, message: str) -> Task:
+    """Build the assigned form of a block-committed task from its
+    pre-assignment object + overlay tuple — single recipe shared by lazy
+    materialization and changelog replay."""
+    from ..models.types import TaskState, TaskStatus
+    new = old.copy()
+    new.node_id = node_id
+    new.status = TaskStatus(state=TaskState(state), timestamp=ts,
+                            message=message)
+    new.meta.version.index = version
+    new.meta.updated_at = ts
+    return new
+
+
 def _obj_name(obj: Any) -> str:
     spec = getattr(obj, "spec", None)
     ann = getattr(spec, "annotations", None) or getattr(obj, "annotations", None)
@@ -455,6 +470,15 @@ class MemoryStore:
         self._proposer = proposer
         self._version = 0
         self.queue = Queue()
+        # bounded changelog ring for watch-from-version resume
+        # (reference: raft.go:1617 ChangesBetween over the raft log).
+        # Entries: ("one", version, action, obj, old) or a columnar
+        # ("block", base_version, olds, node_ids, state, message, ts)
+        # from commit_task_block, expanded lazily on replay.
+        from collections import deque
+        self._changelog: deque = deque()
+        self._changelog_total = 0
+        self.changelog_limit = 8192   # changes retained for resume
 
     # ------------------------------------------------------------------ reads
 
@@ -480,13 +504,7 @@ class MemoryStore:
         if entry is None or old is None:
             return old
         node_id, version, ts, state, message = entry
-        from ..models.types import TaskState, TaskStatus
-        new = old.copy()
-        new.node_id = node_id
-        new.status = TaskStatus(state=TaskState(state), timestamp=ts,
-                                message=message)
-        new.meta.version.index = version
-        new.meta.updated_at = ts
+        new = _materialize_task(old, node_id, version, ts, state, message)
         table.objects[tid] = new
         return new
 
@@ -571,13 +589,81 @@ class MemoryStore:
             tx.closed = True
             return
         with self._lock:
-            for change in tx._changes:
+            for change, ev in zip(tx._changes, tx._events):
                 self._version += 1   # versions pre-stamped in update()
                 self._apply_locked(change)
+                self._log_change_locked(
+                    ("one", self._version, ev.action, ev.obj, ev.old), 1)
         tx.closed = True
         for ev in tx._events:
             self.queue.publish(ev)
         self.queue.publish(EventCommit(self._version))
+
+    # -------------------------------------------------- changelog (resume)
+
+    def _log_change_locked(self, entry: tuple, count: int) -> None:
+        self._changelog.append(entry)
+        self._changelog_total += count
+        while self._changelog_total > self.changelog_limit \
+                and len(self._changelog) > 1:
+            dropped = self._changelog.popleft()
+            self._changelog_total -= (1 if dropped[0] == "one"
+                                      else len(dropped[2]))
+
+    def _entry_version_range(self, entry: tuple) -> Tuple[int, int]:
+        if entry[0] == "one":
+            return entry[1], entry[1]
+        _, base, olds, *_ = entry
+        return base + 1, base + len(olds)
+
+    def changes_between(self, from_version: int) -> List[Event]:
+        """Events for every change with version > ``from_version``, in
+        commit order (reference: raft.go:1617 ChangesBetween).  Raises
+        InvalidStoreAction when that range was compacted out of the
+        changelog (snapshot install / ring overflow) — resuming callers
+        must re-list instead."""
+        with self._lock:
+            if from_version > self._version:
+                raise InvalidStoreAction(
+                    f"version {from_version} is in the future "
+                    f"(store at {self._version})")
+            if from_version == self._version:
+                return []
+            entries = list(self._changelog)
+        if not entries or \
+                self._entry_version_range(entries[0])[0] > from_version + 1:
+            raise InvalidStoreAction(
+                f"changes since version {from_version} were compacted; "
+                "re-list and watch from the current version")
+        out: List[Event] = []
+        for entry in entries:
+            lo, hi = self._entry_version_range(entry)
+            if hi <= from_version:
+                continue
+            if entry[0] == "one":
+                out.append(Event(entry[2], entry[3], entry[4]))
+                continue
+            _, base, olds, node_ids, state, message, ts = entry
+            for i, old in enumerate(olds):
+                ver = base + 1 + i
+                if ver <= from_version:
+                    continue
+                out.append(Event(
+                    "update",
+                    _materialize_task(old, node_ids[i], ver, ts, state,
+                                      message),
+                    old))
+        return out
+
+    def watch_from(self, from_version: int, predicate=None
+                   ) -> Tuple[List[Event], "Subscription"]:
+        """Atomically: events missed since ``from_version`` plus a live
+        subscription from the current version (reference:
+        watchapi/watch.go:32 WatchFrom)."""
+        with self._update_lock:
+            replay = self.changes_between(from_version)
+            sub = self.queue.subscribe(predicate)
+        return replay, sub
 
     def _apply_locked(self, change: StoreAction) -> None:
         obj = change.obj
@@ -789,6 +875,12 @@ class MemoryStore:
                         else:
                             self._commit_apply_py(stamped, table)
                         self._version += len(stamped)
+                        for t in stamped:
+                            # old ref elided on this path (replays carry
+                            # old=None)
+                            self._log_change_locked(
+                                ("one", t.meta.version.index, "update",
+                                 t, None), 1)
 
                 if want_actions:
                     try:
@@ -926,7 +1018,19 @@ class MemoryStore:
                     # seq — the counter must advance past them even if a
                     # callback raised, or the next commit would reissue
                     # duplicate version indices
+                    base = self._version
                     self._version = seq
+                    if committed_idx:
+                        # one columnar changelog entry for the whole
+                        # block: replay materializes per-task lazily.
+                        # Version order within the block matches commit
+                        # order (fast-path items first, then slow).
+                        self._log_change_locked(
+                            ("block", base,
+                             [old_tasks[i] for i in committed_idx],
+                             [node_ids[i] for i in committed_idx],
+                             int(state), message, ts),
+                            len(committed_idx))
             self.queue.publish(EventCommit(self._version))
         for old, nid in missing:
             on_missing(old, nid)
@@ -1021,6 +1125,10 @@ class MemoryStore:
                         self._version = max(self._version + 1,
                                             obj.meta.version.index)
                     self._apply_locked(StoreAction(change.action, obj))
+                    ev = events[-1]
+                    self._log_change_locked(
+                        ("one", self._version, ev.action, ev.obj, ev.old),
+                        1)
             for ev in events:
                 self.queue.publish(ev)
             self.queue.publish(EventCommit(self._version))
@@ -1055,6 +1163,10 @@ class MemoryStore:
                             if name:
                                 table.by_name[name] = cp.id
                 self._version = snapshot.get("version", 0)
+                # resume continuity is lost across a snapshot install:
+                # watch-from callers see "compacted" and must re-list
+                self._changelog.clear()
+                self._changelog_total = 0
             self.queue.publish(EventSnapshotRestore())
 
     def save_bytes(self) -> bytes:

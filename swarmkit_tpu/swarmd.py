@@ -54,7 +54,8 @@ class Swarmd:
                  use_device_scheduler: bool = True,
                  migrate_plaintext_wal: bool = False,
                  cert_renew_interval: float = 60.0,
-                 unlock_key: str = ""):
+                 unlock_key: str = "",
+                 force_new_cluster: bool = False):
         import os
 
         from .agent.testutils import TestExecutor
@@ -85,6 +86,9 @@ class Swarmd:
         # manager state dir; '' means not provided
         self.unlock_key = unlock_key
         self.locked = False
+        # quorum-loss recovery: rebuild a single-member raft from this
+        # node's WAL/snapshot (reference: manager.go:99-101)
+        self.force_new_cluster = force_new_cluster
         self._stop_event = threading.Event()
         self.manager = None
         self.server = None
@@ -524,8 +528,9 @@ class Swarmd:
             logger.rotate_encoder(KeyEncoder(
                 ca.key, allow_plaintext=self.migrate_plaintext_wal))
             self._prev_ca_key = None
-        self.raft_node = RaftNode(raft_id, [raft_id], store, logger,
-                                  self.raft_transport)
+        self.raft_node = RaftNode(
+            raft_id, [raft_id], store, logger, self.raft_transport,
+            force_new_cluster=self.force_new_cluster)
         store._proposer = self.raft_node
         self.manager = Manager(
             store=store, raft_node=self.raft_node, root_ca=ca,
@@ -714,6 +719,9 @@ def main(argv=None) -> int:   # pragma: no cover - thin CLI shell
     parser.add_argument("--unlock-key", default="",
                         help="unlock key for an autolocked manager "
                              "state dir")
+    parser.add_argument("--force-new-cluster", action="store_true",
+                        help="recover from quorum loss: rebuild a "
+                             "single-member raft from this node's state")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO,
@@ -728,7 +736,8 @@ def main(argv=None) -> int:   # pragma: no cover - thin CLI shell
         executor=args.executor,
         use_device_scheduler=not args.no_device_scheduler,
         migrate_plaintext_wal=args.migrate_plaintext_wal,
-        unlock_key=args.unlock_key)
+        unlock_key=args.unlock_key,
+        force_new_cluster=args.force_new_cluster)
     daemon.start()
     try:
         while True:

@@ -160,3 +160,75 @@ def test_autolock_manager_refuses_until_unlocked():
              for s in m1.manager.control_api.list_services()]
     assert "locked-web" in names
     m1.stop()
+
+
+def test_force_new_cluster_recovers_from_quorum_loss():
+    """Kill 2 of 3 managers; the survivor cannot lead.  Restart it with
+    force_new_cluster: single-member raft from its WAL, cluster state
+    intact, and new managers can join again (reference:
+    manager.go:99-101 --force-new-cluster)."""
+    from swarmkit_tpu.models import ReplicatedService
+
+    m0 = Swarmd(state_dir=tempfile.mkdtemp(), hostname="m0",
+                manager=True, listen_remote_api=("127.0.0.1", 0),
+                use_device_scheduler=False)
+    m0.start()
+    mtoken = m0.manager.root_ca.join_token(NodeRole.MANAGER)
+    joiners = []
+    for h in ("m1", "m2"):
+        d = Swarmd(state_dir=tempfile.mkdtemp(), hostname=h,
+                   manager=True, join_addr=m0.server.addr,
+                   join_token=mtoken, listen_remote_api=("127.0.0.1", 0),
+                   use_device_scheduler=False)
+        d.start()
+        joiners.append(d)
+    m1, m2 = joiners
+    svc = m0.manager.control_api.create_service(
+        make_replicated("critical", 2).spec)
+    poll(lambda: len(m0.manager.control_api.list_tasks(
+        service_id=svc.id)) >= 2, timeout=30)
+    # replicate to m2 before the others die
+    poll(lambda: m2.manager.store.view(
+        lambda tx: tx.get(type(svc), svc.id)) is not None, timeout=20,
+        msg="service should replicate to m2")
+
+    survivor_dir = m2.state_dir
+    m0.stop()
+    m1.stop()
+    time.sleep(1.0)
+    m2.stop()
+
+    # recovery: single-member rebuild from the survivor's state dir
+    rec = Swarmd(state_dir=survivor_dir, hostname="m2", manager=True,
+                 listen_remote_api=("127.0.0.1", 0),
+                 use_device_scheduler=False, force_new_cluster=True)
+    rec.start()
+    assert rec.raft_node.core.peers == {"m-m2"}
+    poll(lambda: rec.manager.is_leader
+         and rec.manager.dispatcher is not None, timeout=30,
+         msg="recovered manager should lead alone")
+    got = rec.manager.control_api.get_service(svc.id)
+    assert got.spec.annotations.name == "critical"
+
+    # the rebuilt cluster accepts new managers and workers again
+    token2 = rec.manager.root_ca.join_token(NodeRole.MANAGER)
+    m3 = Swarmd(state_dir=tempfile.mkdtemp(), hostname="m3",
+                manager=True, join_addr=rec.server.addr,
+                join_token=token2, listen_remote_api=("127.0.0.1", 0),
+                use_device_scheduler=False)
+    m3.start()
+    poll(lambda: "m-m3" in rec.raft_node.core.peers, timeout=30,
+         msg="a fresh manager should join the rebuilt group")
+    w = Swarmd(state_dir=tempfile.mkdtemp(), hostname="w0",
+               join_addr=rec.server.addr,
+               join_token=rec.manager.root_ca.join_token(NodeRole.WORKER))
+    w.start()
+    from swarmkit_tpu.models.types import NodeState
+    def worker_ready():
+        nodes = [n for n in rec.manager.control_api.list_nodes()
+                 if n.description and n.description.hostname == "w0"]
+        return nodes and nodes[0].status.state == NodeState.READY
+    poll(worker_ready, timeout=30, msg="worker joins the rebuilt cluster")
+    w.stop()
+    m3.stop()
+    rec.stop()
