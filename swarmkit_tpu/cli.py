@@ -136,6 +136,8 @@ def _build_parser() -> argparse.ArgumentParser:
     autolock = cluster.add_parser("autolock")
     autolock.add_argument("mode", choices=["on", "off"])
     cluster.add_parser("unlock-key")
+    health = cluster.add_parser("health")
+    health.add_argument("--service", default="")
 
     ext = sub.add_parser("extension").add_subparsers(dest="verb",
                                                      required=True)
@@ -418,6 +420,11 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
         if args.verb == "unlock-key":
             key = api.get_unlock_key()
             return key or "autolock is not enabled"
+        if args.verb == "health":
+            health = getattr(api, "health", None)
+            if health is None:
+                raise APIError("health probing needs a manager-bound API")
+            return health(args.service)
 
     if args.noun == "extension":
         if args.verb == "create":

@@ -67,6 +67,7 @@ class Manager:
         # store's proposer so they fail on non-leaders)
         self.control_api = ControlAPI(self.store)
         self.control_api.root_ca = self.root_ca
+        self.control_api.health = self.health_check
         self.watch_server = WatchServer(self.store)
         self.logbroker = LogBroker(self.store)
         self.ca_server = CAServer(self.root_ca)
@@ -401,6 +402,21 @@ class Manager:
                 hook()
             except Exception:
                 log.exception("root-rotation hook failed")
+
+    def health_check(self, service: str = "") -> str:
+        """Health RPC (reference: manager/health/health.go Check,
+        api/health.proto:17): SERVING / NOT_SERVING / UNKNOWN.  The
+        empty service means "the manager"; "raft" reports consensus
+        membership health like the reference's Raft service."""
+        if service in ("", "manager"):
+            return "SERVING" if self._running else "NOT_SERVING"
+        if service == "raft":
+            if self.raft is None:
+                return "SERVING"   # standalone: no consensus to be in
+            if not self._running or self.raft.core.removed:
+                return "NOT_SERVING"
+            return "SERVING"
+        return "UNKNOWN"
 
     def manager_api_addrs(self) -> list:
         """Remote-API addresses of all known managers (replicated via

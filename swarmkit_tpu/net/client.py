@@ -490,6 +490,9 @@ class RemoteControlClient:
     def get_default_cluster(self):
         return _obj_in(self._call("get_default_cluster"))
 
+    def health(self, service: str = "") -> str:
+        return self._conn.call("health", {"service": service})["status"]
+
     def rotate_ca(self):
         return self._call("rotate_ca")
 

@@ -278,6 +278,11 @@ class ManagerServer:
                 params["node_id"], params["session_id"], msgs)
             return "ok"
 
+        # ---- health (cert-gated; reference: authenticated Health.Check)
+        if method == "health":
+            self._require_cert(cert)
+            return {"status": m.health_check(params.get("service", ""))}
+
         # ---- manager join (MANAGER-cert gated)
         if method == "raft_join":
             self._require_cert(cert, params["node_id"])

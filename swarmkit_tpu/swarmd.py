@@ -55,7 +55,8 @@ class Swarmd:
                  migrate_plaintext_wal: bool = False,
                  cert_renew_interval: float = 60.0,
                  unlock_key: str = "",
-                 force_new_cluster: bool = False):
+                 force_new_cluster: bool = False,
+                 listen_metrics: Optional[Tuple[str, int]] = None):
         import os
 
         from .agent.testutils import TestExecutor
@@ -89,6 +90,10 @@ class Swarmd:
         # quorum-loss recovery: rebuild a single-member raft from this
         # node's WAL/snapshot (reference: manager.go:99-101)
         self.force_new_cluster = force_new_cluster
+        # operator observability HTTP listener (reference: swarmd
+        # --listen-metrics, main.go:92-97)
+        self.listen_metrics = listen_metrics
+        self.metrics_server = None
         self._stop_event = threading.Event()
         self.manager = None
         self.server = None
@@ -98,6 +103,28 @@ class Swarmd:
 
     def start(self) -> None:
         from .node import Node
+
+        if self.listen_metrics is not None:
+            from .utils.httpdebug import DebugServer
+            def health() -> str:
+                if self.manager is not None:
+                    return self.manager.health_check()
+                if self.locked or self.is_manager:
+                    return "NOT_SERVING"
+                # worker: healthy only while its agent session is live
+                node = self.node
+                agent = node.agent if node is not None else None
+                if agent is None:
+                    return "NOT_SERVING"
+                return ("SERVING" if agent.session_id
+                        else "NOT_SERVING")
+
+            self.metrics_server = DebugServer(
+                host=self.listen_metrics[0], port=self.listen_metrics[1],
+                health=health)
+            self.metrics_server.start()
+            log.info("metrics/debug HTTP on %s:%d",
+                     *self.metrics_server.addr)
 
         if self.is_manager and self.join_addr is not None:
             self._start_joining_manager()
@@ -690,6 +717,8 @@ class Swarmd:
 
     def stop(self) -> None:
         self._stop_event.set()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
         if self.node is not None:
             self.node.stop()
         if self.server is not None:
@@ -722,6 +751,9 @@ def main(argv=None) -> int:   # pragma: no cover - thin CLI shell
     parser.add_argument("--force-new-cluster", action="store_true",
                         help="recover from quorum loss: rebuild a "
                              "single-member raft from this node's state")
+    parser.add_argument("--listen-metrics", default="",
+                        help="serve /metrics, /healthz and /debug/stacks "
+                             "over plain HTTP on host:port")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO,
@@ -737,7 +769,9 @@ def main(argv=None) -> int:   # pragma: no cover - thin CLI shell
         use_device_scheduler=not args.no_device_scheduler,
         migrate_plaintext_wal=args.migrate_plaintext_wal,
         unlock_key=args.unlock_key,
-        force_new_cluster=args.force_new_cluster)
+        force_new_cluster=args.force_new_cluster,
+        listen_metrics=parse_addr(args.listen_metrics)
+        if args.listen_metrics else None)
     daemon.start()
     try:
         while True:

@@ -1,0 +1,82 @@
+"""Operator observability HTTP listener: /metrics, /healthz, /debug/stacks.
+
+Reference: swarmd/cmd/swarmd/main.go:92-97 (--listen-metrics serving
+Prometheus metrics, --listen-debug serving pprof).  The stacks endpoint
+is the Python analogue of a goroutine dump (the reference's integration
+tests rely on exactly that for diagnosis).
+"""
+
+from __future__ import annotations
+
+import http.server
+import sys
+import threading
+import traceback
+from typing import Callable, Optional, Tuple
+
+from .metrics import registry
+
+
+def _all_stacks() -> str:
+    frames = sys._current_frames()
+    out = []
+    by_id = {t.ident: t for t in threading.enumerate()}
+    for tid, frame in frames.items():
+        t = by_id.get(tid)
+        name = t.name if t else f"thread-{tid}"
+        out.append(f"--- {name} ({tid}) ---")
+        out.extend(line.rstrip()
+                   for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"
+
+
+class DebugServer:
+    """Plain-HTTP observability endpoints (no TLS: bind to loopback or a
+    protected interface, like the reference's --listen-metrics)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 health: Optional[Callable[[], str]] = None):
+        self.health = health or (lambda: "SERVING")
+        outer = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):   # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = registry.expose().encode()
+                    code, ctype = 200, "text/plain; version=0.0.4"
+                elif self.path == "/healthz":
+                    status = outer.health()
+                    body = (status + "\n").encode()
+                    code = 200 if status == "SERVING" else 503
+                    ctype = "text/plain"
+                elif self.path == "/debug/stacks":
+                    body = _all_stacks().encode()
+                    code, ctype = 200, "text/plain"
+                else:
+                    body, code, ctype = b"not found\n", 404, "text/plain"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        class _Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), _Handler)
+        self.addr = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="debug-http",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

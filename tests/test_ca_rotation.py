@@ -232,3 +232,60 @@ def test_force_new_cluster_recovers_from_quorum_loss():
     w.stop()
     m3.stop()
     rec.stop()
+
+
+def test_health_api_and_metrics_endpoint():
+    """Health RPC on the control surface + curl-able /metrics /healthz
+    /debug/stacks (reference: manager/health/health.go, swarmd
+    --listen-metrics main.go:92-97)."""
+    import urllib.error
+    import urllib.request
+
+    from swarmkit_tpu.cli import run_command
+
+    m0 = Swarmd(state_dir=tempfile.mkdtemp(), hostname="m0",
+                manager=True, listen_remote_api=("127.0.0.1", 0),
+                listen_metrics=("127.0.0.1", 0),
+                use_device_scheduler=False)
+    m0.start()
+    try:
+        # in-process probe via CLI
+        assert run_command(["cluster", "health"],
+                           m0.manager.control_api) == "SERVING"
+        assert run_command(["cluster", "health", "--service", "raft"],
+                           m0.manager.control_api) == "SERVING"
+
+        # remote probe over mTLS
+        op = issue_certificate(
+            m0.server.addr, "op",
+            m0.manager.root_ca.join_token(NodeRole.MANAGER))
+        ctl = RemoteControlClient(m0.server.addr, op)
+        assert ctl.health() == "SERVING"
+        assert ctl.health("raft") == "SERVING"
+        assert ctl.health("bogus") == "UNKNOWN"
+        ctl.close()
+
+        # create some state so the collector gauges are non-trivial
+        svc = m0.manager.control_api.create_service(
+            make_replicated("obs", 2).spec)
+        poll(lambda: len(m0.manager.control_api.list_tasks(
+            service_id=svc.id)) == 2, timeout=20)
+
+        base = "http://%s:%d" % m0.metrics_server.addr
+        poll(lambda: b"swarm_manager_services 1" in urllib.request.urlopen(
+            base + "/metrics", timeout=5).read(), timeout=15,
+            msg="collector gauges should surface on /metrics")
+        body = urllib.request.urlopen(base + "/metrics",
+                                      timeout=5).read().decode()
+        assert "swarm_store_write_tx_latency_seconds_count" in body
+
+        assert urllib.request.urlopen(
+            base + "/healthz", timeout=5).read().strip() == b"SERVING"
+        stacks = urllib.request.urlopen(
+            base + "/debug/stacks", timeout=5).read().decode()
+        assert "raft-m-m0" in stacks   # thread dump names live threads
+
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope", timeout=5)
+    finally:
+        m0.stop()
