@@ -70,6 +70,10 @@ def _build_parser() -> argparse.ArgumentParser:
     drain.add_argument("node")
     activate = node.add_parser("activate")
     activate.add_argument("node")
+    promote = node.add_parser("promote")
+    promote.add_argument("node")
+    demote = node.add_parser("demote")
+    demote.add_argument("node")
     nrm = node.add_parser("rm")
     nrm.add_argument("node")
     nrm.add_argument("--force", action="store_true")
@@ -262,6 +266,19 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
                                  else NodeAvailability.ACTIVE)
             api.update_node(n.id, n.meta.version.index, spec)
             return f"{n.id} " + ("drained" if args.verb == "drain" else "activated")
+        if args.verb in ("promote", "demote"):
+            # reference: swarmctl node promote/demote (flips
+            # spec.desired_role; the role manager reconciles raft
+            # membership and the node's CA renewal picks up the role)
+            from .models.types import NodeRole
+            n = _resolve(api.list_nodes(), args.node, "node")
+            spec = n.spec.copy()
+            spec.desired_role = (NodeRole.MANAGER
+                                 if args.verb == "promote"
+                                 else NodeRole.WORKER)
+            api.update_node(n.id, n.meta.version.index, spec)
+            return f"{n.id} " + ("promoted" if args.verb == "promote"
+                                 else "demoted")
         if args.verb == "rm":
             n = _resolve(api.list_nodes(), args.node, "node")
             api.remove_node(n.id, force=args.force)

@@ -454,6 +454,18 @@ class Manager:
         # add a member that may never start — a dead phantom peer would
         # wedge quorum permanently on small clusters
         if addr is not None and node_id not in self.raft.core.peers:
+            # a still-valid MANAGER cert is not enough when the store
+            # says the node is (again) a worker — a join racing a
+            # demotion must not commit a phantom voter (the role manager
+            # flips the role to MANAGER before a node can ever promote,
+            # so a registered joiner's record always agrees)
+            from ..models.objects import Node as NodeObject
+            from ..models.types import NodeRole as _NR
+            rec = self.store.view(lambda tx: tx.get(NodeObject, node_id))
+            if rec is not None and _NR(rec.role) != _NR.MANAGER:
+                raise PermissionError(
+                    f"node {node_id} has role {_NR(rec.role).name}; "
+                    "promote it before joining raft")
             self.raft.add_member(node_id, tuple(addr),
                                  tuple(api_addr) if api_addr else None)
         members = {k: list(v) for k, v in self.raft_peer_addrs.items()}

@@ -86,6 +86,21 @@ class RoleManager:
         desired = NodeRole(node.spec.desired_role)
         observed = NodeRole(node.role)
         if desired == observed:
+            if (desired == NodeRole.WORKER and self.raft is not None
+                    and node.id != self.raft.id
+                    and node.id in getattr(self.raft.core, "peers", set())):
+                # phantom voter: a raft join racing a demotion can land
+                # AFTER the observed role flipped to worker (the join RPC
+                # is gated on the still-valid manager cert).  The ticker
+                # re-runs this sweep, so the dead member cannot inflate
+                # quorum forever.
+                try:
+                    self.raft.remove_member(node.id)
+                    log.info("removed phantom raft member %s "
+                             "(role is worker)", node.id[:8])
+                except Exception:
+                    log.exception("removing phantom member %s failed",
+                                  node.id)
             return
         if desired == NodeRole.WORKER:
             # demotion: leave raft BEFORE flipping the observed role

@@ -321,6 +321,9 @@ class RemoteDispatcherClient:
             # ...and the active root digest, so the renewer reacts to a
             # CA rotation without waiting for cert half-life
             self.last_ca_digest = resp.get("ca_digest", "")
+            # ...and the node's store-reconciled role, so promotion/
+            # demotion is noticed within one heartbeat period
+            self.last_role = resp.get("role")
             return resp["period"]
         return resp
 

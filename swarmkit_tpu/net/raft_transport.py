@@ -52,6 +52,12 @@ class TCPRaftTransport:
         self._send_queues: Dict[str, "queue.Queue"] = {}
         self._senders: Dict[str, threading.Thread] = {}
         self._stop = threading.Event()
+        # live inbound connections: must be force-closed on shutdown or a
+        # peer's established socket keeps feeding a DEAD transport — the
+        # peer never redials, and a restarted member on the same port
+        # never hears from it (no elections ever complete)
+        self._conns: set = set()
+        self._conns_mu = threading.Lock()
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -72,13 +78,19 @@ class TCPRaftTransport:
                         if not hmac.compare_digest(sig, outer._hello_sig()):
                             log.warning("rejected unauthenticated raft peer")
                             return
-                    while True:
+                    with outer._conns_mu:
+                        outer._conns.add(sock)
+                    while not outer._stop.is_set():
                         frame = recv_frame(sock)
                         handler = outer._handler
-                        if handler is not None:
-                            handler(serde.from_dict(Message, frame))
-                except (ConnectionError, OSError):
+                        if handler is None:
+                            break   # unregistered: force the peer to redial
+                        handler(serde.from_dict(Message, frame))
+                except (ConnectionError, OSError, ValueError):
                     pass
+                finally:
+                    with outer._conns_mu:
+                        outer._conns.discard(sock)
 
         class _Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -134,6 +146,18 @@ class TCPRaftTransport:
             q.put(None)
         self._server.shutdown()
         self._server.server_close()
+        # server_close only stops the accept loop; established inbound
+        # sockets live in handler threads and must be closed too, or
+        # peers keep sending into this dead transport instead of
+        # redialing our successor on the same port
+        with self._conns_mu:
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def send(self, msg: Message) -> None:
         """Ordered, best-effort delivery per peer (raft tolerates loss)."""

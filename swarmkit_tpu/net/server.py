@@ -183,6 +183,20 @@ class ManagerServer:
             raise SecurityError(
                 f"a manager certificate is required {what}")
 
+    def _store_role(self, cert: Optional[Certificate]):
+        """The caller's current role per its store Node record (the role
+        manager keeps this reconciled with spec.desired_role); falls back
+        to the cert's role for nodes not yet registered."""
+        if cert is None:
+            return None
+        from ..models.objects import Node as NodeObject
+        try:
+            node = self.manager.store.view(
+                lambda tx: tx.get(NodeObject, cert.node_id))
+        except Exception:
+            node = None
+        return node.role if node is not None else cert.role
+
     # -------------------------------------------------------------- methods
 
     def _dispatch(self, method: str, params: Dict[str, Any],
@@ -216,11 +230,15 @@ class ManagerServer:
                     "key": issued.key_pem.decode(),
                     "ca_cert": m.root_ca.trust_bundle().decode()}
         if method == "renew_certificate":
-            # gated on the caller's valid cert: same identity + role,
-            # fresh validity (reference: ca/renewer.go)
+            # gated on the caller's valid cert: same identity, fresh
+            # validity.  The role comes from the node's STORE record (the
+            # role manager's reconciled role), not the old cert — this is
+            # the channel by which promotion/demotion reaches the node
+            # (reference: ca/server.go:377, role_manager.go reconcile)
             self._require_cert(cert)
             cert_pem = m.ca_server.renew(cert,
-                                         csr_pem=params["csr"].encode())
+                                         csr_pem=params["csr"].encode(),
+                                         role=self._store_role(cert))
             return {"cert": cert_pem.decode(),
                     "ca_cert": m.root_ca.trust_bundle().decode()}
 
@@ -248,8 +266,13 @@ class ManagerServer:
             # the active root digest rides along so agents renew promptly
             # when a rotation begins (reference: the session stream ships
             # the RootCA; ca/renewer reacts)
+            # the node's reconciled role rides along too so a promoted/
+            # demoted node renews (and transitions) without waiting out
+            # its cert half-life (reference: the session stream carries
+            # the Node object; node.go:947 waitRole reacts)
             return {"period": period, "managers": m.manager_api_addrs(),
-                    "ca_digest": m.root_ca.active_digest}
+                    "ca_digest": m.root_ca.active_digest,
+                    "role": self._store_role(cert)}
         if method == "update_task_status":
             self._require_cert(cert, params["node_id"])
             updates = [(u["task_id"],

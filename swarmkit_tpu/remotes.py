@@ -215,6 +215,13 @@ class FailoverDispatcherClient:
         with self._mu:
             return getattr(self._client, "last_ca_digest", "") or ""
 
+    @property
+    def last_role(self):
+        """This node's store-reconciled role from the latest heartbeat
+        (int NodeRole value), or None before the first heartbeat."""
+        with self._mu:
+            return getattr(self._client, "last_role", None)
+
     def reset_connection(self) -> None:
         """Drop the live connection so the next call re-handshakes with
         the (possibly renewed) certificate."""

@@ -580,10 +580,17 @@ class CAServer:
         return self.root_ca.issue(node_id, role)
 
     def renew(self, cert: Certificate,
-              csr_pem: Optional[bytes] = None):
-        """Cert-gated renewal: same identity and role, fresh validity
-        (reference: ca/server.go NodeCertificateStatus + renewer)."""
+              csr_pem: Optional[bytes] = None,
+              role: Optional[int] = None):
+        """Cert-gated renewal: same identity, fresh validity.  ``role``
+        overrides the cert's role — the caller passes the node's current
+        role from the store, so a node promoted/demoted by the role
+        manager picks up its new role on renewal (reference:
+        ca/server.go:377 issues for the store's node.Role, which is how
+        role changes reach the node)."""
         self.root_ca.verify(cert)
+        if role is None:
+            role = cert.role
         if csr_pem is not None:
-            return self.root_ca.sign_csr(csr_pem, cert.node_id, cert.role)
-        return self.root_ca.issue(cert.node_id, cert.role)
+            return self.root_ca.sign_csr(csr_pem, cert.node_id, role)
+        return self.root_ca.issue(cert.node_id, role)

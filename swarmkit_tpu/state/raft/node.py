@@ -144,7 +144,8 @@ class RaftNode(Proposer):
 
     def stop(self) -> None:
         self._stop.set()
-        self._done.wait(timeout=10)
+        if self._thread is not None:   # never started: nothing to wait on
+            self._done.wait(timeout=10)
         self.transport.unregister(self.id)
         self.logger.close()
         self._fail_waiters()

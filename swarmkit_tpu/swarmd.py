@@ -100,6 +100,12 @@ class Swarmd:
         self.node = None
         self.raft_node = None
         self.raft_transport = None
+        # raft member id: "m-<hostname>" for nodes started as managers; a
+        # worker promoted at runtime keeps its node id (the reference uses
+        # one node id for both roles, node/node.go:286)
+        self.raft_id = "m-" + self.hostname
+        # serializes role transitions against stop() and each other
+        self._role_mu = threading.Lock()
 
     def start(self) -> None:
         from .node import Node
@@ -125,6 +131,14 @@ class Swarmd:
             self.metrics_server.start()
             log.info("metrics/debug HTTP on %s:%d",
                      *self.metrics_server.addr)
+
+        if not self.is_manager and self.join_addr is not None:
+            import os as _os
+            if _os.path.exists(self._manager_state_path()):
+                # promoted to manager at runtime in a previous life: come
+                # back up as that manager (reference: node.go:983
+                # runManager restarts from the persisted role)
+                self.is_manager = True
 
         if self.is_manager and self.join_addr is not None:
             self._start_joining_manager()
@@ -168,20 +182,20 @@ class Swarmd:
                     from .models.types import NodeRole as _NR
                     self.raft_transport.set_identity(
                         self.manager.root_ca.issue(
-                            "m-" + self.hostname, _NR.MANAGER))
+                            self.raft_id, _NR.MANAGER))
             self._start_remote_api(port_override=api_port)
             if self.server is not None:
-                self.manager.api_addrs["m-" + self.hostname] = \
-                    self.server.addr
+                self.manager.api_addrs[self.raft_id] = self.server.addr
                 if self.raft_node.is_leader:
                     # replicate our API address so agents can fail over
                     # to us and followers can redirect joins
                     self.raft_node.add_member(
-                        "m-" + self.hostname, self.raft_transport.addr,
+                        self.raft_id, self.raft_transport.addr,
                         self.server.addr)
             self._save_manager_state()
             self._start_manager_agent()
             self._start_manager_identity_renewer()
+            self._start_role_watcher()
             if self.manager.is_leader:
                 log.info("manager up; worker join token: %s",
                          self.manager.root_ca.join_token(0))
@@ -225,6 +239,7 @@ class Swarmd:
             ConnectionBroker(self.remotes), cert)
         self.node.start(client, hostname=self.hostname)
         self._start_cert_renewer(client)
+        self._start_role_watcher()
         log.info("worker %s joined %s", self.node.node_id[:8],
                  self.join_addr)
 
@@ -236,7 +251,6 @@ class Swarmd:
         from .security.ca import needs_renewal
 
         def loop():
-            from .net.client import renew_certificate
             from .security.ca import signing_root_digest
             while not self._stop_event.wait(self.cert_renew_interval):
                 cert = self.node.certificate
@@ -249,36 +263,265 @@ class Swarmd:
                            and advertised != signing_root_digest(cert))
                 if not needs_renewal(cert) and not rotated:
                     continue
-                targets = list(self.remotes.weights()) + [self.join_addr]
-                for addr in targets:
-                    try:
-                        fresh = renew_certificate(addr, cert)
-                    except Exception as e:
-                        log.info("cert renewal via %s failed: %s", addr, e)
-                        continue
-                    self.node.key_rw.write(fresh, b"")
-                    self.node.certificate = fresh
-                    # future connections present the fresh cert (the
-                    # factory closes over client.certificate); drop the
-                    # live connection so the next heartbeat handshakes
-                    # with the new identity (the leader records its
-                    # issuer for rotation progress)
-                    client.certificate = fresh
-                    reset = getattr(client, "reset_connection", None)
-                    if reset is not None:
-                        reset()
+                fresh = self._renew_via_managers(cert)
+                if fresh is not None:
+                    self._swap_node_cert(fresh, client)
                     log.info("renewed certificate for %s (expires %.0f)",
                              fresh.node_id[:8], fresh.expires_at)
-                    break
 
         threading.Thread(target=loop, name="cert-renewer",
                          daemon=True).start()
+
+    def _renew_via_managers(self, cert):
+        """One renewal pass over every reachable manager; returns the
+        fresh certificate or None.  The server issues for the node's
+        STORE role, so the result also carries promotions/demotions."""
+        from .net.client import renew_certificate
+
+        targets = []
+        remotes = getattr(self, "remotes", None)
+        if remotes is not None:
+            targets += list(remotes.weights())
+        if self.join_addr is not None and self.join_addr not in targets:
+            targets.append(self.join_addr)
+        for addr in targets:
+            if self._stop_event.is_set():
+                return None   # don't hold role transitions across stop()
+            try:
+                return renew_certificate(addr, cert)
+            except Exception as e:
+                log.info("cert renewal via %s failed: %s", addr, e)
+        return None
+
+    def _swap_node_cert(self, fresh, client) -> None:
+        """Persist + activate a renewed identity: future connections
+        present the fresh cert (the factory closes over
+        client.certificate); drop the live connection so the next
+        heartbeat handshakes with the new identity (the leader records
+        its issuer for rotation progress)."""
+        self.node.key_rw.write(fresh, b"")
+        self.node.certificate = fresh
+        if client is not None:
+            client.certificate = fresh
+            reset = getattr(client, "reset_connection", None)
+            if reset is not None:
+                reset()
+
+    # ------------------------------------------------- runtime role changes
+
+    def _start_role_watcher(self) -> None:
+        """React to promotion/demotion decided by the leader's role
+        manager.  The node's store-reconciled role rides on every
+        heartbeat response; on a mismatch with what we are running, renew
+        the certificate (the CA issues for the store role) and start or
+        stop the manager component (reference: node/node.go:483
+        superviseManager, :947 waitRole, :1086 role-change teardown)."""
+        if getattr(self, "_role_watcher_started", False):
+            return
+        self._role_watcher_started = True
+        from .models.types import NodeRole
+
+        def loop():
+            backoff, next_try = 0.5, 0.0
+            while not self._stop_event.wait(0.5):
+                node = self.node
+                agent = node.agent if node is not None else None
+                cert = node.certificate if node is not None else None
+                if agent is None or cert is None:
+                    continue
+                client = agent.client
+                role = getattr(client, "last_role", None)
+                if role is None:
+                    continue
+                try:
+                    role = NodeRole(role)
+                except ValueError:
+                    continue
+                wants_promote = (role == NodeRole.MANAGER
+                                 and self.manager is None)
+                wants_demote = (role == NodeRole.WORKER
+                                and self.manager is not None)
+                if not wants_promote and not wants_demote:
+                    backoff, next_try = 0.5, 0.0   # settled: reset
+                    continue
+                if time.time() < next_try:
+                    continue
+                try:
+                    with self._role_mu:
+                        if self._stop_event.is_set():
+                            continue
+                        if wants_promote and self.manager is None:
+                            self._promote_to_manager(client)
+                        elif wants_demote and self.manager is not None:
+                            self._demote_to_worker(client)
+                    backoff, next_try = 0.5, 0.0
+                except Exception:
+                    # a failed attempt redials managers and (for
+                    # promotion) rebuilds a whole stack — back off
+                    # exponentially instead of churning twice a second
+                    log.exception("role transition failed; retrying in "
+                                  "%.1fs", backoff)
+                    next_try = time.time() + backoff
+                    backoff = min(30.0, backoff * 2)
+
+        threading.Thread(target=loop, name="role-watcher",
+                         daemon=True).start()
+
+    def _promote_to_manager(self, client) -> None:
+        """Runtime worker→manager transition: renew into a MANAGER cert,
+        join the raft group under our existing node id, and start the
+        Manager composition beside the running agent (reference:
+        node/node.go:1099 superviseManager starting runManager)."""
+        import base64
+
+        from .models.types import NodeRole
+        from .net import join_raft
+        from .security import RootCA
+
+        cert = self.node.certificate
+        if NodeRole(cert.role) != NodeRole.MANAGER:
+            fresh = self._renew_via_managers(cert)
+            if fresh is None or NodeRole(fresh.role) != NodeRole.MANAGER:
+                raise RuntimeError(
+                    "could not obtain a manager certificate")
+            self._swap_node_cert(fresh, client)
+            cert = fresh
+        self.raft_id = self.node.node_id
+        boot = join_via = None
+        for addr in list(self.remotes.weights()):
+            if self._stop_event.is_set():
+                raise RuntimeError("daemon stopping; promotion aborted")
+            try:
+                boot = join_raft(addr, cert, self.raft_id)
+                join_via = addr
+                break
+            except Exception as e:
+                log.info("raft bootstrap hop via %s failed: %s", addr, e)
+        if boot is None:
+            raise RuntimeError("no manager reachable for raft join")
+        ca = RootCA(base64.b64decode(boot["ca_key"]),
+                    base64.b64decode(boot["ca_cert"]))
+        had_listen = self.listen_remote_api
+        try:
+            self._build_raft_manager(ca, raft_port=0, defer_start=True)
+            if self.listen_remote_api is None:
+                # a manager serves the remote API (joins/control/failover)
+                self.listen_remote_api = ("127.0.0.1", 0)
+            self._start_remote_api()
+            self._complete_raft_join(join_via, cert)
+        except Exception:
+            # roll the half-built stack back so the watcher's retry gate
+            # (self.manager is None) re-arms and ports don't leak.  If
+            # the address-carrying hop already committed our membership,
+            # the committed voter survives this rollback — the watcher's
+            # retry re-adopts it (the leader's join_raft is idempotent
+            # for existing members); should this node die for good
+            # instead, the operator demotes it like any dead manager
+            # (covered by the demote-a-downed-manager flow)
+            self._teardown_manager_stack()
+            self.listen_remote_api = had_listen
+            raise
+        if self.server is not None:
+            self.manager.api_addrs[self.raft_id] = self.server.addr
+        self._save_manager_state()
+        self.is_manager = True
+        self._start_manager_identity_renewer()
+        log.info("node %s promoted to manager; raft group %s",
+                 self.raft_id[:8], sorted(self.raft_node.core.peers))
+
+    def _complete_raft_join(self, join_via, cert) -> None:
+        """The address-carrying join hop plus peer seeding and startup —
+        the one join protocol shared by a fresh `--manager --join-addr`
+        daemon and a runtime promotion (reference: manager.go
+        JoinAndStart -> Join RPC)."""
+        from .net import join_raft
+
+        resp = None
+        for attempt in range(20):
+            if self._stop_event.is_set():
+                raise RuntimeError("daemon stopping; join aborted")
+            try:
+                resp = join_raft(
+                    join_via, cert, self.raft_id,
+                    raft_addr=self.raft_transport.addr,
+                    api_addr=self.server.addr if self.server else None)
+                break
+            except Exception as e:
+                # the leader serializes membership changes; concurrent
+                # joins are a normal, momentary condition
+                log.info("raft join attempt %d failed (%s); retrying",
+                         attempt + 1, e)
+                self._stop_event.wait(0.5)
+        if resp is None:
+            raise RuntimeError("could not join the raft group")
+        for nid, addr in resp["members"].items():
+            if nid != self.raft_id and addr is not None:
+                self.raft_transport.set_peer(nid, tuple(addr))
+                self.raft_node.core.peers.add(nid)
+                self.raft_node.core.peer_addrs[nid] = tuple(addr)
+        self.raft_node.start()
+        self.manager.run()
+
+    def _demote_to_worker(self, client) -> None:
+        """Runtime manager→worker transition.  The leader's role manager
+        removes us from raft BEFORE flipping the observed role
+        (raft-first demotion), so by the time the heartbeat says WORKER
+        our membership is already gone: tear down the manager stack, keep
+        the agent running on a WORKER cert (reference: node/node.go:1086
+        "role changed to worker, stopping manager")."""
+        from .models.types import NodeRole
+
+        cert = self.node.certificate
+        if NodeRole(cert.role) != NodeRole.WORKER:
+            fresh = self._renew_via_managers(cert)
+            if fresh is None or NodeRole(fresh.role) != NodeRole.WORKER:
+                raise RuntimeError("could not obtain a worker certificate")
+            self._swap_node_cert(fresh, client)
+        self._teardown_manager_stack()
+        self.is_manager = False
+        log.info("manager %s demoted; continuing as worker",
+                 self.node.node_id[:8])
+
+    def _teardown_manager_stack(self) -> None:
+        """Stop and clear this daemon's manager components and drop their
+        on-disk state (a restart must come back as a worker; replaying a
+        stale WAL would resurrect a phantom peer)."""
+        import os
+        import shutil
+
+        server, self.server = self.server, None
+        manager, self.manager = self.manager, None
+        raft_node, self.raft_node = self.raft_node, None
+        transport, self.raft_transport = self.raft_transport, None
+        if server is not None:
+            server.stop()
+        if manager is not None:
+            manager.stop()
+        if raft_node is not None:
+            raft_node.stop()   # unregisters (closes) the transport too
+        elif transport is not None:
+            # _build_raft_manager binds the transport's listener before
+            # the raft node exists; a failure between the two must not
+            # leak the bound socket + accept thread
+            try:
+                transport.unregister(transport.node_id)
+            except Exception:
+                pass
+        try:
+            os.remove(self._manager_state_path())
+        except FileNotFoundError:
+            pass
+        shutil.rmtree(os.path.join(self.state_dir, "raft"),
+                      ignore_errors=True)
 
     def _start_manager_identity_renewer(self) -> None:
         """Managers hold the CA, so their serving identities (raft link,
         API server) renew by local re-issue at half of validity — without
         this a long-lived manager's certs expire and every CERT_REQUIRED
         peer handshake starts failing cluster-wide."""
+        if getattr(self, "_identity_renewer_started", False):
+            return   # demote→re-promote cycle: one thread is enough
+        self._identity_renewer_started = True
         from .models.types import NodeRole
         from .security.ca import needs_renewal
 
@@ -369,16 +612,22 @@ class Swarmd:
         from .node import Node
         from .security import RootCA
 
-        raft_id = "m-" + self.hostname
         try:
             state = self._load_manager_state()
         except ManagerLockedError as e:
             self.locked = True
             log.warning("manager locked: %s", e)
             return
+        # a runtime-promoted worker persisted its own node id as the raft
+        # member id; _load_manager_state restored it into self.raft_id
+        raft_id = self.raft_id
         if state is not None:
             # restart: peers + addresses replay from the raft WAL
             self._prev_ca_key = state.get("prev_ca_key")
+            if state["api_port"] and self.listen_remote_api is None:
+                # we served the remote API before the restart and its
+                # address replicated cluster-wide — rebind it
+                self.listen_remote_api = ("127.0.0.1", 0)
             self._build_raft_manager(
                 RootCA(state["ca_key"], state["ca_cert"]),
                 raft_port=state["raft_port"])
@@ -424,29 +673,7 @@ class Swarmd:
             self._build_raft_manager(RootCA(ca_key, ca_cert), raft_port=0,
                                      defer_start=True)
             self._start_remote_api()
-            resp = None
-            for attempt in range(20):
-                try:
-                    resp = join_raft(
-                        self.join_addr, cert, raft_id,
-                        raft_addr=self.raft_transport.addr,
-                        api_addr=self.server.addr if self.server else None)
-                    break
-                except Exception as e:
-                    # the leader serializes membership changes; concurrent
-                    # joins are a normal, momentary condition
-                    log.info("raft join attempt %d failed (%s); retrying",
-                             attempt + 1, e)
-                    time.sleep(0.5)
-            if resp is None:
-                raise RuntimeError("could not join the raft group")
-            for nid, addr in resp["members"].items():
-                if nid != raft_id and addr is not None:
-                    self.raft_transport.set_peer(nid, tuple(addr))
-                    self.raft_node.core.peers.add(nid)
-                    self.raft_node.core.peer_addrs[nid] = tuple(addr)
-            self.raft_node.start()
-            self.manager.run()
+            self._complete_raft_join(self.join_addr, cert)
             self._save_manager_state()
         if self.server is not None:
             self.manager.api_addrs[raft_id] = self.server.addr
@@ -465,6 +692,7 @@ class Swarmd:
         extra = [tuple(a) for a in self.raft_node.core.api_addrs.values()]
         self._start_agent_with_failover(cert, self.join_addr, *extra)
         self._start_manager_identity_renewer()
+        self._start_role_watcher()
         log.info("manager %s joined raft group %s", raft_id,
                  sorted(self.raft_node.core.peers))
 
@@ -482,7 +710,7 @@ class Swarmd:
         # RoleManager can map Node records to raft voters (the reference
         # uses one node id for both)
         self.node = Node(self.executor, self.state_dir,
-                         node_id="m-" + self.hostname)
+                         node_id=self.raft_id)
         cert = None
         try:
             cert, _ = self.node.key_rw.read()
@@ -535,7 +763,7 @@ class Swarmd:
         from .state import MemoryStore
         from .state.raft import KeyEncoder, RaftLogger, RaftNode
 
-        raft_id = "m-" + self.hostname
+        raft_id = self.raft_id
         # raft links run mutual TLS on a manager cert self-issued from
         # the cluster CA (reference: ca/transport.go for raft peers)
         from .models.types import NodeRole
@@ -642,6 +870,9 @@ class Swarmd:
                 f"manager state file {self._manager_state_path()!r} is "
                 f"unreadable ({e})") from e
         try:
+            # restore the raft member id: "m-<hostname>" normally, the
+            # node's own id for a runtime-promoted worker
+            self.raft_id = rec.get("raft_id") or self.raft_id
             return {"ca_key": bytes.fromhex(rec["ca_key"]),
                     "ca_cert": bytes.fromhex(rec["ca_cert"]),
                     "prev_ca_key": bytes.fromhex(rec["prev_ca_key"])
@@ -668,6 +899,7 @@ class Swarmd:
 
         os.makedirs(self.state_dir, exist_ok=True)
         payload = json.dumps({
+            "raft_id": self.raft_id,
             "ca_key": self.manager.root_ca.key.hex(),
             "ca_cert": self.manager.root_ca.cert_pem.hex(),
             # present only mid-re-key: decode fallback for a crash
@@ -717,6 +949,9 @@ class Swarmd:
 
     def stop(self) -> None:
         self._stop_event.set()
+        # let an in-flight role transition finish before tearing down
+        if self._role_mu.acquire(timeout=10):
+            self._role_mu.release()
         if self.metrics_server is not None:
             self.metrics_server.stop()
         if self.node is not None:
