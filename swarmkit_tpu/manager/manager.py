@@ -187,6 +187,7 @@ class Manager:
                 continue
             try:
                 self._adopt_ca_state()
+                self._apply_ca_config()   # followers issue on renewal too
             except Exception:
                 log.exception("CA state adoption failed")
             hook = self.on_cluster_changed
@@ -338,11 +339,26 @@ class Manager:
         THE root, tokens re-derive, and persisted state flips over."""
         while self._running and self._is_leader:
             try:
+                self._apply_ca_config()
                 if self.root_ca.rotation is not None:
                     self._reconcile_ca_rotation()
             except Exception:
                 log.exception("CA rotation reconciliation failed")
             self._stop_event.wait(self.ca_rotation_check_interval)
+
+    def _apply_ca_config(self) -> None:
+        """Live-apply ClusterSpec.ca_config to the signing CA — today
+        that is node_cert_expiry (reference: ca/server.go UpdateRootCA
+        reacting to CAConfig.NodeCertExpiry)."""
+        clusters = self.store.view(
+            lambda tx: tx.find(Cluster, ByName(DEFAULT_CLUSTER_NAME)))
+        if not clusters:
+            return
+        expiry = clusters[0].spec.ca_config.node_cert_expiry
+        if expiry > 0 and expiry != self.root_ca.node_cert_expiry:
+            log.info("node cert expiry set to %.0fs from cluster spec",
+                     expiry)
+            self.root_ca.node_cert_expiry = expiry
 
     def _reconcile_ca_rotation(self) -> None:
         from ..models.types import NodeState

@@ -289,3 +289,58 @@ def test_health_api_and_metrics_endpoint():
             urllib.request.urlopen(base + "/nope", timeout=5)
     finally:
         m0.stop()
+
+
+def test_node_cert_expiry_renewal_under_daemon():
+    """Short-lived node certs renew automatically at half-life and the
+    node stays READY past its original expiry (reference: ca/renewer.go
+    renewal loop + CAConfig.NodeCertExpiry driving validity)."""
+    from swarmkit_tpu.models.types import NodeState
+
+    m0 = Swarmd(state_dir=tempfile.mkdtemp(), hostname="m0", manager=True,
+                listen_remote_api=("127.0.0.1", 0),
+                use_device_scheduler=False)
+    m0.start()
+    w = None
+    try:
+        api = m0.manager.control_api
+        # operator shrinks cert validity via the cluster spec; the
+        # leader's CA applies it live
+        c = api.store.view(
+            lambda tx: tx.find(Cluster, ByName("default")))[0].copy()
+        c.spec.ca_config.node_cert_expiry = 10.0
+        api.store.update(lambda tx: tx.update(c))
+        poll(lambda: m0.manager.root_ca.node_cert_expiry == 10.0,
+             msg="CA picks up node_cert_expiry from the cluster spec")
+
+        w = Swarmd(state_dir=tempfile.mkdtemp(), hostname="w0",
+                   join_addr=m0.server.addr,
+                   join_token=m0.manager.root_ca.join_token(0),
+                   cert_renew_interval=0.5)
+        w.start()
+        first = w.node.certificate
+        # issuance backdates not_valid_before 60s for clock skew, so
+        # check the remaining validity rather than the full lifetime
+        assert first.expires_at - time.time() < 15.0, \
+            "short validity should apply to issuance"
+
+        # the renewer must swap in a fresh cert at ~half-life
+        poll(lambda: w.node.certificate.expires_at > first.expires_at,
+             timeout=20, msg="cert renews before expiry")
+        wid = w.node.node_id
+
+        # past the ORIGINAL expiry the node is still a functioning member
+        time.sleep(max(0.0, first.expires_at - time.time()) + 0.5)
+        def ready():
+            nodes = [n for n in api.list_nodes() if n.id == wid]
+            return nodes and nodes[0].status.state == NodeState.READY
+        poll(ready, timeout=15,
+             msg="node stays READY past its first cert's expiry")
+        svc = api.create_service(make_replicated("fresh-cert", 2).spec)
+        poll(lambda: len([t for t in api.list_tasks(service_id=svc.id)
+                          if t.status.state == TaskState.RUNNING]) == 2,
+             timeout=30, msg="tasks still schedule after renewal")
+    finally:
+        if w is not None:
+            w.stop()
+        m0.stop()

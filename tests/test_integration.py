@@ -282,6 +282,8 @@ def test_demote_downed_manager_recovers_quorum():
             joiners.append(d)
         api = m0.manager.control_api
         assert m0.raft_node.core.peers == {"m-m0", "m-m1", "m-m2"}
+        poll(lambda: _has_node(api, "m-m2"),
+             msg="m2's node record registers before we kill it")
 
         joiners[1].stop()    # kill m2; 2-of-3 quorum survives
         _demote(api, "m-m2")

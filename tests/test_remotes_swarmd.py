@@ -19,6 +19,37 @@ from swarmkit_tpu.utils import new_id
 from test_orchestrator import make_replicated, poll
 
 
+def create_service_after_failover(daemons, spec, timeout=30):
+    """Create a service on whichever daemon currently leads, retrying
+    through post-failover churn.  Transient NotLeader / ProposalDropped
+    here is expected behavior — the reference's clients retry RPCs around
+    leadership changes — and AlreadyExists means an earlier "dropped"
+    proposal actually committed."""
+    from swarmkit_tpu.manager.controlapi import AlreadyExists
+
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        leader = next(
+            (d for d in daemons
+             if d.raft_node is not None and d.raft_node.is_leader
+             and d.manager is not None
+             and d.manager.dispatcher is not None), None)
+        if leader is not None:
+            api = leader.manager.control_api
+            try:
+                return api.create_service(spec)
+            except AlreadyExists:
+                name = spec.annotations.name
+                for s in api.list_services():
+                    if s.spec.annotations.name == name:
+                        return s
+            except Exception as e:
+                last = e
+        time.sleep(0.3)
+    raise AssertionError(f"create_service never succeeded: {last!r}")
+
+
 def test_remotes_weighted_selection():
     r = Remotes(("a", 1), ("b", 2))
     # both selectable initially
@@ -216,8 +247,8 @@ def test_swarmd_three_managers_survive_leader_death():
              msg="manager leadership follows raft")
         # the new leader can still commit (quorum = itself + the other
         # survivor)
-        svc = new_leader.manager.control_api.create_service(
-            make_replicated("post-failover", 1).spec)
+        svc = create_service_after_failover(
+            joiners, make_replicated("post-failover", 1).spec)
         assert svc.id
     finally:
         for d in joiners:
@@ -300,7 +331,8 @@ def test_swarmd_agents_follow_leader_after_death():
         poll(worker_ready, timeout=30,
              msg="worker should fail over to the new leader")
 
-        svc = api.create_service(make_replicated("after-failover", 2).spec)
+        svc = create_service_after_failover(
+            joiners, make_replicated("after-failover", 2).spec)
         # a replica may first land on the dead m0's agent node; it heals
         # once the heartbeat TTL marks that node DOWN (default 5s period
         # x grace), hence the generous timeout
