@@ -348,6 +348,10 @@ def main():
         warm_planner = TPUPlanner()
         warm_planner.enable_small_group_routing = False  # compile shapes
         one_tick(store, warm_planner)
+    # the adaptive router's launch-overhead probe compiles its own tiny
+    # shape on first use — warm it here or the FIRST headline trial pays
+    # a ~1s jit compile and p99 reports compile time, not scheduling
+    TPUPlanner()._measure_launch_overhead()
     if not SKIP_CONFIGS:
         # warm the preassigned-validation kernel (global-service share of
         # config 4) at its node-bucket shape

@@ -140,6 +140,9 @@ def _build_parser() -> argparse.ArgumentParser:
     autolock = cluster.add_parser("autolock")
     autolock.add_argument("mode", choices=["on", "off"])
     cluster.add_parser("unlock-key")
+    extca = cluster.add_parser("external-ca")
+    extca.add_argument("urls", nargs="*",
+                       help="CFSSL signer URLs; none = local signing")
     health = cluster.add_parser("health")
     health.add_argument("--service", default="")
 
@@ -437,6 +440,16 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
         if args.verb == "unlock-key":
             key = api.get_unlock_key()
             return key or "autolock is not enabled"
+        if args.verb == "external-ca":
+            # reference: swarmctl cluster update --external-ca; signing
+            # delegates to the CFSSL endpoint(s) (ca/external.go)
+            c = api.get_default_cluster()
+            spec = c.spec.copy()
+            spec.ca_config.external_cas = list(args.urls)
+            api.update_cluster(c.id, c.meta.version.index, spec)
+            if args.urls:
+                return "external CA signing: " + ", ".join(args.urls)
+            return "external CA signing disabled (local root signs)"
         if args.verb == "health":
             health = getattr(api, "health", None)
             if health is None:

@@ -347,18 +347,32 @@ class Manager:
             self._stop_event.wait(self.ca_rotation_check_interval)
 
     def _apply_ca_config(self) -> None:
-        """Live-apply ClusterSpec.ca_config to the signing CA — today
-        that is node_cert_expiry (reference: ca/server.go UpdateRootCA
-        reacting to CAConfig.NodeCertExpiry)."""
+        """Live-apply ClusterSpec.ca_config to the signing CA:
+        node_cert_expiry and external signer URLs (reference:
+        ca/server.go UpdateRootCA reacting to CAConfig, ca/external.go)."""
         clusters = self.store.view(
             lambda tx: tx.find(Cluster, ByName(DEFAULT_CLUSTER_NAME)))
         if not clusters:
             return
-        expiry = clusters[0].spec.ca_config.node_cert_expiry
+        cfg = clusters[0].spec.ca_config
+        expiry = cfg.node_cert_expiry
         if expiry > 0 and expiry != self.root_ca.node_cert_expiry:
             log.info("node cert expiry set to %.0fs from cluster spec",
                      expiry)
             self.root_ca.node_cert_expiry = expiry
+        urls = [u for u in (cfg.external_cas or []) if u]
+        current = self.ca_server.external
+        current_urls = current.urls if current is not None else []
+        if urls != current_urls:
+            if urls:
+                from ..security.external import ExternalCA
+                self.ca_server.external = ExternalCA(
+                    urls, org=self.root_ca.org,
+                    ca_cert_pem=self.root_ca.cert_pem)
+                log.info("external CA signing enabled: %s", urls)
+            else:
+                self.ca_server.external = None
+                log.info("external CA signing disabled")
 
     def _reconcile_ca_rotation(self) -> None:
         from ..models.types import NodeState

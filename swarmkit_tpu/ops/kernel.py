@@ -270,7 +270,13 @@ def plan_group(nodes: NodeInputs, group: GroupInputs, L: int,
     svc = jnp.clip(nodes.svc_tasks, 0, SVC_CLAMP)
     downweight = jnp.where(nodes.failures >= MAX_FAILURES,
                            jnp.clip(nodes.failures, 0, FAILURE_CLAMP), 0)
-    e = svc + downweight * F_BIG
+    # The waterfill needs a true per-node e.  broadcast_to is a no-op for
+    # today's full-width inputs; it future-proofs against callers shipping
+    # broadcastable length-1 stand-ins for no-signal arrays (tried for H2D
+    # savings and currently off — see the recompile trade-off note in
+    # planner._build_device_inputs before re-enabling).
+    e = jnp.broadcast_to(svc + downweight * F_BIG,
+                         nodes.ready.shape).astype(jnp.int32)
 
     # ---- stage A: allocation down the branch hierarchy
     # branch load counts every valid node's service tasks (feasible or not),

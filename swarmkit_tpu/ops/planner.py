@@ -345,13 +345,21 @@ class TPUPlanner:
             return (infos, 0, nb, valid, cpu, mem, total, None, None, 1,
                     (), 0, 0, [], False)
 
-        # ---- per-service arrays
+        # ---- per-service arrays.  NOTE: every input keeps its full node
+        # shape even when it carries no signal — shrinking no-signal
+        # arrays to broadcastable stand-ins was tried (saves ~40ms of H2D
+        # per tick on a tunneled link) and reverted: each narrow/wide
+        # combination is a distinct jit signature, so cluster-state flips
+        # (first failure, first active task) and new spec shapes trigger
+        # 20-40s XLA recompiles at runtime — a far worse trade.
         svc_tasks = np.zeros(nb, np.int32)
         failures = np.zeros(nb, np.int32)
         ts = now()
+        sid = t.service_id
         for i, info in enumerate(infos):
-            svc_tasks[i] = info.active_tasks_count_by_service.get(
-                t.service_id, 0)
+            c = info.active_tasks_count_by_service.get(sid, 0)
+            if c:
+                svc_tasks[i] = c
             if info.recent_failures:
                 failures[i] = info.count_recent_failures(ts, t)
 

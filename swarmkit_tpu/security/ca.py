@@ -26,10 +26,13 @@ import datetime
 import hashlib
 import hmac
 import json
+import logging
 import os
 import time
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
+
+log = logging.getLogger("security.ca")
 
 from cryptography import x509
 from cryptography.hazmat.primitives import hashes, serialization
@@ -564,10 +567,57 @@ class KeyReadWriter:
 
 class CAServer:
     """Issues certificates to token-bearing joiners
-    (reference: ca/server.go:420 Run / :234 IssueNodeCertificate)."""
+    (reference: ca/server.go:420 Run / :234 IssueNodeCertificate).
+
+    When ``external`` is set (ClusterSpec.ca_config.external_cas), CSR
+    signing is delegated to the CFSSL-style endpoint(s) instead of the
+    local root key (reference: ca/external.go); unreachable signers fall
+    back to local signing with a warning (documented deviation —
+    security/external.py)."""
 
     def __init__(self, root_ca: RootCA):
         self.root_ca = root_ca
+        self.external = None   # security.external.ExternalCA when set
+
+    def _sign(self, csr_pem: bytes, node_id: str, role: int) -> bytes:
+        ext = self.external   # snapshot: the config daemon may swap it
+        if ext is not None:
+            from .external import ExternalSigningError
+            try:
+                pem = ext.sign_csr(csr_pem, node_id, role)
+                self._check_external_cert(pem, csr_pem)
+                return pem
+            except ExternalSigningError as e:
+                log.warning("external CA signing failed (%s); "
+                            "falling back to local root", e)
+        return self.root_ca.sign_csr(csr_pem, node_id, role)
+
+    def _check_external_cert(self, cert_pem: bytes,
+                             csr_pem: bytes) -> None:
+        """A signer that 'succeeds' with a bad certificate must not
+        poison node identity: the result has to parse, chain to the
+        cluster root, and carry the CSR's public key — anything else is
+        a signing failure (and engages the local fallback)."""
+        from .external import ExternalSigningError
+        try:
+            cert = Certificate(cert_pem=cert_pem,
+                               ca_cert_pem=self.root_ca.trust_bundle())
+            self.root_ca.verify(cert)
+            csr = x509.load_pem_x509_csr(csr_pem)
+            cert_key = cert._x509().public_key().public_bytes(
+                serialization.Encoding.DER,
+                serialization.PublicFormat.SubjectPublicKeyInfo)
+            csr_key = csr.public_key().public_bytes(
+                serialization.Encoding.DER,
+                serialization.PublicFormat.SubjectPublicKeyInfo)
+            if cert_key != csr_key:
+                raise ExternalSigningError(
+                    "signer returned a certificate for a different key")
+        except ExternalSigningError:
+            raise
+        except Exception as e:
+            raise ExternalSigningError(
+                f"signer returned an invalid certificate: {e}") from e
 
     def issue_node_certificate(self, node_id: str, token: str,
                                csr_pem: Optional[bytes] = None):
@@ -576,7 +626,7 @@ class CAServer:
         incl. a server-generated key."""
         role = self.root_ca.role_for_token(token)
         if csr_pem is not None:
-            return self.root_ca.sign_csr(csr_pem, node_id, role)
+            return self._sign(csr_pem, node_id, role)
         return self.root_ca.issue(node_id, role)
 
     def renew(self, cert: Certificate,
@@ -592,5 +642,5 @@ class CAServer:
         if role is None:
             role = cert.role
         if csr_pem is not None:
-            return self.root_ca.sign_csr(csr_pem, cert.node_id, role)
+            return self._sign(csr_pem, cert.node_id, role)
         return self.root_ca.issue(cert.node_id, role)
