@@ -415,7 +415,14 @@ class ControlAPI:
 
     def create_secret(self, spec: SecretSpec) -> Secret:
         _validate_secret_annotations(spec.annotations)
-        if not spec.data or len(spec.data) >= MAX_SECRET_SIZE:
+        if spec.driver is not None and spec.driver.name:
+            # driver-backed secrets carry no payload — the value comes
+            # from the provider plugin at assignment time
+            # (reference: secret.go:251 validateSecretSpec driver branch)
+            if spec.data:
+                raise InvalidArgument(
+                    "driver-backed secrets must not carry data")
+        elif not spec.data or len(spec.data) >= MAX_SECRET_SIZE:
             raise InvalidArgument(
                 f"secret data must be larger than 0 and less than "
                 f"{MAX_SECRET_SIZE} bytes")

@@ -145,11 +145,19 @@ class _AssignmentSet:
     """Tracks what a node currently knows and computes diffs
     (reference: assignments.go newAssignmentSet)."""
 
-    def __init__(self, node_id: str):
+    def __init__(self, node_id: str, driver_provider=None):
         self.node_id = node_id
+        self.driver_provider = driver_provider
         self.tasks: Dict[str, Task] = {}
         self.deps_use: Dict[Tuple[str, str], Set[str]] = {}  # (kind,id)->task ids
         self.changes: Dict[Tuple[str, str], tuple] = {}
+        # driver-backed secrets marked DoNotReuse get task-specific ids
+        # (reference: assignments.go assignSecret): (task_id, secret_id)
+        # -> combined assignment id
+        self._secret_alias: Dict[Tuple[str, str], str] = {}
+        # tasks whose driver-secret fetch failed; the assignments loop
+        # retries them on idle ticks until the provider recovers
+        self.pending_secret_retry: Set[str] = set()
 
     # --- dependencies
 
@@ -172,17 +180,86 @@ class _AssignmentSet:
 
     def _add_task_deps(self, tx, t: Task) -> None:
         for key in self._task_deps(t):
+            kind, obj_id = key
+            if kind == "secret":
+                self._assign_secret(tx, t, obj_id)
+                continue
             users = self.deps_use.setdefault(key, set())
             if not users:
-                kind, obj_id = key
                 obj = tx.get(self._DEP_TYPES[kind], obj_id)
                 if obj is not None:
                     self.changes[key] = ("update", kind, obj)
             users.add(t.id)
 
+    def _assign_secret(self, tx, t: Task, secret_id: str) -> None:
+        """Plain secrets ship the stored object; driver-backed secrets
+        fetch their value from the provider plugin, and DoNotReuse values
+        get a task-specific id so different tasks can receive different
+        values (reference: assignments.go assignSecret + drivers/)."""
+        alias = self._secret_alias.get((t.id, secret_id))
+        if alias is not None:
+            # re-add of a task whose task-specific secret already shipped
+            self.deps_use.setdefault(("secret", alias), set()).add(t.id)
+            return
+        base_key = ("secret", secret_id)
+        if self.deps_use.get(base_key):
+            # already shipped under its own id (plain, or driver-fetched
+            # reusable) — don't re-fetch the value per additional task
+            self.deps_use[base_key].add(t.id)
+            return
+        obj = tx.get(Secret, secret_id)
+        key = base_key
+        if obj is not None and obj.spec.driver is not None \
+                and obj.spec.driver.name:
+            if self.driver_provider is None:
+                log.warning("secret %s needs driver %r but no provider "
+                            "is registered; assignment skipped",
+                            secret_id[:8], obj.spec.driver.name)
+                return
+            try:
+                d = self.driver_provider.new_secret_driver(obj.spec.driver)
+                value, no_reuse = d.get(obj.spec, t)
+            except Exception:
+                # fetch errors skip the assignment; the assignments loop
+                # retries on idle ticks, so the task (shipped without its
+                # secret, hence PREPARING) recovers with the provider
+                log.exception("fetching driver secret %s failed",
+                              secret_id[:8])
+                self.pending_secret_retry.add(t.id)
+                return
+            obj = obj.copy()
+            obj.spec.data = value
+            if no_reuse:
+                combined = f"{secret_id}.{t.id}"
+                obj.id = combined
+                obj.internal = True
+                self._secret_alias[(t.id, secret_id)] = combined
+                key = ("secret", combined)
+        users = self.deps_use.setdefault(key, set())
+        if not users and obj is not None:
+            self.changes[key] = ("update", "secret", obj)
+        users.add(t.id)
+
+    def retry_pending_secrets(self, tx) -> bool:
+        """Re-attempt driver-secret fetches that failed earlier; returns
+        True when a retry shipped something new."""
+        n_before = len(self.changes)
+        for tid in list(self.pending_secret_retry):
+            self.pending_secret_retry.discard(tid)
+            t = self.tasks.get(tid)
+            if t is not None:
+                self._add_task_deps(tx, t)
+        return len(self.changes) > n_before
+
     def _release_task_deps(self, t: Task) -> bool:
         modified = False
+        self.pending_secret_retry.discard(t.id)
         for key in self._task_deps(t):
+            kind, obj_id = key
+            if kind == "secret":
+                alias = self._secret_alias.pop((t.id, obj_id), None)
+                if alias is not None:
+                    key = ("secret", alias)
             users = self.deps_use.get(key)
             if users is None:
                 continue
@@ -244,8 +321,12 @@ class _AssignmentSet:
 
 class Dispatcher:
     def __init__(self, store: MemoryStore,
-                 config: Optional[Config_] = None):
+                 config: Optional[Config_] = None,
+                 driver_provider=None):
         self.store = store
+        # resolves SecretSpec.driver to provider plugins
+        # (reference: manager/drivers/provider.go)
+        self.driver_provider = driver_provider
         # private copy: cluster-spec reloads must not mutate the caller's
         # (e.g. the Manager's) config object, which seeds future
         # dispatchers on later leadership cycles
@@ -671,7 +752,8 @@ class Dispatcher:
 
     def _assignments_loop(self, stream: AssignmentStream, node_id: str,
                           session_id: str) -> None:
-        aset = _AssignmentSet(node_id)
+        aset = _AssignmentSet(node_id,
+                              driver_provider=self.driver_provider)
         sequence = 0
         applies_to = ""
 
@@ -691,14 +773,22 @@ class Dispatcher:
                     and ev.obj.node_id == node_id)
 
         def init(tx):
-            for t in tx.find(Task, ByNode(node_id)):
-                aset.add_or_update_task(tx, t)
+            return list(tx.find(Task, ByNode(node_id)))
 
         try:
-            _, sub = self.store.view_and_watch(init, predicate=pred)
+            initial, sub = self.store.view_and_watch(init, predicate=pred)
         except Exception as e:
             stream.close(e)
             return
+        # dependency assembly — including possibly-slow driver-secret
+        # plugin fetches — runs OUTSIDE view_and_watch's init callback:
+        # init holds the store's update lock, and a slow (or store-
+        # calling) provider plugin must not stall or deadlock every
+        # store write.  Events queued since the snapshot replay after
+        # and re-adds are idempotent.
+        tx0 = self.store.view()
+        for t in initial:
+            aset.add_or_update_task(tx0, t)
         try:
             send(AssignmentsMessage.COMPLETE)
             cfg = self.config
@@ -720,6 +810,12 @@ class Dispatcher:
                             else None
                     except TimeoutError:
                         if deadline is None:
+                            if aset.pending_secret_retry and \
+                                    aset.retry_pending_secrets(
+                                        self.store.view()):
+                                modifications += 1
+                                deadline = now() + \
+                                    cfg.assignment_batching_wait
                             continue
                         ev = None
                     except Closed:
@@ -728,6 +824,12 @@ class Dispatcher:
                     if ev is None:
                         if deadline is not None and now() >= deadline:
                             break
+                        if aset.pending_secret_retry and \
+                                aset.retry_pending_secrets(
+                                    self.store.view()):
+                            modifications += 1
+                            deadline = now() + \
+                                cfg.assignment_batching_wait
                         continue
                     t = ev.obj
                     if isinstance(t, Volume):

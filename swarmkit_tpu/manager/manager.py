@@ -50,11 +50,14 @@ class Manager:
                  root_ca: Optional[RootCA] = None,
                  dispatcher_config: Optional[DispatcherConfig] = None,
                  use_device_scheduler: bool = True,
-                 csi_plugins: Optional[dict] = None):
+                 csi_plugins: Optional[dict] = None,
+                 secret_plugins: Optional[dict] = None):
         """``raft_node``: a state.raft.RaftNode already wired as the
         store's proposer, or None for standalone single-manager mode.
         ``csi_plugins``: name -> CSIPlugin for the CSI controller manager
-        (an in-memory plugin named "inmem" is always available)."""
+        (an in-memory plugin named "inmem" is always available).
+        ``secret_plugins``: name -> endpoint URL or callable for
+        driver-backed secrets (reference: manager/drivers)."""
         self.node_id = node_id or new_id()
         self.raft = raft_node
         self.store = store if store is not None else (
@@ -69,6 +72,8 @@ class Manager:
         self.control_api.root_ca = self.root_ca
         self.control_api.health = self.health_check
         self.watch_server = WatchServer(self.store)
+        from .drivers import DriverProvider
+        self.driver_provider = DriverProvider(secret_plugins)
         self.logbroker = LogBroker(self.store)
         self.ca_server = CAServer(self.root_ca)
         self.collector = Collector(self.store)
@@ -289,8 +294,9 @@ class Manager:
             self._is_leader = True
             log.info("manager %s became leader", self.node_id[:8])
             restarts = RestartSupervisor(self.store)
-            self.dispatcher = Dispatcher(self.store,
-                                         self._dispatcher_config)
+            self.dispatcher = Dispatcher(
+                self.store, self._dispatcher_config,
+                driver_provider=self.driver_provider)
             # agents publish task logs through their dispatcher surface;
             # the CLI reads them back via the control api
             self.dispatcher.log_broker = self.logbroker
@@ -318,11 +324,13 @@ class Manager:
             plugins = dict(self._csi_plugins)
             plugins.setdefault("inmem", InMemoryCSIPlugin("inmem"))
             self.csi_manager = CSIManager(self.store, plugins=plugins)
+            from .deallocator import Deallocator
+            self.deallocator = Deallocator(self.store)
             for loop in (self.allocator, self.scheduler, self.replicated,
                          self.global_, self.jobs, self.reaper,
                          self.constraint_enforcer, self.volume_enforcer,
                          self.keymanager, self.role_manager,
-                         self.csi_manager):
+                         self.csi_manager, self.deallocator):
                 loop.start()
             if self._rotation_thread is None \
                     or not self._rotation_thread.is_alive():
@@ -524,7 +532,8 @@ class Manager:
             # return empty
             self.control_api.log_broker = None
             log.info("manager %s lost leadership", self.node_id[:8])
-            loops = [self.csi_manager, self.role_manager,
+            loops = [getattr(self, "deallocator", None),
+                     self.csi_manager, self.role_manager,
                      self.keymanager, self.volume_enforcer,
                      self.constraint_enforcer, self.reaper, self.jobs,
                      self.global_, self.replicated, self.scheduler,
@@ -538,6 +547,7 @@ class Manager:
             self.dispatcher = self.allocator = self.scheduler = None
             self.replicated = self.global_ = self.jobs = None
             self.csi_manager = None
+            self.deallocator = None
             self.reaper = None
             self.constraint_enforcer = self.volume_enforcer = None
             self.keymanager = None

@@ -297,3 +297,176 @@ def test_e2e_agent_death_reschedules_tasks(store):
         sched.stop()
         alloc.stop()
         d.stop()
+
+
+def test_driver_backed_secrets_fetch_per_task_values(store):
+    """Secrets with spec.driver fetch their value from a provider plugin
+    at assignment time; DoNotReuse providers yield task-specific secret
+    ids/values (reference: manager/drivers/secrets.go + assignments.go
+    assignSecret)."""
+    from swarmkit_tpu.manager.drivers import DriverProvider
+    from swarmkit_tpu.models import Secret
+    from swarmkit_tpu.models.specs import ContainerSpec, SecretSpec, TaskSpec
+    from swarmkit_tpu.models.types import Driver, SecretReference
+
+    calls = []
+
+    def plugin(req):
+        calls.append(req)
+        import base64
+        value = f"v-for-{req['TaskID']}".encode()
+        return {"Value": base64.b64encode(value).decode(),
+                "DoNotReuse": True}
+
+    provider = DriverProvider({"vault": plugin})
+    d = Dispatcher(store, fast_config(), driver_provider=provider)
+    d.run()
+    node = make_ready_node("n1")
+    secret = Secret(id=new_id(), spec=SecretSpec(
+        annotations=Annotations(name="db-pass"),
+        driver=Driver(name="vault")))
+
+    def mk_task(slot):
+        return Task(id=new_id(), service_id="svc", slot=slot,
+                    node_id=node.id, desired_state=TaskState.RUNNING,
+                    status=TaskStatus(state=TaskState.ASSIGNED),
+                    spec=TaskSpec(container=ContainerSpec(
+                        image="img", secrets=[SecretReference(
+                            secret_id=secret.id, secret_name="db-pass")])))
+
+    t1, t2 = mk_task(1), mk_task(2)
+
+    def setup(tx):
+        tx.create(node)
+        tx.create(secret)
+        tx.create(t1)
+        tx.create(t2)
+    store.update(setup)
+    try:
+        session, _ = d.register(node.id)
+        stream = d.open_assignments(node.id, session)
+        msg = stream.get(timeout=2)
+        assert msg.type == "complete"
+        secrets = {obj.id: obj for _, kind, obj in msg.changes
+                   if kind == "secret"}
+        assert set(secrets) == {f"{secret.id}.{t1.id}",
+                                f"{secret.id}.{t2.id}"}, \
+            "DoNotReuse secrets must get task-specific ids"
+        assert secrets[f"{secret.id}.{t1.id}"].spec.data == \
+            f"v-for-{t1.id}".encode()
+        assert secrets[f"{secret.id}.{t2.id}"].spec.data == \
+            f"v-for-{t2.id}".encode()
+        assert all(s.internal for s in secrets.values())
+        assert len(calls) == 2
+        assert calls[0]["SecretName"] == "db-pass"
+        assert calls[0]["NodeID"] == node.id
+    finally:
+        d.stop()
+
+
+def test_driver_secret_fetch_error_skips_assignment(store):
+    """Provider failures leave the secret unassigned rather than shipping
+    an empty value (reference: assignments.go fetch-error path)."""
+    from swarmkit_tpu.manager.drivers import DriverProvider
+    from swarmkit_tpu.models import Secret
+    from swarmkit_tpu.models.specs import ContainerSpec, SecretSpec, TaskSpec
+    from swarmkit_tpu.models.types import Driver, SecretReference
+
+    def bad_plugin(req):
+        return {"Err": "vault is sealed"}
+
+    provider = DriverProvider({"vault": bad_plugin})
+    d = Dispatcher(store, fast_config(), driver_provider=provider)
+    d.run()
+    node = make_ready_node("n1")
+    secret = Secret(id=new_id(), spec=SecretSpec(
+        annotations=Annotations(name="db-pass"),
+        driver=Driver(name="vault")))
+    t1 = Task(id=new_id(), service_id="svc", slot=1, node_id=node.id,
+              desired_state=TaskState.RUNNING,
+              status=TaskStatus(state=TaskState.ASSIGNED),
+              spec=TaskSpec(container=ContainerSpec(
+                  image="img", secrets=[SecretReference(
+                      secret_id=secret.id, secret_name="db-pass")])))
+
+    def setup(tx):
+        tx.create(node)
+        tx.create(secret)
+        tx.create(t1)
+    store.update(setup)
+    try:
+        session, _ = d.register(node.id)
+        stream = d.open_assignments(node.id, session)
+        msg = stream.get(timeout=2)
+        assert msg.type == "complete"
+        assert [obj.id for _, kind, obj in msg.changes
+                if kind == "secret"] == [], \
+            "failed driver fetch must not ship a secret"
+        assert [obj.id for _, kind, obj in msg.changes
+                if kind == "task"] == [t1.id], "the task still ships"
+    finally:
+        d.stop()
+
+
+def test_driver_secret_retries_until_provider_recovers(store):
+    """A transient provider outage heals: the assignments loop retries
+    failed fetches on idle ticks and ships the secret once the provider
+    answers."""
+    from swarmkit_tpu.manager.drivers import DriverProvider
+    from swarmkit_tpu.models import Secret
+    from swarmkit_tpu.models.specs import ContainerSpec, SecretSpec, TaskSpec
+    from swarmkit_tpu.models.types import Driver, SecretReference
+
+    state = {"n": 0}
+
+    def flaky_plugin(req):
+        state["n"] += 1
+        if state["n"] <= 2:
+            return {"Err": "vault sealed"}
+        import base64
+        return {"Value": base64.b64encode(b"recovered").decode()}
+
+    provider = DriverProvider({"vault": flaky_plugin})
+    d = Dispatcher(store, fast_config(), driver_provider=provider)
+    d.run()
+    node = make_ready_node("n1")
+    secret = Secret(id=new_id(), spec=SecretSpec(
+        annotations=Annotations(name="db-pass"),
+        driver=Driver(name="vault")))
+    t1 = Task(id=new_id(), service_id="svc", slot=1, node_id=node.id,
+              desired_state=TaskState.RUNNING,
+              status=TaskStatus(state=TaskState.ASSIGNED),
+              spec=TaskSpec(container=ContainerSpec(
+                  image="img", secrets=[SecretReference(
+                      secret_id=secret.id, secret_name="db-pass")])))
+
+    def setup(tx):
+        tx.create(node)
+        tx.create(secret)
+        tx.create(t1)
+    store.update(setup)
+    try:
+        session, _ = d.register(node.id)
+        stream = d.open_assignments(node.id, session)
+        msg = stream.get(timeout=2)
+        assert msg.type == "complete"
+        assert not [o for _, k, o in msg.changes if k == "secret"]
+
+        # the loop's idle-tick retry eventually ships it
+        deadline = time.time() + 10
+        got = None
+        while time.time() < deadline:
+            d.heartbeat(node.id, session)   # keep the session alive
+            try:
+                msg = stream.get(timeout=0.25)
+            except TimeoutError:
+                continue
+            secrets = [o for _, k, o in msg.changes if k == "secret"]
+            if secrets:
+                got = secrets[0]
+                break
+        assert got is not None, "secret never shipped after recovery"
+        assert got.spec.data == b"recovered"
+        assert state["n"] >= 3
+    finally:
+        d.stop()
