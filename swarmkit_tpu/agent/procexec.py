@@ -48,13 +48,19 @@ class ProcessController(Controller):
     """Supervises one task's process (reference: dockerapi/controller.go)."""
 
     def __init__(self, task: Task, log_dir: str,
-                 stop_grace: float = STOP_GRACE_PERIOD, volumes=None):
+                 stop_grace: float = STOP_GRACE_PERIOD, volumes=None,
+                 dependencies=None):
         self.task = task
         self.log_dir = log_dir
         self.stop_grace = stop_grace
         self.volumes = volumes   # node-side CSI manager (paths by id)
+        # worker-backed secret/config getter (secret_for/config_for)
+        self.dependencies = dependencies
         self.proc: Optional[subprocess.Popen] = None
         self.log_path = os.path.join(log_dir, f"{task.id}.log")
+        # secrets/configs materialize as files here (the process
+        # equivalent of the reference's /run/secrets mounts)
+        self.deps_dir = os.path.join(log_dir, f"{task.id}.deps")
         self._argv: Optional[list] = None
         self._env: Optional[dict] = None
         self._cwd: Optional[str] = None
@@ -83,26 +89,86 @@ class ProcessController(Controller):
         # published CSI volume paths surface as SWARM_VOLUME_<TARGET>
         # env vars (process tasks have no mount namespace to bind into);
         # a task with an unpublished volume must not start yet
+        used_keys = set()
         if self.volumes is not None:
-            used_keys = set()
             for va in self.task.volumes:
                 path = self.volumes.get(va.id)
                 if path is None:
-                    raise ErrTaskRetry(
+                    # TemporaryError: do_task retries with backoff, the
+                    # task stays PREPARING until the volume publishes
+                    raise TemporaryError(
                         f"volume {va.id[:8]} not yet published on node")
-                mangled = "".join(ch if ch.isalnum() else "_"
-                                  for ch in va.target.strip("/")).upper()
-                key = "SWARM_VOLUME_" + (mangled or "ROOT")
-                if key in used_keys:
-                    # distinct targets can mangle identically
-                    # (/data-1 vs /data.1): disambiguate by volume id
-                    key = f"{key}_{va.id[:6].upper()}"
-                used_keys.add(key)
+                key = self._dep_env_key("SWARM_VOLUME_", va.target,
+                                        va.id, used_keys)
                 env[key] = path
+        # secrets/configs materialize as files under a per-task dir;
+        # their paths surface as SWARM_SECRET_<NAME> / SWARM_CONFIG_<NAME>
+        # env vars (the reference bind-mounts them at /run/secrets — a
+        # process task has no mount namespace, so files + env it is).
+        # A referenced-but-undelivered dependency delays the start: the
+        # dispatcher ships deps before tasks, but a driver-backed secret
+        # whose provider is down arrives late (reference: the container
+        # waits in PREPARING until its secrets resolve)
+        if self.dependencies is not None:
+            for ref in spec.secrets:
+                obj = self.dependencies.secret_for(self.task.id,
+                                                   ref.secret_id)
+                if obj is None:
+                    # TemporaryError: retried with backoff — a driver-
+                    # backed secret whose provider was down arrives late
+                    raise TemporaryError(
+                        f"secret {ref.secret_name or ref.secret_id[:8]} "
+                        "not yet delivered to this node")
+                key = self._dep_env_key("SWARM_SECRET_",
+                                        ref.target or ref.secret_name,
+                                        ref.secret_id, used_keys)
+                env[key] = self._write_dep(
+                    "secrets", ref.target or ref.secret_name
+                    or ref.secret_id, obj.spec.data, 0o600)
+            for ref in spec.configs:
+                obj = self.dependencies.config_for(self.task.id,
+                                                   ref.config_id)
+                if obj is None:
+                    raise TemporaryError(
+                        f"config {ref.config_name or ref.config_id[:8]} "
+                        "not yet delivered to this node")
+                key = self._dep_env_key("SWARM_CONFIG_",
+                                        ref.target or ref.config_name,
+                                        ref.config_id, used_keys)
+                env[key] = self._write_dep(
+                    "configs", ref.target or ref.config_name
+                    or ref.config_id, obj.spec.data, 0o644)
         self._argv = argv
         self._env = env
         self._cwd = spec.dir or None
         os.makedirs(self.log_dir, exist_ok=True)
+
+    @staticmethod
+    def _dep_env_key(prefix: str, name: str, obj_id: str,
+                     used_keys: set) -> str:
+        """One mangle for every dependency kind; distinct names can
+        mangle identically (db-pass vs db.pass), so collisions
+        disambiguate by object id."""
+        mangled = "".join(ch if ch.isalnum() else "_"
+                          for ch in (name or "").strip("/")).upper()
+        key = prefix + (mangled or "UNNAMED")
+        if key in used_keys:
+            key = f"{key}_{obj_id[:6].upper()}"
+        used_keys.add(key)
+        return key
+
+    def _write_dep(self, kind: str, name: str, data: bytes,
+                   mode: int) -> str:
+        """Secrets and configs live in separate subdirs so same-named
+        targets cannot overwrite each other across kinds."""
+        d = os.path.join(self.deps_dir, kind)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, name.strip("/").replace("/", "_") or "dep")
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode)
+        os.fchmod(fd, mode)   # O_CREAT mode only applies to new files
+        with os.fdopen(fd, "wb") as f:
+            f.write(data or b"")
+        return path
 
     def start(self) -> None:
         if self.proc is not None:
@@ -181,9 +247,16 @@ class ProcessController(Controller):
             os.unlink(self.log_path)
         except OSError:
             pass
+        import shutil
+        shutil.rmtree(self.deps_dir, ignore_errors=True)
 
     def close(self) -> None:
         self._close_log()
+        # plaintext secret material must not outlive the task's
+        # controller (remove() has no caller in the task lifecycle;
+        # close() always runs when the manager winds down)
+        import shutil
+        shutil.rmtree(self.deps_dir, ignore_errors=True)
 
     def _close_log(self) -> None:
         if self._log_file is not None:
@@ -213,6 +286,8 @@ class ProcessExecutor(Executor):
         # node-side CSI manager, injected by the Worker so controllers
         # can hand tasks their published volume paths
         self.volumes = None
+        # worker-backed secret/config getter, injected by the Worker
+        self.dependencies = None
         self.hostname = hostname or socket.gethostname()
         self.log_dir = log_dir or os.path.join(
             tempfile.gettempdir(), "swarmkit-tpu-tasks")
@@ -241,7 +316,8 @@ class ProcessExecutor(Executor):
     def controller(self, t: Task) -> ProcessController:
         ctlr = ProcessController(t, self.log_dir,
                                  stop_grace=self.stop_grace,
-                                 volumes=self.volumes)
+                                 volumes=self.volumes,
+                                 dependencies=self.dependencies)
         with self._mu:
             self.controllers[t.id] = ctlr
             self._sweep_locked()

@@ -130,6 +130,11 @@ class Worker:
             # executors read published volume paths from here (the
             # reference hands controllers a restricted volume getter)
             executor.volumes = volumes
+        # executors resolve secret/config dependencies through the worker
+        # (reference: agent/dependency.go dependencyManager handed to
+        # controllers as a restricted getter)
+        if hasattr(executor, "dependencies"):
+            executor.dependencies = self
         self._mu = threading.Lock()
         self.task_managers: Dict[str, TaskManager] = {}
         self.secrets: Dict[str, Secret] = {}
@@ -140,6 +145,19 @@ class Worker:
         self._pending_volume_removals: set = set()
         self._closing_tasks: Dict[str, Task] = {}
         self._closed = False
+
+    # ------------------------------------------------- dependency getters
+
+    def secret_for(self, task_id: str, secret_id: str):
+        """Resolve a task's secret: task-specific id first (driver-backed
+        DoNotReuse values ship as '<secret_id>.<task_id>'), then the
+        shared id (reference: agent/secrets.go taskRestrictedSecrets +
+        identity.CombineTwoIDs naming)."""
+        return (self.secrets.get(f"{secret_id}.{task_id}")
+                or self.secrets.get(secret_id))
+
+    def config_for(self, task_id: str, config_id: str):
+        return self.configs.get(config_id)
 
     def init_from_db(self) -> None:
         """Resume supervision of persisted assigned tasks before the

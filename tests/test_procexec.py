@@ -214,3 +214,44 @@ def test_cli_service_logs(cluster):
     # but the tail of the output must land inside the window
     assert "line-" in out and "line-3" in out
     assert "logger." in out and "@" in out
+
+
+def test_process_task_receives_secret_and_config_files(cluster):
+    """Secrets/configs materialize as per-task files with their paths in
+    SWARM_SECRET_* / SWARM_CONFIG_* env vars (the process equivalent of
+    the reference's /run/secrets mounts)."""
+    from swarmkit_tpu.models.specs import ConfigSpec, SecretSpec
+    from swarmkit_tpu.models.types import ConfigReference, SecretReference
+
+    manager, node, executor = cluster
+    api = manager.control_api
+    secret = api.create_secret(SecretSpec(
+        annotations=Annotations(name="db-pass"), data=b"hunter2"))
+    config = api.create_config(ConfigSpec(
+        annotations=Annotations(name="app-conf"), data=b"mode=fast"))
+    out = os.path.join(tempfile.mkdtemp(), "out")
+    spec = proc_service(
+        "secretuser", 1,
+        ["sh", "-c",
+         f'cat "$SWARM_SECRET_DB_PASS" "$SWARM_CONFIG_APP_CONF" > {out}'])
+    spec.task.container.secrets = [SecretReference(
+        secret_id=secret.id, secret_name="db-pass", target="db-pass")]
+    spec.task.container.configs = [ConfigReference(
+        config_id=config.id, config_name="app-conf",
+        target="app-conf")]
+    svc = api.create_service(spec)
+    poll(lambda: [t for t in api.list_tasks(service_id=svc.id)
+                  if t.status.state == TaskState.COMPLETE] or None,
+         timeout=20, msg="secret-using task completes")
+    with open(out, "rb") as f:
+        assert f.read() == b"hunter2mode=fast"
+    # secret file mode is owner-only
+    t = api.list_tasks(service_id=svc.id)[0]
+    ctlr = executor.controllers[t.id]
+    spath = os.path.join(ctlr.deps_dir, "secrets", "db-pass")
+    # the file may already be cleaned with the task; check only if present
+    if os.path.exists(spath):
+        assert (os.stat(spath).st_mode & 0o777) == 0o600
+    # controller close must shred the plaintext material
+    ctlr.close()
+    assert not os.path.exists(ctlr.deps_dir)
