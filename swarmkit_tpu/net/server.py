@@ -144,12 +144,25 @@ class ManagerServer:
                             "id": rid, "error": str(e),
                             "code": getattr(e, "code", "internal")})
                     return
+                # per-RPC count + latency + error metrics, the
+                # grpc-prometheus interceptor equivalent (reference:
+                # manager.go:552,563); surfaced by /metrics
+                from ..utils.metrics import registry as _metrics
+                import time as _time
+                _t0 = _time.perf_counter()
                 try:
                     result = self._dispatch(method, params, cert)
                     send_frame(sock, {"id": rid, "result": result})
+                    _metrics.counter(f"swarm_rpc{{method=\"{method}\"}}")
                 except Exception as e:
+                    _metrics.counter(
+                        f"swarm_rpc_errors{{method=\"{method}\","
+                        f"code=\"{getattr(e, 'code', 'internal')}\"}}")
                     send_frame(sock, {"id": rid, "error": str(e),
                                       "code": getattr(e, "code", "internal")})
+                finally:
+                    _metrics.timer("swarm_rpc_latency").observe(
+                        _time.perf_counter() - _t0)
         except (ConnectionError, OSError):
             pass
         except Exception:

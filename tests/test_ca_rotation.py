@@ -278,6 +278,10 @@ def test_health_api_and_metrics_endpoint():
         body = urllib.request.urlopen(base + "/metrics",
                                       timeout=5).read().decode()
         assert "swarm_store_write_tx_latency_seconds_count" in body
+        # per-RPC interceptor metrics: the remote health probes above
+        # must have counted (reference: grpc-prometheus interceptors)
+        assert 'swarm_rpc{method="health"}_total' in body
+        assert "swarm_rpc_latency_seconds_count" in body
 
         assert urllib.request.urlopen(
             base + "/healthz", timeout=5).read().strip() == b"SERVING"
