@@ -22,6 +22,7 @@ import socket
 import socketserver
 import ssl
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from ..models.objects import STORE_OBJECT_TYPES
@@ -31,6 +32,7 @@ from ..security.ca import Certificate, SecurityError
 from ..security.tls import peer_certificate, server_context
 from ..state import serde
 from ..state.watch import Closed
+from ..utils.metrics import registry as metrics
 from .wire import recv_frame, send_frame
 
 log = logging.getLogger("net.server")
@@ -146,23 +148,28 @@ class ManagerServer:
                     return
                 # per-RPC count + latency + error metrics, the
                 # grpc-prometheus interceptor equivalent (reference:
-                # manager.go:552,563); surfaced by /metrics
-                from ..utils.metrics import registry as _metrics
-                import time as _time
-                _t0 = _time.perf_counter()
+                # manager.go:552,563); surfaced by /metrics.  The method
+                # label on successes is bounded by the dispatch table
+                # (unknown methods always error); error counters carry
+                # only the code, so client-chosen strings can never grow
+                # the registry or corrupt the exposition format.
+                t0 = time.perf_counter()
+                error = None
                 try:
                     result = self._dispatch(method, params, cert)
-                    send_frame(sock, {"id": rid, "result": result})
-                    _metrics.counter(f"swarm_rpc{{method=\"{method}\"}}")
                 except Exception as e:
-                    _metrics.counter(
-                        f"swarm_rpc_errors{{method=\"{method}\","
-                        f"code=\"{getattr(e, 'code', 'internal')}\"}}")
-                    send_frame(sock, {"id": rid, "error": str(e),
-                                      "code": getattr(e, "code", "internal")})
-                finally:
-                    _metrics.timer("swarm_rpc_latency").observe(
-                        _time.perf_counter() - _t0)
+                    error = e
+                metrics.timer("swarm_rpc_latency").observe(
+                    time.perf_counter() - t0)
+                if error is None:
+                    metrics.counter(
+                        f'swarm_rpc{{method="{method}"}}')
+                    send_frame(sock, {"id": rid, "result": result})
+                else:
+                    code = getattr(error, "code", "internal")
+                    metrics.counter(f'swarm_rpc_errors{{code="{code}"}}')
+                    send_frame(sock, {"id": rid, "error": str(error),
+                                      "code": code})
         except (ConnectionError, OSError):
             pass
         except Exception:

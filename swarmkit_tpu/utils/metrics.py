@@ -87,7 +87,13 @@ class Registry:
         lines: List[str] = []
         with self._lock:
             for name, v in sorted(self.counters.items()):
-                lines.append(f"{name}_total {v:g}")
+                if "{" in name:
+                    # labeled counter: the _total suffix belongs on the
+                    # metric NAME, before the label braces
+                    base, labels = name.split("{", 1)
+                    lines.append(f"{base}_total{{{labels} {v:g}")
+                else:
+                    lines.append(f"{name}_total {v:g}")
             for name, v in sorted(self.gauges.items()):
                 lines.append(f"{name} {v:g}")
             timers = list(self.timers.items())

@@ -279,8 +279,10 @@ def test_health_api_and_metrics_endpoint():
                                       timeout=5).read().decode()
         assert "swarm_store_write_tx_latency_seconds_count" in body
         # per-RPC interceptor metrics: the remote health probes above
-        # must have counted (reference: grpc-prometheus interceptors)
-        assert 'swarm_rpc{method="health"}_total' in body
+        # must have counted (reference: grpc-prometheus interceptors);
+        # labeled counters must render valid exposition format
+        # (name_total{labels} value)
+        assert 'swarm_rpc_total{method="health"}' in body
         assert "swarm_rpc_latency_seconds_count" in body
 
         assert urllib.request.urlopen(
