@@ -140,6 +140,13 @@ def _build_parser() -> argparse.ArgumentParser:
     autolock = cluster.add_parser("autolock")
     autolock.add_argument("mode", choices=["on", "off"])
     cluster.add_parser("unlock-key")
+    cupdate = cluster.add_parser("update")
+    cupdate.add_argument("--heartbeat-period", type=float, default=None,
+                         help="dispatcher heartbeat period, seconds")
+    cupdate.add_argument("--cert-expiry", type=float, default=None,
+                         help="node certificate validity, seconds")
+    cupdate.add_argument("--task-history-limit", type=int, default=None,
+                         help="retained terminal tasks per slot")
     extca = cluster.add_parser("external-ca")
     extca.add_argument("urls", nargs="*",
                        help="CFSSL signer URLs; none = local signing")
@@ -440,6 +447,29 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
         if args.verb == "unlock-key":
             key = api.get_unlock_key()
             return key or "autolock is not enabled"
+        if args.verb == "update":
+            # reference: swarmctl cluster update flags (dispatcher
+            # heartbeat, CA cert expiry, orchestration history); all are
+            # store-watched and take effect live
+            c = api.get_default_cluster()
+            spec = c.spec.copy()
+            changed = []
+            if args.heartbeat_period is not None:
+                spec.dispatcher.heartbeat_period = args.heartbeat_period
+                changed.append(
+                    f"heartbeat-period={args.heartbeat_period:g}s")
+            if args.cert_expiry is not None:
+                spec.ca_config.node_cert_expiry = args.cert_expiry
+                changed.append(f"cert-expiry={args.cert_expiry:g}s")
+            if args.task_history_limit is not None:
+                spec.orchestration.task_history_retention_limit = \
+                    args.task_history_limit
+                changed.append(
+                    f"task-history-limit={args.task_history_limit}")
+            if not changed:
+                return "nothing to update"
+            api.update_cluster(c.id, c.meta.version.index, spec)
+            return "updated: " + ", ".join(changed)
         if args.verb == "external-ca":
             # reference: swarmctl cluster update --external-ca; signing
             # delegates to the CFSSL endpoint(s) (ca/external.go)

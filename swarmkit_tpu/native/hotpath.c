@@ -597,6 +597,12 @@ block_commit(PyObject *self, PyObject *args)
                           &PyDict_Type, &by_node, &ts, &state, &message,
                           &seq, &guard_state))
         return NULL;
+    /* the guard is an IntEnum: convert once so the per-task check is a
+     * plain C compare instead of a RichCompare through enum __ge__ */
+    long long guard_ll = PyLong_AsLongLong(guard_state);
+    int guard_ok = !(guard_ll == -1 && PyErr_Occurred());
+    if (!guard_ok)
+        PyErr_Clear();
     Py_ssize_t n = PyList_GET_SIZE(old_tasks);
     if (PyList_GET_SIZE(node_ids) != n) {
         PyErr_SetString(PyExc_ValueError, "old_tasks/node_ids mismatch");
@@ -608,9 +614,19 @@ block_commit(PyObject *self, PyObject *args)
         goto fail;
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *old = PyList_GET_ITEM(old_tasks, i);
-        PyObject *d = PyObject_GetAttr(old, s_dict);
-        if (!d)
-            goto fail;
+        /* instance dicts via the dict pointer: dataclass instances always
+         * have one, and this skips the __dict__ descriptor machinery on
+         * the hottest lookup of the loop (falls back for odd objects) */
+        PyObject **dp = _PyObject_GetDictPtr(old);
+        PyObject *d;
+        if (dp != NULL && *dp != NULL) {
+            d = *dp;
+            Py_INCREF(d);   /* keep the DECREF discipline uniform */
+        } else {
+            d = PyObject_GetAttr(old, s_dict);
+            if (!d)
+                goto fail;
+        }
         PyObject *tid = PyDict_GetItem(d, s_id);
         int take_slow = 0;
         if (!tid) {
@@ -626,15 +642,25 @@ block_commit(PyObject *self, PyObject *args)
                 take_slow = 1;
             } else {
                 PyObject *status = PyDict_GetItem(d, s_status);
-                PyObject *st = status ? PyObject_GetAttr(status, s_state)
-                                      : NULL;
+                PyObject *st = NULL;
+                if (status != NULL) {
+                    PyObject **sdp = _PyObject_GetDictPtr(status);
+                    if (sdp != NULL && *sdp != NULL)
+                        st = PyDict_GetItem(*sdp, s_state); /* borrowed */
+                }
                 if (!st) {
-                    PyErr_Clear();
                     take_slow = 1;
+                } else if (guard_ok) {
+                    long long stv = PyLong_AsLongLong(st);
+                    if (stv == -1 && PyErr_Occurred()) {
+                        PyErr_Clear();
+                        take_slow = 1;
+                    } else {
+                        take_slow = stv >= guard_ll;
+                    }
                 } else {
                     int ge = PyObject_RichCompareBool(st, guard_state,
                                                       Py_GE);
-                    Py_DECREF(st);
                     if (ge < 0) {
                         Py_DECREF(d);
                         goto fail;

@@ -258,8 +258,10 @@ def plan_group(nodes: NodeInputs, group: GroupInputs, L: int,
       stage B: nodes within each leaf — level per-service counts
                (failure-down-weighted), tie-broken by total tasks.
 
-    Returns (x i32[N] tasks per node, fail_counts i32[7] per-filter failure
-    counts in pipeline order).
+    Returns (x i32[N] tasks per node, fail_counts i32[7] per-filter
+    failure counts in pipeline order, spill bool scalar — True when a
+    spread branch saturated and the caller should use the host path for
+    exact reference parity).
     """
     mask, cap, fail_counts = feasibility_and_capacity(nodes, group, reduce)
     n = nodes.ready.shape[0]
@@ -291,10 +293,10 @@ def plan_group(nodes: NodeInputs, group: GroupInputs, L: int,
         load = jnp.minimum(
             reduce(_seg_sum_f32(svc_valid, seg, n_segs)),
             float(LOAD_CLAMP)).astype(jnp.int32)
-        bcap = jnp.minimum(
-            reduce(_seg_sum_f32(cap, seg, n_segs)),
-            kk.astype(jnp.float32)).astype(jnp.int32)
-        return load, bcap
+        raw_cap = reduce(_seg_sum_f32(cap, seg, n_segs))  # true capacity
+        bcap = jnp.minimum(raw_cap,
+                           kk.astype(jnp.float32)).astype(jnp.int32)
+        return load, bcap, raw_cap
 
     # hier = (upper_levels, leaf_parent):
     #   upper_levels — tuple of (seg_nodes i32[N], parent i32[L_d]) pairs,
@@ -304,33 +306,60 @@ def plan_group(nodes: NodeInputs, group: GroupInputs, L: int,
 
     k_parent = kk.reshape(1)   # the root's allocation
     parent_count = 1
+    # branch-capacity binding detector: when a spread branch saturates
+    # (allocation == capacity with capacity > 0 at a multi-branch level),
+    # the host oracle's convergence loop (scheduler.py:738, mirroring
+    # reference scheduler.go:772) redistributes with STALE branch counts
+    # and order-biased remainders, producing lumpier distributions than
+    # this water-fill's globally-even answer.  Rather than replicate that
+    # sequential quirk on device, flag it: the planner routes flagged
+    # groups to the host path, preserving exact reference parity.
+    spill = jnp.zeros((), jnp.bool_)
+
+    def level_spill(alloc, raw_cap):
+        # a level diverges from the host only when SOME usable branch
+        # truly saturates (allocation == its UNclamped capacity) while
+        # ANOTHER usable branch does not — that is when the host loop's
+        # stale-count redistribution kicks in.  Compare against the raw
+        # capacity, not the k-clamped bcap: a lone branch absorbing the
+        # whole group, or a fully saturated level (host and device agree
+        # there), must not flag.
+        af = alloc.astype(jnp.float32)
+        usable = raw_cap > 0
+        sat = usable & (af >= raw_cap)
+        return jnp.any(sat) & jnp.any(usable & ~sat)
+
     for seg_nodes, parent in upper_levels:
         L_d = parent.shape[0]
-        load, bcap = branch_arrays(seg_nodes, L_d)
+        load, bcap, raw_cap = branch_arrays(seg_nodes, L_d)
         # stage-A waterfills run on [L_d]-shaped, fully-replicated arrays
         # (the reduce already happened in branch_arrays), so no cross-shard
         # reduce is needed even under shard_map
         k_parent = seg_waterfill(
             e=load, cap=bcap, tie=jnp.arange(L_d, dtype=jnp.int32),
             k_seg=k_parent, seg=parent, L=parent_count)
+        if L_d > 1:
+            spill = spill | level_spill(k_parent, raw_cap)
         parent_count = L_d
 
     if L == 1 and not upper_levels:
-        _, branch_cap = branch_arrays(nodes.leaf, 1)
+        _, branch_cap, _raw = branch_arrays(nodes.leaf, 1)
         k_branch = jnp.minimum(kk, branch_cap)
     else:
-        load, bcap = branch_arrays(nodes.leaf, L)
+        load, bcap, raw_cap = branch_arrays(nodes.leaf, L)
         seg = leaf_parent if leaf_parent is not None \
             else jnp.zeros((L,), jnp.int32)
         k_branch = seg_waterfill(
             e=load, cap=bcap, tie=jnp.arange(L, dtype=jnp.int32),
             k_seg=k_parent, seg=seg, L=parent_count)
+        if L > 1:
+            spill = spill | level_spill(k_branch, raw_cap)
 
     # ---- stage B: nodes within each leaf branch
     tie = (jnp.clip(nodes.total_tasks, 0, TOTAL_CLAMP) << IDX_BITS) | idx
     x = seg_waterfill(e=e, cap=cap, tie=tie, k_seg=k_branch,
                       seg=nodes.leaf, L=L, reduce=reduce)
-    return x, fail_counts
+    return x, fail_counts, spill
 
 
 @functools.partial(jax.jit, static_argnames=("L",))

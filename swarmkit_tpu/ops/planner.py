@@ -124,7 +124,8 @@ def _probe_inputs():
 class TPUPlanner:
     def __init__(self, plan_fn=None):
         # plan_fn(nodes: NodeInputs, group: GroupInputs, L: int, hier)
-        # -> (x i32[N], fail_counts i32[7]); hier carries multi-level
+        # -> (x i32[N], fail_counts i32[7], spill bool); hier carries
+        # multi-level
         # spread segments (() for flat).  Defaults to the single-device jit
         # kernel; parallel/sharded.py provides a mesh-sharded
         # implementation with the same signature.
@@ -670,10 +671,20 @@ class TPUPlanner:
          hier, cpu_d, mem_d, gen_wanted, port_limited) = built
         k = len(task_group)
         import jax as _jax
-        x, fail_counts = self._plan_fn(nodes_in, group_in, L, hier)
-        # one round-trip for both outputs: D2H latency dominates over
+        x, fail_counts, spill = self._plan_fn(nodes_in, group_in, L, hier)
+        # one round-trip for all outputs: D2H latency dominates over
         # tunneled links, so never fetch twice
-        x, fail_counts = _jax.device_get((x, fail_counts))
+        x, fail_counts, spill = _jax.device_get((x, fail_counts, spill))
+        if bool(spill):
+            # a spread branch saturated: the host oracle's convergence
+            # loop redistributes differently than the water-fill in that
+            # regime (see kernel.py) — keep exact reference parity by
+            # letting the host place this group
+            self.stats["plan_seconds"] += _time.perf_counter() - _plan_t0
+            self.stats["groups_spill_to_host"] = \
+                self.stats.get("groups_spill_to_host", 0) + 1
+            self._cache = None
+            return False
         self.last_explanation = self._explain(fail_counts)
         self.stats["plan_seconds"] += _time.perf_counter() - _plan_t0
 

@@ -57,7 +57,8 @@ _GROUP_SPECS = GroupInputs(
 @functools.partial(jax.jit, static_argnames=("L", "mesh"))
 def plan_group_sharded(nodes: NodeInputs, group: GroupInputs, L: int,
                        mesh: Mesh, hier=()):
-    """Sharded group placement: (x i32[N] sharded, fail_counts i32[7])."""
+    """Sharded group placement:
+    (x i32[N] sharded, fail_counts i32[7], spill bool)."""
 
     n_devices = mesh.shape[NODE_AXIS]
     local_n = nodes.ready.shape[0] // n_devices
@@ -77,7 +78,7 @@ def plan_group_sharded(nodes: NodeInputs, group: GroupInputs, L: int,
         hier_specs = ()
     fn = shard_map(kernel, mesh=mesh,
                    in_specs=(_NODE_SPECS, _GROUP_SPECS, hier_specs),
-                   out_specs=(P(NODE_AXIS), P()))
+                   out_specs=(P(NODE_AXIS), P(), P()))
     return fn(nodes, group, hier)
 
 

@@ -668,3 +668,28 @@ def test_node_side_csi_staging_with_process_executor(tmp_path):
     finally:
         agent.stop()
         m.stop()
+
+
+def test_cli_cluster_update_live_settings():
+    """swarmctl cluster update flags flow into the watched ClusterSpec
+    (reference: swarmctl cluster update)."""
+    from swarmkit_tpu.cli import run_command
+    from swarmkit_tpu.manager.controlapi import ControlAPI
+    from swarmkit_tpu.models import Cluster
+    from swarmkit_tpu.models.specs import ClusterSpec
+    from swarmkit_tpu.models.types import Annotations
+    from swarmkit_tpu.state import MemoryStore
+
+    store = MemoryStore()
+    store.update(lambda tx: tx.create(Cluster(
+        id="c1", spec=ClusterSpec(annotations=Annotations(name="default")))))
+    api = ControlAPI(store)
+    out = run_command(["cluster", "update", "--heartbeat-period", "2.5",
+                       "--cert-expiry", "3600",
+                       "--task-history-limit", "9"], api)
+    assert "heartbeat-period=2.5s" in out
+    c = api.get_default_cluster()
+    assert c.spec.dispatcher.heartbeat_period == 2.5
+    assert c.spec.ca_config.node_cert_expiry == 3600
+    assert c.spec.orchestration.task_history_retention_limit == 9
+    assert run_command(["cluster", "update"], api) == "nothing to update"

@@ -124,13 +124,21 @@ def assert_distribution_matches(nodes, svc, make_tasks):
     planner.enable_small_group_routing = False
     _, sched, tpu_tasks = run_schedulers(nodes2, svc_t, tasks_t,
                                          planner=planner)
-    assert sched.batch_planner.stats["groups_planned"] >= 1
+    stats = sched.batch_planner.stats
+    # the device must at least have attempted the group; a spill-to-host
+    # (saturated spread branch, see kernel.py) is a legitimate outcome —
+    # parity then holds because the host placed both sides.  Callers
+    # running many trials must ALSO assert aggregate device coverage via
+    # the returned stats, or a spill-always regression would turn the
+    # whole differential suite into host-vs-host.
+    assert stats["groups_planned"] >= 1 \
+        or stats.get("groups_spill_to_host", 0) >= 1
 
     host_counts = per_node_counts(host_tasks)
     tpu_counts = per_node_counts(tpu_tasks)
     assert sum(host_counts.values()) == sum(tpu_counts.values())
     assert sorted(host_counts.values()) == sorted(tpu_counts.values())
-    return host_tasks, tpu_tasks
+    return host_tasks, tpu_tasks, stats
 
 
 def test_tpu_basic_spread():
@@ -333,8 +341,8 @@ def test_sharded_matches_single_device():
         plat=np.full((1, 4), -1, np.int32), maxrep=np.int32(0),
         port_limited=np.bool_(False))
 
-    single, counts_s = plan_group_jit(nodes, group, 1)
-    sharded, counts_m = ShardedPlanFn(make_mesh())(nodes, group, 1)
+    single, counts_s, _ = plan_group_jit(nodes, group, 1)
+    sharded, counts_m, _ = ShardedPlanFn(make_mesh())(nodes, group, 1)
     np.testing.assert_array_equal(np.asarray(single), np.asarray(sharded))
     np.testing.assert_array_equal(np.asarray(counts_s),
                                   np.asarray(counts_m))
@@ -357,7 +365,7 @@ def test_differential_multilevel_spread():
         PlacementPreference(spread=SpreadOver(
             spread_descriptor="node.labels.rack")),
     ]
-    host_tasks, tpu_tasks = assert_distribution_matches(
+    host_tasks, tpu_tasks, _ = assert_distribution_matches(
         nodes, None, lambda: make_service_with_tasks(24, prefs=prefs))
     # exact per-dc and per-rack balance: 12 per dc, 4 per rack
     node_by_id = {n.id: n for n in nodes}
@@ -440,8 +448,8 @@ def test_sharded_multilevel_matches_single_device():
     leaf_parent[:6] = np.array([0, 0, 0, 1, 1, 1], np.int32)
     hier = (((dc, parent0),), leaf_parent)
 
-    single, counts_s = plan_group_jit(nodes, group, 16, hier)
-    sharded, counts_m = ShardedPlanFn(make_mesh())(nodes, group, 16, hier)
+    single, counts_s, _ = plan_group_jit(nodes, group, 16, hier)
+    sharded, counts_m, _ = ShardedPlanFn(make_mesh())(nodes, group, 16, hier)
     np.testing.assert_array_equal(np.asarray(single), np.asarray(sharded))
     assert np.asarray(single).sum() == 41
 
@@ -451,6 +459,7 @@ def test_differential_fuzz_random_clusters():
     service shapes must yield identical per-node distributions on the host
     oracle and the device path (seeded for reproducibility)."""
     rng = np.random.RandomState(1234)
+    total_planned = 0
     for trial in range(6):
         n_nodes = int(rng.randint(4, 24))
         nodes = []
@@ -481,10 +490,14 @@ def test_differential_fuzz_random_clusters():
         if rng.rand() < 0.2:
             kwargs["max_replicas"] = int(rng.randint(1, 5))
         n_tasks = int(rng.randint(1, 60))
-        assert_distribution_matches(
+        _, _, stats = assert_distribution_matches(
             nodes, None,
             lambda kwargs=kwargs, n_tasks=n_tasks:
             make_service_with_tasks(n_tasks, **kwargs))
+        total_planned += stats["groups_planned"]
+    # aggregate device coverage: a spill-always regression must not turn
+    # this suite into host-vs-host
+    assert total_planned >= 4, total_planned
 
 
 def test_preassigned_validation_device_matches_host():
@@ -527,3 +540,64 @@ def test_preassigned_validation_device_matches_host():
     assert n_dev == n_host == 3
     assert sched.batch_planner.stats["tasks_planned"] >= 1, \
         "device path must have validated the batch"
+
+
+def test_differential_fuzz_deep_feature_mix():
+    """Wider randomized differential: larger clusters, multi-level spread
+    trees, host-port limits, and combined filters — the device path must
+    match the host oracle's distribution on every seed."""
+    rng = np.random.RandomState(987)
+    total_planned = 0
+    for trial in range(10):
+        n_nodes = int(rng.randint(8, 120))
+        nodes = []
+        for i in range(n_nodes):
+            nodes.append(make_ready_node(
+                f"d{trial}n{i}",
+                cpus=int(rng.randint(1, 64)),
+                mem=int(rng.randint(2, 256)) << 30,
+                labels={"zone": f"z{rng.randint(0, 4)}",
+                        "rack": f"r{rng.randint(0, 8)}",
+                        "tier": rng.choice(["web", "db", "cache"])},
+                os=rng.choice(["linux"] * 4 + ["windows"]),
+            ))
+        kwargs = {}
+        r = rng.rand()
+        if r < 0.35:
+            # multi-level spread: zone -> rack tree
+            kwargs["prefs"] = [
+                PlacementPreference(spread=SpreadOver(
+                    spread_descriptor="node.labels.zone")),
+                PlacementPreference(spread=SpreadOver(
+                    spread_descriptor="node.labels.rack"))]
+        elif r < 0.6:
+            kwargs["prefs"] = [PlacementPreference(spread=SpreadOver(
+                spread_descriptor="node.labels.rack"))]
+        if rng.rand() < 0.5:
+            kwargs["reservations"] = Resources(
+                nano_cpus=int(rng.randint(1, 6)) * 10**9,
+                memory_bytes=int(rng.randint(1, 16)) << 30)
+        if rng.rand() < 0.4:
+            kwargs["constraints"] = list(rng.choice(
+                ["node.labels.tier==web", "node.labels.tier!=cache",
+                 "node.labels.zone!=z3", "node.labels.rack==r1"],
+                size=rng.randint(1, 3), replace=False))
+        if rng.rand() < 0.3:
+            kwargs["platforms"] = [Platform(os="linux")]
+        if rng.rand() < 0.25:
+            kwargs["max_replicas"] = int(rng.randint(1, 6))
+        if rng.rand() < 0.2:
+            from swarmkit_tpu.models.types import (
+                PortConfig, PublishMode,
+            )
+            kwargs["ports"] = [PortConfig(
+                name="p", protocol="tcp", target_port=80,
+                published_port=int(rng.randint(30000, 30100)),
+                publish_mode=PublishMode.HOST)]
+        n_tasks = int(rng.randint(1, 200))
+        _, _, stats = assert_distribution_matches(
+            nodes, None,
+            lambda kwargs=kwargs, n_tasks=n_tasks:
+            make_service_with_tasks(n_tasks, **kwargs))
+        total_planned += stats["groups_planned"]
+    assert total_planned >= 6, total_planned
