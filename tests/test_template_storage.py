@@ -143,3 +143,64 @@ def test_rafttool_dumps(tmp_path):
     assert snap is not None and snap["objects"]["nodes"] >= 2
     objs = rafttool.dump_objects(state_dir, "nodes")
     assert all("id" in o for o in objs)
+
+
+def test_rafttool_on_encrypted_swarmd_dir(tmp_path):
+    """dump/decrypt/downgrade-key/renew-certs against a REAL swarmd
+    manager state dir (encrypted WAL under the persisted CA key; autolock
+    sealing) — reference: swarm-rafttool decrypt + downgrade-key +
+    renewcert."""
+    import tempfile
+
+    from swarmkit_tpu import rafttool
+    from swarmkit_tpu.cli import run_command
+    from swarmkit_tpu.swarmd import Swarmd
+
+    from test_orchestrator import make_replicated, poll
+
+    state_dir = str(tmp_path)
+    m = Swarmd(state_dir=state_dir, hostname="m0", manager=True,
+               listen_remote_api=("127.0.0.1", 0),
+               use_device_scheduler=False)
+    m.start()
+    api = m.manager.control_api
+    svc = api.create_service(make_replicated("tooling", 1).spec)
+    key = api.set_autolock(True)   # seal the state file
+    import os as _os
+    poll(lambda: open(_os.path.join(state_dir, "manager-state.json"),
+                      "rb").read(5) == b"LOCK1",
+         msg="state file re-seals under the new unlock key")
+    m.stop()
+
+    # dumps decrypt the WAL via the (sealed) persisted CA key
+    snap_or_wal = rafttool.dump_wal(state_dir, key)
+    assert any(r.get("type") == "entry" for r in snap_or_wal)
+    # wrong key fails closed
+    import pytest
+    from swarmkit_tpu.swarmd import ManagerLockedError
+    with pytest.raises(ManagerLockedError):
+        rafttool.dump_wal(state_dir, "SWMKEY-1-wrong")
+
+    # decrypt to a plaintext dir readable with no key at all (under
+    # tmp_path: the output holds the cluster's full unencrypted state
+    # and must not outlive the test)
+    out = str(tmp_path / "plain")
+    rafttool.decrypt(state_dir, out, key)
+    plain = rafttool.dump_wal(out)
+    assert any(r.get("type") == "entry" for r in plain)
+
+    # downgrade-key: the daemon restarts WITHOUT the unlock key
+    rafttool.downgrade_key(state_dir, key)
+    rafttool.renew_certs(state_dir, "")
+    m2 = Swarmd(state_dir=state_dir, hostname="m0", manager=True,
+                listen_remote_api=("127.0.0.1", 0),
+                use_device_scheduler=False)
+    m2.start()
+    try:
+        assert not m2.locked, "downgraded state must open keyless"
+        from swarmkit_tpu.models import Service
+        poll(lambda: m2.manager.store.view(
+            lambda tx: tx.get(Service, svc.id)) is not None,
+            msg="state survives the tooling round-trip")
+    finally:
+        m2.stop()
