@@ -437,3 +437,46 @@ def test_promoted_manager_restart_comes_back_as_manager():
         if w2 is not None:
             w2.stop()
         m0.stop()
+
+
+def test_device_scheduler_inside_live_manager():
+    """The TPU planner runs inside a live manager daemon end-to-end:
+    service -> orchestrator -> device-planned placement -> dispatcher ->
+    agent -> RUNNING (the other daemon tests pin the host path; this one
+    proves the device path through the full stack)."""
+    from swarmkit_tpu.models.types import NodeState
+
+    m0 = _manager_daemon("m0", use_device_scheduler=True)
+    m0.start()
+    workers = []
+    try:
+        api = m0.manager.control_api
+        token = m0.manager.root_ca.join_token(0)
+        for i in range(3):
+            w = _worker_daemon(f"w{i}", m0.server.addr, token)
+            w.start()
+            workers.append(w)
+        poll(lambda: len([n for n in api.list_nodes()
+                          if n.status.state == NodeState.READY]) == 4,
+             timeout=30, msg="all nodes READY")
+
+        # large enough that the adaptive router sends it to the device
+        planner = m0.manager.scheduler.batch_planner
+        assert planner is not None, "device planner must be wired"
+        planner.enable_small_group_routing = False
+
+        svc = api.create_service(make_replicated("devplanned", 12).spec)
+        poll(lambda: len([t for t in api.list_tasks(service_id=svc.id)
+                          if t.status.state == TaskState.RUNNING
+                          and t.desired_state == TaskState.RUNNING]) == 12,
+             timeout=45, msg="12 replicas RUNNING via the device path")
+        assert planner.stats["tasks_planned"] >= 12, planner.stats
+        # spread across all four agents (manager node + 3 workers)
+        per_node = {}
+        for t in api.list_tasks(service_id=svc.id):
+            per_node[t.node_id] = per_node.get(t.node_id, 0) + 1
+        assert sorted(per_node.values()) == [3, 3, 3, 3], per_node
+    finally:
+        for w in workers:
+            w.stop()
+        m0.stop()
