@@ -1,0 +1,46 @@
+"""Profile the block-mode scheduler tick (apply + commit phases).
+
+Usage: JAX_PLATFORMS=cpu python scripts/profile_tick.py [n_nodes n_tasks]
+Prints a phase breakdown and a cProfile top-30 of the tick.
+"""
+import cProfile
+import gc
+import pstats
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bench import build_cluster, one_tick  # noqa: E402
+from swarmkit_tpu.ops import TPUPlanner  # noqa: E402
+
+
+def main():
+    n_nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    n_tasks = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
+
+    # warm compile cache
+    store, *_ = build_cluster(n_nodes, 64)
+    wp = TPUPlanner()
+    wp.enable_small_group_routing = False
+    one_tick(store, wp)
+    TPUPlanner()._measure_launch_overhead()
+
+    t0 = time.perf_counter()
+    store, svc, nodes, tasks = build_cluster(n_nodes, n_tasks)
+    print(f"build: {time.perf_counter() - t0:.2f}s")
+    planner = TPUPlanner()
+
+    prof = cProfile.Profile()
+    prof.enable()
+    sched, n_dec, dt = one_tick(store, planner)
+    prof.disable()
+    print(f"tick: {dt:.3f}s  decisions: {n_dec}  "
+          f"plan: {planner.stats['plan_seconds']:.3f}s  "
+          f"commit: {sched.stats['commit_seconds']:.3f}s")
+    st = pstats.Stats(prof)
+    st.sort_stats("cumulative").print_stats(30)
+
+
+if __name__ == "__main__":
+    main()

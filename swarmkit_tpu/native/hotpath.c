@@ -209,6 +209,68 @@ plan_apply(PyObject *self, PyObject *args)
     Py_RETURN_NONE;
 }
 
+/* block_stage(items, slots, node_ids_by_node, task_dicts_by_node)
+ *   -> (olds, nids)
+ *
+ * Columnar staging of a planned group for the block-commit path: for each
+ * of the min(len(items), len(slots)) placements, plant the (unmodified)
+ * mirror task into its node's NodeInfo.tasks dict and emit parallel
+ * olds/nids columns ready for MemoryStore.commit_task_block.  No task
+ * objects are built — this replaces a per-task Python loop that allocated
+ * a 3-tuple per placement (ops/planner.py block-mode apply).
+ */
+static PyObject *
+block_stage(PyObject *self, PyObject *args)
+{
+    PyObject *items, *slots, *node_ids, *task_dicts;
+    if (!PyArg_ParseTuple(args, "O!O!O!O!", &PyList_Type, &items,
+                          &PyList_Type, &slots, &PyList_Type, &node_ids,
+                          &PyList_Type, &task_dicts))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(items);
+    Py_ssize_t ns = PyList_GET_SIZE(slots);
+    if (ns < n)
+        n = ns;
+    Py_ssize_t n_nodes = PyList_GET_SIZE(node_ids);
+    PyObject *olds = PyList_New(n);
+    PyObject *nids = PyList_New(n);
+    if (!olds || !nids)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *pair = PyList_GET_ITEM(items, i);
+        if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) {
+            PyErr_SetString(PyExc_TypeError, "items must be (id, task)");
+            goto fail;
+        }
+        PyObject *tid = PyTuple_GET_ITEM(pair, 0);
+        PyObject *task = PyTuple_GET_ITEM(pair, 1);
+        Py_ssize_t ni = PyLong_AsSsize_t(PyList_GET_ITEM(slots, i));
+        if (ni < 0 || ni >= n_nodes) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_IndexError, "slot out of range");
+            goto fail;
+        }
+        PyObject *nid = PyList_GET_ITEM(node_ids, ni);
+        PyObject *tdict = PyList_GET_ITEM(task_dicts, ni);
+        if (PyDict_SetItem(tdict, tid, task) < 0)
+            goto fail;
+        Py_INCREF(task);
+        PyList_SET_ITEM(olds, i, task);
+        Py_INCREF(nid);
+        PyList_SET_ITEM(nids, i, nid);
+    }
+    {
+        PyObject *out = PyTuple_Pack(2, olds, nids);
+        Py_DECREF(olds);
+        Py_DECREF(nids);
+        return out;
+    }
+fail:
+    Py_XDECREF(olds);
+    Py_XDECREF(nids);
+    return NULL;
+}
+
 /* commit_prepare(new_tasks, start, stop, objects, seq_start, ts,
  *                guard_state, action_cls_or_None, event_cls_or_None,
  *                on_missing, on_assigned)
@@ -612,6 +674,15 @@ block_commit(PyObject *self, PyObject *args)
     PyObject *slow = PyList_New(0);
     if (!committed || !slow)
         goto fail;
+    /* the planner emits placements sorted by node (np.repeat over the
+     * per-node counts), so consecutive items usually share a node: cache
+     * the by_node set across the run instead of a dict lookup per task */
+    PyObject *run_nid = NULL;  /* borrowed; element of node_ids */
+    PyObject *run_set = NULL;  /* borrowed; by_node[run_nid] or NULL */
+    /* committed is usually exactly range(n): track contiguity and only
+     * materialize index objects once a gap appears */
+    Py_ssize_t n_contig = 0;
+    int contiguous = 1;
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *old = PyList_GET_ITEM(old_tasks, i);
         /* instance dicts via the dict pointer: dataclass instances always
@@ -676,6 +747,17 @@ block_commit(PyObject *self, PyObject *args)
             Py_DECREF(d);
             if (r < 0)
                 goto fail;
+            if (contiguous) {
+                /* a gap: backfill 0..n_contig-1 and switch to appends */
+                contiguous = 0;
+                for (Py_ssize_t j = 0; j < n_contig; j++) {
+                    PyObject *jo = PyLong_FromSsize_t(j);
+                    int jr = jo ? PyList_Append(committed, jo) : -1;
+                    Py_XDECREF(jo);
+                    if (jr < 0)
+                        goto fail;
+                }
+            }
             continue;
         }
         /* accept: overlay entry + by_node index + version */
@@ -709,32 +791,58 @@ block_commit(PyObject *self, PyObject *args)
                 }
             }
         }
-        if (PyObject_IsTrue(nid)) {
-            PyObject *ns = PyDict_GetItem(by_node, nid);
-            if (!ns) {
-                PyObject *fresh = PySet_New(NULL);
-                if (!fresh || PyDict_SetItem(by_node, nid, fresh) < 0) {
-                    Py_XDECREF(fresh);
-                    Py_DECREF(d);
-                    goto fail;
+        if (nid != run_nid) {
+            run_nid = nid;
+            run_set = NULL;
+            if (PyObject_IsTrue(nid)) {
+                run_set = PyDict_GetItem(by_node, nid);
+                if (!run_set) {
+                    PyObject *fresh = PySet_New(NULL);
+                    if (!fresh ||
+                        PyDict_SetItem(by_node, nid, fresh) < 0) {
+                        Py_XDECREF(fresh);
+                        Py_DECREF(d);
+                        goto fail;
+                    }
+                    Py_DECREF(fresh);
+                    run_set = PyDict_GetItem(by_node, nid);
                 }
-                Py_DECREF(fresh);
-                ns = PyDict_GetItem(by_node, nid);
             }
-            if (PySet_Add(ns, tid) < 0) {
+        }
+        if (run_set && PySet_Add(run_set, tid) < 0) {
+            Py_DECREF(d);
+            goto fail;
+        }
+        if (contiguous) {
+            /* while contiguous, every accepted item has i == n_contig:
+             * the only way to skip an index is the slow branch, which
+             * clears the flag and backfills */
+            n_contig++;
+        } else {
+            PyObject *idx = PyLong_FromSsize_t(i);
+            int r = idx ? PyList_Append(committed, idx) : -1;
+            Py_XDECREF(idx);
+            if (r < 0) {
                 Py_DECREF(d);
                 goto fail;
             }
         }
-        PyObject *idx = PyLong_FromSsize_t(i);
-        int r = idx ? PyList_Append(committed, idx) : -1;
-        Py_XDECREF(idx);
         Py_DECREF(d);
-        if (r < 0)
-            goto fail;
     }
     {
-        PyObject *out = Py_BuildValue("(OOL)", committed, slow, seq);
+        PyObject *out;
+        if (contiguous) {
+            /* all items fast-committed in order: hand back range(n_contig)
+             * instead of n PyLong list entries */
+            PyObject *rng = PyObject_CallFunction(
+                (PyObject *)&PyRange_Type, "n", n_contig);
+            if (!rng)
+                goto fail;
+            out = Py_BuildValue("(OOL)", rng, slow, seq);
+            Py_DECREF(rng);
+        } else {
+            out = Py_BuildValue("(OOL)", committed, slow, seq);
+        }
         Py_DECREF(committed);
         Py_DECREF(slow);
         return out;
@@ -750,6 +858,8 @@ static PyMethodDef methods[] = {
      "Clone and register planner decisions."},
     {"block_commit", block_commit, METH_VARARGS,
      "Columnar task-block commit fast path (overlay + by_node index)."},
+    {"block_stage", block_stage, METH_VARARGS,
+     "Columnar staging of planned placements for the block-commit path."},
     {"commit_prepare", commit_prepare, METH_VARARGS,
      "Validate, version-check, and stamp one commit chunk."},
     {"commit_apply", commit_apply, METH_VARARGS,

@@ -553,14 +553,23 @@ class TPUPlanner:
         all_tasks = sched.all_tasks
         if getattr(sched, "block_mode", False):
             # columnar end-to-end: no per-task object materialization —
-            # the draft commits as one array-shaped store call
+            # each group stages one (olds, nids, message) column triple and
+            # commits as one array-shaped store call
             # (store.commit_task_block); mirrors keep the pre-assignment
             # object (membership + reservations are what they serve)
             node_id_by_i = [info.node.id for info in infos]
-            draft = sched.block_draft
-            for (task_id, task), i in zip(items, slots):
-                draft.append((task, node_id_by_i[i], message))
-                infos[i].tasks[task_id] = task
+            if hp is not None:
+                task_dict_by_i = [info.tasks for info in infos]
+                olds, nids = hp.block_stage(items, slots, node_id_by_i,
+                                            task_dict_by_i)
+            else:
+                olds, nids = [], []
+                for (task_id, task), i in zip(items, slots):
+                    olds.append(task)
+                    nids.append(node_id_by_i[i])
+                    infos[i].tasks[task_id] = task
+            if olds:
+                sched.block_draft.append((olds, nids, message))
         elif hp is not None:
             shared_status = TaskStatus(
                 state=TaskState.ASSIGNED, timestamp=now(), message=message)

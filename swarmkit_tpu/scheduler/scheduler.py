@@ -65,11 +65,12 @@ class Scheduler:
         self.pipeline = Pipeline()
         self.volumes = VolumeSet()
         self.batch_planner = batch_planner
-        # columnar commit draft: (mirror task, node_id, status message)
-        # triples accumulated by the device planner when the store allows
-        # block commits (store.commit_task_block); committed in one
-        # array-shaped call per tick instead of per-task objects
-        self.block_draft: List[Tuple[Task, str, str]] = []
+        # columnar commit draft: one (mirror tasks, node_ids, status
+        # message) column triple per planned group, accumulated by the
+        # device planner when the store allows block commits
+        # (store.commit_task_block); committed as array-shaped calls per
+        # tick instead of per-task objects
+        self.block_draft: List[Tuple[List[Task], List[str], str]] = []
         self.block_mode = False
 
         self._stop = threading.Event()
@@ -339,7 +340,7 @@ class Scheduler:
             for group in by_spec.values():
                 pending.extend(
                     planner.validate_preassigned(self, group, decisions))
-        committed_ids, block_failed = self._commit_block_draft()
+        _, committed_ids, block_failed = self._commit_block_draft()
         for tid in committed_ids:
             self.pending_preassigned_tasks.pop(tid, None)
         for old, nid in block_failed:
@@ -403,9 +404,11 @@ class Scheduler:
             if planner is not None and hasattr(planner, "end_tick"):
                 planner.end_tick()
 
-        n_decisions = len(decisions) + len(self.block_draft)
+        n_decisions = len(decisions) + sum(
+            len(olds) for olds, _, _ in self.block_draft)
         t_commit = now()
-        committed_ids, block_failed = self._commit_block_draft()
+        n_committed, _, block_failed = self._commit_block_draft(
+            want_ids=False)
         for old, nid in block_failed:
             # mirror rollback (remove_task never reads node_id, so the
             # pre-assignment object works) + requeue for the next tick
@@ -414,7 +417,7 @@ class Scheduler:
             if info is not None:
                 info.remove_task(old)
             self._enqueue(old)
-        if committed_ids or block_failed:
+        if n_committed or block_failed:
             self.stats["commit_seconds"] += now() - t_commit
         _, failed = self._apply_scheduling_decisions(decisions)
         for d in failed:
@@ -440,15 +443,17 @@ class Scheduler:
         self.stats["tick_seconds"].append(now() - t0)
         return n_decisions
 
-    def _commit_block_draft(self) -> Tuple[List[str],
-                                           List[Tuple[Task, str]]]:
+    def _commit_block_draft(self, want_ids: bool = True
+                            ) -> Tuple[int, Optional[List[str]],
+                                       List[Tuple[Task, str]]]:
         """Commit the columnar assignment draft through
         store.commit_task_block — arrays end-to-end, no per-task objects
-        (they materialize lazily on read).  Returns (committed task ids,
-        failed (mirror task, node_id) pairs for rollback)."""
+        (they materialize lazily on read).  Returns (committed count,
+        committed task ids or None when ``want_ids`` is False, failed
+        (mirror task, node_id) pairs for rollback)."""
         draft = self.block_draft
         if not draft:
-            return [], []
+            return 0, [] if want_ids else None, []
         self.block_draft = []
         node_info = self.node_set.node_info
         raw_get = self.store.raw_get
@@ -472,14 +477,10 @@ class Scheduler:
             return (node is not None and node.meta.version.index
                     == info.node.meta.version.index)
 
-        by_msg: Dict[str, Tuple[List[Task], List[str]]] = {}
-        for old, nid, msg in draft:
-            olds, nids = by_msg.setdefault(msg, ([], []))
-            olds.append(old)
-            nids.append(nid)
-        committed_ids: List[str] = []
+        n_committed = 0
+        committed_ids: Optional[List[str]] = [] if want_ids else None
         failed: List[Tuple[Task, str]] = []
-        for msg, (olds, nids) in by_msg.items():
+        for olds, nids, msg in draft:
             try:
                 c, f = self.store.commit_task_block(
                     olds, nids, int(TaskState.ASSIGNED), msg,
@@ -489,9 +490,11 @@ class Scheduler:
                 log.exception("scheduler block commit failed")
                 failed.extend(zip(olds, nids))
                 continue
-            committed_ids.extend(olds[i].id for i in c)
+            n_committed += len(c)
+            if committed_ids is not None:
+                committed_ids.extend(olds[i].id for i in c)
             failed.extend((olds[i], nids[i]) for i in f)
-        return committed_ids, failed
+        return n_committed, committed_ids, failed
 
     def _apply_scheduling_decisions(
             self, decisions: Dict[str, SchedulingDecision]

@@ -247,8 +247,9 @@ def test_network_ipam_allocation():
         n2 = api.create_network(NetworkSpec(
             annotations=Annotations(name="frontend")))
         poll(lambda: store.view(
-            lambda tx: tx.get(Network, n1.id)).ipam is not None,
-            msg="subnet allocated")
+            lambda tx: all(tx.get(Network, i).ipam is not None
+                           for i in (n1.id, n2.id))),
+            msg="subnets allocated")
         nets = store.view(lambda tx: [tx.get(Network, i)
                                       for i in (n1.id, n2.id)])
         subnets = [n.ipam.configs[0].subnet for n in nets]
