@@ -66,6 +66,7 @@ class Agent:
         self._log_offsets: Dict[str, int] = {}
         self.log_ship_interval = 0.5
         self.stats = {"sessions": 0, "reports": 0, "log_batches": 0}
+        self._applied_key_clock = 0
 
     # ------------------------------------------------------------- lifecycle
 
@@ -187,6 +188,7 @@ class Agent:
                 except Exception:
                     failed.set()
                     return
+                self._apply_network_keys()
 
         hb = threading.Thread(target=heartbeat_loop, name="agent-heartbeat",
                               daemon=True)
@@ -214,6 +216,29 @@ class Agent:
             stream.close()
             failed.set()
             hb.join(timeout=2)
+
+    def _apply_network_keys(self) -> None:
+        """Hand rotated dataplane keys to the executor (reference:
+        agent.go handleSessionMessage -> SetNetworkBootstrapKeys).  The
+        wire client stashes the heartbeat piggyback; the lamport clock
+        gates re-delivery so the executor sees each rotation once."""
+        delivery = getattr(self.client, "network_key_delivery", None)
+        if delivery is not None:
+            clock, raw = delivery          # atomic pair (failover client)
+        else:
+            clock = getattr(self.client, "last_key_clock", None)
+            raw = getattr(self.client, "last_network_keys", None)
+        if clock is None or raw is None or clock == self._applied_key_clock:
+            return
+        from ..models.types import EncryptionKey
+        from ..state import serde
+        try:
+            keys = [k if isinstance(k, EncryptionKey)
+                    else serde.from_dict(EncryptionKey, k) for k in raw]
+            self.executor.set_network_bootstrap_keys(keys)
+            self._applied_key_clock = clock
+        except Exception:
+            log.exception("applying network bootstrap keys failed")
 
     # -------------------------------------------------------------- reporter
 

@@ -89,6 +89,13 @@ class Executor:
     def configure(self, node) -> None:
         """Apply node object changes (labels etc.)."""
 
+    def set_network_bootstrap_keys(self, keys) -> None:
+        """Receive the cluster's dataplane encryption keys (gossip/IPSec)
+        when the key manager rotates them (reference:
+        agent/exec/executor.go:30 SetNetworkBootstrapKeys, delivered via
+        the session stream's SessionMessage.NetworkBootstrapKeys).
+        Executors without a dataplane ignore them."""
+
     def controller(self, t: Task) -> Controller:
         raise NotImplementedError
 

@@ -86,6 +86,10 @@ class TestExecutor(Executor):
     def describe(self) -> NodeDescription:
         return NodeDescription(hostname=self.hostname)
 
+    def set_network_bootstrap_keys(self, keys) -> None:
+        # recorded for tests asserting key-manager rotations reach agents
+        self.network_keys = list(keys)
+
     def controller(self, t: Task) -> TestController:
         ctlr = TestController(**self.controller_kwargs)
         ctlr.task = t

@@ -70,6 +70,8 @@ def _build_parser() -> argparse.ArgumentParser:
     drain.add_argument("node")
     activate = node.add_parser("activate")
     activate.add_argument("node")
+    npause = node.add_parser("pause")
+    npause.add_argument("node")
     promote = node.add_parser("promote")
     promote.add_argument("node")
     demote = node.add_parser("demote")
@@ -77,6 +79,8 @@ def _build_parser() -> argparse.ArgumentParser:
     nrm = node.add_parser("rm")
     nrm.add_argument("node")
     nrm.add_argument("--force", action="store_true")
+    ninspect = node.add_parser("inspect")
+    ninspect.add_argument("node")
 
     task = sub.add_parser("task").add_subparsers(dest="verb", required=True)
     tls = task.add_parser("ls")
@@ -268,14 +272,20 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
                     "manager" if n.spec.desired_role else "worker"])
             return _fmt_table(
                 ["ID", "NAME", "STATUS", "AVAILABILITY", "ROLE"], rows)
-        if args.verb in ("drain", "activate"):
+        if args.verb in ("drain", "activate", "pause"):
+            # reference: swarmctl node drain/activate/pause (availability
+            # flips; PAUSE keeps running tasks but blocks new placements —
+            # the scheduler's ReadyFilter requires ACTIVE)
             n = _resolve(api.list_nodes(), args.node, "node")
             spec = n.spec.copy()
-            spec.availability = (NodeAvailability.DRAIN
-                                 if args.verb == "drain"
-                                 else NodeAvailability.ACTIVE)
+            spec.availability = {
+                "drain": NodeAvailability.DRAIN,
+                "activate": NodeAvailability.ACTIVE,
+                "pause": NodeAvailability.PAUSE,
+            }[args.verb]
             api.update_node(n.id, n.meta.version.index, spec)
-            return f"{n.id} " + ("drained" if args.verb == "drain" else "activated")
+            return f"{n.id} " + {"drain": "drained", "activate": "activated",
+                                 "pause": "paused"}[args.verb]
         if args.verb in ("promote", "demote"):
             # reference: swarmctl node promote/demote (flips
             # spec.desired_role; the role manager reconciles raft
@@ -293,6 +303,30 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
             n = _resolve(api.list_nodes(), args.node, "node")
             api.remove_node(n.id, force=args.force)
             return n.id
+        if args.verb == "inspect":
+            n = _resolve(api.list_nodes(), args.node, "node")
+            d = n.description
+            res = d.resources if d and d.resources else None
+            lines = [
+                f"ID: {n.id}",
+                f"Name: {n.spec.annotations.name or (d.hostname if d else '')}",
+                f"Hostname: {d.hostname if d else ''}",
+                f"Status: {n.status.state.name}",
+                f"Availability: {n.spec.availability.name.lower()}",
+                "Role: " + ("manager" if n.spec.desired_role else "worker"),
+            ]
+            if d and d.platform:
+                lines.append(
+                    f"Platform: {d.platform.os}/{d.platform.architecture}")
+            if res:
+                lines.append(
+                    f"Resources: {res.nano_cpus / 1e9:g} CPUs / "
+                    f"{res.memory_bytes >> 20} MiB")
+            if n.spec.annotations.labels:
+                lines.append("Labels: " + ", ".join(
+                    f"{k}={v}"
+                    for k, v in sorted(n.spec.annotations.labels.items())))
+            return "\n".join(lines)
 
     if args.noun == "task":
         tasks = api.list_tasks()

@@ -324,6 +324,12 @@ class RemoteDispatcherClient:
             # ...and the node's store-reconciled role, so promotion/
             # demotion is noticed within one heartbeat period
             self.last_role = resp.get("role")
+            # ...and the dataplane encryption keys (reference:
+            # SessionMessage.NetworkBootstrapKeys); the agent hands them
+            # to its executor when the rotation clock advances
+            if "network_keys" in resp:
+                self.last_network_keys = resp["network_keys"]
+                self.last_key_clock = resp.get("key_clock", 0)
             return resp["period"]
         return resp
 

@@ -203,6 +203,28 @@ class ManagerServer:
             raise SecurityError(
                 f"a manager certificate is required {what}")
 
+    def _network_keys(self):
+        """Current dataplane encryption keys + lamport clock, serialized
+        for the heartbeat piggyback; cached per clock value so steady-
+        state heartbeats reuse the serialized form (the key manager only
+        bumps the clock on rotation)."""
+        from ..models.objects import Cluster
+        from ..state import serde
+        try:
+            cluster = self.manager.store.view(
+                lambda tx: next(iter(tx.find(Cluster)), None))
+        except Exception:
+            return None, 0
+        if cluster is None or not cluster.network_bootstrap_keys:
+            return None, 0
+        clock = cluster.encryption_key_lamport_clock
+        cached = getattr(self, "_netkey_cache", None)
+        if cached is not None and cached[0] == clock:
+            return cached[1], clock
+        keys = [serde.to_dict(k) for k in cluster.network_bootstrap_keys]
+        self._netkey_cache = (clock, keys)
+        return keys, clock
+
     def _store_role(self, cert: Optional[Certificate]):
         """The caller's current role per its store Node record (the role
         manager keeps this reconciled with spec.desired_role); falls back
@@ -290,9 +312,18 @@ class ManagerServer:
             # demoted node renews (and transitions) without waiting out
             # its cert half-life (reference: the session stream carries
             # the Node object; node.go:947 waitRole reacts)
-            return {"period": period, "managers": m.manager_api_addrs(),
+            resp = {"period": period, "managers": m.manager_api_addrs(),
                     "ca_digest": m.root_ca.active_digest,
                     "role": self._store_role(cert)}
+            # dataplane encryption keys ride along so agents pick up key-
+            # manager rotations (reference: SessionMessage.
+            # NetworkBootstrapKeys, api/dispatcher.proto; agent.go
+            # handleSessionMessage -> executor.SetNetworkBootstrapKeys)
+            keys, clock = self._network_keys()
+            if keys is not None:
+                resp["network_keys"] = keys
+                resp["key_clock"] = clock
+            return resp
         if method == "update_task_status":
             self._require_cert(cert, params["node_id"])
             updates = [(u["task_id"],

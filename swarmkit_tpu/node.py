@@ -28,6 +28,39 @@ from .utils import new_id
 log = logging.getLogger("node")
 
 
+class LocalDispatcherClient:
+    """In-process agent→dispatcher adapter.
+
+    Same surface as the wire dispatcher client, plus the heartbeat
+    piggyback the wire path gets from the server — network bootstrap keys
+    (reference: SessionMessage.NetworkBootstrapKeys) read straight from
+    the co-located store, so a manager's own agent follows key-manager
+    rotations exactly like remote workers do."""
+
+    def __init__(self, dispatcher):
+        self._dispatcher = dispatcher
+        self.last_network_keys = None
+        self.last_key_clock = None
+
+    def __getattr__(self, name):
+        return getattr(self._dispatcher, name)
+
+    def heartbeat(self, node_id: str, session_id: str) -> float:
+        period = self._dispatcher.heartbeat(node_id, session_id)
+        try:
+            from .models.objects import Cluster
+            cluster = self._dispatcher.store.view(
+                lambda tx: next(iter(tx.find(Cluster)), None))
+            if cluster is not None and cluster.network_bootstrap_keys:
+                self.last_network_keys = list(
+                    cluster.network_bootstrap_keys)
+                self.last_key_clock = \
+                    cluster.encryption_key_lamport_clock
+        except Exception:
+            log.exception("reading network bootstrap keys failed")
+        return period
+
+
 class Node:
     def __init__(self, executor: Executor, state_dir: str,
                  node_id: Optional[str] = None,
@@ -93,6 +126,10 @@ class Node:
                     tx.create(node_obj)
 
             store.update(cb)
+        if hasattr(dispatcher_client, "store"):
+            # a bare in-process Dispatcher: wrap it so the heartbeat
+            # piggyback (network bootstrap keys) works like the wire path
+            dispatcher_client = LocalDispatcherClient(dispatcher_client)
         self.agent = Agent(
             self.node_id, self.executor, dispatcher_client,
             task_db_path=os.path.join(self.state_dir, "worker", "tasks.db"))

@@ -588,8 +588,15 @@ class TPUPlanner:
                 info.tasks[task_id] = new_t
                 decisions[task_id] = SchedulingDecision(task, new_t)
         service_id = t.service_id
-        cached = self._cache is not None
-        for i in np.nonzero(counts)[0].tolist():
+        idx = np.nonzero(counts)[0]
+        if self._cache is not None and len(idx):
+            # column-cache arithmetic stays vectorized; only the per-node
+            # NodeInfo mirror below needs a Python loop
+            hit = counts[idx]
+            total[idx] += hit
+            cpu[idx] -= hit.astype(np.int64) * cpu_d
+            mem[idx] -= hit.astype(np.int64) * mem_d
+        for i in idx.tolist():
             cnt = int(counts[i])
             info = infos[i]
             info.active_tasks_count += cnt
@@ -598,10 +605,6 @@ class TPUPlanner:
             ar = info.available_resources
             ar.nano_cpus -= cnt * cpu_d
             ar.memory_bytes -= cnt * mem_d
-            if cached:
-                total[i] += cnt
-                cpu[i] -= cnt * cpu_d
-                mem[i] -= cnt * mem_d
 
     def validate_preassigned(self, sched, tasks, decisions) -> list:
         """Validate preassigned tasks (same service) against their FIXED

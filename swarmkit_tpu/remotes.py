@@ -194,6 +194,19 @@ class FailoverDispatcherClient:
     def heartbeat(self, node_id, session_id):
         return self._call("heartbeat", node_id, session_id)
 
+    @property
+    def network_key_delivery(self):
+        """Heartbeat piggyback stash (network bootstrap keys) as one
+        atomic (clock, keys) pair from whichever inner wire client served
+        the last heartbeat — a single locked read so a concurrent
+        failover rotation cannot tear the pair apart."""
+        with self._mu:
+            c = self._client
+            if c is None:
+                return None, None
+            return (getattr(c, "last_key_clock", None),
+                    getattr(c, "last_network_keys", None))
+
     def update_task_status(self, node_id, session_id, updates):
         return self._call("update_task_status", node_id, session_id,
                           updates)

@@ -208,6 +208,24 @@ def test_manager_standalone_cluster_and_cli():
         nodes_out = run_command(["node", "ls"], api)
         assert "w1" in nodes_out and "READY" in nodes_out
 
+        # availability verbs (reference: swarmctl node pause/activate)
+        run_command(["node", "pause", "w1"], api)
+        assert "pause" in run_command(["node", "ls"], api)
+        run_command(["node", "activate", "w1"], api)
+        assert "active" in run_command(["node", "ls"], api)
+        insp = run_command(["node", "inspect", "w1"], api)
+        assert "Hostname: w1" in insp and "Availability: active" in insp
+
+        # in-proc agents follow key-manager rotations through the local
+        # heartbeat piggyback (LocalDispatcherClient), like remote workers
+        ex = node.executor
+        poll(lambda: getattr(ex, "network_keys", None), timeout=10,
+             msg="network keys should reach the in-proc agent")
+        clock0 = max(k.lamport_time for k in ex.network_keys)
+        manager.keymanager.rotate_now()
+        poll(lambda: max(k.lamport_time for k in ex.network_keys) > clock0,
+             timeout=10, msg="rotated keys should reach the in-proc agent")
+
         run_command(["service", "scale", "web=4"], api)
         poll(lambda: len([t for t in api.list_tasks(service_id=service_id)
                           if t.desired_state == TaskState.RUNNING]) == 4,

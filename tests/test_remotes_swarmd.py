@@ -155,6 +155,55 @@ def test_swarmd_manager_and_remote_worker():
         mgr_daemon.stop()
 
 
+def test_network_bootstrap_keys_reach_remote_worker():
+    """Key-manager rotations are delivered to agents over the wire and
+    handed to the executor (reference: SessionMessage.NetworkBootstrapKeys;
+    agent.go handleSessionMessage -> executor.SetNetworkBootstrapKeys)."""
+    mgr_daemon = Swarmd(state_dir=tempfile.mkdtemp(), hostname="m0",
+                        manager=True, listen_remote_api=("127.0.0.1", 0),
+                        use_device_scheduler=False)
+    mgr_daemon.start()
+    worker = None
+    try:
+        api = mgr_daemon.manager.control_api
+
+        # fast heartbeats BEFORE the worker registers, so delivery is
+        # prompt (the dispatcher reloads the period from the cluster spec)
+        def fast(tx):
+            c = tx.find(Cluster, ByName("default"))[0].copy()
+            c.spec.dispatcher.heartbeat_period = 0.3
+            tx.update(c)
+        mgr_daemon.manager.store.update(fast)
+        poll(lambda: mgr_daemon.manager.dispatcher.config.heartbeat_period
+             == 0.3, msg="heartbeat period reload")
+
+        token = mgr_daemon.manager.root_ca.join_token(0)
+        worker = Swarmd(state_dir=tempfile.mkdtemp(), hostname="w0",
+                        join_addr=mgr_daemon.server.addr,
+                        join_token=token)
+        worker.start()
+
+        # the key manager populates keys at leader startup; the first
+        # heartbeats deliver them
+        poll(lambda: getattr(worker.executor, "network_keys", None),
+             timeout=15, msg="initial network keys should reach the agent")
+        keys = worker.executor.network_keys
+        subsystems = {k.subsystem for k in keys}
+        assert "networking:gossip" in subsystems
+        assert all(k.key for k in keys)
+        clock0 = max(k.lamport_time for k in keys)
+
+        # a rotation bumps the lamport clock and re-delivers
+        mgr_daemon.manager.keymanager.rotate_now()
+        poll(lambda: max(k.lamport_time
+                         for k in worker.executor.network_keys) > clock0,
+             timeout=15, msg="rotated keys should reach the agent")
+    finally:
+        if worker is not None:
+            worker.stop()
+        mgr_daemon.stop()
+
+
 def test_dispatcher_live_heartbeat_reload():
     mgr = Manager(dispatcher_config=Config_(heartbeat_period=5.0,
                                             process_updates_interval=0.02),
