@@ -137,6 +137,7 @@ def _build_parser() -> argparse.ArgumentParser:
 
     cluster = sub.add_parser("cluster").add_subparsers(dest="verb",
                                                        required=True)
+    cluster.add_parser("ls")
     cluster.add_parser("inspect")
     rotate = cluster.add_parser("rotate-token")
     rotate.add_argument("role", choices=["worker", "manager"])
@@ -214,10 +215,24 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
             service = api.create_service(spec)
             return service.id
         if args.verb == "ls":
+            services = api.list_services()
+            # running/desired counts via the ListServiceStatuses helper
+            # (reference: swarmctl service ls REPLICAS column)
+            statuses = {}
+            lister = getattr(api, "list_service_statuses", None)
+            if lister is not None:
+                statuses = {st["service_id"]: st
+                            for st in lister([s.id for s in services])}
             rows = []
-            for s in api.list_services():
-                replicas = (str(s.spec.replicated.replicas)
-                            if s.spec.replicated else "-")
+            for s in services:
+                st = statuses.get(s.id)
+                if st is not None:
+                    replicas = (f"{st['running_tasks']}/"
+                                f"{st['desired_tasks']}")
+                elif s.spec.replicated:
+                    replicas = str(s.spec.replicated.replicas)
+                else:
+                    replicas = "-"
                 image = (s.spec.task.container.image
                          if s.spec.task.container else "-")
                 rows.append([s.id[:12], s.spec.annotations.name,
@@ -454,6 +469,18 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
             return v.id
 
     if args.noun == "cluster":
+        if args.verb == "ls":
+            # reference: swarmctl cluster ls (cluster/list.go)
+            lister = getattr(api, "list_clusters", None)
+            clusters = lister() if lister is not None \
+                else [api.get_default_cluster()]
+            rows = [[c.id[:12], c.spec.annotations.name,
+                     f"{c.spec.ca_config.node_cert_expiry / 86400.0:g}d",
+                     "on" if c.spec.encryption_config.auto_lock_managers
+                     else "off"]
+                    for c in clusters]
+            return _fmt_table(["ID", "NAME", "CERT-EXPIRY", "AUTOLOCK"],
+                              rows)
         c = api.get_default_cluster()
         if args.verb == "inspect":
             jt = c.root_ca.join_tokens if c.root_ca else None

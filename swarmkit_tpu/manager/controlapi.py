@@ -353,6 +353,58 @@ class ControlAPI:
         by = ByNamePrefix(name_prefix) if name_prefix else All()
         return self.store.view(lambda tx: tx.find(Service, by))
 
+    def list_service_statuses(self, service_ids: List[str]) -> List[dict]:
+        """Per-service desired/running(/completed) task counts — the
+        `service ls` helper (reference: manager/controlapi/service.go:1047
+        ListServiceStatuses).  Unknown service ids return zeroed statuses,
+        matching the reference; deleted services with surviving tasks
+        count 0 desired."""
+        from ..models import ServiceMode, TaskState
+        from ..state.store import ByService
+
+        def cb(tx):
+            out = []
+            for sid in service_ids:
+                status = {"service_id": sid, "desired_tasks": 0,
+                          "running_tasks": 0, "completed_tasks": 0}
+                out.append(status)
+                svc = tx.get(Service, sid)
+                global_ = False
+                job_iteration = None
+                if svc is not None:
+                    mode = svc.spec.mode
+                    if mode == ServiceMode.REPLICATED:
+                        status["desired_tasks"] = (
+                            svc.spec.replicated.replicas
+                            if svc.spec.replicated else 1)
+                    elif mode == ServiceMode.REPLICATED_JOB:
+                        job = svc.spec.replicated_job
+                        status["desired_tasks"] = (
+                            (job.max_concurrent or job.total_completions)
+                            if job else 0)
+                    else:
+                        global_ = True
+                    if svc.job_status is not None:
+                        job_iteration = svc.job_status.job_iteration.index
+                for t in tx.find(Task, ByService(sid)):
+                    if job_iteration is not None:
+                        if (t.job_iteration is None
+                                or t.job_iteration.index != job_iteration):
+                            continue
+                        if t.status.state == TaskState.COMPLETE:
+                            status["completed_tasks"] += 1
+                    if t.status.state == TaskState.RUNNING:
+                        status["running_tasks"] += 1
+                    if global_ and t.desired_state == TaskState.RUNNING:
+                        status["desired_tasks"] += 1
+                    if (global_
+                            and t.status.state != TaskState.COMPLETE
+                            and t.desired_state == TaskState.COMPLETE):
+                        status["desired_tasks"] += 1
+            return out
+
+        return self.store.view(cb)
+
     # ---------------------------------------------------------------- nodes
 
     def get_node(self, node_id: str) -> Node:
@@ -611,6 +663,10 @@ class ControlAPI:
         if c is None:
             raise NotFound(f"cluster {cluster_id} not found")
         return c
+
+    def list_clusters(self) -> List[Cluster]:
+        """reference: manager/controlapi/cluster.go ListClusters."""
+        return self.store.view(lambda tx: tx.find(Cluster))
 
     def get_default_cluster(self) -> Cluster:
         clusters = self.store.view(

@@ -398,6 +398,10 @@ class RemoteControlClient:
         return [_obj_in(o) for o in self._call(
             "list_services", name_prefix=name_prefix)]
 
+    def list_service_statuses(self, service_ids):
+        return self._call("list_service_statuses",
+                          service_ids=list(service_ids))
+
     def list_nodes(self):
         return [_obj_in(o) for o in self._call("list_nodes")]
 
@@ -498,6 +502,9 @@ class RemoteControlClient:
 
     def get_default_cluster(self):
         return _obj_in(self._call("get_default_cluster"))
+
+    def list_clusters(self):
+        return [_obj_in(o) for o in self._call("list_clusters")]
 
     def health(self, service: str = "") -> str:
         return self._conn.call("health", {"service": service})["status"]

@@ -457,6 +457,9 @@ class ManagerServer:
         if method == "list_services":
             return [obj_out(s) for s in api.list_services(
                 name_prefix=params.get("name_prefix", ""))]
+        if method == "list_service_statuses":
+            return api.list_service_statuses(
+                list(params.get("service_ids", [])))
         if method == "list_nodes":
             return [obj_out(n) for n in api.list_nodes()]
         if method == "update_node":
@@ -542,6 +545,8 @@ class ManagerServer:
             return api.rotate_join_token(params["role"])
         if method == "get_default_cluster":
             return obj_out(api.get_default_cluster())
+        if method == "list_clusters":
+            return [obj_out(c) for c in api.list_clusters()]
         if method == "rotate_ca":
             return api.rotate_ca()
         if method == "set_autolock":

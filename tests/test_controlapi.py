@@ -460,6 +460,8 @@ def test_cli_volume_network_cluster_nouns():
 
         out = run_command(["cluster", "inspect"], api2)
         assert "SWMTKN-1-" in out
+        ls = run_command(["cluster", "ls"], api2)
+        assert "default" in ls and "AUTOLOCK" in ls
         token = run_command(["cluster", "rotate-token", "worker"], api2)
         assert token.startswith("SWMTKN-1-")
         assert token in run_command(["cluster", "inspect"], api2)
@@ -469,6 +471,65 @@ def test_cli_volume_network_cluster_nouns():
         assert "w1" in run_command(["resource", "ls"], api2)
         run_command(["resource", "rm", "w1"], api2)
         run_command(["extension", "rm", "widgets"], api2)
+    finally:
+        m.stop()
+
+
+def test_list_service_statuses():
+    """Desired/running counts per service — the `service ls` helper
+    (reference: manager/controlapi/service.go:1047 ListServiceStatuses:
+    replicated desired = replicas; global desired counts live tasks;
+    unknown ids return zeroed statuses)."""
+    from swarmkit_tpu.cli import run_command
+    from swarmkit_tpu.manager import Manager
+    from swarmkit_tpu.models import (
+        Annotations, ContainerSpec, ServiceMode, ServiceSpec, TaskSpec,
+    )
+
+    from test_orchestrator import poll
+
+    m = Manager(use_device_scheduler=False)
+    m.run()
+    api = m.control_api
+    try:
+        run_command(["service", "create", "--name", "web",
+                     "--image", "nginx", "--replicas", "3"], api)
+        svc = api.list_services("web")[0]
+        gsvc = api.create_service(ServiceSpec(
+            annotations=Annotations(name="agent-everywhere"),
+            task=TaskSpec(container=ContainerSpec(image="agent")),
+            mode=ServiceMode.GLOBAL))
+        # no agents: replicated tasks never RUN, but desired is 3 now
+        sts = {st["service_id"]: st for st in api.list_service_statuses(
+            [svc.id, gsvc.id, "no-such-service"])}
+        assert sts[svc.id]["desired_tasks"] == 3
+        assert sts["no-such-service"] == {
+            "service_id": "no-such-service", "desired_tasks": 0,
+            "running_tasks": 0, "completed_tasks": 0}
+
+        # a node joins: global desired becomes 1, and once tasks run the
+        # running counts follow
+        from swarmkit_tpu.agent.testutils import TestExecutor
+        from swarmkit_tpu.node import Node as ClusterNode
+        import tempfile
+        node = ClusterNode(TestExecutor(hostname="w1"), tempfile.mkdtemp())
+        cluster = api.get_default_cluster()
+        node.load_or_join(m.ca_server, cluster.root_ca.join_tokens.worker)
+        node.start(m.dispatcher, store=m.store, hostname="w1")
+        try:
+            def counts():
+                sts = {st["service_id"]: st
+                       for st in api.list_service_statuses(
+                           [svc.id, gsvc.id])}
+                return (sts[svc.id]["running_tasks"] == 3
+                        and sts[gsvc.id]["desired_tasks"] == 1
+                        and sts[gsvc.id]["running_tasks"] == 1)
+            poll(counts, timeout=20,
+                 msg="statuses should reach 3/3 and 1/1")
+            ls = run_command(["service", "ls"], api)
+            assert "3/3" in ls and "1/1" in ls
+        finally:
+            node.stop()
     finally:
         m.stop()
 
@@ -506,6 +567,11 @@ def test_cli_nouns_over_remote_control_client():
         assert "k1" in run_command(["resource", "ls"], ctl)
         run_command(["resource", "rm", "k1"], ctl)
         run_command(["extension", "rm", "kinds"], ctl)
+        # service ls pulls running/desired through the wire statuses RPC
+        run_command(["service", "create", "--name", "rweb",
+                     "--image", "nginx", "--replicas", "2"], ctl)
+        assert "0/2" in run_command(["service", "ls"], ctl)
+        run_command(["service", "rm", "rweb"], ctl)
         ctl.close()
     finally:
         srv.stop()
