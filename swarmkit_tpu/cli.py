@@ -21,7 +21,7 @@ from typing import List, Optional
 from .manager.controlapi import APIError, ControlAPI
 from .models.specs import ContainerSpec, SecretSpec, ConfigSpec, ServiceSpec
 from .models.types import (
-    Annotations, NodeAvailability, TaskState,
+    Annotations, NodeAvailability, TaskState, UpdateConfig, UpdateOrder,
 )
 from .models import ReplicatedService, ServiceMode, TaskSpec
 
@@ -57,6 +57,17 @@ def _build_parser() -> argparse.ArgumentParser:
     inspect.add_argument("service")
     scale = svc.add_parser("scale")
     scale.add_argument("target")  # name=replicas
+    supdate = svc.add_parser("update")
+    supdate.add_argument("service")
+    supdate.add_argument("--image", default="")
+    supdate.add_argument("--replicas", type=int, default=None)
+    supdate.add_argument("--update-parallelism", type=int, default=None)
+    supdate.add_argument("--update-delay", type=float, default=None)
+    supdate.add_argument("--update-order",
+                         choices=["stop-first", "start-first"],
+                         default=None)
+    supdate.add_argument("--constraint", action="append", default=None,
+                         help="replace placement constraints")
     rm = svc.add_parser("rm")
     rm.add_argument("service")
     logs = svc.add_parser("logs")
@@ -256,6 +267,38 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
             spec.replicated = ReplicatedService(replicas=int(replicas))
             api.update_service(s.id, s.meta.version.index, spec)
             return f"{s.spec.annotations.name} scaled to {replicas}"
+        if args.verb == "update":
+            # reference: swarmctl service update — spec changes roll out
+            # through the update supervisor (parallelism/delay/order from
+            # spec.update; see orchestrator/update.py)
+            s = _resolve(api.list_services(), args.service, "service")
+            spec = s.spec.copy()
+            if args.image:
+                if spec.task.container is None:
+                    raise APIError("service has no container spec")
+                spec.task.container.image = args.image
+            if args.replicas is not None:
+                if spec.mode != ServiceMode.REPLICATED:
+                    raise APIError(
+                        "--replicas only applies to replicated services")
+                spec.replicated = ReplicatedService(replicas=args.replicas)
+            if args.constraint is not None:
+                spec.task.placement.constraints = list(args.constraint)
+            if (args.update_parallelism is not None
+                    or args.update_delay is not None
+                    or args.update_order is not None):
+                uc = spec.update.copy() if spec.update else UpdateConfig()
+                if args.update_parallelism is not None:
+                    uc.parallelism = args.update_parallelism
+                if args.update_delay is not None:
+                    uc.delay = args.update_delay
+                if args.update_order is not None:
+                    uc.order = (UpdateOrder.START_FIRST
+                                if args.update_order == "start-first"
+                                else UpdateOrder.STOP_FIRST)
+                spec.update = uc
+            api.update_service(s.id, s.meta.version.index, spec)
+            return f"{s.spec.annotations.name} updated"
         if args.verb == "rm":
             s = _resolve(api.list_services(), args.service, "service")
             api.remove_service(s.id)

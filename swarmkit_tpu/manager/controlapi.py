@@ -152,6 +152,9 @@ def _validate_task_spec(task_spec) -> None:
 def _validate_update(uc) -> None:
     if uc is None:
         return
+    if uc.parallelism < 0:
+        raise InvalidArgument(
+            "TaskSpec: update-parallelism cannot be negative")
     if uc.delay < 0:
         raise InvalidArgument("TaskSpec: update-delay cannot be negative")
     if uc.monitor < 0:

@@ -216,6 +216,21 @@ def test_manager_standalone_cluster_and_cli():
         insp = run_command(["node", "inspect", "w1"], api)
         assert "Hostname: w1" in insp and "Availability: active" in insp
 
+        # rolling update from the CLI: new image reaches every replica
+        # through the update supervisor (reference: swarmctl service
+        # update driving updater.go)
+        run_command(["service", "update", "web", "--image", "nginx:2",
+                     "--update-parallelism", "2"], api)
+        def updated():
+            tasks = [t for t in api.list_tasks(service_id=service_id)
+                     if t.desired_state == TaskState.RUNNING]
+            return (len(tasks) == 2 and all(
+                t.spec.container.image == "nginx:2"
+                and t.status.state == TaskState.RUNNING for t in tasks))
+        poll(updated, timeout=30,
+             msg="all replicas should roll to the new image")
+        assert "nginx:2" in run_command(["service", "ls"], api)
+
         # in-proc agents follow key-manager rotations through the local
         # heartbeat piggyback (LocalDispatcherClient), like remote workers
         ex = node.executor
