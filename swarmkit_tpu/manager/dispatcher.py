@@ -77,12 +77,22 @@ class ErrNodeNotRegistered(DispatcherError):
     pass
 
 
+class ErrRateLimited(DispatcherError):
+    """Node re-registered too often (reference: nodes.go:90
+    CheckRateLimit — at most RATE_LIMIT_COUNT registrations per
+    rate_limit_period)."""
+
+
+RATE_LIMIT_COUNT = 3   # reference: nodes.go:14
+
+
 @dataclass
 class _RegisteredNode:
     node_id: str
     session_id: str
     deadline: float = 0.0
     registered_at: float = field(default_factory=now)
+    attempts: int = 0
     streams: List["AssignmentStream"] = field(default_factory=list)
 
 
@@ -440,10 +450,33 @@ class Dispatcher:
         period = self._heartbeat_period()
         with self._mu:
             old = self._nodes.get(node_id)
+            attempts = 0
             if old is not None:
+                # re-registration rate limit (reference: nodes.go:90
+                # CheckRateLimit): attempts reset once the last
+                # registration is older than the period, and carry over
+                # across accepted re-registrations otherwise; period <= 0
+                # disables the limit (reference tests set 0)
+                if self.config.rate_limit_period > 0:
+                    attempts = old.attempts
+                    if now() - old.registered_at > \
+                            self.config.rate_limit_period:
+                        attempts = 0
+                    attempts += 1
+                    if attempts > RATE_LIMIT_COUNT:
+                        # attempts stick but the window keeps aging from
+                        # the last ACCEPTED registration (reference:
+                        # nodes.go:94-101 — Registered is only stamped on
+                        # success), so steady retries recover after one
+                        # quiet period
+                        old.attempts = attempts
+                        raise ErrRateLimited(
+                            f"node {node_id} exceeded rate limit count "
+                            "of registrations")
                 for stream in old.streams:
                     stream.close(ErrSessionInvalid("node re-registered"))
-            rn = _RegisteredNode(node_id=node_id, session_id=session_id)
+            rn = _RegisteredNode(node_id=node_id, session_id=session_id,
+                                 attempts=attempts)
             rn.deadline = now() + period * self.config.grace_multiplier
             self._nodes[node_id] = rn
             self._down_nodes.pop(node_id, None)

@@ -12,9 +12,12 @@ completely (they can recover).
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
 from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger("remotes")
 
 # reference: remotes.go DefaultObservationWeight and bounds
 DEFAULT_OBSERVATION_WEIGHT = 10
@@ -81,6 +84,62 @@ class Remotes:
                 if pick <= acc:
                     return addr
             return candidates[-1][0]
+
+
+class PersistentRemotes(Remotes):
+    """Remotes whose peer set survives restarts (reference:
+    node/node.go:1202 persistentRemotes + state.json): every membership
+    change rewrites the state file atomically, and construction merges
+    the persisted peers with any seed addresses — so a restarted worker
+    can reach the cluster even when its original --join-addr is gone."""
+
+    def __init__(self, path: str, *addrs: Addr):
+        self._path = path
+        super().__init__(*addrs)
+        for addr in self._load():
+            if tuple(addr) not in self._weights:
+                self._weights[tuple(addr)] = DEFAULT_OBSERVATION_WEIGHT
+        self._save()
+
+    def _load(self) -> List[Addr]:
+        import json
+        try:
+            with open(self._path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            if not isinstance(data, dict):
+                return []
+            return [tuple(a) for a in data.get("managers", [])]
+        except (OSError, ValueError, TypeError):
+            # unreadable or corrupt state file: fall back to the seeds,
+            # mirroring _save's tolerance
+            return []
+
+    def _save(self) -> None:
+        import json
+        import os as _os
+        tmp = self._path + ".tmp"
+        try:
+            _os.makedirs(_os.path.dirname(self._path) or ".",
+                         exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"managers": sorted(
+                    list(a) for a in self.weights())}, f)
+            _os.replace(tmp, self._path)
+        except OSError:
+            log.exception("persisting remotes failed")
+
+    def observe(self, addr: Addr,
+                weight: int = DEFAULT_OBSERVATION_WEIGHT) -> None:
+        known = tuple(addr) in self.weights()
+        super().observe(addr, weight)
+        if not known and tuple(addr) in self.weights():
+            self._save()   # membership change, not just a weight shift
+
+    def remove(self, addr: Addr) -> None:
+        known = tuple(addr) in self.weights()
+        super().remove(addr)
+        if known:
+            self._save()
 
 
 class ConnectionBroker:

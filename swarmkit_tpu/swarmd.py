@@ -203,12 +203,11 @@ class Swarmd:
                          self.manager.root_ca.join_token(1))
             return
 
-        if self.join_addr is None or not self.join_token:
-            raise SystemExit(
-                "worker mode needs --join-addr and --join-token")
+        import os as _os
+
         from .net import issue_certificate
         from .remotes import (
-            ConnectionBroker, FailoverDispatcherClient, Remotes,
+            ConnectionBroker, FailoverDispatcherClient, PersistentRemotes,
         )
         from .security.ca import SecurityError
 
@@ -219,7 +218,15 @@ class Swarmd:
             cert, _ = self.node.key_rw.read()
         except (FileNotFoundError, SecurityError):
             pass
-        if cert is not None and not self._cert_accepted(cert):
+        if cert is None and (self.join_addr is None
+                             or not self.join_token):
+            # restarts ride the persisted identity + remotes; a FIRST
+            # join needs the operator's addr+token (reference:
+            # node/node.go — JoinAddr only required without stored state)
+            raise SystemExit(
+                "worker mode needs --join-addr and --join-token")
+        if cert is not None and self.join_addr is not None \
+                and not self._cert_accepted(cert):
             # a cert from a rebuilt/foreign cluster would make every
             # register() fail with an application-level SecurityError the
             # failover client rightly never retries around — fall back to
@@ -227,14 +234,29 @@ class Swarmd:
             # the same verify-then-rejoin dance against a local CA)
             cert = None
         if cert is None:
+            if self.join_addr is None or not self.join_token:
+                # the persisted cert was rejected (rebuilt/foreign
+                # cluster) and there is nothing to re-join with
+                raise SystemExit(
+                    "worker mode needs --join-addr and --join-token")
             cert = issue_certificate(self.join_addr, self.node.node_id,
                                      self.join_token)
             self.node.key_rw.write(cert, b"")
         self.node.certificate = cert
         self.node.node_id = cert.node_id
-        # weighted failover across known managers (seeded with the join
-        # address; more managers can be observed into self.remotes)
-        self.remotes = Remotes(self.join_addr)
+        # weighted failover across known managers, persisted across
+        # restarts (reference: node/node.go:1202 persistentRemotes) and
+        # seeded with the join address; managers learned from heartbeats
+        # are observed into the set and survive the next restart
+        seeds = [self.join_addr] if self.join_addr is not None else []
+        self.remotes = PersistentRemotes(
+            _os.path.join(self.state_dir, "state.json"), *seeds)
+        if not self.remotes.weights():
+            # persisted identity but no persisted managers and no seed:
+            # the agent could only spin on NoSuchRemote forever
+            raise SystemExit(
+                "no known managers: pass --join-addr (persisted remotes "
+                "state.json is empty)")
         client = FailoverDispatcherClient(
             ConnectionBroker(self.remotes), cert)
         self.node.start(client, hostname=self.hostname)
@@ -742,12 +764,15 @@ class Swarmd:
         self._start_agent_with_failover(cert, *seeds)
 
     def _start_agent_with_failover(self, cert, seed=None, *extra) -> None:
+        import os as _os
+
         from .remotes import (
-            ConnectionBroker, FailoverDispatcherClient, Remotes,
+            ConnectionBroker, FailoverDispatcherClient, PersistentRemotes,
         )
 
         addrs = ([tuple(seed)] if seed else []) + [tuple(a) for a in extra]
-        self.remotes = Remotes(*addrs)
+        self.remotes = PersistentRemotes(
+            _os.path.join(self.state_dir, "state.json"), *addrs)
         client = FailoverDispatcherClient(
             ConnectionBroker(self.remotes), cert)
         self.node.start(client, hostname=self.hostname)

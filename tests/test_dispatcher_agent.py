@@ -58,6 +58,37 @@ def test_register_requires_known_node(store):
         d.stop()
 
 
+def test_register_rate_limit(store):
+    """Re-registration is rate limited per node (reference: nodes.go:90
+    CheckRateLimit — RATE_LIMIT_COUNT re-registrations per period, reset
+    once the last registration ages past the period)."""
+    from swarmkit_tpu.manager.dispatcher import ErrRateLimited
+
+    d = Dispatcher(store, fast_config(rate_limit_period=0.5))
+    d.run()
+    node = make_ready_node("n1")
+    store.update(lambda tx: tx.create(node))
+    try:
+        d.register(node.id)
+        for _ in range(3):        # three rapid re-registrations pass
+            d.register(node.id)
+        with pytest.raises(ErrRateLimited):
+            d.register(node.id)   # the fourth within the period fails
+        time.sleep(0.6)           # ...and ages out
+        d.register(node.id)
+
+        # disabled limit (period 0): unlimited re-registration
+        d2 = Dispatcher(store, fast_config(rate_limit_period=0.0))
+        d2.run()
+        try:
+            for _ in range(10):
+                d2.register(node.id)
+        finally:
+            d2.stop()
+    finally:
+        d.stop()
+
+
 def test_heartbeat_session_validation(store):
     d = Dispatcher(store, fast_config())
     d.run()

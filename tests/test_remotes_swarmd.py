@@ -304,6 +304,73 @@ def test_swarmd_three_managers_survive_leader_death():
             d.stop()
 
 
+def test_worker_restart_survives_join_manager_death(tmp_path):
+    """Learned managers persist across worker restarts (reference:
+    node/node.go:1202 persistentRemotes + state.json): a worker that
+    joined via m0 restarts with NO --join-addr after m0 died and finds
+    the surviving managers from its persisted remotes."""
+    import os
+
+    from swarmkit_tpu.models.types import NodeRole, NodeState
+
+    m0 = Swarmd(state_dir=tempfile.mkdtemp(), hostname="m0",
+                manager=True, listen_remote_api=("127.0.0.1", 0),
+                use_device_scheduler=False)
+    m0.start()
+    token = m0.manager.root_ca.join_token(NodeRole.MANAGER)
+    wtoken = m0.manager.root_ca.join_token(NodeRole.WORKER)
+    joiners, worker = [], None
+    wdir = str(tmp_path / "worker")
+    try:
+        for h in ("m1", "m2"):
+            d = Swarmd(state_dir=tempfile.mkdtemp(), hostname=h,
+                       manager=True, join_addr=m0.server.addr,
+                       join_token=token,
+                       listen_remote_api=("127.0.0.1", 0),
+                       use_device_scheduler=False)
+            d.start()
+            joiners.append(d)
+
+        worker = Swarmd(state_dir=wdir, hostname="w0",
+                        join_addr=m0.server.addr, join_token=wtoken)
+        worker.start()
+        # heartbeats piggyback the manager list; the persistent remotes
+        # must learn all three managers before m0 goes away
+        poll(lambda: len(worker.remotes.weights()) >= 3, timeout=20,
+             msg="worker should learn every manager from heartbeats")
+        assert os.path.exists(os.path.join(wdir, "state.json"))
+        worker.stop()
+
+        m0.stop()
+        new_leader = poll(
+            lambda: next((d for d in joiners
+                          if d.raft_node.is_leader
+                          and d.manager is not None
+                          and d.manager.dispatcher is not None), None),
+            timeout=30, msg="survivors elect a leader")
+
+        # restart WITHOUT join flags: persisted identity + remotes only
+        worker = Swarmd(state_dir=wdir, hostname="w0")
+        worker.start()
+
+        def ready():
+            api = new_leader.manager.control_api
+            return any(
+                n.status.state == NodeState.READY
+                and (n.spec.annotations.name == "w0"
+                     or (n.description
+                         and n.description.hostname == "w0"))
+                for n in api.list_nodes())
+        poll(ready, timeout=30,
+             msg="restarted worker should re-register via survivors")
+    finally:
+        if worker is not None:
+            worker.stop()
+        for d in joiners:
+            d.stop()
+        m0.stop()
+
+
 def test_swarmd_bootstrap_manager_restart(tmp_path):
     """A raft-backed bootstrap manager restarted on the same state dir
     reuses its CA key and raft port and recovers its cluster state."""
