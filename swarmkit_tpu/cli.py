@@ -74,6 +74,13 @@ def _build_parser() -> argparse.ArgumentParser:
     logs.add_argument("service")
     logs.add_argument("--duration", type=float, default=2.0,
                       help="seconds to collect live log output for")
+    logs.add_argument("--tail", type=int, default=-1,
+                      help="last N history messages per task "
+                      "(-1 = all retained, 0 = none)")
+    logs.add_argument("--since", type=float, default=0.0,
+                      help="only history at/after this unix time")
+    logs.add_argument("--no-follow", action="store_true",
+                      help="print retained history and exit")
 
     node = sub.add_parser("node").add_subparsers(dest="verb", required=True)
     node.add_parser("ls")
@@ -309,7 +316,9 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
             # swarmctl service logs over the log broker)
             s = _resolve(api.list_services(), args.service, "service")
             lines = []
-            for msg in api.collect_logs(s.id, duration=args.duration):
+            for msg in api.collect_logs(s.id, duration=args.duration,
+                                        tail=args.tail, since=args.since,
+                                        follow=not args.no_follow):
                 text = msg["data"].decode("utf-8", "replace").rstrip()
                 for line in text.splitlines():
                     lines.append(

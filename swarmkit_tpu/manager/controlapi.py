@@ -1035,26 +1035,43 @@ class ControlAPI:
             raise NotFound(f"task {task_id} not found")
         return t
 
-    def collect_logs(self, service_id: str, duration: float = 2.0
-                     ) -> List[dict]:
-        """Collect live log output for a service for up to ``duration``
-        seconds (reference: swarmctl service logs over the log broker).
-        Returns [{task_id, node_id, stream, data(bytes)}], in arrival
-        order.  Only meaningful on the leader (the broker agents publish
-        to); bounded so one call can't pin a server thread forever."""
+    def collect_logs(self, service_id: str, duration: float = 2.0,
+                     tail: int = -1, since: float = 0.0,
+                     follow: bool = True, streams=None) -> List[dict]:
+        """Collect log output for a service (reference: swarmctl service
+        logs over the log broker, api/logbroker.proto
+        LogSubscriptionOptions).  History replays per tail/since; with
+        ``follow`` live output is then collected for up to ``duration``
+        seconds.  Returns [{task_id, node_id, stream, data(bytes)}], in
+        arrival order.  Only meaningful on the leader (the broker agents
+        publish to); bounded so one call can't pin a server thread."""
         import time as _time
 
         broker = getattr(self, "log_broker", None)
         if broker is None:
             raise APIError("log broker unavailable on this manager")
-        from .logbroker import LogSelector
+        from .logbroker import LogSelector, LogSubscriptionOptions
         duration = min(max(duration, 0.0), 30.0)
-        stream = broker.subscribe_logs(LogSelector(
-            service_ids=[service_id]))
+        stream = broker.subscribe_logs(
+            LogSelector(service_ids=[service_id]),
+            options=LogSubscriptionOptions(
+                streams=list(streams or []), follow=follow,
+                tail=tail, since=since))
         out: List[dict] = []
-        deadline = _time.time() + duration
         try:
-            while _time.time() < deadline:
+            # history backlog is pre-buffered at subscribe time: drain it
+            # fully BEFORE the live-collection window starts, so a short
+            # duration can never truncate the tail/since replay
+            while True:
+                try:
+                    msg = stream.get(timeout=0.01)
+                except Exception:   # empty (timeout) or closed (no follow)
+                    break
+                out.append({"task_id": msg.task_id,
+                            "node_id": msg.node_id,
+                            "stream": msg.stream, "data": msg.data})
+            deadline = _time.time() + duration
+            while follow and _time.time() < deadline:
                 try:
                     msg = stream.get(timeout=max(
                         0.05, deadline - _time.time()))

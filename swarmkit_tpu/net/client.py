@@ -417,12 +417,16 @@ class RemoteControlClient:
         return [_obj_in(o) for o in self._call(
             "list_tasks", service_id=service_id, node_id=node_id)]
 
-    def collect_logs(self, service_id: str, duration: float = 2.0):
+    def collect_logs(self, service_id: str, duration: float = 2.0,
+                     tail: int = -1, since: float = 0.0,
+                     follow: bool = True, streams=None):
         import base64 as _b64
         return [dict(m, data=_b64.b64decode(m["data"]))
                 for m in self._call("collect_logs",
                                     service_id=service_id,
-                                    duration=duration)]
+                                    duration=duration, tail=tail,
+                                    since=since, follow=follow,
+                                    streams=list(streams or []))]
 
     def create_secret(self, spec):
         return _obj_in(self._call("create_secret",

@@ -453,7 +453,11 @@ class ManagerServer:
             return [dict(m, data=_b64.b64encode(m["data"]).decode())
                     for m in api.collect_logs(
                         params["service_id"],
-                        duration=params.get("duration", 2.0))]
+                        duration=params.get("duration", 2.0),
+                        tail=params.get("tail", -1),
+                        since=params.get("since", 0.0),
+                        follow=params.get("follow", True),
+                        streams=params.get("streams") or [])]
         if method == "list_services":
             return [obj_out(s) for s in api.list_services(
                 name_prefix=params.get("name_prefix", ""))]

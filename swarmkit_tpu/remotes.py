@@ -95,6 +95,10 @@ class PersistentRemotes(Remotes):
 
     def __init__(self, path: str, *addrs: Addr):
         self._path = path
+        # file writes serialize separately from the weights lock: the
+        # session loop and the log shipper can both trigger membership
+        # saves concurrently
+        self._save_mu = threading.Lock()
         super().__init__(*addrs)
         for addr in self._load():
             if tuple(addr) not in self._weights:
@@ -117,16 +121,17 @@ class PersistentRemotes(Remotes):
     def _save(self) -> None:
         import json
         import os as _os
-        tmp = self._path + ".tmp"
-        try:
-            _os.makedirs(_os.path.dirname(self._path) or ".",
-                         exist_ok=True)
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump({"managers": sorted(
-                    list(a) for a in self.weights())}, f)
-            _os.replace(tmp, self._path)
-        except OSError:
-            log.exception("persisting remotes failed")
+        with self._save_mu:
+            tmp = self._path + ".tmp"
+            try:
+                _os.makedirs(_os.path.dirname(self._path) or ".",
+                             exist_ok=True)
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump({"managers": sorted(
+                        list(a) for a in self.weights())}, f)
+                _os.replace(tmp, self._path)
+            except OSError:
+                log.exception("persisting remotes failed")
 
     def observe(self, addr: Addr,
                 weight: int = DEFAULT_OBSERVATION_WEIGHT) -> None:

@@ -145,6 +145,100 @@ def test_logbroker_fanout():
     broker.close()
 
 
+def test_logbroker_subscription_options():
+    """tail/since/streams/follow (reference: api/logbroker.proto:26
+    LogSubscriptionOptions): history replays from the broker's bounded
+    per-task ring; follow=False closes after the backlog."""
+    import pytest as _p
+
+    from swarmkit_tpu.manager.logbroker import LogSubscriptionOptions
+    from swarmkit_tpu.models.types import now
+    from swarmkit_tpu.state.watch import Closed
+
+    store = MemoryStore()
+    t = Task(id=new_id(), service_id="svcA", slot=1, node_id="n1")
+    store.update(lambda tx: tx.create(t))
+    broker = LogBroker(store)
+
+    t_mid = None
+    for i in range(5):
+        if i == 3:
+            t_mid = now()
+        broker.publish_logs([LogMessage(
+            task_id=t.id, node_id="n1",
+            stream="stderr" if i == 4 else "stdout",
+            data=f"line{i}".encode())])
+
+    def drain(sub):
+        out = []
+        while True:
+            try:
+                out.append(sub.get(timeout=0.2))
+            except (Closed, TimeoutError):
+                return out
+
+    # tail: last 2 history messages, then closed (no follow)
+    sub = broker.subscribe_logs(
+        LogSelector(service_ids=["svcA"]),
+        options=LogSubscriptionOptions(follow=False, tail=2))
+    assert [m.data for m in drain(sub)] == [b"line3", b"line4"]
+
+    # since: only messages at/after the stamp
+    sub = broker.subscribe_logs(
+        LogSelector(service_ids=["svcA"]),
+        options=LogSubscriptionOptions(follow=False, since=t_mid))
+    assert [m.data for m in drain(sub)] == [b"line3", b"line4"]
+
+    # streams filter applies to history and live alike
+    sub = broker.subscribe_logs(
+        LogSelector(service_ids=["svcA"]),
+        options=LogSubscriptionOptions(streams=["stderr"], tail=-1))
+    msgs = []
+    while True:
+        try:
+            msgs.append(sub.get(timeout=0.2))
+        except TimeoutError:
+            break
+    assert [m.data for m in msgs] == [b"line4"]
+    broker.publish_logs([LogMessage(task_id=t.id, node_id="n1",
+                                    stream="stdout", data=b"ignored"),
+                         LogMessage(task_id=t.id, node_id="n1",
+                                    stream="stderr", data=b"kept")])
+    assert sub.get(timeout=2).data == b"kept"
+    with _p.raises(TimeoutError):
+        sub.get(timeout=0.1)
+    sub.close()
+
+    # tail=0: no history at all, live only
+    sub = broker.subscribe_logs(
+        LogSelector(service_ids=["svcA"]),
+        options=LogSubscriptionOptions(tail=0))
+    with _p.raises(TimeoutError):
+        sub.get(timeout=0.1)
+    sub.close()
+    broker.close()
+
+
+def test_logbroker_history_bounded():
+    """Per-task history honors the byte budget (oldest messages fall
+    off) and rings for reaped tasks are pruned."""
+    store = MemoryStore()
+    t = Task(id=new_id(), service_id="svcA", slot=1, node_id="n1")
+    store.update(lambda tx: tx.create(t))
+    broker = LogBroker(store)
+    broker.HISTORY_BYTES_PER_TASK = 64
+
+    for i in range(10):
+        broker.publish_logs([LogMessage(
+            task_id=t.id, node_id="n1", stream="stdout",
+            data=(f"{i}:" + "x" * 14).encode())])   # 16 bytes each
+    ring = broker._history[t.id]
+    assert 0 < sum(len(m.data) for m in ring) <= 64
+    assert ring[-1].data.startswith(b"9:")
+    assert ring[0].data.startswith(b"6:")   # oldest evicted
+    broker.close()
+
+
 # ----------------------------------------------------------------- watch api
 
 def test_watch_api_filters():

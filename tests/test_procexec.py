@@ -213,6 +213,15 @@ def test_cli_service_logs(cluster):
     # live-only stream: line-1 may print before collection subscribes,
     # but the tail of the output must land inside the window
     assert "line-" in out and "line-3" in out
+
+    # history replay: --no-follow returns instantly from the broker's
+    # ring — including output that predates this subscription — and
+    # --tail bounds it (reference: LogSubscriptionOptions)
+    out = run_command(["service", "logs", "logger", "--no-follow"], api)
+    assert "line-1" in out and "line-3" in out
+    # tail bounds the replay to the last message(s) per task
+    msgs = api.collect_logs(svc.id, tail=1, follow=False)
+    assert len(msgs) == 1 and b"line-3" in msgs[0]["data"]
     assert "logger." in out and "@" in out
 
 
