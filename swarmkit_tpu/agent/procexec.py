@@ -66,6 +66,8 @@ class ProcessController(Controller):
         self._cwd: Optional[str] = None
         self._interrupted = threading.Event()
         self._log_file = None
+        self._health_failures = 0
+        self._next_health_check: Optional[float] = None
 
     # ----------------------------------------------------------- lifecycle
 
@@ -187,22 +189,100 @@ class ProcessController(Controller):
             raise TaskError(f"executable not found: {e.filename}")
         except OSError as e:
             raise TemporaryError(f"spawn failed: {e}")
+        # health state lives on the controller, not in wait(): an
+        # interrupt-triggered wait() retry must neither reset the
+        # consecutive-failure count nor re-apply start_period
+        argv, hc = self._health_argv()
+        self._health_failures = 0
+        self._next_health_check = None
+        if argv is not None:
+            self._next_health_check = time.monotonic() + \
+                (hc.start_period or hc.interval or 30.0)
+
+    def _health_argv(self):
+        """Health probe argv from the spec, or None when disabled
+        (reference: api/types.proto HealthConfig.Test — ["NONE"]
+        disables, ["CMD", ...] is exec form, ["CMD-SHELL", s] runs via
+        the shell; dockerapi executes these inside the container, here
+        they run as host probes beside the process)."""
+        c = self.task.spec.container
+        hc = c.healthcheck if c is not None else None
+        if hc is None or not hc.test:
+            return None, None
+        test = list(hc.test)
+        if test[0] == "NONE":
+            return None, None
+        if test[0] == "CMD":
+            argv = test[1:]
+        elif test[0] == "CMD-SHELL":
+            argv = ["sh", "-c", " ".join(test[1:])]
+        else:
+            argv = test
+        return (argv or None), hc
 
     def wait(self) -> None:
         proc = self.proc
         if proc is None:
             raise TaskError("wait before start")
+        health_argv, hc = self._health_argv()
         while proc.poll() is None:
             if self._interrupted.is_set():
                 # one-shot: the retried wait() must be able to block again
                 # (a sticky event would spin the task in retries forever)
                 self._interrupted.clear()
                 raise TemporaryError("wait interrupted by task update")
+            if self._next_health_check is not None \
+                    and time.monotonic() >= self._next_health_check:
+                # reference defaults (dockerd): interval/timeout 30s,
+                # 3 retries; start_period delays the first verdict
+                self._next_health_check = \
+                    time.monotonic() + (hc.interval or 30.0)
+                failed = self._health_probe_failed(health_argv, hc)
+                if self._interrupted.is_set():
+                    continue   # probe aborted: verdict is inconclusive
+                if failed:
+                    self._health_failures += 1
+                    if self._health_failures >= (hc.retries or 3):
+                        # unhealthy: stop the task so the restart policy
+                        # takes over (reference: dockerapi controller
+                        # Wait returns when the container turns
+                        # unhealthy -> task fails -> orchestrator heals)
+                        self.shutdown()
+                        raise TaskError(
+                            f"task failed health check "
+                            f"({self._health_failures} consecutive "
+                            f"failures): {' '.join(health_argv)}")
+                else:
+                    self._health_failures = 0
             time.sleep(WAIT_POLL_INTERVAL)
         code = proc.returncode
         if code != 0:
             raise TaskError(
                 f"process exited with {code}: {self._err_tail()}")
+
+    def _health_probe_failed(self, argv, hc) -> bool:
+        """Run one probe in its own process group, polling so an
+        interrupt() aborts promptly (the Controller.wait contract) and a
+        timed-out shell pipeline cannot leak children past the kill."""
+        try:
+            p = subprocess.Popen(
+                argv, env=self._env, cwd=self._cwd,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                start_new_session=True)
+        except OSError:
+            return True
+        deadline = time.monotonic() + (hc.timeout or 30.0)
+        while p.poll() is None:
+            timed_out = time.monotonic() >= deadline
+            if timed_out or self._interrupted.is_set():
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                p.wait()
+                return timed_out   # interrupt: inconclusive, not a fail
+            time.sleep(WAIT_POLL_INTERVAL)
+        return p.returncode != 0
 
     def _err_tail(self) -> str:
         try:

@@ -94,6 +94,57 @@ def test_process_failure_surfaces_exit_code(cluster):
     assert "boom" in got[0].status.err
 
 
+def test_health_check_fails_unhealthy_task(cluster):
+    """A failing healthcheck stops the task with a diagnostic err and the
+    restart policy replaces it (reference: dockerapi controller health
+    monitoring; api/types.proto HealthConfig)."""
+    from swarmkit_tpu.models.specs import HealthConfig
+
+    manager, node, executor = cluster
+    api = manager.control_api
+
+    # healthy-then-unhealthy: the probe passes while the flag file
+    # exists, then we delete it and the task must fail within ~2 probes
+    flag = os.path.join(tempfile.mkdtemp(), "healthy")
+    open(flag, "w").close()
+    spec = proc_service("webish", 1, ["sh", "-c", "sleep 60"])
+    spec.task.container.healthcheck = HealthConfig(
+        test=["CMD", "test", "-e", flag],
+        interval=0.2, timeout=1.0, retries=2, start_period=0.2)
+    svc = api.create_service(spec)
+    poll(lambda: [t for t in api.list_tasks(service_id=svc.id)
+                  if t.status.state == TaskState.RUNNING] or None,
+         timeout=20, msg="task should start healthy")
+    time.sleep(0.6)   # at least one passing probe
+    running = [t for t in api.list_tasks(service_id=svc.id)
+               if t.status.state == TaskState.RUNNING]
+    assert running, "passing health checks must not kill the task"
+
+    os.unlink(flag)
+    got = poll(lambda: [t for t in api.list_tasks(service_id=svc.id)
+                        if t.status.state == TaskState.FAILED] or None,
+               timeout=20, msg="unhealthy task should FAIL")
+    assert "health check" in got[0].status.err
+
+    # CMD-SHELL form + restart policy: always-unhealthy task cycles
+    # through replacements (the orchestrator heals unhealthy tasks)
+    spec2 = proc_service(
+        "sickly", 1, ["sh", "-c", "sleep 60"],
+        restart=RestartPolicy(condition=RestartCondition.ON_FAILURE,
+                              delay=0.05))
+    spec2.task.container.healthcheck = HealthConfig(
+        test=["CMD-SHELL", "exit 1"],
+        interval=0.1, timeout=1.0, retries=2)
+    svc2 = api.create_service(spec2)
+
+    def replaced():
+        ts = api.list_tasks(service_id=svc2.id)
+        return len([t for t in ts
+                    if t.status.state == TaskState.FAILED]) >= 2
+    poll(replaced, timeout=25,
+         msg="restart policy should replace unhealthy tasks")
+
+
 def test_process_shutdown_terminates_group(cluster):
     manager, node, executor = cluster
     api = manager.control_api
