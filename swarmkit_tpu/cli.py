@@ -46,8 +46,27 @@ def _build_parser() -> argparse.ArgumentParser:
     create = svc.add_parser("create")
     create.add_argument("--name", required=True)
     create.add_argument("--image", required=True)
-    create.add_argument("--replicas", type=int, default=1)
+    create.add_argument("--replicas", type=int, default=None)
+    create.add_argument("--mode", choices=["replicated", "global"],
+                        default="replicated")
     create.add_argument("--constraint", action="append", default=[])
+    create.add_argument("--env", action="append", default=[],
+                        metavar="KEY=VALUE")
+    create.add_argument("--label", action="append", default=[],
+                        metavar="KEY=VALUE")
+    create.add_argument("--publish", action="append", default=[],
+                        metavar="PUBLISHED:TARGET[/PROTO]",
+                        help="publish a port (e.g. 8080:80 or 53:53/udp)")
+    create.add_argument("--network", action="append", default=[],
+                        help="attach to a network by name or id")
+    create.add_argument("--secret", action="append", default=[],
+                        metavar="NAME[:TARGET]")
+    create.add_argument("--config", action="append", default=[],
+                        metavar="NAME[:TARGET]")
+    create.add_argument("--restart-condition",
+                        choices=["none", "on-failure", "any"], default=None)
+    create.add_argument("--restart-delay", type=float, default=None)
+    create.add_argument("--restart-max-attempts", type=int, default=None)
     create.add_argument("--csi-volume", action="append", default=[],
                         metavar="SOURCE:TARGET",
                         help="mount a CSI volume (source = volume name or "
@@ -214,13 +233,103 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
 
     if args.noun == "service":
         if args.verb == "create":
+            # reference: swarmctl service create flag surface
+            # (swarmd/cmd/swarmctl/service/flagparser)
             spec = ServiceSpec(
                 annotations=Annotations(name=args.name),
-                task=TaskSpec(container=ContainerSpec(image=args.image)),
-                mode=ServiceMode.REPLICATED,
-                replicated=ReplicatedService(replicas=args.replicas))
+                task=TaskSpec(container=ContainerSpec(image=args.image)))
+            if args.mode == "global":
+                if args.replicas is not None:
+                    raise APIError(
+                        "--replicas conflicts with --mode global")
+                spec.mode = ServiceMode.GLOBAL
+            else:
+                spec.mode = ServiceMode.REPLICATED
+                spec.replicated = ReplicatedService(
+                    replicas=1 if args.replicas is None
+                    else args.replicas)
             if args.constraint:
                 spec.task.placement.constraints = list(args.constraint)
+            if args.env:
+                for e in args.env:
+                    if "=" not in e:
+                        raise APIError("--env must be KEY=VALUE")
+                spec.task.container.env = list(args.env)
+            if args.label:
+                labels = {}
+                for kv in args.label:
+                    k, sep, v = kv.partition("=")
+                    if not sep or not k:
+                        raise APIError("--label must be KEY=VALUE")
+                    labels[k] = v
+                spec.annotations.labels = labels
+            if args.publish:
+                from .models.types import (
+                    EndpointSpec, PortConfig, PortProtocol,
+                )
+                protos = {"tcp": PortProtocol.TCP, "udp": PortProtocol.UDP,
+                          "sctp": PortProtocol.SCTP}
+                ports = []
+                for p in args.publish:
+                    spec_part, _, proto = p.partition("/")
+                    pub, sep, target = spec_part.partition(":")
+                    if not sep or not pub.isdigit() \
+                            or not target.isdigit() \
+                            or not 1 <= int(pub) <= 65535 \
+                            or not 1 <= int(target) <= 65535 \
+                            or (proto or "tcp") not in protos:
+                        raise APIError(
+                            "--publish must be PUBLISHED:TARGET[/PROTO] "
+                            "with ports in 1-65535")
+                    ports.append(PortConfig(
+                        protocol=protos[proto or "tcp"],
+                        target_port=int(target),
+                        published_port=int(pub)))
+                spec.endpoint = EndpointSpec(ports=ports)
+            if args.network:
+                from .models.types import NetworkAttachmentConfig
+                nets = api.list_networks()
+                for ref in args.network:
+                    n = _resolve(nets, ref, "network")
+                    # the allocator reads task-level attachments (VIPs
+                    # and per-task addresses key on spec.task.networks)
+                    spec.task.networks.append(
+                        NetworkAttachmentConfig(target=n.id))
+            if args.secret:
+                from .models.types import SecretReference
+                known = api.list_secrets()
+                for ref in args.secret:
+                    name, _, target = ref.partition(":")
+                    s = _resolve(known, name, "secret")
+                    real = s.spec.annotations.name
+                    spec.task.container.secrets.append(SecretReference(
+                        secret_id=s.id, secret_name=real,
+                        target=target or real))
+            if args.config:
+                from .models.types import ConfigReference
+                known = api.list_configs()
+                for ref in args.config:
+                    name, _, target = ref.partition(":")
+                    c = _resolve(known, name, "config")
+                    real = c.spec.annotations.name
+                    spec.task.container.configs.append(ConfigReference(
+                        config_id=c.id, config_name=real,
+                        target=target or real))
+            if (args.restart_condition is not None
+                    or args.restart_delay is not None
+                    or args.restart_max_attempts is not None):
+                from .models.types import RestartCondition
+                rp = spec.task.restart
+                if args.restart_condition is not None:
+                    rp.condition = {
+                        "none": RestartCondition.NONE,
+                        "on-failure": RestartCondition.ON_FAILURE,
+                        "any": RestartCondition.ANY,
+                    }[args.restart_condition]
+                if args.restart_delay is not None:
+                    rp.delay = args.restart_delay
+                if args.restart_max_attempts is not None:
+                    rp.max_attempts = args.restart_max_attempts
             if args.csi_volume:
                 from .models.types import Mount, MountType
                 for m in args.csi_volume:
@@ -270,6 +379,9 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
             if not replicas.isdigit():
                 raise APIError("scale target must be <service>=<replicas>")
             s = _resolve(api.list_services(), name, "service")
+            if s.spec.mode != ServiceMode.REPLICATED:
+                raise APIError(
+                    "scale only applies to replicated services")
             spec = s.spec.copy()
             spec.replicated = ReplicatedService(replicas=int(replicas))
             api.update_service(s.id, s.meta.version.index, spec)

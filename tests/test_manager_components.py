@@ -310,6 +310,51 @@ def test_manager_standalone_cluster_and_cli():
         insp = run_command(["node", "inspect", "w1"], api)
         assert "Hostname: w1" in insp and "Availability: active" in insp
 
+        # service create flag surface (reference: swarmctl
+        # service/flagparser): env, labels, publish, restart policy,
+        # secret/config refs, network attachment, global mode
+        run_command(["secret", "create", "db-pass", "hunter2"], api)
+        run_command(["config", "create", "app-conf", "x=1"], api)
+        nid = run_command(["network", "create", "backend0"], api)
+        sid2 = run_command(
+            ["service", "create", "--name", "rich", "--image", "api:1",
+             "--replicas", "1", "--env", "MODE=prod", "--label", "tier=web",
+             "--publish", "8080:80", "--publish", "53:53/udp",
+             "--network", "backend0", "--secret", "db-pass",
+             "--config", "app-conf:conf/app.ini",
+             "--restart-condition", "on-failure",
+             "--restart-delay", "0.5"], api)
+        rich = api.get_service(sid2)
+        assert rich.spec.task.container.env == ["MODE=prod"]
+        assert rich.spec.annotations.labels == {"tier": "web"}
+        ports = rich.spec.endpoint.ports
+        assert [(p.published_port, p.target_port, int(p.protocol))
+                for p in ports] == [(8080, 80, 0), (53, 53, 1)]
+        assert rich.spec.task.networks[0].target == nid
+        assert rich.spec.task.container.secrets[0].secret_name == "db-pass"
+        cref = rich.spec.task.container.configs[0]
+        assert cref.target == "conf/app.ini"
+        assert rich.spec.task.restart.condition.name == "ON_FAILURE"
+        assert rich.spec.task.restart.delay == 0.5
+        run_command(["service", "rm", "rich"], api)
+
+        gid = run_command(
+            ["service", "create", "--name", "everywhere",
+             "--image", "agent:1", "--mode", "global"], api)
+        assert api.get_service(gid).spec.mode.name == "GLOBAL"
+        poll(lambda: [t for t in api.list_tasks(service_id=gid)
+                      if t.status.state == TaskState.RUNNING] or None,
+             timeout=20, msg="global service should land on the worker")
+        with pytest.raises(APIError):
+            run_command(["service", "scale", "everywhere=3"], api)
+        run_command(["service", "rm", "everywhere"], api)
+        with pytest.raises(APIError):
+            run_command(["service", "create", "--name", "x", "--image",
+                         "i", "--mode", "global", "--replicas", "5"], api)
+        with pytest.raises(APIError):
+            run_command(["service", "create", "--name", "x", "--image",
+                         "i", "--publish", "99999:80"], api)
+
         # rolling update from the CLI: new image reaches every replica
         # through the update supervisor (reference: swarmctl service
         # update driving updater.go)
