@@ -122,6 +122,10 @@ def _build_parser() -> argparse.ArgumentParser:
     task = sub.add_parser("task").add_subparsers(dest="verb", required=True)
     tls = task.add_parser("ls")
     tls.add_argument("--service", default="")
+    tinspect = task.add_parser("inspect")
+    tinspect.add_argument("task")
+    trm = task.add_parser("rm")
+    trm.add_argument("task")
 
     secret = sub.add_parser("secret").add_subparsers(dest="verb",
                                                      required=True)
@@ -225,6 +229,23 @@ def _resolve(items, ident, what):
         if name == ident:
             return obj
     raise APIError(f"{what} {ident} not found")
+
+
+def _resolve_task(api, ident: str):
+    """Task lookup by id or unique id prefix (tasks have no names);
+    ambiguous prefixes error rather than picking an arbitrary match —
+    `task rm` is destructive."""
+    if not ident:
+        raise APIError("task id required")
+    matches = [t for t in api.list_tasks()
+               if t.id == ident or t.id.startswith(ident)]
+    if not matches:
+        raise APIError(f"task {ident} not found")
+    if len(matches) > 1 and not any(t.id == ident for t in matches):
+        raise APIError(
+            f"task prefix {ident} is ambiguous "
+            f"({len(matches)} matches)")
+    return next((t for t in matches if t.id == ident), matches[0])
 
 
 def run_command(argv: List[str], api: ControlAPI) -> str:
@@ -508,6 +529,34 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
             return "\n".join(lines)
 
     if args.noun == "task":
+        if args.verb == "inspect":
+            # reference: swarmctl task inspect (task/inspect.go)
+            t = _resolve_task(api, args.task)
+            lines = [
+                f"ID: {t.id}",
+                f"Service: {t.service_annotations.name or t.service_id}",
+                f"Slot: {t.slot}",
+                f"Node: {t.node_id or '-'}",
+                f"Status: {t.status.state.name}",
+                f"Desired: {t.desired_state.name}",
+            ]
+            if t.status.message:
+                lines.append(f"Message: {t.status.message}")
+            if t.status.err:
+                lines.append(f"Err: {t.status.err}")
+            if t.spec.container is not None:
+                lines.append(f"Image: {t.spec.container.image}")
+            if t.networks:
+                addrs = [a for n in t.networks for a in n.addresses]
+                if addrs:
+                    lines.append("Addresses: " + ", ".join(addrs))
+            return "\n".join(lines)
+        if args.verb == "rm":
+            # reference: Control.RemoveTask (controlapi task.go) — an
+            # operator escape hatch for stuck/historic tasks
+            t = _resolve_task(api, args.task)
+            api.remove_task(t.id)
+            return t.id
         tasks = api.list_tasks()
         if args.service:
             s = _resolve(api.list_services(), args.service, "service")

@@ -417,6 +417,9 @@ class RemoteControlClient:
         return [_obj_in(o) for o in self._call(
             "list_tasks", service_id=service_id, node_id=node_id)]
 
+    def remove_task(self, task_id: str):
+        self._call("remove_task", task_id=task_id)
+
     def collect_logs(self, service_id: str, duration: float = 2.0,
                      tail: int = -1, since: float = 0.0,
                      follow: bool = True, streams=None):

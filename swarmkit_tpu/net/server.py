@@ -478,6 +478,9 @@ class ManagerServer:
             return [obj_out(t) for t in api.list_tasks(
                 service_id=params.get("service_id", ""),
                 node_id=params.get("node_id", ""))]
+        if method == "remove_task":
+            api.remove_task(params["task_id"])
+            return "ok"
         if method == "create_secret":
             return obj_out(api.create_secret(
                 serde.from_dict(SecretSpec, params["spec"])))

@@ -151,6 +151,14 @@ class Orchestrator:
                 self._reconcile_one_node(obj)
         elif isinstance(obj, Task) and ev.action == "update":
             self._handle_task_change(obj)
+        elif isinstance(obj, Task) and ev.action == "delete":
+            # beyond the reference (global.go:164 only watches updates):
+            # an out-of-band deletion (operator `task rm`) of a live
+            # global task would otherwise leave its node without a
+            # replica until an unrelated event arrives
+            if (obj.service_id in self.global_services
+                    and obj.desired_state <= TaskState.RUNNING):
+                self._reconcile_services([obj.service_id])
 
     def _handle_task_change(self, t: Task) -> None:
         if t.service_id not in self.global_services:

@@ -299,6 +299,10 @@ def test_manager_standalone_cluster_and_cli():
         assert "web" in ls and "nginx" in ls
         tasks_out = run_command(["task", "ls"], api)
         assert "RUNNING" in tasks_out and "web.1" in tasks_out
+        t0 = api.list_tasks(service_id=service_id)[0]
+        insp = run_command(["task", "inspect", t0.id[:8]], api)
+        assert f"ID: {t0.id}" in insp and "Status: " in insp
+        assert "Image: nginx" in insp
         nodes_out = run_command(["node", "ls"], api)
         assert "w1" in nodes_out and "READY" in nodes_out
 
