@@ -135,6 +135,8 @@ def _build_parser() -> argparse.ArgumentParser:
     secret.add_parser("ls")
     srm = secret.add_parser("rm")
     srm.add_argument("secret")
+    sinsp = secret.add_parser("inspect")
+    sinsp.add_argument("secret")
 
     config = sub.add_parser("config").add_subparsers(dest="verb",
                                                      required=True)
@@ -144,6 +146,8 @@ def _build_parser() -> argparse.ArgumentParser:
     config.add_parser("ls")
     crm = config.add_parser("rm")
     crm.add_argument("config")
+    cinsp = config.add_parser("inspect")
+    cinsp.add_argument("config")
 
     network = sub.add_parser("network").add_subparsers(dest="verb",
                                                        required=True)
@@ -586,6 +590,16 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
             s = _resolve(api.list_secrets(), args.secret, "secret")
             api.remove_secret(s.id)
             return s.id
+        if args.verb == "inspect":
+            # reference: swarmctl secret inspect — metadata only, the
+            # payload never leaves the manager (secret.go ListSecrets
+            # strips Spec.Data)
+            s = _resolve(api.list_secrets(), args.secret, "secret")
+            return "\n".join([
+                f"ID: {s.id}",
+                f"Name: {s.spec.annotations.name}",
+                f"Created: {s.meta.created_at}",
+                f"Version: {s.meta.version.index}"])
 
     if args.noun == "network":
         from .models.specs import NetworkSpec
@@ -803,6 +817,15 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
             c = _resolve(api.list_configs(), args.config, "config")
             api.remove_config(c.id)
             return c.id
+        if args.verb == "inspect":
+            # reference: swarmctl config inspect — configs are not
+            # sensitive, so the payload prints (config/inspect.go)
+            c = _resolve(api.list_configs(), args.config, "config")
+            return "\n".join([
+                f"ID: {c.id}",
+                f"Name: {c.spec.annotations.name}",
+                f"Version: {c.meta.version.index}",
+                "Data: " + c.spec.data.decode("utf-8", "replace")])
 
     raise APIError("unknown command")
 

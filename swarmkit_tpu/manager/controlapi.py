@@ -69,6 +69,15 @@ def _validate_annotations(ann) -> None:
         raise InvalidArgument("name must be 63 characters or fewer")
 
 
+def _stripped_secret(secret):
+    """API-response projection of a secret: the payload never leaves the
+    manager — every secret-returning endpoint redacts through this one
+    point (reference: secret.go:44,87,143,175)."""
+    s = secret.copy()
+    s.spec.data = b""
+    return s
+
+
 def _validate_secret_annotations(ann) -> None:
     if not ann.name:
         raise InvalidArgument("name must be provided")
@@ -487,13 +496,14 @@ class ControlAPI:
         except NameConflict:
             raise AlreadyExists(
                 f"secret {spec.annotations.name} already exists")
-        return self.store.view(lambda tx: tx.get(Secret, secret.id))
+        return _stripped_secret(
+            self.store.view(lambda tx: tx.get(Secret, secret.id)))
 
     def get_secret(self, secret_id: str) -> Secret:
         s = self.store.view(lambda tx: tx.get(Secret, secret_id))
         if s is None:
             raise NotFound(f"secret {secret_id} not found")
-        return s
+        return _stripped_secret(s)
 
     def update_secret(self, secret_id: str, version: int,
                       spec: SecretSpec) -> Secret:
@@ -511,7 +521,7 @@ class ControlAPI:
             return secret
 
         try:
-            return self.store.update(cb)
+            return _stripped_secret(self.store.update(cb))
         except SequenceConflict as e:
             raise FailedPrecondition(str(e))
 
@@ -540,13 +550,7 @@ class ControlAPI:
 
     def list_secrets(self) -> List[Secret]:
         secrets = self.store.view(lambda tx: tx.find(Secret))
-        # data is never returned over the API (reference: secret.go:98)
-        out = []
-        for s in secrets:
-            cp = s.copy()
-            cp.spec.data = b""
-            out.append(cp)
-        return out
+        return [_stripped_secret(s) for s in secrets]
 
     # --------------------------------------------------------------- configs
 

@@ -435,6 +435,12 @@ class RemoteControlClient:
         return _obj_in(self._call("create_secret",
                                   spec=serde.to_dict(spec)))
 
+    def get_secret(self, secret_id):
+        return _obj_in(self._call("get_secret", secret_id=secret_id))
+
+    def get_config(self, config_id):
+        return _obj_in(self._call("get_config", config_id=config_id))
+
     def list_secrets(self):
         return [_obj_in(o) for o in self._call("list_secrets")]
 

@@ -484,6 +484,10 @@ class ManagerServer:
         if method == "create_secret":
             return obj_out(api.create_secret(
                 serde.from_dict(SecretSpec, params["spec"])))
+        if method == "get_secret":
+            return obj_out(api.get_secret(params["secret_id"]))
+        if method == "get_config":
+            return obj_out(api.get_config(params["config_id"]))
         if method == "list_secrets":
             return [obj_out(s) for s in api.list_secrets()]
         if method == "remove_secret":

@@ -175,10 +175,14 @@ def test_secret_lifecycle(api):
         api.create_secret(SecretSpec(annotations=Annotations(name="s"),
                                      data=b"x"))
 
-    # list hides data
+    # the payload never leaves the manager — list AND get strip it
+    # (reference: secret.go:44,143); the stored object keeps it
     listed = api.list_secrets()
     assert listed[0].spec.data == b""
-    assert api.get_secret(secret.id).spec.data == b"data"
+    assert api.get_secret(secret.id).spec.data == b""
+    from swarmkit_tpu.models import Secret as _Secret
+    assert api.store.view(
+        lambda tx: tx.get(_Secret, secret.id)).spec.data == b"data"
 
     with pytest.raises(InvalidArgument,
                        match="only updates to Labels are allowed"):
@@ -190,7 +194,9 @@ def test_secret_lifecycle(api):
         SecretSpec(annotations=Annotations(name="s",
                                            labels={"env": "prod"})))
     assert updated.spec.annotations.labels == {"env": "prod"}
-    assert api.get_secret(secret.id).spec.data == b"data"
+    assert updated.spec.data == b""   # responses stay stripped
+    assert api.store.view(
+        lambda tx: tx.get(_Secret, secret.id)).spec.data == b"data"
 
     api.remove_secret(secret.id)
     with pytest.raises(NotFound):
@@ -566,6 +572,13 @@ def test_cli_nouns_over_remote_control_client():
         run_command(["resource", "create", "k1", "kinds"], ctl)
         assert "k1" in run_command(["resource", "ls"], ctl)
         run_command(["resource", "rm", "k1"], ctl)
+        run_command(["secret", "create", "rs", "payload"], ctl)
+        insp = run_command(["secret", "inspect", "rs"], ctl)
+        assert "Name: rs" in insp and "payload" not in insp
+        run_command(["secret", "rm", "rs"], ctl)
+        run_command(["config", "create", "rc", "k=v"], ctl)
+        assert "Data: k=v" in run_command(["config", "inspect", "rc"], ctl)
+        run_command(["config", "rm", "rc"], ctl)
         run_command(["extension", "rm", "kinds"], ctl)
         # service ls pulls running/desired through the wire statuses RPC
         run_command(["service", "create", "--name", "rweb",
