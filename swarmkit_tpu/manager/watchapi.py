@@ -28,6 +28,10 @@ class WatchRequest:
     actions: List[str] = field(default_factory=list)  # [] = all actions
     id_prefix: str = ""
     name_prefix: str = ""
+    # task-shaped selectors (reference: watch.proto SelectByServiceID /
+    # SelectByNodeID); objects without the field never match
+    service_ids: List[str] = field(default_factory=list)
+    node_ids: List[str] = field(default_factory=list)
     include_old_object: bool = False
     # store version to resume from (0/None = live-only, no replay)
     resume_from_version: Optional[int] = None
@@ -68,6 +72,14 @@ class WatchServer:
                 if not _obj_name(ev.obj).lower().startswith(
                         request.name_prefix.lower()):
                     return False
+            if request.service_ids and \
+                    getattr(ev.obj, "service_id", None) \
+                    not in request.service_ids:
+                return False
+            if request.node_ids and \
+                    getattr(ev.obj, "node_id", None) \
+                    not in request.node_ids:
+                return False
             return True
 
         if request.resume_from_version is not None:

@@ -255,6 +255,23 @@ def test_watch_api_filters():
         stream.get(timeout=0.1)   # the task event was filtered out
     stream.close()
 
+    # service/node selectors (reference: watch.proto SelectByServiceID/
+    # SelectByNodeID)
+    stream = server.watch(WatchRequest(kinds=[Task],
+                                       service_ids=["svc-a"]))
+    ta = Task(id=new_id(), service_id="svc-a", slot=1)
+    tb = Task(id=new_id(), service_id="svc-b", slot=1)
+    store.update(lambda tx: (tx.create(ta), tx.create(tb)))
+    assert stream.get(timeout=2).obj.id == ta.id
+    with pytest.raises(TimeoutError):
+        stream.get(timeout=0.1)
+    stream.close()
+    stream = server.watch(WatchRequest(kinds=[Task], node_ids=["n-1"]))
+    tc = Task(id=new_id(), service_id="svc-a", slot=2, node_id="n-1")
+    store.update(lambda tx: tx.create(tc))
+    assert stream.get(timeout=2).obj.id == tc.id
+    stream.close()
+
 
 # ------------------------------------------------- manager composition + CLI
 
