@@ -235,6 +235,29 @@ def _resolve(items, ident, what):
     raise APIError(f"{what} {ident} not found")
 
 
+def _update_node_spec(api, ident: str, mutate):
+    """Read-modify-write a node spec with a bounded retry: agents write
+    node status/description concurrently, so a freshly read version can
+    be stale by the time the update lands (SequenceConflict semantics).
+    Real operators should not have to hand-retry a role or availability
+    flip."""
+    import time as _time
+    last = None
+    for _ in range(10):
+        n = _resolve(api.list_nodes(), ident, "node")
+        spec = n.spec.copy()
+        mutate(spec)
+        try:
+            api.update_node(n.id, n.meta.version.index, spec)
+            return n
+        except APIError as e:
+            if "stale version" not in str(e):
+                raise
+            last = e
+            _time.sleep(0.05)
+    raise last
+
+
 def _resolve_task(api, ident: str):
     """Task lookup by id or unique id prefix (tasks have no names);
     ambiguous prefixes error rather than picking an arbitrary match —
@@ -480,14 +503,15 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
             # reference: swarmctl node drain/activate/pause (availability
             # flips; PAUSE keeps running tasks but blocks new placements —
             # the scheduler's ReadyFilter requires ACTIVE)
-            n = _resolve(api.list_nodes(), args.node, "node")
-            spec = n.spec.copy()
-            spec.availability = {
+            avail = {
                 "drain": NodeAvailability.DRAIN,
                 "activate": NodeAvailability.ACTIVE,
                 "pause": NodeAvailability.PAUSE,
             }[args.verb]
-            api.update_node(n.id, n.meta.version.index, spec)
+
+            def set_avail(spec):
+                spec.availability = avail
+            n = _update_node_spec(api, args.node, set_avail)
             return f"{n.id} " + {"drain": "drained", "activate": "activated",
                                  "pause": "paused"}[args.verb]
         if args.verb in ("promote", "demote"):
@@ -495,12 +519,12 @@ def run_command(argv: List[str], api: ControlAPI) -> str:
             # spec.desired_role; the role manager reconciles raft
             # membership and the node's CA renewal picks up the role)
             from .models.types import NodeRole
-            n = _resolve(api.list_nodes(), args.node, "node")
-            spec = n.spec.copy()
-            spec.desired_role = (NodeRole.MANAGER
-                                 if args.verb == "promote"
-                                 else NodeRole.WORKER)
-            api.update_node(n.id, n.meta.version.index, spec)
+            role = (NodeRole.MANAGER if args.verb == "promote"
+                    else NodeRole.WORKER)
+
+            def set_role(spec):
+                spec.desired_role = role
+            n = _update_node_spec(api, args.node, set_role)
             return f"{n.id} " + ("promoted" if args.verb == "promote"
                                  else "demoted")
         if args.verb == "rm":

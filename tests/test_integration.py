@@ -7,6 +7,7 @@ daemon incl. a downed manager; node rejoin; rolling manager restarts.
 """
 
 import tempfile
+import time
 
 from swarmkit_tpu.models import (
     Annotations, Cluster, ReplicatedService, Service, Task, TaskState,
@@ -160,20 +161,35 @@ def _speed_up_heartbeats(api, period=0.5):
     api.store.update(lambda tx: tx.update(c))
 
 
+def _set_role(api, node_id, role):
+    """Role flip with read-modify-write retry: the agent's status and
+    description writes race the version we read, and the control API
+    rightly rejects stale versions (SequenceConflict semantics) — real
+    clients re-read and retry, so these helpers do too."""
+    from swarmkit_tpu.manager.controlapi import FailedPrecondition
+    last = None
+    for _ in range(10):
+        n = api.get_node(node_id)
+        spec = n.spec.copy()
+        spec.desired_role = role
+        try:
+            return api.update_node(n.id, n.meta.version.index, spec)
+        except FailedPrecondition as e:
+            if "stale version" not in str(e):
+                raise
+            last = e
+            time.sleep(0.1)
+    raise last
+
+
 def _promote(api, node_id):
     from swarmkit_tpu.models.types import NodeRole
-    n = api.get_node(node_id)
-    spec = n.spec.copy()
-    spec.desired_role = NodeRole.MANAGER
-    api.update_node(n.id, n.meta.version.index, spec)
+    _set_role(api, node_id, NodeRole.MANAGER)
 
 
 def _demote(api, node_id):
     from swarmkit_tpu.models.types import NodeRole
-    n = api.get_node(node_id)
-    spec = n.spec.copy()
-    spec.desired_role = NodeRole.WORKER
-    api.update_node(n.id, n.meta.version.index, spec)
+    _set_role(api, node_id, NodeRole.WORKER)
 
 
 def test_promote_worker_to_manager_under_daemon():
