@@ -195,9 +195,17 @@ class ProcessController(Controller):
         argv, hc = self._health_argv()
         self._health_failures = 0
         self._next_health_check = None
+        self._health_grace_until = 0.0
         if argv is not None:
+            # probes run on the normal interval from the start;
+            # start_period only suppresses failure COUNTING, it does not
+            # delay probing (reference: dockerd health.go — probes during
+            # the start period run but failures don't count, and one
+            # success ends the period early)
             self._next_health_check = time.monotonic() + \
-                (hc.start_period or hc.interval or 30.0)
+                (hc.interval or 30.0)
+            self._health_grace_until = time.monotonic() + \
+                (hc.start_period or 0.0)
 
     def _health_argv(self):
         """Health probe argv from the spec, or None when disabled
@@ -241,6 +249,8 @@ class ProcessController(Controller):
                 if self._interrupted.is_set():
                     continue   # probe aborted: verdict is inconclusive
                 if failed:
+                    if time.monotonic() < self._health_grace_until:
+                        continue   # start period: failures don't count
                     self._health_failures += 1
                     if self._health_failures >= (hc.retries or 3):
                         # unhealthy: stop the task so the restart policy
@@ -254,6 +264,9 @@ class ProcessController(Controller):
                             f"failures): {' '.join(health_argv)}")
                 else:
                     self._health_failures = 0
+                    # a success ends the start period early: later
+                    # failures count from here on
+                    self._health_grace_until = 0.0
             time.sleep(WAIT_POLL_INTERVAL)
         code = proc.returncode
         if code != 0:

@@ -78,6 +78,23 @@ def _stripped_secret(secret):
     return s
 
 
+def _redacted_cluster(cluster):
+    """API-response projection of a cluster: signing keys and unlock
+    keys never leave the manager (reference: controlapi/cluster.go:252
+    redactClusters — strips Spec.CAConfig.SigningCAKey/SigningCACert,
+    RootCA.CAKey, RootRotation.CAKey, and omits UnlockKeys and
+    NetworkBootstrapKeys; join tokens stay — they're operator-facing)."""
+    c = cluster.copy()
+    c.spec.ca_config.signing_ca_key = b""
+    c.spec.ca_config.signing_ca_cert = b""
+    if c.root_ca is not None:
+        c.root_ca.ca_key = b""
+        c.root_ca.rotation_ca_key = b""
+    c.unlock_keys = []
+    c.network_bootstrap_keys = []
+    return c
+
+
 def _validate_secret_annotations(ann) -> None:
     if not ann.name:
         raise InvalidArgument("name must be provided")
@@ -204,6 +221,21 @@ def _validate_mode(spec: ServiceSpec) -> None:
                 "job-mode services cannot have update options")
 
 
+def _normalized_service_spec(spec: ServiceSpec) -> ServiceSpec:
+    """Private normalized copy of a validated spec.  REPLICATED_JOB
+    defaults max_concurrent to total_completions (like the docker CLI)
+    so DesiredTasks can report MaxConcurrent directly, matching
+    reference ListServiceStatuses (controlapi/service.go:1086).
+    Applied on create AND update so stored specs are always normalized."""
+    spec = spec.copy()
+    if spec.mode == ServiceMode.REPLICATED_JOB \
+            and spec.replicated_job is not None \
+            and not spec.replicated_job.max_concurrent:
+        spec.replicated_job.max_concurrent = \
+            spec.replicated_job.total_completions
+    return spec
+
+
 def validate_service_spec(spec: Optional[ServiceSpec]) -> None:
     """reference: service.go:527 validateServiceSpec."""
     if spec is None:
@@ -299,7 +331,8 @@ class ControlAPI:
         """reference: service.go:727 CreateService."""
         validate_service_spec(spec)
         self._check_port_conflicts(spec, "")
-        service = Service(id=new_id(), spec=spec.copy(),
+        spec = _normalized_service_spec(spec)
+        service = Service(id=new_id(), spec=spec,
                           spec_version=Version(index=1))
 
         def cb(tx):
@@ -340,7 +373,7 @@ class ControlAPI:
             service.meta.version.index = version
             service.previous_spec = service.spec
             service.previous_spec_version = service.spec_version
-            service.spec = spec.copy()
+            service.spec = _normalized_service_spec(spec)
             service.spec_version = Version(index=self.store.version + 1)
             service.update_status = None
             tx.update(service)
@@ -390,10 +423,12 @@ class ControlAPI:
                             svc.spec.replicated.replicas
                             if svc.spec.replicated else 1)
                     elif mode == ServiceMode.REPLICATED_JOB:
+                        # MaxConcurrent alone, matching reference
+                        # ListServiceStatuses (controlapi/service.go);
+                        # total_completions is not a desired-slot count
                         job = svc.spec.replicated_job
                         status["desired_tasks"] = (
-                            (job.max_concurrent or job.total_completions)
-                            if job else 0)
+                            job.max_concurrent if job else 0)
                     else:
                         global_ = True
                     if svc.job_status is not None:
@@ -669,13 +704,19 @@ class ControlAPI:
         c = self.store.view(lambda tx: tx.get(Cluster, cluster_id))
         if c is None:
             raise NotFound(f"cluster {cluster_id} not found")
-        return c
+        return _redacted_cluster(c)
 
     def list_clusters(self) -> List[Cluster]:
         """reference: manager/controlapi/cluster.go ListClusters."""
-        return self.store.view(lambda tx: tx.find(Cluster))
+        return [_redacted_cluster(c)
+                for c in self.store.view(lambda tx: tx.find(Cluster))]
 
     def get_default_cluster(self) -> Cluster:
+        return _redacted_cluster(self._default_cluster_raw())
+
+    def _default_cluster_raw(self) -> Cluster:
+        """Unredacted default cluster, for in-process callers that need
+        key material (autolock, unlock-key); never served over the wire."""
         clusters = self.store.view(
             lambda tx: tx.find(Cluster, ByName("default")))
         if not clusters:
@@ -689,7 +730,17 @@ class ControlAPI:
                 raise NotFound(f"cluster {cluster_id} not found")
             cluster = cluster.copy()
             cluster.meta.version.index = version
-            cluster.spec = spec.copy()
+            new_spec = spec.copy()
+            # redacted inspect→update round trips blank the signing CA
+            # material; empty means "keep current", never "clear"
+            # (reference: controlapi/cluster.go redaction note)
+            if not new_spec.ca_config.signing_ca_key:
+                new_spec.ca_config.signing_ca_key = \
+                    cluster.spec.ca_config.signing_ca_key
+            if not new_spec.ca_config.signing_ca_cert:
+                new_spec.ca_config.signing_ca_cert = \
+                    cluster.spec.ca_config.signing_ca_cert
+            cluster.spec = new_spec
             tx.update(cluster)
             return cluster
 
@@ -986,7 +1037,7 @@ class ControlAPI:
     def get_unlock_key(self) -> str:
         """Current unlock key ('' when autolock is off) — operator-only
         (reference: controlapi GetUnlockKey)."""
-        cluster = self.get_default_cluster()
+        cluster = self._default_cluster_raw()
         for ek in cluster.unlock_keys:
             if ek.subsystem == "manager":
                 return ek.key.decode()
@@ -1065,12 +1116,20 @@ class ControlAPI:
         try:
             # history backlog is pre-buffered at subscribe time: drain it
             # fully BEFORE the live-collection window starts, so a short
-            # duration can never truncate the tail/since replay
-            while True:
+            # duration can never truncate the tail/since replay.  Bounded
+            # by the backlog size snapshotted at subscribe — with follow
+            # a producer outpacing the 10ms poll must not extend this
+            # phase past the replay (live output belongs to the
+            # duration-bounded window below)
+            remaining = getattr(stream, "backlog_count", 0) \
+                if follow else None
+            while remaining is None or remaining > 0:
                 try:
                     msg = stream.get(timeout=0.01)
                 except Exception:   # empty (timeout) or closed (no follow)
                     break
+                if remaining is not None:
+                    remaining -= 1
                 out.append({"task_id": msg.task_id,
                             "node_id": msg.node_id,
                             "stream": msg.stream, "data": msg.data})

@@ -74,6 +74,10 @@ class _LogSubscription:
         self.options = options
         self.stream = Queue()
         self._sub = self.stream.subscribe()
+        # number of history-replay messages queued at subscribe time;
+        # consumers drain exactly this many before the live window so a
+        # fast producer can't extend the drain phase unboundedly
+        self.backlog_count = 0
 
     def matches(self, msg: LogMessage, task: Optional[Task]) -> bool:
         opts = self.options
@@ -130,6 +134,7 @@ class LogBroker:
         sub = _LogSubscription(self, selector, options)
         with self._mu:
             backlog = self._backlog_locked(sub)
+            sub.backlog_count = len(backlog)
             for msg in backlog:
                 sub.stream.publish(msg)
             if options.follow:
@@ -195,11 +200,12 @@ class LogBroker:
                     used -= len(ring.pop(0).data)
                 self._history_bytes[msg.task_id] = used
             self._prune_tick += 1
-            if len(self._history) > 1024 and self._prune_tick >= 256:
+            if self._prune_tick >= 256:
                 # long-lived managers: drop rings for tasks the store no
                 # longer knows (reaped); active tasks keep their history.
-                # Interval-gated: with >1024 LIVE tasks the scan would
-                # otherwise rerun on every ingest batch under the lock
+                # Interval-gated (every 256 ingests) so the scan doesn't
+                # rerun on every batch under the lock; unconditional on
+                # ring count — ≤1024 dead rings still pin up to 256MiB
                 self._prune_tick = 0
                 for tid in list(self._history):
                     if self.store.raw_get(Task, tid) is None:

@@ -952,7 +952,8 @@ class Swarmd:
         """The cluster's manager unlock key when autolock is enabled
         (bytes), else None."""
         try:
-            cluster = self.manager.control_api.get_default_cluster()
+            # unredacted read: the API projection strips unlock_keys
+            cluster = self.manager.control_api._default_cluster_raw()
         except Exception:
             return None
         if not cluster.spec.encryption_config.auto_lock_managers:

@@ -773,3 +773,58 @@ def test_cli_cluster_update_live_settings():
     assert c.spec.ca_config.node_cert_expiry == 3600
     assert c.spec.orchestration.task_history_retention_limit == 9
     assert run_command(["cluster", "update"], api) == "nothing to update"
+
+
+def test_cluster_responses_redact_key_material():
+    """get/list/get_default cluster strip signing + unlock keys but keep
+    join tokens, and a redacted inspect→update round trip preserves the
+    stored signing CA material (reference: controlapi/cluster.go:252
+    redactClusters)."""
+    from swarmkit_tpu.manager.controlapi import ControlAPI
+    from swarmkit_tpu.models import Cluster
+    from swarmkit_tpu.models.objects import RootCAState
+    from swarmkit_tpu.models.specs import ClusterSpec
+    from swarmkit_tpu.models.types import (
+        Annotations, EncryptionKey, JoinTokens,
+    )
+    from swarmkit_tpu.state import MemoryStore
+
+    store = MemoryStore()
+    spec = ClusterSpec(annotations=Annotations(name="default"))
+    spec.ca_config.signing_ca_key = b"SIGNKEY"
+    spec.ca_config.signing_ca_cert = b"SIGNCERT"
+    store.update(lambda tx: tx.create(Cluster(
+        id="c1", spec=spec,
+        root_ca=RootCAState(
+            ca_key=b"CAKEY", ca_cert=b"CACERT",
+            rotation_ca_key=b"ROTKEY",
+            join_tokens=JoinTokens(worker="SWMTKN-w", manager="SWMTKN-m")),
+        unlock_keys=[EncryptionKey(subsystem="manager", key=b"UNLOCK")],
+        network_bootstrap_keys=[
+            EncryptionKey(subsystem="networking", key=b"GOSSIP")])))
+    api = ControlAPI(store)
+
+    for c in (api.get_cluster("c1"), api.get_default_cluster(),
+              *api.list_clusters()):
+        assert c.spec.ca_config.signing_ca_key == b""
+        assert c.spec.ca_config.signing_ca_cert == b""
+        assert c.root_ca.ca_key == b""
+        assert c.root_ca.rotation_ca_key == b""
+        assert c.unlock_keys == []
+        assert c.network_bootstrap_keys == []
+        # public material survives redaction
+        assert c.root_ca.ca_cert == b"CACERT"
+        assert c.root_ca.join_tokens.worker == "SWMTKN-w"
+
+    # in-process raw reads still see the key material (autolock path)
+    assert api._default_cluster_raw().unlock_keys[0].key == b"UNLOCK"
+
+    # redacted round trip: update with a blanked spec keeps signing keys
+    c = api.get_default_cluster()
+    new_spec = c.spec.copy()
+    new_spec.dispatcher.heartbeat_period = 7.0
+    api.update_cluster(c.id, c.meta.version.index, new_spec)
+    stored = api._default_cluster_raw()
+    assert stored.spec.dispatcher.heartbeat_period == 7.0
+    assert stored.spec.ca_config.signing_ca_key == b"SIGNKEY"
+    assert stored.spec.ca_config.signing_ca_cert == b"SIGNCERT"
