@@ -262,6 +262,164 @@ def run_storm(planner_factory):
     }
 
 
+def run_live_manager(planner_factory, external_firehose=False):
+    """Config 6: config-4's shape (100k pending tasks x 10k nodes) in
+    PRODUCTION shape — a real single-voter raft proposer (on-disk WAL,
+    consensus apply path) attached to the store, plus the control
+    plane's subscriber mix (dispatcher sessions, orchestrator/reaper
+    loops, metrics collector — all in their real block-aware
+    subscription shapes, with live consumer threads).  Blocks ride one
+    compact TaskBlockAction per chunk through raft and publish one
+    coalesced EventTaskBlock.
+
+    ``external_firehose`` adds a watch-API-style client consuming EVERY
+    task as a synthesized per-task event.  Synthesis runs on the
+    consumer's own thread (never the commit path), but this benchmark
+    host has ONE core, so the firehose's GIL time lands in the tick
+    wall-clock anyway; it is off by default because a real manager has
+    no all-task external watcher — the cost scales with what external
+    clients actually subscribe to."""
+    _trim_heap()
+    import shutil
+    import tempfile
+    import threading
+
+    from swarmkit_tpu.models import Task as _Task, TaskState
+    from swarmkit_tpu.state import match
+    from swarmkit_tpu.state.raft import LocalNetwork, RaftLogger, RaftNode
+
+    store, svc, nodes, tasks = build_cluster(N_NODES, N_TASKS)
+    tmp = tempfile.mkdtemp(prefix="bench-raft-")
+    rn = RaftNode("b0", ["b0"], store,
+                  RaftLogger(os.path.join(tmp, "b0")), LocalNetwork())
+    store._proposer = rn
+    rn.start()
+    deadline = time.time() + 15
+    while not (rn.is_leader and rn.core.leader_ready):
+        if time.time() > deadline:
+            raise RuntimeError("bench raft leader not ready")
+        time.sleep(0.01)
+
+    from swarmkit_tpu.state.events import EventTaskBlock
+
+    counts = {}
+    # the subscriber mix a live manager carries, in each component's real
+    # subscription shape: block-aware control loops (orchestrators,
+    # reaper, restart — they skip assignment blocks by contract),
+    # block-aware dispatcher sessions (per_node membership probes), the
+    # metrics collector (cheap per-item histogram shift), and one
+    # EXTERNAL watch client in the legacy per-event shape — it pays the
+    # per-task synthesis, on its own thread, never the commit path
+    subs = {
+        # real orchestrator/reaper loops subscribe unfiltered and skip
+        # blocks by contract (state<=RUNNING); model that exactly
+        "orchestrator": store.queue.subscribe(accepts_blocks=True),
+        "reaper": store.queue.subscribe(accepts_blocks=True),
+    }
+    if external_firehose:
+        subs["external_watch"] = store.queue.subscribe(
+            match(_Task, actions=("update",)))
+    session_nodes = [n.id for n in nodes[:8]]
+    for i, nid in enumerate(session_nodes):
+        def pred(ev, nid=nid):
+            if isinstance(ev, EventTaskBlock):
+                return True   # per-node probe runs on the consumer side
+            return getattr(getattr(ev, "obj", None), "node_id",
+                           None) == nid
+        subs[f"session{i}"] = store.queue.subscribe(
+            pred, accepts_blocks=True)
+    hist = {}
+    metrics_sub = store.queue.subscribe(accepts_blocks=True)
+    stop = threading.Event()
+
+    def consume(name, sub):
+        got = 0
+        while not stop.is_set():
+            items = sub.drain()
+            if items:
+                for it in items:
+                    if isinstance(it, EventTaskBlock):
+                        if name.startswith("session"):
+                            nid = session_nodes[int(name[7:])]
+                            got += len(it.per_node().get(nid, ()))
+                        else:
+                            got += len(it)   # control loop: O(1) skip
+                    else:
+                        got += 1
+            else:
+                time.sleep(0.01)
+        for it in sub.drain():
+            got += len(it) if isinstance(it, EventTaskBlock) else 1
+        counts[name] = got
+
+    def consume_metrics(sub):
+        got = 0
+
+        def absorb(items):
+            nonlocal got
+            for it in items:
+                if isinstance(it, EventTaskBlock):
+                    for old in it.olds:
+                        k = int(old.status.state)
+                        hist[k] = hist.get(k, 0) - 1
+                    hist[it.state] = hist.get(it.state, 0) + len(it)
+                    got += len(it)
+                else:
+                    got += 1
+
+        while not stop.is_set():
+            items = sub.drain()
+            if items:
+                absorb(items)
+            else:
+                time.sleep(0.01)
+        absorb(sub.drain())   # post-stop tail, like consume()
+        counts["metrics"] = got
+
+    threads = [threading.Thread(target=consume, args=(k, s), daemon=True)
+               for k, s in subs.items()]
+    threads.append(threading.Thread(target=consume_metrics,
+                                    args=(metrics_sub,), daemon=True))
+    for t in threads:
+        t.start()
+
+    try:
+        planner = planner_factory()
+        sched, n_dec, dt = one_tick(store, planner)
+        time.sleep(0.2)   # let consumers drain the tail
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        n_assigned = sum(
+            1 for t in store.view(lambda tx: tx.find(_Task))
+            if t.status.state >= TaskState.ASSIGNED and t.node_id)
+        assert n_assigned >= N_TASKS, \
+            f"live-manager: only {n_assigned}/{N_TASKS} ASSIGNED"
+        # the metrics histogram must balance, and when the firehose
+        # client is attached every decision must reach it as a per-task
+        # synthesized event
+        assert counts["metrics"] >= n_dec, counts
+        assert hist.get(int(TaskState.ASSIGNED), 0) >= n_dec, hist
+        if external_firehose:
+            assert counts["external_watch"] >= n_dec, counts
+        return {
+            "nodes": N_NODES, "tasks": N_TASKS,
+            "decisions": n_dec,
+            "decisions_per_sec": round(n_dec / dt, 1),
+            "tick_s": round(dt, 3),
+            "plan_s": round(planner.stats["plan_seconds"], 3),
+            "commit_s": round(sched.stats["commit_seconds"], 3),
+            "fallback_groups": planner.stats["groups_fallback"],
+            "raft_entries_applied": rn.stats["applied"],
+            "events_delivered": dict(counts),
+            "path": "device+raft+watchers",
+        }
+    finally:
+        stop.set()
+        rn.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_e2e(n_agents=5, n_replicas=500):
     """swarm-bench equivalent: create an N-replica service and measure
     per-task time from service creation to RUNNING status committed
@@ -429,6 +587,12 @@ def main():
                 spread=SpreadOver(spread_descriptor="node.labels.rack"))],
             global_share=0.2)
         configs["5_reschedule_storm"] = run_storm(tpu)
+        configs["6_live_manager_100k_x_10k"] = run_live_manager(tpu)
+        live = configs["6_live_manager_100k_x_10k"]["decisions_per_sec"]
+        # production-shape cost factor: the same 100k x 10k tick vs the
+        # lab-shape headline (no proposer/watchers); target <1.5x
+        configs["6_live_manager_100k_x_10k"]["shape_cost_x"] = round(
+            tpu_dps / live, 2) if live else None
     e2e = None if SKIP_E2E else run_e2e()
 
     print(json.dumps({

@@ -251,7 +251,9 @@ class Allocator:
                     if t.status.state == TaskState.NEW:
                         self._pending_tasks[t.id] = t
 
-            _, sub = self.store.view_and_watch(init)
+            # accepts_blocks: allocation triggers on NEW tasks and
+            # deletes; assignment blocks are updates past PENDING
+            _, sub = self.store.view_and_watch(init, accepts_blocks=True)
             try:
                 self._tick()
                 while not self._stop.is_set():

@@ -126,7 +126,8 @@ class Manager:
             for v in tx.find(Volume):
                 self.queue.enqueue(v.id)
 
-        _, sub = self.store.view_and_watch(init, predicate=pred)
+        _, sub = self.store.view_and_watch(init, predicate=pred,
+                                           accepts_blocks=True)
         try:
             while not self._stop.is_set():
                 try:

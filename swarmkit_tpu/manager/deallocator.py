@@ -57,7 +57,7 @@ class Deallocator:
                 return services, tx.find(Network), counts
 
             (services, networks, counts), sub = self.store.view_and_watch(
-                init, predicate=pred)
+                init, predicate=pred, accepts_blocks=True)
             try:
                 for s in services:
                     if not s.pending_delete:

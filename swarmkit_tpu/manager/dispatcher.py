@@ -35,7 +35,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..models.objects import Cluster, Config, Node, Secret, Task, Volume
 from ..models.types import NodeState, NodeStatus, TaskState, TaskStatus, now
-from ..state.events import Event, EventSnapshotRestore
+from ..state.events import Event, EventSnapshotRestore, EventTaskBlock
 from ..state.store import Batch, ByNode, MemoryStore
 from ..state.watch import Closed, Subscription
 from ..utils import new_id
@@ -375,7 +375,8 @@ class Dispatcher:
             self._cluster_sub = self.store.queue.subscribe(
                 lambda ev: isinstance(ev, EventSnapshotRestore)
                 or (isinstance(ev, Event) and isinstance(ev.obj, Cluster)
-                    and ev.action == "update"))
+                    and ev.action == "update"),
+                accepts_blocks=True)   # blocks are never cluster events
             self._load_cluster_config()
             self._mark_nodes_unknown()
             self._worker = threading.Thread(target=self._worker_loop,
@@ -798,6 +799,12 @@ class Dispatcher:
             applies_to = results_in
 
         def pred(ev):
+            if isinstance(ev, EventTaskBlock):
+                # deliver every block; the session loop probes its own
+                # node against the block's shared per-node grouping on
+                # the CONSUMER thread — predicates run on the committing
+                # writer's thread, which must stay O(1) per subscriber
+                return True
             if not isinstance(ev, Event):
                 return False
             if isinstance(ev.obj, Volume):
@@ -809,7 +816,8 @@ class Dispatcher:
             return list(tx.find(Task, ByNode(node_id)))
 
         try:
-            initial, sub = self.store.view_and_watch(init, predicate=pred)
+            initial, sub = self.store.view_and_watch(init, predicate=pred,
+                                                     accepts_blocks=True)
         except Exception as e:
             stream.close(e)
             return
@@ -863,6 +871,21 @@ class Dispatcher:
                             modifications += 1
                             deadline = now() + \
                                 cfg.assignment_batching_wait
+                        continue
+                    if isinstance(ev, EventTaskBlock):
+                        # scheduler block: only this node's slice matters;
+                        # raw_get materializes each task lazily from the
+                        # store overlay (the same object every reader sees)
+                        tx = self.store.view()
+                        modified = False
+                        for old, _ver in ev.per_node().get(node_id, ()):
+                            t = self.store.raw_get(Task, old.id)
+                            if t is None:
+                                continue
+                            modified |= aset.add_or_update_task(tx, t)
+                        if modified:
+                            modifications += 1
+                            deadline = now() + cfg.assignment_batching_wait
                         continue
                     t = ev.obj
                     if isinstance(t, Volume):

@@ -135,7 +135,8 @@ class Manager:
             # every manager loads the cluster's security config)
             self._ca_sub = self.store.queue.subscribe(
                 lambda ev: isinstance(ev, EventSnapshotRestore)
-                or (isinstance(ev, Event) and isinstance(ev.obj, Cluster)))
+                or (isinstance(ev, Event) and isinstance(ev.obj, Cluster)),
+                accepts_blocks=True)   # blocks are never cluster events
             # baseline digest = the root the daemon booted with, so even
             # the FIRST adoption fires the re-key hook when the replayed
             # cluster state carries a different (rotated) root

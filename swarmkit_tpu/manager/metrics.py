@@ -14,7 +14,7 @@ from typing import Dict, Optional
 from ..models.objects import (
     Config, Network, Node, Secret, Service, Task,
 )
-from ..state.events import Event, EventSnapshotRestore
+from ..state.events import Event, EventSnapshotRestore, EventTaskBlock
 from ..state.store import MemoryStore
 from ..state.watch import Closed
 from ..utils.metrics import registry
@@ -54,7 +54,7 @@ class Collector:
                         for n in objs:
                             self._node_states[int(n.status.state)] += 1
 
-            _, sub = self.store.view_and_watch(init)
+            _, sub = self.store.view_and_watch(init, accepts_blocks=True)
             self._export()
             try:
                 while not self._stop.is_set():
@@ -66,6 +66,14 @@ class Collector:
                         return
                     if isinstance(ev, EventSnapshotRestore):
                         self._recount()
+                    elif isinstance(ev, EventTaskBlock):
+                        # n state transitions in one event: shift the
+                        # histogram from the pre-assignment states (the
+                        # olds arrays, no materialization needed)
+                        for old in ev.olds:
+                            self._task_states[int(old.status.state)] -= 1
+                        self._task_states[int(ev.state)] += len(ev)
+                        self._export()
                     elif isinstance(ev, Event):
                         self._handle(ev)
             finally:

@@ -55,7 +55,8 @@ class RoleManager:
             def init(tx):
                 return tx.find(Node)
 
-            nodes, sub = self.store.view_and_watch(init, predicate=pred)
+            nodes, sub = self.store.view_and_watch(init, predicate=pred,
+                                                   accepts_blocks=True)
             try:
                 for n in nodes:
                     self._reconcile(n)

@@ -853,6 +853,223 @@ fail:
     return NULL;
 }
 
+/* block_validate(old_tasks, node_ids, objects, overlay, guard_state)
+ *     -> (accepted: range|list, slow: list)
+ *
+ * Read-only screen for the PROPOSER block path (store.py
+ * _commit_task_block_proposed): an item fast-accepts when the mirror IS
+ * the stored instance, is not overlaid, and its status state is below
+ * the guard; everything else routes to the Python slow loop for the
+ * full bulk-path checks.  No writes — the overlay/index mutation runs
+ * later, inside the consensus apply callback (block_apply below). */
+static PyObject *
+block_validate(PyObject *self, PyObject *args)
+{
+    PyObject *old_tasks, *node_ids, *objects, *overlay, *guard_state;
+    if (!PyArg_ParseTuple(args, "O!O!O!O!O", &PyList_Type, &old_tasks,
+                          &PyList_Type, &node_ids, &PyDict_Type, &objects,
+                          &PyDict_Type, &overlay, &guard_state))
+        return NULL;
+    long long guard_ll = PyLong_AsLongLong(guard_state);
+    int guard_ok = !(guard_ll == -1 && PyErr_Occurred());
+    if (!guard_ok)
+        PyErr_Clear();
+    Py_ssize_t n = PyList_GET_SIZE(old_tasks);
+    if (PyList_GET_SIZE(node_ids) != n) {
+        PyErr_SetString(PyExc_ValueError, "old_tasks/node_ids mismatch");
+        return NULL;
+    }
+    PyObject *accepted = PyList_New(0);
+    PyObject *slow = PyList_New(0);
+    if (!accepted || !slow)
+        goto fail;
+    Py_ssize_t n_contig = 0;
+    int contiguous = 1;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *old = PyList_GET_ITEM(old_tasks, i);
+        PyObject **dp = _PyObject_GetDictPtr(old);
+        PyObject *d = (dp != NULL && *dp != NULL) ? *dp : NULL;
+        int take_slow = 0;
+        PyObject *tid = d ? PyDict_GetItem(d, s_id) : NULL;
+        if (!tid) {
+            take_slow = 1;
+        } else {
+            PyObject *cur = PyDict_GetItem(objects, tid);
+            int in_overlay = PyDict_Contains(overlay, tid);
+            if (in_overlay < 0)
+                goto fail;
+            if (cur != old || in_overlay) {
+                take_slow = 1;
+            } else {
+                PyObject *status = PyDict_GetItem(d, s_status);
+                PyObject *st = NULL;
+                if (status != NULL) {
+                    PyObject **sdp = _PyObject_GetDictPtr(status);
+                    if (sdp != NULL && *sdp != NULL)
+                        st = PyDict_GetItem(*sdp, s_state);
+                }
+                if (!st) {
+                    take_slow = 1;
+                } else if (guard_ok) {
+                    long long stv = PyLong_AsLongLong(st);
+                    if (stv == -1 && PyErr_Occurred()) {
+                        PyErr_Clear();
+                        take_slow = 1;
+                    } else {
+                        take_slow = stv >= guard_ll;
+                    }
+                } else {
+                    int ge = PyObject_RichCompareBool(st, guard_state,
+                                                      Py_GE);
+                    if (ge < 0)
+                        goto fail;
+                    take_slow = ge;
+                }
+            }
+        }
+        if (take_slow) {
+            PyObject *idx = PyLong_FromSsize_t(i);
+            int r = idx ? PyList_Append(slow, idx) : -1;
+            Py_XDECREF(idx);
+            if (r < 0)
+                goto fail;
+            if (contiguous) {
+                contiguous = 0;
+                for (Py_ssize_t j = 0; j < n_contig; j++) {
+                    PyObject *jo = PyLong_FromSsize_t(j);
+                    int jr = jo ? PyList_Append(accepted, jo) : -1;
+                    Py_XDECREF(jo);
+                    if (jr < 0)
+                        goto fail;
+                }
+            }
+            continue;
+        }
+        if (contiguous) {
+            n_contig++;
+        } else {
+            PyObject *idx = PyLong_FromSsize_t(i);
+            int r = idx ? PyList_Append(accepted, idx) : -1;
+            Py_XDECREF(idx);
+            if (r < 0)
+                goto fail;
+        }
+    }
+    {
+        PyObject *out;
+        if (contiguous) {
+            PyObject *rng = PyObject_CallFunction(
+                (PyObject *)&PyRange_Type, "n", n_contig);
+            if (!rng)
+                goto fail;
+            out = Py_BuildValue("(OO)", rng, slow);
+            Py_DECREF(rng);
+        } else {
+            out = Py_BuildValue("(OO)", accepted, slow);
+        }
+        Py_DECREF(accepted);
+        Py_DECREF(slow);
+        return out;
+    }
+fail:
+    Py_XDECREF(accepted);
+    Py_XDECREF(slow);
+    return NULL;
+}
+
+/* block_apply(old_tasks, node_ids, accepted, overlay, by_node, ts,
+ *             state, message, base_seq) -> end_seq
+ *
+ * Write phase of the proposer block path, run inside the consensus
+ * apply callback: install (node_id, version, ts, state, message)
+ * overlay entries and maintain the by_node index for every accepted
+ * index, versions running base_seq+1.. in accepted order.  Mirrors the
+ * accept branch of block_commit exactly. */
+static PyObject *
+block_apply(PyObject *self, PyObject *args)
+{
+    PyObject *old_tasks, *node_ids, *accepted;
+    PyObject *overlay, *by_node, *ts, *state, *message;
+    long long seq;
+    if (!PyArg_ParseTuple(args, "O!O!OO!O!OOOL", &PyList_Type, &old_tasks,
+                          &PyList_Type, &node_ids, &accepted,
+                          &PyDict_Type, &overlay, &PyDict_Type, &by_node,
+                          &ts, &state, &message, &seq))
+        return NULL;
+    PyObject *fast = PySequence_Fast(accepted, "accepted must be iterable");
+    if (!fast)
+        return NULL;
+    Py_ssize_t k = PySequence_Fast_GET_SIZE(fast);
+    Py_ssize_t n = PyList_GET_SIZE(old_tasks);
+    PyObject *run_nid = NULL;
+    PyObject *run_set = NULL;
+    for (Py_ssize_t j = 0; j < k; j++) {
+        PyObject *io = PySequence_Fast_GET_ITEM(fast, j);
+        Py_ssize_t i = PyLong_AsSsize_t(io);
+        if (i < 0 || i >= n) {
+            if (PyErr_Occurred())
+                goto fail;
+            PyErr_SetString(PyExc_IndexError, "accepted index out of range");
+            goto fail;
+        }
+        PyObject *old = PyList_GET_ITEM(old_tasks, i);
+        PyObject **dp = _PyObject_GetDictPtr(old);
+        PyObject *d = (dp != NULL && *dp != NULL) ? *dp : NULL;
+        PyObject *tid = d ? PyDict_GetItem(d, s_id) : NULL;
+        if (!tid) {
+            PyErr_SetString(PyExc_ValueError, "task without id");
+            goto fail;
+        }
+        seq++;
+        PyObject *nid = PyList_GET_ITEM(node_ids, i);
+        PyObject *ver = PyLong_FromLongLong(seq);
+        if (!ver)
+            goto fail;
+        PyObject *entry = PyTuple_Pack(5, nid, ver, ts, state, message);
+        Py_DECREF(ver);
+        if (!entry || PyDict_SetItem(overlay, tid, entry) < 0) {
+            Py_XDECREF(entry);
+            goto fail;
+        }
+        Py_DECREF(entry);
+        PyObject *onid = PyDict_GetItem(d, s_node_id);
+        if (onid && PyObject_IsTrue(onid) && onid != nid) {
+            int eq = dict_vals_equal(onid, nid);
+            if (eq < 0)
+                goto fail;
+            if (!eq) {
+                PyObject *os = PyDict_GetItem(by_node, onid);
+                if (os && PySet_Discard(os, tid) < 0)
+                    goto fail;
+            }
+        }
+        if (nid != run_nid) {
+            run_nid = nid;
+            run_set = NULL;
+            if (PyObject_IsTrue(nid)) {
+                run_set = PyDict_GetItem(by_node, nid);
+                if (!run_set) {
+                    PyObject *fresh = PySet_New(NULL);
+                    if (!fresh ||
+                        PyDict_SetItem(by_node, nid, fresh) < 0) {
+                        Py_XDECREF(fresh);
+                        goto fail;
+                    }
+                    Py_DECREF(fresh);
+                    run_set = PyDict_GetItem(by_node, nid);
+                }
+            }
+        }
+        if (run_set && PySet_Add(run_set, tid) < 0)
+            goto fail;
+    }
+    Py_DECREF(fast);
+    return PyLong_FromLongLong(seq);
+fail:
+    Py_DECREF(fast);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"plan_apply", plan_apply, METH_VARARGS,
      "Clone and register planner decisions."},
@@ -860,6 +1077,10 @@ static PyMethodDef methods[] = {
      "Columnar task-block commit fast path (overlay + by_node index)."},
     {"block_stage", block_stage, METH_VARARGS,
      "Columnar staging of planned placements for the block-commit path."},
+    {"block_validate", block_validate, METH_VARARGS,
+     "Read-only screen for the proposer block-commit path."},
+    {"block_apply", block_apply, METH_VARARGS,
+     "Apply accepted block items (overlay + by_node), proposer path."},
     {"commit_prepare", commit_prepare, METH_VARARGS,
      "Validate, version-check, and stamp one commit chunk."},
     {"commit_apply", commit_apply, METH_VARARGS,

@@ -46,7 +46,7 @@ class _EnforcerLoop:
     def run(self) -> None:
         try:
             _, sub = self.store.view_and_watch(
-                self._init, predicate=self._pred)
+                self._init, predicate=self._pred, accepts_blocks=True)
             try:
                 self._initial_pass()
                 while not self._stop.is_set():

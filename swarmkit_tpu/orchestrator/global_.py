@@ -79,7 +79,9 @@ class Orchestrator:
                         self._update_service(s)
                         reconcile_ids.append(s.id)
 
-            _, sub = self.store.view_and_watch(init)
+            # accepts_blocks: assignment blocks (state<=RUNNING) are not
+            # failures; _handle_task_change only reacts to state>RUNNING
+            _, sub = self.store.view_and_watch(init, accepts_blocks=True)
             try:
                 # outside view_and_watch: check_tasks writes through
                 # store.batch, which needs the update lock view_and_watch

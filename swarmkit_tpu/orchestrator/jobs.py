@@ -237,7 +237,10 @@ class Orchestrator:
                     if common.is_replicated_job(s) or common.is_global_job(s):
                         self._dirty.add(s.id)
 
-            _, sub = self.store.view_and_watch(init)
+            # accepts_blocks: a job task's ASSIGNED flip changes neither
+            # the desired-state running count nor completions, so
+            # assignment blocks need no reconcile
+            _, sub = self.store.view_and_watch(init, accepts_blocks=True)
             try:
                 taskinit.check_tasks(self.store, self.store.view(), self,
                                      self.restarts)

@@ -65,7 +65,11 @@ class Orchestrator:
                     if common.is_replicated_service(s):
                         self.reconcile_services[s.id] = s
 
-            _, sub = self.store.view_and_watch(init)
+            # accepts_blocks: scheduler assignment blocks carry
+            # state<=RUNNING transitions by store contract — never a
+            # failure this loop reacts to (_handle_task_change fires on
+            # state>RUNNING); node invalidation arrives as Node events
+            _, sub = self.store.view_and_watch(init, accepts_blocks=True)
             try:
                 # outside view_and_watch: check_tasks writes through
                 # store.batch, which needs the update lock view_and_watch

@@ -279,7 +279,10 @@ class Supervisor:
         if self._worker is None or not self._worker.is_alive():
             self._stopped = False
             if self._sub is None:
-                self._sub = self.store.queue.subscribe(self._event_pred)
+                # accepts_blocks: pred drops them — assignment blocks are
+                # state<=RUNNING by store contract, never failures
+                self._sub = self.store.queue.subscribe(
+                    self._event_pred, accepts_blocks=True)
             self._worker = threading.Thread(
                 target=self._worker_loop, name="restart-timer", daemon=True)
             self._worker.start()

@@ -73,7 +73,10 @@ class TaskReaper:
                             or t.status.state >= TaskState.COMPLETE):
                         self.cleanup.append(t.id)
 
-            _, sub = self.store.view_and_watch(init)
+            # accepts_blocks: reaping triggers on creates, orphaned and
+            # REMOVE-desired terminal states — assignment blocks
+            # (state<=RUNNING by store contract) match none of those
+            _, sub = self.store.view_and_watch(init, accepts_blocks=True)
             try:
                 if self.cleanup:
                     self.tick()

@@ -181,7 +181,8 @@ class Updater:
                         and ev.obj.service_id == sid
                         and ev.obj.status.state > TaskState.RUNNING)
 
-            failed_watch = self.store.queue.subscribe(pred)
+            failed_watch = self.store.queue.subscribe(
+                pred, accepts_blocks=True)   # blocks are never failures
 
         try:
             slot_queue: "queue_mod.Queue" = queue_mod.Queue(maxsize=1)
@@ -307,7 +308,10 @@ class Updater:
             return (isinstance(ev, Event) and isinstance(ev.obj, Task)
                     and ev.obj.id == uid and ev.action == "update")
 
-        sub = self.store.queue.subscribe(pred)
+        # accepts_blocks: this wait only cares about state>=RUNNING, which
+        # assignment blocks (state<=RUNNING) never carry; the agent's
+        # RUNNING flip arrives as a per-object event
+        sub = self.store.queue.subscribe(pred, accepts_blocks=True)
         try:
             with self._mu:
                 self._updated_tasks[uid] = 0.0

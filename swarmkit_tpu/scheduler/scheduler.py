@@ -130,8 +130,14 @@ class Scheduler:
     def run(self) -> None:
         try:
             self.pipeline.add_filter(VolumesFilter(self.volumes))
+            # accepts_blocks: EventTaskBlocks on this store are this
+            # scheduler's OWN commits (it is the only block producer on a
+            # leader) — mirrors intentionally keep the pre-assignment
+            # objects, so blocks are ignored below instead of being
+            # expanded into len(block) synthesized self-echo events
             _, sub = self.store.view_and_watch(
-                lambda tx: self._setup_tasks_list(tx))
+                lambda tx: self._setup_tasks_list(tx),
+                accepts_blocks=True)
             try:
                 self._process_preassigned_tasks()
                 self.tick()

@@ -1,10 +1,12 @@
-from .events import Event, EventCommit, EventSnapshotRestore, match, any_of
+from .events import (
+    Event, EventCommit, EventSnapshotRestore, EventTaskBlock, match, any_of,
+)
 from .store import (
     All, AlreadyExists, Batch, By, ByCustom, ByDesiredState, ByIDPrefix,
     ByKind, ByMembership, ByName, ByNamePrefix, ByNode, ByReferencedConfig,
     ByReferencedNetwork, ByReferencedSecret, ByRole, ByService, BySlot,
     ByTaskState, ByVolumeGroup, MemoryStore, NameConflict, NotFound, Or,
-    Proposer, ReadTx, SequenceConflict, StoreAction, StoreError, Where,
-    WriteTx, MAX_CHANGES_PER_TX,
+    Proposer, ReadTx, SequenceConflict, StoreAction, StoreError,
+    TaskBlockAction, Where, WriteTx, MAX_CHANGES_PER_TX,
 )
 from .watch import Closed, Queue, Subscription

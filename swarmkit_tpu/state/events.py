@@ -23,6 +23,75 @@ class Event:
         return self.obj.collection
 
 
+class EventTaskBlock:
+    """One coalesced event for a columnar scheduler block commit.
+
+    Carries the block arrays (pre-assignment tasks, node ids, version
+    base, status columns); ``expand_events()`` lazily synthesizes the
+    equivalent per-task update Events ONCE, shared across every
+    subscriber — the watch queue expands it for subscribers that have
+    not opted into block delivery (``accepts_blocks``), so existing
+    consumers observe exactly the per-task stream the per-object commit
+    path would have produced.  No reference counterpart: the reference
+    publishes one event per task (state/store/memory.go publish); the
+    block form is what lets the TPU scheduler's array-shaped commits
+    stay legal with live watchers.
+    """
+
+    __slots__ = ("olds", "node_ids", "base_version", "state", "message",
+                 "ts", "_events", "_per_node")
+
+    def __init__(self, olds, node_ids, base_version, state, message, ts):
+        self.olds = olds
+        self.node_ids = node_ids
+        self.base_version = base_version
+        self.state = state
+        self.message = message
+        self.ts = ts
+        self._events = None
+        self._per_node = None
+
+    def expand_events(self):
+        """Synthesized per-task Events (cached; thread-safe because the
+        build is idempotent and the final assignment is atomic)."""
+        events = self._events
+        if events is None:
+            from .store import _materialize_task
+            base = self.base_version
+            state, message, ts = self.state, self.message, self.ts
+            events = [
+                Event("update",
+                      _materialize_task(old, nid, base + 1 + i, ts,
+                                        state, message),
+                      old)
+                for i, (old, nid) in enumerate(zip(self.olds,
+                                                   self.node_ids))
+            ]
+            self._events = events
+        return events
+
+    def per_node(self):
+        """node_id -> [(old_task, version), ...] grouping (cached,
+        shared).  Block-aware per-node consumers (dispatcher sessions)
+        use this for an O(1) membership probe instead of filtering the
+        synthesized per-task stream — with S agent sessions that turns
+        O(tasks x S) predicate work into O(tasks + S)."""
+        grouped = self._per_node
+        if grouped is None:
+            grouped = {}
+            base = self.base_version
+            for i, (old, nid) in enumerate(zip(self.olds, self.node_ids)):
+                lst = grouped.get(nid)
+                if lst is None:
+                    lst = grouped[nid] = []
+                lst.append((old, base + 1 + i))
+            self._per_node = grouped
+        return grouped
+
+    def __len__(self) -> int:
+        return len(self.olds)
+
+
 @dataclass(frozen=True)
 class EventCommit:
     """Published once per committed transaction — drives debounced loops

@@ -157,7 +157,48 @@ def snapshot_from_bytes(data: bytes) -> Dict[str, Any]:
 
 
 def action_to_dict(action) -> Dict[str, Any]:
-    """One replicated store mutation (reference: api.StoreAction)."""
+    """One replicated store mutation (reference: api.StoreAction).
+    Columnar task blocks serialize as parallel id/node arrays plus the
+    shared status columns — ~2 strings per task instead of a full Task."""
+    if action.action == "task_block":
+        # compact wire form: ids joined (new_id hex never contains ","),
+        # node ids run-length encoded (the planner emits placements
+        # sorted by node, so runs are long) — ~25x smaller than per-task
+        # StoreActions at 16k items
+        ids = action.ids
+        parts: list = []
+        run_nid = None
+        run_len = 0
+        plain = True
+        for nid in action.node_ids:
+            if nid == run_nid:
+                run_len += 1
+                continue
+            if run_nid is not None:
+                parts.append(f"{run_nid}:{run_len}")
+            run_nid, run_len = nid, 1
+            if ":" in nid or "," in nid:
+                plain = False
+        if run_nid is not None:
+            parts.append(f"{run_nid}:{run_len}")
+        out: Dict[str, Any] = {
+            "action": "task_block",
+            "base_version": action.base_version,
+            "state": action.state,
+            "message": action.message,
+            "ts": action.ts,
+        }
+        if plain:
+            # flat strings: serde's generic to_dict walk sees 2 scalars
+            # instead of ~10k nested rle pairs
+            out["node_rle"] = ",".join(parts)
+        else:
+            out["node_ids"] = list(action.node_ids)   # odd id alphabet
+        if any("," in s for s in ids):
+            out["ids_list"] = list(ids)               # odd id alphabet
+        else:
+            out["ids"] = ",".join(ids)
+        return out
     return {
         "action": action.action,
         "collection": action.obj.collection,
@@ -166,6 +207,25 @@ def action_to_dict(action) -> Dict[str, Any]:
 
 
 def action_from_dict(data: Dict[str, Any]):
-    from .store import StoreAction
+    from .store import StoreAction, TaskBlockAction
+    if data["action"] == "task_block":
+        if "ids_list" in data:
+            ids = tuple(data["ids_list"])
+        else:
+            joined = data["ids"]
+            ids = tuple(joined.split(",")) if joined else ()
+        if "node_ids" in data:
+            node_ids = list(data["node_ids"])
+        else:
+            node_ids = []
+            rle = data["node_rle"]
+            if rle:
+                for part in rle.split(","):
+                    nid, _, count = part.rpartition(":")
+                    node_ids.extend([nid] * int(count))
+        return TaskBlockAction(
+            "task_block", ids, tuple(node_ids),
+            data["base_version"], data["state"], data["message"],
+            data["ts"])
     cls = _collection_map()[data["collection"]]
     return StoreAction(data["action"], from_dict(cls, data["obj"]))

@@ -42,7 +42,7 @@ from ..models.objects import (
     Task, Volume, STORE_OBJECT_TYPES,
 )
 from ..models.types import now
-from .events import Event, EventCommit, EventSnapshotRestore
+from .events import Event, EventCommit, EventSnapshotRestore, EventTaskBlock
 from .watch import Queue, Subscription
 
 MAX_CHANGES_PER_TX = 200  # reference: memory.go:45-51
@@ -116,6 +116,25 @@ class StoreAction:
 
     action: str        # "create" | "update" | "delete"
     obj: Any           # a store object snapshot
+
+
+@dataclass(frozen=True)
+class TaskBlockAction:
+    """One replicated columnar scheduler block: N task assignments in a
+    single compact raft entry (~2 strings/task instead of N serialized
+    Task objects).  Followers apply it straight into the task table's
+    overlay — the same lazy-materialization shape the leader commits.
+    Replaces N per-task StoreActions for scheduler status flips; the
+    reference has no counterpart (it proposes per-object actions,
+    manager/state/raft/raft.go:1592 ProposeValue)."""
+
+    action: str            # always "task_block"
+    ids: Tuple[str, ...]
+    node_ids: Tuple[str, ...]
+    base_version: int      # versions run base+1 .. base+len(ids)
+    state: int
+    message: str
+    ts: float
 
 
 class Proposer:
@@ -520,12 +539,15 @@ class MemoryStore:
         return cb(tx)
 
     def view_and_watch(self, cb: Callable[[ReadTx], Any],
-                       predicate=None, limit: Optional[int] = None
+                       predicate=None, limit: Optional[int] = None,
+                       accepts_blocks: bool = False
                        ) -> Tuple[Any, Subscription]:
         """Atomic snapshot + subscribe (reference: memory.go:892)."""
         with self._update_lock:
-            sub = (self.queue.subscribe_limited(limit, predicate)
-                   if limit else self.queue.subscribe(predicate))
+            sub = (self.queue.subscribe_limited(limit, predicate,
+                                                accepts_blocks)
+                   if limit else self.queue.subscribe(predicate,
+                                                      accepts_blocks))
             result = cb(ReadTx(self))
         return result, sub
 
@@ -909,12 +931,13 @@ class MemoryStore:
     @property
     def supports_block_commit(self) -> bool:
         """True when scheduler assignments may commit as a columnar block
-        (arrays end-to-end, objects materialized lazily on read).  With a
-        proposer or live watchers the per-object path runs instead: raft
-        replication and event payloads need the materialized objects (the
-        block StoreAction / block event extensions lift this in the
-        dispatcher integration)."""
-        return self._proposer is None and not self.queue.has_subscribers()
+        (arrays end-to-end, objects materialized lazily on read) — always,
+        since round 4: with live watchers the block publishes ONE coalesced
+        EventTaskBlock (expanded lazily, shared, per subscriber); with a
+        raft proposer it rides a compact columnar TaskBlockAction through
+        consensus.  Kept as a property for callers that keyed off the old
+        no-watcher/no-proposer restriction."""
+        return True
 
     def commit_task_block(self, old_tasks: Sequence[Task],
                           node_ids: Sequence[str],
@@ -933,19 +956,25 @@ class MemoryStore:
         hold store references), validation is one identity check.
 
         by_node indexes update eagerly, so index-driven queries stay
-        correct without materializing.  Only valid when
-        ``supports_block_commit`` (no proposer, no watchers).
+        correct without materializing.  Live watchers get one coalesced
+        EventTaskBlock per block (expanded to per-task events for
+        subscribers that didn't opt into blocks); with a proposer the
+        block is validated first, then proposed as chunked columnar
+        TaskBlockActions and applied in the consensus apply path
+        (reference: raft.go:1592 ProposeValue + wait.trigger).
 
         Returns (committed_indices, failed_indices); skipped items appear
         in neither.
         """
-        if not self.supports_block_commit:
-            # a subscriber/proposer appeared after the caller's check: a
-            # block commit would rob it of per-task events/actions
-            raise InvalidStoreAction(
-                "block commit requires the no-proposer/no-watcher store "
-                "shape; use bulk_update_tasks")
         from .. import native
+        from ..models.types import TaskState
+        if int(state) > int(TaskState.RUNNING):
+            # contract block-aware consumers rely on: blocks carry
+            # scheduler placement transitions only (state<=RUNNING), so
+            # restart/reconcile/reaper loops may skip them wholesale —
+            # failure and terminal states must go through per-object paths
+            raise InvalidStoreAction(
+                f"task blocks carry states <= RUNNING, got {state}")
         ts = now()
         committed_idx: List[int] = []
         failed_idx: List[int] = []
@@ -954,6 +983,10 @@ class MemoryStore:
             old_tasks = list(old_tasks)
         if not isinstance(node_ids, list):
             node_ids = list(node_ids)
+        if self._proposer is not None:
+            return self._commit_task_block_proposed(
+                old_tasks, node_ids, int(state), message,
+                on_missing, on_assigned, int(guard_state), ts)
         with self._update_lock:
             table = self._tables["tasks"]
             objects = table.objects
@@ -1020,17 +1053,154 @@ class MemoryStore:
                     # duplicate version indices
                     base = self._version
                     self._version = seq
+                    olds_c = nids_c = None
                     if committed_idx:
                         # one columnar changelog entry for the whole
                         # block: replay materializes per-task lazily.
                         # Version order within the block matches commit
                         # order (fast-path items first, then slow).
+                        olds_c = [old_tasks[i] for i in committed_idx]
+                        nids_c = [node_ids[i] for i in committed_idx]
                         self._log_change_locked(
-                            ("block", base,
-                             [old_tasks[i] for i in committed_idx],
-                             [node_ids[i] for i in committed_idx],
+                            ("block", base, olds_c, nids_c,
                              int(state), message, ts),
                             len(committed_idx))
+            if olds_c and self.queue.has_subscribers():
+                # one coalesced event for the whole block; per-task
+                # events synthesize lazily, shared across subscribers
+                self.queue.publish(EventTaskBlock(
+                    olds_c, nids_c, base, int(state), message, ts))
+            self.queue.publish(EventCommit(self._version))
+        for old, nid in missing:
+            on_missing(old, nid)
+        return committed_idx, failed_idx
+
+    #: items per columnar raft proposal — ~25B/item serialized (joined
+    #: ids + node RLE) keeps each entry under ~1MB, inside the
+    #: reference's 1.5MB tx bound (memory.go:45-51)
+    BLOCK_PROPOSAL_MAX_ITEMS = 32768
+
+    def _commit_task_block_proposed(self, old_tasks: List[Task],
+                                    node_ids: List[str], state: int,
+                                    message: str, on_missing, on_assigned,
+                                    guard_state: int, ts: float
+                                    ) -> Tuple[List[int], List[int]]:
+        """Block commit through the consensus seam: validate every item
+        against the current store (no writes), stamp versions, then ride
+        chunked columnar TaskBlockActions through the proposer — the
+        overlay/index writes run inside the consensus apply path, exactly
+        like ``update``'s commit callback, so snapshots taken at an
+        applied index always include that index's changes.  Chunk failure
+        granularity matches ``bulk_update_tasks``: committed chunks stay
+        committed, the failing chunk and everything after fail."""
+        from .. import native
+        hp = native.get()
+        committed_idx: List[int] = []
+        failed_idx: List[int] = []
+        missing: List[Tuple[Task, str]] = []
+        with self._update_lock:
+            table = self._tables["tasks"]
+            objects = table.objects
+            overlay = table.overlay
+            by_node = table.by_node
+            with self._lock:
+                base = self._version
+                if hp is not None:
+                    fast, slow = hp.block_validate(
+                        old_tasks, node_ids, objects, overlay,
+                        int(guard_state))
+                    # all-fast blocks keep the range lazy (no 100k-int
+                    # list); slow leftovers force a mutable list
+                    accepted = list(fast) if slow else fast
+                else:
+                    accepted = []
+                    slow = range(len(old_tasks))
+                for i in slow:
+                    old = old_tasks[i]
+                    tid = old.id
+                    cur = objects.get(tid)
+                    if cur is not old or tid in overlay:
+                        # mirror is not the stored instance: full checks
+                        # against the stored one (bulk-path semantics)
+                        if cur is not None and tid in overlay:
+                            cur = self._materialize_locked(table, tid)
+                        if cur is None:
+                            missing.append((old, node_ids[i]))
+                            continue
+                        cs = cur.status
+                        if cs.state == state and cs.message == message:
+                            continue
+                        if cs.state >= guard_state and \
+                                not on_assigned(old, node_ids[i]):
+                            failed_idx.append(i)
+                            continue
+                        if cur.meta.version.index != \
+                                old.meta.version.index:
+                            failed_idx.append(i)
+                            continue
+                    elif cur.status.state >= guard_state and \
+                            not on_assigned(old, node_ids[i]):
+                        failed_idx.append(i)
+                        continue
+                    accepted.append(i)
+            pos = 0
+            chunk_base = base
+            while pos < len(accepted):
+                chunk = accepted[pos:pos + self.BLOCK_PROPOSAL_MAX_ITEMS]
+                # one materialization of the chunk's columns, shared by
+                # the action, the changelog entry, and the block event
+                olds_c = [old_tasks[i] for i in chunk]
+                nids_c = [node_ids[i] for i in chunk]
+                action = TaskBlockAction(
+                    "task_block", tuple(t.id for t in olds_c),
+                    tuple(nids_c), chunk_base, state, message, ts)
+
+                def apply_chunk(chunk=chunk, chunk_base=chunk_base,
+                                olds_c=olds_c, nids_c=nids_c):
+                    with self._lock:
+                        if hp is not None:
+                            seq = hp.block_apply(
+                                old_tasks, node_ids, chunk, overlay,
+                                by_node, ts, state, message, chunk_base)
+                        else:
+                            seq = chunk_base
+                            for i in chunk:
+                                seq += 1
+                                old = old_tasks[i]
+                                tid = old.id
+                                nid = node_ids[i]
+                                overlay[tid] = (nid, seq, ts, state,
+                                                message)
+                                old_nid = old.node_id
+                                if old_nid and old_nid != nid:
+                                    by_node.get(old_nid,
+                                                set()).discard(tid)
+                                if nid:
+                                    s = by_node.get(nid)
+                                    if s is None:
+                                        s = by_node[nid] = set()
+                                    s.add(tid)
+                        self._version = seq
+                        self._log_change_locked(
+                            ("block", chunk_base, olds_c, nids_c,
+                             state, message, ts),
+                            len(chunk))
+
+                try:
+                    self._proposer.propose([action], apply_chunk)
+                except Exception:
+                    # committed chunks stay committed; this chunk and all
+                    # remaining accepted items fail so the caller rolls
+                    # back only what the store did not apply
+                    log.exception("columnar block proposal failed")
+                    failed_idx.extend(accepted[pos:])
+                    break
+                committed_idx.extend(chunk)
+                if self.queue.has_subscribers():
+                    self.queue.publish(EventTaskBlock(
+                        olds_c, nids_c, chunk_base, state, message, ts))
+                chunk_base += len(chunk)
+                pos += len(chunk)
             self.queue.publish(EventCommit(self._version))
         for old, nid in missing:
             on_missing(old, nid)
@@ -1101,11 +1271,20 @@ class MemoryStore:
 
     def apply_store_actions(self, actions: Sequence[StoreAction]) -> None:
         """Apply replicated actions without re-proposing
-        (reference: memory.go:280)."""
-        events: List[Event] = []
+        (reference: memory.go:280).  Columnar TaskBlockActions apply
+        straight into the task overlay — followers converge on the same
+        lazy-materialization shape the leader committed."""
+        events: List[Any] = []
         with self._update_lock:
             with self._lock:
                 for change in actions:
+                    if change.action == "task_block":
+                        ev = self._apply_task_block_locked(change)
+                        if isinstance(ev, list):
+                            events.extend(ev)
+                        elif ev is not None:
+                            events.append(ev)
+                        continue
                     obj = change.obj.copy()
                     old = self._tables[obj.collection].objects.get(obj.id)
                     if change.action == "create":
@@ -1133,7 +1312,62 @@ class MemoryStore:
                 self.queue.publish(ev)
             self.queue.publish(EventCommit(self._version))
 
-    # ----------------------------------------------------------- snapshotting
+    def _apply_task_block_locked(self, action: "TaskBlockAction"):
+        """Apply one replicated columnar block (caller holds both locks).
+        Uses the leader's version numbering (base+1..base+n) so overlay
+        entries converge bit-for-bit.  Returns one event to publish (an
+        EventTaskBlock normally, a list of per-item Events if ids were
+        skipped), or None when nothing resolved."""
+        table = self._tables["tasks"]
+        objects = table.objects
+        overlay = table.overlay
+        by_node = table.by_node
+        state, message, ts = action.state, action.message, action.ts
+        applied: List[Tuple[Task, str, int]] = []
+        for j, (tid, nid) in enumerate(zip(action.ids, action.node_ids)):
+            cur = objects.get(tid)
+            if cur is not None and tid in overlay:
+                cur = self._materialize_locked(table, tid)
+            if cur is None:
+                # diverged follower (should not happen with a healthy
+                # log): the leader still burned this version index
+                continue
+            ver = action.base_version + 1 + j
+            overlay[tid] = (nid, ver, ts, state, message)
+            old_nid = cur.node_id
+            if old_nid and old_nid != nid:
+                by_node.get(old_nid, set()).discard(tid)
+            if nid:
+                s = by_node.get(nid)
+                if s is None:
+                    s = by_node[nid] = set()
+                s.add(tid)
+            applied.append((cur, nid, ver))
+        self._version = max(self._version,
+                            action.base_version + len(action.ids))
+        if not applied:
+            return None
+        if len(applied) == len(action.ids):
+            # versions are contiguous from base: block changelog entry +
+            # block event (both stamp versions as base+1+i)
+            olds = [a[0] for a in applied]
+            nids = [a[1] for a in applied]
+            self._log_change_locked(
+                ("block", action.base_version, olds, nids, state,
+                 message, ts), len(applied))
+            return EventTaskBlock(olds, nids, action.base_version,
+                                  state, message, ts)
+        # skipped ids broke contiguity: log/publish per item with exact
+        # versions so changelog replay and events stamp correctly
+        events: List[Event] = []
+        for old, nid, ver in applied:
+            ev = Event("update",
+                       _materialize_task(old, nid, ver, ts, state,
+                                         message), old)
+            self._log_change_locked(
+                ("one", ver, "update", ev.obj, ev.old), 1)
+            events.append(ev)
+        return events
 
     def save(self) -> Dict[str, Any]:
         """Full-store snapshot (reference: snapshot.proto StoreSnapshot)."""

@@ -27,7 +27,7 @@ class Closed(Exception):
 
 class Subscription:
     def __init__(self, queue: "Queue", predicate: Optional[Predicate],
-                 limit: Optional[int]):
+                 limit: Optional[int], accepts_blocks: bool = False):
         self._queue = queue
         self._predicate = predicate
         self._limit = limit
@@ -35,9 +35,34 @@ class Subscription:
         self._cond = threading.Condition()
         self._closed = False
         self.overflowed = False
+        #: opt-in: deliver coalesced block events (objects exposing
+        #: ``expand_events``) as-is instead of expanding them into their
+        #: per-item events — block-aware consumers read the arrays
+        #: directly and skip the per-item synthesis entirely
+        self.accepts_blocks = accepts_blocks
 
     # -- producer side -----------------------------------------------------
     def _publish(self, event: Any) -> None:
+        if not self.accepts_blocks \
+                and getattr(event, "expand_events", None) is not None:
+            # coalesced block for a per-item consumer: buffer the block
+            # AS-IS and expand at consumption time, in the CONSUMER's
+            # thread — the committing writer pays O(subscribers) per
+            # block, never O(items).  The expansion itself is cached on
+            # the block, shared across subscribers; each subscriber pays
+            # only its own predicate filter.
+            with self._cond:
+                if self._closed:
+                    return
+                if self._limit is not None and \
+                        len(self._buf) + len(event) > self._limit:
+                    self.overflowed = True
+                    self._closed = True
+                    self._cond.notify_all()
+                    return
+                self._buf.append(event)
+                self._cond.notify()
+            return
         if self._predicate is not None:
             try:
                 if not self._predicate(event):
@@ -57,15 +82,73 @@ class Subscription:
             self._cond.notify()
 
     # -- consumer side -----------------------------------------------------
+    def _needs_expand(self, item: Any) -> bool:
+        return not self.accepts_blocks and \
+            getattr(item, "expand_events", None) is not None
+
+    def _expand(self, block: Any) -> List[Any]:
+        """Synthesize + filter a block's per-item events.  Runs WITHOUT
+        _cond held: expansion is O(len(block)) and must never stall the
+        publishing (committing) thread, which takes _cond in _publish.
+        A predicate exception drops only the offending event, matching
+        the per-event publish path's granularity."""
+        try:
+            events = block.expand_events()
+        except Exception:
+            return []
+        pred = self._predicate
+        if pred is None:
+            return list(events)
+        out = []
+        for e in events:
+            try:
+                if pred(e):
+                    out.append(e)
+            except Exception:
+                continue
+        return out
+
+    def _splice_front_locked(self, events: List[Any]) -> None:
+        self._buf.extendleft(reversed(events))
+
     def get(self, timeout: Optional[float] = None) -> Any:
-        with self._cond:
-            if not self._buf and not self._closed:
-                self._cond.wait(timeout)
-            if self._buf:
-                return self._buf.popleft()
-            if self._closed:
-                raise Closed()
-            raise TimeoutError()
+        """Next event; blocks up to ``timeout`` (forever when None).
+        Buffered blocks expand on THIS thread, outside the lock — with
+        one consumer per subscription (the usage contract) ordering is
+        preserved by re-splicing the tail at the buffer front."""
+        import time as _time
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        while True:
+            with self._cond:
+                item = self._buf.popleft() if self._buf else None
+                if item is None:
+                    if self._closed:
+                        raise Closed()
+                    if deadline is None:
+                        self._cond.wait()
+                    else:
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0:
+                            raise TimeoutError()
+                        self._cond.wait(remaining)
+                    item = self._buf.popleft() if self._buf else None
+                    if item is None:
+                        if self._closed:
+                            raise Closed()
+                        if deadline is not None and \
+                                _time.monotonic() >= deadline:
+                            raise TimeoutError()
+                        continue
+            if not self._needs_expand(item):
+                return item
+            events = self._expand(item)
+            if not events:
+                continue   # block filtered to nothing: keep waiting
+            if len(events) > 1:
+                with self._cond:
+                    self._splice_front_locked(events[1:])
+            return events[0]
 
     WAKE = object()   # sentinel returned by get() after wake()
 
@@ -80,16 +163,32 @@ class Subscription:
             self._cond.notify()
 
     def poll(self) -> Optional[Any]:
-        with self._cond:
-            if self._buf:
-                return self._buf.popleft()
-            return None
+        while True:
+            with self._cond:
+                if not self._buf:
+                    return None
+                item = self._buf.popleft()
+            if not self._needs_expand(item):
+                return item
+            events = self._expand(item)
+            if not events:
+                continue
+            if len(events) > 1:
+                with self._cond:
+                    self._splice_front_locked(events[1:])
+            return events[0]
 
     def drain(self) -> List[Any]:
         with self._cond:
-            items = list(self._buf)
+            raw = list(self._buf)
             self._buf.clear()
-            return items
+        items: List[Any] = []
+        for item in raw:
+            if self._needs_expand(item):
+                items.extend(self._expand(item))
+            else:
+                items.append(item)
+        return items
 
     def __iter__(self) -> Iterator[Any]:
         while True:
@@ -129,12 +228,16 @@ class Queue:
         for e in events:
             self.publish(e)
 
-    def subscribe(self, predicate: Optional[Predicate] = None) -> Subscription:
-        return self._add(Subscription(self, predicate, None))
+    def subscribe(self, predicate: Optional[Predicate] = None,
+                  accepts_blocks: bool = False) -> Subscription:
+        return self._add(Subscription(self, predicate, None,
+                                      accepts_blocks))
 
     def subscribe_limited(self, limit: int,
-                          predicate: Optional[Predicate] = None) -> Subscription:
-        return self._add(Subscription(self, predicate, limit))
+                          predicate: Optional[Predicate] = None,
+                          accepts_blocks: bool = False) -> Subscription:
+        return self._add(Subscription(self, predicate, limit,
+                                      accepts_blocks))
 
     def _add(self, sub: Subscription) -> Subscription:
         with self._lock:

@@ -143,18 +143,182 @@ def test_block_commit_interops_with_tx_update_and_snapshot():
     assert s2.raw_get(Task, tasks[2].id).node_id == nodes[0].id
 
 
-def test_block_commit_gated_by_consumers():
-    store, svc, nodes, tasks = _mk_store_with_tasks(2)
-    assert store.supports_block_commit
-    sub = store.watch_queue().subscribe()
-    assert not store.supports_block_commit
+def test_block_commit_with_watchers_synthesizes_events():
+    """Live watchers get the per-task update events the per-object path
+    would have published — synthesized lazily from ONE coalesced
+    EventTaskBlock; block-aware subscribers get the block itself."""
+    from swarmkit_tpu.state import EventCommit
+    from swarmkit_tpu.state.events import EventTaskBlock, match
+
+    store, svc, nodes, tasks = _mk_store_with_tasks(4)
+    assert store.supports_block_commit   # watchers no longer disable it
+    sub = store.watch_queue().subscribe(
+        match(Task, actions=("update",)))
+    raw = store.watch_queue().subscribe(accepts_blocks=True)
+    v0 = store.version
+    node_ids = [nodes[i % 3].id for i in range(4)]
+    committed, failed = store.commit_task_block(
+        tasks, node_ids, int(TaskState.ASSIGNED), "assigned",
+        _noop_missing, _no_conflict)
+    assert committed == list(range(4)) and failed == []
+
+    evs = [sub.get(timeout=2) for _ in range(4)]
+    for i, ev in enumerate(evs):
+        assert ev.action == "update"
+        assert ev.obj.id == tasks[i].id
+        assert ev.obj.node_id == node_ids[i]
+        assert ev.obj.status.state == TaskState.ASSIGNED
+        assert ev.obj.meta.version.index == v0 + 1 + i
+        assert ev.old is tasks[i]          # pre-assignment object
+    with pytest.raises(TimeoutError):
+        sub.get(timeout=0.05)
+
+    block = raw.get(timeout=2)
+    assert isinstance(block, EventTaskBlock)
+    assert len(block) == 4 and block.base_version == v0
+    assert isinstance(raw.get(timeout=2), EventCommit)
+    store.watch_queue().unsubscribe(sub)
+    store.watch_queue().unsubscribe(raw)
+
+
+def test_block_filtered_to_nothing_does_not_break_waiters():
+    """A subscriber whose predicate rejects every event a block expands
+    to must keep honoring its get() timeout: the block wakes the waiter,
+    expansion filters to nothing, and the wait continues to the caller's
+    deadline (no premature TimeoutError), then delivers later events."""
+    import threading
+    import time as _time
+
+    from swarmkit_tpu.models import Node
+    from swarmkit_tpu.state.events import match
+
+    store, svc, nodes, tasks = _mk_store_with_tasks(3)
+    sub = store.watch_queue().subscribe(match(Node, actions=("update",)))
+
+    def commit_late():
+        _time.sleep(0.1)
+        store.commit_task_block(
+            tasks, [nodes[0].id] * 3, int(TaskState.ASSIGNED),
+            "assigned", _noop_missing, _no_conflict)
+
+    th = threading.Thread(target=commit_late, daemon=True)
+    t0 = _time.monotonic()
+    th.start()
+    with pytest.raises(TimeoutError):
+        sub.get(timeout=0.6)
+    elapsed = _time.monotonic() - t0
+    th.join()
+    assert elapsed >= 0.55, \
+        f"woke after {elapsed:.2f}s — block traffic broke the deadline"
+
+    # matching events still flow after the no-match block
+    def touch_node(tx):
+        n = tx.get(Node, nodes[1].id).copy()
+        tx.update(n)
+    store.update(touch_node)
+    ev = sub.get(timeout=2)
+    assert ev.obj.id == nodes[1].id
     store.watch_queue().unsubscribe(sub)
 
-    class P:
-        def propose(self, actions, cb):
-            cb()
-    store._proposer = P()
-    assert not store.supports_block_commit
+
+class _CapturingProposer:
+    """Test proposer: records serialized actions, commits via callback
+    (the consensus seam contract), optionally replays onto a follower."""
+
+    def __init__(self, follower=None, fail=False):
+        self.actions = []
+        self.follower = follower
+        self.fail = fail
+
+    def propose(self, actions, commit_cb):
+        if self.fail:
+            raise RuntimeError("leadership lost")
+        from swarmkit_tpu.state import serde
+        wire = serde.dumps([serde.action_to_dict(a) for a in actions])
+        self.actions.extend(actions)
+        commit_cb()
+        if self.follower is not None:
+            decoded = [serde.action_from_dict(d)
+                       for d in serde.loads_dict(wire)]
+            self.follower.apply_store_actions(decoded)
+
+
+def test_block_commit_rides_proposer_and_converges_follower():
+    """With a proposer the block validates first, then rides a compact
+    columnar TaskBlockAction through consensus; a follower replaying the
+    serialized action converges bit-for-bit (same versions, node ids,
+    lazy overlay shape)."""
+    from swarmkit_tpu.state.store import TaskBlockAction
+
+    store, svc, nodes, tasks = _mk_store_with_tasks(6)
+    follower = MemoryStore()
+    follower.restore(store.save())
+    store._proposer = _CapturingProposer(follower=follower)
+    assert store.supports_block_commit
+
+    v0 = store.version
+    node_ids = [nodes[i % 3].id for i in range(6)]
+    committed, failed = store.commit_task_block(
+        tasks, node_ids, int(TaskState.ASSIGNED), "assigned",
+        _noop_missing, _no_conflict)
+    assert committed == list(range(6)) and failed == []
+    assert store.version == v0 + 6
+
+    [action] = store._proposer.actions
+    assert isinstance(action, TaskBlockAction)
+    assert list(action.ids) == [t.id for t in tasks]
+    assert list(action.node_ids) == node_ids
+    assert action.base_version == v0
+
+    # leader committed lazily (overlay, not materialized objects)
+    assert len(store._tables["tasks"].overlay) == 6
+
+    # follower converges: same assignments and version stamps
+    assert follower.version == store.version
+    for i, t in enumerate(tasks):
+        mine = store.raw_get(Task, t.id)
+        theirs = follower.raw_get(Task, t.id)
+        assert theirs.node_id == mine.node_id == node_ids[i]
+        assert theirs.meta.version.index == mine.meta.version.index
+        assert theirs.status.state == TaskState.ASSIGNED
+    assert {t.id for t in follower.view(
+        lambda tx: tx.find(Task, ByNode(nodes[0].id)))} == \
+        {t.id for t in store.view(
+            lambda tx: tx.find(Task, ByNode(nodes[0].id)))}
+
+
+def test_block_commit_proposer_validation_and_failure():
+    """Validation (stale/ghost/guard) happens before proposing — rejected
+    items never reach consensus; a dropped proposal fails every accepted
+    item and leaves the store untouched."""
+    store, svc, nodes, tasks = _mk_store_with_tasks(5)
+    store._proposer = _CapturingProposer()
+    nid = nodes[0].id
+
+    stale = tasks[0].copy()
+    stale.meta.version.index -= 1
+    ghost = tasks[1].copy()
+    ghost.id = new_id()
+    seen = []
+    committed, failed = store.commit_task_block(
+        [stale, ghost, tasks[2], tasks[3]], [nid] * 4,
+        int(TaskState.ASSIGNED), "assigned",
+        lambda t, n: seen.append(t), lambda t, n: False)
+    assert committed == [2, 3] and failed == [0] and seen == [ghost]
+    [action] = store._proposer.actions
+    assert list(action.ids) == [tasks[2].id, tasks[3].id]
+
+    # dropped proposal: accepted items fail, nothing commits
+    store2, _, nodes2, tasks2 = _mk_store_with_tasks(3)
+    store2._proposer = _CapturingProposer(fail=True)
+    v = store2.version
+    committed, failed = store2.commit_task_block(
+        tasks2, [nodes2[0].id] * 3, int(TaskState.ASSIGNED), "assigned",
+        _noop_missing, _no_conflict)
+    assert committed == [] and failed == [0, 1, 2]
+    assert store2.version == v
+    assert not store2._tables["tasks"].overlay
+    assert store2.raw_get(Task, tasks2[0].id).node_id == ""
 
 
 def test_block_commit_native_matches_python(monkeypatch):
