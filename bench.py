@@ -44,6 +44,13 @@ SKIP_HOST = os.environ.get("BENCH_SKIP_HOST", "") == "1"
 SKIP_CONFIGS = os.environ.get("BENCH_SKIP_CONFIGS", "") == "1"
 SKIP_E2E = os.environ.get("BENCH_SKIP_E2E", "") == "1"
 TRIALS = int(os.environ.get("BENCH_TRIALS", 3))
+# best-of-N per config (r4->r5 showed a 17x swing on identical code from
+# one-off XLA recompiles landing inside a single timed trial)
+CONFIG_TRIALS = int(os.environ.get("BENCH_CONFIG_TRIALS", 2))
+# variance guard: a config whose worst trial is >1.3x its best gets one
+# extra trial so a single recompile/GC hiccup cannot own the number
+VARIANCE_GUARD_X = float(os.environ.get("BENCH_VARIANCE_GUARD_X", 1.3))
+VARIANCE_RETRIES = int(os.environ.get("BENCH_VARIANCE_RETRIES", 1))
 
 
 def build_cluster(n_nodes, n_tasks, node_labels=None, reservations=None,
@@ -152,38 +159,91 @@ def _trim_heap():
         pass
 
 
+def run_with_variance_guard(trial, n_trials=None):
+    """Best-of-N with the variance guard: run ``trial`` (returning a
+    tuple whose first element is the timed seconds) n_trials times, then
+    keep re-running while the worst trial exceeds VARIANCE_GUARD_X of
+    the best (up to VARIANCE_RETRIES extras).  Returns (results,
+    retries)."""
+    results = [trial() for _ in range(n_trials or CONFIG_TRIALS)]
+    retries = 0
+    while retries < VARIANCE_RETRIES:
+        dts = [r[0] for r in results]
+        if max(dts) <= VARIANCE_GUARD_X * min(dts):
+            break
+        retries += 1
+        results.append(trial())
+    return results, retries
+
+
+def _spread_stats(dts):
+    """Trial-spread fields shared by every multi-trial config."""
+    best = min(dts)
+    return {
+        "trials": len(dts),
+        "tick_s": round(best, 3),                      # headline = best
+        "tick_s_median": round(statistics.median(dts), 3),
+        "tick_s_stdev": round(statistics.stdev(dts), 4)
+        if len(dts) > 1 else 0.0,
+        "variance_x": round(max(dts) / best, 2),
+    }
+
+
 def run_config(name, n_nodes, n_tasks, planner_factory, expect=None, **kw):
+    """Best-of-CONFIG_TRIALS with a per-config shape warm-up pass and a
+    variance guard, so a one-off XLA recompile can never be the headline
+    (VERDICT Weak #2)."""
     from swarmkit_tpu.models import Task as _Task, TaskState
 
-    _trim_heap()
     preassigned = kw.get("global_share", 0.0) > 0
-    store, svc, nodes, tasks = build_cluster(n_nodes, n_tasks, **kw)
-    planner = planner_factory()
-    sched, n_dec, dt = one_tick(store, planner, preassigned=preassigned)
-    expected = expect if expect is not None else n_tasks
-    n_assigned = sum(
-        1 for t in store.view(lambda tx: tx.find(_Task))
-        if t.status.state >= TaskState.ASSIGNED and t.node_id)
-    assert n_assigned >= expected, \
-        f"{name}: only {n_assigned}/{expected} tasks actually ASSIGNED"
-    small = planner.stats["groups_small_to_host"]
-    if planner.stats["tasks_planned"] == 0:
-        # legitimate only when the adaptive router sent every group to the
-        # host because the measured device round-trip would not amortize
-        assert small > 0 and planner.stats["groups_fallback"] == 0, \
-            f"{name}: TPU path did not engage: {planner.stats}"
-    return {
+
+    # per-config warm-up: tiny task count, IDENTICAL node shape and
+    # constraint/preference mix, so every jit signature this config hits
+    # is compiled before any timed trial
+    _trim_heap()
+    warm_store, *_ = build_cluster(n_nodes, 64, **kw)
+    warm_planner = planner_factory()
+    warm_planner.enable_small_group_routing = False
+    one_tick(warm_store, warm_planner, preassigned=preassigned)
+    del warm_store, warm_planner
+
+    def trial():
+        _trim_heap()
+        store, svc, nodes, tasks = build_cluster(n_nodes, n_tasks, **kw)
+        planner = planner_factory()
+        sched, n_dec, dt = one_tick(store, planner,
+                                    preassigned=preassigned)
+        expected = expect if expect is not None else n_tasks
+        n_assigned = sum(
+            1 for t in store.view(lambda tx: tx.find(_Task))
+            if t.status.state >= TaskState.ASSIGNED and t.node_id)
+        assert n_assigned >= expected, \
+            f"{name}: only {n_assigned}/{expected} tasks ASSIGNED"
+        small = planner.stats["groups_small_to_host"]
+        if planner.stats["tasks_planned"] == 0:
+            # legitimate only when the adaptive router sent every group
+            # to the host because the device round-trip won't amortize
+            assert small > 0 and planner.stats["groups_fallback"] == 0, \
+                f"{name}: TPU path did not engage: {planner.stats}"
+        return dt, n_dec, planner, sched
+
+    results, retries = run_with_variance_guard(trial)
+    dts = [r[0] for r in results]
+    dt, n_dec, planner, sched = min(results, key=lambda r: r[0])
+    out = {
         "nodes": n_nodes, "tasks": n_tasks,
         "decisions": n_dec,
         "decisions_per_sec": round(n_dec / dt, 1),
-        "tick_s": round(dt, 3),
         "plan_s": round(planner.stats["plan_seconds"], 3),
         "commit_s": round(sched.stats["commit_seconds"], 3),
         "fallback_groups": planner.stats["groups_fallback"],
-        "groups_small_to_host": small,
+        "groups_small_to_host": planner.stats["groups_small_to_host"],
+        "variance_reruns": retries,
         "path": "host-routed" if planner.stats["tasks_planned"] == 0
         else "device",
     }
+    out.update(_spread_stats(dts))
+    return out
 
 
 def run_storm(planner_factory):
@@ -192,8 +252,8 @@ def run_storm(planner_factory):
     one tick.  The cluster is built post-drain: drained nodes carry
     availability=DRAIN with their old tasks already SHUT DOWN (what the
     orchestrator/enforcer do), and one PENDING replacement per displaced
-    task sits in the queue."""
-    _trim_heap()
+    task sits in the queue.  Best-of-CONFIG_TRIALS with the same variance
+    guard as run_config (this config showed the 17x r4/r5 swing)."""
     from swarmkit_tpu.models import (
         NodeAvailability, Task, TaskState, TaskStatus,
     )
@@ -201,65 +261,76 @@ def run_storm(planner_factory):
     from swarmkit_tpu.utils import new_id
 
     n_nodes, n_tasks, n_drained = 10_000, 500_000, 1_000
-    store, svc, nodes, tasks = build_cluster(
-        n_nodes, n_tasks, assigned_state=TaskState.RUNNING)
 
-    drained = set(n.id for n in nodes[:n_drained])
+    def trial():
+        _trim_heap()
+        store, svc, nodes, tasks = build_cluster(
+            n_nodes, n_tasks, assigned_state=TaskState.RUNNING)
 
-    def drain_nodes(tx):
-        for n in nodes[:n_drained]:
-            cur = tx.get(type(n), n.id).copy()
-            cur.spec.availability = NodeAvailability.DRAIN
-            tx.update(cur)
+        drained = set(n.id for n in nodes[:n_drained])
 
-    store.update(drain_nodes)
-
-    displaced = [t for t in tasks if t.node_id in drained]
-    replacements = []
-    for t in displaced:
-        r = t.copy()
-        r.id = new_id()
-        r.node_id = ""
-        r.status = TaskStatus(state=TaskState.PENDING)
-        replacements.append(r)
-
-    def shutdown_and_replace(batch):
-        for t in displaced:
-            def down(tx, t=t):
-                cur = tx.get(Task, t.id).copy()
-                cur.desired_state = TaskState.SHUTDOWN
-                cur.status = TaskStatus(state=TaskState.SHUTDOWN)
+        def drain_nodes(tx):
+            for n in nodes[:n_drained]:
+                cur = tx.get(type(n), n.id).copy()
+                cur.spec.availability = NodeAvailability.DRAIN
                 tx.update(cur)
-            batch.update(down)
-        for r in replacements:
-            batch.update(lambda tx, r=r: tx.create(r))
 
-    store.batch(shutdown_and_replace)
+        store.update(drain_nodes)
 
-    planner = planner_factory()
-    sched = Scheduler(store, batch_planner=planner)
-    store.view(sched._setup_tasks_list)
+        displaced = [t for t in tasks if t.node_id in drained]
+        replacements = []
+        for t in displaced:
+            r = t.copy()
+            r.id = new_id()
+            r.node_id = ""
+            r.status = TaskStatus(state=TaskState.PENDING)
+            replacements.append(r)
 
-    gc.collect()
-    gc.freeze()
-    t0 = time.perf_counter()
-    n_dec = sched.tick()
-    dt = time.perf_counter() - t0
-    gc.unfreeze()
-    assert n_dec == len(replacements), (n_dec, len(replacements))
-    placed = store.view(lambda tx: [tx.get(Task, r.id) for r in replacements])
-    assert all(t is not None and t.node_id and t.node_id not in drained
-               for t in placed), "replacements must avoid drained nodes"
-    return {
+        def shutdown_and_replace(batch):
+            for t in displaced:
+                def down(tx, t=t):
+                    cur = tx.get(Task, t.id).copy()
+                    cur.desired_state = TaskState.SHUTDOWN
+                    cur.status = TaskStatus(state=TaskState.SHUTDOWN)
+                    tx.update(cur)
+                batch.update(down)
+            for r in replacements:
+                batch.update(lambda tx, r=r: tx.create(r))
+
+        store.batch(shutdown_and_replace)
+
+        planner = planner_factory()
+        sched = Scheduler(store, batch_planner=planner)
+        store.view(sched._setup_tasks_list)
+
+        gc.collect()
+        gc.freeze()
+        t0 = time.perf_counter()
+        n_dec = sched.tick()
+        dt = time.perf_counter() - t0
+        gc.unfreeze()
+        assert n_dec == len(replacements), (n_dec, len(replacements))
+        placed = store.view(
+            lambda tx: [tx.get(Task, r.id) for r in replacements])
+        assert all(t is not None and t.node_id and t.node_id not in drained
+                   for t in placed), "replacements must avoid drained nodes"
+        return dt, n_dec, len(replacements), planner, sched
+
+    results, retries = run_with_variance_guard(trial)
+    dts = [r[0] for r in results]
+    dt, n_dec, n_repl, planner, sched = min(results, key=lambda r: r[0])
+    out = {
         "nodes": n_nodes, "tasks": n_tasks,
         "drained_nodes": n_drained,
-        "replacements": len(replacements),
+        "replacements": n_repl,
         "decisions_per_sec": round(n_dec / dt, 1),
-        "tick_s": round(dt, 3),
         "plan_s": round(planner.stats["plan_seconds"], 3),
         "commit_s": round(sched.stats["commit_seconds"], 3),
         "fallback_groups": planner.stats["groups_fallback"],
+        "variance_reruns": retries,
     }
+    out.update(_spread_stats(dts))
+    return out
 
 
 def run_live_manager(planner_factory, external_firehose=False):
@@ -535,18 +606,21 @@ def main():
         warm_planner.enable_small_group_routing = False
         one_tick(store, warm_planner, preassigned=True)
 
-    # ---- headline: config 4 scale, median of TRIALS
-    trials = []
-    for _ in range(TRIALS):
+    # ---- headline: config 4 scale, median of TRIALS (variance-guarded)
+    def headline_trial():
         store, svc, nodes, tasks = build_cluster(N_NODES, N_TASKS)
         planner = TPUPlanner()
         sched, n_dec, dt = one_tick(store, planner)
         assert n_dec == N_TASKS
         assert planner.stats["tasks_planned"] == N_TASKS, planner.stats
-        trials.append((dt, planner.stats["plan_seconds"],
-                       sched.stats["commit_seconds"]))
+        out = (dt, planner.stats["plan_seconds"],
+               sched.stats["commit_seconds"])
         del store, svc, nodes, tasks, planner, sched
         gc.collect()
+        return out
+
+    trials, headline_reruns = run_with_variance_guard(
+        headline_trial, n_trials=TRIALS)
     ticks = sorted(t[0] for t in trials)
     med = statistics.median(ticks)
     rep = min(trials, key=lambda t: abs(t[0] - med))
@@ -603,11 +677,16 @@ def main():
         "vs_baseline": round(vs, 2),
         "tick_p50_s": round(med, 3),
         "tick_p99_s": round(ticks[-1], 3),
+        "tick_min_s": round(ticks[0], 3),
+        "tick_stdev_s": round(statistics.stdev(ticks), 4)
+        if len(ticks) > 1 else 0.0,
+        "headline_variance_x": round(ticks[-1] / ticks[0], 2),
+        "headline_variance_reruns": headline_reruns,
         "plan_phase_s": round(rep[1], 3),
         "commit_phase_s": round(rep[2], 3),
         "plan_phase_decisions_per_sec": round(N_TASKS / rep[1], 1)
         if rep[1] else None,
-        "trials": TRIALS,
+        "trials": len(trials),
         "baseline": "host-oracle path, same store+commit framework "
                     "(Go toolchain unavailable; see BASELINE.md)",
         "baseline_decisions_per_sec": round(host_dps, 1) if host_dps

@@ -332,8 +332,12 @@ class _AssignmentSet:
 class Dispatcher:
     def __init__(self, store: MemoryStore,
                  config: Optional[Config_] = None,
-                 driver_provider=None):
+                 driver_provider=None,
+                 rng: Optional[random.Random] = None):
         self.store = store
+        # heartbeat jitter source: injectable so the deterministic
+        # simulator can seed it (production uses the module-level RNG)
+        self._rng = rng or random
         # resolves SecretSpec.driver to provider plugins
         # (reference: manager/drivers/provider.go)
         self.driver_provider = driver_provider
@@ -362,8 +366,12 @@ class Dispatcher:
 
     # ------------------------------------------------------------- lifecycle
 
-    def run(self) -> None:
-        """Start the dispatcher's timer/batching worker."""
+    def run(self, start_worker: bool = True) -> None:
+        """Start the dispatcher's timer/batching worker.
+
+        ``start_worker=False`` brings the dispatcher fully up but runs no
+        thread — the caller (the deterministic simulator) drives
+        ``process_deadlines``/``_flush_updates`` itself under its clock."""
         with self._mu:
             if self._running:
                 return
@@ -379,9 +387,11 @@ class Dispatcher:
                 accepts_blocks=True)   # blocks are never cluster events
             self._load_cluster_config()
             self._mark_nodes_unknown()
-            self._worker = threading.Thread(target=self._worker_loop,
-                                            name="dispatcher", daemon=True)
-            self._worker.start()
+            if start_worker:
+                self._worker = threading.Thread(
+                    target=self._worker_loop, name="dispatcher",
+                    daemon=True)
+                self._worker.start()
 
     def _mark_nodes_unknown(self) -> None:
         """A fresh dispatcher (new leader) inherits store nodes that
@@ -489,8 +499,8 @@ class Dispatcher:
 
     def _heartbeat_period(self) -> float:
         base = self.config.heartbeat_period
-        return base + random.uniform(-self.config.heartbeat_epsilon,
-                                     self.config.heartbeat_epsilon)
+        return base + self._rng.uniform(-self.config.heartbeat_epsilon,
+                                        self.config.heartbeat_epsilon)
 
     def publish_logs(self, node_id: str, session_id: str,
                      messages) -> None:
@@ -721,49 +731,59 @@ class Dispatcher:
                 max(0.0, min(interval, deadline - now()))
             self._stop.wait(timeout=timeout)
             ts = now()
-            # apply live cluster-config changes (and resync on restore)
-            sub = getattr(self, "_cluster_sub", None)
-            while sub is not None:
-                ev = sub.poll()
-                if ev is None:
-                    break
-                if isinstance(ev, EventSnapshotRestore):
-                    self._load_cluster_config()
-                else:
-                    self._apply_cluster_config(ev.obj)
-            # heartbeat expirations + orphan deadlines
-            while True:
-                with self._mu:
-                    if not self._heap or self._heap[0][0] > ts:
-                        break
-                    _, _, kind, node_id = heapq.heappop(self._heap)
-                    if kind == "hb":
-                        rn = self._nodes.get(node_id)
-                        expired = rn is not None and rn.deadline <= ts
-                    elif kind == "reg":
-                        # registration grace after a leadership change
-                        expired = node_id not in self._nodes
-                    else:
-                        down_since = self._down_nodes.get(node_id)
-                        expired = (down_since is not None
-                                   and ts - down_since
-                                   >= self.config.orphan_timeout)
-                        if expired:
-                            del self._down_nodes[node_id]
-                if kind == "hb" and expired:
-                    log.info("heartbeat expiration for worker %s", node_id)
-                    self._mark_node_not_ready(node_id, "heartbeat failure")
-                elif kind == "reg" and expired:
-                    log.info("node %s never registered after leadership "
-                             "change", node_id)
-                    self._mark_node_not_ready(
-                        node_id, "node did not re-register after "
-                        "leadership change")
-                elif kind == "orphan" and expired:
-                    self._move_tasks_to_orphaned(node_id)
+            self.process_deadlines(ts)
             if ts - last_flush >= interval:
                 self._flush_updates()
                 last_flush = ts
+
+    def process_deadlines(self, ts: Optional[float] = None) -> None:
+        """Fire every deadline (heartbeat TTL, registration grace, orphan
+        timeout) due at ``ts``, and apply pending cluster-config events.
+        Called by the worker thread each wakeup; the deterministic
+        simulator calls it directly under virtual time instead of running
+        the worker thread."""
+        if ts is None:
+            ts = now()
+        # apply live cluster-config changes (and resync on restore)
+        sub = getattr(self, "_cluster_sub", None)
+        while sub is not None:
+            ev = sub.poll()
+            if ev is None:
+                break
+            if isinstance(ev, EventSnapshotRestore):
+                self._load_cluster_config()
+            else:
+                self._apply_cluster_config(ev.obj)
+        # heartbeat expirations + orphan deadlines
+        while True:
+            with self._mu:
+                if not self._heap or self._heap[0][0] > ts:
+                    break
+                _, _, kind, node_id = heapq.heappop(self._heap)
+                if kind == "hb":
+                    rn = self._nodes.get(node_id)
+                    expired = rn is not None and rn.deadline <= ts
+                elif kind == "reg":
+                    # registration grace after a leadership change
+                    expired = node_id not in self._nodes
+                else:
+                    down_since = self._down_nodes.get(node_id)
+                    expired = (down_since is not None
+                               and ts - down_since
+                               >= self.config.orphan_timeout)
+                    if expired:
+                        del self._down_nodes[node_id]
+            if kind == "hb" and expired:
+                log.info("heartbeat expiration for worker %s", node_id)
+                self._mark_node_not_ready(node_id, "heartbeat failure")
+            elif kind == "reg" and expired:
+                log.info("node %s never registered after leadership "
+                         "change", node_id)
+                self._mark_node_not_ready(
+                    node_id, "node did not re-register after "
+                    "leadership change")
+            elif kind == "orphan" and expired:
+                self._move_tasks_to_orphaned(node_id)
 
     # ---------------------------------------------------------- assignments
 

@@ -26,8 +26,23 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 
+# Injectable time source: everything in the control plane that stamps or
+# compares wall-clock times (task status timestamps, dispatcher heartbeat
+# TTLs, scheduler debounce) reads through now(), so the deterministic
+# simulator (swarmkit_tpu/sim) can swap in a virtual clock and replay the
+# whole control plane under controlled time.  Production never touches it.
+_time_source = time.time
+
+
+def set_time_source(source=None) -> None:
+    """Install a replacement ``now()`` source (``None`` restores
+    ``time.time``).  Only the simulator and tests should call this."""
+    global _time_source
+    _time_source = source if source is not None else time.time
+
+
 def now() -> float:
-    return time.time()
+    return _time_source()
 
 
 class TaskState(enum.IntEnum):

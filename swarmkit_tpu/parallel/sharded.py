@@ -76,9 +76,14 @@ def plan_group_sharded(nodes: NodeInputs, group: GroupInputs, L: int,
         hier_specs = (tuple((P(NODE_AXIS), P()) for _ in upper), P())
     else:
         hier_specs = ()
+    # check_rep=False: this jax version's replication checker mistypes the
+    # scan carry inside psum-reducing kernels (mismatched replication
+    # [None, set(), None] vs [None, set(), {'nodes'}]); the checker is
+    # advisory — the collectives themselves are unchanged
     fn = shard_map(kernel, mesh=mesh,
                    in_specs=(_NODE_SPECS, _GROUP_SPECS, hier_specs),
-                   out_specs=(P(NODE_AXIS), P(), P()))
+                   out_specs=(P(NODE_AXIS), P(), P()),
+                   check_rep=False)
     return fn(nodes, group, hier)
 
 

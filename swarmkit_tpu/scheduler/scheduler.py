@@ -49,8 +49,14 @@ class SchedulingDecision:
 
 class Scheduler:
     def __init__(self, store: MemoryStore,
-                 batch_planner=None):
+                 batch_planner=None,
+                 debounce_gap: float = COMMIT_DEBOUNCE_GAP,
+                 max_latency: float = MAX_LATENCY):
         self.store = store
+        # commit-event debounce windows (reference: scheduler.go:149-155);
+        # injectable so tests and the simulator control latency precisely
+        self.debounce_gap = debounce_gap
+        self.max_latency = max_latency
         self.unassigned_tasks: Dict[str, Task] = {}
         # incremental (service, spec-version) grouping of the unassigned
         # queue: maintained at enqueue/dequeue time so tick() does not pay
@@ -149,8 +155,8 @@ class Scheduler:
                     if debounce_started is None:
                         timeout = 0.2
                     else:
-                        deadline = min(debounce_started + MAX_LATENCY,
-                                       self._last_event + COMMIT_DEBOUNCE_GAP)
+                        deadline = min(debounce_started + self.max_latency,
+                                       self._last_event + self.debounce_gap)
                         timeout = max(0.0, deadline - now())
                     try:
                         event = sub.get(timeout=timeout) if timeout > 0 else None

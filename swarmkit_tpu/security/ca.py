@@ -34,10 +34,39 @@ from typing import Optional, Tuple
 
 log = logging.getLogger("security.ca")
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # gated dependency: importable module, unusable CA
+    HAVE_CRYPTOGRAPHY = False
+
+    class _MissingCrypto:
+        """Raises on first use so importing this module (and everything
+        that transitively pulls it in: manager, swarmd, agent wiring)
+        works without the ``cryptography`` package; only actually
+        creating/parsing certificates requires it."""
+
+        def __init__(self, name: str):
+            self._name = name
+
+        def __getattr__(self, attr: str):
+            raise ImportError(
+                f"the 'cryptography' package is required for "
+                f"{self._name}.{attr} (CA/TLS certificate operations)")
+
+        def __call__(self, *a, **kw):
+            raise ImportError(
+                "the 'cryptography' package is required for CA/TLS "
+                "certificate operations")
+
+    x509 = _MissingCrypto("x509")
+    hashes = _MissingCrypto("hashes")
+    serialization = _MissingCrypto("serialization")
+    ec = _MissingCrypto("ec")
+    NameOID = _MissingCrypto("NameOID")
 
 from ..models.types import NodeRole
 

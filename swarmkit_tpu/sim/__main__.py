@@ -1,0 +1,75 @@
+"""CLI for the deterministic simulator.
+
+    python -m swarmkit_tpu.sim --seed 7 --scenario partition-churn
+    python -m swarmkit_tpu.sim --seed 7 --scenario partition-churn --trace
+    python -m swarmkit_tpu.sim --fuzz 50 [--start-seed 100]
+    python -m swarmkit_tpu.sim --list
+
+Exit status: 0 when every invariant held, 1 otherwise (failing seeds are
+printed so they can be replayed verbatim).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .fuzz import failures, fuzz
+from .scenario import SCENARIOS, run_scenario
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m swarmkit_tpu.sim")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--scenario", default="partition-churn",
+                   choices=sorted(SCENARIOS))
+    p.add_argument("--fuzz", type=int, metavar="N", default=0,
+                   help="run N seeds of the random-fuzz scenario")
+    p.add_argument("--start-seed", type=int, default=0)
+    p.add_argument("--managers", type=int, default=3)
+    p.add_argument("--agents", type=int, default=5)
+    p.add_argument("--trace", action="store_true",
+                   help="dump the full event trace to stderr")
+    p.add_argument("--list", action="store_true",
+                   help="list scenarios and exit")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for name in sorted(SCENARIOS):
+            doc = (SCENARIOS[name].__doc__ or "").strip().split("\n")[0]
+            print(f"{name:26s} {doc}")
+        return 0
+
+    if args.fuzz:
+        def progress(r):
+            mark = "ok" if r.ok else "FAIL"
+            print(f"seed {r.seed:6d} {mark} trace={r.trace_hash[:12]} "
+                  f"events={r.events}", file=sys.stderr)
+
+        reports = fuzz(args.fuzz, start_seed=args.start_seed,
+                       progress=progress)
+        bad = failures(reports)
+        print(json.dumps({
+            "seeds": args.fuzz,
+            "start_seed": args.start_seed,
+            "failures": [
+                {"seed": r.seed, "violations": r.violations,
+                 "reproduce": f"python -m swarmkit_tpu.sim --seed "
+                              f"{r.seed} --scenario random-fuzz"}
+                for r in bad],
+            "ok": not bad,
+        }, indent=2))
+        return 1 if bad else 0
+
+    report = run_scenario(args.scenario, args.seed,
+                          n_managers=args.managers, n_agents=args.agents,
+                          keep_trace=args.trace)
+    if args.trace:
+        print("\n".join(report.trace), file=sys.stderr)
+    print(json.dumps(report.to_dict(), indent=2))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

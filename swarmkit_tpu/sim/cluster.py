@@ -1,0 +1,554 @@
+"""SimCluster: an in-process multi-manager / multi-agent cluster driven
+entirely by the simulation engine.
+
+Two layers share one event loop, one virtual clock, and one seeded RNG:
+
+* **Consensus layer** — N raft members built on the real ``RaftCore``
+  (the same sans-IO state machine production uses) with an in-memory
+  WAL that models durability faithfully: every Ready's hard state and
+  entries persist BEFORE messages send, a crash loses all volatile
+  state but keeps the WAL, and a crash-with-truncation loses the last
+  k WAL records ("died before fsync").  Messages route through
+  ``SimNetwork`` with seeded delay/drop/duplication and partitions.
+
+* **Control-plane layer** — the real ``Scheduler`` and ``Dispatcher``
+  running single-threaded against a leader store under virtual time
+  (the dispatcher's worker thread is replaced by direct
+  ``process_deadlines`` calls; the scheduler's event loop by explicit
+  resync+tick steps), plus simulated agents that register, heartbeat,
+  advance task FSMs, and fail on command.  In this subsystem version
+  the control-plane store is standalone (not raft-attached); committed
+  raft entries and store commits are invariant-checked independently.
+
+Determinism contract: all object ids the simulation creates are
+deterministic strings, every random draw comes from the engine's seeded
+RNG tree, and RaftCore broadcasts iterate peers in sorted order — so a
+run's trace hash is a pure function of (scenario, seed).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from ..manager.dispatcher import Config_ as DispatcherConfig, Dispatcher, \
+    DispatcherError
+from ..models import (
+    Annotations, Node, NodeDescription, NodeSpec, NodeState, NodeStatus,
+    ReplicatedService, Resources, Service, ServiceMode, ServiceSpec, Task,
+    TaskSpec, TaskState, TaskStatus, Version,
+)
+from ..models.types import TERMINAL_STATES, now
+from ..scheduler import Scheduler
+from ..scheduler.filters import VolumesFilter
+from ..state.raft.core import (
+    ENTRY_CONF, Entry, HardState, LEADER, RaftCore,
+)
+from ..state.store import MemoryStore
+from .engine import SimEngine
+from .faults import NetConfig, SimNetwork
+from .invariants import (
+    RaftInvariants, TaskInvariants, Violations, entry_digest,
+)
+
+
+class SimManager:
+    """One raft member with an in-memory durable WAL."""
+
+    TICK = 0.1   # seconds of virtual time per raft tick
+
+    def __init__(self, member_id: str, peers: List[str], engine: SimEngine,
+                 net: SimNetwork, raft_inv: RaftInvariants):
+        self.id = member_id
+        self.peers = list(peers)
+        self.engine = engine
+        self.net = net
+        self.raft_inv = raft_inv
+        self.alive = True
+        self.stopped = False
+        self.tick_scale = 1.0    # clock-skew fault: >1 ticks slower
+        # durable state ("disk"): survives crashes, lost records only
+        # through explicit truncation faults
+        self._wal_records: List[tuple] = []   # ("hs", HardState)|("ent", Entry)
+        self.restarts = 0
+        self.core = self._new_core()
+        net.register(member_id, self._on_message)
+        self._schedule_tick()
+
+    def _new_core(self) -> RaftCore:
+        return RaftCore(self.id, self.peers, rng=self.engine.fork_rng(),
+                        prevote=True)
+
+    # ------------------------------------------------------------ event loop
+
+    def _schedule_tick(self) -> None:
+        def loop():
+            if self.stopped:
+                return
+            if self.alive:
+                self.core.tick()
+                self.pump()
+            self.engine.after(self.TICK * self.tick_scale,
+                              f"{self.id} tick", loop)
+        self.engine.after(self.TICK * self.tick_scale,
+                          f"{self.id} tick", loop)
+
+    def _on_message(self, msg) -> None:
+        if not self.alive:
+            return
+        self.core.step(msg)
+        self.pump()
+
+    def pump(self) -> None:
+        """The Ready loop: persist -> send -> apply -> advance, exactly
+        the ordering RaftNode uses (durability before visibility)."""
+        while self.core.has_ready():
+            rd = self.core.ready()
+            if rd.hard_state is not None:
+                self._wal_records.append(
+                    ("hs", HardState(rd.hard_state.term,
+                                     rd.hard_state.voted_for,
+                                     rd.hard_state.commit)))
+            for e in rd.entries:
+                self._wal_records.append(
+                    ("ent", Entry(e.term, e.index, e.data, e.type)))
+            for m in rd.messages:
+                self.net.send(m)
+            for e in rd.committed:
+                self._apply(e)
+            self.core.advance(rd)
+        if self.core.role == LEADER:
+            self.raft_inv.observe_leader(self.core.term, self.id)
+
+    def _apply(self, e: Entry) -> None:
+        self.raft_inv.observe_apply(self.id, e.index, e.term,
+                                    f"{e.type}:{entry_digest(e.data)}")
+        if e.type == ENTRY_CONF:
+            try:
+                change = json.loads(e.data)
+                self.core.apply_conf_change(change["op"], change["id"])
+            except Exception:
+                pass
+
+    # ---------------------------------------------------------------- faults
+
+    def crash(self, truncate_wal: int = 0) -> None:
+        """Lose all volatile state; optionally lose the last
+        ``truncate_wal`` WAL records.
+
+        Truncation models a crash BEFORE fsync — which is OUTSIDE raft's
+        fault model: this member already acked those records, so the
+        cluster may have counted it toward a commit majority.  Default
+        scenarios and the fuzzer therefore crash with the WAL intact;
+        truncation exists precisely so tests can inject a durability bug
+        and prove the invariant checkers catch it (see
+        tests/test_sim.py::test_checker_detects_seeded_durability_bug)."""
+        if not self.alive:
+            return
+        self.alive = False
+        if truncate_wal > 0:
+            dropped = self._wal_records[-truncate_wal:]
+            del self._wal_records[-truncate_wal:]
+            self.engine.log(
+                f"fault crash {self.id} truncate={len(dropped)}")
+        else:
+            self.engine.log(f"fault crash {self.id}")
+        self.net.isolate(self.id)
+
+    def restart(self) -> None:
+        if self.alive:
+            return
+        self.restarts += 1
+        hs, entries = self._replay_wal()
+        self.core = self._new_core()
+        self.core.load(hs, entries, None)
+        # re-apply the committed prefix to the (new) state machine; the
+        # invariant ledger cross-checks every re-applied entry
+        for e in self.core.entries_from(1):
+            if e.index > self.core.commit_index:
+                break
+            self._apply(e)
+            self.core.applied_index = e.index
+        self.alive = True
+        self.net.rejoin(self.id)
+        self.engine.log(f"fault restart {self.id} "
+                        f"commit={self.core.commit_index}")
+
+    def _replay_wal(self):
+        """Mirror RaftLogger._load_wal: later entry records override
+        earlier ones at the same or higher index (truncation)."""
+        hs = HardState()
+        entries: List[Entry] = []
+        for kind, rec in self._wal_records:
+            if kind == "hs":
+                hs = HardState(rec.term, rec.voted_for, rec.commit)
+            else:
+                while entries and entries[-1].index >= rec.index:
+                    entries.pop()
+                entries.append(rec)
+        # a truncated WAL may report a commit index beyond the surviving
+        # entries; clamp like a real bootstrap would (can't commit what
+        # is not on disk)
+        last = entries[-1].index if entries else 0
+        if hs.commit > last:
+            hs = HardState(hs.term, hs.voted_for, last)
+        return hs, entries
+
+
+class SimAgent:
+    """A worker: registers with the dispatcher, heartbeats, advances the
+    task FSM one step per cycle, fails tasks on command."""
+
+    FSM_NEXT = {
+        TaskState.ASSIGNED: TaskState.ACCEPTED,
+        TaskState.ACCEPTED: TaskState.PREPARING,
+        TaskState.PREPARING: TaskState.READY,
+        TaskState.READY: TaskState.STARTING,
+        TaskState.STARTING: TaskState.RUNNING,
+    }
+
+    def __init__(self, node_id: str, cp: "SimControlPlane",
+                 interval: float = 1.0):
+        self.node_id = node_id
+        self.cp = cp
+        self.engine = cp.engine
+        self.interval = interval
+        self.rate_scale = 1.0      # clock-skew fault
+        self.alive = True
+        self.partitioned = False
+        self.fail_p = 0.0          # per-step chance of failing a RUNNING task
+        self.session: Optional[str] = None
+        self._rng = cp.engine.fork_rng()
+        self._schedule()
+
+    def _schedule(self) -> None:
+        def loop():
+            if self.cp.stopped:
+                return
+            self.step()
+            self.engine.after(self.interval * self.rate_scale,
+                              f"agent {self.node_id} step", loop)
+        # deterministic phase offset so agents don't step in lockstep
+        self.engine.after(self._rng.random() * self.interval,
+                          f"agent {self.node_id} step", loop)
+
+    # ------------------------------------------------------------------ step
+
+    def step(self) -> None:
+        if not self.alive or self.partitioned:
+            return
+        d = self.cp.dispatcher
+        try:
+            if self.session is None:
+                self.session, _ = d.register(
+                    self.node_id,
+                    description=NodeDescription(hostname=self.node_id))
+                self.engine.log(f"agent {self.node_id} registered")
+            else:
+                d.heartbeat(self.node_id, self.session)
+        except DispatcherError:
+            self.session = None
+            return
+        self._advance_tasks()
+
+    def _advance_tasks(self) -> None:
+        from ..state.store import ByNode
+        tasks = self.cp.store.view(
+            lambda tx: tx.find(Task, ByNode(self.node_id)))
+        updates = []
+        for t in sorted(tasks, key=lambda t: t.id):
+            state = TaskState(t.status.state)
+            if state in TERMINAL_STATES:
+                continue
+            if t.desired_state >= TaskState.SHUTDOWN:
+                updates.append((t.id, TaskStatus(
+                    state=TaskState.SHUTDOWN, timestamp=now(),
+                    message="sim shutdown")))
+                continue
+            if state == TaskState.RUNNING:
+                if self.fail_p and self._rng.random() < self.fail_p:
+                    updates.append((t.id, TaskStatus(
+                        state=TaskState.FAILED, timestamp=now(),
+                        message="sim fault", err="injected failure")))
+                    self.engine.log(f"agent {self.node_id} failed task "
+                                    f"{t.id}")
+                continue
+            nxt = self.FSM_NEXT.get(state)
+            if nxt is not None:
+                updates.append((t.id, TaskStatus(
+                    state=nxt, timestamp=now(), message="sim")))
+        if updates:
+            try:
+                self.cp.dispatcher.update_task_status(
+                    self.node_id, self.session, updates)
+            except DispatcherError:
+                self.session = None
+
+    # ---------------------------------------------------------------- faults
+
+    def crash(self) -> None:
+        if self.alive:
+            self.alive = False
+            self.session = None
+            self.engine.log(f"fault agent-crash {self.node_id}")
+
+    def restart(self) -> None:
+        if not self.alive:
+            self.alive = True
+            self.engine.log(f"fault agent-restart {self.node_id}")
+
+    def partition(self, on: bool) -> None:
+        self.partitioned = on
+        self.engine.log(f"fault agent-partition {self.node_id} "
+                        f"{'on' if on else 'off'}")
+
+
+class SimControlPlane:
+    """The leader's store + real Scheduler + real Dispatcher, driven
+    synchronously under virtual time."""
+
+    def __init__(self, engine: SimEngine, violations: Violations,
+                 n_agents: int, control_interval: float = 0.5):
+        self.engine = engine
+        self.stopped = False
+        self.store = MemoryStore()
+        self.invariants = TaskInvariants(violations, self.store)
+        self.dispatcher = Dispatcher(
+            self.store,
+            DispatcherConfig(heartbeat_period=2.0, heartbeat_epsilon=0.2,
+                             grace_multiplier=3.0, rate_limit_period=0.0,
+                             orphan_timeout=20.0),
+            rng=engine.fork_rng())
+        self.scheduler = Scheduler(self.store)
+        self.scheduler.pipeline.add_filter(
+            VolumesFilter(self.scheduler.volumes))
+        self._task_seq = 0
+        self._replaced: set = set()
+        self.service = Service(
+            id="svc-sim",
+            spec=ServiceSpec(
+                annotations=Annotations(name="sim"),
+                mode=ServiceMode.REPLICATED,
+                replicated=ReplicatedService(replicas=0),
+                task=TaskSpec()),
+            spec_version=Version(index=1))
+        self.store.update(lambda tx: tx.create(self.service))
+
+        self.agents: List[SimAgent] = []
+        for i in range(n_agents):
+            node = Node(
+                id=f"w{i}",
+                spec=NodeSpec(annotations=Annotations(name=f"w{i}")),
+                status=NodeStatus(state=NodeState.UNKNOWN),
+                description=NodeDescription(
+                    hostname=f"w{i}",
+                    resources=Resources(nano_cpus=8 * 10 ** 9,
+                                        memory_bytes=32 << 30)))
+            self.store.update(lambda tx, n=node: tx.create(n))
+            self.agents.append(SimAgent(f"w{i}", self))
+
+        # dispatcher up, worker thread replaced by control_step
+        self.dispatcher.run(start_worker=False)
+        self.store.view(self.scheduler._setup_tasks_list)
+        engine.every(control_interval, "control step", self.control_step)
+
+    # -------------------------------------------------------------- workload
+
+    def create_tasks(self, n: int) -> None:
+        def cb(tx):
+            for _ in range(n):
+                self._task_seq += 1
+                tx.create(Task(
+                    id=f"t{self._task_seq:05d}",
+                    service_id=self.service.id,
+                    slot=self._task_seq,
+                    desired_state=TaskState.RUNNING,
+                    spec=self.service.spec.task,
+                    spec_version=Version(index=1),
+                    status=TaskStatus(state=TaskState.PENDING,
+                                      timestamp=now())))
+        self.store.update(cb)
+        self.engine.log(f"workload create {n} tasks")
+
+    # ---------------------------------------------------------- control loop
+
+    def control_step(self) -> object:
+        if self.stopped:
+            return False
+        self.dispatcher.process_deadlines()
+        self.dispatcher._flush_updates()
+        self.scheduler._resync()
+        n = self.scheduler.tick()
+        if n:
+            self.engine.log(f"scheduler assigned {n}")
+        self._restart_step()
+        self.invariants.drain()
+        return None
+
+    def _restart_step(self) -> None:
+        """Minimal orchestrator stand-in: replace terminal tasks whose
+        desired state is still RUNNING (new task id, same slot — the
+        restart supervisor's contract; the full orchestrators are
+        exercised separately by the block-contract tests)."""
+        tasks = self.store.view(lambda tx: tx.find(Task))
+        to_replace = [
+            t for t in sorted(tasks, key=lambda t: t.id)
+            if TaskState(t.status.state) in TERMINAL_STATES
+            and t.desired_state == TaskState.RUNNING
+            and t.id not in self._replaced]
+        if not to_replace:
+            return
+
+        def cb(tx):
+            for t in to_replace:
+                self._replaced.add(t.id)
+                cur = tx.get(Task, t.id)
+                if cur is not None:
+                    cur = cur.copy()
+                    cur.desired_state = TaskState.SHUTDOWN
+                    tx.update(cur)
+                self._task_seq += 1
+                tx.create(Task(
+                    id=f"t{self._task_seq:05d}",
+                    service_id=self.service.id,
+                    slot=t.slot,
+                    desired_state=TaskState.RUNNING,
+                    spec=self.service.spec.task,
+                    spec_version=Version(index=1),
+                    status=TaskStatus(state=TaskState.PENDING,
+                                      timestamp=now())))
+        self.store.update(cb)
+        self.engine.log(f"restart replaced {len(to_replace)}")
+
+
+class Sim:
+    """Top-level harness: engine + consensus layer + control plane +
+    invariant sinks.  Use as a context manager (installs the virtual
+    clock into models.types.now() and restores it afterwards)."""
+
+    def __init__(self, seed: int, n_managers: int = 3, n_agents: int = 5,
+                 net_config: Optional[NetConfig] = None):
+        self.seed = seed
+        self.engine = SimEngine(seed)
+        # the virtual clock must be live BEFORE any component exists:
+        # the dispatcher stamps registration-grace deadlines at run()
+        # time, and a wall-clock value leaking into the deadline heap
+        # would both break determinism and park those deadlines decades
+        # past virtual time.  __exit__ restores the real clock.
+        self.engine.clock.install()
+        self.violations = Violations(self.engine)
+        self.net = SimNetwork(self.engine, net_config)
+        self.raft_inv = RaftInvariants(self.violations)
+        member_ids = [f"m{i}" for i in range(n_managers)]
+        self.finishing = False
+        self.managers = [
+            SimManager(mid, member_ids, self.engine, self.net,
+                       self.raft_inv)
+            for mid in member_ids]
+        self.cp = SimControlPlane(self.engine, self.violations, n_agents)
+        self.proposed = 0
+        self.committed_target = 0
+
+    # ---------------------------------------------------------------- clock
+
+    def __enter__(self) -> "Sim":
+        self.engine.clock.install()     # idempotent
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.engine.clock.uninstall()
+
+    # ---------------------------------------------------------------- raft
+
+    def leader(self) -> Optional[SimManager]:
+        for m in self.managers:
+            if m.alive and m.core.role == LEADER and m.core.leader_ready:
+                return m
+        return None
+
+    def propose(self, payload: bytes) -> bool:
+        m = self.leader()
+        if m is None:
+            return False
+        m.core.propose(payload)
+        m.pump()
+        self.proposed += 1
+        return True
+
+    def start_raft_workload(self, interval: float = 0.4) -> None:
+        def work():
+            if self.finishing:
+                return False
+            self.propose(f"op-{self.proposed:05d}".encode())
+            return None
+        self.engine.every(interval, "raft workload", work)
+
+    def stepdown_leader(self) -> None:
+        m = self.leader()
+        if m is not None:
+            self.engine.log(f"fault stepdown {m.id}")
+            m.core.step_down()
+            m.pump()
+
+    # -------------------------------------------------------------- running
+
+    def run(self, duration: float) -> None:
+        self.engine.run_until(duration)
+
+    def finish(self, grace: float = 20.0) -> None:
+        """Heal every fault, give the cluster ``grace`` virtual seconds
+        to converge, then run end-state checks."""
+        self.finishing = True
+        self.net.heal_all()
+        for m in self.managers:
+            m.tick_scale = 1.0
+            if not m.alive:
+                m.restart()
+        for a in self.cp.agents:
+            a.rate_scale = 1.0
+            a.fail_p = 0.0
+            a.partition(False)
+            a.restart()
+        self.engine.run_until(self.engine.clock.elapsed() + grace)
+        self._check_convergence()
+        self.cp.stopped = True
+        for m in self.managers:
+            m.stopped = True
+
+    def _check_convergence(self) -> None:
+        target = self.raft_inv.max_committed()
+        for m in self.managers:
+            if not m.alive:
+                continue
+            if m.core.applied_index < target:
+                self.violations.record(
+                    "post-heal-convergence",
+                    f"{m.id} applied only {m.core.applied_index} of "
+                    f"{target} committed entries after heal+grace")
+        terms = {m.core.term for m in self.managers if m.alive}
+        if len(terms) > 1:
+            self.violations.record(
+                "post-heal-convergence",
+                f"terms did not converge after heal+grace: {sorted(terms)}")
+
+    # ----------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, object]:
+        tasks = self.cp.store.view(lambda tx: tx.find(Task))
+        by_state: Dict[str, int] = {}
+        for t in tasks:
+            k = TaskState(t.status.state).name
+            by_state[k] = by_state.get(k, 0) + 1
+        return {
+            "events": self.engine.events_run,
+            "net": dict(self.net.stats),
+            "raft": {
+                "proposed": self.proposed,
+                "max_committed": self.raft_inv.max_committed(),
+                "terms_seen": len(self.raft_inv.leaders),
+                "restarts": sum(m.restarts for m in self.managers),
+            },
+            "tasks": by_state,
+            "heartbeats": self.cp.dispatcher.stats["heartbeats"],
+            "expirations": self.cp.dispatcher.stats["expirations"],
+        }

@@ -1,0 +1,172 @@
+"""Safety invariants checked continuously during simulation.
+
+Raft layer (checked on every state change, cluster-wide):
+
+* single-leader-per-term — two members must never both be LEADER in the
+  same term
+* committed-entry agreement / no loss — once ANY member applies entry
+  (index, term, digest), every member that ever applies that index must
+  apply the identical entry, including after crash/restore from WAL
+
+Control-plane layer (checked against the leader store's event stream):
+
+* task FSM never moves backwards — observed status.state is monotone
+  per task; desired_state is monotone per task
+* terminal states are sticky — a COMPLETE/FAILED/... task never leaves
+  the terminal set
+* assignment liveness — when a task reaches ASSIGNED, its node exists
+  and is not DOWN in the same store view
+* no double assignment — a task's node_id never changes once set
+* blocks are never failures — EventTaskBlock only ever carries
+  assignment-band states (<= RUNNING), by contract
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Tuple
+
+from ..models.objects import Node, Task
+from ..models.types import NodeState, TaskState, TERMINAL_STATES
+from ..state.events import Event, EventTaskBlock
+
+
+class InvariantViolation(AssertionError):
+    pass
+
+
+class Violations:
+    """Shared sink: checkers record, the runner decides pass/fail."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.items: List[str] = []
+
+    def record(self, name: str, msg: str) -> None:
+        line = f"INVARIANT {name}: {msg}"
+        self.engine.log(line)
+        self.items.append(f"t={self.engine.clock.elapsed():.3f} {line}")
+
+
+def entry_digest(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()[:16]
+
+
+class RaftInvariants:
+    def __init__(self, violations: Violations):
+        self.v = violations
+        self.leaders: Dict[int, str] = {}         # term -> leader id
+        self.ledger: Dict[int, Tuple[int, str]] = {}  # index -> (term, digest)
+
+    def observe_leader(self, term: int, member_id: str) -> None:
+        seen = self.leaders.get(term)
+        if seen is None:
+            self.leaders[term] = member_id
+        elif seen != member_id:
+            # an election needs a majority of votes in that term; two
+            # distinct winners for one term is a safety violation no
+            # matter when each was observed
+            self.v.record("single-leader-per-term",
+                          f"term {term}: {seen} and {member_id} "
+                          "are both leader")
+
+    def observe_apply(self, member_id: str, index: int, term: int,
+                      digest: str) -> None:
+        seen = self.ledger.get(index)
+        if seen is None:
+            self.ledger[index] = (term, digest)
+        elif seen != (term, digest):
+            self.v.record(
+                "no-committed-entry-loss",
+                f"{member_id} applied ({term},{digest}) at index {index} "
+                f"but the cluster committed {seen} there")
+
+    def max_committed(self) -> int:
+        return max(self.ledger) if self.ledger else 0
+
+
+class TaskInvariants:
+    """Subscribes to a store's event queue; ``drain()`` must be called
+    after every synchronous control-plane step (single-threaded sim, so
+    no events are ever in flight between checks)."""
+
+    def __init__(self, violations: Violations, store):
+        self.v = violations
+        self.store = store
+        self.states: Dict[str, int] = {}
+        self.desired: Dict[str, int] = {}
+        self.node_of: Dict[str, str] = {}
+        self.sub = store.queue.subscribe(
+            lambda ev: isinstance(ev, (Event, EventTaskBlock)),
+            accepts_blocks=True)
+
+    def drain(self) -> None:
+        while True:
+            ev = self.sub.poll()
+            if ev is None:
+                return
+            if isinstance(ev, EventTaskBlock):
+                self._check_block(ev)
+                for per_node in ev.per_node().values():
+                    for old, _ver in per_node:
+                        t = self.store.raw_get(Task, old.id)
+                        if t is not None:
+                            self._check_task(t)
+                continue
+            if isinstance(ev.obj, Task) and ev.action != "delete":
+                self._check_task(ev.obj)
+
+    def _check_block(self, ev: EventTaskBlock) -> None:
+        if ev.state > int(TaskState.RUNNING):
+            self.v.record(
+                "blocks-never-failures",
+                f"task block committed state {ev.state} "
+                f"(> RUNNING): blocks must only carry assignment states")
+
+    def _check_task(self, t: Task) -> None:
+        state = int(t.status.state)
+        prev = self.states.get(t.id)
+        if prev is not None:
+            if state < prev:
+                self.v.record(
+                    "fsm-monotonic",
+                    f"task {t.id[:8]} moved {TaskState(prev).name} -> "
+                    f"{TaskState(state).name}")
+            if TaskState(prev) in TERMINAL_STATES and state != prev \
+                    and TaskState(state) not in TERMINAL_STATES:
+                self.v.record(
+                    "terminal-sticky",
+                    f"task {t.id[:8]} left terminal "
+                    f"{TaskState(prev).name} for {TaskState(state).name}")
+        self.states[t.id] = state
+
+        des = int(t.desired_state)
+        prev_des = self.desired.get(t.id)
+        if prev_des is not None and des < prev_des:
+            self.v.record(
+                "desired-monotonic",
+                f"task {t.id[:8]} desired moved {TaskState(prev_des).name}"
+                f" -> {TaskState(des).name}")
+        self.desired[t.id] = des
+
+        if t.node_id:
+            prev_node = self.node_of.get(t.id)
+            if prev_node is not None and prev_node != t.node_id:
+                self.v.record(
+                    "no-double-assign",
+                    f"task {t.id[:8]} reassigned {prev_node[:8]} -> "
+                    f"{t.node_id[:8]} while live")
+            self.node_of[t.id] = t.node_id
+
+        if state == int(TaskState.ASSIGNED) and prev != state:
+            node = self.store.raw_get(Node, t.node_id) if t.node_id else None
+            if node is None:
+                self.v.record(
+                    "assigned-node-live",
+                    f"task {t.id[:8]} ASSIGNED to missing node "
+                    f"{t.node_id[:8] if t.node_id else '<none>'}")
+            elif node.status.state == NodeState.DOWN:
+                self.v.record(
+                    "assigned-node-live",
+                    f"task {t.id[:8]} ASSIGNED to DOWN node "
+                    f"{t.node_id[:8]}")

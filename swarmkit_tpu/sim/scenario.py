@@ -1,0 +1,357 @@
+"""Named scenarios + the runner.
+
+A scenario is a function ``(sim: Sim) -> duration`` that schedules a
+workload and a fault timeline on the sim's engine, returning how long
+(in virtual seconds) to run before the heal-and-converge epilogue.  The
+runner wraps it with clock installation, the finish sequence (heal all
+faults, restart everything, grace period, convergence checks), and
+report assembly.
+
+Every scenario exercises at least three distinct fault classes from the
+taxonomy in ``faults``; the randomized ``random-fuzz`` scenario draws
+its entire fault timeline from the seed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List
+
+from .cluster import Sim
+from .faults import NetConfig
+
+
+@dataclass
+class SimReport:
+    scenario: str
+    seed: int
+    duration: float
+    events: int
+    trace_hash: str
+    ok: bool
+    violations: List[str] = field(default_factory=list)
+    stats: dict = field(default_factory=dict)
+    trace: List[str] = field(default_factory=list)   # when keep_trace
+
+    def to_dict(self) -> dict:
+        return {
+            "scenario": self.scenario, "seed": self.seed,
+            "duration_virtual_s": self.duration, "events": self.events,
+            "trace_hash": self.trace_hash, "ok": self.ok,
+            "violations": self.violations, "stats": self.stats,
+        }
+
+
+# --------------------------------------------------------------- scenarios
+
+def _partition_churn(sim: Sim) -> float:
+    """The acceptance scenario: a 3-manager/5-agent cluster through
+    partitions, message loss, leader churn, and agent crash/partition
+    faults — four distinct fault classes on one seeded timeline."""
+    eng = sim.engine
+    sim.start_raft_workload(interval=0.4)
+    sim.cp.create_tasks(12)
+    rng = eng.fork_rng()
+    mids = [m.id for m in sim.managers]
+
+    def churn():
+        if sim.finishing:
+            return False
+        # random two-way split (sometimes isolating the leader)
+        lone = rng.choice(mids)
+        sim.net.split([lone], [m for m in mids if m != lone])
+        eng.after(2.5, "heal split", sim.net.heal_all)
+        return None
+
+    eng.every(6.0, "partition churn", churn, phase=5.0)
+
+    # message-loss burst mid-run
+    def drops_on():
+        sim.net.config.drop_p = 0.15
+        eng.log("fault drop-burst on")
+
+    def drops_off():
+        sim.net.config.drop_p = 0.0
+        eng.log("fault drop-burst off")
+
+    eng.at(eng.clock.start + 20.0, "drop burst on", drops_on)
+    eng.at(eng.clock.start + 30.0, "drop burst off", drops_off)
+
+    # forced leader churn
+    eng.at(eng.clock.start + 14.0, "stepdown", sim.stepdown_leader)
+    eng.at(eng.clock.start + 40.0, "stepdown", sim.stepdown_leader)
+
+    # agent faults: crash/restart one, partition another
+    a0, a1 = sim.cp.agents[0], sim.cp.agents[1]
+    eng.at(eng.clock.start + 12.0, "agent crash", a0.crash)
+    eng.at(eng.clock.start + 32.0, "agent restart", a0.restart)
+    eng.at(eng.clock.start + 25.0, "agent partition",
+           lambda: a1.partition(True))
+    eng.at(eng.clock.start + 45.0, "agent heal",
+           lambda: a1.partition(False))
+    eng.at(eng.clock.start + 35.0, "more tasks",
+           lambda: sim.cp.create_tasks(6))
+    return 55.0
+
+
+def _crash_leader_mid_commit(sim: Sim) -> float:
+    """Propose a burst at the leader and crash it in the same virtual
+    instant — entries are on its WAL and (partially) on the wire but
+    unacked.  The cluster must elect a successor without losing any
+    entry it committed, and the rejoining ex-leader must converge."""
+    eng = sim.engine
+    sim.start_raft_workload(interval=0.5)
+    sim.cp.create_tasks(8)
+
+    def strike():
+        m = sim.leader()
+        if m is None:
+            eng.after(1.0, "await leader", strike)
+            return
+        for i in range(20):
+            sim.propose(f"burst-{i:03d}".encode())
+        m.crash()                       # before any ack round-trips
+        eng.after(6.0, "restart ex-leader", m.restart)
+
+    eng.at(eng.clock.start + 5.0, "crash leader mid-commit", strike)
+
+    # second strike against the successor (WAL intact — crash-with-
+    # truncation is a durability bug the checkers are REQUIRED to flag,
+    # exercised separately in tests)
+    def strike2():
+        m = sim.leader()
+        if m is not None:
+            for i in range(10):
+                sim.propose(f"burst2-{i:03d}".encode())
+            m.crash()
+            eng.after(5.0, "restart ex-leader", m.restart)
+
+    eng.at(eng.clock.start + 16.0, "crash successor mid-commit", strike2)
+    eng.at(eng.clock.start + 10.0, "agent crash",
+           sim.cp.agents[2].crash)
+    eng.at(eng.clock.start + 20.0, "agent restart",
+           sim.cp.agents[2].restart)
+    return 28.0
+
+
+def _crash_restart_churn(sim: Sim) -> float:
+    """Rolling crash/restart of managers (never losing quorum for
+    long); every restart rebuilds from the WAL and the ledger checker
+    verifies the re-applied committed prefix byte-for-byte."""
+    eng = sim.engine
+    sim.start_raft_workload(interval=0.3)
+    sim.cp.create_tasks(10)
+    rng = eng.fork_rng()
+
+    def churn():
+        if sim.finishing:
+            return False
+        alive = [m for m in sim.managers if m.alive]
+        if len(alive) <= 2:     # keep a quorum candidate pool
+            return None
+        victim = rng.choice(alive)
+        victim.crash()
+        eng.after(3.0, f"restart {victim.id}", victim.restart)
+        return None
+
+    eng.every(7.0, "crash churn", churn, phase=4.0)
+    # agents churn too
+    a = sim.cp.agents
+    eng.at(eng.clock.start + 9.0, "agent crash", a[3].crash)
+    eng.at(eng.clock.start + 18.0, "agent restart", a[3].restart)
+    eng.at(eng.clock.start + 22.0, "more tasks",
+           lambda: sim.cp.create_tasks(5))
+    return 45.0
+
+
+def _clock_skew(sim: Sim) -> float:
+    """Timing faults: slow the leader's tick rate (its heartbeats
+    arrive late -> followers may elect; pre-vote keeps this from
+    cascading into term explosions), and slow one agent's heartbeat
+    cadence past the TTL so the dispatcher marks it DOWN."""
+    eng = sim.engine
+    sim.start_raft_workload(interval=0.5)
+    sim.cp.create_tasks(10)
+
+    def skew_leader():
+        m = sim.leader()
+        if m is None:
+            eng.after(1.0, "await leader", skew_leader)
+            return
+        m.tick_scale = 3.0
+        eng.log(f"fault clock-skew {m.id} x3")
+        eng.after(12.0, "unskew", lambda: setattr(m, "tick_scale", 1.0))
+
+    eng.at(eng.clock.start + 8.0, "skew leader", skew_leader)
+    agent = sim.cp.agents[4]
+
+    def skew_agent():
+        agent.rate_scale = 8.0      # heartbeats now slower than the TTL
+        eng.log(f"fault clock-skew agent {agent.node_id} x8")
+
+    eng.at(eng.clock.start + 15.0, "skew agent", skew_agent)
+    eng.at(eng.clock.start + 32.0, "unskew agent",
+           lambda: setattr(agent, "rate_scale", 1.0))
+    eng.at(eng.clock.start + 20.0, "drop burst",
+           lambda: setattr(sim.net.config, "drop_p", 0.1))
+    eng.at(eng.clock.start + 28.0, "drop off",
+           lambda: setattr(sim.net.config, "drop_p", 0.0))
+    return 40.0
+
+
+def _agent_storm(sim: Sim) -> float:
+    """Control-plane stress: task failure storms + agent churn while the
+    consensus layer rides steady message jitter."""
+    eng = sim.engine
+    sim.start_raft_workload(interval=0.5)
+    sim.cp.create_tasks(20)
+
+    def storm_on():
+        for a in sim.cp.agents:
+            a.fail_p = 0.08
+        eng.log("fault task-failure-storm on")
+
+    def storm_off():
+        for a in sim.cp.agents:
+            a.fail_p = 0.0
+        eng.log("fault task-failure-storm off")
+
+    eng.at(eng.clock.start + 8.0, "storm on", storm_on)
+    eng.at(eng.clock.start + 25.0, "storm off", storm_off)
+    rng = eng.fork_rng()
+
+    def agent_churn():
+        if sim.finishing:
+            return False
+        up = [a for a in sim.cp.agents if a.alive]
+        if len(up) > 2:
+            victim = rng.choice(up)
+            victim.crash()
+            # outlive the heartbeat TTL (period 2s x grace 3) so the
+            # dispatcher's expiry -> DOWN -> reschedule path runs
+            eng.after(8.0, "agent restart", victim.restart)
+        return None
+
+    eng.every(6.0, "agent churn", agent_churn, phase=10.0)
+    eng.at(eng.clock.start + 30.0, "more tasks",
+           lambda: sim.cp.create_tasks(8))
+    return 42.0
+
+
+def _random_fuzz(sim: Sim) -> float:
+    """The fuzzer's scenario: the entire fault timeline is drawn from
+    the seed.  Constraints keep the run inside raft's fault model
+    (crashes keep WALs intact and leave at least two members up so
+    elections stay possible; durability bugs are injected only by the
+    dedicated checker-detection test)."""
+    eng = sim.engine
+    rng = eng.fork_rng()
+    sim.start_raft_workload(interval=0.3 + rng.random() * 0.4)
+    sim.cp.create_tasks(rng.randrange(6, 16))
+    duration = 30.0
+
+    t = 3.0
+    while t < duration - 4.0:
+        op = rng.choice([
+            "split", "isolate", "heal", "crash", "crash",
+            "stepdown", "drop_burst", "agent_crash", "agent_partition",
+            "skew", "tasks"])
+        at = eng.clock.start + t
+
+        if op == "split":
+            def do_split():
+                if sim.finishing:
+                    return
+                mids = [m.id for m in sim.managers]
+                lone = rng.choice(mids)
+                sim.net.split([lone], [m for m in mids if m != lone])
+            eng.at(at, "fuzz split", do_split)
+        elif op == "isolate":
+            mid = rng.choice([m.id for m in sim.managers])
+            eng.at(at, "fuzz isolate",
+                   lambda mid=mid: sim.net.isolate(mid))
+        elif op == "heal":
+            eng.at(at, "fuzz heal", sim.net.heal_all)
+        elif op == "crash":
+            def do_crash():
+                if sim.finishing:
+                    return
+                alive = [m for m in sim.managers if m.alive]
+                if len(alive) <= 2:
+                    return
+                victim = rng.choice(alive)
+                victim.crash()
+                eng.after(2.0 + rng.random() * 4.0,
+                          f"fuzz restart {victim.id}", victim.restart)
+            eng.at(at, "fuzz crash", do_crash)
+        elif op == "stepdown":
+            eng.at(at, "fuzz stepdown", sim.stepdown_leader)
+        elif op == "drop_burst":
+            p = 0.05 + rng.random() * 0.2
+            eng.at(at, "fuzz drops on",
+                   lambda p=p: setattr(sim.net.config, "drop_p", p))
+            eng.at(at + 2.0 + rng.random() * 4.0, "fuzz drops off",
+                   lambda: setattr(sim.net.config, "drop_p", 0.0))
+        elif op == "agent_crash":
+            def do_acrash():
+                up = [a for a in sim.cp.agents if a.alive]
+                if len(up) > 1:
+                    victim = rng.choice(up)
+                    victim.crash()
+                    eng.after(2.0 + rng.random() * 5.0,
+                              "fuzz agent restart", victim.restart)
+            eng.at(at, "fuzz agent crash", do_acrash)
+        elif op == "agent_partition":
+            agent = rng.choice(sim.cp.agents)
+            eng.at(at, "fuzz agent partition",
+                   lambda a=agent: a.partition(True))
+            eng.at(at + 3.0 + rng.random() * 5.0, "fuzz agent heal",
+                   lambda a=agent: a.partition(False))
+        elif op == "skew":
+            m = rng.choice(sim.managers)
+            scale = 1.5 + rng.random() * 2.0
+            eng.at(at, "fuzz skew",
+                   lambda m=m, s=scale: setattr(m, "tick_scale", s))
+            eng.at(at + 5.0, "fuzz unskew",
+                   lambda m=m: setattr(m, "tick_scale", 1.0))
+        elif op == "tasks":
+            n = rng.randrange(2, 8)
+            eng.at(at, "fuzz tasks", lambda n=n: sim.cp.create_tasks(n))
+        t += 1.0 + rng.random() * 2.5
+    return duration
+
+
+SCENARIOS: Dict[str, Callable[[Sim], float]] = {
+    "partition-churn": _partition_churn,
+    "crash-leader-mid-commit": _crash_leader_mid_commit,
+    "crash-restart-churn": _crash_restart_churn,
+    "clock-skew": _clock_skew,
+    "agent-storm": _agent_storm,
+    "random-fuzz": _random_fuzz,
+}
+
+
+# ------------------------------------------------------------------ runner
+
+def run_scenario(name: str, seed: int, n_managers: int = 3,
+                 n_agents: int = 5, grace: float = 20.0,
+                 keep_trace: bool = False) -> SimReport:
+    try:
+        fn = SCENARIOS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown scenario {name!r}; have {sorted(SCENARIOS)}")
+    sim = Sim(seed, n_managers=n_managers, n_agents=n_agents,
+              net_config=NetConfig())
+    with sim:
+        sim.engine.log(f"scenario {name} seed {seed}")
+        duration = fn(sim)
+        sim.run(duration)
+        sim.finish(grace=grace)
+        stats = sim.stats()
+    return SimReport(
+        scenario=name, seed=seed, duration=duration + grace,
+        events=sim.engine.events_run, trace_hash=sim.engine.trace_hash(),
+        ok=not sim.violations.items,
+        violations=list(sim.violations.items), stats=stats,
+        trace=list(sim.engine.trace) if keep_trace else [])

@@ -103,9 +103,18 @@ class RaftCore:
 
     def __init__(self, node_id: str, peers: Sequence[str],
                  election_tick: int = 10, heartbeat_tick: int = 1,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 prevote: bool = True):
         self.id = node_id
         self.peers = set(peers) | {node_id}
+        # pre-vote (raft thesis §9.6, etcd-raft PreVote): before a real
+        # campaign, probe a majority with a WOULD-you-vote round that
+        # mutates no state — a partitioned rejoiner keeps timing out its
+        # pre-vote instead of bumping its term, so it cannot depose a
+        # healthy leader when the partition heals
+        self.prevote = prevote
+        self._in_prevote = False
+        self._prevotes: Dict[str, bool] = {}
         self.peer_addrs: Dict[str, Tuple[str, int]] = {}
         self.api_addrs: Dict[str, Tuple[str, int]] = {}
         self.election_tick = election_tick
@@ -234,14 +243,31 @@ class RaftCore:
                 return
             self._elapsed += 1
             if self._elapsed >= self._timeout:
-                self._campaign()
+                if self.prevote and len(self.peers) > 1:
+                    self._prevote_campaign()
+                else:
+                    self._campaign()
+
+    def _prevote_campaign(self) -> None:
+        """Probe for electability without mutating term/vote state."""
+        self._in_prevote = True
+        self._prevotes = {self.id: True}
+        self._elapsed = 0
+        self._timeout = self._rand_timeout()
+        for peer in sorted(self.peers):
+            if peer == self.id:
+                continue
+            self._msgs.append(Message(
+                type="prevote", term=self.term + 1, src=self.id, dst=peer,
+                last_log_index=self.last_index(),
+                last_log_term=self._term_at(self.last_index()) or 0))
 
     def _campaign(self) -> None:
         self._become_candidate()
         if len(self.peers) == 1:
             self._become_leader()
             return
-        for peer in self.peers:
+        for peer in sorted(self.peers):
             if peer == self.id:
                 continue
             self._msgs.append(Message(
@@ -258,6 +284,7 @@ class RaftCore:
             self._hs_dirty = True
         self.role = FOLLOWER
         self.leader_id = leader
+        self._in_prevote = False
         self._elapsed = 0
         self._timeout = self._rand_timeout()
 
@@ -265,6 +292,7 @@ class RaftCore:
         self.term += 1
         self.voted_for = self.id
         self._hs_dirty = True
+        self._in_prevote = False
         self.role = CANDIDATE
         self.leader_id = ""
         self._votes = {self.id: True}
@@ -373,6 +401,16 @@ class RaftCore:
             return
         if self.role == LEADER and m.src in self.peers:
             self._recent_active.add(m.src)
+        if m.type in ("prevote", "prevote_resp"):
+            # pre-vote rounds carry a FUTURE term the sender has not
+            # adopted; they must never make the receiver step down or
+            # adjust its own term (etcd-raft: pre-vote messages are
+            # exempt from the term-advance rule)
+            if m.type == "prevote":
+                self._on_prevote(m)
+            else:
+                self._on_prevote_resp(m)
+            return
         if m.term > self.term:
             leader = m.src if m.type in ("app", "snap") else ""
             self._become_follower(m.term, leader)
@@ -386,6 +424,42 @@ class RaftCore:
             self._on_append_resp(m)
         elif m.type == "snap":
             self._on_snapshot(m)
+
+    def _on_prevote(self, m: Message) -> None:
+        """Answer a pre-vote probe; grants mutate NO local state.  Grant
+        only when (a) the proposed term is ahead of ours, (b) the
+        candidate's log is at least as up-to-date, and (c) our leader
+        lease has lapsed — i.e. we have not heard from a live leader
+        within an election timeout (leader stickiness, the property that
+        stops a healed rejoiner from deposing a healthy leader)."""
+        my_last = self.last_index()
+        my_last_term = self._term_at(my_last) or 0
+        up_to_date = (m.last_log_term, m.last_log_index) >= \
+            (my_last_term, my_last)
+        if self.role == LEADER:
+            # a live leader never grants: check-quorum demotes it first
+            # if it actually lost its majority
+            lease_lapsed = False
+        else:
+            lease_lapsed = (self.leader_id == ""
+                            or self._elapsed >= self.election_tick)
+        grant = m.term > self.term and up_to_date and lease_lapsed
+        self._msgs.append(Message(type="prevote_resp", term=m.term,
+                                  src=self.id, dst=m.src, granted=grant))
+
+    def _on_prevote_resp(self, m: Message) -> None:
+        if not self._in_prevote or m.term != self.term + 1:
+            return
+        self._prevotes[m.src] = m.granted
+        granted = sum(1 for g in self._prevotes.values() if g)
+        if granted > len(self.peers) // 2:
+            # a majority would vote for us: run the real election
+            self._in_prevote = False
+            self._campaign()
+        elif len(self._prevotes) - granted > len(self.peers) // 2:
+            # majority rejected: stand down without having disturbed
+            # anyone's term; retry on the next timeout
+            self._in_prevote = False
 
     def _on_vote(self, m: Message) -> None:
         if m.term < self.term:
@@ -422,6 +496,10 @@ class RaftCore:
         self.role = FOLLOWER
         self.leader_id = m.src
         self._elapsed = 0
+        # a live leader cancels any pre-vote round in flight: a stale
+        # grant arriving after this heartbeat must not start a real
+        # campaign (etcd-raft clears pre-vote state on leader contact)
+        self._in_prevote = False
 
         prev_term = self._term_at(m.prev_index)
         if prev_term is None or (m.prev_index > 0
@@ -478,6 +556,7 @@ class RaftCore:
         self.role = FOLLOWER
         self.leader_id = m.src
         self._elapsed = 0
+        self._in_prevote = False
         snap = m.snapshot
         if snap.index <= self.commit_index:
             # stale snapshot; report progress instead
@@ -512,7 +591,10 @@ class RaftCore:
                 break
 
     def _broadcast_append(self, heartbeat: bool = False) -> None:
-        for peer in self.peers:
+        # sorted: message emission order must be a pure function of state,
+        # not of str-hash-seeded set order, so the deterministic simulator
+        # gets identical traces across processes
+        for peer in sorted(self.peers):
             if peer != self.id:
                 self._send_append(peer, heartbeat=heartbeat)
 

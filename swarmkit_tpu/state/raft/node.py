@@ -59,13 +59,19 @@ class RaftNode(Proposer):
                  store: MemoryStore, logger: RaftLogger, transport,
                  snapshot_interval: int = 1000,
                  on_leadership: Optional[Callable[[bool], None]] = None,
-                 force_new_cluster: bool = False):
+                 force_new_cluster: bool = False,
+                 tick_interval: Optional[float] = None):
         self.id = node_id
         self.store = store
         self.logger = logger
         self.transport = transport
         self.snapshot_interval = snapshot_interval
         self.on_leadership = on_leadership
+        # injectable tick pacing (tests/simulation shrink it; the
+        # deterministic simulator bypasses this thread entirely and
+        # drives RaftCore ticks itself)
+        self.tick_interval = (tick_interval if tick_interval is not None
+                              else self.TICK_INTERVAL)
         self.core = RaftCore(node_id, peers)
 
         self._inbox: "queue.Queue" = queue.Queue()
@@ -162,7 +168,7 @@ class RaftNode(Proposer):
         try:
             while not self._stop.is_set():
                 try:
-                    item = self._inbox.get(timeout=self.TICK_INTERVAL)
+                    item = self._inbox.get(timeout=self.tick_interval)
                 except queue.Empty:
                     item = None
                 if item is None:

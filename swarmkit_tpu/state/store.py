@@ -46,6 +46,10 @@ from .events import Event, EventCommit, EventSnapshotRestore, EventTaskBlock
 from .watch import Queue, Subscription
 
 MAX_CHANGES_PER_TX = 200  # reference: memory.go:45-51
+# a transaction (= one raft proposal) also flushes once its changes reach
+# this serialized size, whichever bound trips first (reference:
+# memory.go:45-51 MaxTransactionBytes = 1.5MB)
+MAX_TX_BYTES = 1_500_000
 WEDGE_TIMEOUT = 30.0      # reference: memory.go:79-146 deadlock tripwire
 
 log = logging.getLogger("store")
@@ -1436,6 +1440,8 @@ class Batch:
         self._tx: Optional[WriteTx] = None
         self.applied = 0    # callbacks run
         self.committed = 0  # changes committed
+        self._staged_bytes = 0   # serialized size of staged changes
+        self._measured = 0       # changes already size-accounted
 
     def update(self, cb: Callable[[WriteTx], Any]) -> Any:
         if self._tx is None:
@@ -1443,12 +1449,28 @@ class Batch:
             self._tx = WriteTx(self._store)
         result = cb(self._tx)
         self.applied += 1
-        if len(self._tx._changes) >= MAX_CHANGES_PER_TX:
+        changes = self._tx._changes
+        if self._store._proposer is not None:
+            # size-account only the changes staged since the last
+            # callback; each serializes once here, exactly as it will on
+            # the raft wire.  Proposer-less stores skip this — the byte
+            # bound exists to cap a single raft proposal, and paying
+            # O(serialized bytes) per local batch would tax every
+            # orchestrator batch for nothing.
+            while self._measured < len(changes):
+                from . import serde
+                self._staged_bytes += len(serde.dumps(
+                    serde.action_to_dict(changes[self._measured])))
+                self._measured += 1
+        if len(changes) >= MAX_CHANGES_PER_TX \
+                or self._staged_bytes >= MAX_TX_BYTES:
             self._flush_tx()
         return result
 
     def _flush_tx(self) -> None:
         tx, self._tx = self._tx, None
+        self._staged_bytes = 0
+        self._measured = 0
         try:
             n = len(tx._changes)
             self._store._propose_and_commit(tx)
@@ -1464,4 +1486,6 @@ class Batch:
         """Discard any uncommitted tail (after an error) and release."""
         if self._tx is not None:
             self._tx = None
+            self._staged_bytes = 0
+            self._measured = 0
             self._store._update_lock.release()

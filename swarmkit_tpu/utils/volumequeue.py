@@ -17,7 +17,11 @@ MAX_RETRY_INTERVAL = 600.0   # reference: maxRetryInterval
 
 
 class VolumeQueue:
-    def __init__(self) -> None:
+    def __init__(self, clock=None) -> None:
+        # injectable monotonic time source (deterministic simulation seam);
+        # wait() still blocks on the condition using real timeouts, but all
+        # deadline arithmetic goes through the clock
+        self._clock = clock or time.monotonic
         self._cond = threading.Condition()
         self._heap: list = []            # (ready_at, seq, id)
         self._attempts: Dict[str, int] = {}
@@ -38,7 +42,7 @@ class VolumeQueue:
                             MAX_RETRY_INTERVAL)
             else:
                 delay = 0.0
-            ready = time.monotonic() + delay
+            ready = self._clock() + delay
             if id in self._pending and self._pending[id] <= ready:
                 return  # already queued sooner
             self._pending[id] = ready
@@ -54,12 +58,12 @@ class VolumeQueue:
 
     def wait(self, timeout: Optional[float] = None) -> Optional[str]:
         """Pop the next due id, blocking until one is due (or timeout)."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else self._clock() + timeout
         with self._cond:
             while True:
                 if self._closed:
                     return None
-                now = time.monotonic()
+                now = self._clock()
                 while self._heap and self._heap[0][0] <= now:
                     ready, _, id = heapq.heappop(self._heap)
                     # deliver only the entry matching the CURRENT deadline:

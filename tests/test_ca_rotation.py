@@ -9,6 +9,9 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="CA/TLS tests require the cryptography package")
+
 from swarmkit_tpu.models import Cluster, TaskState
 from swarmkit_tpu.models.types import NodeRole
 from swarmkit_tpu.net import RemoteControlClient, issue_certificate

@@ -20,6 +20,10 @@ from swarmkit_tpu.state.store import ByName
 from swarmkit_tpu.swarmd import Swarmd
 
 from test_orchestrator import poll
+import pytest
+
+pytest.importorskip(
+    "cryptography", reason="CA/TLS tests require the cryptography package")
 
 
 class CFSSLServer:

@@ -24,6 +24,14 @@ from swarmkit_tpu.state import ByService, MemoryStore
 
 from test_orchestrator import FakeAgent, make_global, make_replicated, poll
 from test_scheduler import make_ready_node
+import pytest
+
+from swarmkit_tpu.security.ca import HAVE_CRYPTOGRAPHY
+
+requires_crypto = pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY,
+    reason="requires the 'cryptography' package")
+
 
 
 def test_full_slice_service_to_running_with_healing():
@@ -192,6 +200,7 @@ def _demote(api, node_id):
     _set_role(api, node_id, NodeRole.WORKER)
 
 
+@requires_crypto
 def test_promote_worker_to_manager_under_daemon():
     """A running worker daemon promoted via the control API renews into a
     manager cert, joins raft, and serves as a manager — without restart
@@ -239,6 +248,7 @@ def _has_node(api, node_id):
         return False
 
 
+@requires_crypto
 def test_demote_manager_to_worker_under_daemon():
     """A joined manager demoted via the control API leaves raft, tears
     down its manager stack, and keeps serving as a worker (reference:
@@ -280,6 +290,7 @@ def test_demote_manager_to_worker_under_daemon():
         m0.stop()
 
 
+@requires_crypto
 def test_demote_downed_manager_recovers_quorum():
     """Demoting a DEAD manager removes it from raft so the survivors'
     quorum shrinks (reference: integration_test.go:393 demote a downed
@@ -316,6 +327,7 @@ def test_demote_downed_manager_recovers_quorum():
         m0.stop()
 
 
+@requires_crypto
 def test_worker_rejoin_same_state_dir():
     """A worker stopped and restarted on the same state dir rejoins with
     its persisted identity and turns READY again (reference:
@@ -353,6 +365,7 @@ def test_worker_rejoin_same_state_dir():
         m0.stop()
 
 
+@requires_crypto
 def test_rolling_manager_restart_preserves_cluster():
     """Restart all three managers one at a time; state and membership
     survive throughout (reference: integration_test.go rolling manager
@@ -411,6 +424,7 @@ def _services_of(daemon):
         return []
 
 
+@requires_crypto
 def test_promoted_manager_restart_comes_back_as_manager():
     """A runtime-promoted node restarted on its state dir boots straight
     into manager mode (persisted raft id + WAL), like the reference's
@@ -455,6 +469,7 @@ def test_promoted_manager_restart_comes_back_as_manager():
         m0.stop()
 
 
+@requires_crypto
 def test_device_scheduler_inside_live_manager():
     """The TPU planner runs inside a live manager daemon end-to-end:
     service -> orchestrator -> device-planned placement -> dispatcher ->

@@ -29,6 +29,13 @@ from swarmkit_tpu.utils import new_id
 
 from test_orchestrator import poll
 
+from swarmkit_tpu.security.ca import HAVE_CRYPTOGRAPHY
+
+requires_crypto = pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY,
+    reason="requires the 'cryptography' package")
+
+
 
 def fast_cfg():
     return Config_(heartbeat_period=0.3, heartbeat_epsilon=0.02,
@@ -38,6 +45,7 @@ def fast_cfg():
 
 # --------------------------------------------------------------- CA / tokens
 
+@requires_crypto
 def test_join_tokens_and_certificates():
     ca = RootCA()
     worker_token = ca.join_token(NodeRole.WORKER)
@@ -70,6 +78,7 @@ def test_join_tokens_and_certificates():
     assert ca.role_for_token(new) == NodeRole.WORKER
 
 
+@requires_crypto
 def test_key_read_writer_kek(tmp_path):
     ca = RootCA()
     cert = ca.issue("n1", NodeRole.WORKER)
@@ -275,6 +284,7 @@ def test_watch_api_filters():
 
 # ------------------------------------------------- manager composition + CLI
 
+@requires_crypto
 def test_manager_standalone_cluster_and_cli():
     manager = Manager(dispatcher_config=fast_cfg(),
                       use_device_scheduler=False)
@@ -422,6 +432,7 @@ def test_manager_standalone_cluster_and_cli():
         manager.stop()
 
 
+@requires_crypto
 def test_manager_leadership_lifecycle():
     """become_leader starts the loops; become_follower stops them."""
     manager = Manager(dispatcher_config=fast_cfg(),

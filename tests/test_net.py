@@ -25,6 +25,13 @@ from swarmkit_tpu.utils import new_id
 
 from test_orchestrator import make_replicated, poll
 
+from swarmkit_tpu.security.ca import HAVE_CRYPTOGRAPHY
+
+requires_crypto = pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY,
+    reason="requires the 'cryptography' package")
+
+
 
 def fast_cfg():
     return Config_(heartbeat_period=0.3, heartbeat_epsilon=0.02,
@@ -32,6 +39,7 @@ def fast_cfg():
                    assignment_batching_wait=0.02)
 
 
+@requires_crypto
 def test_remote_agent_and_control_over_tcp():
     """Full E2E over real sockets: join via token -> cert; agent sessions,
     heartbeats, assignment stream, status writeback; control client drives
@@ -102,6 +110,7 @@ def test_remote_agent_and_control_over_tcp():
         manager.stop()
 
 
+@requires_crypto
 def test_unauthenticated_connection_rejected():
     manager = Manager(dispatcher_config=fast_cfg(),
                       use_device_scheduler=False)
@@ -139,9 +148,11 @@ def test_raft_over_tcp(tmp_path):
         members[i] = rn
         rn.start()
     try:
+        # leader_ready: proposals before the election no-op applies are
+        # dropped by design; wait for a proposal-ready leader
         leader = poll(
-            lambda: next((m for m in members.values() if m.is_leader),
-                         None)
+            lambda: next((m for m in members.values()
+                          if m.is_leader and m.core.leader_ready), None)
             if sum(1 for m in members.values() if m.is_leader) == 1
             else None,
             timeout=20, msg="leader over TCP")
@@ -164,6 +175,7 @@ def test_raft_over_tcp(tmp_path):
             m.stop()
 
 
+@requires_crypto
 def test_manager_raft_join_rpc(tmp_path):
     """A promoted node's manager joins the raft group over the network:
     manager-cert gated, returns peer addresses, membership grows."""
@@ -223,6 +235,7 @@ def test_manager_raft_join_rpc(tmp_path):
         rn.stop()
 
 
+@requires_crypto
 def test_collect_logs_over_tcp():
     """service logs work through the remote control client too."""
     import tempfile as _tf

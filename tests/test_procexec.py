@@ -7,6 +7,9 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="CA/TLS tests require the cryptography package")
+
 from swarmkit_tpu.agent import ProcessExecutor
 from swarmkit_tpu.manager import Manager
 from swarmkit_tpu.manager.dispatcher import Config_

@@ -37,7 +37,11 @@ def make_cluster(tmp_path, n=3, snapshot_interval=1000):
 
 def wait_leader(nodes, timeout=10):
     def find():
-        leaders = [rn for rn in nodes.values() if rn.is_leader]
+        # leader_ready: the election no-op must be applied before the
+        # leader accepts proposals — a bare is_leader check races
+        # ProposalDropped on an immediate store.update
+        leaders = [rn for rn in nodes.values()
+                   if rn.is_leader and rn.core.leader_ready]
         return leaders[0] if len(leaders) == 1 else None
     return poll(find, timeout=timeout, msg="no single leader elected")
 

@@ -18,6 +18,13 @@ from swarmkit_tpu.utils import new_id
 
 from test_orchestrator import make_replicated, poll
 
+from swarmkit_tpu.security.ca import HAVE_CRYPTOGRAPHY
+
+requires_crypto = pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY,
+    reason="requires the 'cryptography' package")
+
+
 
 def create_service_after_failover(daemons, spec, timeout=30):
     """Create a service on whichever daemon currently leads, retrying
@@ -121,6 +128,7 @@ def test_failover_client_switches_managers():
     assert ("m2", 2) in calls
 
 
+@requires_crypto
 def test_swarmd_manager_and_remote_worker():
     """Full daemon wiring: a manager swarmd serving the remote API, a
     worker swarmd joining over TCP with the printed token."""
@@ -155,6 +163,7 @@ def test_swarmd_manager_and_remote_worker():
         mgr_daemon.stop()
 
 
+@requires_crypto
 def test_network_bootstrap_keys_reach_remote_worker():
     """Key-manager rotations are delivered to agents over the wire and
     handed to the executor (reference: SessionMessage.NetworkBootstrapKeys;
@@ -204,6 +213,7 @@ def test_network_bootstrap_keys_reach_remote_worker():
         mgr_daemon.stop()
 
 
+@requires_crypto
 def test_dispatcher_live_heartbeat_reload():
     mgr = Manager(dispatcher_config=Config_(heartbeat_period=5.0,
                                             process_updates_interval=0.02),
@@ -223,6 +233,7 @@ def test_dispatcher_live_heartbeat_reload():
         mgr.stop()
 
 
+@requires_crypto
 def test_swarmd_manager_join_forms_raft_group():
     """A second swarmd --manager with --join-addr + manager token joins the
     bootstrap manager's raft group and replicates its state."""
@@ -263,6 +274,7 @@ def test_swarmd_manager_join_forms_raft_group():
         m0.stop()
 
 
+@requires_crypto
 def test_swarmd_three_managers_survive_leader_death():
     """m1 and m2 both join via m0; their transport addresses replicate
     through conf entries, so when m0 dies the survivors can still dial
@@ -304,6 +316,7 @@ def test_swarmd_three_managers_survive_leader_death():
             d.stop()
 
 
+@requires_crypto
 def test_worker_restart_survives_join_manager_death(tmp_path):
     """Learned managers persist across worker restarts (reference:
     node/node.go:1202 persistentRemotes + state.json): a worker that
@@ -371,6 +384,7 @@ def test_worker_restart_survives_join_manager_death(tmp_path):
         m0.stop()
 
 
+@requires_crypto
 def test_swarmd_bootstrap_manager_restart(tmp_path):
     """A raft-backed bootstrap manager restarted on the same state dir
     reuses its CA key and raft port and recovers its cluster state."""
@@ -400,6 +414,7 @@ def test_swarmd_bootstrap_manager_restart(tmp_path):
         m2.stop()
 
 
+@requires_crypto
 def test_swarmd_agents_follow_leader_after_death():
     """Agents learn the full manager list from heartbeat responses, so
     when the manager they joined through dies they fail over to the new

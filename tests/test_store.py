@@ -373,3 +373,90 @@ def test_bulk_commit_native_matches_python(monkeypatch):
     n2, python_state = run(disable_native=True)
     assert n1 == n2 == 23
     assert native_state == python_state
+
+
+class _PassThroughProposer:
+    """Consensus stub: commits locally, like a single-voter raft.  The
+    byte bound guards raft proposal size, so it only engages on stores
+    that HAVE a proposer."""
+
+    def propose(self, actions, commit_cb=None):
+        if commit_cb is not None:
+            commit_cb()
+
+
+def test_batch_flushes_on_byte_bound():
+    """A batch transaction must flush when its staged changes reach the
+    reference's 1.5MB serialized-size bound, not only at 200 changes
+    (memory.go:45-51: 200 changes OR MaxTransactionBytes)."""
+    from swarmkit_tpu.state.store import MAX_CHANGES_PER_TX, MAX_TX_BYTES
+
+    s = MemoryStore()
+    s._proposer = _PassThroughProposer()
+    commits = []
+    sub = s.queue.subscribe(lambda e: isinstance(e, EventCommit))
+
+    # each service carries ~200KB of labels -> the byte bound trips after
+    # ~8 changes, far below the 200-change bound
+    big_blob = "x" * 200_000
+    n = 20
+
+    def cb(batch):
+        for i in range(n):
+            def one(tx, i=i):
+                tx.create(Service(
+                    id=new_id(),
+                    spec=ServiceSpec(annotations=Annotations(
+                        name=f"fat-{i}", labels={"pad": big_blob}))))
+            batch.update(one)
+        return batch
+
+    b = s.batch(cb)
+    assert b.committed == n
+    while True:
+        ev = sub.poll()
+        if ev is None:
+            break
+        commits.append(ev)
+    # multiple flushes happened (byte bound), and every sub-transaction
+    # stayed under both bounds
+    assert len(commits) > 1, "byte bound never split the batch"
+    assert len(commits) >= n * 200_000 // MAX_TX_BYTES
+    assert all(len(s.view(lambda tx: tx.find(Service))) == n
+               for _ in range(1))
+
+    # small changes still coalesce up to the change-count bound
+    s2 = MemoryStore()
+    sub2 = s2.queue.subscribe(lambda e: isinstance(e, EventCommit))
+
+    def cb2(batch):
+        for i in range(MAX_CHANGES_PER_TX):
+            batch.update(lambda tx, i=i: tx.create(
+                Service(id=new_id(),
+                        spec=ServiceSpec(
+                            annotations=Annotations(name=f"slim-{i}")))))
+
+    s2.batch(cb2)
+    n_commits2 = 0
+    while sub2.poll() is not None:
+        n_commits2 += 1
+    assert n_commits2 == 1, "small changes must still coalesce into one tx"
+
+    # proposer-less stores skip byte accounting entirely (the bound caps
+    # raft proposal size; local batches shouldn't pay serialization)
+    s3 = MemoryStore()
+    sub3 = s3.queue.subscribe(lambda e: isinstance(e, EventCommit))
+
+    def cb3(batch):
+        for i in range(10):
+            batch.update(lambda tx, i=i: tx.create(Service(
+                id=new_id(),
+                spec=ServiceSpec(annotations=Annotations(
+                    name=f"local-{i}", labels={"pad": big_blob})))))
+
+    s3.batch(cb3)
+    n_commits3 = 0
+    while sub3.poll() is not None:
+        n_commits3 += 1
+    assert n_commits3 == 1, \
+        "proposer-less batch must not split on bytes"

@@ -11,6 +11,13 @@ from swarmkit_tpu.models.specs import ContainerSpec
 from swarmkit_tpu.models.types import Annotations, NodeDescription, Platform
 from swarmkit_tpu.utils import new_id
 
+from swarmkit_tpu.security.ca import HAVE_CRYPTOGRAPHY
+
+requires_crypto = pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY,
+    reason="requires the 'cryptography' package")
+
+
 
 def make_task():
     return Task(
@@ -145,6 +152,7 @@ def test_rafttool_dumps(tmp_path):
     assert all("id" in o for o in objs)
 
 
+@requires_crypto
 def test_rafttool_on_encrypted_swarmd_dir(tmp_path):
     """dump/decrypt/downgrade-key/renew-certs against a REAL swarmd
     manager state dir (encrypted WAL under the persisted CA key; autolock

@@ -11,6 +11,9 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="CA/TLS tests require the cryptography package")
+
 from swarmkit_tpu.manager import Manager
 from swarmkit_tpu.manager.dispatcher import Config_
 from swarmkit_tpu.models import Cluster
