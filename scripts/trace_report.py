@@ -1,0 +1,69 @@
+"""Summarize a Chrome trace-event JSON into a per-phase table.
+
+Usage:
+    python scripts/trace_report.py bench_trace.json
+    python scripts/trace_report.py bench_trace.json --validate
+    python scripts/trace_report.py sim_trace.json --json
+
+Works on any trace the obs tracer emits: ``bench.py``'s BENCH_TRACE_OUT,
+``python -m swarmkit_tpu.sim --trace-json``, or a ``/debug/trace``
+download.  When the trace carries ``bench.config`` marker spans, a table
+is printed per config; otherwise one table covers the whole trace.
+``--validate`` schema-checks the document and exits non-zero on problems
+(the tier-1 smoke test runs exactly this check in-process).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from swarmkit_tpu.obs.report import (  # noqa: E402
+    config_windows, format_table, phase_table, validate_chrome_trace,
+    x_events,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python scripts/trace_report.py")
+    p.add_argument("trace", help="Chrome trace-event JSON file")
+    p.add_argument("--validate", action="store_true",
+                   help="schema-check only; exit 1 on problems")
+    p.add_argument("--json", action="store_true",
+                   help="emit the phase table(s) as JSON")
+    args = p.parse_args(argv)
+
+    with open(args.trace) as f:
+        doc = json.load(f)
+
+    problems = validate_chrome_trace(doc)
+    if args.validate:
+        for pr in problems:
+            print(pr, file=sys.stderr)
+        print(f"{args.trace}: "
+              f"{'INVALID' if problems else 'ok'} "
+              f"({len(x_events(doc))} spans)")
+        return 1 if problems else 0
+    if problems:
+        print(f"warning: {len(problems)} schema problems "
+              f"(run --validate)", file=sys.stderr)
+
+    windows = config_windows(doc)
+    if not windows:
+        windows = [("all", None)]
+    tables = {name: phase_table(doc, window=w) for name, w in windows}
+    if args.json:
+        print(json.dumps(tables, indent=2, sort_keys=True))
+        return 0
+    for name, table in tables.items():
+        print(f"=== {name} ===")
+        print(format_table(table))
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,15 +30,18 @@ import heapq
 import logging
 import random
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..models.objects import Cluster, Config, Node, Secret, Task, Volume
 from ..models.types import NodeState, NodeStatus, TaskState, TaskStatus, now
+from ..obs.trace import tracer
 from ..state.events import Event, EventSnapshotRestore, EventTaskBlock
 from ..state.store import Batch, ByNode, MemoryStore
 from ..state.watch import Closed, Subscription
 from ..utils import new_id
+from ..utils.metrics import registry as _metrics
 
 log = logging.getLogger("dispatcher")
 
@@ -363,6 +366,12 @@ class Dispatcher:
         self._worker: Optional[threading.Thread] = None
         self._streams_threads: List[threading.Thread] = []
         self.stats = {"heartbeats": 0, "expirations": 0}
+        # cached Timer references — no per-call registry lookup on the
+        # flush/assignments paths (reset() resets these in place)
+        self._flush_timer = _metrics.timer(
+            "swarm_dispatcher_update_batch_latency")
+        self._build_timer = _metrics.timer(
+            "swarm_dispatcher_assignments_build")
 
     # ------------------------------------------------------------- lifecycle
 
@@ -538,6 +547,7 @@ class Dispatcher:
             rn.deadline = now() + period * self.config.grace_multiplier
             self._push_deadline(rn.deadline, "hb", node_id)
         self.stats["heartbeats"] += 1
+        _metrics.counter("swarm_dispatcher_heartbeats")
         return period
 
     def _check_session(self, node_id: str, session_id: str) -> None:
@@ -562,6 +572,7 @@ class Dispatcher:
         """Heartbeat expiry or disconnect: node DOWN
         (reference: dispatcher.go:1253)."""
         self.stats["expirations"] += 1
+        _metrics.counter("swarm_dispatcher_heartbeat_expirations")
         with self._mu:
             rn = self._nodes.pop(node_id, None)
             self._down_nodes[node_id] = now()
@@ -644,6 +655,9 @@ class Dispatcher:
             self._unpublished_volumes = set()
         if not task_updates and not node_updates and not unpublished:
             return
+        _metrics.counter("swarm_dispatcher_task_status_updates",
+                         len(task_updates))
+        _flush_t0 = time.perf_counter()
 
         def cb(batch: Batch) -> None:
             for task_id, status in task_updates.items():
@@ -713,6 +727,7 @@ class Dispatcher:
             self.store.batch(cb)
         except Exception:
             log.exception("dispatcher update batch failed")
+        self._flush_timer.observe(time.perf_counter() - _flush_t0)
 
     # ------------------------------------------------------------ worker
 
@@ -815,7 +830,19 @@ class Dispatcher:
             nonlocal sequence, applies_to
             sequence += 1
             results_in = str(sequence)
-            stream._push(aset.message(type_, applies_to, results_in))
+            # diff build (assignments.go message assembly) + delivery
+            t0 = time.perf_counter()
+            with tracer.span("dispatcher.assignments_send", "dispatcher",
+                             type=type_) as sp:
+                msg = aset.message(type_, applies_to, results_in)
+                if sp is not None:
+                    sp.args["changes"] = len(msg.changes)
+                stream._push(msg)
+            self._build_timer.observe(time.perf_counter() - t0)
+            _metrics.counter(
+                f'swarm_dispatcher_assignments_sent{{type="{type_}"}}')
+            _metrics.counter("swarm_dispatcher_assignment_changes",
+                             len(msg.changes))
             applies_to = results_in
 
         def pred(ev):

@@ -77,6 +77,8 @@ class Manager:
         self.logbroker = LogBroker(self.store)
         self.ca_server = CAServer(self.root_ca)
         self.collector = Collector(self.store)
+        from ..obs import LifecycleTracker
+        self.lifecycle = LifecycleTracker(self.store)
 
         # leader-only loops, created on become_leader
         self.dispatcher: Optional[Dispatcher] = None
@@ -122,6 +124,7 @@ class Manager:
     def run(self) -> None:
         self._running = True
         self.collector.start()
+        self.lifecycle.start()
         if self.raft is None:
             self._ensure_cluster_object()
             self._become_leader()
@@ -211,6 +214,7 @@ class Manager:
             self._ca_sub = None
         self._become_follower()
         self.collector.stop()
+        self.lifecycle.stop()
         self.logbroker.close()
 
     @property

@@ -31,6 +31,11 @@ class Collector:
         self._counts: Dict[str, int] = defaultdict(int)
         self._task_states: Dict[int, int] = defaultdict(int)
         self._node_states: Dict[int, int] = defaultdict(int)
+        # every state label ever exported: a state whose count drops to
+        # zero (or vanishes across an EventSnapshotRestore recount) must
+        # keep exporting 0, not linger at its stale pre-restore value
+        self._exported_task_states: set = set()
+        self._exported_node_states: set = set()
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.run, name="metrics",
@@ -129,11 +134,18 @@ class Collector:
         from ..models.types import NodeState, TaskState
         for coll, n in self._counts.items():
             registry.gauge(f"swarm_manager_{coll}", n)
-        for state, n in self._task_states.items():
+        # labeled exposition (reference: collector.go's
+        # {state="running"}-style gauge vectors).  States seen earlier but
+        # absent now export 0 so scrapes never read a stale count.
+        self._exported_task_states.update(self._task_states)
+        for state in self._exported_task_states:
             registry.gauge(
-                f'swarm_manager_tasks_state_{TaskState(state).name.lower()}',
-                n)
-        for state, n in self._node_states.items():
+                f'swarm_manager_tasks{{state='
+                f'"{TaskState(state).name.lower()}"}}',
+                self._task_states.get(state, 0))
+        self._exported_node_states.update(self._node_states)
+        for state in self._exported_node_states:
             registry.gauge(
-                f'swarm_manager_nodes_state_{NodeState(state).name.lower()}',
-                n)
+                f'swarm_manager_nodes{{state='
+                f'"{NodeState(state).name.lower()}"}}',
+                self._node_states.get(state, 0))

@@ -1,0 +1,16 @@
+"""Observability layer: span tracing, task-lifecycle latency, reports.
+
+``tracer`` is the process-wide span recorder (disabled by default; bench,
+the simulator, and ``/debug/trace`` enable/serve it).  Metrics counters
+and timers live in ``utils.metrics.registry`` — this package adds the
+span/trace dimension and the lifecycle tracker on top.
+"""
+
+from .lifecycle import LifecycleTracker
+from .report import format_table, phase_table, validate_chrome_trace
+from .trace import Span, Tracer, tracer
+
+__all__ = [
+    "LifecycleTracker", "Span", "Tracer", "format_table", "phase_table",
+    "tracer", "validate_chrome_trace",
+]

@@ -1,0 +1,271 @@
+"""Span-based tracing core.
+
+Dapper-style explicit spans (start/end with parent links) recorded into a
+bounded in-memory buffer and exported as Chrome trace-event JSON — the
+format ``chrome://tracing`` and Perfetto load directly.
+
+Design constraints, in priority order:
+
+* **Near-zero cost when disabled.**  ``tracer.span(...)`` on a disabled
+  tracer returns a shared no-op context manager: one attribute load and
+  one call, no allocation.  The hot paths (scheduler tick, planner group
+  loop) are instrumented at *phase* granularity — per tick / per group,
+  never per task — so even enabled tracing stays within the ≤3% budget
+  bench.py measures.
+
+* **Time-source aware.**  Timestamps come from ``models.types.now()``,
+  the same seam the deterministic simulator's VirtualClock installs
+  into.  Under the sim, every span timestamp is virtual time and every
+  span id comes from a monotonic counter — so a simulation trace is a
+  pure function of its seed, byte for byte (asserted in
+  tests/test_obs.py).
+
+* **Thread-safe.**  Production components record spans from their own
+  threads; the buffer append and id allocation are lock-protected, and
+  parent links are tracked per-thread (a span's parent is the innermost
+  open span *on the same thread*).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..models import types as _types
+
+
+class Span:
+    __slots__ = ("name", "cat", "start", "end", "span_id", "parent_id",
+                 "thread", "args")
+
+    def __init__(self, name: str, cat: str, start: float, span_id: int,
+                 parent_id: int, thread: str,
+                 args: Optional[Dict[str, Any]]):
+        self.name = name
+        self.cat = cat
+        self.start = start
+        self.end = start
+        self.span_id = span_id
+        self.parent_id = parent_id   # 0 = root
+        self.thread = thread
+        self.args = args
+
+    @property
+    def duration(self) -> float:
+        return self.end - self.start
+
+
+class _Noop:
+    """Shared do-nothing context manager returned while disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP = _Noop()
+
+
+class _SpanCtx:
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "span")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 args: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._args = args
+
+    def __enter__(self) -> Span:
+        self.span = self._tracer.start_span(self._name, self._cat,
+                                            self._args)
+        return self.span
+
+    def __exit__(self, *exc) -> bool:
+        self._tracer.end_span(self.span)
+        return False
+
+
+class Tracer:
+    """Bounded span recorder with explicit start/end and parent links."""
+
+    def __init__(self, clock=None, max_spans: int = 262_144):
+        # None -> models.types.now (late-bound so a VirtualClock installed
+        # later still governs this tracer)
+        self._clock = clock
+        self.enabled = False
+        self.max_spans = max_spans
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+        # spans started but not yet ended, by id — exported as
+        # "incomplete" so a live /debug/trace snapshot taken mid-tick
+        # still contains every referenced parent
+        self._open: Dict[int, Span] = {}
+        self._next_id = 1
+        self._local = threading.local()
+        self.epoch = 0.0
+        self.dropped = 0
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _now(self) -> float:
+        return self._clock() if self._clock is not None else _types.now()
+
+    def enable(self) -> None:
+        if not self._spans:
+            self.epoch = self._now()
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        """Drop all recorded spans and restart ids; the next span's clock
+        reading becomes the new epoch (per-run isolation).  Spans still
+        open on other threads when reset runs belong to the previous
+        recording session — end_span drops them (pre-epoch start)."""
+        with self._lock:
+            self._spans = []
+            self._open = {}
+            self._next_id = 1
+            self.dropped = 0
+            self.epoch = self._now()
+        self._local = threading.local()
+
+    def save_state(self):
+        """Capture the recording state (buffer, ids, epoch, enabled) so
+        an embedded recording session — the sim runner resets the shared
+        tracer around each scenario — can hand the caller's trace back
+        via restore_state afterwards."""
+        with self._lock:
+            return (self._spans, self._open, self._next_id, self.epoch,
+                    self.dropped, self.enabled)
+
+    def restore_state(self, state) -> None:
+        with self._lock:
+            (self._spans, self._open, self._next_id, self.epoch,
+             self.dropped, enabled) = state
+        self._local = threading.local()
+        self.enabled = enabled
+
+    # ------------------------------------------------------------- recording
+
+    def span(self, name: str, cat: str = "", **args):
+        """Context manager recording one span; no-op when disabled.
+        ``args`` land in the exported event's args dict — keep them
+        deterministic (counts, names), never wall-clock readings."""
+        if not self.enabled:
+            return _NOOP
+        return _SpanCtx(self, name, cat, args or None)
+
+    def start_span(self, name: str, cat: str = "",
+                   args: Optional[Dict[str, Any]] = None) -> Span:
+        t = self._now()
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        parent = stack[-1].span_id if stack else 0
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+            sp = Span(name, cat, t, sid, parent,
+                      threading.current_thread().name, args)
+            self._open[sid] = sp
+        stack.append(sp)
+        return sp
+
+    def end_span(self, sp: Span) -> None:
+        sp.end = self._now()
+        stack = getattr(self._local, "stack", None)
+        if stack and stack[-1] is sp:
+            stack.pop()
+        elif stack and sp in stack:        # mismatched exit order
+            stack.remove(sp)
+        with self._lock:
+            self._open.pop(sp.span_id, None)
+            if sp.start < self.epoch:
+                # started before the last reset: a leftover of the
+                # previous recording session — exporting it would yield
+                # a negative timestamp
+                self.dropped += 1
+            elif len(self._spans) < self.max_spans:
+                self._spans.append(sp)
+            else:
+                self.dropped += 1
+
+    # --------------------------------------------------------------- export
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def to_chrome(self) -> Dict[str, Any]:
+        """Chrome trace-event JSON object (``traceEvents`` array of "X"
+        complete events plus thread-name metadata).  Deterministic: events
+        appear in end order (the order spans were recorded), thread ids
+        are assigned by first appearance, and timestamps are integer
+        microseconds relative to the tracer epoch."""
+        t_now = self._now()
+        with self._lock:
+            spans = list(self._spans)
+            open_spans = sorted(self._open.values(),
+                                key=lambda s: s.span_id)
+        tids: Dict[str, int] = {}
+        events: List[Dict[str, Any]] = []
+        for sp in spans:
+            tid = tids.setdefault(sp.thread, len(tids) + 1)
+            ev: Dict[str, Any] = {
+                "name": sp.name, "cat": sp.cat or "default", "ph": "X",
+                "ts": int(round((sp.start - self.epoch) * 1e6)),
+                # clamped: a backwards wall-clock step (NTP) mid-span
+                # must not emit a negative duration the validator and
+                # chrome://tracing both reject
+                "dur": max(0, int(round((sp.end - sp.start) * 1e6))),
+                "pid": 1, "tid": tid,
+                "args": dict(sp.args or {},
+                             span_id=sp.span_id, parent_id=sp.parent_id),
+            }
+            events.append(ev)
+        for sp in open_spans:
+            # a live snapshot mid-tick: export in-flight spans too, so
+            # every parent_id in the document resolves
+            if sp.start < self.epoch:
+                continue
+            tid = tids.setdefault(sp.thread, len(tids) + 1)
+            try:
+                # the owning thread may be mutating args concurrently
+                # (e.g. the dispatcher filling in a count mid-span)
+                args = dict(sp.args) if sp.args else {}
+            except RuntimeError:
+                args = {}
+            args.update(span_id=sp.span_id, parent_id=sp.parent_id,
+                        incomplete=True)
+            events.append({
+                "name": sp.name, "cat": sp.cat or "default", "ph": "X",
+                "ts": int(round((sp.start - self.epoch) * 1e6)),
+                "dur": max(0, int(round((t_now - sp.start) * 1e6))),
+                "pid": 1, "tid": tid,
+                "args": args,
+            })
+        meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                 "args": {"name": tname}}
+                for tname, tid in sorted(tids.items(), key=lambda kv: kv[1])]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "otherData": {"dropped_spans": self.dropped}}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_chrome(), sort_keys=True,
+                          separators=(",", ":"))
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+
+# the process-wide tracer every instrumented component records into
+tracer = Tracer()

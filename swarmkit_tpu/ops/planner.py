@@ -41,10 +41,15 @@ from ..scheduler import constraint as constraint_mod
 from ..scheduler.filters import normalize_arch, _references_volume_plugin
 from ..scheduler.nodeinfo import NodeInfo
 from ..models.types import TaskState, TaskStatus
+from ..obs.trace import tracer
+from ..utils.metrics import registry as _metrics
 from .hashing import str_hash
 from .kernel import GroupInputs, K_CLAMP, NodeInputs, plan_group_jit
 
 log = logging.getLogger("tpu-planner")
+
+# cached Timer reference (Registry.reset() resets in place)
+_PLAN_TIMER = _metrics.timer("swarm_planner_plan_latency")
 
 # static shape buckets to bound recompiles
 _CC_BUCKETS = (1, 4, 16)      # constraint slots
@@ -146,6 +151,30 @@ class TPUPlanner:
         # begin_tick, updated incrementally by the apply phase, invalidated
         # by host-path fallbacks (which mutate NodeInfos behind our back)
         self._cache = None
+
+    # ------------------------------------------------------------- accounting
+
+    # routing-counter keys -> the route label exported on
+    # swarm_planner_groups{route=...}; every increment goes through
+    # _count so the stats dict and the metrics registry can never
+    # disagree (bench reads the registry)
+    _ROUTE = {"groups_planned": "device",
+              "groups_fallback": "fallback",
+              "groups_small_to_host": "host_small",
+              "groups_spill_to_host": "spill"}
+
+    def _count(self, key: str, delta: int = 1) -> None:
+        self.stats[key] = self.stats.get(key, 0) + delta
+        route = self._ROUTE.get(key)
+        if route is not None:
+            _metrics.counter(f'swarm_planner_groups{{route="{route}"}}',
+                             delta)
+        else:
+            _metrics.counter(f"swarm_planner_{key}", delta)
+
+    def _observe_plan(self, dt: float) -> None:
+        self.stats["plan_seconds"] += dt
+        _PLAN_TIMER.observe(dt)
 
     # ------------------------------------------------------- per-tick caching
 
@@ -278,7 +307,7 @@ class TPUPlanner:
 
     def _fallback(self) -> bool:
         # the host path will mutate NodeInfos the cached columns mirror
-        self.stats["groups_fallback"] += 1
+        self._count("groups_fallback")
         self._cache = None
         return False
 
@@ -319,7 +348,7 @@ class TPUPlanner:
         if self.enable_small_group_routing and \
                 len(task_group) * self.host_cost_per_task \
                 < 0.8 * self._launch_overhead:
-            self.stats["groups_small_to_host"] += 1
+            self._count("groups_small_to_host")
             self._cache = None   # host path mutates NodeInfos
             return False
 
@@ -328,7 +357,8 @@ class TPUPlanner:
         k = len(task_group)
         if k > K_CLAMP:  # beyond the kernel's 32-bit budget (see kernel.py)
             return self._fallback()
-        built = self._build_device_inputs(sched, t, k)
+        with tracer.span("plan.build_inputs", "plan", tasks=k):
+            built = self._build_device_inputs(sched, t, k)
         if built is None:
             return self._fallback()
         if built[1] == 0:   # no valid nodes densified
@@ -639,7 +669,8 @@ class TPUPlanner:
                 return tasks   # below device break-even: host loop
         import time as _time
         _plan_t0 = _time.perf_counter()
-        built = self._build_device_inputs(sched, t, len(tasks))
+        with tracer.span("plan.build_inputs", "plan", tasks=len(tasks)):
+            built = self._build_device_inputs(sched, t, len(tasks))
         if built is None or built[1] == 0:
             return tasks
         (infos, n, nb, valid, cpu, mem, total, nodes_in, group_in, L,
@@ -648,8 +679,9 @@ class TPUPlanner:
             return tasks   # per-task claim bookkeeping: host path
 
         import jax as _jax
-        mask, cap, _ = _jax.device_get(
-            feasibility_jit(nodes_in, group_in))
+        with tracer.span("plan.feasibility", "plan", tasks=len(tasks)):
+            mask, cap, _ = _jax.device_get(
+                feasibility_jit(nodes_in, group_in))
         col = {info.node.id: i for i, info in enumerate(infos)}
 
         items = []      # (task_id, task) admitted
@@ -664,15 +696,17 @@ class TPUPlanner:
             used[i] += 1
             items.append((task.id, task))
             slots.append(i)
-        self.stats["plan_seconds"] += _time.perf_counter() - _plan_t0
+        self._observe_plan(_time.perf_counter() - _plan_t0)
         if not items:
             return remaining
 
-        self._apply_assignments(
-            sched, t, items, slots, infos, decisions, cpu_d, mem_d, used,
-            cpu, mem, total,
-            message="scheduler confirmed task can run on preassigned node")
-        self.stats["tasks_planned"] += len(items)
+        with tracer.span("plan.apply", "plan", tasks=len(items)):
+            self._apply_assignments(
+                sched, t, items, slots, infos, decisions, cpu_d, mem_d,
+                used, cpu, mem, total,
+                message="scheduler confirmed task can run on preassigned "
+                        "node")
+        self._count("tasks_planned", len(items))
         return remaining
 
     def _plan_on_device(self, sched, t, task_group, decisions, built,
@@ -683,22 +717,25 @@ class TPUPlanner:
          hier, cpu_d, mem_d, gen_wanted, port_limited) = built
         k = len(task_group)
         import jax as _jax
-        x, fail_counts, spill = self._plan_fn(nodes_in, group_in, L, hier)
+        with tracer.span("plan.dispatch", "plan", tasks=k):
+            x, fail_counts, spill = self._plan_fn(nodes_in, group_in, L,
+                                                  hier)
         # one round-trip for all outputs: D2H latency dominates over
         # tunneled links, so never fetch twice
-        x, fail_counts, spill = _jax.device_get((x, fail_counts, spill))
+        with tracer.span("plan.d2h", "plan"):
+            x, fail_counts, spill = _jax.device_get(
+                (x, fail_counts, spill))
         if bool(spill):
             # a spread branch saturated: the host oracle's convergence
             # loop redistributes differently than the water-fill in that
             # regime (see kernel.py) — keep exact reference parity by
             # letting the host place this group
-            self.stats["plan_seconds"] += _time.perf_counter() - _plan_t0
-            self.stats["groups_spill_to_host"] = \
-                self.stats.get("groups_spill_to_host", 0) + 1
+            self._observe_plan(_time.perf_counter() - _plan_t0)
+            self._count("groups_spill_to_host")
             self._cache = None
             return False
         self.last_explanation = self._explain(fail_counts)
-        self.stats["plan_seconds"] += _time.perf_counter() - _plan_t0
+        self._observe_plan(_time.perf_counter() - _plan_t0)
 
         # ---- apply: expand per-node counts into per-task decisions
         from ..scheduler.scheduler import SchedulingDecision
@@ -723,9 +760,11 @@ class TPUPlanner:
             # its Python cost dominates large groups when run per task)
             placed = min(len(items), len(slots))
             counts = np.asarray(x)
-            self._apply_assignments(sched, t, items[:placed],
-                                    slots[:placed], infos, decisions,
-                                    cpu_d, mem_d, counts, cpu, mem, total)
+            with tracer.span("plan.apply", "plan", tasks=placed):
+                self._apply_assignments(sched, t, items[:placed],
+                                        slots[:placed], infos, decisions,
+                                        cpu_d, mem_d, counts, cpu, mem,
+                                        total)
             if placed == len(task_group):
                 task_group.clear()
             else:
@@ -734,15 +773,16 @@ class TPUPlanner:
         else:
             # generic resources / host ports need per-task claim bookkeeping
             self._cache = None   # add_task mutates behind the columns
-            for (task_id, task), node_i in zip(items, slots):
-                info = infos[node_i]
-                new_t = _fast_assign(task, info.id, shared_status)
-                all_tasks[task_id] = new_t
-                info.add_task(new_t)
-                decisions[task_id] = SchedulingDecision(task, new_t)
-                del task_group[task_id]
-                placed += 1
+            with tracer.span("plan.apply", "plan", tasks=len(slots)):
+                for (task_id, task), node_i in zip(items, slots):
+                    info = infos[node_i]
+                    new_t = _fast_assign(task, info.id, shared_status)
+                    all_tasks[task_id] = new_t
+                    info.add_task(new_t)
+                    decisions[task_id] = SchedulingDecision(task, new_t)
+                    del task_group[task_id]
+                    placed += 1
 
-        self.stats["groups_planned"] += 1
-        self.stats["tasks_planned"] += placed
+        self._count("groups_planned")
+        self._count("tasks_planned", placed)
         return True

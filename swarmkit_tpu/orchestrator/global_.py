@@ -11,10 +11,12 @@ from typing import Dict, List, Optional, Set
 
 from ..models.objects import Cluster, Node, Service, Task
 from ..models.types import NodeAvailability, NodeState, TaskState
+from ..obs.trace import tracer
 from ..scheduler import constraint as constraint_mod
 from ..state.events import Event, EventCommit, EventSnapshotRestore
 from ..state.store import Batch, ByName, ByNode, ByService, MemoryStore
 from ..state.watch import Closed
+from ..utils.metrics import registry as _metrics
 from . import common
 from .replicated import DEFAULT_CLUSTER_NAME
 from .restart import Supervisor as RestartSupervisor
@@ -22,6 +24,10 @@ from .update import Supervisor as UpdateSupervisor
 from . import taskinit
 
 log = logging.getLogger("global")
+
+# cached Timer reference (Registry.reset() resets in place)
+_RECONCILE_TIMER = _metrics.timer(
+    'swarm_orchestrator_reconcile{kind="global"}')
 
 
 class _GlobalService:
@@ -186,6 +192,12 @@ class Orchestrator:
 
     def _reconcile_services(self, service_ids: List[str]) -> None:
         """reference: global.go:254 reconcileServices."""
+        with tracer.span("orchestrator.reconcile", "orchestrator",
+                         kind="global", services=len(service_ids)), \
+                _RECONCILE_TIMER.time():
+            self._reconcile_services_inner(service_ids)
+
+    def _reconcile_services_inner(self, service_ids: List[str]) -> None:
         node_tasks: Dict[str, Dict[str, List[Task]]] = {}
 
         def read(tx):

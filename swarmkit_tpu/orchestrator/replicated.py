@@ -17,9 +17,11 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..models.objects import Cluster, Node, Service, Task
 from ..models.types import TaskState
+from ..obs.trace import tracer
 from ..state.events import Event, EventCommit, EventSnapshotRestore
 from ..state.store import Batch, ByName, ByNode, ByService, MemoryStore
 from ..state.watch import Closed
+from ..utils.metrics import registry as _metrics
 from . import common
 from .restart import Supervisor as RestartSupervisor
 from .update import Supervisor as UpdateSupervisor
@@ -28,6 +30,10 @@ from . import taskinit
 log = logging.getLogger("replicated")
 
 DEFAULT_CLUSTER_NAME = "default"  # reference: store.DefaultClusterName
+
+# cached Timer reference (Registry.reset() resets in place)
+_RECONCILE_TIMER = _metrics.timer(
+    'swarm_orchestrator_reconcile{kind="replicated"}')
 
 
 class Orchestrator:
@@ -200,8 +206,11 @@ class Orchestrator:
         if not self.reconcile_services:
             return
         services, self.reconcile_services = self.reconcile_services, {}
-        for s in services.values():
-            self._reconcile(s)
+        with tracer.span("orchestrator.reconcile", "orchestrator",
+                         kind="replicated", services=len(services)):
+            with _RECONCILE_TIMER.time():
+                for s in services.values():
+                    self._reconcile(s)
 
     # ------------------------------------------------------------- reconcile
 

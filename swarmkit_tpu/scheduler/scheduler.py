@@ -24,6 +24,8 @@ from ..models.objects import Node, Service, Task, Volume
 from ..models.types import (
     Resources, TaskState, TaskStatus, now,
 )
+from ..obs.trace import tracer
+from ..utils.metrics import registry as _metrics
 from ..state.events import Event, EventCommit, EventSnapshotRestore
 from ..state.store import Batch, MemoryStore, ReadTx
 from ..state.watch import Closed
@@ -37,6 +39,10 @@ log = logging.getLogger("scheduler")
 
 COMMIT_DEBOUNCE_GAP = 0.050   # reference: scheduler.go:149-155
 MAX_LATENCY = 1.0
+
+# cached Timer references (Registry.reset() resets in place)
+_TICK_TIMER = _metrics.timer("swarm_scheduler_tick_latency")
+_COMMIT_TIMER = _metrics.timer("swarm_scheduler_commit_latency")
 
 
 class SchedulingDecision:
@@ -331,6 +337,11 @@ class Scheduler:
     # -------------------------------------------------------------- decisions
 
     def _process_preassigned_tasks(self) -> None:
+        with tracer.span("sched.preassigned", "sched",
+                         pending=len(self.pending_preassigned_tasks)):
+            self._process_preassigned_inner()
+
+    def _process_preassigned_inner(self) -> None:
         decisions: Dict[str, SchedulingDecision] = {}
         pending = list(self.pending_preassigned_tasks.values())
         planner = self.batch_planner
@@ -380,8 +391,13 @@ class Scheduler:
     def tick(self) -> int:
         """Schedule the unassigned queue; returns number of decisions."""
         from ..utils.gctune import paused_gc
-        with paused_gc():
-            return self._tick_inner()
+        t0 = now()
+        with paused_gc(), tracer.span("sched.tick", "sched") as sp:
+            n = self._tick_inner()
+            if sp is not None:
+                sp.args = {"decisions": n}
+        _TICK_TIMER.observe(now() - t0)
+        return n
 
     def _tick_inner(self) -> int:
         t0 = now()
@@ -392,10 +408,14 @@ class Scheduler:
         # groups are maintained incrementally by _enqueue/_dequeue; take
         # them over wholesale — failures re-enqueue into fresh dicts during
         # the scheduling phase below
-        groups = self.unassigned_groups
-        self.unassigned_groups = {}
-        self.unassigned_tasks.clear()
-        one_off_tasks = groups.pop(None, {})
+        with tracer.span("sched.batch_build", "sched") as sp:
+            groups = self.unassigned_groups
+            self.unassigned_groups = {}
+            self.unassigned_tasks.clear()
+            one_off_tasks = groups.pop(None, {})
+            if sp is not None:
+                sp.args = {"groups": len(groups),
+                           "one_off": len(one_off_tasks)}
 
         planner = self.batch_planner
         if planner is not None and hasattr(planner, "begin_tick"):
@@ -418,20 +438,25 @@ class Scheduler:
 
         n_decisions = len(decisions) + sum(
             len(olds) for olds, _, _ in self.block_draft)
-        t_commit = now()
-        n_committed, _, block_failed = self._commit_block_draft(
-            want_ids=False)
-        for old, nid in block_failed:
-            # mirror rollback (remove_task never reads node_id, so the
-            # pre-assignment object works) + requeue for the next tick
-            self.all_tasks[old.id] = old
-            info = self.node_set.node_info(nid)
-            if info is not None:
-                info.remove_task(old)
-            self._enqueue(old)
-        if n_committed or block_failed:
-            self.stats["commit_seconds"] += now() - t_commit
-        _, failed = self._apply_scheduling_decisions(decisions)
+        with tracer.span("sched.commit", "sched", decisions=n_decisions):
+            t_commit = now()
+            n_committed, _, block_failed = self._commit_block_draft(
+                want_ids=False)
+            for old, nid in block_failed:
+                # mirror rollback (remove_task never reads node_id, so the
+                # pre-assignment object works) + requeue for the next tick
+                self.all_tasks[old.id] = old
+                info = self.node_set.node_info(nid)
+                if info is not None:
+                    info.remove_task(old)
+                self._enqueue(old)
+            if n_committed or block_failed:
+                dt_block = now() - t_commit
+                self.stats["commit_seconds"] += dt_block
+                # the columnar path commits here, not through
+                # _apply_scheduling_decisions — feed the timer both ways
+                _COMMIT_TIMER.observe(dt_block)
+            _, failed = self._apply_scheduling_decisions(decisions)
         for d in failed:
             self.all_tasks[d.old.id] = d.old
             info = self.node_set.node_info(d.new.node_id)
@@ -524,7 +549,9 @@ class Scheduler:
         try:
             return self._apply_decisions_inner(decisions)
         finally:
-            self.stats["commit_seconds"] += now() - t0
+            dt = now() - t0
+            self.stats["commit_seconds"] += dt
+            _COMMIT_TIMER.observe(dt)
 
     def _apply_decisions_inner(self, decisions):
         fast: List[SchedulingDecision] = []
@@ -730,10 +757,12 @@ class Scheduler:
             return a.active_tasks_count < b.active_tasks_count
 
         prefs = t.spec.placement.preferences if t.spec.placement else []
-        tree = self.node_set.tree(t.service_id, prefs, len(task_group),
-                                  self.pipeline.process, node_less)
-        self._schedule_n_tasks_on_subtree(len(task_group), task_group, tree,
-                                          decisions, node_less)
+        with tracer.span("sched.host_fallback", "sched",
+                         tasks=len(task_group)):
+            tree = self.node_set.tree(t.service_id, prefs, len(task_group),
+                                      self.pipeline.process, node_less)
+            self._schedule_n_tasks_on_subtree(len(task_group), task_group,
+                                              tree, decisions, node_less)
         if task_group:
             self._no_suitable_node(task_group, decisions)
 

@@ -31,6 +31,9 @@ def main(argv=None) -> int:
     p.add_argument("--agents", type=int, default=5)
     p.add_argument("--trace", action="store_true",
                    help="dump the full event trace to stderr")
+    p.add_argument("--trace-json", metavar="PATH", default=None,
+                   help="write the run's Chrome trace-event JSON here "
+                        "(fuzz mode: one file per seed, suffixed)")
     p.add_argument("--list", action="store_true",
                    help="list scenarios and exit")
     args = p.parse_args(argv)
@@ -45,14 +48,29 @@ def main(argv=None) -> int:
         def progress(r):
             mark = "ok" if r.ok else "FAIL"
             print(f"seed {r.seed:6d} {mark} trace={r.trace_hash[:12]} "
-                  f"events={r.events}", file=sys.stderr)
+                  f"obs={r.obs_trace_sha256[:12]} events={r.events}",
+                  file=sys.stderr)
 
         reports = fuzz(args.fuzz, start_seed=args.start_seed,
                        progress=progress)
+        if args.trace_json:
+            for r in reports:
+                path = (args.trace_json if len(reports) == 1
+                        else f"{args.trace_json}.seed{r.seed}")
+                with open(path, "w") as f:
+                    f.write(r.obs_trace)
         bad = failures(reports)
         print(json.dumps({
             "seeds": args.fuzz,
             "start_seed": args.start_seed,
+            # per-seed identity: the engine trace hash AND the sha of the
+            # Chrome span trace — both pure functions of the seed, so two
+            # runs of the same command are byte-identical end to end
+            "runs": [
+                {"seed": r.seed, "ok": r.ok, "events": r.events,
+                 "trace_hash": r.trace_hash,
+                 "obs_trace_sha256": r.obs_trace_sha256}
+                for r in reports],
             "failures": [
                 {"seed": r.seed, "violations": r.violations,
                  "reproduce": f"python -m swarmkit_tpu.sim --seed "
@@ -67,6 +85,9 @@ def main(argv=None) -> int:
                           keep_trace=args.trace)
     if args.trace:
         print("\n".join(report.trace), file=sys.stderr)
+    if args.trace_json:
+        with open(args.trace_json, "w") as f:
+            f.write(report.obs_trace)
     print(json.dumps(report.to_dict(), indent=2))
     return 0 if report.ok else 1
 

@@ -14,9 +14,11 @@ its entire fault timeline from the seed.
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List
 
+from ..obs.trace import tracer
 from .cluster import Sim
 from .faults import NetConfig
 
@@ -32,12 +34,22 @@ class SimReport:
     violations: List[str] = field(default_factory=list)
     stats: dict = field(default_factory=dict)
     trace: List[str] = field(default_factory=list)   # when keep_trace
+    # Chrome trace-event JSON of the control plane under virtual time
+    # (obs.tracer spans); byte-identical for a given (scenario, seed)
+    obs_trace: str = ""
+    obs_trace_sha256: str = ""   # computed once in __post_init__
+
+    def __post_init__(self) -> None:
+        if self.obs_trace and not self.obs_trace_sha256:
+            self.obs_trace_sha256 = hashlib.sha256(
+                self.obs_trace.encode()).hexdigest()
 
     def to_dict(self) -> dict:
         return {
             "scenario": self.scenario, "seed": self.seed,
             "duration_virtual_s": self.duration, "events": self.events,
-            "trace_hash": self.trace_hash, "ok": self.ok,
+            "trace_hash": self.trace_hash,
+            "obs_trace_sha256": self.obs_trace_sha256, "ok": self.ok,
             "violations": self.violations, "stats": self.stats,
         }
 
@@ -344,14 +356,33 @@ def run_scenario(name: str, seed: int, n_managers: int = 3,
     sim = Sim(seed, n_managers=n_managers, n_agents=n_agents,
               net_config=NetConfig())
     with sim:
-        sim.engine.log(f"scenario {name} seed {seed}")
-        duration = fn(sim)
-        sim.run(duration)
-        sim.finish(grace=grace)
-        stats = sim.stats()
+        # record control-plane spans under the virtual clock: epoch and
+        # every timestamp are virtual, span ids are a counter, and the
+        # sim is single-threaded — the exported JSON is a pure function
+        # of (scenario, seed).  The shared tracer's prior recording
+        # state (an embedding process may be tracing) is saved and
+        # restored around the scenario.  Constraint: other threads must
+        # not RECORD spans while the scenario runs (their wall-clock
+        # spans would land in the sim buffer and break byte-identity) —
+        # run sims from the CLI or tests, not inside a live traced
+        # manager process.
+        saved = tracer.save_state()
+        tracer.reset()
+        tracer.enable()
+        try:
+            sim.engine.log(f"scenario {name} seed {seed}")
+            duration = fn(sim)
+            sim.run(duration)
+            sim.finish(grace=grace)
+            stats = sim.stats()
+        finally:
+            tracer.disable()
+            obs_trace = tracer.to_json()
+            tracer.restore_state(saved)
     return SimReport(
         scenario=name, seed=seed, duration=duration + grace,
         events=sim.engine.events_run, trace_hash=sim.engine.trace_hash(),
         ok=not sim.violations.items,
         violations=list(sim.violations.items), stats=stats,
-        trace=list(sim.engine.trace) if keep_trace else [])
+        trace=list(sim.engine.trace) if keep_trace else [],
+        obs_trace=obs_trace)

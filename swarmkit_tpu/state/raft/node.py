@@ -19,9 +19,11 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ...utils.metrics import registry as _metrics
 from .. import serde
 from ..store import MemoryStore, Proposer, StoreAction
 from .core import (
@@ -31,6 +33,11 @@ from .core import (
 from .storage import RaftLogger
 
 log = logging.getLogger("raft")
+
+# cached Timer references (Registry.reset() resets these in place, so
+# holding them is safe); apply runs once per committed entry
+_APPLY_TIMER = _metrics.timer("swarm_raft_apply_latency")
+_PROPOSE_TIMER = _metrics.timer("swarm_raft_propose_latency")
 
 
 class NotLeader(Exception):
@@ -310,6 +317,8 @@ class RaftNode(Proposer):
         if e.type == ENTRY_NOOP or not e.data:
             return
         self.stats["applied"] += 1
+        _metrics.counter("swarm_raft_entries_applied")
+        _apply_t0 = time.perf_counter()
         local = e.index in self._local_indices
         if local:
             self._local_indices.discard(e.index)
@@ -333,6 +342,7 @@ class RaftNode(Proposer):
                         ok = False
                 waiter.ok = ok
                 waiter.event.set()
+                _APPLY_TIMER.observe(time.perf_counter() - _apply_t0)
                 return
             # the waiter was cancelled (leadership churn) but the entry
             # committed anyway: apply it like a remote entry so this store
@@ -344,6 +354,7 @@ class RaftNode(Proposer):
             self.store.apply_store_actions(actions)
         except Exception:
             log.exception("applying raft entry %d failed", e.index)
+        _APPLY_TIMER.observe(time.perf_counter() - _apply_t0)
 
     def _maybe_snapshot(self) -> None:
         """reference: raft.go:781 needsSnapshot + doSnapshot."""
@@ -419,11 +430,14 @@ class RaftNode(Proposer):
         but leadership loss fails us)."""
         if self.core.role != LEADER:
             raise NotLeader(f"{self.id} is not the leader")
+        t0 = time.perf_counter()
         data = serde.dumps([serde.action_to_dict(a) for a in actions])
         waiter = _Waiter(event=threading.Event(), term=self.core.term,
                          index=0, commit_cb=commit_cb)
         self._inbox.put((data, waiter))
         waiter.event.wait()
+        # serialize -> consensus round -> apply-path commit, end to end
+        _PROPOSE_TIMER.observe(time.perf_counter() - t0)
         if not waiter.ok:
             raise ProposalDropped(
                 "raft proposal dropped (leadership change)")

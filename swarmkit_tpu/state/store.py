@@ -42,6 +42,7 @@ from ..models.objects import (
     Task, Volume, STORE_OBJECT_TYPES,
 )
 from ..models.types import now
+from ..utils.metrics import registry as _metrics
 from .events import Event, EventCommit, EventSnapshotRestore, EventTaskBlock
 from .watch import Queue, Subscription
 
@@ -54,19 +55,32 @@ WEDGE_TIMEOUT = 30.0      # reference: memory.go:79-146 deadlock tripwire
 
 log = logging.getLogger("store")
 
+# cached Timer references for the write paths (Registry.reset() resets
+# these in place, so holding them is safe)
+_UPDATE_TX_TIMER = _metrics.timer("swarm_store_write_tx_latency")
+_BATCH_TIMER = _metrics.timer("swarm_store_batch_latency")
+_BLOCK_COMMIT_TIMER = _metrics.timer("swarm_store_block_commit_latency")
+
 
 class _TimedLock:
     """Update-lock wrapper with a lock-age tripwire and hold-time metric
     (reference: memory.go timedMutex — logs when the store wedges)."""
 
-    __slots__ = ("_lock", "_acquired_at", "_holder")
+    __slots__ = ("_lock", "_acquired_at", "_holder", "_wait_timer",
+                 "_hold_timer")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._acquired_at = 0.0
         self._holder = ""
+        # cached Timer references: this runs on the system's hottest
+        # lock, so no per-call registry lookup (Registry.reset() resets
+        # timers in place precisely to keep held references valid)
+        self._wait_timer = _metrics.timer("swarm_store_lock_wait")
+        self._hold_timer = _metrics.timer("swarm_store_lock_hold")
 
     def acquire(self) -> None:
+        t0 = time.monotonic()
         while not self._lock.acquire(timeout=WEDGE_TIMEOUT):
             log.error(
                 "store update lock wedged: held for %.0fs by %r "
@@ -74,11 +88,15 @@ class _TimedLock:
                 self._holder, threading.current_thread().name)
         self._acquired_at = time.monotonic()
         self._holder = threading.current_thread().name
+        # reference: memory.go:84-112 lockTimer — contention visibility
+        self._wait_timer.observe(self._acquired_at - t0)
 
     def release(self) -> None:
         held = time.monotonic() - self._acquired_at
         self._holder = ""
         self._lock.release()
+        # observed after the release so it never extends the hold
+        self._hold_timer.observe(held)
         if held > WEDGE_TIMEOUT:
             log.error("store update lock was held for %.0fs", held)
 
@@ -568,13 +586,15 @@ class MemoryStore:
         followers replaying them converge bit-for-bit (the reference gets
         this via proposer.GetVersion(); memory.go).
         """
-        from ..utils.metrics import registry
-        with registry.timer("swarm_store_write_tx_latency").time():
+        t0 = time.perf_counter()
+        try:
             with self._update_lock:
                 tx = WriteTx(self)
                 result = cb(tx)  # exceptions roll back (nothing committed)
                 self._propose_and_commit(tx)
                 return result
+        finally:
+            _UPDATE_TX_TIMER.observe(time.perf_counter() - t0)
 
     def _propose_and_commit(self, tx: "WriteTx") -> None:
         """Stamp versions, run consensus, apply.  Caller holds _update_lock.
@@ -602,6 +622,7 @@ class MemoryStore:
         Sub-transactions commit incrementally (best-effort): an error midway
         leaves earlier flushes committed, like the reference.
         """
+        t0 = time.perf_counter()
         b = Batch(self)
         try:
             result = cb(b)
@@ -609,6 +630,7 @@ class MemoryStore:
             return result
         finally:
             b._abort()
+            _BATCH_TIMER.observe(time.perf_counter() - t0)
 
     def _commit(self, tx: WriteTx) -> None:
         if not tx._changes:
@@ -943,12 +965,19 @@ class MemoryStore:
         no-watcher/no-proposer restriction."""
         return True
 
-    def commit_task_block(self, old_tasks: Sequence[Task],
-                          node_ids: Sequence[str],
-                          state: int, message: str,
-                          on_missing, on_assigned,
-                          guard_state: int = 192,  # TaskState.ASSIGNED
+    def commit_task_block(self, *args, **kwargs
                           ) -> Tuple[List[int], List[int]]:
+        # timing shell only — signature, defaults, and docs live on the
+        # impl so they exist in exactly one place
+        with _BLOCK_COMMIT_TIMER.time():
+            return self._commit_task_block_impl(*args, **kwargs)
+
+    def _commit_task_block_impl(self, old_tasks: Sequence[Task],
+                                node_ids: Sequence[str],
+                                state: int, message: str,
+                                on_missing, on_assigned,
+                                guard_state: int = 192,
+                                ) -> Tuple[List[int], List[int]]:
         """Columnar scheduler commit: assignments stay arrays end-to-end.
 
         Same per-item semantics as ``bulk_update_tasks`` (scheduler.go:490

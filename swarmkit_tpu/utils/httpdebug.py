@@ -1,4 +1,5 @@
-"""Operator observability HTTP listener: /metrics, /healthz, /debug/stacks.
+"""Operator observability HTTP listener: /metrics, /healthz,
+/debug/stacks, /debug/trace.
 
 Reference: swarmd/cmd/swarmd/main.go:92-97 (--listen-metrics serving
 Prometheus metrics, --listen-debug serving pprof).  The stacks endpoint
@@ -55,6 +56,28 @@ class DebugServer:
                 elif self.path == "/debug/stacks":
                     body = _all_stacks().encode()
                     code, ctype = 200, "text/plain"
+                elif self.path == "/debug/trace":
+                    # Chrome trace-event JSON of the process tracer —
+                    # load in chrome://tracing or ui.perfetto.dev.
+                    # GET ?enable=1 / ?enable=0 toggles recording.
+                    from ..obs.trace import tracer
+                    body = tracer.to_json().encode()
+                    code, ctype = 200, "application/json"
+                elif self.path.startswith("/debug/trace?enable="):
+                    from ..obs.trace import tracer
+                    value = self.path.split("=", 1)[1].lower()
+                    if value in ("1", "true", "on", "yes"):
+                        tracer.reset()
+                        tracer.enable()
+                        body, code = b"tracing enabled\n", 200
+                    elif value in ("0", "false", "off", "no"):
+                        tracer.disable()
+                        body, code = b"tracing disabled\n", 200
+                    else:
+                        body = (f"bad enable value {value!r}; use 1/0\n"
+                                .encode())
+                        code = 400
+                    ctype = "text/plain"
                 else:
                     body, code, ctype = b"not found\n", 404, "text/plain"
                 self.send_response(code)

@@ -8,6 +8,7 @@ dispatcher.go:72-77, object-count collector manager/metrics/collector.go).
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import defaultdict
@@ -56,8 +57,21 @@ class Timer:
             buf = sorted(self._buf)
         if not buf:
             return {q: 0.0 for q in _QUANTILES}
-        return {q: buf[min(len(buf) - 1, int(q * len(buf)))]
-                for q in _QUANTILES}
+        # nearest-rank: the smallest value with at least q*n observations
+        # at or below it.  The previous ``int(q*len)`` indexed one element
+        # HIGH for exact multiples (p50 of 10 returned the 6th element)
+        # while q*n just under len biased to max-1 — on small buffers the
+        # reported p99 was systematically off by one rank.
+        n = len(buf)
+        return {q: buf[max(0, math.ceil(q * n) - 1)] for q in _QUANTILES}
+
+    def reset(self) -> None:
+        """Forget every observation (per-bench-config isolation)."""
+        with self._lock:
+            self._buf = []
+            self._i = 0
+            self.count = 0
+            self.total = 0.0
 
 
 class Registry:
@@ -82,6 +96,28 @@ class Registry:
                 t = self.timers[name] = Timer()
             return t
 
+    def get_counter(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self.counters.get(name, default)
+
+    def counters_snapshot(self, prefix: str = "") -> Dict[str, float]:
+        """Copy of the counter map (optionally prefix-filtered); bench
+        diffs two snapshots to attribute counts to one timed region."""
+        with self._lock:
+            return {k: v for k, v in self.counters.items()
+                    if k.startswith(prefix)}
+
+    def reset(self) -> None:
+        """Zero all counters/gauges and reset timers IN PLACE — components
+        hold Timer references from ``timer(name)``, so the objects must
+        survive a reset (per-bench-config isolation)."""
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            timers = list(self.timers.values())
+        for t in timers:
+            t.reset()
+
     def expose(self) -> str:
         """Prometheus-style text format."""
         lines: List[str] = []
@@ -98,6 +134,18 @@ class Registry:
                 lines.append(f"{name} {v:g}")
             timers = list(self.timers.items())
         for name, t in sorted(timers):
+            if "{" in name:
+                # labeled timer: merge the quantile label into the
+                # existing label set, suffix on the metric name
+                base, labels = name.split("{", 1)
+                labels = labels[:-1]  # strip closing brace
+                for q, v in t.quantiles().items():
+                    lines.append(f'{base}_seconds{{{labels},'
+                                 f'quantile="{q}"}} {v:.6f}')
+                lines.append(f"{base}_seconds_count{{{labels}}} {t.count}")
+                lines.append(f"{base}_seconds_sum{{{labels}}} "
+                             f"{t.total:.6f}")
+                continue
             for q, v in t.quantiles().items():
                 lines.append(f'{name}_seconds{{quantile="{q}"}} {v:.6f}')
             lines.append(f"{name}_seconds_count {t.count}")
