@@ -67,6 +67,13 @@ CONFIG_TRIALS = int(os.environ.get("BENCH_CONFIG_TRIALS", 2))
 VARIANCE_GUARD_X = float(os.environ.get("BENCH_VARIANCE_GUARD_X", 1.3))
 VARIANCE_RETRIES = int(os.environ.get("BENCH_VARIANCE_RETRIES", 1))
 TRACE_OUT = os.environ.get("BENCH_TRACE_OUT", "bench_trace.json")
+# flight-recorder post-mortem written when a trial trips the variance
+# guard — the evidence trail for "why did this config swing"
+FLIGHTREC_OUT = os.environ.get("BENCH_FLIGHTREC_OUT",
+                               "bench_flightrec.json")
+# every run appends its per-config summary here (bench_compare.py diffs
+# entries); set to "" to disable
+HISTORY_OUT = os.environ.get("BENCH_HISTORY", "BENCH_HISTORY.jsonl")
 
 
 def _planner_counters():
@@ -90,6 +97,23 @@ def _planner_counter_delta(snap):
     cur = _planner_counter_snapshot()
     return {stat_key: int(cur.get(reg_key, 0.0) - snap.get(reg_key, 0.0))
             for stat_key, reg_key in _planner_counters().items()}
+
+
+_COMPILE_PREFIX = 'swarm_planner_compiles{bucket="'
+
+
+def _compile_delta(snap):
+    """Per-bucket XLA compile counts since ``snap`` (zeros included, so
+    the artifact names every bucket the run touched — "this bucket
+    existed and did NOT recompile" is the common, load-bearing case)."""
+    cur = _planner_counter_snapshot()
+    out = {}
+    for key in set(cur) | set(snap):
+        if not key.startswith(_COMPILE_PREFIX):
+            continue
+        bucket = key[len(_COMPILE_PREFIX):-2]
+        out[bucket] = int(cur.get(key, 0.0) - snap.get(key, 0.0))
+    return dict(sorted(out.items()))
 
 
 def build_cluster(n_nodes, n_tasks, node_labels=None, reservations=None,
@@ -198,18 +222,40 @@ def _trim_heap():
         pass
 
 
-def run_with_variance_guard(trial, n_trials=None):
+# name -> {"path", "sha256"} of flight-recorder dumps written because a
+# config tripped the variance guard (read back into the artifact)
+_flightrec_dumps = {}
+
+
+def _dump_flightrec_on_trip(name):
+    """A trial swung past the guard: dump the black box NOW, before the
+    retry overwrites the evidence (the recent spans — including any
+    plan.compile — and counter samples around the slow trial)."""
+    from swarmkit_tpu.obs import flightrec
+    base, ext = os.path.splitext(FLIGHTREC_OUT)
+    path = f"{base}_{name}{ext}" if name else FLIGHTREC_OUT
+    try:
+        sha = flightrec.dump(path)
+    except OSError:
+        return
+    _flightrec_dumps[name or "headline"] = {"path": path, "sha256": sha}
+
+
+def run_with_variance_guard(trial, n_trials=None, name=None):
     """Best-of-N with the variance guard: run ``trial`` (returning a
     tuple whose first element is the timed seconds) n_trials times, then
     keep re-running while the worst trial exceeds VARIANCE_GUARD_X of
-    the best (up to VARIANCE_RETRIES extras).  Returns (results,
-    retries)."""
+    the best (up to VARIANCE_RETRIES extras).  A tripped guard dumps the
+    flight recorder so the swing is explainable after the fact.
+    Returns (results, retries)."""
     results = [trial() for _ in range(n_trials or CONFIG_TRIALS)]
     retries = 0
     while retries < VARIANCE_RETRIES:
         dts = [r[0] for r in results]
         if max(dts) <= VARIANCE_GUARD_X * min(dts):
             break
+        if retries == 0:
+            _dump_flightrec_on_trip(name)
         retries += 1
         results.append(trial())
     return results, retries
@@ -282,7 +328,7 @@ def run_config(name, n_nodes, n_tasks, planner_factory, expect=None, **kw):
                 f"{name}: TPU path did not engage: {routed}"
         return dt, n_dec, planner, sched, routed
 
-    results, retries = run_with_variance_guard(trial)
+    results, retries = run_with_variance_guard(trial, name=name)
     dts = [r[0] for r in results]
     dt, n_dec, planner, sched, routed = min(results, key=lambda r: r[0])
     out = {
@@ -298,7 +344,13 @@ def run_config(name, n_nodes, n_tasks, planner_factory, expect=None, **kw):
         "variance_reruns": retries,
         "path": "host-routed" if routed["tasks_planned"] == 0
         else "device",
+        # per-bucket XLA compiles inside the timed trials (registry was
+        # reset post-warm-up, so any nonzero count here is a compile
+        # that landed in a timed region — the r4/r5 swing explained)
+        "compiles": _compile_delta({}),
     }
+    if name in _flightrec_dumps:
+        out["flightrec_dump"] = _flightrec_dumps[name]
     out.update(_spread_stats(dts))
     return out
 
@@ -383,7 +435,7 @@ def run_storm(planner_factory):
         return dt, n_dec, len(replacements), planner, sched, \
             _planner_counter_delta(snap)
 
-    results, retries = run_with_variance_guard(trial)
+    results, retries = run_with_variance_guard(trial, name="storm")
     dts = [r[0] for r in results]
     dt, n_dec, n_repl, planner, sched, routed = min(results,
                                                     key=lambda r: r[0])
@@ -396,7 +448,10 @@ def run_storm(planner_factory):
         "commit_s": round(sched.stats["commit_seconds"], 3),
         "fallback_groups": routed["groups_fallback"],
         "variance_reruns": retries,
+        "compiles": _compile_delta({}),
     }
+    if "storm" in _flightrec_dumps:
+        out["flightrec_dump"] = _flightrec_dumps["storm"]
     out.update(_spread_stats(dts))
     return out
 
@@ -554,6 +609,7 @@ def run_live_manager(planner_factory, external_firehose=False):
             "raft_entries_applied": rn.stats["applied"],
             "events_delivered": dict(counts),
             "path": "device+raft+watchers",
+            "compiles": _compile_delta(snap),
         }
     finally:
         stop.set()
@@ -681,6 +737,11 @@ def main():
     # spans recorded from here on; the warm-up compiles above stay out
     tracer.reset()
     tracer.enable()
+    # black box on: recent spans + registry samples stay dumpable when
+    # a variance guard trips (run_with_variance_guard)
+    from swarmkit_tpu.obs import flightrec
+    flightrec.reset()
+    flightrec.enabled = True
 
     # ---- headline: config 4 scale, median of TRIALS (variance-guarded)
     def headline_trial():
@@ -695,9 +756,14 @@ def main():
         gc.collect()
         return out
 
+    headline_compile_snap = _planner_counter_snapshot()
     with tracer.span("bench.config", "bench", cfg="headline"):
         trials, headline_reruns = run_with_variance_guard(
-            headline_trial, n_trials=TRIALS)
+            headline_trial, n_trials=TRIALS, name="headline")
+    # per-bucket compile counts inside the timed headline region — the
+    # warm-up above compiled every signature, so nonzero means a compile
+    # landed in a timed trial and the numbers carry its cost
+    headline_compiles = _compile_delta(headline_compile_snap)
     ticks = sorted(t[0] for t in trials)
     med = statistics.median(ticks)
     rep = min(trials, key=lambda t: abs(t[0] - med))
@@ -799,7 +865,14 @@ def main():
     tables = {cfg: phase_table(doc, window=w)
               for cfg, w in config_windows(doc)}
 
-    print(json.dumps({
+    # health plane verdict over the finished run's registry: all-pass is
+    # the clean-run baseline the acceptance criteria pin
+    from swarmkit_tpu.obs.health import HealthEvaluator
+    health_eval = HealthEvaluator()
+    health_checks = health_eval.evaluate()
+    health = {"status": health_eval.status(), "checks": health_checks}
+
+    artifact = {
         "metric": f"scheduling decisions/sec, {N_TASKS // 1000}k tasks x "
                   f"{N_NODES // 1000}k nodes (single tick, store-committed)",
         "value": round(tpu_dps, 1),
@@ -823,10 +896,50 @@ def main():
         else None,
         "obs": obs_stats,
         "trace_file": trace_file,
+        # per-bucket XLA compiles inside the timed headline region
+        "planner_compiles": headline_compiles,
+        "health": health,
         "phase_table": tables,
         "configs": configs,
         "e2e_time_to_running": e2e,
-    }))
+    }
+    if "headline" in _flightrec_dumps:
+        artifact["flightrec_dump"] = _flightrec_dumps["headline"]
+    print(json.dumps(artifact))
+    _append_history(artifact)
+
+
+def _append_history(artifact):
+    """One compact JSONL record per run — the regression ledger
+    ``scripts/bench_compare.py`` diffs.  Best-effort: an unwritable
+    history file must not fail the bench."""
+    if not HISTORY_OUT:
+        return
+    record = {
+        "t": round(time.time(), 3),
+        "metric": artifact["metric"],
+        "value": artifact["value"],
+        "unit": artifact["unit"],
+        "tick_p50_s": artifact["tick_p50_s"],
+        "headline_variance_x": artifact["headline_variance_x"],
+        "obs_overhead_pct": (artifact["obs"] or {}).get("overhead_pct"),
+        "health": artifact["health"]["status"],
+        "planner_compiles": sum(artifact["planner_compiles"].values()),
+        "configs": {
+            name: {
+                "decisions_per_sec": cfg.get("decisions_per_sec"),
+                "variance_x": cfg.get("variance_x"),
+                "fallback_groups": cfg.get("fallback_groups"),
+                "compiles": sum(cfg.get("compiles", {}).values()),
+                "shape_cost_x": cfg.get("shape_cost_x"),
+            }
+            for name, cfg in artifact["configs"].items()},
+    }
+    try:
+        with open(HISTORY_OUT, "a") as f:
+            f.write(json.dumps(record, sort_keys=True) + "\n")
+    except OSError:
+        pass
 
 
 if __name__ == "__main__":

@@ -1,0 +1,146 @@
+"""Diff two bench runs and fail on per-config regressions.
+
+Usage:
+    python scripts/bench_compare.py OLD.json NEW.json
+    python scripts/bench_compare.py --history BENCH_HISTORY.jsonl
+    python scripts/bench_compare.py --history BENCH_HISTORY.jsonl \
+        -a -3 -b -1
+    python scripts/bench_compare.py OLD.json NEW.json --threshold 0.1
+
+Inputs are either full bench artifacts (the JSON line ``bench.py``
+prints, saved as ``BENCH_*.json``) or entries of the append-only
+``BENCH_HISTORY.jsonl`` ledger every run writes — both carry the same
+per-config ``decisions_per_sec`` numbers.  ``--history`` compares two
+entries of the ledger (defaults: previous vs last).
+
+Exit status: 1 when any config (or the headline) regressed by more than
+``--threshold`` (default 0.20 = the round-5 "regression-proof bench"
+bar), else 0.  Improvements and new/removed configs never fail the run.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _norm(doc):
+    """Normalize an artifact or history record to
+    {"headline": dps, "configs": {name: dps}} plus context fields."""
+    configs = {}
+    for name, cfg in (doc.get("configs") or {}).items():
+        dps = cfg.get("decisions_per_sec")
+        if dps:
+            configs[name] = float(dps)
+    return {
+        "headline": float(doc.get("value") or 0.0),
+        "configs": configs,
+        "t": doc.get("t"),
+        "health": (doc.get("health") or {}).get("status")
+        if isinstance(doc.get("health"), dict) else doc.get("health"),
+    }
+
+
+def _load_file(path):
+    with open(path) as f:
+        text = f.read().strip()
+    # artifacts may carry log noise before the JSON line; take the last
+    # line that parses
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            return _norm(json.loads(line))
+        except ValueError:
+            continue
+    raise SystemExit(f"{path}: no JSON document found")
+
+
+def _load_history(path, index):
+    with open(path) as f:
+        entries = [json.loads(line) for line in f if line.strip()]
+    if not entries:
+        raise SystemExit(f"{path}: empty history")
+    try:
+        return _norm(entries[index])
+    except IndexError:
+        raise SystemExit(
+            f"{path}: index {index} out of range ({len(entries)} entries)")
+
+
+def compare(old, new, threshold):
+    """Returns (rows, regressions).  A row covers the headline and every
+    config present in either run."""
+    names = ["headline"] + sorted(set(old["configs"]) | set(new["configs"]))
+    rows, regressions = [], []
+    for name in names:
+        if name == "headline":
+            a, b = old["headline"], new["headline"]
+        else:
+            a = old["configs"].get(name)
+            b = new["configs"].get(name)
+        if not a or not b:
+            rows.append((name, a, b, None, "new" if not a else "gone"))
+            continue
+        delta = (b - a) / a
+        mark = ""
+        if delta < -threshold:
+            mark = "REGRESSION"
+            regressions.append(name)
+        rows.append((name, a, b, delta, mark))
+    return rows, regressions
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python scripts/bench_compare.py")
+    p.add_argument("runs", nargs="*",
+                   help="two artifact/history-entry JSON files (OLD NEW)")
+    p.add_argument("--history", metavar="JSONL",
+                   help="compare two entries of a BENCH_HISTORY.jsonl")
+    p.add_argument("-a", type=int, default=-2,
+                   help="history index of the baseline entry (default -2)")
+    p.add_argument("-b", type=int, default=-1,
+                   help="history index of the candidate entry (default -1)")
+    p.add_argument("--threshold", type=float, default=0.20,
+                   help="max tolerated per-config decisions/s regression "
+                        "(fraction, default 0.20)")
+    args = p.parse_args(argv)
+
+    if args.history:
+        old = _load_history(args.history, args.a)
+        new = _load_history(args.history, args.b)
+        labels = (f"{os.path.basename(args.history)}[{args.a}]",
+                  f"{os.path.basename(args.history)}[{args.b}]")
+    elif len(args.runs) == 2:
+        old = _load_file(args.runs[0])
+        new = _load_file(args.runs[1])
+        labels = tuple(os.path.basename(r) for r in args.runs)
+    else:
+        p.error("pass two run files, or --history JSONL")
+        return 2
+
+    rows, regressions = compare(old, new, args.threshold)
+    print(f"{'config':<28} {labels[0]:>16} {labels[1]:>16} {'delta':>9}")
+    for name, a, b, delta, mark in rows:
+        sa = f"{a:,.1f}" if a else "-"
+        sb = f"{b:,.1f}" if b else "-"
+        sd = f"{delta * 100:+.1f}%" if delta is not None else mark
+        line = f"{name:<28} {sa:>16} {sb:>16} {sd:>9}"
+        if mark == "REGRESSION":
+            line += "  <-- REGRESSION"
+        print(line)
+    if old.get("health") or new.get("health"):
+        print(f"\nhealth: {old.get('health')} -> {new.get('health')}")
+    if regressions:
+        print(f"\n{len(regressions)} config(s) regressed more than "
+              f"{args.threshold * 100:.0f}%: {', '.join(regressions)}",
+              file=sys.stderr)
+        return 1
+    print(f"\nok: no config regressed more than "
+          f"{args.threshold * 100:.0f}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

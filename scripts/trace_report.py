@@ -1,9 +1,10 @@
-"""Summarize a Chrome trace-event JSON into a per-phase table.
+"""Summarize or diff Chrome trace-event JSON as per-phase tables.
 
 Usage:
     python scripts/trace_report.py bench_trace.json
     python scripts/trace_report.py bench_trace.json --validate
     python scripts/trace_report.py sim_trace.json --json
+    python scripts/trace_report.py --diff A.json B.json
 
 Works on any trace the obs tracer emits: ``bench.py``'s BENCH_TRACE_OUT,
 ``python -m swarmkit_tpu.sim --trace-json``, or a ``/debug/trace``
@@ -11,6 +12,10 @@ download.  When the trace carries ``bench.config`` marker spans, a table
 is printed per config; otherwise one table covers the whole trace.
 ``--validate`` schema-checks the document and exits non-zero on problems
 (the tier-1 smoke test runs exactly this check in-process).
+``--diff A B`` prints a side-by-side phase table with per-phase total_s
+deltas (A = baseline, B = candidate), matched per config window where
+both traces carry the same ``bench.config`` markers — the same
+``obs/report.py`` aggregation the bench artifact embeds.
 """
 
 import argparse
@@ -22,28 +27,81 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from swarmkit_tpu.obs.report import (  # noqa: E402
-    config_windows, format_table, phase_table, validate_chrome_trace,
-    x_events,
+    config_windows, diff_phase_tables, format_diff, format_table,
+    phase_table, validate_chrome_trace, x_events,
 )
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _tables(doc):
+    windows = config_windows(doc)
+    if not windows:
+        windows = [("all", None)]
+    return {name: phase_table(doc, window=w) for name, w in windows}
+
+
+def _run_diff(path_a: str, path_b: str, as_json: bool) -> int:
+    doc_a, doc_b = _load(path_a), _load(path_b)
+    ta, tb = _tables(doc_a), _tables(doc_b)
+    only_a = sorted(set(ta) - set(tb))
+    only_b = sorted(set(tb) - set(ta))
+    names = [n for n in ta if n in tb]
+    matched = {}
+    if names:
+        matched = {n: (ta[n], tb[n]) for n in names}
+    else:
+        # no shared config windows: diff whole-trace tables (and still
+        # report the disjoint config sets below — that mismatch is the
+        # headline when it happens)
+        matched = {"all": (phase_table(doc_a), phase_table(doc_b))}
+        names = ["all"]
+    diffs = {name: diff_phase_tables(a, b)
+             for name, (a, b) in matched.items()}
+    if as_json:
+        print(json.dumps(diffs, indent=2, sort_keys=True))
+        return 0
+    print(f"A = {path_a}\nB = {path_b}\n")
+    for name in names:
+        print(f"=== {name} ===")
+        print(format_diff(diffs[name]))
+        print()
+    if only_a:
+        print(f"configs only in A: {', '.join(only_a)}")
+    if only_b:
+        print(f"configs only in B: {', '.join(only_b)}")
+    return 0
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python scripts/trace_report.py")
-    p.add_argument("trace", help="Chrome trace-event JSON file")
+    p.add_argument("trace", nargs="+",
+                   help="Chrome trace-event JSON file(s); two with --diff")
     p.add_argument("--validate", action="store_true",
                    help="schema-check only; exit 1 on problems")
     p.add_argument("--json", action="store_true",
                    help="emit the phase table(s) as JSON")
+    p.add_argument("--diff", action="store_true",
+                   help="side-by-side phase diff of two traces (A B)")
     args = p.parse_args(argv)
 
-    with open(args.trace) as f:
-        doc = json.load(f)
+    if args.diff:
+        if len(args.trace) != 2:
+            p.error("--diff takes exactly two trace files")
+        return _run_diff(args.trace[0], args.trace[1], args.json)
+    if len(args.trace) != 1:
+        p.error("pass one trace file (or two with --diff)")
+
+    doc = _load(args.trace[0])
 
     problems = validate_chrome_trace(doc)
     if args.validate:
         for pr in problems:
             print(pr, file=sys.stderr)
-        print(f"{args.trace}: "
+        print(f"{args.trace[0]}: "
               f"{'INVALID' if problems else 'ok'} "
               f"({len(x_events(doc))} spans)")
         return 1 if problems else 0
@@ -51,10 +109,7 @@ def main(argv=None) -> int:
         print(f"warning: {len(problems)} schema problems "
               f"(run --validate)", file=sys.stderr)
 
-    windows = config_windows(doc)
-    if not windows:
-        windows = [("all", None)]
-    tables = {name: phase_table(doc, window=w) for name, w in windows}
+    tables = _tables(doc)
     if args.json:
         print(json.dumps(tables, indent=2, sort_keys=True))
         return 0

@@ -77,8 +77,16 @@ class Manager:
         self.logbroker = LogBroker(self.store)
         self.ca_server = CAServer(self.root_ca)
         self.collector = Collector(self.store)
-        from ..obs import LifecycleTracker
+        from ..obs import LifecycleTracker, Sampler
+        from ..obs.health import evaluator as _health_evaluator
         self.lifecycle = LifecycleTracker(self.store)
+        # health/SLO plane + black box: the sampler thread snapshots the
+        # registry into the flight recorder and re-judges the SLO checks
+        # every interval; /debug/health and /debug/flightrec serve the
+        # same shared singletons
+        self.sampler = Sampler()
+        self.health = _health_evaluator
+        self.obs_sample_interval = 2.0
 
         # leader-only loops, created on become_leader
         self.dispatcher: Optional[Dispatcher] = None
@@ -125,6 +133,15 @@ class Manager:
         self._running = True
         self.collector.start()
         self.lifecycle.start()
+        # black-box recording is always on for a live manager: recent
+        # spans/samples/store events stay dumpable via /debug/flightrec
+        # whatever happens later
+        from ..obs.flightrec import flightrec
+        flightrec.enabled = True
+        flightrec.watch_store(self.store)
+        self.sampler.rebase()
+        self.sampler.start(interval=self.obs_sample_interval,
+                           on_sample=self.health.evaluate)
         if self.raft is None:
             self._ensure_cluster_object()
             self._become_leader()
@@ -213,6 +230,9 @@ class Manager:
             self.store.queue.unsubscribe(self._ca_sub)
             self._ca_sub = None
         self._become_follower()
+        self.sampler.stop()
+        from ..obs.flightrec import flightrec
+        flightrec.unwatch_store(self.store)
         self.collector.stop()
         self.lifecycle.stop()
         self.logbroker.close()

@@ -1,16 +1,31 @@
-"""Observability layer: span tracing, task-lifecycle latency, reports.
+"""Observability layer: span tracing, task-lifecycle latency, reports,
+flight recorder, time-series sampler, and the health/SLO plane.
 
 ``tracer`` is the process-wide span recorder (disabled by default; bench,
-the simulator, and ``/debug/trace`` enable/serve it).  Metrics counters
-and timers live in ``utils.metrics.registry`` — this package adds the
-span/trace dimension and the lifecycle tracker on top.
+the simulator, and ``/debug/trace`` enable/serve it).  ``flightrec`` is
+the process-wide black box: bounded rings of recent spans (tapped from
+the tracer), metric samples (``Sampler``), store events, and raft role
+transitions, dumped as one post-mortem JSON (``/debug/flightrec``, sim
+invariant violations, bench variance-guard trips).  ``HealthEvaluator``
+judges declarative SLO checks over the registry and serves
+``/debug/health``.  Metrics counters and timers live in
+``utils.metrics.registry`` — this package adds the span/trace dimension
+and the derived planes on top.
 """
 
+from .flightrec import FlightRecorder, flightrec
+from .health import Check, HealthEvaluator
 from .lifecycle import LifecycleTracker
-from .report import format_table, phase_table, validate_chrome_trace
+from .report import (
+    diff_phase_tables, format_diff, format_table, phase_table,
+    validate_chrome_trace,
+)
+from .sampler import Sampler
 from .trace import Span, Tracer, tracer
 
 __all__ = [
-    "LifecycleTracker", "Span", "Tracer", "format_table", "phase_table",
-    "tracer", "validate_chrome_trace",
+    "Check", "FlightRecorder", "HealthEvaluator", "LifecycleTracker",
+    "Sampler", "Span", "Tracer", "diff_phase_tables", "flightrec",
+    "format_diff", "format_table", "phase_table", "tracer",
+    "validate_chrome_trace",
 ]

@@ -1,0 +1,210 @@
+"""Declarative SLO/health evaluator over the metrics registry.
+
+Each check reads a live signal (timer quantile, counter ratio) and
+compares it against warn/fail thresholds; the evaluator tracks per-check
+state transitions (pass -> warn -> fail -> recover), exports every state
+as a ``swarm_health{check="..."}`` gauge (0=pass, 1=warn, 2=fail), and
+notes every transition into the flight recorder so a post-mortem shows
+*when* a signal degraded, not just that it did.
+
+``/debug/health`` (utils/httpdebug) serves ``report()`` — pass/warn/fail
+per check plus the offending sample window from the flight recorder's
+time series — and returns HTTP 503 while any check is failing, so
+load-balancer/probe consumers need no JSON parsing.
+
+Checks with no data (a timer never observed, a counter never
+incremented) report ``pass`` with ``value: null`` — a fresh manager is
+healthy, not unknown-unhealthy.  Thresholds are constructor arguments;
+the defaults are sized for the production-shape bench (100k-task ticks
+well under a second of p99 budget).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..models import types as _types
+from ..utils.metrics import Registry
+from ..utils.metrics import registry as _default_registry
+from .flightrec import FlightRecorder, flightrec
+
+PASS, WARN, FAIL = "pass", "warn", "fail"
+_STATE_VALUE = {PASS: 0, WARN: 1, FAIL: 2}
+
+
+@dataclass
+class Check:
+    name: str
+    value: Callable[[Registry], Optional[float]]
+    warn: float
+    fail: float
+    unit: str = ""
+    #: sampler-row key prefixes relevant to this check — report() uses
+    #: them to attach the offending sample window from the recorder
+    window_prefixes: Tuple[str, ...] = field(default_factory=tuple)
+
+    def judge(self, v: Optional[float]) -> str:
+        if v is None:
+            return PASS
+        if v >= self.fail:
+            return FAIL
+        if v >= self.warn:
+            return WARN
+        return PASS
+
+
+# --------------------------------------------------------- value accessors
+
+def timer_p99(name: str) -> Callable[[Registry], Optional[float]]:
+    def get(reg: Registry) -> Optional[float]:
+        t = reg.get_timer(name)
+        if t is None or t.count == 0:
+            return None
+        return t.quantiles()[0.99]
+    return get
+
+
+def counter_ratio(numerator: str, denominators: Tuple[str, ...]
+                  ) -> Callable[[Registry], Optional[float]]:
+    """numerator / sum(denominators), None while the denominator is 0."""
+    def get(reg: Registry) -> Optional[float]:
+        total = sum(reg.get_counter(d) for d in denominators)
+        if total <= 0:
+            return None
+        return reg.get_counter(numerator) / total
+    return get
+
+
+_ROUTES = tuple(f'swarm_planner_groups{{route="{r}"}}'
+                for r in ("device", "fallback", "host_small", "spill"))
+
+
+def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
+                   edge_warn: float = 10.0, edge_fail: float = 60.0,
+                   fallback_warn: float = 0.1, fallback_fail: float = 0.5,
+                   propose_warn: float = 2.0, propose_fail: float = 10.0,
+                   hb_warn: float = 0.05, hb_fail: float = 0.25
+                   ) -> List[Check]:
+    return [
+        Check("tick_p99", timer_p99("swarm_scheduler_tick_latency"),
+              tick_warn, tick_fail, "s",
+              ("swarm_scheduler_",)),
+        Check("lifecycle_assign_p99",
+              timer_p99('swarm_task_lifecycle'
+                        '{from="pending",to="assigned"}'),
+              edge_warn, edge_fail, "s",
+              ("swarm_task_lifecycle",)),
+        Check("planner_fallback_rate",
+              counter_ratio('swarm_planner_groups{route="fallback"}',
+                            _ROUTES),
+              fallback_warn, fallback_fail, "ratio",
+              ("swarm_planner_",)),
+        Check("raft_propose_p99", timer_p99("swarm_raft_propose_latency"),
+              propose_warn, propose_fail, "s",
+              ("swarm_raft_",)),
+        Check("heartbeat_miss_rate",
+              counter_ratio("swarm_dispatcher_heartbeat_expirations",
+                            ("swarm_dispatcher_heartbeats",)),
+              hb_warn, hb_fail, "ratio",
+              ("swarm_dispatcher_heartbeat",)),
+    ]
+
+
+class HealthEvaluator:
+    def __init__(self, registry: Optional[Registry] = None,
+                 recorder: Optional[FlightRecorder] = None,
+                 checks: Optional[List[Check]] = None):
+        self.registry = registry or _default_registry
+        self.recorder = recorder or flightrec
+        self.checks = checks if checks is not None else default_checks()
+        self._state: Dict[str, str] = {}
+        self._value: Dict[str, Optional[float]] = {}
+        #: (t, check, old_state, new_state) history — a deque keeps the
+        #: NEWEST entries when it fills (the recent degradation is the
+        #: evidence /debug/health exists for, not the oldest one)
+        self.transitions: deque = deque(maxlen=256)
+
+    # ------------------------------------------------------------ evaluating
+
+    def evaluate(self) -> Dict[str, str]:
+        """Run every check once; returns {check: state}.  Exports
+        ``swarm_health{check=...}`` gauges and notes state changes to
+        the flight recorder."""
+        t = _types.now()
+        out: Dict[str, str] = {}
+        for c in self.checks:
+            try:
+                v = c.value(self.registry)
+            except Exception:
+                v = None
+            state = c.judge(v)
+            prev = self._state.get(c.name, PASS)
+            if state != prev:
+                self.transitions.append((t, c.name, prev, state))
+                self.recorder.note(
+                    f"health {c.name}: {prev} -> {state}"
+                    f" (value={v!r} warn={c.warn} fail={c.fail})")
+            self._state[c.name] = state
+            self._value[c.name] = v
+            self.registry.gauge(f'swarm_health{{check="{c.name}"}}',
+                                _STATE_VALUE[state])
+            out[c.name] = state
+        return out
+
+    def failing(self) -> bool:
+        return FAIL in self._state.values()
+
+    def status(self) -> str:
+        states = self._state.values()
+        if FAIL in states:
+            return FAIL
+        if WARN in states:
+            return WARN
+        return PASS
+
+    # --------------------------------------------------------------- report
+
+    def _window(self, prefixes: Tuple[str, ...], n: int = 10) -> list:
+        """The offending sample window: the recorder's most recent rows
+        trimmed to this check's metric families."""
+        rows = []
+        for row in self.recorder.samples.items()[-n:]:
+            keep = {}
+            for section in ("counters", "timer_counts", "timer_totals",
+                            "gauges"):
+                vals = row.get(section) or {}
+                hit = {k: v for k, v in vals.items()
+                       if any(k.startswith(p) for p in prefixes)}
+                if hit:
+                    keep[section] = hit
+            if keep:
+                keep["t"] = row.get("t")
+                rows.append(keep)
+        return rows
+
+    def report(self) -> Dict[str, object]:
+        self.evaluate()
+        checks = {}
+        for c in self.checks:
+            state = self._state[c.name]
+            entry: Dict[str, object] = {
+                "state": state,
+                "value": self._value[c.name],
+                "warn": c.warn, "fail": c.fail, "unit": c.unit,
+            }
+            if state != PASS:
+                entry["window"] = self._window(c.window_prefixes)
+            checks[c.name] = entry
+        return {
+            "status": self.status(),
+            "checks": checks,
+            "transitions": [
+                {"t": t, "check": name, "from": a, "to": b}
+                for t, name, a, b in list(self.transitions)[-32:]],
+        }
+
+
+# the default evaluator /debug/health and the Manager share
+evaluator = HealthEvaluator()

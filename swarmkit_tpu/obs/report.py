@@ -126,6 +126,52 @@ def format_table(table: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def diff_phase_tables(a: Dict[str, Any], b: Dict[str, Any]
+                      ) -> Dict[str, Any]:
+    """Side-by-side diff of two ``phase_table`` results (A = baseline,
+    B = candidate).  ``delta_pct`` is B vs A on total_s; None when A has
+    no time in that phase.  Shared by ``scripts/trace_report.py --diff``
+    and tests, so CLI output and assertions use one aggregation."""
+    names = sorted(set(a.get("phases", {})) | set(b.get("phases", {})))
+    rows = []
+    for n in names:
+        ra = a.get("phases", {}).get(n) or {"count": 0, "total_s": 0.0}
+        rb = b.get("phases", {}).get(n) or {"count": 0, "total_s": 0.0}
+        ta, tb = ra["total_s"], rb["total_s"]
+        rows.append({
+            "phase": n,
+            "a_count": ra["count"], "b_count": rb["count"],
+            "a_total_s": ta, "b_total_s": tb,
+            "delta_pct": round((tb - ta) / ta * 100.0, 1) if ta else None,
+        })
+    summary = {}
+    for key in ("plan_wall_s", "commit_wall_s", "plan_commit_overlap_s",
+                "plan_hidden_frac"):
+        summary[key] = (a.get(key, 0.0), b.get(key, 0.0))
+    return {"rows": rows, "summary": summary}
+
+
+def format_diff(diff: Dict[str, Any]) -> str:
+    lines = [f"{'phase':<28} {'A cnt':>7} {'B cnt':>7} "
+             f"{'A total_s':>12} {'B total_s':>12} {'delta':>8}"]
+    for row in diff["rows"]:
+        d = row["delta_pct"]
+        if d is not None:
+            delta = f"{d:+.1f}%"
+        elif row["b_total_s"] and not row["a_total_s"]:
+            delta = "new"
+        else:
+            delta = "="
+        lines.append(
+            f"{row['phase']:<28} {row['a_count']:>7} {row['b_count']:>7} "
+            f"{row['a_total_s']:>12.6f} {row['b_total_s']:>12.6f} "
+            f"{delta:>8}")
+    lines.append("")
+    for key, (va, vb) in diff["summary"].items():
+        lines.append(f"{key:<22}: {va:.6f} -> {vb:.6f}")
+    return "\n".join(lines)
+
+
 def validate_chrome_trace(doc: Any) -> List[str]:
     """Schema-validate a Chrome trace-event document.  Returns a list of
     problems (empty = valid)."""

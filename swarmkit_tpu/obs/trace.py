@@ -99,6 +99,11 @@ class Tracer:
         # later still governs this tracer)
         self._clock = clock
         self.enabled = False
+        # optional tap: called with every ended span (even ones the
+        # bounded buffer dropped) — the flight recorder's black box
+        # installs itself here.  Process-wide, so save/restore_state
+        # deliberately leaves it alone.
+        self.sink = None
         self.max_spans = max_spans
         self._lock = threading.Lock()
         self._spans: List[Span] = []
@@ -193,10 +198,42 @@ class Tracer:
                 # previous recording session — exporting it would yield
                 # a negative timestamp
                 self.dropped += 1
+                return
             elif len(self._spans) < self.max_spans:
                 self._spans.append(sp)
             else:
                 self.dropped += 1
+        sink = self.sink
+        if sink is not None:
+            sink(sp)
+
+    def record_complete(self, name: str, cat: str = "",
+                        duration: float = 0.0, **args) -> Optional[Span]:
+        """Record an already-measured span ending *now* — for events the
+        caller only recognizes after timing them (an XLA compile is
+        detected by a jit-cache-size delta once the call returns).  The
+        span parents under the innermost open span on this thread, so a
+        retroactive ``plan.compile`` nests inside ``plan.dispatch``."""
+        if not self.enabled:
+            return None
+        end = self._now()
+        start = max(self.epoch, end - max(0.0, duration))
+        stack = getattr(self._local, "stack", None)
+        parent = stack[-1].span_id if stack else 0
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+            sp = Span(name, cat, start, sid, parent,
+                      threading.current_thread().name, args or None)
+            sp.end = end
+            if len(self._spans) < self.max_spans:
+                self._spans.append(sp)
+            else:
+                self.dropped += 1
+        sink = self.sink
+        if sink is not None:
+            sink(sp)
+        return sp
 
     # --------------------------------------------------------------- export
 
@@ -269,3 +306,9 @@ class Tracer:
 
 # the process-wide tracer every instrumented component records into
 tracer = Tracer()
+
+# the flight recorder taps every ended span (cheap: one attribute check
+# while the recorder is disabled)
+from .flightrec import flightrec as _flightrec  # noqa: E402  (cycle-free)
+
+tracer.sink = _flightrec.record_span

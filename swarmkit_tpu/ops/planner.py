@@ -48,12 +48,50 @@ from .kernel import GroupInputs, K_CLAMP, NodeInputs, plan_group_jit
 
 log = logging.getLogger("tpu-planner")
 
-# cached Timer reference (Registry.reset() resets in place)
+# cached Timer references (Registry.reset() resets in place)
 _PLAN_TIMER = _metrics.timer("swarm_planner_plan_latency")
+_COMPILE_TIMER = _metrics.timer("swarm_planner_compile_latency")
 
 # static shape buckets to bound recompiles
 _CC_BUCKETS = (1, 4, 16)      # constraint slots
 _P_BUCKETS = (1, 4)           # platform slots
+
+
+def _jit_cache_size(fn) -> Optional[int]:
+    """Compiled-signature count of a jitted callable, or None when the
+    runtime does not expose it (then compile detection is off rather
+    than guessed — the whole point is observation, not inference)."""
+    cache_size = getattr(fn, "_cache_size", None)
+    if cache_size is None:
+        return None
+    try:
+        return cache_size()
+    except Exception:
+        return None
+
+
+def _bucket_label(nodes_in, group_in, L: int, hier) -> str:
+    """Stable name for one static jit signature: node bucket, constraint
+    slots, platform slots, spread leaf bucket, spread depth.  Bounded
+    cardinality — every component comes from a fixed bucket ladder."""
+    depth = len(hier[0]) + 1 if hier else 0
+    return (f"nb{nodes_in.valid.shape[0]}_cc{group_in.con_hash.shape[0]}"
+            f"_p{group_in.plat.shape[0]}_L{L}_h{depth}")
+
+
+def _observe_compile(fn, bucket: str, cache_before: Optional[int],
+                     dt: float) -> None:
+    """Count an XLA cache miss when the jit cache grew across one call:
+    a ``swarm_planner_compiles{bucket=...}`` counter tick, a compile
+    timer observation, and a retroactive ``plan.compile`` span — the
+    explanation trail for ``shape_cost_x``/bench variance swings."""
+    after = _jit_cache_size(fn)
+    if cache_before is None or after is None or after <= cache_before:
+        return
+    _metrics.counter(f'swarm_planner_compiles{{bucket="{bucket}"}}',
+                     after - cache_before)
+    _COMPILE_TIMER.observe(dt)
+    tracer.record_complete("plan.compile", "plan", dt, bucket=bucket)
 
 
 def _bucket(n: int, buckets) -> Optional[int]:
@@ -176,6 +214,19 @@ class TPUPlanner:
         self.stats["plan_seconds"] += dt
         _PLAN_TIMER.observe(dt)
 
+    def _call_plan_fn(self, nodes_in, group_in, L, hier):
+        """Every device-plan dispatch goes through here so XLA cache
+        misses are *observed* per static shape bucket (jit cache-size
+        delta around the call), not inferred from timing swings."""
+        import time as _time
+        bucket = _bucket_label(nodes_in, group_in, L, hier)
+        before = _jit_cache_size(self._plan_fn)
+        t0 = _time.perf_counter()
+        out = self._plan_fn(nodes_in, group_in, L, hier)
+        _observe_compile(self._plan_fn, bucket, before,
+                         _time.perf_counter() - t0)
+        return out
+
     # ------------------------------------------------------- per-tick caching
 
     def begin_tick(self, sched) -> None:
@@ -294,9 +345,9 @@ class TPUPlanner:
             return
         nodes_in, group_in = _probe_inputs()
         try:
-            _jax.device_get(self._plan_fn(nodes_in, group_in, 1, ()))
+            _jax.device_get(self._call_plan_fn(nodes_in, group_in, 1, ()))
             t0 = _time.perf_counter()
-            _jax.device_get(self._plan_fn(nodes_in, group_in, 1, ()))
+            _jax.device_get(self._call_plan_fn(nodes_in, group_in, 1, ()))
             self._launch_overhead = _time.perf_counter() - t0
             # only successful measurements are shared: caching a failed
             # probe (0.0) would poison every future planner's break-even
@@ -680,8 +731,14 @@ class TPUPlanner:
 
         import jax as _jax
         with tracer.span("plan.feasibility", "plan", tasks=len(tasks)):
+            _feas_bucket = "feas_" + _bucket_label(nodes_in, group_in,
+                                                   1, ())
+            _cache_before = _jit_cache_size(feasibility_jit)
+            _feas_t0 = _time.perf_counter()
             mask, cap, _ = _jax.device_get(
                 feasibility_jit(nodes_in, group_in))
+            _observe_compile(feasibility_jit, _feas_bucket, _cache_before,
+                             _time.perf_counter() - _feas_t0)
         col = {info.node.id: i for i, info in enumerate(infos)}
 
         items = []      # (task_id, task) admitted
@@ -718,8 +775,8 @@ class TPUPlanner:
         k = len(task_group)
         import jax as _jax
         with tracer.span("plan.dispatch", "plan", tasks=k):
-            x, fail_counts, spill = self._plan_fn(nodes_in, group_in, L,
-                                                  hier)
+            x, fail_counts, spill = self._call_plan_fn(nodes_in, group_in,
+                                                       L, hier)
         # one round-trip for all outputs: D2H latency dominates over
         # tunneled links, so never fetch twice
         with tracer.span("plan.d2h", "plan"):

@@ -73,6 +73,10 @@ def main(argv=None) -> int:
                 for r in reports],
             "failures": [
                 {"seed": r.seed, "violations": r.violations,
+                 # the black box: spans/samples/store events/raft
+                 # transitions around the violation, sha-stable per seed
+                 "flightrec": r.flightrec_path,
+                 "flightrec_sha256": r.flightrec_sha256,
                  "reproduce": f"python -m swarmkit_tpu.sim --seed "
                               f"{r.seed} --scenario random-fuzz"}
                 for r in bad],

@@ -76,8 +76,14 @@ class SimManager:
         self._schedule_tick()
 
     def _new_core(self) -> RaftCore:
-        return RaftCore(self.id, self.peers, rng=self.engine.fork_rng(),
+        core = RaftCore(self.id, self.peers, rng=self.engine.fork_rng(),
                         prevote=True)
+        # role transitions land in the flight recorder under virtual
+        # time — part of the deterministic post-mortem a failing seed
+        # dumps (scenario.run_scenario)
+        from ..obs.flightrec import flightrec
+        core.on_transition = flightrec.record_raft
+        return core
 
     # ------------------------------------------------------------ event loop
 

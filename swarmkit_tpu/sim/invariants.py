@@ -46,6 +46,10 @@ class Violations:
         line = f"INVARIANT {name}: {msg}"
         self.engine.log(line)
         self.items.append(f"t={self.engine.clock.elapsed():.3f} {line}")
+        # mark the black box too: the post-mortem dump shows the
+        # violation in context (surrounding spans/events), not alone
+        from ..obs.flightrec import flightrec
+        flightrec.note(line)
 
 
 def entry_digest(data: bytes) -> str:

@@ -15,9 +15,12 @@ its entire fault timeline from the seed.
 from __future__ import annotations
 
 import hashlib
+import os
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
 
+from ..obs.flightrec import flightrec
+from ..obs.sampler import Sampler
 from ..obs.trace import tracer
 from .cluster import Sim
 from .faults import NetConfig
@@ -38,6 +41,11 @@ class SimReport:
     # (obs.tracer spans); byte-identical for a given (scenario, seed)
     obs_trace: str = ""
     obs_trace_sha256: str = ""   # computed once in __post_init__
+    # flight-recorder post-mortem, written automatically when the run
+    # ends with invariant violations; sha is a pure function of the
+    # seed (virtual timestamps, delta-based samples)
+    flightrec_path: str = ""
+    flightrec_sha256: str = ""
 
     def __post_init__(self) -> None:
         if self.obs_trace and not self.obs_trace_sha256:
@@ -45,13 +53,17 @@ class SimReport:
                 self.obs_trace.encode()).hexdigest()
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "scenario": self.scenario, "seed": self.seed,
             "duration_virtual_s": self.duration, "events": self.events,
             "trace_hash": self.trace_hash,
             "obs_trace_sha256": self.obs_trace_sha256, "ok": self.ok,
             "violations": self.violations, "stats": self.stats,
         }
+        if self.flightrec_path:
+            out["flightrec_path"] = self.flightrec_path
+            out["flightrec_sha256"] = self.flightrec_sha256
+        return out
 
 
 # --------------------------------------------------------------- scenarios
@@ -347,7 +359,8 @@ SCENARIOS: Dict[str, Callable[[Sim], float]] = {
 
 def run_scenario(name: str, seed: int, n_managers: int = 3,
                  n_agents: int = 5, grace: float = 20.0,
-                 keep_trace: bool = False) -> SimReport:
+                 keep_trace: bool = False,
+                 flightrec_dir: Optional[str] = None) -> SimReport:
     try:
         fn = SCENARIOS[name]
     except KeyError:
@@ -367,17 +380,49 @@ def run_scenario(name: str, seed: int, n_managers: int = 3,
         # run sims from the CLI or tests, not inside a live traced
         # manager process.
         saved = tracer.save_state()
+        fr_saved = flightrec.save_state()
         tracer.reset()
         tracer.enable()
+        # the black box records continuously under virtual time:
+        # spans (tracer sink), store events, raft transitions, and
+        # periodic metric samples (deltas, so concurrent-process
+        # history cannot leak into the capture).  A violating or
+        # crashing run dumps it as a post-mortem whose sha is a pure
+        # function of the seed.
+        flightrec.reset(deterministic=True)
+        flightrec.enabled = True
+        flightrec.watch_store(sim.cp.store)
+        sampler = Sampler(deterministic=True)
+
+        def _sample():
+            if sim.cp.stopped:
+                return False
+            flightrec.poll_store()
+            sampler.sample()
+            return None
+
+        sim.engine.every(5.0, "flightrec sample", _sample)
+        fr_path = fr_sha = ""
+        crashed = False
         try:
             sim.engine.log(f"scenario {name} seed {seed}")
             duration = fn(sim)
             sim.run(duration)
             sim.finish(grace=grace)
             stats = sim.stats()
+        except BaseException as e:
+            crashed = True
+            flightrec.note(f"scenario crashed: {type(e).__name__}: {e}")
+            raise
         finally:
             tracer.disable()
             obs_trace = tracer.to_json()
+            if crashed or sim.violations.items:
+                fr_path, fr_sha = _dump_flightrec(name, seed,
+                                                  flightrec_dir)
+            flightrec.enabled = False
+            flightrec.unwatch_store(sim.cp.store)   # only the sim's tap
+            flightrec.restore_state(fr_saved)
             tracer.restore_state(saved)
     return SimReport(
         scenario=name, seed=seed, duration=duration + grace,
@@ -385,4 +430,18 @@ def run_scenario(name: str, seed: int, n_managers: int = 3,
         ok=not sim.violations.items,
         violations=list(sim.violations.items), stats=stats,
         trace=list(sim.engine.trace) if keep_trace else [],
-        obs_trace=obs_trace)
+        obs_trace=obs_trace, flightrec_path=fr_path,
+        flightrec_sha256=fr_sha)
+
+
+def _dump_flightrec(name: str, seed: int,
+                    flightrec_dir: Optional[str]) -> tuple:
+    """Write the post-mortem (``flightrec_<scenario>_seed<N>.json``) in
+    ``flightrec_dir`` (default: $SWARM_SIM_FLIGHTREC_DIR, else cwd)."""
+    d = flightrec_dir or os.environ.get("SWARM_SIM_FLIGHTREC_DIR") or "."
+    path = os.path.join(d, f"flightrec_{name}_seed{seed}.json")
+    try:
+        sha = flightrec.dump(path)
+    except OSError:
+        return "", ""
+    return path, sha

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -125,6 +125,12 @@ class RaftCore:
         self.voted_for = ""
         self.role = FOLLOWER
         self.leader_id = ""
+        # observability tap: called as (member_id, role, term) on every
+        # role transition.  The core stays sans-IO — embedders (RaftNode,
+        # the sim's SimManager) point this at the flight recorder; the
+        # callback must be non-throwing and side-effect-free w.r.t.
+        # consensus state.
+        self.on_transition: Optional[Callable[[str, str, int], None]] = None
 
         # log[0] corresponds to index snap_index+1
         self.log: List[Entry] = []
@@ -278,6 +284,7 @@ class RaftCore:
     # ------------------------------------------------------------ transitions
 
     def _become_follower(self, term: int, leader: str = "") -> None:
+        role_changed = self.role != FOLLOWER
         if term > self.term:
             self.term = term
             self.voted_for = ""
@@ -287,6 +294,11 @@ class RaftCore:
         self._in_prevote = False
         self._elapsed = 0
         self._timeout = self._rand_timeout()
+        # only genuine role changes reach the tap: this path also runs
+        # for term bumps while already a follower (every higher-term
+        # message), which would flood the bounded raft ring
+        if role_changed and self.on_transition is not None:
+            self.on_transition(self.id, self.role, self.term)
 
     def _become_candidate(self) -> None:
         self.term += 1
@@ -298,6 +310,8 @@ class RaftCore:
         self._votes = {self.id: True}
         self._elapsed = 0
         self._timeout = self._rand_timeout()
+        if self.on_transition is not None:
+            self.on_transition(self.id, self.role, self.term)
 
     def _become_leader(self) -> None:
         self.role = LEADER
@@ -313,6 +327,8 @@ class RaftCore:
         self._append(Entry(term=self.term, index=last + 1,
                            type=ENTRY_NOOP))
         self.noop_index = last + 1
+        if self.on_transition is not None:
+            self.on_transition(self.id, self.role, self.term)
         self._broadcast_append()
 
     @property
@@ -493,6 +509,10 @@ class RaftCore:
             self._msgs.append(Message(type="app_resp", term=self.term,
                                       src=self.id, dst=m.src, success=False))
             return
+        if self.role != FOLLOWER and self.on_transition is not None:
+            # only genuine role changes reach the tap — this runs on
+            # every heartbeat, and a steady-state follower is not news
+            self.on_transition(self.id, FOLLOWER, self.term)
         self.role = FOLLOWER
         self.leader_id = m.src
         self._elapsed = 0
@@ -553,6 +573,8 @@ class RaftCore:
     def _on_snapshot(self, m: Message) -> None:
         if m.term < self.term or m.snapshot is None:
             return
+        if self.role != FOLLOWER and self.on_transition is not None:
+            self.on_transition(self.id, FOLLOWER, self.term)
         self.role = FOLLOWER
         self.leader_id = m.src
         self._elapsed = 0

@@ -80,6 +80,10 @@ class RaftNode(Proposer):
         self.tick_interval = (tick_interval if tick_interval is not None
                               else self.TICK_INTERVAL)
         self.core = RaftCore(node_id, peers)
+        # black-box the role history: every transition (with term) lands
+        # in the flight recorder's bounded ring for post-mortems
+        from ...obs.flightrec import flightrec
+        self.core.on_transition = flightrec.record_raft
 
         self._inbox: "queue.Queue" = queue.Queue()
         self._waiters: Dict[int, _Waiter] = {}

@@ -1,21 +1,31 @@
-"""Operator observability HTTP listener: /metrics, /healthz,
-/debug/stacks, /debug/trace.
+"""Operator observability HTTP listener: /metrics, /healthz, and the
+/debug/* family (stacks, trace, health, flightrec).
 
 Reference: swarmd/cmd/swarmd/main.go:92-97 (--listen-metrics serving
 Prometheus metrics, --listen-debug serving pprof).  The stacks endpoint
 is the Python analogue of a goroutine dump (the reference's integration
 tests rely on exactly that for diagnosis).
+
+Endpoints register into a table (path -> handler + description) so ``/``
+serves a discoverable index and embedders can add their own via
+``register()``.  ``/debug/health`` returns 503 while any SLO check is
+failing, so load balancers and probes can consume it without parsing.
 """
 
 from __future__ import annotations
 
 import http.server
+import json
 import sys
 import threading
 import traceback
-from typing import Callable, Optional, Tuple
+import urllib.parse
+from typing import Callable, Dict, Optional, Tuple
 
 from .metrics import registry
+
+# handler(query: {k: [v, ...]}) -> (body bytes, status code, content type)
+Handler = Callable[[Dict[str, list]], Tuple[bytes, int, str]]
 
 
 def _all_stacks() -> str:
@@ -36,8 +46,16 @@ class DebugServer:
     protected interface, like the reference's --listen-metrics)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 health: Optional[Callable[[], str]] = None):
+                 health: Optional[Callable[[], str]] = None,
+                 health_evaluator=None):
         self.health = health or (lambda: "SERVING")
+        # the SLO evaluator behind /debug/health; defaults to the shared
+        # obs.health singleton (late-bound so importing this module never
+        # pulls the obs package in)
+        self._evaluator = health_evaluator
+        #: path -> (description, handler); see register()
+        self.endpoints: Dict[str, Tuple[str, Handler]] = {}
+        self._register_builtins()
         outer = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -45,41 +63,7 @@ class DebugServer:
                 pass
 
             def do_GET(self):
-                if self.path == "/metrics":
-                    body = registry.expose().encode()
-                    code, ctype = 200, "text/plain; version=0.0.4"
-                elif self.path == "/healthz":
-                    status = outer.health()
-                    body = (status + "\n").encode()
-                    code = 200 if status == "SERVING" else 503
-                    ctype = "text/plain"
-                elif self.path == "/debug/stacks":
-                    body = _all_stacks().encode()
-                    code, ctype = 200, "text/plain"
-                elif self.path == "/debug/trace":
-                    # Chrome trace-event JSON of the process tracer —
-                    # load in chrome://tracing or ui.perfetto.dev.
-                    # GET ?enable=1 / ?enable=0 toggles recording.
-                    from ..obs.trace import tracer
-                    body = tracer.to_json().encode()
-                    code, ctype = 200, "application/json"
-                elif self.path.startswith("/debug/trace?enable="):
-                    from ..obs.trace import tracer
-                    value = self.path.split("=", 1)[1].lower()
-                    if value in ("1", "true", "on", "yes"):
-                        tracer.reset()
-                        tracer.enable()
-                        body, code = b"tracing enabled\n", 200
-                    elif value in ("0", "false", "off", "no"):
-                        tracer.disable()
-                        body, code = b"tracing disabled\n", 200
-                    else:
-                        body = (f"bad enable value {value!r}; use 1/0\n"
-                                .encode())
-                        code = 400
-                    ctype = "text/plain"
-                else:
-                    body, code, ctype = b"not found\n", 404, "text/plain"
+                body, code, ctype = outer._dispatch(self.path)
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -93,6 +77,107 @@ class DebugServer:
         self._server = _Server((host, port), _Handler)
         self.addr = self._server.server_address
         self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- endpoints
+
+    def register(self, path: str, handler: Handler,
+                 description: str) -> None:
+        """Add/replace an endpoint; it appears on the ``/`` index."""
+        self.endpoints[path] = (description, handler)
+
+    def _register_builtins(self) -> None:
+        self.register("/metrics", self._h_metrics,
+                      "Prometheus text exposition of the process registry")
+        self.register("/healthz", self._h_healthz,
+                      "liveness probe: SERVING (200) or NOT_SERVING (503)")
+        self.register("/debug/stacks", self._h_stacks,
+                      "stack dump of every live thread")
+        self.register("/debug/trace", self._h_trace,
+                      "Chrome trace-event JSON of the span tracer "
+                      "(?enable=1/0 toggles recording)")
+        self.register("/debug/health", self._h_health,
+                      "SLO check report (JSON); 503 while any check "
+                      "is failing")
+        self.register("/debug/flightrec", self._h_flightrec,
+                      "flight-recorder post-mortem dump (JSON): recent "
+                      "spans, metric samples, store events, raft "
+                      "transitions")
+
+    def _dispatch(self, raw_path: str) -> Tuple[bytes, int, str]:
+        parts = urllib.parse.urlsplit(raw_path)
+        path = parts.path
+        # keep blanks: "?enable=" must reach the handler (and 400)
+        # rather than silently degrade to the no-query behavior
+        query = urllib.parse.parse_qs(parts.query,
+                                      keep_blank_values=True)
+        if path in ("", "/"):
+            return self._h_index(query)
+        entry = self.endpoints.get(path)
+        if entry is None:
+            return b"not found\n", 404, "text/plain"
+        try:
+            return entry[1](query)
+        except Exception as e:   # an endpoint must never kill the server
+            return (f"endpoint error: {e!r}\n".encode(), 500,
+                    "text/plain")
+
+    # -------------------------------------------------------------- handlers
+
+    def _h_index(self, query) -> Tuple[bytes, int, str]:
+        width = max(len(p) for p in self.endpoints)
+        lines = ["swarmkit-tpu debug endpoints:", ""]
+        for path in sorted(self.endpoints):
+            desc, _ = self.endpoints[path]
+            lines.append(f"  {path:<{width}}  {desc}")
+        return ("\n".join(lines) + "\n").encode(), 200, "text/plain"
+
+    def _h_metrics(self, query) -> Tuple[bytes, int, str]:
+        return (registry.expose().encode(), 200,
+                "text/plain; version=0.0.4")
+
+    def _h_healthz(self, query) -> Tuple[bytes, int, str]:
+        status = self.health()
+        return ((status + "\n").encode(),
+                200 if status == "SERVING" else 503, "text/plain")
+
+    def _h_stacks(self, query) -> Tuple[bytes, int, str]:
+        return _all_stacks().encode(), 200, "text/plain"
+
+    def _h_trace(self, query) -> Tuple[bytes, int, str]:
+        from ..obs.trace import tracer
+        enable = query.get("enable")
+        if enable:
+            value = enable[0].lower()
+            if value in ("1", "true", "on", "yes"):
+                tracer.reset()
+                tracer.enable()
+                return b"tracing enabled\n", 200, "text/plain"
+            if value in ("0", "false", "off", "no"):
+                tracer.disable()
+                return b"tracing disabled\n", 200, "text/plain"
+            return (f"bad enable value {value!r}; use 1/0\n".encode(),
+                    400, "text/plain")
+        return tracer.to_json().encode(), 200, "application/json"
+
+    def _get_evaluator(self):
+        if self._evaluator is None:
+            from ..obs.health import evaluator
+            self._evaluator = evaluator
+        return self._evaluator
+
+    def _h_health(self, query) -> Tuple[bytes, int, str]:
+        ev = self._get_evaluator()
+        report = ev.report()
+        # probes consume the status code; humans the JSON body
+        code = 503 if report["status"] == "fail" else 200
+        body = json.dumps(report, sort_keys=True, indent=1).encode()
+        return body, code, "application/json"
+
+    def _h_flightrec(self, query) -> Tuple[bytes, int, str]:
+        from ..obs.flightrec import flightrec
+        return flightrec.dump_json().encode(), 200, "application/json"
+
+    # ------------------------------------------------------------- lifecycle
 
     def start(self) -> None:
         self._thread = threading.Thread(

@@ -107,6 +107,23 @@ class Registry:
             return {k: v for k, v in self.counters.items()
                     if k.startswith(prefix)}
 
+    def gauges_snapshot(self, prefix: str = "") -> Dict[str, float]:
+        with self._lock:
+            return {k: v for k, v in self.gauges.items()
+                    if k.startswith(prefix)}
+
+    def timers_snapshot(self, prefix: str = "") -> Dict[str, Timer]:
+        """Name -> live Timer references (the objects are stable across
+        ``reset()``); consumers read .count/.total/.quantiles() without
+        touching this registry's lock protocol."""
+        with self._lock:
+            return {k: t for k, t in self.timers.items()
+                    if k.startswith(prefix)}
+
+    def get_timer(self, name: str) -> Optional[Timer]:
+        with self._lock:
+            return self.timers.get(name)
+
     def reset(self) -> None:
         """Zero all counters/gauges and reset timers IN PLACE — components
         hold Timer references from ``timer(name)``, so the objects must

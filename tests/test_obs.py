@@ -310,6 +310,10 @@ def test_bench_tiny_config_emits_valid_trace(tmp_path, monkeypatch,
     and the artifact's phase table reflects the trace's per-phase spans."""
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     trace_out = str(tmp_path / "trace.json")
+    history_out = str(tmp_path / "history.jsonl")
+    monkeypatch.setenv("BENCH_HISTORY", history_out)
+    monkeypatch.setenv("BENCH_FLIGHTREC_OUT",
+                       str(tmp_path / "flightrec.json"))
     monkeypatch.setenv("BENCH_NODES", "64")
     # large enough that the adaptive router always amortizes a device
     # round-trip (4096 tasks ≈ 200ms of host-path cost vs a launch
@@ -330,7 +334,8 @@ def test_bench_tiny_config_emits_valid_trace(tmp_path, monkeypatch,
         # leave the module with default constants for any later importer
         for k in ("BENCH_NODES", "BENCH_TASKS", "BENCH_TRIALS",
                   "BENCH_SKIP_HOST", "BENCH_SKIP_CONFIGS",
-                  "BENCH_SKIP_E2E", "BENCH_TRACE_OUT"):
+                  "BENCH_SKIP_E2E", "BENCH_TRACE_OUT", "BENCH_HISTORY",
+                  "BENCH_FLIGHTREC_OUT"):
             monkeypatch.delenv(k, raising=False)
         importlib.reload(bench)
 
@@ -358,3 +363,22 @@ def test_bench_tiny_config_emits_valid_trace(tmp_path, monkeypatch,
     assert "overhead_pct" in artifact["obs"]
     assert artifact["obs"]["enabled_decisions_per_sec"] > 0
     assert artifact["obs"]["disabled_decisions_per_sec"] > 0
+
+    # compile observability: the artifact names every jit bucket the
+    # headline touched, and — warm-up done — none recompiled inside the
+    # timed region (a nonzero count here IS the r4/r5 variance story)
+    compiles = artifact["planner_compiles"]
+    assert isinstance(compiles, dict) and compiles
+    assert all(v == 0 for v in compiles.values()), compiles
+
+    # health plane: a clean tiny-bench run reports every check passing
+    assert artifact["health"]["status"] == "pass"
+    assert artifact["health"]["checks"]
+    assert all(s == "pass" for s in artifact["health"]["checks"].values())
+
+    # the run appended one history record bench_compare.py can diff
+    with open(history_out) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    assert len(records) == 1
+    assert records[0]["value"] == artifact["value"]
+    assert records[0]["health"] == "pass"
