@@ -54,6 +54,11 @@ N_TASKS = int(os.environ.get("BENCH_TASKS", 100_000))
 BASELINE_TASKS = int(os.environ.get("BENCH_BASELINE_TASKS", 5_000))
 SKIP_HOST = os.environ.get("BENCH_SKIP_HOST", "") == "1"
 SKIP_CONFIGS = os.environ.get("BENCH_SKIP_CONFIGS", "") == "1"
+# run only the named configs, e.g. BENCH_CONFIGS="4 6" (empty = all);
+# the headline always runs.  scripts/bench_repro.py uses this to repeat
+# the cfg6 bar cheaply.
+CONFIGS_ONLY = set(
+    os.environ.get("BENCH_CONFIGS", "").replace(",", " ").split())
 SKIP_E2E = os.environ.get("BENCH_SKIP_E2E", "") == "1"
 # skips the alternating on/off overhead pairs (2x TRIALS extra headline
 # trials); smoke/CI runs that don't read overhead_pct can turn it off
@@ -74,6 +79,12 @@ FLIGHTREC_OUT = os.environ.get("BENCH_FLIGHTREC_OUT",
 # every run appends its per-config summary here (bench_compare.py diffs
 # entries); set to "" to disable
 HISTORY_OUT = os.environ.get("BENCH_HISTORY", "BENCH_HISTORY.jsonl")
+
+
+def _cfg_enabled(n: int) -> bool:
+    if SKIP_CONFIGS:
+        return False
+    return not CONFIGS_ONLY or str(n) in CONFIGS_ONLY
 
 
 def _planner_counters():
@@ -118,7 +129,8 @@ def _compile_delta(snap):
 
 def build_cluster(n_nodes, n_tasks, node_labels=None, reservations=None,
                   constraints=None, platforms=None, prefs=None,
-                  node_platform=None, global_share=0.0, assigned_state=None):
+                  node_platform=None, global_share=0.0, assigned_state=None,
+                  n_services=1):
     from swarmkit_tpu.models import (
         Annotations, Node, NodeDescription, NodeSpec, NodeState, NodeStatus,
         Placement, Platform, ReplicatedService, Resources,
@@ -144,12 +156,6 @@ def build_cluster(n_nodes, n_tasks, node_labels=None, reservations=None,
                 hostname=f"node-{i:05d}", platform=platform,
                 resources=Resources(nano_cpus=64 * 10**9,
                                     memory_bytes=256 << 30))))
-    svc = Service(
-        id=new_id(),
-        spec=ServiceSpec(annotations=Annotations(name="bench"),
-                         mode=ServiceMode.REPLICATED,
-                         replicated=ReplicatedService(replicas=n_tasks)),
-        spec_version=Version(index=1))
     shared_spec = TaskSpec(
         placement=Placement(constraints=constraints or [],
                             platforms=platforms or [],
@@ -158,26 +164,42 @@ def build_cluster(n_nodes, n_tasks, node_labels=None, reservations=None,
             reservations=reservations
             or Resources(nano_cpus=10**8, memory_bytes=64 << 20)))
 
-    n_global = int(n_tasks * global_share)
+    # n_services > 1 splits the task count over distinct services: each
+    # becomes its own (service, spec-version) scheduling group, the unit
+    # the pipelined tick overlaps (plan group i+1 while committing i)
+    services = []
     tasks = []
-    for s in range(1, n_tasks + 1):
-        t = Task(id=new_id(), service_id=svc.id, slot=s,
-                 desired_state=TaskState.RUNNING, spec=shared_spec,
-                 spec_version=Version(index=1),
-                 status=TaskStatus(state=TaskState.PENDING))
-        if s <= n_global:
-            # global-service style: preassigned to a node
-            t.slot = 0
-            t.node_id = nodes[s % n_nodes].id
-        if assigned_state is not None and s > n_global:
-            t.node_id = nodes[s % n_nodes].id
-            t.status = TaskStatus(state=assigned_state)
-        tasks.append(t)
+    per = n_tasks // n_services
+    for si in range(n_services):
+        count = per if si < n_services - 1 else n_tasks - per * si
+        svc = Service(
+            id=new_id(),
+            spec=ServiceSpec(annotations=Annotations(name=f"bench-{si}"),
+                             mode=ServiceMode.REPLICATED,
+                             replicated=ReplicatedService(replicas=count)),
+            spec_version=Version(index=1))
+        services.append(svc)
+        n_global = int(count * global_share)
+        for s in range(1, count + 1):
+            t = Task(id=new_id(), service_id=svc.id, slot=s,
+                     desired_state=TaskState.RUNNING, spec=shared_spec,
+                     spec_version=Version(index=1),
+                     status=TaskStatus(state=TaskState.PENDING))
+            if s <= n_global:
+                # global-service style: preassigned to a node
+                t.slot = 0
+                t.node_id = nodes[s % n_nodes].id
+            if assigned_state is not None and s > n_global:
+                t.node_id = nodes[s % n_nodes].id
+                t.status = TaskStatus(state=assigned_state)
+            tasks.append(t)
+    svc = services[0]
 
     def create_nodes(tx):
         for n in nodes:
             tx.create(n)
-        tx.create(svc)
+        for s in services:
+            tx.create(s)
 
     store.update(create_nodes)
 
@@ -456,15 +478,23 @@ def run_storm(planner_factory):
     return out
 
 
-def run_live_manager(planner_factory, external_firehose=False):
-    """Config 6: config-4's shape (100k pending tasks x 10k nodes) in
-    PRODUCTION shape — a real single-voter raft proposer (on-disk WAL,
-    consensus apply path) attached to the store, plus the control
-    plane's subscriber mix (dispatcher sessions, orchestrator/reaper
-    loops, metrics collector — all in their real block-aware
-    subscription shapes, with live consumer threads).  Blocks ride one
-    compact TaskBlockAction per chunk through raft and publish one
-    coalesced EventTaskBlock.
+def run_live_manager(planner_factory, external_firehose=False,
+                     n_services=None):
+    """Config 6: config-4's scale (100k pending tasks x 10k nodes, one
+    such service per ``n_services``) in PRODUCTION shape — a real
+    single-voter raft proposer (on-disk WAL, consensus apply path)
+    attached to the store, plus the control plane's subscriber mix
+    (dispatcher sessions, orchestrator/reaper loops, metrics collector —
+    all in their real block-aware subscription shapes, with live
+    consumer threads).  Blocks ride one compact TaskBlockAction per
+    chunk through raft and publish one coalesced EventTaskBlock.
+
+    ``n_services`` (default 2, env BENCH_CFG6_SERVICES) services of
+    N_TASKS each schedule in ONE tick — the multi-group shape a live
+    manager actually carries, and the shape the pipelined scheduler
+    overlaps: group i+1's device plan computes while group i's chunks
+    ride raft (the tick's ``plan_hidden_frac`` is the headline overlap
+    evidence for ROADMAP item 1).
 
     ``external_firehose`` adds a watch-API-style client consuming EVERY
     task as a synthesized per-task event.  Synthesis runs on the
@@ -482,7 +512,11 @@ def run_live_manager(planner_factory, external_firehose=False):
     from swarmkit_tpu.state import match
     from swarmkit_tpu.state.raft import LocalNetwork, RaftLogger, RaftNode
 
-    store, svc, nodes, tasks = build_cluster(N_NODES, N_TASKS)
+    if n_services is None:
+        n_services = int(os.environ.get("BENCH_CFG6_SERVICES", 2))
+    total_tasks = N_TASKS * n_services
+    store, svc, nodes, tasks = build_cluster(N_NODES, total_tasks,
+                                             n_services=n_services)
     tmp = tempfile.mkdtemp(prefix="bench-raft-")
     rn = RaftNode("b0", ["b0"], store,
                   RaftLogger(os.path.join(tmp, "b0")), LocalNetwork())
@@ -589,8 +623,8 @@ def run_live_manager(planner_factory, external_firehose=False):
         n_assigned = sum(
             1 for t in store.view(lambda tx: tx.find(_Task))
             if t.status.state >= TaskState.ASSIGNED and t.node_id)
-        assert n_assigned >= N_TASKS, \
-            f"live-manager: only {n_assigned}/{N_TASKS} ASSIGNED"
+        assert n_assigned >= total_tasks, \
+            f"live-manager: only {n_assigned}/{total_tasks} ASSIGNED"
         # the metrics histogram must balance, and when the firehose
         # client is attached every decision must reach it as a per-task
         # synthesized event
@@ -599,7 +633,9 @@ def run_live_manager(planner_factory, external_firehose=False):
         if external_firehose:
             assert counts["external_watch"] >= n_dec, counts
         return {
-            "nodes": N_NODES, "tasks": N_TASKS,
+            "nodes": N_NODES, "tasks": total_tasks,
+            "services": n_services,
+            "pipeline_depth": sched.pipeline_depth,
             "decisions": n_dec,
             "decisions_per_sec": round(n_dec / dt, 1),
             "tick_s": round(dt, 3),
@@ -630,9 +666,15 @@ def run_e2e(n_agents=5, n_replicas=500):
     from swarmkit_tpu.manager.dispatcher import Config_
     from swarmkit_tpu.models import TaskState
 
-    mgr = Manager(dispatcher_config=Config_(
-        heartbeat_period=2.0, process_updates_interval=0.05,
-        assignment_batching_wait=0.05))
+    try:
+        mgr = Manager(dispatcher_config=Config_(
+            heartbeat_period=2.0, process_updates_interval=0.05,
+            assignment_batching_wait=0.05))
+    except ImportError as e:
+        # image without the `cryptography` package (ROADMAP env note):
+        # the manager's CA bootstrap is unavailable — report instead of
+        # failing the whole bench artifact
+        return {"error": f"skipped: {e}"}
     mgr.run()
     agents = []
     try:
@@ -713,8 +755,12 @@ def main():
     rack_pref = [PlacementPreference(
         spread=SpreadOver(spread_descriptor="node.labels.rack"))]
     warm = [(N_NODES, None)]
-    if not SKIP_CONFIGS:
-        warm += [(100, None), (5_000, None), (N_NODES, rack_pref)]
+    if _cfg_enabled(1):
+        warm += [(100, None)]
+    if _cfg_enabled(3):
+        warm += [(5_000, None)]
+    if _cfg_enabled(4):
+        warm += [(N_NODES, rack_pref)]
     for n_nodes, prefs in warm:
         store, svc, nodes, tasks = build_cluster(
             n_nodes, 64, prefs=prefs)
@@ -725,7 +771,7 @@ def main():
     # shape on first use — warm it here or the FIRST headline trial pays
     # a ~1s jit compile and p99 reports compile time, not scheduling
     TPUPlanner()._measure_launch_overhead()
-    if not SKIP_CONFIGS:
+    if _cfg_enabled(4):
         # warm the preassigned-validation kernel (global-service share of
         # config 4) at its node-bucket shape
         store, svc, nodes, tasks = build_cluster(
@@ -807,16 +853,18 @@ def main():
         vs = tpu_dps / host_dps
 
     configs = {}
-    if not SKIP_CONFIGS:
+    if _cfg_enabled(1):
         with tracer.span("bench.config", "bench", cfg="cfg1"):
             configs["1_spread_1k_x_100"] = run_config(
                 "cfg1", 100, 1_000, tpu,
                 reservations=Resources())
+    if _cfg_enabled(2):
         with tracer.span("bench.config", "bench", cfg="cfg2"):
             configs["2_binpack_10k_x_1k"] = run_config(
                 "cfg2", 1_000, 10_000, tpu,
                 reservations=Resources(nano_cpus=2 * 10**9,
                                        memory_bytes=2 << 30))
+    if _cfg_enabled(3):
         with tracer.span("bench.config", "bench", cfg="cfg3"):
             configs["3_constraints_50k_x_5k"] = run_config(
                 "cfg3", 5_000, 50_000, tpu,
@@ -828,6 +876,7 @@ def main():
                 constraints=["node.labels.tier==web"],
                 platforms=[Platform(os="linux", architecture="amd64")],
                 expect=50_000)
+    if _cfg_enabled(4):
         with tracer.span("bench.config", "bench", cfg="cfg4"):
             configs["4_mixed_100k_x_10k"] = run_config(
                 "cfg4", N_NODES, N_TASKS, tpu,
@@ -835,14 +884,17 @@ def main():
                     spread=SpreadOver(
                         spread_descriptor="node.labels.rack"))],
                 global_share=0.2)
+    if _cfg_enabled(5):
         with tracer.span("bench.config", "bench", cfg="cfg5"):
             configs["5_reschedule_storm"] = run_storm(tpu)
+    if _cfg_enabled(6):
         with tracer.span("bench.config", "bench", cfg="cfg6"):
-            configs["6_live_manager_100k_x_10k"] = run_live_manager(tpu)
-        live = configs["6_live_manager_100k_x_10k"]["decisions_per_sec"]
-        # production-shape cost factor: the same 100k x 10k tick vs the
-        # lab-shape headline (no proposer/watchers); target <1.5x
-        configs["6_live_manager_100k_x_10k"]["shape_cost_x"] = round(
+            configs["6_live_manager_2x100k_x_10k"] = run_live_manager(tpu)
+        live = configs["6_live_manager_2x100k_x_10k"]["decisions_per_sec"]
+        # production-shape cost factor: per-decision rate of the live
+        # multi-service tick vs the lab-shape headline (no
+        # proposer/watchers); target <1.5x
+        configs["6_live_manager_2x100k_x_10k"]["shape_cost_x"] = round(
             tpu_dps / live, 2) if live else None
     if SKIP_E2E:
         e2e = None
@@ -864,6 +916,14 @@ def main():
     from swarmkit_tpu.obs.report import config_windows
     tables = {cfg: phase_table(doc, window=w)
               for cfg, w in config_windows(doc)}
+
+    # headline overlap evidence (ROADMAP item 1), promoted from the
+    # per-config phase_table: cfg6 — the production-shape pipelined
+    # tick — when it ran, else the headline window.  bench_compare
+    # fails a run whose overlap regressed to 0 with the pipeline on.
+    from swarmkit_tpu.utils.pipeline import default_pipeline_depth
+    overlap_src = "cfg6" if "cfg6" in tables else "headline"
+    overlap_tbl = tables.get(overlap_src, {})
 
     # health plane verdict over the finished run's registry: all-pass is
     # the clean-run baseline the acceptance criteria pin
@@ -898,6 +958,13 @@ def main():
         "trace_file": trace_file,
         # per-bucket XLA compiles inside the timed headline region
         "planner_compiles": headline_compiles,
+        # plan/commit software pipeline: configured depth + the overlap
+        # the trace actually measured (see overlap_src above)
+        "pipeline_depth": default_pipeline_depth(),
+        "plan_commit_overlap_s": overlap_tbl.get(
+            "plan_commit_overlap_s", 0.0),
+        "plan_hidden_frac": overlap_tbl.get("plan_hidden_frac", 0.0),
+        "plan_overlap_source": overlap_src,
         "health": health,
         "phase_table": tables,
         "configs": configs,
@@ -925,6 +992,10 @@ def _append_history(artifact):
         "obs_overhead_pct": (artifact["obs"] or {}).get("overhead_pct"),
         "health": artifact["health"]["status"],
         "planner_compiles": sum(artifact["planner_compiles"].values()),
+        "pipeline_depth": artifact["pipeline_depth"],
+        "plan_commit_overlap_s": artifact["plan_commit_overlap_s"],
+        "plan_hidden_frac": artifact["plan_hidden_frac"],
+        "plan_overlap_source": artifact["plan_overlap_source"],
         "configs": {
             name: {
                 "decisions_per_sec": cfg.get("decisions_per_sec"),

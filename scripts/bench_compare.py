@@ -38,6 +38,13 @@ def _norm(doc):
         "t": doc.get("t"),
         "health": (doc.get("health") or {}).get("status")
         if isinstance(doc.get("health"), dict) else doc.get("health"),
+        # plan/commit overlap evidence (artifacts and history records
+        # both carry these since the pipelined-scheduler PR; older runs
+        # report None and are exempt from the overlap gate)
+        "pipeline_depth": doc.get("pipeline_depth"),
+        "plan_hidden_frac": doc.get("plan_hidden_frac"),
+        "plan_commit_overlap_s": doc.get("plan_commit_overlap_s"),
+        "plan_overlap_source": doc.get("plan_overlap_source"),
     }
 
 
@@ -132,6 +139,29 @@ def main(argv=None) -> int:
         print(line)
     if old.get("health") or new.get("health"):
         print(f"\nhealth: {old.get('health')} -> {new.get('health')}")
+    # overlap gate: a run with the pipeline ON (depth > 1) whose
+    # plan/commit overlap collapsed to 0 lost the pipelining win even if
+    # raw throughput hasn't (yet) regressed past the threshold — fail it
+    # like any other regression.  The gate keys on the NEW run alone (a
+    # zero-overlap baseline must not disarm it), when the overlap was
+    # measured in a window where it is meaningful: the cfg6 multi-group
+    # tick always is; for source-less records (transitional) fall back
+    # to requiring the baseline to have shown overlap.  Runs predating
+    # the overlap fields or with the serial escape hatch are exempt —
+    # as are headline-window measurements (a single-group tick has no
+    # group to overlap with).
+    old_h, new_h = old.get("plan_hidden_frac"), new.get("plan_hidden_frac")
+    if old_h is not None or new_h is not None:
+        print(f"plan_hidden_frac: {old_h} -> {new_h} "
+              f"(pipeline depth {old.get('pipeline_depth')} -> "
+              f"{new.get('pipeline_depth')})")
+    src = new.get("plan_overlap_source")
+    meaningful = src == "cfg6" or (src is None and (old_h or 0.0) > 0.0)
+    if ((new.get("pipeline_depth") or 1) > 1 and new_h is not None
+            and not new_h and meaningful):
+        print("\nplan/commit overlap regressed to 0 with the pipeline "
+              "on", file=sys.stderr)
+        regressions.append("plan_hidden_frac")
     if regressions:
         print(f"\n{len(regressions)} config(s) regressed more than "
               f"{args.threshold * 100:.0f}%: {', '.join(regressions)}",

@@ -51,14 +51,19 @@ class Manager:
                  dispatcher_config: Optional[DispatcherConfig] = None,
                  use_device_scheduler: bool = True,
                  csi_plugins: Optional[dict] = None,
-                 secret_plugins: Optional[dict] = None):
+                 secret_plugins: Optional[dict] = None,
+                 scheduler_pipeline_depth: Optional[int] = None):
         """``raft_node``: a state.raft.RaftNode already wired as the
         store's proposer, or None for standalone single-manager mode.
         ``csi_plugins``: name -> CSIPlugin for the CSI controller manager
         (an in-memory plugin named "inmem" is always available).
         ``secret_plugins``: name -> endpoint URL or callable for
-        driver-backed secrets (reference: manager/drivers)."""
+        driver-backed secrets (reference: manager/drivers).
+        ``scheduler_pipeline_depth``: plan/commit pipeline depth for the
+        scheduler (None -> SWARM_PIPELINE_DEPTH, default 2; 1 = serial
+        escape hatch)."""
         self.node_id = node_id or new_id()
+        self._scheduler_pipeline_depth = scheduler_pipeline_depth
         self.raft = raft_node
         self.store = store if store is not None else (
             raft_node.store if raft_node is not None else MemoryStore())
@@ -329,7 +334,9 @@ class Manager:
             self.dispatcher.run()
             self.allocator = Allocator(self.store)
             planner = TPUPlanner() if self.use_device_scheduler else None
-            self.scheduler = Scheduler(self.store, batch_planner=planner)
+            self.scheduler = Scheduler(
+                self.store, batch_planner=planner,
+                pipeline_depth=self._scheduler_pipeline_depth)
             self.replicated = ReplicatedOrchestrator(self.store,
                                                      restarts=restarts)
             self.global_ = GlobalOrchestrator(self.store, restarts=restarts)

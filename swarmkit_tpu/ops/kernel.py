@@ -368,6 +368,26 @@ def plan_group_jit(nodes: NodeInputs, group: GroupInputs, L: int,
     return plan_group(nodes, group, L, hier=hier)
 
 
+# --------------------------------------------------------- pipeline stages
+#
+# The jitted entry above is ASYNC-DISPATCHED: calling it (stage 1)
+# enqueues the XLA program and returns device arrays immediately; the
+# host blocks only when it reads their values.  The pipelined scheduler
+# exploits exactly this split — dispatch group i+1's plan (any plan_fn
+# with plan_group_jit's signature, incl. the mesh-sharded one), run
+# group i's host commit while the device computes, then fetch — with the
+# two stages wrapped in the ``plan.dispatch`` / ``plan.d2h`` spans the
+# overlap metrics are built from (ops/planner.py dispatch_group /
+# fetch_group).
+
+def fetch_plan(arrays):
+    """Stage 2: one blocking D2H round-trip for a dispatched plan's
+    outputs.  Fetch everything in one call — transfer latency dominates
+    over tunneled links, so never fetch twice.  Works for single-device
+    and mesh-sharded (shard_map) outputs alike."""
+    return jax.device_get(arrays)
+
+
 @jax.jit
 def feasibility_jit(nodes: NodeInputs, group: GroupInputs):
     """Mask + capacity only — validates preassigned (global-service)

@@ -28,6 +28,7 @@ so a tick of many small groups pays O(N) once, not O(N x groups).
 from __future__ import annotations
 
 import logging
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -44,7 +45,9 @@ from ..models.types import TaskState, TaskStatus
 from ..obs.trace import tracer
 from ..utils.metrics import registry as _metrics
 from .hashing import str_hash
-from .kernel import GroupInputs, K_CLAMP, NodeInputs, plan_group_jit
+from .kernel import (
+    GroupInputs, K_CLAMP, NodeInputs, fetch_plan, plan_group_jit,
+)
 
 log = logging.getLogger("tpu-planner")
 
@@ -164,6 +167,24 @@ def _probe_inputs():
     return nodes, group
 
 
+class _InFlightPlan:
+    """One dispatched-but-unfetched device plan: everything fetch_group
+    needs to finish the group once the device triple lands."""
+
+    __slots__ = ("sched", "t", "task_group", "decisions", "built",
+                 "plan_t0", "arrays")
+
+    def __init__(self, sched, t, task_group, decisions, built, plan_t0,
+                 arrays):
+        self.sched = sched
+        self.t = t
+        self.task_group = task_group
+        self.decisions = decisions
+        self.built = built
+        self.plan_t0 = plan_t0
+        self.arrays = arrays
+
+
 class TPUPlanner:
     def __init__(self, plan_fn=None):
         # plan_fn(nodes: NodeInputs, group: GroupInputs, L: int, hier)
@@ -189,6 +210,14 @@ class TPUPlanner:
         # begin_tick, updated incrementally by the apply phase, invalidated
         # by host-path fallbacks (which mutate NodeInfos behind our back)
         self._cache = None
+        # FIFO in-flight queue for the dispatch/fetch pipeline split:
+        # plans dispatched via dispatch_group wait here until fetch_group
+        # blocks on their D2H.  At most ONE plan may be in flight (the
+        # dispatch_group guard): group i+1's input columns depend on
+        # group i's apply, so the pipelined scheduler overlaps the
+        # in-flight plan with group COMMITS (bounded by the scheduler's
+        # pipeline_depth), never with another plan.
+        self._inflight: deque = deque()
 
     # ------------------------------------------------------------- accounting
 
@@ -391,9 +420,33 @@ class TPUPlanner:
 
     def schedule_group(self, sched, task_group: Dict[str, Task],
                        decisions) -> bool:
+        """Serial entry point: dispatch + immediate fetch.  The pipelined
+        scheduler calls the two stages separately (commit work runs
+        between them); both paths share exactly this code, so pipelining
+        cannot change placements."""
+        handle = self.dispatch_group(sched, task_group, decisions)
+        if handle is None:
+            return False
+        return self.fetch_group(handle)
+
+    def dispatch_group(self, sched, task_group: Dict[str, Task],
+                       decisions) -> Optional[_InFlightPlan]:
+        """Pipeline stage 1: route, densify, and async-dispatch one
+        group's device plan.  Returns an in-flight handle to finish with
+        ``fetch_group``, or None when the group is not device-planned
+        (the caller must run the host path; routing counters and column-
+        cache invalidation have already been applied exactly as the
+        serial path would).
+
+        The handle's plan was built from the CURRENT mirror state: the
+        caller must fetch-and-apply it before mutating mirrors or
+        building another group's inputs (enforced below), otherwise the
+        dispatched placement would be read against stale columns.
+        """
         t = next(iter(task_group.values()))
         if not self._supported(t):
-            return self._fallback()
+            self._fallback()
+            return None
         if self.enable_small_group_routing and self._launch_overhead is None:
             self._measure_launch_overhead()
         if self.enable_small_group_routing and \
@@ -401,21 +454,33 @@ class TPUPlanner:
                 < 0.8 * self._launch_overhead:
             self._count("groups_small_to_host")
             self._cache = None   # host path mutates NodeInfos
-            return False
+            return None
 
         import time as _time
         _plan_t0 = _time.perf_counter()
         k = len(task_group)
         if k > K_CLAMP:  # beyond the kernel's 32-bit budget (see kernel.py)
-            return self._fallback()
+            self._fallback()
+            return None
+        if self._inflight:
+            raise RuntimeError(
+                "dispatch_group with a plan already in flight: fetch it "
+                "first (its apply feeds this group's input columns)")
         with tracer.span("plan.build_inputs", "plan", tasks=k):
             built = self._build_device_inputs(sched, t, k)
         if built is None:
-            return self._fallback()
+            self._fallback()
+            return None
         if built[1] == 0:   # no valid nodes densified
-            return False
-        return self._plan_on_device(sched, t, task_group, decisions,
-                                    built, _plan_t0)
+            return None
+        nodes_in, group_in, L, hier = built[7], built[8], built[9], \
+            built[10]
+        with tracer.span("plan.dispatch", "plan", tasks=k):
+            arrays = self._call_plan_fn(nodes_in, group_in, L, hier)
+        handle = _InFlightPlan(sched, t, task_group, decisions, built,
+                               _plan_t0, arrays)
+        self._inflight.append(handle)
+        return handle
 
     def _build_device_inputs(self, sched, t, k):
         """Densify the cluster + one task-group spec into kernel inputs.
@@ -766,22 +831,41 @@ class TPUPlanner:
         self._count("tasks_planned", len(items))
         return remaining
 
-    def _plan_on_device(self, sched, t, task_group, decisions, built,
-                        _plan_t0):
+    def discard_inflight(self) -> None:
+        """Drop dispatched-but-unfetched plans (aborted tick): their
+        results are never applied, and the column cache is invalidated
+        since mirrors may no longer match what was densified."""
+        if self._inflight:
+            self._inflight.clear()
+            self._cache = None
+
+    def fetch_group(self, handle: _InFlightPlan) -> bool:
+        """Pipeline stage 2: block on the dispatched plan's D2H, then
+        apply it to the scheduler mirrors / decision draft.  Returns True
+        when the device handled the group (``task_group`` retains any
+        unplaceable leftovers), False when the plan spilled and the
+        caller must re-run the group through the host oracle (counters
+        and cache invalidation already applied, as in the serial path).
+
+        Handles must be fetched oldest-first (FIFO) — each plan's apply
+        feeds the next plan's input columns.
+        """
         import time as _time
 
+        if not self._inflight or self._inflight[0] is not handle:
+            raise RuntimeError("fetch_group out of dispatch order")
+        self._inflight.popleft()
+        sched, t = handle.sched, handle.t
+        task_group, decisions = handle.task_group, handle.decisions
+        _plan_t0 = handle.plan_t0
         (infos, n, nb, valid, cpu, mem, total, nodes_in, group_in, L,
-         hier, cpu_d, mem_d, gen_wanted, port_limited) = built
+         hier, cpu_d, mem_d, gen_wanted, port_limited) = handle.built
         k = len(task_group)
-        import jax as _jax
-        with tracer.span("plan.dispatch", "plan", tasks=k):
-            x, fail_counts, spill = self._call_plan_fn(nodes_in, group_in,
-                                                       L, hier)
         # one round-trip for all outputs: D2H latency dominates over
         # tunneled links, so never fetch twice
         with tracer.span("plan.d2h", "plan"):
-            x, fail_counts, spill = _jax.device_get(
-                (x, fail_counts, spill))
+            x, fail_counts, spill = fetch_plan(handle.arrays)
+        handle.arrays = None
         if bool(spill):
             # a spread branch saturated: the host oracle's convergence
             # loop redistributes differently than the water-fill in that

@@ -17,8 +17,9 @@ Pipeline gating strategy called for in SURVEY.md §5.8.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..models.objects import Node, Service, Task, Volume
 from ..models.types import (
@@ -26,6 +27,7 @@ from ..models.types import (
 )
 from ..obs.trace import tracer
 from ..utils.metrics import registry as _metrics
+from ..utils.pipeline import default_pipeline_depth
 from ..state.events import Event, EventCommit, EventSnapshotRestore
 from ..state.store import Batch, MemoryStore, ReadTx
 from ..state.watch import Closed
@@ -53,12 +55,119 @@ class SchedulingDecision:
         self.new = new
 
 
+class _TickCommitter:
+    """One tick's commit pipeline: group drafts commit on a dedicated
+    thread, in submission (= planning) order, while the main thread
+    builds and dispatches the next group's device plan — the host-commit
+    half of the plan/commit overlap (docs/architecture.md "Pipelined
+    scheduling").
+
+    The tick is only acked after ``close()``: every submitted draft has
+    resolved, commit results aggregated, so conflict rollback and
+    re-enqueue run exactly as the serial path's end-of-tick handling.
+    Once leadership is observed lost, remaining drafts fail WITHOUT
+    touching the store — no in-flight device plan may commit after
+    leadership loss (asserted by the sim's pipelined-commit scenario).
+    """
+
+    __slots__ = ("_sched", "_q", "_tickets", "_thread", "_resolved")
+
+    def __init__(self, sched: "Scheduler"):
+        self._sched = sched
+        self._q: "queue.Queue" = queue.Queue()
+        self._tickets: List[dict] = []
+        self._thread: Optional[threading.Thread] = None
+        self._resolved = 0   # tickets resolve strictly FIFO
+
+    def submit(self, draft: List[Tuple[List[Task], List[str], str]]
+               ) -> None:
+        ticket = {"draft": draft, "done": threading.Event(),
+                  "committed": 0, "failed": [], "missing": []}
+        self._tickets.append(ticket)
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="sched-commit", daemon=True)
+            self._thread.start()
+        self._q.put(ticket)
+
+    def throttle(self, max_inflight: int) -> None:
+        """Bounded depth: block until at most ``max_inflight`` submitted
+        drafts remain unresolved.  Tickets resolve in submission order
+        (single FIFO committer), so a monotonic resolved-prefix index
+        keeps this O(1) amortized per call."""
+        while len(self._tickets) - self._resolved > max_inflight:
+            self._tickets[self._resolved]["done"].wait()
+            self._resolved += 1
+
+    @staticmethod
+    def _fail_all(ticket: dict) -> None:
+        ticket["failed"] = [
+            (old, nid) for olds, nids, _ in ticket["draft"]
+            for old, nid in zip(olds, nids)]
+
+    def _lost_leadership(self) -> bool:
+        proposer = self._sched.store._proposer
+        return (proposer is not None
+                and not getattr(proposer, "is_leader", True))
+
+    def _run(self) -> None:
+        while True:
+            ticket = self._q.get()
+            if ticket is None:
+                return
+            sched = self._sched
+            try:
+                if self._lost_leadership():
+                    self._fail_all(ticket)
+                else:
+                    n = sum(len(olds)
+                            for olds, _, _ in ticket["draft"])
+                    t0 = now()
+                    with tracer.span("sched.commit", "sched",
+                                     decisions=n):
+                        c, _, f = sched._commit_draft(
+                            ticket["draft"], want_ids=False,
+                            missing_out=ticket["missing"])
+                    dt = now() - t0
+                    sched.stats["commit_seconds"] += dt
+                    _COMMIT_TIMER.observe(dt)
+                    ticket["committed"] = c
+                    ticket["failed"] = f
+            except Exception:
+                log.exception("pipelined block commit failed")
+                self._fail_all(ticket)
+            finally:
+                ticket["done"].set()
+
+    def close(self) -> Tuple[int, List[Tuple[Task, str]]]:
+        """Join the committer, then run the deferred vanished-task
+        cleanup on the calling (main) thread; returns (committed count,
+        failed (mirror task, node_id) pairs) across all drafts."""
+        if self._thread is not None:
+            self._q.put(None)
+            self._thread.join()
+        committed = sum(t["committed"] for t in self._tickets)
+        failed = [p for t in self._tickets for p in t["failed"]]
+        for t in self._tickets:
+            for old, nid in t["missing"]:
+                self._sched._on_block_missing(old, nid)
+        return committed, failed
+
+
 class Scheduler:
     def __init__(self, store: MemoryStore,
                  batch_planner=None,
                  debounce_gap: float = COMMIT_DEBOUNCE_GAP,
-                 max_latency: float = MAX_LATENCY):
+                 max_latency: float = MAX_LATENCY,
+                 pipeline_depth: Optional[int] = None):
         self.store = store
+        # bounded-depth plan/commit software pipeline: while group i's
+        # draft commits on the committer thread, group i+1's device plan
+        # is dispatched and computes.  1 = strictly serial tick
+        # (SWARM_PIPELINE_DEPTH escape hatch); placements are
+        # byte-identical either way (tests/test_pipeline.py).
+        self.pipeline_depth = (pipeline_depth if pipeline_depth is not None
+                               else default_pipeline_depth())
         # commit-event debounce windows (reference: scheduler.go:149-155);
         # injectable so tests and the simulator control latency precisely
         self.debounce_gap = debounce_gap
@@ -418,30 +527,35 @@ class Scheduler:
                            "one_off": len(one_off_tasks)}
 
         planner = self.batch_planner
+        use_pipeline = (self.pipeline_depth > 1 and self.block_mode
+                        and planner is not None
+                        and hasattr(planner, "dispatch_group"))
+        pipe_block = 0       # block decisions already committed in-pipeline
+        pipe_committed = 0
+        pipe_failed: List[Tuple[Task, str]] = []
         if planner is not None and hasattr(planner, "begin_tick"):
             planner.begin_tick(self)
         try:
-            for group in groups.values():
-                # drop entries that were assigned out-of-band since enqueue
-                stale = [tid for tid, t in group.items()
-                         if t is None or t.node_id]
-                for tid in stale:
-                    del group[tid]
-                if group:
+            if use_pipeline:
+                pipe_block, pipe_committed, pipe_failed = \
+                    self._run_group_pipeline(groups, one_off_tasks,
+                                             decisions)
+            else:
+                for group in self._tick_groups(groups, one_off_tasks):
                     self._schedule_task_group(group, decisions)
-            for t in one_off_tasks.values():
-                if t is not None and not t.node_id:
-                    self._schedule_task_group({t.id: t}, decisions)
         finally:
             if planner is not None and hasattr(planner, "end_tick"):
                 planner.end_tick()
 
-        n_decisions = len(decisions) + sum(
+        n_decisions = len(decisions) + pipe_block + sum(
             len(olds) for olds, _, _ in self.block_draft)
         with tracer.span("sched.commit", "sched", decisions=n_decisions):
             t_commit = now()
             n_committed, _, block_failed = self._commit_block_draft(
                 want_ids=False)
+            residual = n_committed or block_failed
+            n_committed += pipe_committed
+            block_failed = pipe_failed + block_failed
             for old, nid in block_failed:
                 # mirror rollback (remove_task never reads node_id, so the
                 # pre-assignment object works) + requeue for the next tick
@@ -450,7 +564,9 @@ class Scheduler:
                 if info is not None:
                     info.remove_task(old)
                 self._enqueue(old)
-            if n_committed or block_failed:
+            if residual:
+                # pipelined drafts were timed on the committer thread;
+                # only a residual serial commit lands here
                 dt_block = now() - t_commit
                 self.stats["commit_seconds"] += dt_block
                 # the columnar path commits here, not through
@@ -480,6 +596,93 @@ class Scheduler:
         self.stats["tick_seconds"].append(now() - t0)
         return n_decisions
 
+    def _tick_groups(self, groups, one_off_tasks
+                     ) -> Iterable[Dict[str, Task]]:
+        """The tick's task groups in scheduling order, with entries that
+        were assigned out-of-band since enqueue dropped — one code path
+        shared by the serial loop and the pipeline so group order (and
+        therefore commit/event order) is identical in both modes."""
+        for group in groups.values():
+            stale = [tid for tid, t in group.items()
+                     if t is None or t.node_id]
+            for tid in stale:
+                del group[tid]
+            if group:
+                yield group
+        for t in one_off_tasks.values():
+            if t is not None and not t.node_id:
+                yield {t.id: t}
+
+    def _run_group_pipeline(self, groups, one_off_tasks, decisions
+                            ) -> Tuple[int, int, List[Tuple[Task, str]]]:
+        """Software-pipelined scheduling phase: while group i's draft
+        commits on the committer thread (raft propose/apply, store
+        overlay writes), group i+1's inputs are densified and its device
+        plan dispatched — the device computes during the host commit
+        instead of idling.  Placement order, mirror mutation order, and
+        commit order all match the serial path exactly (each group's
+        plan is fetched and applied before the next group's inputs are
+        built), so placements are byte-identical; only the wall-clock
+        interleaving changes.  Returns (block decisions drafted,
+        committed count, failed pairs); the tick is acked only after the
+        last draft resolved.
+        """
+        planner = self.batch_planner
+        committer = _TickCommitter(self)
+        inflight: Optional[Tuple[object, Dict[str, Task]]] = None
+        n_block = 0
+        try:
+            for group in self._tick_groups(groups, one_off_tasks):
+                if inflight is not None:
+                    n_block += self._finish_inflight(inflight, decisions,
+                                                     committer)
+                    inflight = None
+                handle = planner.dispatch_group(self, group, decisions)
+                if handle is None:
+                    # not device-planned: host oracle, synchronously (no
+                    # plan is in flight here, so mirror mutation order
+                    # matches the serial path)
+                    self._schedule_group_host(group, decisions)
+                else:
+                    inflight = (handle, group)
+            if inflight is not None:
+                n_block += self._finish_inflight(inflight, decisions,
+                                                 committer)
+                inflight = None
+        finally:
+            if inflight is not None and hasattr(planner,
+                                                "discard_inflight"):
+                planner.discard_inflight()
+            committed, failed = committer.close()
+        return n_block, committed, failed
+
+    def _finish_inflight(self, inflight, decisions,
+                         committer: _TickCommitter) -> int:
+        """Fetch + apply an in-flight device plan, then hand its draft
+        to the commit pipeline.  Returns the number of block decisions
+        drafted for the group."""
+        handle, group = inflight
+        planner = self.batch_planner
+        handled = planner.fetch_group(handle)
+        if not handled:
+            # spill: exact reference parity requires the host oracle's
+            # convergence loop for this group (same as the serial path)
+            self._schedule_group_host(group, decisions)
+            return 0
+        if group:
+            self._no_suitable_node(
+                group, decisions,
+                explanation=getattr(planner, "last_explanation", ""))
+        if not self.block_draft:
+            return 0
+        draft, self.block_draft = self.block_draft, []
+        n = sum(len(olds) for olds, _, _ in draft)
+        committer.submit(draft)
+        # bounded depth: one plan in flight on the device + at most
+        # depth-1 unacked commits behind it
+        committer.throttle(max(1, self.pipeline_depth - 1))
+        return n
+
     def _commit_block_draft(self, want_ids: bool = True
                             ) -> Tuple[int, Optional[List[str]],
                                        List[Tuple[Task, str]]]:
@@ -492,17 +695,40 @@ class Scheduler:
         if not draft:
             return 0, [] if want_ids else None, []
         self.block_draft = []
+        return self._commit_draft(draft, want_ids)
+
+    def _on_block_missing(self, old: Task, nid: str) -> None:
+        # the draft already planted the task on the assigned node's
+        # mirror (membership + reservations) — clean THAT node, not
+        # old.node_id (which is empty pre-assignment)
+        info = self.node_set.node_info(nid)
+        if info is not None:
+            info.remove_task(old)
+        self._delete_task(self.all_tasks.get(old.id, old))
+
+    def _commit_draft(self, draft: List[Tuple[List[Task], List[str], str]],
+                      want_ids: bool = True,
+                      missing_out: Optional[List[Tuple[Task, str]]] = None
+                      ) -> Tuple[int, Optional[List[str]],
+                                 List[Tuple[Task, str]]]:
+        """Commit an explicit draft list (the body of
+        ``_commit_block_draft``, callable from the tick committer with
+        drafts taken off ``block_draft`` at submit time).
+
+        ``missing_out``: when given (the committer-thread path),
+        vanished-task cleanup is DEFERRED — (old, nid) pairs are
+        appended for the main thread to process at tick end via
+        ``_on_block_missing`` — because it mutates scheduler mirrors,
+        which must not happen concurrently with the main thread's
+        planning.  The serial path runs it inline (same thread)."""
         node_info = self.node_set.node_info
         raw_get = self.store.raw_get
 
         def on_missing(old: Task, nid: str) -> None:
-            # the draft already planted the task on the assigned node's
-            # mirror (membership + reservations) — clean THAT node, not
-            # old.node_id (which is empty pre-assignment)
-            info = node_info(nid)
-            if info is not None:
-                info.remove_task(old)
-            self._delete_task(self.all_tasks.get(old.id, old))
+            if missing_out is not None:
+                missing_out.append((old, nid))
+                return
+            self._on_block_missing(old, nid)
 
         def on_assigned(old: Task, nid: str) -> bool:
             # stored task already >= ASSIGNED: commit only if our view of
@@ -726,9 +952,6 @@ class Scheduler:
 
     def _schedule_task_group(self, task_group: Dict[str, Task],
                              decisions: Dict[str, SchedulingDecision]) -> None:
-        t = next(iter(task_group.values()))
-        self.pipeline.set_task(t)
-
         if self.batch_planner is not None:
             handled = self.batch_planner.schedule_group(
                 self, task_group, decisions)
@@ -739,7 +962,15 @@ class Scheduler:
                         explanation=getattr(self.batch_planner,
                                             "last_explanation", ""))
                 return
+        self._schedule_group_host(task_group, decisions)
 
+    def _schedule_group_host(self, task_group: Dict[str, Task],
+                             decisions: Dict[str, SchedulingDecision]
+                             ) -> None:
+        """The host oracle path: spread tree + sorted round-robin
+        (reference: scheduler.go:694 scheduleTaskGroup)."""
+        t = next(iter(task_group.values()))
+        self.pipeline.set_task(t)
         ts = now()
 
         def node_less(a: NodeInfo, b: NodeInfo) -> bool:

@@ -70,6 +70,11 @@ class SimManager:
         # durable state ("disk"): survives crashes, lost records only
         # through explicit truncation faults
         self._wal_records: List[tuple] = []   # ("hs", HardState)|("ent", Entry)
+        # apply tap for data entries: (member_id, entry) per applied
+        # non-conf entry — SimRaftProposer completes its waiters (and
+        # runs store commit callbacks in the apply path) through this,
+        # mirroring RaftNode._apply_entry's waiter handling
+        self.on_apply = None
         self.restarts = 0
         self.core = self._new_core()
         net.register(member_id, self._on_message)
@@ -135,6 +140,9 @@ class SimManager:
                 self.core.apply_conf_change(change["op"], change["id"])
             except Exception:
                 pass
+            return
+        if self.on_apply is not None and e.data:
+            self.on_apply(self.id, e)
 
     # ---------------------------------------------------------------- faults
 
@@ -309,6 +317,97 @@ class SimAgent:
                         f"{'on' if on else 'off'}")
 
 
+class SimRaftProposer:
+    """MemoryStore ``Proposer`` backed by the sim's consensus layer:
+    proposals ride the real RaftCore through SimNetwork faults, and
+    commit callbacks run in the proposing member's apply path (the
+    ``SimManager.on_apply`` tap), mirroring RaftNode's waiter handling.
+
+    Implements the async pair (``propose_async``/``wait_proposal``) the
+    store's chunk-pipelined block commit uses, so leader churn against
+    in-flight pipelined proposals is simulatable deterministically.
+    ``wait_proposal`` advances VIRTUAL time by pumping the engine, so it
+    must only be driven from top-level scenario code — never from inside
+    an engine event (the engine loop is not re-entrant).
+    """
+
+    PUMP = 0.05      # virtual seconds per wait slice
+    TIMEOUT = 30.0   # virtual seconds before a proposal is abandoned
+
+    def __init__(self, sim: "Sim"):
+        self.sim = sim
+        self._pending: Dict[tuple, dict] = {}
+        self.stats = {"proposed": 0, "committed": 0, "dropped": 0}
+        for m in sim.managers:
+            m.on_apply = self._on_apply
+
+    # ------------------------------------------------------------- proposer
+
+    def propose_async(self, actions, commit_cb=None) -> dict:
+        from ..state import serde
+        leader = self.sim.leader()
+        if leader is None:
+            raise RuntimeError("no ready raft leader to propose to")
+        data = serde.dumps([serde.action_to_dict(a) for a in actions])
+        index = leader.core.propose(data)
+        leader.pump()
+        waiter = {"member": leader, "index": index,
+                  "commit_cb": commit_cb, "done": False, "ok": False,
+                  "deadline": self.sim.engine.clock.elapsed()
+                  + self.TIMEOUT}
+        self._pending[(leader.id, index)] = waiter
+        self.stats["proposed"] += 1
+        return waiter
+
+    def wait_proposal(self, waiter: dict) -> None:
+        from ..state.raft.node import ProposalDropped
+        eng = self.sim.engine
+        while not waiter["done"]:
+            m = waiter["member"]
+            if not m.alive or m.stopped:
+                # the proposing member is gone: its store can never run
+                # the commit callback, so the proposal fails here even
+                # if the entry later commits cluster-wide (a real
+                # manager rebuilds its store from the WAL on restart)
+                self._fail(waiter)
+                break
+            if m.core.role != LEADER \
+                    and m.core.commit_index < waiter["index"]:
+                self._fail(waiter)   # deposed before the entry committed
+                break
+            if eng.clock.elapsed() >= waiter["deadline"]:
+                self._fail(waiter)
+                break
+            eng.run_until(eng.clock.elapsed() + self.PUMP)
+        if not waiter["ok"]:
+            self.stats["dropped"] += 1
+            raise ProposalDropped("sim raft proposal dropped")
+        self.stats["committed"] += 1
+
+    def propose(self, actions, commit_cb=None) -> None:
+        self.wait_proposal(self.propose_async(actions, commit_cb))
+
+    # ------------------------------------------------------------ apply tap
+
+    def _on_apply(self, member_id: str, entry) -> None:
+        waiter = self._pending.pop((member_id, entry.index), None)
+        if waiter is None or waiter["done"]:
+            return
+        ok = True
+        if waiter["commit_cb"] is not None:
+            try:
+                waiter["commit_cb"]()
+            except Exception:
+                ok = False
+        waiter["ok"] = ok
+        waiter["done"] = True
+
+    def _fail(self, waiter: dict) -> None:
+        self._pending.pop((waiter["member"].id, waiter["index"]), None)
+        waiter["done"] = True
+        waiter["ok"] = False
+
+
 class SimControlPlane:
     """The leader's store + real Scheduler + real Dispatcher, driven
     synchronously under virtual time."""
@@ -325,7 +424,11 @@ class SimControlPlane:
                              grace_multiplier=3.0, rate_limit_period=0.0,
                              orphan_timeout=20.0),
             rng=engine.fork_rng())
-        self.scheduler = Scheduler(self.store)
+        # pipeline_depth=1: the committer thread of the pipelined tick
+        # would break the sim's single-threaded determinism contract;
+        # chunk-pipelined PROPOSALS (store-level, single-threaded) are
+        # exercised by the pipelined-commit-churn scenario instead
+        self.scheduler = Scheduler(self.store, pipeline_depth=1)
         self.scheduler.pipeline.add_filter(
             VolumesFilter(self.scheduler.volumes))
         self._task_seq = 0

@@ -345,12 +345,183 @@ def _random_fuzz(sim: Sim) -> float:
     return duration
 
 
+def _pipelined_commit_churn(sim: Sim) -> float:
+    """Chunk-pipelined scheduler commits through the sim consensus layer
+    under leader crash: a raft-attached store (SimRaftProposer) commits
+    a device-planned task group as many small pipelined block chunks,
+    and the leader is crashed while later chunks are still in flight.
+
+    Asserted (as violations when broken):
+      * the clean pipelined tick commits every task;
+      * NO chunk commits to the store after the leadership-loss instant
+        — in-flight device plans must fail, roll back, and requeue;
+      * committed + requeued always accounts for every task (none lost);
+      * after a new leader emerges, a re-tick places the remainder;
+      * the committed-entry ledger invariant (RaftInvariants) holds for
+        the chunk-pipelined proposals interleaved with the background
+        raft workload — checked continuously by the shared checkers.
+    """
+    eng = sim.engine
+    sim.start_raft_workload(interval=0.6)
+    sim.cp.create_tasks(6)   # keep the standard control plane busy too
+
+    # top-level pumping: wait_proposal advances virtual time itself, so
+    # this scenario DRIVES its workload inline instead of scheduling it
+    # (the engine loop is not re-entrant from inside an event)
+    while sim.leader() is None and eng.clock.elapsed() < 30.0:
+        eng.run_until(eng.clock.elapsed() + 0.5)
+    if sim.leader() is None:
+        sim.violations.record("pipelined-commit",
+                              "no ready leader within 30s")
+        return eng.clock.elapsed() + 5.0
+
+    from ..models import (
+        Annotations, Node, NodeDescription, NodeSpec, NodeState,
+        NodeStatus, ReplicatedService, Resources, Service, ServiceMode,
+        ServiceSpec, Task, TaskSpec, TaskState, TaskStatus, Version,
+    )
+    from ..models.types import now
+    from ..ops import TPUPlanner
+    from ..scheduler import Scheduler
+    from ..state.store import MemoryStore
+    from .cluster import SimRaftProposer
+
+    proposer = SimRaftProposer(sim)
+    store = MemoryStore(proposer=proposer)
+    store.pipeline_depth = 4            # chunk-pipelined proposals
+    store.BLOCK_PROPOSAL_MAX_ITEMS = 8  # many small chunks per group
+
+    def mk_nodes(tx):
+        for i in range(16):
+            tx.create(Node(
+                id=f"pn{i:02d}",
+                spec=NodeSpec(annotations=Annotations(name=f"pn{i:02d}")),
+                status=NodeStatus(state=NodeState.READY),
+                description=NodeDescription(
+                    hostname=f"pn{i:02d}",
+                    resources=Resources(nano_cpus=8 * 10 ** 9,
+                                        memory_bytes=32 << 30))))
+
+    store.update(mk_nodes)
+    svc = Service(
+        id="svc-pipe",
+        spec=ServiceSpec(annotations=Annotations(name="pipe"),
+                         mode=ServiceMode.REPLICATED,
+                         replicated=ReplicatedService(replicas=96),
+                         task=TaskSpec()),
+        spec_version=Version(index=1))
+    store.update(lambda tx: tx.create(svc))
+
+    def mk_tasks(base):
+        def cb(tx):
+            for i in range(48):
+                tx.create(Task(
+                    id=f"pt{base + i:03d}", service_id=svc.id,
+                    slot=base + i + 1,
+                    desired_state=TaskState.RUNNING, spec=svc.spec.task,
+                    spec_version=Version(index=1),
+                    status=TaskStatus(state=TaskState.PENDING,
+                                      timestamp=now())))
+        store.update(cb)
+
+    def count_assigned():
+        return sum(1 for t in store.view(lambda tx: tx.find(Task))
+                   if t.node_id
+                   and t.status.state >= TaskState.ASSIGNED)
+
+    planner = TPUPlanner()
+    planner.enable_small_group_routing = False
+    # scheduler-level depth 1: its committer thread would break the
+    # sim's single-threaded determinism; the store-level chunk pipeline
+    # (window 4 above) is what this scenario exercises
+    sched = Scheduler(store, batch_planner=planner, pipeline_depth=1)
+    store.view(sched._setup_tasks_list)
+
+    # ---- phase 1: clean pipelined tick, every chunk rides consensus
+    mk_tasks(0)
+    sched._resync()
+    sched.tick()
+    assigned1 = count_assigned()
+    if assigned1 != 48:
+        sim.violations.record(
+            "pipelined-commit",
+            f"clean pipelined tick committed {assigned1}/48")
+
+    # ---- phase 2: crash the leader while chunks are in flight.  The
+    # strike is keyed off the pipeline itself (after the 2nd chunk's
+    # commit is acked, with up to window-1 later chunks still riding
+    # consensus), not off wall/virtual timing — deterministic per seed
+    # and guaranteed to land mid-pipeline.
+    mk_tasks(48)
+    sched._resync()
+    at_crash: Dict[str, int] = {}
+    acked = {"n": 0}
+
+    def strike():
+        m = sim.leader()
+        if m is None:
+            return
+        at_crash["assigned"] = count_assigned()
+        eng.log(f"fault crash {m.id} mid-pipeline")
+        m.crash()
+        eng.after(8.0, "restart ex-leader", m.restart)
+
+    orig_wait = proposer.wait_proposal
+
+    def wait_then_strike(waiter):
+        orig_wait(waiter)
+        acked["n"] += 1
+        if acked["n"] == 2:
+            strike()
+
+    proposer.wait_proposal = wait_then_strike
+    try:
+        sched.tick()
+    finally:
+        proposer.wait_proposal = orig_wait
+    assigned2 = count_assigned()
+    requeued = len(sched.unassigned_tasks)
+    flightrec.note(f"pipelined-commit phase2: assigned={assigned2} "
+                   f"at_crash={at_crash.get('assigned')} "
+                   f"requeued={requeued}")
+    if "assigned" not in at_crash:
+        sim.violations.record("pipelined-commit",
+                              "leader crash fault never fired")
+    elif assigned2 > at_crash["assigned"]:
+        sim.violations.record(
+            "pipeline-commit-after-leadership-loss",
+            f"{assigned2 - at_crash['assigned']} tasks committed after "
+            f"the leadership-loss instant (in-flight chunks must fail)")
+    if assigned2 - 48 + requeued != 48:
+        sim.violations.record(
+            "pipelined-commit",
+            f"task accounting broken after churn: committed "
+            f"{assigned2 - 48} + requeued {requeued} != 48")
+
+    # ---- phase 3: a successor leader acks the re-placed remainder
+    while sim.leader() is None and eng.clock.elapsed() < 90.0:
+        eng.run_until(eng.clock.elapsed() + 0.5)
+    if sim.leader() is None:
+        sim.violations.record("pipelined-commit",
+                              "no successor leader within 90s")
+    else:
+        sched._resync()
+        sched.tick()
+        assigned3 = count_assigned()
+        if assigned3 != 96:
+            sim.violations.record(
+                "pipelined-commit",
+                f"re-tick after churn placed {assigned3}/96")
+    return eng.clock.elapsed() + 3.0
+
+
 SCENARIOS: Dict[str, Callable[[Sim], float]] = {
     "partition-churn": _partition_churn,
     "crash-leader-mid-commit": _crash_leader_mid_commit,
     "crash-restart-churn": _crash_restart_churn,
     "clock-skew": _clock_skew,
     "agent-storm": _agent_storm,
+    "pipelined-commit-churn": _pipelined_commit_churn,
     "random-fuzz": _random_fuzz,
 }
 

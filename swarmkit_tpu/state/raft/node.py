@@ -55,6 +55,7 @@ class _Waiter:
     index: int
     ok: bool = False
     commit_cb: Optional[Callable[[], None]] = None
+    t0: float = 0.0   # propose_async submit time (propose-latency timer)
 
 
 class RaftNode(Proposer):
@@ -426,22 +427,40 @@ class RaftNode(Proposer):
 
     # -------------------------------------------------------------- proposer
 
-    def propose(self, actions: Sequence[StoreAction],
-                commit_cb=None) -> None:
-        """Block until the change list is committed by consensus and
-        ``commit_cb`` ran in the apply path (reference: raft.go:1592
-        ProposeValue; no internal timeout by design, design/raft.md:215 —
-        but leadership loss fails us)."""
+    def propose_async(self, actions: Sequence[StoreAction],
+                      commit_cb=None) -> _Waiter:
+        """Submit a proposal without waiting for consensus: serialize on
+        the caller's thread, enqueue to the raft loop, return the waiter.
+        Proposals submitted from one thread are appended to the log (and
+        therefore committed and applied) in submission order — the
+        ordering guarantee the store's chunk-pipelined block commits rely
+        on.  Pair every returned waiter with ``wait_proposal``: the
+        commit callback runs in the apply path regardless, but success or
+        failure is only observable through the wait."""
         if self.core.role != LEADER:
             raise NotLeader(f"{self.id} is not the leader")
         t0 = time.perf_counter()
         data = serde.dumps([serde.action_to_dict(a) for a in actions])
         waiter = _Waiter(event=threading.Event(), term=self.core.term,
-                         index=0, commit_cb=commit_cb)
+                         index=0, commit_cb=commit_cb, t0=t0)
         self._inbox.put((data, waiter))
+        return waiter
+
+    def wait_proposal(self, waiter: _Waiter) -> None:
+        """Block until a ``propose_async`` proposal commits (commit_cb
+        already ran in the apply path) or fails; raises ProposalDropped
+        on leadership loss (no internal timeout by design,
+        design/raft.md:215)."""
         waiter.event.wait()
         # serialize -> consensus round -> apply-path commit, end to end
-        _PROPOSE_TIMER.observe(time.perf_counter() - t0)
+        _PROPOSE_TIMER.observe(time.perf_counter() - waiter.t0)
         if not waiter.ok:
             raise ProposalDropped(
                 "raft proposal dropped (leadership change)")
+
+    def propose(self, actions: Sequence[StoreAction],
+                commit_cb=None) -> None:
+        """Block until the change list is committed by consensus and
+        ``commit_cb`` ran in the apply path (reference: raft.go:1592
+        ProposeValue)."""
+        self.wait_proposal(self.propose_async(actions, commit_cb))

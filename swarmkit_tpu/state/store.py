@@ -34,6 +34,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Type
 
@@ -43,6 +44,7 @@ from ..models.objects import (
 )
 from ..models.types import now
 from ..utils.metrics import registry as _metrics
+from ..utils.pipeline import default_pipeline_depth
 from .events import Event, EventCommit, EventSnapshotRestore, EventTaskBlock
 from .watch import Queue, Subscription
 
@@ -510,13 +512,19 @@ class MemoryStore:
         }
         self._proposer = proposer
         self._version = 0
+        # raft block-chunk pipelining window for commit_task_block: with
+        # a proposer exposing propose_async/wait_proposal, up to this
+        # many chunk proposals ride consensus at once (serialization and
+        # WAL writes of chunk i+1 overlap the apply of chunk i); 1 =
+        # strictly serial propose->wait per chunk (SWARM_PIPELINE_DEPTH
+        # escape hatch)
+        self.pipeline_depth = default_pipeline_depth()
         self.queue = Queue()
         # bounded changelog ring for watch-from-version resume
         # (reference: raft.go:1617 ChangesBetween over the raft log).
         # Entries: ("one", version, action, obj, old) or a columnar
         # ("block", base_version, olds, node_ids, state, message, ts)
         # from commit_task_block, expanded lazily on replay.
-        from collections import deque
         self._changelog: deque = deque()
         self._changelog_total = 0
         self.changelog_limit = 8192   # changes retained for resume
@@ -1176,10 +1184,53 @@ class MemoryStore:
                         failed_idx.append(i)
                         continue
                     accepted.append(i)
+            # ---- chunked proposals, optionally pipelined.  With a
+            # proposer exposing propose_async/wait_proposal and
+            # pipeline_depth > 1, up to ``window`` chunk proposals ride
+            # consensus at once: chunk i+1 serializes and persists while
+            # chunk i is being applied.  Ordering is preserved because
+            # same-thread proposals append to the raft log in submission
+            # order and apply callbacks run in log order; the caller is
+            # only acked (this method returns) after every chunk
+            # resolved.  window=1 / missing async API degrades to the
+            # strictly serial propose->wait-per-chunk behavior.
+            proposer = self._proposer
+            window = max(1, self.pipeline_depth)
+            can_async = (window > 1
+                         and hasattr(proposer, "propose_async")
+                         and hasattr(proposer, "wait_proposal"))
+            pending: deque = deque()
+
+            def reap(entry) -> bool:
+                chunk, olds_c, nids_c, cb_base, waiter = entry
+                try:
+                    proposer.wait_proposal(waiter)
+                except Exception:
+                    log.exception("columnar block proposal failed")
+                    failed_idx.extend(chunk)
+                    return False
+                committed_idx.extend(chunk)
+                if self.queue.has_subscribers():
+                    self.queue.publish(EventTaskBlock(
+                        olds_c, nids_c, cb_base, state, message, ts))
+                return True
+
             pos = 0
             chunk_base = base
-            while pos < len(accepted):
+            n_acc = len(accepted)
+            # a failed submit/commit fails the chunk and everything
+            # after it (committed chunks stay committed) — same
+            # granularity as bulk_update_tasks; chunks already in
+            # flight when a failure surfaces resolve by their own
+            # waiter (a later chunk cannot commit unless every earlier
+            # one did, so results stay consistent with the log)
+            ok_to_submit = True
+            while pos < n_acc:
                 chunk = accepted[pos:pos + self.BLOCK_PROPOSAL_MAX_ITEMS]
+                pos += len(chunk)
+                if not ok_to_submit:
+                    failed_idx.extend(chunk)
+                    continue
                 # one materialization of the chunk's columns, shared by
                 # the action, the changelog entry, and the block event
                 olds_c = [old_tasks[i] for i in chunk]
@@ -1219,21 +1270,36 @@ class MemoryStore:
                              state, message, ts),
                             len(chunk))
 
-                try:
-                    self._proposer.propose([action], apply_chunk)
-                except Exception:
-                    # committed chunks stay committed; this chunk and all
-                    # remaining accepted items fail so the caller rolls
-                    # back only what the store did not apply
-                    log.exception("columnar block proposal failed")
-                    failed_idx.extend(accepted[pos:])
-                    break
-                committed_idx.extend(chunk)
-                if self.queue.has_subscribers():
-                    self.queue.publish(EventTaskBlock(
-                        olds_c, nids_c, chunk_base, state, message, ts))
+                if can_async:
+                    try:
+                        waiter = proposer.propose_async([action],
+                                                        apply_chunk)
+                    except Exception:
+                        log.exception("columnar block proposal failed")
+                        failed_idx.extend(chunk)
+                        ok_to_submit = False
+                        continue
+                    pending.append((chunk, olds_c, nids_c, chunk_base,
+                                    waiter))
+                    if len(pending) >= window \
+                            and not reap(pending.popleft()):
+                        ok_to_submit = False
+                else:
+                    try:
+                        proposer.propose([action], apply_chunk)
+                    except Exception:
+                        log.exception("columnar block proposal failed")
+                        failed_idx.extend(chunk)
+                        ok_to_submit = False
+                        continue
+                    committed_idx.extend(chunk)
+                    if self.queue.has_subscribers():
+                        self.queue.publish(EventTaskBlock(
+                            olds_c, nids_c, chunk_base, state, message,
+                            ts))
                 chunk_base += len(chunk)
-                pos += len(chunk)
+            while pending:
+                reap(pending.popleft())
             self.queue.publish(EventCommit(self._version))
         for old, nid in missing:
             on_missing(old, nid)

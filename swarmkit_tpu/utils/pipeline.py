@@ -1,0 +1,33 @@
+"""Pipeline-depth configuration for the plan/commit software pipeline.
+
+One knob governs every stage of the pipelined scheduler (see
+docs/architecture.md "Pipelined scheduling"): the scheduler's bound on
+in-flight stages per tick (one dispatched device plan + up to depth-1
+unacked group commits) and the store's window of raft block-chunk
+proposals in flight at once.
+
+``SWARM_PIPELINE_DEPTH=1`` is the escape hatch: every consumer reverts
+to the strictly serial plan -> commit ordering (bit-for-bit the
+pre-pipeline behavior).  Values below 1 clamp to 1; unparseable values
+fall back to the default.
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_PIPELINE_DEPTH = 2
+ENV_VAR = "SWARM_PIPELINE_DEPTH"
+
+
+def default_pipeline_depth() -> int:
+    """The process-wide pipeline depth: ``SWARM_PIPELINE_DEPTH`` when
+    set and parseable, else 2.  Read at component construction time, so
+    tests can override per instance without touching the environment."""
+    raw = os.environ.get(ENV_VAR)
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    return DEFAULT_PIPELINE_DEPTH

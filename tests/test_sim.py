@@ -55,6 +55,22 @@ def test_different_seeds_diverge():
     assert a.trace_hash != b.trace_hash
 
 
+def test_pipelined_commit_churn_scenario():
+    """Chunk-pipelined block proposals under a mid-pipeline leader
+    crash: committed chunks survive, nothing commits after the
+    leadership-loss instant, the remainder requeues and re-places under
+    the successor, and the committed-entry ledger stays consistent —
+    all checked inside the scenario (violations fail the run).  Same
+    seed => identical engine trace."""
+    r1 = run_scenario("pipelined-commit-churn", seed=7, keep_trace=True)
+    assert r1.ok, r1.violations
+    assert any(" fault crash " in line and "mid-pipeline" in line
+               for line in r1.trace), "the mid-pipeline strike must fire"
+    r2 = run_scenario("pipelined-commit-churn", seed=7)
+    assert r2.trace_hash == r1.trace_hash
+    assert r2.violations == r1.violations
+
+
 def test_fuzz_50_seeds_no_violations():
     """Acceptance: >= 50 randomized fault schedules, zero invariant
     violations, and any report reproduces from its seed byte-for-byte."""
