@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Seed-sweep the leader-failover scenarios (raft-attached control
+plane) and fail loudly on any invariant violation.
+
+    python scripts/failover_fuzz.py --fuzz 20
+    python scripts/failover_fuzz.py --fuzz 20 --scenario leader-crash-mid-tick
+    python scripts/failover_fuzz.py --list
+
+Each (scenario, seed) runs the full raft-attached control plane —
+scheduler, dispatcher, allocator, restart supervisor, replicated +
+global orchestrators on per-member replicated stores — through its
+fault timeline under every invariant checker (single-leader-per-term,
+committed-entry ledger, FSM monotonicity, no-double-assign,
+control-loops-only-on-leader, no-stale-epoch-commit, failover
+re-placement).  Exit status is 0 only when every run held every
+invariant; failures print the violations, the exact replay command, and
+the flight-recorder post-mortem path + sha the runner dumped.
+
+The tier-1 test (tests/test_failover.py) runs a small deterministic
+sweep through this same entry point; the wide sweep is the `slow` tier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from swarmkit_tpu.sim.scenario import (          # noqa: E402
+    FAILOVER_SCENARIOS, SCENARIOS, run_scenario,
+)
+
+
+def sweep(scenarios, n_seeds: int, start_seed: int = 0,
+          progress=None) -> list:
+    """Run every (scenario, seed) pair; returns all SimReports."""
+    reports = []
+    for name in scenarios:
+        for seed in range(start_seed, start_seed + n_seeds):
+            r = run_scenario(name, seed)
+            reports.append(r)
+            if progress is not None:
+                progress(r)
+    return reports
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="scripts/failover_fuzz.py")
+    p.add_argument("--fuzz", type=int, metavar="N", default=5,
+                   help="seeds per scenario (default 5)")
+    p.add_argument("--start-seed", type=int, default=0)
+    p.add_argument("--scenario", action="append", default=None,
+                   choices=sorted(FAILOVER_SCENARIOS),
+                   help="restrict to one scenario (repeatable); "
+                        "default: the whole failover suite")
+    p.add_argument("--list", action="store_true",
+                   help="list the failover scenarios and exit")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress per-run progress lines")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for name in FAILOVER_SCENARIOS:
+            doc = (SCENARIOS[name].__doc__ or "").strip().split("\n")[0]
+            print(f"{name:32s} {doc}")
+        return 0
+
+    scenarios = tuple(args.scenario) if args.scenario \
+        else FAILOVER_SCENARIOS
+
+    def progress(r):
+        if args.quiet:
+            return
+        mark = "ok" if r.ok else "FAIL"
+        ctl = r.stats.get("control", {})
+        print(f"{r.scenario:32s} seed {r.seed:5d} {mark} "
+              f"trace={r.trace_hash[:12]} "
+              f"attaches={ctl.get('attaches', 0)}", file=sys.stderr)
+
+    reports = sweep(scenarios, args.fuzz, start_seed=args.start_seed,
+                    progress=progress)
+    bad = [r for r in reports if not r.ok]
+    print(json.dumps({
+        "scenarios": list(scenarios),
+        "seeds_per_scenario": args.fuzz,
+        "start_seed": args.start_seed,
+        "runs": len(reports),
+        "failures": [
+            {"scenario": r.scenario, "seed": r.seed,
+             "violations": r.violations,
+             "flightrec": r.flightrec_path,
+             "flightrec_sha256": r.flightrec_sha256,
+             "reproduce": f"python -m swarmkit_tpu.sim --seed {r.seed} "
+                          f"--scenario {r.scenario}"}
+            for r in bad],
+        "ok": not bad,
+    }, indent=2))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

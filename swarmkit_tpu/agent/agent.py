@@ -16,10 +16,12 @@ Dispatcher object itself, over the network a gRPC client wrapper.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..models.types import TaskStatus
+from ..remotes import backoff_with_jitter
 from ..state.watch import Closed
 from .exec import Executor
 from .worker import Worker
@@ -29,11 +31,15 @@ log = logging.getLogger("agent")
 
 class Agent:
     def __init__(self, node_id: str, executor: Executor, client,
-                 description=None, task_db_path=None):
+                 description=None, task_db_path=None,
+                 rng: Optional[random.Random] = None):
         self.node_id = node_id
         self.executor = executor
         self.client = client
         self.description = description
+        # reconnect-jitter rng: injectable so the simulator's reconnect
+        # storms stay deterministic per seed (see remotes.backoff_with_jitter)
+        self._rng = rng or random.Random()
         db = None
         if task_db_path:
             from .storage import TaskDB
@@ -132,7 +138,7 @@ class Agent:
                 self._log_offsets[m["task_id"]] -= len(m["data"])
 
     def run(self) -> None:
-        backoff = 0.1
+        attempt = 0
         try:
             self._log_thread = threading.Thread(
                 target=self._log_shipper, name="agent-logs", daemon=True)
@@ -150,14 +156,19 @@ class Agent:
             while not self._stop.is_set():
                 try:
                     self._session()
-                    backoff = 0.1
+                    attempt = 0
                 except Exception as e:
                     if self._stop.is_set():
                         return
-                    log.info("agent session failed (%s); backing off %.1fs",
-                             e, backoff)
-                    self._stop.wait(timeout=backoff)
-                    backoff = min(backoff * 2, 8.0)
+                    # jittered exponential backoff: the ceiling doubles
+                    # per consecutive failure (capped), the actual sleep
+                    # is drawn uniformly below it so a manager failover
+                    # does not produce a synchronized re-register storm
+                    delay = backoff_with_jitter(attempt, self._rng)
+                    log.info("agent session failed (%s); backing off "
+                             "%.2fs (attempt %d)", e, delay, attempt + 1)
+                    self._stop.wait(timeout=delay)
+                    attempt += 1
         finally:
             self._done.set()
 

@@ -420,7 +420,12 @@ class Dispatcher:
             if n.status.state != NodeState.DOWN:
                 self._push_deadline(deadline, "reg", n.id)
 
-    def stop(self) -> None:
+    def stop(self, flush: bool = True) -> None:
+        """``flush=False`` drops buffered status updates instead of
+        writing them out — the deposed-leader teardown path: a fenced
+        proposer would reject the flush anyway, and the successor's
+        dispatcher re-learns task state from the agents' re-registration
+        (fresh COMPLETE assignment sets)."""
         self._stop.set()
         with self._mu:
             self._running = False
@@ -435,7 +440,8 @@ class Dispatcher:
         if getattr(self, "_cluster_sub", None) is not None:
             self.store.queue.unsubscribe(self._cluster_sub)
             self._cluster_sub = None
-        self._flush_updates()
+        if flush:
+            self._flush_updates()
 
     def _load_cluster_config(self) -> None:
         from ..state.store import ByName

@@ -140,8 +140,13 @@ class Manager:
         self.lifecycle.start()
         # black-box recording is always on for a live manager: recent
         # spans/samples/store events stay dumpable via /debug/flightrec
-        # whatever happens later
-        from ..obs.flightrec import flightrec
+        # whatever happens later.  The crash hook turns an unhandled
+        # exception in any control-loop thread into a dumped post-mortem
+        # (path + sha logged) instead of a silently-dead daemon thread.
+        from ..obs.flightrec import flightrec, install_crash_hook
+        if not getattr(self, "_crash_hook_installed", False):
+            install_crash_hook()
+            self._crash_hook_installed = True
         flightrec.enabled = True
         flightrec.watch_store(self.store)
         self.sampler.rebase()
@@ -236,7 +241,13 @@ class Manager:
             self._ca_sub = None
         self._become_follower()
         self.sampler.stop()
-        from ..obs.flightrec import flightrec
+        from ..obs.flightrec import flightrec, uninstall_crash_hook
+        # uninstall exactly the reference this instance took: a double
+        # stop() (or stop() without run()) must not strip a co-resident
+        # manager's hook out from under it (the ref count pairs 1:1)
+        if getattr(self, "_crash_hook_installed", False):
+            self._crash_hook_installed = False
+            uninstall_crash_hook()
         flightrec.unwatch_store(self.store)
         self.collector.stop()
         self.lifecycle.stop()
@@ -569,13 +580,27 @@ class Manager:
                      self.keymanager, self.volume_enforcer,
                      self.constraint_enforcer, self.reaper, self.jobs,
                      self.global_, self.replicated, self.scheduler,
-                     self.allocator, self.dispatcher]
+                     self.allocator]
             for loop in loops:
                 if loop is not None:
                     try:
                         loop.stop()
                     except Exception:
                         log.exception("stopping %r failed", loop)
+            if self.dispatcher is not None:
+                try:
+                    # flush buffered agent status updates only while the
+                    # proposer can still commit them: standalone always,
+                    # raft only if we are STILL the leader (a graceful
+                    # shutdown of a live leader must not drop reported
+                    # states).  On genuine deposal the epoch is fenced
+                    # and the flush would only raise — the successor's
+                    # dispatcher re-learns task state from the agents'
+                    # re-registration.
+                    self.dispatcher.stop(
+                        flush=self.raft is None or self.raft.is_leader)
+                except Exception:
+                    log.exception("stopping dispatcher failed")
             self.dispatcher = self.allocator = self.scheduler = None
             self.replicated = self.global_ = self.jobs = None
             self.csi_manager = None

@@ -45,6 +45,15 @@ def now() -> float:
     return _time_source()
 
 
+def time_source_installed() -> bool:
+    """True while a replacement time source (the simulator's virtual
+    clock) is live — consumers that would otherwise mix wall-clock
+    measurements into deterministic artifacts check this (e.g. the
+    planner zeroes compile-span durations so sim traces stay a pure
+    function of the seed)."""
+    return _time_source is not time.time
+
+
 class TaskState(enum.IntEnum):
     """Monotonic task lifecycle state (reference: api/types.proto:510).
 

@@ -33,11 +33,15 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
+import os
 import threading
 from collections import deque
 from typing import Any, Dict, List, Optional
 
 from ..models import types as _types
+
+log = logging.getLogger("flightrec")
 
 
 class Ring:
@@ -254,3 +258,79 @@ class FlightRecorder:
 
 # the process-wide recorder; obs.trace installs it as the tracer sink
 flightrec = FlightRecorder()
+
+
+# --------------------------------------------------------- crash hook
+#
+# Control-loop threads (scheduler, orchestrators, dispatcher worker,
+# the raft loop...) are daemon threads: an unhandled exception kills the
+# thread silently and the manager limps on without it.  The crash hook
+# turns that into evidence — the black box is dumped as a post-mortem
+# (path + sha logged) BEFORE the thread dies, with the crash itself as
+# the final note.  Installed by Manager.run, removed by Manager.stop;
+# ref-counted so co-resident managers (HA tests) compose.
+
+_crash_hook_lock = threading.Lock()
+_crash_hook_refs = 0
+_prev_excepthook = None
+_crash_seq = 0
+
+
+def _crash_dump(thread_name: str, exc_type, exc_value) -> None:
+    global _crash_seq
+    if not flightrec.enabled:
+        return
+    flightrec.note(f"thread {thread_name!r} crashed: "
+                   f"{exc_type.__name__}: {exc_value}")
+    safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                   for c in thread_name) or "thread"
+    d = os.environ.get("SWARM_FLIGHTREC_DIR") or "."
+    with _crash_hook_lock:
+        _crash_seq += 1
+        seq = _crash_seq
+    path = os.path.join(
+        d, f"flightrec_crash_{safe}_{os.getpid()}_{seq}.json")
+    try:
+        sha = flightrec.dump(path)
+    except OSError:
+        log.exception("crash post-mortem dump failed")
+        return
+    log.error("thread %r died with %s; flight-recorder post-mortem "
+              "dumped to %s (sha256 %s)", thread_name,
+              exc_type.__name__, path, sha)
+
+
+def _crash_excepthook(args) -> None:
+    try:
+        if args.exc_type is not SystemExit:
+            _crash_dump(getattr(args.thread, "name", None) or "unknown",
+                        args.exc_type, args.exc_value)
+    except Exception:
+        log.exception("flightrec crash hook failed")
+    finally:
+        prev = _prev_excepthook or threading.__excepthook__
+        prev(args)
+
+
+def install_crash_hook() -> None:
+    """Route ``threading.excepthook`` through the flight recorder
+    (chained: the previous hook still prints the traceback)."""
+    global _crash_hook_refs, _prev_excepthook
+    with _crash_hook_lock:
+        _crash_hook_refs += 1
+        if _crash_hook_refs == 1:
+            _prev_excepthook = threading.excepthook
+            threading.excepthook = _crash_excepthook
+
+
+def uninstall_crash_hook() -> None:
+    global _crash_hook_refs, _prev_excepthook
+    with _crash_hook_lock:
+        if _crash_hook_refs == 0:
+            return
+        _crash_hook_refs -= 1
+        if _crash_hook_refs == 0 \
+                and threading.excepthook is _crash_excepthook:
+            threading.excepthook = \
+                _prev_excepthook or threading.__excepthook__
+            _prev_excepthook = None

@@ -77,8 +77,16 @@ def counter_ratio(numerator: str, denominators: Tuple[str, ...]
     return get
 
 
+def gauge_value(name: str) -> Callable[[Registry], Optional[float]]:
+    """Latest value of a gauge; None (pass) until first export."""
+    def get(reg: Registry) -> Optional[float]:
+        return reg.get_gauge(name)
+    return get
+
+
 _ROUTES = tuple(f'swarm_planner_groups{{route="{r}"}}'
-                for r in ("device", "fallback", "host_small", "spill"))
+                for r in ("device", "fallback", "host_small", "spill",
+                          "breaker"))
 
 
 def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
@@ -109,6 +117,15 @@ def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
                             ("swarm_dispatcher_heartbeats",)),
               hb_warn, hb_fail, "ratio",
               ("swarm_dispatcher_heartbeat",)),
+        # device-path circuit breaker (ops/planner.py PlannerBreaker):
+        # 0=closed (pass), 1=half-open probing (warn), 2=open — every
+        # group on host fallback (fail).  Degraded throughput, not an
+        # outage: placements stay valid, so this is the check that says
+        # "the device is sick", not "the manager is down".
+        Check("planner_breaker",
+              gauge_value("swarm_planner_breaker_state"),
+              1.0, 2.0, "state",
+              ("swarm_planner_",)),
     ]
 
 

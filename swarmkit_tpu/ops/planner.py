@@ -94,7 +94,13 @@ def _observe_compile(fn, bucket: str, cache_before: Optional[int],
     _metrics.counter(f'swarm_planner_compiles{{bucket="{bucket}"}}',
                      after - cache_before)
     _COMPILE_TIMER.observe(dt)
-    tracer.record_complete("plan.compile", "plan", dt, bucket=bucket)
+    # under a virtual clock (the simulator) the wall-clock compile
+    # duration would be the ONLY nondeterministic bytes in an otherwise
+    # seed-pure span trace: keep the event, zero the duration
+    from ..models.types import time_source_installed
+    tracer.record_complete("plan.compile", "plan",
+                           0.0 if time_source_installed() else dt,
+                           bucket=bucket)
 
 
 def _bucket(n: int, buckets) -> Optional[int]:
@@ -167,6 +173,117 @@ def _probe_inputs():
     return nodes, group
 
 
+BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN = 0, 1, 2
+_BREAKER_NAMES = {BREAKER_CLOSED: "closed", BREAKER_HALF_OPEN: "half-open",
+                  BREAKER_OPEN: "open"}
+
+
+class PlannerBreaker:
+    """Degraded-mode circuit breaker for the device path.
+
+    N consecutive device dispatch/fetch failures trip the breaker OPEN:
+    every group routes to the host oracle (placements stay valid, the
+    tick never fails) until the cooldown elapses.  The breaker then goes
+    HALF-OPEN and admits a single probe group; a successful probe closes
+    it, a failed probe re-opens it with a doubled (capped) cooldown.
+    Successful closes decay the accumulated cooldown back toward the
+    base, so a device that recovers cleanly is re-trusted quickly while
+    a flapping one backs off geometrically.
+
+    State is exported as the ``swarm_planner_breaker_state`` gauge
+    (0=closed, 1=half-open, 2=open) — judged by the ``planner_breaker``
+    SLO check in obs/health — and every trip lands in the flight
+    recorder.  Time is read through ``models.types.now()`` so the sim
+    drives the cooldown deterministically.
+    """
+
+    def __init__(self, threshold: int = 3, cooldown: float = 30.0,
+                 max_cooldown: float = 480.0):
+        self.threshold = max(1, threshold)
+        self.base_cooldown = cooldown
+        self.max_cooldown = max_cooldown
+        self._state = BREAKER_CLOSED
+        self._failures = 0          # consecutive, resets on success
+        self._cooldown = cooldown
+        self._open_until = 0.0
+        self._probe_inflight = False
+        self.stats = {"trips": 0, "probes": 0, "failures": 0}
+        self._export()
+
+    def _export(self) -> None:
+        _metrics.gauge("swarm_planner_breaker_state", self._state)
+
+    @property
+    def state(self) -> int:
+        return self._state
+
+    @property
+    def state_name(self) -> str:
+        return _BREAKER_NAMES[self._state]
+
+    def allow_device(self) -> bool:
+        """Gate one group's device dispatch.  OPEN past its cooldown
+        flips to HALF-OPEN and admits exactly one probe; every other
+        caller stays on the host until the probe resolves."""
+        if self._state == BREAKER_CLOSED:
+            return True
+        if self._state == BREAKER_OPEN:
+            if now() < self._open_until:
+                return False
+            self._state = BREAKER_HALF_OPEN
+            self._probe_inflight = False
+            self._export()
+        # HALF_OPEN: single probe at a time
+        if self._probe_inflight:
+            return False
+        self._probe_inflight = True
+        self.stats["probes"] += 1
+        _metrics.counter("swarm_planner_breaker_probes")
+        return True
+
+    def abort_probe(self) -> None:
+        """The admitted group never reached the device (routed to host
+        for an unrelated reason): release the probe slot unchanged."""
+        self._probe_inflight = False
+
+    def record_success(self) -> None:
+        self._failures = 0
+        if self._state == BREAKER_HALF_OPEN:
+            self._probe_inflight = False
+            self._state = BREAKER_CLOSED
+            # decay the accumulated backoff toward the base: a clean
+            # recovery is re-trusted, a flapper keeps most of its penalty
+            self._cooldown = max(self.base_cooldown, self._cooldown / 2.0)
+            self._export()
+            log.info("planner breaker closed (device recovered)")
+
+    def record_failure(self) -> None:
+        self.stats["failures"] += 1
+        if self._state == BREAKER_HALF_OPEN:
+            # failed probe: back off harder
+            self._cooldown = min(self._cooldown * 2.0, self.max_cooldown)
+            self._trip()
+            return
+        self._failures += 1
+        if self._state == BREAKER_CLOSED \
+                and self._failures >= self.threshold:
+            self._trip()
+
+    def _trip(self) -> None:
+        self._state = BREAKER_OPEN
+        self._probe_inflight = False
+        self._failures = 0
+        self._open_until = now() + self._cooldown
+        self.stats["trips"] += 1
+        _metrics.counter("swarm_planner_breaker_trips")
+        self._export()
+        log.warning("planner breaker OPEN for %.1fs: device path "
+                    "degraded to host fallback", self._cooldown)
+        from ..obs.flightrec import flightrec
+        flightrec.note(f"planner breaker tripped open "
+                       f"(cooldown {self._cooldown:.1f}s)")
+
+
 class _InFlightPlan:
     """One dispatched-but-unfetched device plan: everything fetch_group
     needs to finish the group once the device triple lands."""
@@ -210,6 +327,9 @@ class TPUPlanner:
         # begin_tick, updated incrementally by the apply phase, invalidated
         # by host-path fallbacks (which mutate NodeInfos behind our back)
         self._cache = None
+        # degraded-mode circuit breaker: consecutive device failures trip
+        # the whole planner to host fallback instead of failing ticks
+        self.breaker = PlannerBreaker()
         # FIFO in-flight queue for the dispatch/fetch pipeline split:
         # plans dispatched via dispatch_group wait here until fetch_group
         # blocks on their D2H.  At most ONE plan may be in flight (the
@@ -228,7 +348,8 @@ class TPUPlanner:
     _ROUTE = {"groups_planned": "device",
               "groups_fallback": "fallback",
               "groups_small_to_host": "host_small",
-              "groups_spill_to_host": "spill"}
+              "groups_spill_to_host": "spill",
+              "groups_breaker_to_host": "breaker"}
 
     def _count(self, key: str, delta: int = 1) -> None:
         self.stats[key] = self.stats.get(key, 0) + delta
@@ -447,12 +568,19 @@ class TPUPlanner:
         if not self._supported(t):
             self._fallback()
             return None
+        if not self.breaker.allow_device():
+            # degraded mode: a sick device routes every group to the
+            # host oracle until the breaker's cooldown/probe admits it
+            self._count("groups_breaker_to_host")
+            self._cache = None   # host path mutates NodeInfos
+            return None
         if self.enable_small_group_routing and self._launch_overhead is None:
             self._measure_launch_overhead()
         if self.enable_small_group_routing and \
                 len(task_group) * self.host_cost_per_task \
                 < 0.8 * self._launch_overhead:
             self._count("groups_small_to_host")
+            self.breaker.abort_probe()   # never reached the device
             self._cache = None   # host path mutates NodeInfos
             return None
 
@@ -460,23 +588,37 @@ class TPUPlanner:
         _plan_t0 = _time.perf_counter()
         k = len(task_group)
         if k > K_CLAMP:  # beyond the kernel's 32-bit budget (see kernel.py)
+            self.breaker.abort_probe()
             self._fallback()
             return None
         if self._inflight:
+            self.breaker.abort_probe()
             raise RuntimeError(
                 "dispatch_group with a plan already in flight: fetch it "
                 "first (its apply feeds this group's input columns)")
         with tracer.span("plan.build_inputs", "plan", tasks=k):
             built = self._build_device_inputs(sched, t, k)
         if built is None:
+            self.breaker.abort_probe()
             self._fallback()
             return None
         if built[1] == 0:   # no valid nodes densified
+            self.breaker.abort_probe()
             return None
         nodes_in, group_in, L, hier = built[7], built[8], built[9], \
             built[10]
-        with tracer.span("plan.dispatch", "plan", tasks=k):
-            arrays = self._call_plan_fn(nodes_in, group_in, L, hier)
+        try:
+            with tracer.span("plan.dispatch", "plan", tasks=k):
+                arrays = self._call_plan_fn(nodes_in, group_in, L, hier)
+        except Exception:
+            # device dispatch failure degrades THIS group to the host
+            # path and feeds the breaker — a sick device trips to
+            # wholesale host fallback instead of failing the tick
+            log.exception("device dispatch failed; group routed to host")
+            self._count("groups_device_error")
+            self.breaker.record_failure()
+            self._cache = None
+            return None
         handle = _InFlightPlan(sched, t, task_group, decisions, built,
                                _plan_t0, arrays)
         self._inflight.append(handle)
@@ -777,33 +919,50 @@ class TPUPlanner:
             # toward active totals (nodeinfo.py:132 addTask guard) —
             # shutdown-marked stragglers take the host path
             return tasks
+        if not self.breaker.allow_device():
+            # breaker open: host loop validates; counted like
+            # dispatch_group so route breakdowns stay honest
+            self._count("groups_breaker_to_host")
+            return tasks
         if self.enable_small_group_routing:
             if self._launch_overhead is None:
                 self._measure_launch_overhead()
             if len(tasks) * self.host_cost_per_task < \
                     0.8 * self._launch_overhead:
+                self.breaker.abort_probe()
                 return tasks   # below device break-even: host loop
         import time as _time
         _plan_t0 = _time.perf_counter()
         with tracer.span("plan.build_inputs", "plan", tasks=len(tasks)):
             built = self._build_device_inputs(sched, t, len(tasks))
         if built is None or built[1] == 0:
+            self.breaker.abort_probe()
             return tasks
         (infos, n, nb, valid, cpu, mem, total, nodes_in, group_in, L,
          hier, cpu_d, mem_d, gen_wanted, port_limited) = built
         if gen_wanted or port_limited:
+            self.breaker.abort_probe()
             return tasks   # per-task claim bookkeeping: host path
 
         import jax as _jax
-        with tracer.span("plan.feasibility", "plan", tasks=len(tasks)):
-            _feas_bucket = "feas_" + _bucket_label(nodes_in, group_in,
-                                                   1, ())
-            _cache_before = _jit_cache_size(feasibility_jit)
-            _feas_t0 = _time.perf_counter()
-            mask, cap, _ = _jax.device_get(
-                feasibility_jit(nodes_in, group_in))
-            _observe_compile(feasibility_jit, _feas_bucket, _cache_before,
-                             _time.perf_counter() - _feas_t0)
+        try:
+            with tracer.span("plan.feasibility", "plan", tasks=len(tasks)):
+                _feas_bucket = "feas_" + _bucket_label(nodes_in, group_in,
+                                                       1, ())
+                _cache_before = _jit_cache_size(feasibility_jit)
+                _feas_t0 = _time.perf_counter()
+                mask, cap, _ = _jax.device_get(
+                    feasibility_jit(nodes_in, group_in))
+                _observe_compile(feasibility_jit, _feas_bucket,
+                                 _cache_before,
+                                 _time.perf_counter() - _feas_t0)
+        except Exception:
+            log.exception("device feasibility failed; host validates")
+            self._count("groups_device_error")
+            self.breaker.record_failure()
+            self._cache = None
+            return tasks
+        self.breaker.record_success()
         col = {info.node.id: i for i, info in enumerate(infos)}
 
         items = []      # (task_id, task) admitted
@@ -834,7 +993,11 @@ class TPUPlanner:
     def discard_inflight(self) -> None:
         """Drop dispatched-but-unfetched plans (aborted tick): their
         results are never applied, and the column cache is invalidated
-        since mirrors may no longer match what was densified."""
+        since mirrors may no longer match what was densified.  A
+        discarded plan may have been the breaker's half-open probe —
+        release the slot (no outcome observed) or the breaker would
+        stay wedged in half-open with no path back to the device."""
+        self.breaker.abort_probe()
         if self._inflight:
             self._inflight.clear()
             self._cache = None
@@ -863,9 +1026,22 @@ class TPUPlanner:
         k = len(task_group)
         # one round-trip for all outputs: D2H latency dominates over
         # tunneled links, so never fetch twice
-        with tracer.span("plan.d2h", "plan"):
-            x, fail_counts, spill = fetch_plan(handle.arrays)
+        try:
+            with tracer.span("plan.d2h", "plan"):
+                x, fail_counts, spill = fetch_plan(handle.arrays)
+        except Exception:
+            # fetch failure: the plan is lost but the group is not — it
+            # re-runs through the host oracle (return False), and the
+            # breaker counts the device failure
+            log.exception("device fetch failed; group routed to host")
+            handle.arrays = None
+            self._observe_plan(_time.perf_counter() - _plan_t0)
+            self._count("groups_device_error")
+            self.breaker.record_failure()
+            self._cache = None
+            return False
         handle.arrays = None
+        self.breaker.record_success()
         if bool(spill):
             # a spread branch saturated: the host oracle's convergence
             # loop redistributes differently than the water-fill in that

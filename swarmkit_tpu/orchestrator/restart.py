@@ -60,12 +60,16 @@ class _DelayedStart:
 
 
 class Supervisor:
-    def __init__(self, store: MemoryStore):
+    def __init__(self, store: MemoryStore, start_worker: bool = True):
+        """``start_worker=False`` runs no timer thread: the caller (the
+        deterministic simulator) pumps ``drive()`` under its own clock —
+        identical deadline/wait-stop semantics, zero threads."""
         self.store = store
         self._mu = threading.Lock()
         self._delays: Dict[str, _DelayedStart] = {}
         self._history: Dict[str, Dict[common.SlotTuple, _RestartInfo]] = {}
         self.task_timeout = DEFAULT_OLD_TASK_TIMEOUT
+        self._start_worker = start_worker
         self._worker: Optional[threading.Thread] = None
         self._stopped = False
         self._heap: List = []   # (deadline, seq, _DelayedStart)
@@ -276,16 +280,37 @@ class Supervisor:
         return ds.done
 
     def _ensure_worker_locked(self) -> None:
+        if self._sub is None:
+            # accepts_blocks: pred drops them — assignment blocks are
+            # state<=RUNNING by store contract, never failures
+            self._sub = self.store.queue.subscribe(
+                self._event_pred, accepts_blocks=True)
+        if not self._start_worker:
+            return   # simulator mode: drive() pumps instead of a thread
         if self._worker is None or not self._worker.is_alive():
             self._stopped = False
-            if self._sub is None:
-                # accepts_blocks: pred drops them — assignment blocks are
-                # state<=RUNNING by store contract, never failures
-                self._sub = self.store.queue.subscribe(
-                    self._event_pred, accepts_blocks=True)
             self._worker = threading.Thread(
                 target=self._worker_loop, name="restart-timer", daemon=True)
             self._worker.start()
+
+    def drive(self) -> None:
+        """One synchronous pump of the timer machinery (start_worker=False
+        mode): handle buffered stop events, sweep cancellations, fire due
+        deadlines.  Exactly one _worker_loop iteration, minus the thread
+        and the blocking get — the simulator calls this every control
+        step under virtual time."""
+        from ..state.watch import Subscription
+        if self._sub is None:
+            with self._mu:
+                self._ensure_worker_locked()
+        while True:
+            ev = self._sub.poll()
+            if ev is None:
+                break
+            if ev is not Subscription.WAKE:
+                self._handle_stop_event(ev)
+        self._sweep_cancelled()
+        self._fire_due()
 
     @staticmethod
     def _event_pred(ev) -> bool:

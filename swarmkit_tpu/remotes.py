@@ -24,7 +24,37 @@ DEFAULT_OBSERVATION_WEIGHT = 10
 REMOTE_WEIGHT_MAX = 1 << 8
 REMOTE_WEIGHT_MIN = -(1 << 8)
 
+# reconnect backoff ladder (agent sessions; reference: agent.go's
+# session backoff, hardened with full jitter per the AWS exponential
+# backoff guidance so a mass disconnect does not reconnect in lockstep)
+RECONNECT_BACKOFF_BASE = 0.1
+RECONNECT_BACKOFF_CAP = 8.0
+
 Addr = Tuple[str, int]
+
+
+def backoff_with_jitter(attempt: int,
+                        rng: Optional[random.Random] = None,
+                        base: float = RECONNECT_BACKOFF_BASE,
+                        cap: float = RECONNECT_BACKOFF_CAP) -> float:
+    """Jittered exponential backoff: with ``ceiling = min(cap,
+    base * 2^attempt)``, the delay is drawn uniformly from
+    ``[0.1 * ceiling, ceiling]`` — AWS-style full jitter, floored at a
+    tenth of the ceiling so a long backoff can never collapse into a
+    hot reconnect loop.
+
+    ``attempt`` counts consecutive failures starting at 0.  The ceiling
+    caps at ``cap`` however large ``attempt`` grows (no overflow: the
+    exponent is clamped first).  Drawing through an injected ``rng``
+    keeps simulated reconnect storms deterministic per seed while still
+    de-synchronizing the fleet: two agents sharing a failure instant
+    draw different delays from their own streams.
+    """
+    rng = rng or random
+    ceiling = min(cap, base * (2.0 ** min(attempt, 30)))
+    # avoid a zero sleep (a hot reconnect loop) while keeping the
+    # spread: the floor is a tenth of the current ceiling
+    return ceiling * (0.1 + 0.9 * rng.random())
 
 
 class NoSuchRemote(Exception):
@@ -32,11 +62,12 @@ class NoSuchRemote(Exception):
 
 
 class Remotes:
-    def __init__(self, *addrs: Addr):
+    def __init__(self, *addrs: Addr, rng: Optional[random.Random] = None):
         self._mu = threading.Lock()
         self._weights: Dict[Addr, int] = {
             tuple(a): DEFAULT_OBSERVATION_WEIGHT for a in addrs}
-        self._rng = random.Random()
+        # injectable rng seam: deterministic peer selection in the sim
+        self._rng = rng or random.Random()
 
     def observe(self, addr: Addr, weight: int = DEFAULT_OBSERVATION_WEIGHT
                 ) -> None:
@@ -93,13 +124,14 @@ class PersistentRemotes(Remotes):
     the persisted peers with any seed addresses — so a restarted worker
     can reach the cluster even when its original --join-addr is gone."""
 
-    def __init__(self, path: str, *addrs: Addr):
+    def __init__(self, path: str, *addrs: Addr,
+                 rng: Optional[random.Random] = None):
         self._path = path
         # file writes serialize separately from the weights lock: the
         # session loop and the log shipper can both trigger membership
         # saves concurrently
         self._save_mu = threading.Lock()
-        super().__init__(*addrs)
+        super().__init__(*addrs, rng=rng)
         for addr in self._load():
             if tuple(addr) not in self._weights:
                 self._weights[tuple(addr)] = DEFAULT_OBSERVATION_WEIGHT

@@ -106,9 +106,22 @@ class _TickCommitter:
             for old, nid in zip(olds, nids)]
 
     def _lost_leadership(self) -> bool:
+        """Fail-fast check before touching the store.  The epoch
+        comparison is the load-bearing one: the tick's drafts are pinned
+        to the leadership epoch captured at tick start, so a deposal —
+        even a depose-and-re-elect flap this thread never observes as a
+        role change — fences the remaining drafts.  (The proposer
+        re-checks the same epoch pre-WAL and at commit delivery, so this
+        racy fast-path can only ever fail early, never admit late.)"""
         proposer = self._sched.store._proposer
-        return (proposer is not None
-                and not getattr(proposer, "is_leader", True))
+        if proposer is None:
+            return False
+        if not getattr(proposer, "is_leader", True):
+            return True
+        tick_epoch = self._sched._tick_epoch
+        return (tick_epoch is not None
+                and getattr(proposer, "leadership_epoch", None)
+                != tick_epoch)
 
     def _run(self) -> None:
         while True:
@@ -194,6 +207,9 @@ class Scheduler:
         self.block_draft: List[Tuple[List[Task], List[str], str]] = []
         self.block_mode = False
 
+        # leadership epoch captured at tick/preassigned-pass start; every
+        # commit of that pass is pinned to it (None = unfenced proposer)
+        self._tick_epoch: Optional[int] = None
         self._stop = threading.Event()
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -451,6 +467,8 @@ class Scheduler:
             self._process_preassigned_inner()
 
     def _process_preassigned_inner(self) -> None:
+        self._tick_epoch = getattr(self.store._proposer,
+                                   "leadership_epoch", None)
         decisions: Dict[str, SchedulingDecision] = {}
         pending = list(self.pending_preassigned_tasks.values())
         planner = self.batch_planner
@@ -511,6 +529,10 @@ class Scheduler:
     def _tick_inner(self) -> int:
         t0 = now()
         self.stats["ticks"] += 1
+        # one reign per tick: every draft planned below commits under the
+        # epoch read here or not at all (leadership-epoch fencing)
+        self._tick_epoch = getattr(self.store._proposer,
+                                   "leadership_epoch", None)
         self.block_mode = self.store.supports_block_commit
         decisions: Dict[str, SchedulingDecision] = {}
 
@@ -748,7 +770,8 @@ class Scheduler:
                 c, f = self.store.commit_task_block(
                     olds, nids, int(TaskState.ASSIGNED), msg,
                     on_missing, on_assigned,
-                    guard_state=int(TaskState.ASSIGNED))
+                    guard_state=int(TaskState.ASSIGNED),
+                    epoch=self._tick_epoch)
             except Exception:
                 log.exception("scheduler block commit failed")
                 failed.extend(zip(olds, nids))
@@ -826,7 +849,8 @@ class Scheduler:
         try:
             committed, failed_idx = self.store.bulk_update_tasks(
                 fast_tasks, on_missing=self._delete_task,
-                on_assigned=on_assigned, guard_state=TaskState.ASSIGNED)
+                on_assigned=on_assigned, guard_state=TaskState.ASSIGNED,
+                epoch=self._tick_epoch)
             return ([fast[i] for i in committed],
                     [fast[i] for i in failed_idx])
         except Exception:

@@ -11,25 +11,36 @@ Two layers share one event loop, one virtual clock, and one seeded RNG:
   k WAL records ("died before fsync").  Messages route through
   ``SimNetwork`` with seeded delay/drop/duplication and partitions.
 
-* **Control-plane layer** — the real ``Scheduler`` and ``Dispatcher``
-  running single-threaded against a leader store under virtual time
-  (the dispatcher's worker thread is replaced by direct
-  ``process_deadlines`` calls; the scheduler's event loop by explicit
-  resync+tick steps), plus simulated agents that register, heartbeat,
-  advance task FSMs, and fail on command.  In this subsystem version
-  the control-plane store is standalone (not raft-attached); committed
-  raft entries and store commits are invariant-checked independently.
+* **Control-plane layer** — two modes share one agent/fault vocabulary:
+
+  - *standalone* (``SimControlPlane``, the original subsystem shape):
+    the real ``Scheduler`` and ``Dispatcher`` run single-threaded
+    against one standalone leader store under virtual time while the
+    consensus layer churns alongside; committed raft entries and store
+    commits are invariant-checked independently.
+  - *raft-attached* (``RaftControlPlane``, the failover scenarios):
+    EVERY member owns a replicated ``MemoryStore`` fed from its raft
+    log; the full control plane — scheduler, dispatcher, restart
+    supervisor, replicated + global orchestrators — cold-starts on
+    whichever member is the ready leader, writing through a
+    member-bound ``SimRaftProposer`` (leadership-epoch fenced), and is
+    torn down by the member's own role-transition handler the instant
+    it is deposed.  Blocking on consensus pumps VIRTUAL time
+    (re-entrant ``engine.run_until``), so agent traffic, elections and
+    faults keep flowing while a control write is in flight.
 
 Determinism contract: all object ids the simulation creates are
-deterministic strings, every random draw comes from the engine's seeded
-RNG tree, and RaftCore broadcasts iterate peers in sorted order — so a
-run's trace hash is a pure function of (scenario, seed).
+deterministic strings (``utils.identity.set_id_source`` is installed
+for the run, so even orchestrator-created tasks get seeded ids), every
+random draw comes from the engine's seeded RNG tree, and RaftCore
+broadcasts iterate peers in sorted order — so a run's trace hash is a
+pure function of (scenario, seed).
 """
 
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..manager.dispatcher import Config_ as DispatcherConfig, Dispatcher, \
     DispatcherError
@@ -44,21 +55,39 @@ from ..scheduler.filters import VolumesFilter
 from ..state.raft.core import (
     ENTRY_CONF, Entry, HardState, LEADER, RaftCore,
 )
+from ..state.raft.node import NotLeader, ProposalDropped, StaleEpoch
 from ..state.store import MemoryStore
+from ..utils.identity import set_id_source
 from .engine import SimEngine
 from .faults import NetConfig, SimNetwork
 from .invariants import (
     RaftInvariants, TaskInvariants, Violations, entry_digest,
 )
 
+#: entry-data prefix marking replicated control-plane store actions —
+#: member stores apply (only) these; opaque workload payloads and the
+#: standalone scenarios' store traffic are invisible to them
+CP_MAGIC = b"cpstore:"
+
+#: the failures the sim treats as "leadership/RPC fallout, retry later"
+#: — enumerated (DispatcherError covers invalid/expired sessions,
+#: NotLeader/StaleEpoch/ProposalDropped cover a deposal landing inside
+#: a store write), NOT a blanket Exception: the simulator exists to
+#: surface unexpected control-plane crashes, so anything else must
+#: propagate and fail the scenario loudly.  Shared by the agents'
+#: dispatcher RPCs and the control plane's own step/attach paths.
+AGENT_RPC_ERRORS = (DispatcherError, NotLeader, ProposalDropped)
+
 
 class SimManager:
-    """One raft member with an in-memory durable WAL."""
+    """One raft member with an in-memory durable WAL and (in
+    raft-attached mode) a replicated control-plane store."""
 
     TICK = 0.1   # seconds of virtual time per raft tick
 
     def __init__(self, member_id: str, peers: List[str], engine: SimEngine,
-                 net: SimNetwork, raft_inv: RaftInvariants):
+                 net: SimNetwork, raft_inv: RaftInvariants,
+                 with_store: bool = False):
         self.id = member_id
         self.peers = list(peers)
         self.engine = engine
@@ -70,11 +99,32 @@ class SimManager:
         # durable state ("disk"): survives crashes, lost records only
         # through explicit truncation faults
         self._wal_records: List[tuple] = []   # ("hs", HardState)|("ent", Entry)
-        # apply tap for data entries: (member_id, entry) per applied
-        # non-conf entry — SimRaftProposer completes its waiters (and
-        # runs store commit callbacks in the apply path) through this,
-        # mirroring RaftNode._apply_entry's waiter handling
-        self.on_apply = None
+        # apply taps for data entries: each is called (member_id, entry)
+        # per applied non-conf entry and returns True when it consumed
+        # the apply (ran the proposing store's commit callback) —
+        # SimRaftProposer completes its waiters through this, mirroring
+        # RaftNode._apply_entry's waiter handling.  Unconsumed CP_MAGIC
+        # entries replay into the member's replicated store below.
+        self.apply_taps: List[Callable[[str, Entry], bool]] = []
+        # role-transition hooks (member, role, term) — the raft-attached
+        # control plane detaches/fences through these; re-wired across
+        # restarts because _new_core rebuilds the core object
+        self.transition_hooks: List[Callable[["SimManager", str, int],
+                                             None]] = []
+        # replicated control-plane store (raft-attached mode): rebuilt
+        # from the WAL on restart like a real manager's
+        self.store: Optional[MemoryStore] = MemoryStore() if with_store \
+            else None
+        self._with_store = with_store
+        # the member-bound proposer wired into self.store._proposer by
+        # the control plane; kept here so restart() re-wires it into the
+        # REBUILT store (a proposer-less rebuild would silently commit
+        # post-restart writes locally, without consensus or fencing)
+        self.store_proposer = None
+        # entries whose store apply must wait: the store's update lock is
+        # held by an in-flight local proposal (single thread), so remote
+        # applies queue here and drain on the next tick after release
+        self._deferred_entries: List[Entry] = []
         self.restarts = 0
         self.core = self._new_core()
         net.register(member_id, self._on_message)
@@ -83,12 +133,18 @@ class SimManager:
     def _new_core(self) -> RaftCore:
         core = RaftCore(self.id, self.peers, rng=self.engine.fork_rng(),
                         prevote=True)
+        core.on_transition = self._on_transition
+        return core
+
+    def _on_transition(self, member_id: str, role: str, term: int) -> None:
         # role transitions land in the flight recorder under virtual
         # time — part of the deterministic post-mortem a failing seed
-        # dumps (scenario.run_scenario)
+        # dumps (scenario.run_scenario) — then fan out to control-plane
+        # hooks (detach-and-fence on deposal)
         from ..obs.flightrec import flightrec
-        core.on_transition = flightrec.record_raft
-        return core
+        flightrec.record_raft(member_id, role, term)
+        for hook in list(self.transition_hooks):
+            hook(self, role, term)
 
     # ------------------------------------------------------------ event loop
 
@@ -97,6 +153,7 @@ class SimManager:
             if self.stopped:
                 return
             if self.alive:
+                self._drain_deferred()
                 self.core.tick()
                 self.pump()
             self.engine.after(self.TICK * self.tick_scale,
@@ -131,7 +188,7 @@ class SimManager:
         if self.core.role == LEADER:
             self.raft_inv.observe_leader(self.core.term, self.id)
 
-    def _apply(self, e: Entry) -> None:
+    def _apply(self, e: Entry, replay: bool = False) -> None:
         self.raft_inv.observe_apply(self.id, e.index, e.term,
                                     f"{e.type}:{entry_digest(e.data)}")
         if e.type == ENTRY_CONF:
@@ -141,8 +198,49 @@ class SimManager:
             except Exception:
                 pass
             return
-        if self.on_apply is not None and e.data:
-            self.on_apply(self.id, e)
+        if not e.data:
+            return
+        consumed = False
+        if not replay:
+            # give proposers a chance to run the proposing store's commit
+            # callback in the apply path (RaftNode._apply_entry parity);
+            # a fenced/cancelled waiter leaves the entry unconsumed and
+            # it replays into the member store like a remote entry
+            for tap in list(self.apply_taps):
+                if tap(self.id, e):
+                    consumed = True
+                    break
+        if consumed or self.store is None \
+                or not e.data.startswith(CP_MAGIC):
+            return
+        if not replay and (self._deferred_entries
+                           or self.store._update_lock._lock.locked()):
+            # the single thread is inside this store's own update (an
+            # in-flight proposal pumping virtual time): applying now
+            # would deadlock on the update lock.  Queue in log order;
+            # the tick loop drains after the lock is released.
+            self._deferred_entries.append(e)
+            return
+        self._apply_store_entry(e)
+
+    def _apply_store_entry(self, e: Entry) -> None:
+        from ..state import serde
+        try:
+            actions = [serde.action_from_dict(d)
+                       for d in serde.loads_dict(e.data[len(CP_MAGIC):])]
+            self.store.apply_store_actions(actions)
+        except Exception as exc:
+            # a member store that cannot apply a committed entry is
+            # DIVERGED — that must fail the run loudly, not limp on
+            self.raft_inv.v.record(
+                "store-apply-failed",
+                f"{self.id} failed to apply committed entry {e.index}: "
+                f"{type(exc).__name__}: {exc}")
+
+    def _drain_deferred(self) -> None:
+        while self._deferred_entries \
+                and not self.store._update_lock._lock.locked():
+            self._apply_store_entry(self._deferred_entries.pop(0))
 
     # ---------------------------------------------------------------- faults
 
@@ -160,6 +258,9 @@ class SimManager:
         if not self.alive:
             return
         self.alive = False
+        # volatile state dies with the process: un-applied remote
+        # entries will be re-applied from the WAL on restart
+        self._deferred_entries.clear()
         if truncate_wal > 0:
             dropped = self._wal_records[-truncate_wal:]
             del self._wal_records[-truncate_wal:]
@@ -176,12 +277,19 @@ class SimManager:
         hs, entries = self._replay_wal()
         self.core = self._new_core()
         self.core.load(hs, entries, None)
+        if self._with_store:
+            # rebuild the replicated store from the WAL, like a real
+            # manager's bootstrap: replaying the committed prefix below
+            # converges it bit-for-bit with the cluster's stores.  The
+            # member-bound proposer carries over — if this member leads
+            # again, its writes must ride consensus, fenced, as before.
+            self.store = MemoryStore(proposer=self.store_proposer)
         # re-apply the committed prefix to the (new) state machine; the
         # invariant ledger cross-checks every re-applied entry
         for e in self.core.entries_from(1):
             if e.index > self.core.commit_index:
                 break
-            self._apply(e)
+            self._apply(e, replay=True)
             self.core.applied_index = e.index
         self.alive = True
         self.net.rejoin(self.id)
@@ -251,7 +359,20 @@ class SimAgent:
     def step(self) -> None:
         if not self.alive or self.partitioned:
             return
-        d = self.cp.dispatcher
+        cp = self.cp
+        if getattr(cp, "busy", False):
+            # a control-plane write is pumping virtual time through this
+            # very event: touching the leader store now would deadlock
+            # the single thread on its update lock.  Model it as RPC
+            # backpressure — retry on the next agent step.
+            return
+        d = cp.dispatcher
+        if d is None:
+            return   # no leader control plane right now (failover gap)
+        drain = getattr(cp, "drain_deferred", None)
+        if drain is not None:
+            drain()   # never stage an RPC's write over a deferred backlog
+        cp.busy = True
         try:
             if self.session is None:
                 self.session, _ = d.register(
@@ -260,14 +381,29 @@ class SimAgent:
                 self.engine.log(f"agent {self.node_id} registered")
             else:
                 d.heartbeat(self.node_id, self.session)
-        except DispatcherError:
+            # keep using the dispatcher captured above: the register/
+            # heartbeat pump may have deposed the leader mid-step, and
+            # the cp.dispatcher property would now be None — a stopped
+            # dispatcher raises DispatcherError, which is handled
+            self._advance_tasks(d)
+        except AGENT_RPC_ERRORS:
+            # an RPC failure — invalid session, dispatcher stopping, a
+            # proposal fenced by leadership loss — drops the session;
+            # the agent re-registers with whoever leads next
             self.session = None
-            return
-        self._advance_tasks()
+        finally:
+            cp.busy = False
 
-    def _advance_tasks(self) -> None:
+    def _advance_tasks(self, d=None) -> None:
         from ..state.store import ByNode
-        tasks = self.cp.store.view(
+        if d is None:
+            d = self.cp.dispatcher
+            if d is None:
+                return
+        store = self.cp.store
+        if store is None:
+            return
+        tasks = store.view(
             lambda tx: tx.find(Task, ByNode(self.node_id)))
         updates = []
         for t in sorted(tasks, key=lambda t: t.id):
@@ -293,9 +429,8 @@ class SimAgent:
                     state=nxt, timestamp=now(), message="sim")))
         if updates:
             try:
-                self.cp.dispatcher.update_task_status(
-                    self.node_id, self.session, updates)
-            except DispatcherError:
+                d.update_task_status(self.node_id, self.session, updates)
+            except AGENT_RPC_ERRORS:
                 self.session = None
 
     # ---------------------------------------------------------------- faults
@@ -321,46 +456,101 @@ class SimRaftProposer:
     """MemoryStore ``Proposer`` backed by the sim's consensus layer:
     proposals ride the real RaftCore through SimNetwork faults, and
     commit callbacks run in the proposing member's apply path (the
-    ``SimManager.on_apply`` tap), mirroring RaftNode's waiter handling.
+    ``SimManager.apply_taps`` seam), mirroring RaftNode's waiter
+    handling.
+
+    Two modes:
+
+    * **unbound** (``member=None``) — routes each proposal to whichever
+      member currently leads; the original shape the pipelined-commit
+      scenario drives a standalone store with.
+    * **member-bound** — the proposer IS one member's consensus
+      identity (RaftNode parity): proposals are refused unless that
+      member is the ready leader, every proposal carries the
+      leadership epoch it was created under, entry data is tagged
+      ``CP_MAGIC`` so every member's replicated store applies it, and
+      the commit callback is fenced — a proposal whose epoch was
+      fenced (deposal, explicit ``fence_epoch``) fails WITHOUT running
+      its commit callback even when the entry itself commits (the
+      member store then converges through the remote-apply path,
+      exactly like RaftNode).  ``enforce_fencing=False`` disables the
+      fence (checker-sensitivity tests): a stale commit then RUNS and
+      the ``no-stale-epoch-commit`` invariant must catch it.
 
     Implements the async pair (``propose_async``/``wait_proposal``) the
     store's chunk-pipelined block commit uses, so leader churn against
     in-flight pipelined proposals is simulatable deterministically.
-    ``wait_proposal`` advances VIRTUAL time by pumping the engine, so it
-    must only be driven from top-level scenario code — never from inside
-    an engine event (the engine loop is not re-entrant).
+    ``wait_proposal`` advances VIRTUAL time by pumping the engine;
+    ``engine.run_until`` is re-entrant, so this may be driven from
+    inside engine events (control steps) as well as top-level code.
     """
 
     PUMP = 0.05      # virtual seconds per wait slice
     TIMEOUT = 30.0   # virtual seconds before a proposal is abandoned
 
-    def __init__(self, sim: "Sim"):
+    def __init__(self, sim: "Sim", member: Optional[SimManager] = None,
+                 violations: Optional[Violations] = None):
         self.sim = sim
+        self.member = member
+        self.violations = violations
+        self.enforce_fencing = True
         self._pending: Dict[tuple, dict] = {}
-        self.stats = {"proposed": 0, "committed": 0, "dropped": 0}
-        for m in sim.managers:
-            m.on_apply = self._on_apply
+        self.stats = {"proposed": 0, "committed": 0, "dropped": 0,
+                      "stale_epoch_rejects": 0}
+        if member is not None:
+            member.apply_taps.append(self._on_apply)
+        else:
+            for m in sim.managers:
+                m.apply_taps.append(self._on_apply)
+
+    # ------------------------------------------------------------- fencing
+
+    @property
+    def leadership_epoch(self) -> Optional[int]:
+        """Fencing token for the store's epoch pinning (RaftNode
+        parity); None in unbound mode (no fencing identity)."""
+        if self.member is None:
+            return None
+        return self.member.core.leadership_epoch
 
     # ------------------------------------------------------------- proposer
 
-    def propose_async(self, actions, commit_cb=None) -> dict:
+    def propose_async(self, actions, commit_cb=None, epoch=None) -> dict:
         from ..state import serde
-        leader = self.sim.leader()
-        if leader is None:
-            raise RuntimeError("no ready raft leader to propose to")
+        if self.member is not None:
+            target = self.member
+            core = target.core
+            if core.role != LEADER or not core.leader_ready \
+                    or not target.alive:
+                raise NotLeader(f"{target.id} is not a ready leader")
+            cur = core.leadership_epoch
+            if epoch is None:
+                epoch = cur
+            elif epoch != cur:
+                # pre-serialization fence (RaftNode parity): the reign
+                # this commit was planned under is over
+                self.stats["stale_epoch_rejects"] += 1
+                raise StaleEpoch(
+                    f"{target.id}: proposal epoch {epoch} fenced "
+                    f"(current {cur})")
+        else:
+            target = self.sim.leader()
+            if target is None:
+                raise RuntimeError("no ready raft leader to propose to")
         data = serde.dumps([serde.action_to_dict(a) for a in actions])
-        index = leader.core.propose(data)
-        leader.pump()
-        waiter = {"member": leader, "index": index,
+        if self.member is not None:
+            data = CP_MAGIC + data
+        index = target.core.propose(data)
+        target.pump()
+        waiter = {"member": target, "index": index, "epoch": epoch,
                   "commit_cb": commit_cb, "done": False, "ok": False,
                   "deadline": self.sim.engine.clock.elapsed()
                   + self.TIMEOUT}
-        self._pending[(leader.id, index)] = waiter
+        self._pending[(target.id, index)] = waiter
         self.stats["proposed"] += 1
         return waiter
 
     def wait_proposal(self, waiter: dict) -> None:
-        from ..state.raft.node import ProposalDropped
         eng = self.sim.engine
         while not waiter["done"]:
             m = waiter["member"]
@@ -371,28 +561,74 @@ class SimRaftProposer:
                 # manager rebuilds its store from the WAL on restart)
                 self._fail(waiter)
                 break
+            if waiter["epoch"] is not None \
+                    and m.core.leadership_epoch != waiter["epoch"]:
+                # fenced: deposed (or deposed-and-re-elected) since this
+                # proposal was created — fail fast, don't wait for the
+                # commit outcome
+                self._fail(waiter)
+                break
             if m.core.role != LEADER \
                     and m.core.commit_index < waiter["index"]:
                 self._fail(waiter)   # deposed before the entry committed
                 break
             if eng.clock.elapsed() >= waiter["deadline"]:
-                self._fail(waiter)
-                break
+                if waiter["epoch"] is not None and m.core.role == LEADER \
+                        and m.core.leadership_epoch == waiter["epoch"]:
+                    # a bound proposal is never abandoned while its reign
+                    # lasts (RaftNode has no proposal timeout either, by
+                    # design): failing it here would orphan an entry that
+                    # can still commit — and later apply BEHIND a newer
+                    # proposal's store write, inverting apply order on
+                    # the leader store.  Check-quorum deposes an isolated
+                    # leader within ~2 election timeouts, which fences
+                    # the epoch and fails this waiter properly.
+                    waiter["deadline"] = eng.clock.elapsed() + self.TIMEOUT
+                else:
+                    self._fail(waiter)
+                    break
             eng.run_until(eng.clock.elapsed() + self.PUMP)
         if not waiter["ok"]:
             self.stats["dropped"] += 1
             raise ProposalDropped("sim raft proposal dropped")
         self.stats["committed"] += 1
 
-    def propose(self, actions, commit_cb=None) -> None:
-        self.wait_proposal(self.propose_async(actions, commit_cb))
+    def propose(self, actions, commit_cb=None, epoch=None) -> None:
+        self.wait_proposal(self.propose_async(actions, commit_cb,
+                                              epoch=epoch))
 
     # ------------------------------------------------------------ apply tap
 
-    def _on_apply(self, member_id: str, entry) -> None:
+    def _on_apply(self, member_id: str, entry) -> bool:
+        """Apply-path waiter completion; returns True when this tap
+        consumed the entry (ran/settled the commit callback)."""
         waiter = self._pending.pop((member_id, entry.index), None)
         if waiter is None or waiter["done"]:
-            return
+            return False
+        if waiter["epoch"] is not None:
+            core = waiter["member"].core
+            stale = (core.role != LEADER
+                     or core.leadership_epoch != waiter["epoch"])
+            if stale:
+                if self.enforce_fencing:
+                    # commit-delivery fence: the entry committed but its
+                    # reign is over — the proposer sees failure and the
+                    # member store converges via the remote-apply path
+                    # (we return False so _apply replays it)
+                    self.stats["stale_epoch_rejects"] += 1
+                    waiter["done"] = True
+                    waiter["ok"] = False
+                    return False
+                if self.violations is not None:
+                    # fencing disabled (checker-sensitivity): the stale
+                    # commit callback WILL run — that is the safety
+                    # violation this invariant exists to catch
+                    self.violations.record(
+                        "no-stale-epoch-commit",
+                        f"{member_id} ran a commit callback for entry "
+                        f"{entry.index} proposed under epoch "
+                        f"{waiter['epoch']} (current "
+                        f"{core.leadership_epoch}, role {core.role})")
         ok = True
         if waiter["commit_cb"] is not None:
             try:
@@ -401,6 +637,7 @@ class SimRaftProposer:
                 ok = False
         waiter["ok"] = ok
         waiter["done"] = True
+        return True
 
     def _fail(self, waiter: dict) -> None:
         self._pending.pop((waiter["member"].id, waiter["index"]), None)
@@ -409,13 +646,16 @@ class SimRaftProposer:
 
 
 class SimControlPlane:
-    """The leader's store + real Scheduler + real Dispatcher, driven
-    synchronously under virtual time."""
+    """Standalone-mode control plane: one leader store + real Scheduler
+    + real Dispatcher, driven synchronously under virtual time while the
+    consensus layer churns alongside.  The raft-attached mode
+    (``RaftControlPlane`` below) is what the failover scenarios run."""
 
     def __init__(self, engine: SimEngine, violations: Violations,
                  n_agents: int, control_interval: float = 0.5):
         self.engine = engine
         self.stopped = False
+        self.busy = False   # agent-step guard (shared SimAgent surface)
         self.store = MemoryStore()
         self.invariants = TaskInvariants(violations, self.store)
         self.dispatcher = Dispatcher(
@@ -530,13 +770,448 @@ class SimControlPlane:
         self.engine.log(f"restart replaced {len(to_replace)}")
 
 
+class _InertUpdater:
+    """Stand-in for the rolling-update supervisor inside the simulator:
+    the real one spawns one worker thread per service update, which
+    would break the single-threaded determinism contract.  Scale churn
+    and crash/restart replacement — what the failover scenarios
+    exercise — never need it; spec-rollout updates are out of sim scope
+    (covered by tests/test_orchestrator.py against real threads)."""
+
+    def update(self, cluster, service, slots) -> None:
+        return None
+
+    def cancel_all(self) -> None:
+        return None
+
+
+class SimMemberControl:
+    """The real control plane cold-started on ONE member's replicated
+    store: scheduler, dispatcher, restart supervisor, and the
+    replicated + global orchestrators, all writing through the member's
+    epoch-fenced ``SimRaftProposer`` and all driven synchronously by
+    ``step()`` under virtual time.  Built when the member becomes the
+    ready leader; ``detach()``-ed (by the member's own role-transition
+    handler) the instant it is deposed."""
+
+    def __init__(self, member: SimManager, cp: "RaftControlPlane"):
+        from ..orchestrator import (
+            GlobalOrchestrator, ReplicatedOrchestrator, RestartSupervisor,
+        )
+        self.member = member
+        self.cp = cp
+        self.detached = False
+        store = member.store
+        self.store = store
+        store.pipeline_depth = cp.store_pipeline_depth
+        if cp.block_proposal_max_items is not None:
+            store.BLOCK_PROPOSAL_MAX_ITEMS = cp.block_proposal_max_items
+        self.dispatcher = Dispatcher(
+            store,
+            DispatcherConfig(heartbeat_period=2.0, heartbeat_epsilon=0.2,
+                             grace_multiplier=3.0, rate_limit_period=0.0,
+                             orphan_timeout=20.0),
+            rng=cp.engine.fork_rng())
+        from ..manager.allocator import Allocator
+        self.allocator = Allocator(store)
+        self.restarts = RestartSupervisor(store, start_worker=False)
+        planner = cp.planner_factory() if cp.planner_factory else None
+        # scheduler pipeline_depth=1: the tick committer THREAD would
+        # break determinism; store-level chunk-pipelined proposals
+        # (pipeline_depth above) are the pipelining under test here
+        self.scheduler = Scheduler(store, batch_planner=planner,
+                                   pipeline_depth=1)
+        self.scheduler.pipeline.add_filter(
+            VolumesFilter(self.scheduler.volumes))
+        self.replicated = ReplicatedOrchestrator(store,
+                                                 restarts=self.restarts)
+        self.global_ = GlobalOrchestrator(store, restarts=self.restarts)
+        inert = _InertUpdater()
+        self.replicated.updater = inert
+        self.global_.updater = inert
+        # (orchestrator, subscription, tick) driver tuples — the event
+        # loops of the real orchestrators, minus their threads
+        self._drivers: List[tuple] = []
+
+    def cold_start(self) -> None:
+        """Adopt the replicated store: dispatcher up, scheduler mirrors
+        built, orchestrators init'd + startup task-consistency pass
+        (taskinit re-arms the previous leader's delayed restarts).
+        Store writes here ride consensus — the caller handles a
+        mid-cold-start deposal by detaching and retrying later."""
+        from ..orchestrator import taskinit
+        store = self.store
+        self.dispatcher.run(start_worker=False)
+        store.view(self.scheduler._setup_tasks_list)
+        # allocator first: it moves NEW tasks to PENDING — the state the
+        # scheduler and orchestrators act on
+        sub = store.queue.subscribe(accepts_blocks=True)
+        self._drivers.append((self.allocator, sub, self.allocator._tick))
+        self.allocator._resync()
+        for orch, tick in ((self.replicated, self.replicated._tick),
+                           (self.global_, self.global_._tick_tasks)):
+            sub = store.queue.subscribe(accepts_blocks=True)
+            self._drivers.append((orch, sub, tick))
+            taskinit.check_tasks(store, store.view(), orch, self.restarts)
+            orch._resync()
+
+    def step(self) -> None:
+        """One synchronous control-plane step, mirroring the production
+        loops' cadence: dispatcher deadlines + status flush, scheduler
+        resync/preassigned/tick, orchestrator event intake + ticks,
+        restart timer pump.  Aborts between phases once detached — a
+        deposal can land inside any store write below."""
+        from ..state.events import Event, EventSnapshotRestore
+        self.dispatcher.process_deadlines()
+        if self.detached:
+            # a deposal landed inside process_deadlines' store write:
+            # the buffered statuses die with the reign (detach chose
+            # dispatcher.stop(flush=False)) — flushing them here would
+            # be the deposed-loops-still-writing bug the invariant hunts
+            return
+        self.dispatcher._flush_updates()
+        if self.detached:
+            return
+        self.scheduler._resync()
+        if self.scheduler.pending_preassigned_tasks:
+            self.scheduler._process_preassigned_tasks()
+        n = self.scheduler.tick()
+        if n:
+            self.cp.engine.log(f"scheduler assigned {n}")
+        for orch, sub, tick in self._drivers:
+            if self.detached:
+                return
+            while True:
+                ev = sub.poll()
+                if ev is None:
+                    break
+                if isinstance(ev, EventSnapshotRestore):
+                    orch._resync()
+                elif isinstance(ev, Event):
+                    orch._handle_event(ev)
+            tick()
+        if self.detached:
+            return
+        self.restarts.drive()
+
+    def detach(self) -> None:
+        """Tear the loops down WITHOUT writing to the store: a deposed
+        member's buffered work must die with its reign (the successor
+        re-learns everything from the replicated store + agent
+        re-registration), and detach can run nested inside one of this
+        member's own in-flight proposals, where a store write would
+        deadlock the single thread."""
+        if self.detached:
+            return
+        self.detached = True
+        try:
+            self.restarts.stop()     # cancels delayed starts; threadless
+        except Exception:
+            pass
+        for _, sub, _ in self._drivers:
+            try:
+                self.store.queue.unsubscribe(sub)
+            except Exception:
+                pass
+        self._drivers.clear()
+        try:
+            self.dispatcher.stop(flush=False)
+        except Exception:
+            pass
+
+
+class RaftControlPlane:
+    """Raft-attached control plane (ROADMAP item 8): every member holds
+    a replicated store, the full control plane runs on the current
+    leader only, and leadership hand-off is driven by the members' own
+    role transitions — stop the old leader's loops, fence its epoch,
+    cold-start on the successor from the replicated store.
+
+    Safety is watched continuously by two invariants on top of the
+    shared checkers:
+
+    * ``control-loops-only-on-leader`` — every control step verifies the
+      attached loops belong to a live, current leader; a deposed member
+      still holding loops is a violation (the transition handler must
+      have detached it).
+    * ``no-stale-epoch-commit`` — recorded by the member-bound proposers
+      when a commit callback would run under a fenced epoch (only
+      reachable with ``enforce_fencing`` disabled; the
+      checker-sensitivity test proves the checker fires).
+    """
+
+    def __init__(self, engine: SimEngine, violations: Violations,
+                 sim: "Sim", n_agents: int,
+                 control_interval: float = 0.5):
+        self.engine = engine
+        self.violations = violations
+        self.sim = sim
+        self.n_agents = n_agents
+        self.stopped = False
+        self.busy = False
+        self.active: Optional[SimMemberControl] = None
+        # scenario knobs, applied at (re)attach time
+        self.planner_factory: Optional[Callable[[], object]] = None
+        self.store_pipeline_depth = 1
+        self.block_proposal_max_items: Optional[int] = None
+        #: checker-sensitivity seam: False breaks the detach-on-deposal
+        #: handler so control-loops-only-on-leader must fire
+        self.detach_on_depose = True
+        self.desired_replicas = 0
+        self._bootstrapped = False
+        self.attaches = 0
+        self._dispatcher_totals = {"heartbeats": 0, "expirations": 0}
+        self.proposers: Dict[str, SimRaftProposer] = {}
+        for m in sim.managers:
+            p = SimRaftProposer(sim, member=m, violations=violations)
+            m.store._proposer = p
+            m.store_proposer = p     # survives store rebuilds (restart)
+            self.proposers[m.id] = p
+            m.transition_hooks.append(self._member_transition)
+        # per-member-store task invariants (rebuilt when a restart
+        # replaces the store object)
+        self._inv: Dict[str, tuple] = {}
+        self.agents: List[SimAgent] = [
+            SimAgent(f"w{i}", self) for i in range(n_agents)]
+        engine.every(control_interval, "control step", self.control_step)
+
+    # ------------------------------------------------------- shared surface
+
+    @property
+    def store(self) -> Optional[MemoryStore]:
+        """The authoritative store view: the active leader's, else the
+        most-caught-up member's (stats/agents after a failover gap)."""
+        if self.active is not None and not self.active.detached:
+            return self.active.store
+        best = None
+        for m in self.sim.managers:
+            if m.store is not None and (
+                    best is None or m.store.version > best.version):
+                best = m.store
+        return best
+
+    @property
+    def dispatcher(self) -> Optional[Dispatcher]:
+        mc = self.active
+        if mc is None or mc.detached or not mc.member.alive:
+            return None
+        return mc.dispatcher
+
+    @property
+    def dispatcher_stats(self) -> Dict[str, int]:
+        """Accumulated across every leader's dispatcher (attach epochs)."""
+        totals = dict(self._dispatcher_totals)
+        mc = self.active
+        if mc is not None:
+            for k in totals:
+                totals[k] += mc.dispatcher.stats.get(k, 0)
+        return totals
+
+    # ---------------------------------------------------------- transitions
+
+    def _member_transition(self, member: SimManager, role: str,
+                           term: int) -> None:
+        mc = self.active
+        if mc is not None and mc.member is member and role != LEADER:
+            if self.detach_on_depose:
+                # fence FIRST: even proposals already past their role
+                # checks can no longer commit under the old reign
+                member.core.fence_epoch()
+                self._detach(f"{member.id} deposed (term {term})")
+
+    def _detach(self, reason: str) -> None:
+        mc, self.active = self.active, None
+        if mc is None:
+            return
+        self.engine.log(f"control detach {mc.member.id}: {reason}")
+        for k in self._dispatcher_totals:
+            self._dispatcher_totals[k] += mc.dispatcher.stats.get(k, 0)
+        mc.detach()
+
+    def _attach(self, member: SimManager) -> None:
+        # the deposal window may have left committed entries deferred
+        # (the old reign's failing proposal held the store lock while
+        # they applied): they MUST land before the new reign reads or
+        # writes the store, or apply order inverts against the cluster
+        member._drain_deferred()
+        self.attaches += 1
+        mc = SimMemberControl(member, self)
+        self.active = mc
+        self.engine.log(
+            f"control attach {member.id} term={member.core.term} "
+            f"epoch={member.core.leadership_epoch}")
+        self.busy = True
+        try:
+            mc.cold_start()
+        except AGENT_RPC_ERRORS as e:
+            # leadership lost mid-cold-start: tear down, retry on the
+            # next leader.  Anything else propagates — a broken control
+            # plane must fail the scenario, not log-and-limp.
+            self.engine.log(f"control cold-start aborted on {member.id}: "
+                            f"{type(e).__name__}")
+            self._detach("cold start failed")
+        finally:
+            self.busy = False
+
+    # --------------------------------------------------------- control step
+
+    def _checker_for(self, m: SimManager) -> Optional[TaskInvariants]:
+        if m.store is None:
+            return None
+        entry = self._inv.get(m.id)
+        if entry is None or entry[0] is not m.store:
+            entry = (m.store, TaskInvariants(self.violations, m.store))
+            self._inv[m.id] = entry
+        return entry[1]
+
+    def drain_deferred(self) -> None:
+        """Apply any backlog of committed-but-deferred entries on the
+        active member's store before a control-plane write stages
+        against it (see SimManager._deferred_entries)."""
+        mc = self.active
+        if mc is not None and mc.member.alive:
+            mc.member._drain_deferred()
+
+    def control_step(self) -> object:
+        if self.stopped:
+            return False
+        sim = self.sim
+        # deferred backlogs drain BEFORE any member's store is read or
+        # written this step — a write staged over an un-drained backlog
+        # would commit ahead of older log entries (order inversion)
+        for m in sim.managers:
+            if m.alive and m.store is not None:
+                m._drain_deferred()
+        mc = self.active
+        if mc is not None:
+            m = mc.member
+            if not m.alive or m.stopped:
+                self._detach(f"{m.id} crashed")
+            elif m.core.role != LEADER:
+                # the transition handler must have detached already; a
+                # deposed member still holding live control loops is the
+                # split-brain this invariant exists to catch
+                self.violations.record(
+                    "control-loops-only-on-leader",
+                    f"{m.id} still runs control loops as {m.core.role} "
+                    f"(term {m.core.term})")
+                self._detach(f"{m.id} deposed (checker)")
+        if self.active is None and not self.busy:
+            lead = sim.leader()
+            if lead is not None and lead.store is not None:
+                self._attach(lead)
+        mc = self.active
+        if mc is not None and not self.busy:
+            self.busy = True
+            try:
+                if not self._bootstrapped:
+                    self._bootstrap(mc.store)
+                mc.step()
+            except AGENT_RPC_ERRORS as e:
+                # leadership lost inside a store write: the loops'
+                # internal rollback paths have run; the successor takes
+                # over from the replicated store.  Any OTHER exception
+                # propagates and fails the scenario — masking a genuine
+                # control-plane crash would defeat the simulator.
+                self.engine.log(
+                    f"control step aborted: {type(e).__name__}")
+            finally:
+                self.busy = False
+        # drain the per-store task invariants (single-threaded: nothing
+        # is in flight between control steps)
+        for m in sim.managers:
+            inv = self._checker_for(m)
+            if inv is not None:
+                inv.drain()
+        return None
+
+    # -------------------------------------------------------------- workload
+
+    def _bootstrap(self, store: MemoryStore) -> None:
+        """First-leader bootstrap: worker Node records + the replicated
+        service, replicated to every member.  Idempotent — a retry after
+        a dropped-but-committed proposal skips existing objects."""
+        def cb(tx):
+            for i in range(self.n_agents):
+                nid = f"w{i}"
+                if tx.get(Node, nid) is None:
+                    tx.create(Node(
+                        id=nid,
+                        spec=NodeSpec(annotations=Annotations(name=nid)),
+                        status=NodeStatus(state=NodeState.UNKNOWN),
+                        description=NodeDescription(
+                            hostname=nid,
+                            resources=Resources(nano_cpus=8 * 10 ** 9,
+                                                memory_bytes=32 << 30))))
+            if tx.get(Service, "svc-sim") is None:
+                tx.create(Service(
+                    id="svc-sim",
+                    spec=ServiceSpec(
+                        annotations=Annotations(name="sim"),
+                        mode=ServiceMode.REPLICATED,
+                        replicated=ReplicatedService(
+                            replicas=self.desired_replicas),
+                        task=TaskSpec()),
+                    spec_version=Version(index=1)))
+        store.update(cb)
+        self._bootstrapped = True
+        self.engine.log("workload bootstrap replicated")
+
+    def scale(self, replicas: int) -> None:
+        """Set the replicated service's replica count through the
+        leader store; the replicated orchestrator materializes/removes
+        tasks on its next tick.  Retries while no leader control plane
+        is up (failover gaps) — deterministic, event-driven."""
+        self.desired_replicas = replicas
+        mc = self.active
+        if (self.stopped or mc is None or mc.detached or self.busy
+                or not self._bootstrapped):
+            self.engine.after(0.5, "scale retry",
+                              lambda: self._scale_if_current(replicas))
+            return
+        self.busy = True
+        try:
+            def cb(tx):
+                svc = tx.get(Service, "svc-sim")
+                if svc is None:
+                    return
+                svc = svc.copy()
+                svc.spec.replicated.replicas = replicas
+                tx.update(svc)
+            mc.store.update(cb)
+            self.engine.log(f"workload scale {replicas}")
+        except AGENT_RPC_ERRORS as e:
+            self.engine.log(f"workload scale failed: {type(e).__name__}")
+            self.engine.after(0.5, "scale retry",
+                              lambda: self._scale_if_current(replicas))
+        finally:
+            self.busy = False
+
+    def _scale_if_current(self, replicas: int) -> None:
+        # a newer scale() call supersedes the retry chain
+        if replicas == self.desired_replicas:
+            self.scale(replicas)
+
+    def create_tasks(self, n: int) -> None:
+        """Shared scenario surface: grow the workload by ``n`` replicas
+        (the orchestrator creates the tasks — ids are deterministic via
+        the sim's id source)."""
+        self.scale(self.desired_replicas + n)
+
+
 class Sim:
     """Top-level harness: engine + consensus layer + control plane +
     invariant sinks.  Use as a context manager (installs the virtual
-    clock into models.types.now() and restores it afterwards)."""
+    clock into models.types.now() and the deterministic id source, and
+    restores both afterwards)."""
 
     def __init__(self, seed: int, n_managers: int = 3, n_agents: int = 5,
-                 net_config: Optional[NetConfig] = None):
+                 net_config: Optional[NetConfig] = None,
+                 raft_cp: bool = False):
+        """``raft_cp=True`` runs the raft-attached control plane
+        (``RaftControlPlane``): per-member replicated stores, leader-only
+        loops, epoch-fenced proposals.  False keeps the original
+        standalone control-plane store alongside the consensus layer."""
         self.seed = seed
         self.engine = SimEngine(seed)
         # the virtual clock must be live BEFORE any component exists:
@@ -545,6 +1220,11 @@ class Sim:
         # would both break determinism and park those deadlines decades
         # past virtual time.  __exit__ restores the real clock.
         self.engine.clock.install()
+        # deterministic ids for everything minted during the run —
+        # session ids, orchestrator-created tasks — so event order (and
+        # the flight-recorder dump) is a pure function of the seed
+        self._id_seq = 0
+        set_id_source(self._next_id)
         self.violations = Violations(self.engine)
         self.net = SimNetwork(self.engine, net_config)
         self.raft_inv = RaftInvariants(self.violations)
@@ -552,20 +1232,31 @@ class Sim:
         self.finishing = False
         self.managers = [
             SimManager(mid, member_ids, self.engine, self.net,
-                       self.raft_inv)
+                       self.raft_inv, with_store=raft_cp)
             for mid in member_ids]
-        self.cp = SimControlPlane(self.engine, self.violations, n_agents)
+        if raft_cp:
+            self.cp = RaftControlPlane(self.engine, self.violations,
+                                       self, n_agents)
+        else:
+            self.cp = SimControlPlane(self.engine, self.violations,
+                                      n_agents)
         self.proposed = 0
         self.committed_target = 0
+
+    def _next_id(self) -> str:
+        self._id_seq += 1
+        return f"sim{self.seed & 0xFFFFFFFF:08x}{self._id_seq:014d}"
 
     # ---------------------------------------------------------------- clock
 
     def __enter__(self) -> "Sim":
         self.engine.clock.install()     # idempotent
+        set_id_source(self._next_id)
         return self
 
     def __exit__(self, *exc) -> None:
         self.engine.clock.uninstall()
+        set_id_source(None)
 
     # ---------------------------------------------------------------- raft
 
@@ -639,16 +1330,39 @@ class Sim:
             self.violations.record(
                 "post-heal-convergence",
                 f"terms did not converge after heal+grace: {sorted(terms)}")
+        if isinstance(self.cp, RaftControlPlane):
+            # failover re-placement: after every fault is healed and the
+            # grace ran, the successor's control plane must have placed
+            # every live task — a PENDING unplaced task means the
+            # hand-off lost work
+            store = self.cp.store
+            if store is not None:
+                stuck = [
+                    t for t in store.view(lambda tx: tx.find(Task))
+                    if t.desired_state == TaskState.RUNNING
+                    and TaskState(t.status.state) == TaskState.PENDING
+                    and not t.node_id]
+                if stuck:
+                    self.violations.record(
+                        "failover-replacement",
+                        f"{len(stuck)} tasks still unplaced after "
+                        "heal+grace")
 
     # ----------------------------------------------------------------- stats
 
     def stats(self) -> Dict[str, object]:
-        tasks = self.cp.store.view(lambda tx: tx.find(Task))
+        store = self.cp.store
+        tasks = store.view(lambda tx: tx.find(Task)) \
+            if store is not None else []
         by_state: Dict[str, int] = {}
         for t in tasks:
             k = TaskState(t.status.state).name
             by_state[k] = by_state.get(k, 0) + 1
-        return {
+        if isinstance(self.cp, RaftControlPlane):
+            disp = self.cp.dispatcher_stats
+        else:
+            disp = self.cp.dispatcher.stats
+        out = {
             "events": self.engine.events_run,
             "net": dict(self.net.stats),
             "raft": {
@@ -658,6 +1372,18 @@ class Sim:
                 "restarts": sum(m.restarts for m in self.managers),
             },
             "tasks": by_state,
-            "heartbeats": self.cp.dispatcher.stats["heartbeats"],
-            "expirations": self.cp.dispatcher.stats["expirations"],
+            "heartbeats": disp.get("heartbeats", 0),
+            "expirations": disp.get("expirations", 0),
         }
+        if isinstance(self.cp, RaftControlPlane):
+            out["control"] = {
+                "attaches": self.cp.attaches,
+                "stale_epoch_rejects": sum(
+                    p.stats["stale_epoch_rejects"]
+                    for p in self.cp.proposers.values()),
+                "proposed": sum(p.stats["proposed"]
+                                for p in self.cp.proposers.values()),
+                "committed": sum(p.stats["committed"]
+                                 for p in self.cp.proposers.values()),
+            }
+        return out

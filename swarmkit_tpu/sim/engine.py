@@ -61,7 +61,16 @@ class SimEngine:
     # --------------------------------------------------------------- running
 
     def run_until(self, t_end: float) -> None:
-        """Pop events in order until virtual time reaches ``t_end``."""
+        """Pop events in order until virtual time reaches ``t_end``.
+
+        Re-entrant: an event handler may itself call ``run_until`` (the
+        raft-attached control plane blocks on consensus by pumping
+        virtual time from inside a control step — see
+        ``SimRaftProposer.wait_proposal``).  The inner call consumes
+        heap events up to ITS deadline; the outer loop simply finds them
+        gone.  Still single-threaded and heap-ordered, so determinism is
+        untouched — only the clock clamp below is needed, because an
+        inner pump may have advanced time past the outer deadline."""
         end = self.clock.start + t_end
         while self._heap and self._heap[0][0] <= end:
             t, seq, label, fn = heapq.heappop(self._heap)
@@ -73,7 +82,7 @@ class SimEngine:
             if self.events_run > self.max_events:
                 raise RuntimeError("simulation exceeded max_events")
             fn()
-        self.clock.advance_to(end)
+        self.clock.advance_to(max(end, self.clock.time()))
 
     # ----------------------------------------------------------------- trace
 

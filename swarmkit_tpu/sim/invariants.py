@@ -100,6 +100,13 @@ class TaskInvariants:
         self.states: Dict[str, int] = {}
         self.desired: Dict[str, int] = {}
         self.node_of: Dict[str, str] = {}
+        # node states tracked from the SAME ordered event stream the
+        # task observations come from: the assigned-node-live check must
+        # compare an assignment against the node state committed BEFORE
+        # it, not against the store's current row — drain can run behind
+        # the commits (follower catch-up, deferred applies), where a
+        # later DOWN would falsely indict an earlier valid assignment
+        self.node_states: Dict[str, int] = {}
         self.sub = store.queue.subscribe(
             lambda ev: isinstance(ev, (Event, EventTaskBlock)),
             accepts_blocks=True)
@@ -111,14 +118,29 @@ class TaskInvariants:
                 return
             if isinstance(ev, EventTaskBlock):
                 self._check_block(ev)
-                for per_node in ev.per_node().values():
-                    for old, _ver in per_node:
-                        t = self.store.raw_get(Task, old.id)
-                        if t is not None:
-                            self._check_task(t)
+                # observe the block's OWN payload (state/node arrays),
+                # never the store's current row: drain may run behind a
+                # catch-up burst (a rejoined member replaying a long
+                # committed suffix), where the store is already ahead of
+                # the event being drained — reading "current" there
+                # manufactures false FSM regressions
+                state = int(ev.state)
+                for nid, items in ev.per_node().items():
+                    for old, _ver in items:
+                        self._observe(old.id, state,
+                                      int(old.desired_state), nid)
+                continue
+            if isinstance(ev.obj, Node):
+                if ev.action == "delete":
+                    self.node_states.pop(ev.obj.id, None)
+                else:
+                    self.node_states[ev.obj.id] = \
+                        int(ev.obj.status.state)
                 continue
             if isinstance(ev.obj, Task) and ev.action != "delete":
-                self._check_task(ev.obj)
+                t = ev.obj
+                self._observe(t.id, int(t.status.state),
+                              int(t.desired_state), t.node_id)
 
     def _check_block(self, ev: EventTaskBlock) -> None:
         if ev.state > int(TaskState.RUNNING):
@@ -127,50 +149,60 @@ class TaskInvariants:
                 f"task block committed state {ev.state} "
                 f"(> RUNNING): blocks must only carry assignment states")
 
-    def _check_task(self, t: Task) -> None:
-        state = int(t.status.state)
-        prev = self.states.get(t.id)
+    def _observe(self, task_id: str, state: int, des: int,
+                 node_id: str) -> None:
+        """One observed (state, desired, node) triple for a task, from
+        the event payload itself (per-task Event or block column)."""
+        prev = self.states.get(task_id)
         if prev is not None:
             if state < prev:
                 self.v.record(
                     "fsm-monotonic",
-                    f"task {t.id[:8]} moved {TaskState(prev).name} -> "
+                    f"task {task_id[:8]} moved {TaskState(prev).name} -> "
                     f"{TaskState(state).name}")
             if TaskState(prev) in TERMINAL_STATES and state != prev \
                     and TaskState(state) not in TERMINAL_STATES:
                 self.v.record(
                     "terminal-sticky",
-                    f"task {t.id[:8]} left terminal "
+                    f"task {task_id[:8]} left terminal "
                     f"{TaskState(prev).name} for {TaskState(state).name}")
-        self.states[t.id] = state
+        self.states[task_id] = state
 
-        des = int(t.desired_state)
-        prev_des = self.desired.get(t.id)
+        prev_des = self.desired.get(task_id)
         if prev_des is not None and des < prev_des:
             self.v.record(
                 "desired-monotonic",
-                f"task {t.id[:8]} desired moved {TaskState(prev_des).name}"
-                f" -> {TaskState(des).name}")
-        self.desired[t.id] = des
+                f"task {task_id[:8]} desired moved "
+                f"{TaskState(prev_des).name} -> {TaskState(des).name}")
+        self.desired[task_id] = des
 
-        if t.node_id:
-            prev_node = self.node_of.get(t.id)
-            if prev_node is not None and prev_node != t.node_id:
+        if node_id:
+            prev_node = self.node_of.get(task_id)
+            if prev_node is not None and prev_node != node_id:
                 self.v.record(
                     "no-double-assign",
-                    f"task {t.id[:8]} reassigned {prev_node[:8]} -> "
-                    f"{t.node_id[:8]} while live")
-            self.node_of[t.id] = t.node_id
+                    f"task {task_id[:8]} reassigned {prev_node[:8]} -> "
+                    f"{node_id[:8]} while live")
+            self.node_of[task_id] = node_id
 
         if state == int(TaskState.ASSIGNED) and prev != state:
-            node = self.store.raw_get(Node, t.node_id) if t.node_id else None
-            if node is None:
-                self.v.record(
-                    "assigned-node-live",
-                    f"task {t.id[:8]} ASSIGNED to missing node "
-                    f"{t.node_id[:8] if t.node_id else '<none>'}")
-            elif node.status.state == NodeState.DOWN:
-                self.v.record(
-                    "assigned-node-live",
-                    f"task {t.id[:8]} ASSIGNED to DOWN node "
-                    f"{t.node_id[:8]}")
+            ns = self.node_states.get(node_id) if node_id else None
+            if ns is not None:
+                # ordered knowledge: the node's last state committed
+                # BEFORE this assignment — a DOWN here means the
+                # scheduler placed onto a node it knew was dead
+                if ns == int(NodeState.DOWN):
+                    self.v.record(
+                        "assigned-node-live",
+                        f"task {task_id[:8]} ASSIGNED to DOWN node "
+                        f"{node_id[:8]}")
+            else:
+                # no ordered knowledge (subscribed mid-stream): at least
+                # the node must exist
+                node = self.store.raw_get(Node, node_id) \
+                    if node_id else None
+                if node is None:
+                    self.v.record(
+                        "assigned-node-live",
+                        f"task {task_id[:8]} ASSIGNED to missing node "
+                        f"{node_id[:8] if node_id else '<none>'}")

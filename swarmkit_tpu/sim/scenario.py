@@ -515,6 +515,184 @@ def _pipelined_commit_churn(sim: Sim) -> float:
     return eng.clock.elapsed() + 3.0
 
 
+# ------------------------------------------------- failover scenarios
+#
+# These run the RAFT-ATTACHED control plane (Sim(raft_cp=True)): every
+# member holds a replicated store, the real scheduler / dispatcher /
+# allocator / restart supervisor / orchestrators run on the leader only,
+# and leadership hand-off is exercised under faults.  The shared
+# checkers run throughout, plus control-loops-only-on-leader,
+# no-stale-epoch-commit (epoch fencing), and the failover-replacement
+# end-state check in Sim.finish.
+
+
+def _device_planner():
+    """Planner factory for the failover scenarios: the device path with
+    small-group routing off, so every group's assignments commit as
+    chunk-pipelined columnar block proposals (the pipelined commit the
+    partition scenario strikes mid-flight)."""
+    from ..ops import TPUPlanner
+    p = TPUPlanner()
+    p.enable_small_group_routing = False
+    return p
+
+
+def _arm_leader_strike(sim: Sim, fire) -> None:
+    """Wrap the ACTIVE leader's member-bound proposer so ``fire(member)``
+    triggers deterministically from inside the control plane's own
+    consensus traffic (not off wall/virtual timing).  ``fire`` returns
+    True once the strike happened; arming then stops."""
+    eng = sim.engine
+    state = {"fired": False}
+
+    def arm():
+        if sim.finishing or state["fired"]:
+            return False
+        mc = sim.cp.active
+        if mc is None:
+            return None
+        proposer = sim.cp.proposers[mc.member.id]
+        if getattr(proposer, "_strike_armed", False):
+            return None
+        proposer._strike_armed = True
+        orig_wait = proposer.wait_proposal
+
+        def wait_then_strike(waiter):
+            orig_wait(waiter)
+            if not state["fired"] and not sim.finishing \
+                    and fire(proposer, mc.member):
+                state["fired"] = True
+        proposer.wait_proposal = wait_then_strike
+        return None
+
+    eng.every(0.5, "arm leader strike", arm, phase=0.25)
+
+
+def _mk_leader_crash_mid_tick(depth: int) -> Callable[[Sim], float]:
+    def scenario(sim: Sim) -> float:
+        eng = sim.engine
+        cp = sim.cp
+        cp.store_pipeline_depth = depth
+        cp.block_proposal_max_items = 4
+        cp.planner_factory = _device_planner
+        sim.start_raft_workload(interval=0.6)
+        cp.create_tasks(12)
+
+        def fire(proposer, member) -> bool:
+            # strike only once real control traffic is flowing: past the
+            # bootstrap + scale + task-creation commits, i.e. inside a
+            # scheduling/status tick of the attached leader
+            if proposer.stats["committed"] < 6:
+                return False
+            eng.log(f"fault crash {member.id} mid-tick")
+            member.crash()
+            eng.after(6.0, "restart ex-leader", member.restart)
+            return True
+
+        _arm_leader_strike(sim, fire)
+        # agent churn rides along so the successor re-learns sessions
+        a = sim.cp.agents
+        eng.at(eng.clock.start + 16.0, "agent crash", a[1].crash)
+        eng.at(eng.clock.start + 24.0, "agent restart", a[1].restart)
+        eng.at(eng.clock.start + 20.0, "more tasks",
+               lambda: cp.create_tasks(6))
+        return 40.0
+    scenario.raft_cp = True
+    return scenario
+
+
+def _mk_partition_pipelined_commit(depth: int) -> Callable[[Sim], float]:
+    def scenario(sim: Sim) -> float:
+        eng = sim.engine
+        cp = sim.cp
+        cp.store_pipeline_depth = depth
+        cp.block_proposal_max_items = 4
+        cp.planner_factory = _device_planner
+        sim.start_raft_workload(interval=0.7)
+        cp.create_tasks(16)
+        state = {"armed_async": False}
+
+        def fire(proposer, member) -> bool:
+            if state["armed_async"]:
+                return False
+            if proposer.stats["committed"] < 4:
+                return False
+            # from here on, the moment the chunk-pipelined window holds
+            # 2+ in-flight proposals, cut the leader off mid-commit
+            state["armed_async"] = True
+            orig_async = proposer.propose_async
+
+            # at depth 1 chunks ride strictly serially, so one in-flight
+            # proposal IS the mid-commit window; deeper pipelines strike
+            # with the window actually filled
+            window_needed = 2 if depth > 1 else 1
+
+            def async_then_partition(actions, commit_cb=None, epoch=None):
+                w = orig_async(actions, commit_cb, epoch=epoch)
+                if len(proposer._pending) >= window_needed \
+                        and member.alive:
+                    eng.log(f"fault partition {member.id} mid-pipelined-"
+                            f"commit (window={len(proposer._pending)})")
+                    sim.net.isolate(member.id)
+                    eng.after(10.0, "heal partition",
+                              lambda: sim.net.rejoin(member.id))
+                    proposer.propose_async = orig_async
+                return w
+            proposer.propose_async = async_then_partition
+            return True
+
+        _arm_leader_strike(sim, fire)
+        eng.at(eng.clock.start + 24.0, "more tasks",
+               lambda: cp.create_tasks(6))
+        return 45.0
+    scenario.raft_cp = True
+    return scenario
+
+
+def _failover_churn_rollout(sim: Sim) -> float:
+    """Scale rollout (up, down, up) under leader churn, agent churn and
+    a task-failure storm: the restart supervisor and orchestrators must
+    keep the replica count converging across two leadership hand-offs
+    with no lost or duplicated restarts."""
+    eng = sim.engine
+    cp = sim.cp
+    sim.start_raft_workload(interval=0.8)
+    cp.create_tasks(10)
+    eng.at(eng.clock.start + 10.0, "scale up", lambda: cp.scale(16))
+    eng.at(eng.clock.start + 20.0, "scale down", lambda: cp.scale(6))
+    eng.at(eng.clock.start + 28.0, "scale up again",
+           lambda: cp.scale(12))
+
+    eng.at(eng.clock.start + 14.0, "stepdown", sim.stepdown_leader)
+
+    def crash_leader():
+        m = sim.leader()
+        if m is None:
+            return
+        m.crash()
+        eng.after(6.0, "restart ex-leader", m.restart)
+    eng.at(eng.clock.start + 24.0, "crash leader", crash_leader)
+
+    def storm_on():
+        for a in cp.agents:
+            a.fail_p = 0.05
+        eng.log("fault task-failure-storm on")
+
+    def storm_off():
+        for a in cp.agents:
+            a.fail_p = 0.0
+        eng.log("fault task-failure-storm off")
+    eng.at(eng.clock.start + 8.0, "storm on", storm_on)
+    eng.at(eng.clock.start + 30.0, "storm off", storm_off)
+    a = cp.agents
+    eng.at(eng.clock.start + 12.0, "agent crash", a[2].crash)
+    eng.at(eng.clock.start + 26.0, "agent restart", a[2].restart)
+    return 45.0
+
+
+_failover_churn_rollout.raft_cp = True
+
+
 SCENARIOS: Dict[str, Callable[[Sim], float]] = {
     "partition-churn": _partition_churn,
     "crash-leader-mid-commit": _crash_leader_mid_commit,
@@ -523,7 +701,21 @@ SCENARIOS: Dict[str, Callable[[Sim], float]] = {
     "agent-storm": _agent_storm,
     "pipelined-commit-churn": _pipelined_commit_churn,
     "random-fuzz": _random_fuzz,
+    # failover suite (raft-attached control plane); depth = store-level
+    # chunk-pipelined proposal window
+    "leader-crash-mid-tick": _mk_leader_crash_mid_tick(2),
+    "leader-crash-mid-tick-d1": _mk_leader_crash_mid_tick(1),
+    "partition-pipelined-commit": _mk_partition_pipelined_commit(2),
+    "partition-pipelined-commit-d1": _mk_partition_pipelined_commit(1),
+    "failover-churn-rollout": _failover_churn_rollout,
 }
+
+#: the failover sweep scripts/failover_fuzz.py seed-sweeps
+FAILOVER_SCENARIOS = (
+    "leader-crash-mid-tick", "leader-crash-mid-tick-d1",
+    "partition-pipelined-commit", "partition-pipelined-commit-d1",
+    "failover-churn-rollout",
+)
 
 
 # ------------------------------------------------------------------ runner
@@ -538,7 +730,8 @@ def run_scenario(name: str, seed: int, n_managers: int = 3,
         raise KeyError(
             f"unknown scenario {name!r}; have {sorted(SCENARIOS)}")
     sim = Sim(seed, n_managers=n_managers, n_agents=n_agents,
-              net_config=NetConfig())
+              net_config=NetConfig(),
+              raft_cp=getattr(fn, "raft_cp", False))
     with sim:
         # record control-plane spans under the virtual clock: epoch and
         # every timestamp are virtual, span ids are a counter, and the
@@ -562,7 +755,15 @@ def run_scenario(name: str, seed: int, n_managers: int = 3,
         # function of the seed.
         flightrec.reset(deterministic=True)
         flightrec.enabled = True
-        flightrec.watch_store(sim.cp.store)
+        # raft-attached mode taps every member's replicated store (the
+        # leader's commits and the followers' replayed applies both land
+        # in the black box); standalone taps the one control-plane store.
+        # A store rebuilt by a crash-restart is not re-tapped — the
+        # post-mortem keeps the pre-crash view, the WAL has the rest.
+        fr_stores = [m.store for m in sim.managers
+                     if m.store is not None] or [sim.cp.store]
+        for s in fr_stores:
+            flightrec.watch_store(s)
         sampler = Sampler(deterministic=True)
 
         def _sample():
@@ -592,7 +793,8 @@ def run_scenario(name: str, seed: int, n_managers: int = 3,
                 fr_path, fr_sha = _dump_flightrec(name, seed,
                                                   flightrec_dir)
             flightrec.enabled = False
-            flightrec.unwatch_store(sim.cp.store)   # only the sim's tap
+            for s in fr_stores:                     # only the sim's taps
+                flightrec.unwatch_store(s)
             flightrec.restore_state(fr_saved)
             tracer.restore_state(saved)
     return SimReport(

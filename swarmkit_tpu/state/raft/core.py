@@ -32,6 +32,13 @@ ENTRY_NOOP = 1
 ENTRY_CONF = 2   # data = JSON {"op": "add"|"remove", "id": member id,
                  #                "addr": optional [host, port]}
 
+# leadership epochs are minted at term * stride: the headroom between
+# consecutive terms absorbs every fence bump a reign can accumulate
+# (deposal + explicit handler fences), so an epoch minted under any
+# later term — in particular, after a crash-restart — is strictly
+# greater than every epoch the earlier term could have reached
+EPOCH_TERM_STRIDE = 1 << 20
+
 
 @dataclass
 class Entry:
@@ -125,6 +132,19 @@ class RaftCore:
         self.voted_for = ""
         self.role = FOLLOWER
         self.leader_id = ""
+        # leadership-epoch fencing token (Chubby sequencer / ZooKeeper
+        # zxid-epoch style): minted strictly monotonically on every
+        # transition INTO leadership and bumped again the moment
+        # leadership is lost (or explicitly fenced), so a proposal
+        # stamped with the epoch it was created under can be rejected at
+        # the proposer's fence points even if its in-flight role checks
+        # race a re-election.  Epochs live at term * EPOCH_TERM_STRIDE
+        # plus a per-term fence count: a new election's term strictly
+        # exceeds every persisted term, so post-restart epochs are
+        # strictly above every pre-crash epoch (however many fences
+        # inflated it, up to the stride) WITHOUT persisting the counter
+        # itself — a stale pin can never collide across a restart.
+        self.leadership_epoch = 0
         # observability tap: called as (member_id, role, term) on every
         # role transition.  The core stays sans-IO — embedders (RaftNode,
         # the sim's SimManager) point this at the flight recorder; the
@@ -221,6 +241,11 @@ class RaftCore:
                                   for k, v in snapshot.api_addrs.items()}
         self.term = hard_state.term
         self.voted_for = hard_state.voted_for
+        # epoch floor: any election after this restart runs at a term —
+        # and hence an epoch stride — above everything minted before
+        # the crash
+        self.leadership_epoch = max(self.leadership_epoch,
+                                    hard_state.term * EPOCH_TERM_STRIDE)
         self.commit_index = max(self.commit_index, hard_state.commit)
         self.log = [e for e in entries if e.index > self.snap_index]
         self._persisted_index = self.last_index()
@@ -283,8 +308,20 @@ class RaftCore:
 
     # ------------------------------------------------------------ transitions
 
+    def fence_epoch(self) -> None:
+        """Invalidate every proposal created under the current epoch.
+        Called automatically on deposal; role-transition handlers (the
+        Manager, the sim's control plane) call it explicitly so their
+        stop-the-loops path and the fence can never disagree."""
+        self.leadership_epoch += 1
+
     def _become_follower(self, term: int, leader: str = "") -> None:
         role_changed = self.role != FOLLOWER
+        if self.role == LEADER:
+            # deposed: fence the reign's epoch so in-flight proposals
+            # created under it fail even if this member is re-elected
+            # before they reach a fence point
+            self.fence_epoch()
         if term > self.term:
             self.term = term
             self.voted_for = ""
@@ -316,6 +353,13 @@ class RaftCore:
     def _become_leader(self) -> None:
         self.role = LEADER
         self.leader_id = self.id
+        # mint a fresh leadership epoch.  max(): strictly greater than
+        # every epoch this process ever minted or fenced, and — because
+        # an election's term strictly exceeds every persisted term, and
+        # a reign's fence bumps never reach the next term's stride —
+        # strictly greater than any epoch minted before a crash-restart.
+        self.leadership_epoch = max(self.leadership_epoch + 1,
+                                    self.term * EPOCH_TERM_STRIDE)
         self._elapsed = 0
         last = self.last_index()
         for peer in self.peers:

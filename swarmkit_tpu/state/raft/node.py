@@ -44,6 +44,10 @@ class NotLeader(Exception):
     """Proposal sent to a non-leader member."""
 
 
+class StaleEpoch(NotLeader):
+    """Proposal carries a leadership epoch that has been fenced."""
+
+
 class ProposalDropped(Exception):
     """Leadership was lost before the proposal committed."""
 
@@ -56,6 +60,9 @@ class _Waiter:
     ok: bool = False
     commit_cb: Optional[Callable[[], None]] = None
     t0: float = 0.0   # propose_async submit time (propose-latency timer)
+    # leadership epoch the proposal was created under; checked against
+    # the core's current epoch pre-WAL and at commit-callback delivery
+    epoch: int = -1
 
 
 class RaftNode(Proposer):
@@ -95,7 +102,8 @@ class RaftNode(Proposer):
         self._thread: Optional[threading.Thread] = None
         self._was_leader = False
         self._last_snap_applied = 0
-        self.stats = {"applied": 0, "snapshots": 0}
+        self.stats = {"applied": 0, "snapshots": 0,
+                      "stale_epoch_rejects": 0}
 
         # boot from disk (reference: JoinAndStart -> BootstrapFromDisk)
         hs, entries, snapshot = logger.bootstrap()
@@ -173,6 +181,14 @@ class RaftNode(Proposer):
         return self.core.role == LEADER
 
     @property
+    def leadership_epoch(self) -> int:
+        """Current fencing token (see RaftCore.leadership_epoch).  The
+        store pins multi-proposal commits (chunked block commits, the
+        scheduler's pipelined drafts) to the epoch read here so none of
+        their chunks can land across a role change."""
+        return self.core.leadership_epoch
+
+    @property
     def leader_id(self) -> str:
         return self.core.leader_id
 
@@ -238,7 +254,20 @@ class RaftNode(Proposer):
                 self._waiters[index] = waiter
             return
         data, waiter = item
-        if not self.core.leader_ready:
+        # pre-WAL fence: this runs on the raft thread — the same thread
+        # that applies role transitions — so the check cannot race a
+        # deposal/re-election: a proposal created under a fenced epoch is
+        # rejected HERE, before it is appended (and therefore before it
+        # can ever be serialized into the WAL or replicated)
+        if not self.core.leader_ready \
+                or waiter.epoch != self.core.leadership_epoch:
+            if self.core.role == LEADER \
+                    and waiter.epoch != self.core.leadership_epoch:
+                self.stats["stale_epoch_rejects"] += 1
+                _metrics.counter("swarm_raft_stale_epoch_rejects")
+                log.warning(
+                    "pre-WAL fence: proposal epoch %d != current %d",
+                    waiter.epoch, self.core.leadership_epoch)
             waiter.ok = False
             waiter.event.set()
             return
@@ -264,7 +293,14 @@ class RaftNode(Proposer):
             for m in rd.messages:
                 if m.type == "snap" and m.snapshot is not None \
                         and not m.snapshot.data:
-                    snap = self.logger.load_snapshot()
+                    try:
+                        snap = self.logger.load_snapshot()
+                    except OSError:
+                        # transient read error (load_snapshot propagates
+                        # I/O errors rather than quarantining): skip this
+                        # send — the follower's next rejection retries it
+                        log.exception("snapshot read failed; send skipped")
+                        continue
                     if snap is None:
                         continue
                     m.snapshot = snap
@@ -335,6 +371,22 @@ class RaftNode(Proposer):
             # raft.go:1917)
             with self._waiters_lock:
                 waiter = self._waiters.pop(e.index, None)
+            if waiter is not None \
+                    and waiter.epoch != self.core.leadership_epoch:
+                # commit-callback fence: the entry committed, but the
+                # reign that created it is over (fenced epoch).  The
+                # proposer must observe failure — its commit callback
+                # (store-side success path) must NOT run — while the
+                # store still converges by applying the entry below
+                # exactly like a follower would.
+                self.stats["stale_epoch_rejects"] += 1
+                _metrics.counter("swarm_raft_stale_epoch_rejects")
+                log.warning(
+                    "commit fence: entry %d epoch %d != current %d",
+                    e.index, waiter.epoch, self.core.leadership_epoch)
+                waiter.ok = False
+                waiter.event.set()
+                waiter = None
             if waiter is not None:
                 ok = True
                 if waiter.commit_cb is not None:
@@ -428,7 +480,8 @@ class RaftNode(Proposer):
     # -------------------------------------------------------------- proposer
 
     def propose_async(self, actions: Sequence[StoreAction],
-                      commit_cb=None) -> _Waiter:
+                      commit_cb=None, epoch: Optional[int] = None
+                      ) -> _Waiter:
         """Submit a proposal without waiting for consensus: serialize on
         the caller's thread, enqueue to the raft loop, return the waiter.
         Proposals submitted from one thread are appended to the log (and
@@ -436,13 +489,31 @@ class RaftNode(Proposer):
         ordering guarantee the store's chunk-pipelined block commits rely
         on.  Pair every returned waiter with ``wait_proposal``: the
         commit callback runs in the apply path regardless, but success or
-        failure is only observable through the wait."""
+        failure is only observable through the wait.
+
+        ``epoch`` pins the proposal to a leadership epoch captured
+        earlier (``leadership_epoch``): a multi-proposal commit passes
+        the epoch it started under so no chunk can be created — let
+        alone land — after a role change.  A stale pin is rejected here,
+        before serialization; None stamps the current epoch."""
         if self.core.role != LEADER:
             raise NotLeader(f"{self.id} is not the leader")
+        cur = self.core.leadership_epoch
+        if epoch is None:
+            epoch = cur
+        elif epoch != cur:
+            # pre-serialization fence: the reign this commit belongs to
+            # is already over
+            self.stats["stale_epoch_rejects"] += 1
+            _metrics.counter("swarm_raft_stale_epoch_rejects")
+            raise StaleEpoch(
+                f"{self.id}: proposal epoch {epoch} fenced "
+                f"(current {cur})")
         t0 = time.perf_counter()
         data = serde.dumps([serde.action_to_dict(a) for a in actions])
         waiter = _Waiter(event=threading.Event(), term=self.core.term,
-                         index=0, commit_cb=commit_cb, t0=t0)
+                         index=0, commit_cb=commit_cb, t0=t0,
+                         epoch=epoch)
         self._inbox.put((data, waiter))
         return waiter
 
@@ -459,8 +530,9 @@ class RaftNode(Proposer):
                 "raft proposal dropped (leadership change)")
 
     def propose(self, actions: Sequence[StoreAction],
-                commit_cb=None) -> None:
+                commit_cb=None, epoch: Optional[int] = None) -> None:
         """Block until the change list is committed by consensus and
         ``commit_cb`` ran in the apply path (reference: raft.go:1592
         ProposeValue)."""
-        self.wait_proposal(self.propose_async(actions, commit_cb))
+        self.wait_proposal(self.propose_async(actions, commit_cb,
+                                              epoch=epoch))

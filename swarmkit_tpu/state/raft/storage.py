@@ -21,10 +21,14 @@ import base64
 import hashlib
 import hmac as _hmac
 import json
+import logging
 import os
 import secrets
 import threading
+import zlib
 from typing import List, Optional, Tuple
+
+log = logging.getLogger("raft.storage")
 
 from .core import Entry, HardState, Snapshot
 
@@ -129,9 +133,27 @@ class RaftLogger:
             self._wal = open(self._wal_path, mode)
         return self._wal
 
+    @staticmethod
+    def _record_crc(record: dict) -> int:
+        """CRC32 over the canonical (sorted-key) serialization of the
+        record WITHOUT its crc field — integrity of the decoded content,
+        so a bit flip that survives base64/JSON/decryption parsing (e.g.
+        inside an entry's data payload) is still caught on replay.  The
+        load path re-canonicalizes before checking, so the write path is
+        free to append the crc after the canonical body."""
+        body = {k: v for k, v in record.items() if k != "crc"}
+        return zlib.crc32(json.dumps(body, sort_keys=True,
+                                     separators=(",", ":")).encode())
+
     def _write_record(self, record: dict) -> None:
-        data = json.dumps(record, sort_keys=True,
-                          separators=(",", ":")).encode()
+        # serialize the crc-less body exactly once: the checksum covers
+        # these canonical bytes, and the crc field is appended textually
+        # (JSON key order is irrelevant to the loader, which
+        # re-canonicalizes via _record_crc before verifying)
+        record.pop("crc", None)
+        body = json.dumps(record, sort_keys=True, separators=(",", ":"))
+        crc = zlib.crc32(body.encode())
+        data = (body[:-1] + ',"crc":' + str(crc) + "}").encode()
         payload = base64.b64encode(self.encoder.encode(data))
         wal = self._open_wal()
         wal.write(payload + b"\n")
@@ -159,15 +181,24 @@ class RaftLogger:
 
     def _write_snapshot_file(self, snapshot: Snapshot) -> None:
         tmp = self._snap_path + ".tmp"
+        encoded = self.encoder.encode(snapshot.data)
         record = json.dumps({
             "index": snapshot.index, "term": snapshot.term,
+            # integrity hash of the STORED (encoded) body, verified
+            # before decode on load: corruption quarantines the snapshot
+            # instead of restoring a damaged store.  Hashing the
+            # ciphertext — never the plaintext — keeps the cleartext
+            # envelope from becoming a content-confirmation oracle under
+            # encryption-at-rest.
+            "data_sha256": hashlib.sha256(encoded).hexdigest(),
             "peers": list(snapshot.peers),
             "peer_addrs": {k: list(v)
                            for k, v in snapshot.peer_addrs.items()},
             "api_addrs": {k: list(v)
                           for k, v in snapshot.api_addrs.items()},
-            "data": base64.b64encode(
-                self.encoder.encode(snapshot.data)).decode("ascii"),
+            # the same encoded bytes the hash covers (the encoder is
+            # nonce-randomized: encoding twice would break the pairing)
+            "data": base64.b64encode(encoded).decode("ascii"),
         }, sort_keys=True).encode()
         with open(tmp, "wb") as f:
             f.write(record)
@@ -247,6 +278,15 @@ class RaftLogger:
                     raise   # wrong key must not look like an empty log
                 except Exception:
                     break  # torn tail record: stop replay here
+                crc = rec.get("crc")
+                if crc is not None and crc != self._record_crc(rec):
+                    # corrupt record (bit flip that survived parsing):
+                    # everything from here on is untrustworthy — stop
+                    # replay exactly like a torn tail.  Records without
+                    # a crc are legacy (pre-CRC WALs) and replay as-is.
+                    log.error("WAL record %d failed CRC32; truncating "
+                              "replay here", count + 1)
+                    break
                 count += 1
                 if rec["t"] == "hs":
                     hs = HardState(term=rec["term"], voted_for=rec["vote"],
@@ -267,12 +307,41 @@ class RaftLogger:
         hs, entries, _ = self._load_wal()
         return hs, entries
 
+    def _quarantine_snapshot(self, reason: str) -> None:
+        """Move the corrupt snapshot aside (``snapshot.corrupt``) so
+        bootstrap falls back to WAL-only replay instead of restoring a
+        damaged store — and the evidence survives for forensics."""
+        corrupt = self._snap_path + ".corrupt"
+        try:
+            os.replace(self._snap_path, corrupt)
+            log.error("snapshot quarantined to %s (%s); bootstrap will "
+                      "replay the WAL only", corrupt, reason)
+        except OSError:
+            log.exception("quarantining corrupt snapshot failed")
+
     def load_snapshot(self) -> Optional[Snapshot]:
         if not os.path.exists(self._snap_path):
             return None
+        # transient I/O errors (EIO, permissions) must NOT look like
+        # corruption: quarantining a healthy snapshot on a flaky read
+        # would permanently degrade bootstrap to the post-compaction WAL
+        # tail — let OSError propagate to the caller instead
+        with open(self._snap_path, "rb") as f:
+            raw = f.read()
         try:
-            with open(self._snap_path, "rb") as f:
-                rec = json.loads(f.read())
+            rec = json.loads(raw)
+            body = base64.b64decode(rec["data"])
+        except Exception:
+            self._quarantine_snapshot("unparseable")
+            return None
+        want = rec.get("data_sha256")
+        if want is not None and \
+                hashlib.sha256(body).hexdigest() != want:
+            # stored-body hash mismatch, checked BEFORE decryption
+            # (absent hash = legacy snapshot)
+            self._quarantine_snapshot("body hash mismatch")
+            return None
+        try:
             return Snapshot(
                 index=rec["index"], term=rec["term"],
                 peers=list(rec.get("peers", [])),
@@ -280,10 +349,11 @@ class RaftLogger:
                             rec.get("peer_addrs", {}).items()},
                 api_addrs={k: tuple(v) for k, v in
                            rec.get("api_addrs", {}).items()},
-                data=self.encoder.decode(base64.b64decode(rec["data"])))
+                data=self.encoder.decode(body))
         except DecryptionError:
             raise   # wrong key/tampering must not read as "no snapshot"
         except Exception:
+            self._quarantine_snapshot("unparseable")
             return None
 
     def bootstrap(self) -> Tuple[HardState, List[Entry],

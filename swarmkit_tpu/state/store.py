@@ -175,7 +175,19 @@ class Proposer:
     already stamped (see MemoryStore.update).  A nil proposer (None) keeps
     the store fully functional standalone — the master test fixture of the
     reference.
+
+    Leadership fencing (optional): proposers that expose a non-None
+    ``leadership_epoch`` (RaftNode, the sim's member-bound proposer)
+    accept an ``epoch=`` keyword on propose/propose_async and reject a
+    proposal whose pinned epoch has been fenced — before serialization,
+    again pre-WAL, and again at commit-callback delivery.  The store
+    pins every chunk of a multi-proposal commit to the epoch it started
+    under, so a chunked commit can never straddle a role change.  Plain
+    proposers (this base class, test fakes) ignore fencing entirely.
     """
+
+    #: current leadership-epoch fencing token; None = no fencing support
+    leadership_epoch: Optional[int] = None
 
     def propose(self, actions: Sequence[StoreAction],
                 commit_cb: Callable[[], None]) -> None:
@@ -604,6 +616,20 @@ class MemoryStore:
         finally:
             _UPDATE_TX_TIMER.observe(time.perf_counter() - t0)
 
+    def _proposer_epoch(self) -> Optional[int]:
+        """The proposer's current leadership-epoch fencing token, or None
+        when the proposer (or a nil proposer) does not support fencing."""
+        return getattr(self._proposer, "leadership_epoch", None)
+
+    @staticmethod
+    def _propose_fenced(proposer, actions, commit_cb, epoch):
+        """propose() with the epoch pin when fencing is supported; plain
+        two-argument propose for legacy/test proposers."""
+        if epoch is None:
+            proposer.propose(actions, commit_cb)
+        else:
+            proposer.propose(actions, commit_cb, epoch=epoch)
+
     def _propose_and_commit(self, tx: "WriteTx") -> None:
         """Stamp versions, run consensus, apply.  Caller holds _update_lock.
 
@@ -618,8 +644,12 @@ class MemoryStore:
                 if change.action in ("create", "update"):
                     change.obj.meta.version.index = seq
             if self._proposer is not None:
-                self._proposer.propose(tx._changes,
-                                       lambda: self._commit(tx))
+                # the epoch read here travels with the proposal: stamped
+                # versions are only valid for the reign they were read
+                # under, and the fence makes that a checked invariant
+                self._propose_fenced(self._proposer, tx._changes,
+                                     lambda: self._commit(tx),
+                                     self._proposer_epoch())
                 return
         self._commit(tx)
 
@@ -851,6 +881,7 @@ class MemoryStore:
     def bulk_update_tasks(self, new_tasks: Sequence[Task], on_missing,
                           on_assigned,
                           guard_state: int = 192,  # TaskState.ASSIGNED
+                          epoch: Optional[int] = None,
                           ) -> Tuple[List[int], List[int]]:
         """Columnar commit path for scheduler decisions (the TPU path's
         array-shaped output).  Semantically one ``batch`` of single-task
@@ -899,6 +930,11 @@ class MemoryStore:
                             self._materialize_locked(table, t.id)
             want_actions = self._proposer is not None
             want_events = self.queue.has_subscribers()
+            if want_actions and epoch is None:
+                # pin every chunk of this commit to one reign: a role
+                # change mid-commit fails the remaining chunks instead of
+                # letting them ride the successor's epoch
+                epoch = self._proposer_epoch()
             i = 0
             while i < n:
                 stop = min(i + MAX_CHANGES_PER_TX, n)
@@ -942,7 +978,8 @@ class MemoryStore:
                     try:
                         # commit runs inside the consensus apply path (see
                         # Proposer.propose)
-                        self._proposer.propose(actions, apply_chunk)
+                        self._propose_fenced(self._proposer, actions,
+                                             apply_chunk, epoch)
                     except Exception:
                         # per-chunk failure granularity: earlier chunks are
                         # committed and stay committed; this chunk and all
@@ -985,6 +1022,7 @@ class MemoryStore:
                                 state: int, message: str,
                                 on_missing, on_assigned,
                                 guard_state: int = 192,
+                                epoch: Optional[int] = None,
                                 ) -> Tuple[List[int], List[int]]:
         """Columnar scheduler commit: assignments stay arrays end-to-end.
 
@@ -1027,7 +1065,8 @@ class MemoryStore:
         if self._proposer is not None:
             return self._commit_task_block_proposed(
                 old_tasks, node_ids, int(state), message,
-                on_missing, on_assigned, int(guard_state), ts)
+                on_missing, on_assigned, int(guard_state), ts,
+                epoch=epoch)
         with self._update_lock:
             table = self._tables["tasks"]
             objects = table.objects
@@ -1124,7 +1163,8 @@ class MemoryStore:
     def _commit_task_block_proposed(self, old_tasks: List[Task],
                                     node_ids: List[str], state: int,
                                     message: str, on_missing, on_assigned,
-                                    guard_state: int, ts: float
+                                    guard_state: int, ts: float,
+                                    epoch: Optional[int] = None,
                                     ) -> Tuple[List[int], List[int]]:
         """Block commit through the consensus seam: validate every item
         against the current store (no writes), stamp versions, then ride
@@ -1133,9 +1173,14 @@ class MemoryStore:
         like ``update``'s commit callback, so snapshots taken at an
         applied index always include that index's changes.  Chunk failure
         granularity matches ``bulk_update_tasks``: committed chunks stay
-        committed, the failing chunk and everything after fail."""
+        committed, the failing chunk and everything after fail.  All
+        chunks are pinned to one leadership epoch (``epoch``, default:
+        the proposer's at entry): a role change mid-commit fences the
+        remaining chunks at the proposer instead of racing it."""
         from .. import native
         hp = native.get()
+        if epoch is None:
+            epoch = self._proposer_epoch()
         committed_idx: List[int] = []
         failed_idx: List[int] = []
         missing: List[Tuple[Task, str]] = []
@@ -1272,8 +1317,12 @@ class MemoryStore:
 
                 if can_async:
                     try:
-                        waiter = proposer.propose_async([action],
-                                                        apply_chunk)
+                        if epoch is None:
+                            waiter = proposer.propose_async([action],
+                                                            apply_chunk)
+                        else:
+                            waiter = proposer.propose_async(
+                                [action], apply_chunk, epoch=epoch)
                     except Exception:
                         log.exception("columnar block proposal failed")
                         failed_idx.extend(chunk)
@@ -1286,7 +1335,8 @@ class MemoryStore:
                         ok_to_submit = False
                 else:
                     try:
-                        proposer.propose([action], apply_chunk)
+                        self._propose_fenced(proposer, [action],
+                                             apply_chunk, epoch)
                     except Exception:
                         log.exception("columnar block proposal failed")
                         failed_idx.extend(chunk)

@@ -2,7 +2,16 @@
 
 IDs are 25-character base36 strings drawn from a cryptographic source, like
 the reference's, so they sort uniformly and are URL-safe.
+
+``set_id_source`` is the determinism seam (the identity analogue of
+``models.types.set_time_source``): the simulator installs a seeded
+counter so ids minted by components it drives — orchestrator task
+creation above all — are a pure function of the scenario seed, keeping
+event order (agents sort tasks by id) and flight-recorder dumps
+byte-reproducible.  Production never installs a source.
 """
+
+from typing import Callable, Optional
 
 import secrets
 import string
@@ -12,8 +21,19 @@ _ID_LEN = 25
 # largest value representable in _ID_LEN base36 digits
 _MAX = 36 ** _ID_LEN
 
+# when set, new_id() delegates here (deterministic simulation)
+_id_source: Optional[Callable[[], str]] = None
+
+
+def set_id_source(source: Optional[Callable[[], str]]) -> None:
+    """Install (or with None, remove) a deterministic id generator."""
+    global _id_source
+    _id_source = source
+
 
 def new_id() -> str:
+    if _id_source is not None:
+        return _id_source()
     n = secrets.randbelow(_MAX)
     digits = []
     for _ in range(_ID_LEN):

@@ -100,6 +100,13 @@ class Registry:
         with self._lock:
             return self.counters.get(name, default)
 
+    def get_gauge(self, name: str, default: Optional[float] = None
+                  ) -> Optional[float]:
+        """Point read of one gauge; default (None) distinguishes
+        never-set from 0.0 — health checks treat no-data as pass."""
+        with self._lock:
+            return self.gauges.get(name, default)
+
     def counters_snapshot(self, prefix: str = "") -> Dict[str, float]:
         """Copy of the counter map (optionally prefix-filtered); bench
         diffs two snapshots to attribute counts to one timed region."""
