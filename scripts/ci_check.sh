@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# CI gate: static analysis first (cheap, catches convention drift with
+# exact file:line messages), then the tier-1 test suite from ROADMAP.md.
+# Exit nonzero on new swarmlint findings, stale/unjustified baseline
+# entries, or any tier-1 failure.
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== swarmlint (scripts/swarmlint.py) =="
+python scripts/swarmlint.py || exit 1
+
+echo
+echo "== tier-1 tests (ROADMAP.md) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+exit $rc

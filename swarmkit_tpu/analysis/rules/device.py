@@ -1,0 +1,152 @@
+"""device-path-purity: no host syncs or debug hooks inside plan fns.
+
+The planner's throughput story (async dispatch overlapping host commit,
+PR 4) dies the moment a jitted plan fn — or a helper it calls — forces
+a host round-trip.  Inside device-path functions in ``ops``/``parallel``
+(any function reaching jit: decorated with ``@jax.jit`` /
+``functools.partial(jax.jit, ...)``, wrapped via ``jax.jit(fn)``, or
+called from one within the same module) this rule flags:
+
+* ``.item()`` / ``float(tracer)`` / ``int(tracer)`` — implicit D2H
+  syncs (literal-constant args are fine);
+* ``jax.device_get`` / ``.block_until_ready()`` — explicit syncs that
+  belong in the *fetch* stage (``ops/kernel.py fetch_plan``), never
+  inside the compiled program;
+* ``np.*`` — numpy ops silently fall back to the host; device code uses
+  ``jnp``;
+* ``jax.debug.*`` — debug callbacks in the hot path recompile and
+  serialize the program.
+
+Host-side driver code in the same modules (``TPUPlanner``, the
+``ShardedPlanFn`` padding wrapper) is untouched: syncs are its job.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..core import Checker, Finding, ImportMap, ModuleInfo, register
+
+SCOPE_PREFIXES = ("swarmkit_tpu/ops/", "swarmkit_tpu/parallel/")
+
+_SYNC_ATTRS = {"item", "block_until_ready"}
+
+
+def _is_jit_decorator(dec: ast.AST, imports: ImportMap) -> bool:
+    """Matches @jax.jit, @jit, @functools.partial(jax.jit, ...) and
+    @partial(jit, ...)."""
+    if isinstance(dec, ast.Call):
+        dotted = imports.resolve(dec.func)
+        if dotted in ("jax.jit", "jit"):
+            return True
+        if dotted in ("functools.partial", "partial") and dec.args:
+            return imports.resolve(dec.args[0]) in ("jax.jit", "jit")
+        return False
+    return imports.resolve(dec) in ("jax.jit", "jit")
+
+
+def _module_functions(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
+    """Module-level and class-level defs by (unqualified) name."""
+    out: Dict[str, ast.FunctionDef] = {}
+    stack = list(ast.iter_child_nodes(tree))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            stack.extend(ast.iter_child_nodes(node))
+        elif isinstance(node, ast.FunctionDef):
+            out.setdefault(node.name, node)
+    return out
+
+
+@register
+class DevicePathPurity(Checker):
+    name = "device-path-purity"
+    description = ("no .item()/float()/np./jax.debug host syncs inside "
+                   "jitted plan fns (ops/, parallel/)")
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        if not mod.relpath.startswith(SCOPE_PREFIXES):
+            return ()
+        imports = ImportMap(mod.tree)
+        fns = _module_functions(mod.tree)
+
+        # roots: jit-decorated defs + fns wrapped as `x = jax.jit(f)`
+        device: Set[str] = set()
+        for name, fn in fns.items():
+            if any(_is_jit_decorator(d, imports) for d in fn.decorator_list):
+                device.add(name)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and imports.resolve(node.func) == "jax.jit" \
+                    and node.args and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in fns:
+                device.add(node.args[0].id)
+
+        # closure: helpers called (by bare name) from device fns, within
+        # this module, are device code too
+        frontier = list(device)
+        while frontier:
+            fn = fns.get(frontier.pop())
+            if fn is None:
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Name) \
+                        and sub.func.id in fns \
+                        and sub.func.id not in device:
+                    device.add(sub.func.id)
+                    frontier.append(sub.func.id)
+
+        out: List[Finding] = []
+        for name in sorted(device):
+            out.extend(self._check_fn(mod, fns[name], imports))
+        return out
+
+    def _check_fn(self, mod: ModuleInfo, fn: ast.FunctionDef,
+                  imports: ImportMap) -> List[Finding]:
+        out: List[Finding] = []
+        numpy_aliases = {alias for alias, target in imports.aliases.items()
+                         if target == "numpy"}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                dotted = imports.resolve(node.func)
+                tail = node.func.attr \
+                    if isinstance(node.func, ast.Attribute) else None
+                if tail in _SYNC_ATTRS:
+                    out.append(mod.finding(
+                        self.name, node,
+                        f".{tail}() inside device fn {fn.name}: implicit "
+                        "host sync; keep values on device (fetch "
+                        "belongs in ops/kernel.py fetch_plan)"))
+                elif dotted == "jax.device_get":
+                    out.append(mod.finding(
+                        self.name, node,
+                        f"jax.device_get inside device fn {fn.name}: "
+                        "D2H belongs in the fetch stage, not the "
+                        "compiled program"))
+                elif dotted and dotted.startswith("jax.debug."):
+                    out.append(mod.finding(
+                        self.name, node,
+                        f"{dotted} inside device fn {fn.name}: debug "
+                        "callbacks serialize the hot path; gate or "
+                        "remove"))
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in ("float", "int") \
+                        and node.args \
+                        and not isinstance(node.args[0], ast.Constant) \
+                        and not (isinstance(node.args[0], ast.Name)
+                                 and node.args[0].id.isupper()):
+                    out.append(mod.finding(
+                        self.name, node,
+                        f"{node.func.id}() on a traced value inside "
+                        f"device fn {fn.name}: implicit host sync; use "
+                        "jnp dtype casts"))
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in numpy_aliases:
+                out.append(mod.finding(
+                    self.name, node,
+                    f"np.{node.attr} inside device fn {fn.name}: numpy "
+                    "runs on host; use jnp"))
+        return out

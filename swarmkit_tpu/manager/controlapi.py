@@ -1017,6 +1017,9 @@ class ControlAPI:
         and refuse to serve after a restart until unlocked."""
         import os as _os
 
+        # the unlock key is cryptographic key material: it must come
+        # from the OS CSPRNG, never a seeded/simulated source
+        # swarmlint: disable=determinism-seam
         key = _os.urandom(32).hex() if enabled else ""
 
         def cb(tx):
@@ -1099,8 +1102,10 @@ class ControlAPI:
         ``follow`` live output is then collected for up to ``duration``
         seconds.  Returns [{task_id, node_id, stream, data(bytes)}], in
         arrival order.  Only meaningful on the leader (the broker agents
-        publish to); bounded so one call can't pin a server thread."""
-        import time as _time
+        publish to); bounded so one call can't pin a server thread.  The
+        collection deadline reads the models.types.now() seam, so a
+        simulated control API follows logs in virtual time."""
+        from ..models.types import now as _now
 
         broker = getattr(self, "log_broker", None)
         if broker is None:
@@ -1133,11 +1138,11 @@ class ControlAPI:
                 out.append({"task_id": msg.task_id,
                             "node_id": msg.node_id,
                             "stream": msg.stream, "data": msg.data})
-            deadline = _time.time() + duration
-            while follow and _time.time() < deadline:
+            deadline = _now() + duration
+            while follow and _now() < deadline:
                 try:
                     msg = stream.get(timeout=max(
-                        0.05, deadline - _time.time()))
+                        0.05, deadline - _now()))
                 except TimeoutError:
                     break
                 except Exception:      # broker closed mid-collection

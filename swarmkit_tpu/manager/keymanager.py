@@ -48,9 +48,12 @@ class KeyManager:
         self._thread: Optional[threading.Thread] = None
 
     def _new_key(self, subsystem: str, lamport: int) -> EncryptionKey:
+        # overlay encryption keys are cryptographic material: they must
+        # come from the OS CSPRNG, never a seeded/simulated source
+        # swarmlint: disable=determinism-seam
+        key = os.urandom(self.config.keylen)
         return EncryptionKey(subsystem=subsystem, algorithm=0,
-                             key=os.urandom(self.config.keylen),
-                             lamport_time=lamport)
+                             key=key, lamport_time=lamport)
 
     def rotate_now(self) -> None:
         """One rotation pass (reference: rotateKey :124)."""

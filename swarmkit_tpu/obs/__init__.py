@@ -13,6 +13,7 @@ judges declarative SLO checks over the registry and serves
 and the derived planes on top.
 """
 
+from . import debugpages  # noqa: F401  (installs /debug/* endpoint hook)
 from .flightrec import FlightRecorder, flightrec
 from .health import Check, HealthEvaluator
 from .lifecycle import LifecycleTracker

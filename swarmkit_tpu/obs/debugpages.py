@@ -1,0 +1,69 @@
+"""The obs package's /debug/* endpoints for utils.httpdebug.DebugServer.
+
+These handlers used to live inside httpdebug itself, lazily importing
+obs — an inversion of the layering matrix (utils must import nothing
+above itself; swarmlint's ``layering`` rule now enforces that).  They
+register here instead, through the server's default-endpoint hook:
+importing the obs package is what makes any subsequently constructed
+DebugServer serve /debug/trace, /debug/health and /debug/flightrec.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Tuple
+
+from ..utils import httpdebug
+
+
+def _h_trace(server, query) -> Tuple[bytes, int, str]:
+    from .trace import tracer
+    enable = query.get("enable")
+    if enable:
+        value = enable[0].lower()
+        if value in ("1", "true", "on", "yes"):
+            tracer.reset()
+            tracer.enable()
+            return b"tracing enabled\n", 200, "text/plain"
+        if value in ("0", "false", "off", "no"):
+            tracer.disable()
+            return b"tracing disabled\n", 200, "text/plain"
+        return (f"bad enable value {value!r}; use 1/0\n".encode(),
+                400, "text/plain")
+    return tracer.to_json().encode(), 200, "application/json"
+
+
+def _h_health(server, query) -> Tuple[bytes, int, str]:
+    ev = server._evaluator
+    if ev is None:
+        from .health import evaluator
+        ev = server._evaluator = evaluator
+    report = ev.report()
+    # probes consume the status code; humans the JSON body
+    code = 503 if report["status"] == "fail" else 200
+    body = json.dumps(report, sort_keys=True, indent=1).encode()
+    return body, code, "application/json"
+
+
+def _h_flightrec(server, query) -> Tuple[bytes, int, str]:
+    from .flightrec import flightrec
+    return flightrec.dump_json().encode(), 200, "application/json"
+
+
+def _install(server: "httpdebug.DebugServer") -> None:
+    server.register("/debug/trace",
+                    lambda query: _h_trace(server, query),
+                    "Chrome trace-event JSON of the span tracer "
+                    "(?enable=1/0 toggles recording)")
+    server.register("/debug/health",
+                    lambda query: _h_health(server, query),
+                    "SLO check report (JSON); 503 while any check "
+                    "is failing")
+    server.register("/debug/flightrec",
+                    lambda query: _h_flightrec(server, query),
+                    "flight-recorder post-mortem dump (JSON): recent "
+                    "spans, metric samples, store events, raft "
+                    "transitions")
+
+
+httpdebug.register_default_endpoints(_install)

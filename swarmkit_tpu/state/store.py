@@ -1318,6 +1318,8 @@ class MemoryStore:
                 if can_async:
                     try:
                         if epoch is None:
+                            # legacy 2-arg proposers have no fencing
+                            # swarmlint: disable=epoch-fencing
                             waiter = proposer.propose_async([action],
                                                             apply_chunk)
                         else:

@@ -18,6 +18,9 @@ import threading
 from collections import deque
 from typing import Any, Callable, Iterable, Iterator, List, Optional
 
+from ..models.types import now as _now, time_source_installed \
+    as _virtual_time
+
 Predicate = Callable[[Any], bool]
 
 
@@ -115,10 +118,24 @@ class Subscription:
         """Next event; blocks up to ``timeout`` (forever when None).
         Buffered blocks expand on THIS thread, outside the lock — with
         one consumer per subscription (the usage contract) ordering is
-        preserved by re-splicing the tail at the buffer front."""
+        preserved by re-splicing the tail at the buffer front.  The
+        deadline reads through models.types.now() — the determinism
+        seam — so a simulated consumer's wait window is a function of
+        the virtual clock, not the host's.  In production now() is
+        wall-clock: like every other deadline in the control plane
+        (dispatcher TTLs, scheduler debounce), a clock step moves it —
+        the price of one observable time axis end to end.  A generous
+        REAL-time backstop bounds the wait when an installed virtual
+        clock is frozen (a test forgot to step it): raise TimeoutError,
+        never hang the consumer thread."""
         import time as _time
-        deadline = None if timeout is None \
-            else _time.monotonic() + timeout
+        deadline = None if timeout is None else _now() + timeout
+        if timeout is None:
+            real_deadline = None
+        else:
+            # the backstop must read host time by definition
+            # swarmlint: disable=determinism-seam
+            real_deadline = _time.monotonic() + timeout * 16.0 + 1.0
         while True:
             with self._cond:
                 item = self._buf.popleft() if self._buf else None
@@ -128,17 +145,26 @@ class Subscription:
                     if deadline is None:
                         self._cond.wait()
                     else:
-                        remaining = deadline - _time.monotonic()
+                        remaining = deadline - _now()
                         if remaining <= 0:
                             raise TimeoutError()
-                        self._cond.wait(remaining)
+                        # virtual remaining is not real seconds: under
+                        # an installed virtual clock wait in short real
+                        # slices so a deadline stepped past mid-wait is
+                        # observed promptly, not after the full slice
+                        self._cond.wait(min(remaining, 0.05)
+                                        if _virtual_time()
+                                        else remaining)
                     item = self._buf.popleft() if self._buf else None
                     if item is None:
                         if self._closed:
                             raise Closed()
-                        if deadline is not None and \
-                                _time.monotonic() >= deadline:
-                            raise TimeoutError()
+                        if deadline is not None:
+                            # backstop read, see above
+                            # swarmlint: disable=determinism-seam
+                            hung = _time.monotonic() >= real_deadline
+                            if hung or _now() >= deadline:
+                                raise TimeoutError()
                         continue
             if not self._needs_expand(item):
                 return item

@@ -21,9 +21,12 @@ from __future__ import annotations
 
 import argparse
 import logging
+import random
 import threading
 import time
 from typing import Optional, Tuple
+
+from .models.types import now as _seam_now
 
 log = logging.getLogger("swarmd")
 
@@ -56,7 +59,8 @@ class Swarmd:
                  cert_renew_interval: float = 60.0,
                  unlock_key: str = "",
                  force_new_cluster: bool = False,
-                 listen_metrics: Optional[Tuple[str, int]] = None):
+                 listen_metrics: Optional[Tuple[str, int]] = None,
+                 clock=None, rng: Optional[random.Random] = None):
         import os
 
         from .agent.testutils import TestExecutor
@@ -106,11 +110,18 @@ class Swarmd:
         self.raft_id = "m-" + self.hostname
         # serializes role transitions against stop() and each other
         self._role_mu = threading.Lock()
+        # injected clock/rng seams (matching Agent(rng=)): deadlines and
+        # reconnect/role-retry backoff read through these so tests and
+        # the simulator control them; production defaults are the
+        # models.types.now() seam and a per-process unseeded rng
+        self._clock = clock or _seam_now
+        self._rng = rng or random.Random()
 
     def start(self) -> None:
         from .node import Node
 
         if self.listen_metrics is not None:
+            from . import obs  # noqa: F401  (registers /debug/* endpoints)
             from .utils.httpdebug import DebugServer
             def health() -> str:
                 if self.manager is not None:
@@ -343,8 +354,10 @@ class Swarmd:
         self._role_watcher_started = True
         from .models.types import NodeRole
 
+        from .remotes import backoff_with_jitter
+
         def loop():
-            backoff, next_try = 0.5, 0.0
+            attempt, next_try = 0, 0.0
             while not self._stop_event.wait(0.5):
                 node = self.node
                 agent = node.agent if node is not None else None
@@ -364,9 +377,9 @@ class Swarmd:
                 wants_demote = (role == NodeRole.WORKER
                                 and self.manager is not None)
                 if not wants_promote and not wants_demote:
-                    backoff, next_try = 0.5, 0.0   # settled: reset
+                    attempt, next_try = 0, 0.0   # settled: reset
                     continue
-                if time.time() < next_try:
+                if self._clock() < next_try:
                     continue
                 try:
                     with self._role_mu:
@@ -376,15 +389,19 @@ class Swarmd:
                             self._promote_to_manager(client)
                         elif wants_demote and self.manager is not None:
                             self._demote_to_worker(client)
-                    backoff, next_try = 0.5, 0.0
+                    attempt, next_try = 0, 0.0
                 except Exception:
                     # a failed attempt redials managers and (for
-                    # promotion) rebuilds a whole stack — back off
-                    # exponentially instead of churning twice a second
+                    # promotion) rebuilds a whole stack — back off with
+                    # full jitter through the injected clock/rng seams
+                    # instead of churning twice a second (and instead
+                    # of a whole fleet retrying in lockstep)
+                    delay = backoff_with_jitter(attempt, rng=self._rng,
+                                                base=0.5, cap=30.0)
                     log.exception("role transition failed; retrying in "
-                                  "%.1fs", backoff)
-                    next_try = time.time() + backoff
-                    backoff = min(30.0, backoff * 2)
+                                  "%.1fs", delay)
+                    next_try = self._clock() + delay
+                    attempt += 1
 
         threading.Thread(target=loop, name="role-watcher",
                          daemon=True).start()
@@ -594,11 +611,19 @@ class Swarmd:
                          daemon=True).start()
 
     def _wait(self, cond, err: str, timeout: float = 20.0) -> None:
-        deadline = time.time() + timeout
-        while not cond():
-            if time.time() > deadline:
+        """Poll ``cond`` until true or the injected-clock deadline
+        passes.  A loop-count backstop (~10x the nominal window in real
+        sleeps) guards against a frozen injected clock: a test that
+        forgets to step its virtual clock gets the RuntimeError, not a
+        hung harness."""
+        deadline = self._clock() + timeout
+        for _ in range(max(1, int(timeout / 0.02) * 10)):
+            if cond():
+                return
+            if self._clock() > deadline:
                 raise RuntimeError(err)
             time.sleep(0.02)
+        raise RuntimeError(err)
 
     def _cert_accepted(self, cert) -> bool:
         """Probe the remote hello with the persisted cert: the server

@@ -8,14 +8,16 @@ tests rely on exactly that for diagnosis).
 
 Endpoints register into a table (path -> handler + description) so ``/``
 serves a discoverable index and embedders can add their own via
-``register()``.  ``/debug/health`` returns 503 while any SLO check is
-failing, so load balancers and probes can consume it without parsing.
+``register()``.  This module owns only the layer-free builtins
+(/metrics, /healthz, /debug/stacks); the obs package contributes
+/debug/trace, /debug/health (503 while any SLO check is failing) and
+/debug/flightrec through ``register_default_endpoints`` — utils sits
+below obs in the layering matrix and must not import it.
 """
 
 from __future__ import annotations
 
 import http.server
-import json
 import sys
 import threading
 import traceback
@@ -26,6 +28,20 @@ from .metrics import registry
 
 # handler(query: {k: [v, ...]}) -> (body bytes, status code, content type)
 Handler = Callable[[Dict[str, list]], Tuple[bytes, int, str]]
+
+# registered by higher layers (obs) at import time: each callback gets
+# every newly constructed DebugServer and installs its endpoints, so the
+# dependency points downward (obs -> utils) instead of utils importing
+# the planes it serves
+_default_endpoint_hooks: list = []
+
+
+def register_default_endpoints(hook: Callable[["DebugServer"], None]
+                               ) -> None:
+    """Install ``hook(server)`` to run for every DebugServer built from
+    now on (idempotent per hook object)."""
+    if hook not in _default_endpoint_hooks:
+        _default_endpoint_hooks.append(hook)
 
 
 def _all_stacks() -> str:
@@ -49,13 +65,14 @@ class DebugServer:
                  health: Optional[Callable[[], str]] = None,
                  health_evaluator=None):
         self.health = health or (lambda: "SERVING")
-        # the SLO evaluator behind /debug/health; defaults to the shared
-        # obs.health singleton (late-bound so importing this module never
-        # pulls the obs package in)
+        # the SLO evaluator behind /debug/health (served by the obs
+        # endpoint hook); None means the obs singleton
         self._evaluator = health_evaluator
         #: path -> (description, handler); see register()
         self.endpoints: Dict[str, Tuple[str, Handler]] = {}
         self._register_builtins()
+        for hook in list(_default_endpoint_hooks):
+            hook(self)
         outer = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -92,16 +109,6 @@ class DebugServer:
                       "liveness probe: SERVING (200) or NOT_SERVING (503)")
         self.register("/debug/stacks", self._h_stacks,
                       "stack dump of every live thread")
-        self.register("/debug/trace", self._h_trace,
-                      "Chrome trace-event JSON of the span tracer "
-                      "(?enable=1/0 toggles recording)")
-        self.register("/debug/health", self._h_health,
-                      "SLO check report (JSON); 503 while any check "
-                      "is failing")
-        self.register("/debug/flightrec", self._h_flightrec,
-                      "flight-recorder post-mortem dump (JSON): recent "
-                      "spans, metric samples, store events, raft "
-                      "transitions")
 
     def _dispatch(self, raw_path: str) -> Tuple[bytes, int, str]:
         parts = urllib.parse.urlsplit(raw_path)
@@ -142,40 +149,6 @@ class DebugServer:
 
     def _h_stacks(self, query) -> Tuple[bytes, int, str]:
         return _all_stacks().encode(), 200, "text/plain"
-
-    def _h_trace(self, query) -> Tuple[bytes, int, str]:
-        from ..obs.trace import tracer
-        enable = query.get("enable")
-        if enable:
-            value = enable[0].lower()
-            if value in ("1", "true", "on", "yes"):
-                tracer.reset()
-                tracer.enable()
-                return b"tracing enabled\n", 200, "text/plain"
-            if value in ("0", "false", "off", "no"):
-                tracer.disable()
-                return b"tracing disabled\n", 200, "text/plain"
-            return (f"bad enable value {value!r}; use 1/0\n".encode(),
-                    400, "text/plain")
-        return tracer.to_json().encode(), 200, "application/json"
-
-    def _get_evaluator(self):
-        if self._evaluator is None:
-            from ..obs.health import evaluator
-            self._evaluator = evaluator
-        return self._evaluator
-
-    def _h_health(self, query) -> Tuple[bytes, int, str]:
-        ev = self._get_evaluator()
-        report = ev.report()
-        # probes consume the status code; humans the JSON body
-        code = 503 if report["status"] == "fail" else 200
-        body = json.dumps(report, sort_keys=True, indent=1).encode()
-        return body, code, "application/json"
-
-    def _h_flightrec(self, query) -> Tuple[bytes, int, str]:
-        from ..obs.flightrec import flightrec
-        return flightrec.dump_json().encode(), 200, "application/json"
 
     # ------------------------------------------------------------- lifecycle
 

@@ -1,0 +1,29 @@
+"""Fixture: every determinism-seam bypass in one module (must fire)."""
+import os
+import random
+import time
+import uuid
+
+
+def deadline(timeout):
+    return time.time() + timeout          # bare wall clock
+
+
+def wait_until(timeout):
+    return time.monotonic() + timeout     # bare monotonic
+
+
+def mint_id():
+    return uuid.uuid4().hex               # unseamed id
+
+
+def token():
+    return os.urandom(16).hex()           # unseamed entropy
+
+
+def make_rng():
+    return random.Random()                # unseeded, not a seam default
+
+
+def draw():
+    return random.random()                # global unseeded RNG

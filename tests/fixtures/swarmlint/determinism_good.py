@@ -1,0 +1,31 @@
+"""Fixture: the corrected twin — everything flows through the seams."""
+import random
+import time
+
+from swarmkit_tpu.models.types import now
+from swarmkit_tpu.utils import identity
+
+
+def deadline(timeout):
+    return now() + timeout                # the time seam
+
+
+def mint_id():
+    return identity.new_id()              # the id seam
+
+
+def token():
+    return identity.new_secret()          # the entropy seam
+
+
+class Worker:
+    def __init__(self, rng=None, clock=None):
+        # the sanctioned constructor-default idiom for injected seams
+        self._rng = rng or random.Random()
+        self._clock = clock or time.monotonic   # reference, not a call
+
+    def draw(self):
+        return self._rng.random()
+
+    def measure(self):
+        return time.perf_counter()        # duration measurement: allowed

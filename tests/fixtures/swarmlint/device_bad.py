@@ -1,0 +1,28 @@
+"""Fixture: host syncs inside jitted plan fns (must fire).
+
+The test harness lints this file as ``swarmkit_tpu/ops/fixture.py``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def plan(scores, k):
+    best = scores.argmax()
+    worst = float(scores.min())            # implicit D2H sync
+    return np.take(scores, best), worst    # numpy falls back to host
+
+
+@functools.partial(jax.jit, static_argnames=("L",))
+def plan_hier(scores, L):
+    jax.debug.print("scores {s}", s=scores)   # debug in the hot path
+    return _accumulate(scores)
+
+
+def _accumulate(scores):
+    # reached from plan_hier: device code by closure
+    return scores.sum().item()             # D2H sync in a helper

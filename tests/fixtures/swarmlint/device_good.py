@@ -1,0 +1,39 @@
+"""Fixture: the corrected twin — pure device code, syncs in the driver.
+
+The test harness lints this file as ``swarmkit_tpu/ops/fixture.py``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LOAD_CLAMP = 1 << 20
+
+
+@jax.jit
+def plan(scores, k):
+    best = scores.argmax()
+    worst = scores.min().astype(jnp.float32)     # stays on device
+    clamped = jnp.minimum(scores, float(LOAD_CLAMP))  # static constant
+    return jnp.take(clamped, best), worst
+
+
+@functools.partial(jax.jit, static_argnames=("L",))
+def plan_hier(scores, L):
+    return _accumulate(scores)
+
+
+def _accumulate(scores):
+    return scores.sum()                          # still a device value
+
+
+def fetch(arrays):
+    # host driver (not jitted): explicit D2H is its job
+    return jax.device_get(arrays)
+
+
+def pad_inputs(a, width):
+    # host driver: numpy padding before device placement is fine
+    return np.pad(np.asarray(a), (0, width))

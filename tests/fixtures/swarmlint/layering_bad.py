@@ -1,0 +1,10 @@
+"""Fixture: device-path module reaching into forbidden layers (fires).
+
+The test harness lints this file as ``swarmkit_tpu/ops/fixture.py``.
+"""
+
+import swarmkit_tpu.state.store                      # ops -> state
+from swarmkit_tpu.manager.dispatcher import Dispatcher   # ops -> manager
+from swarmkit_tpu.sim import run_scenario            # production -> sim
+
+from ..orchestrator import common                    # ops -> orchestrator

@@ -1,0 +1,13 @@
+"""Fixture: the corrected twin — device path sees only what it may.
+
+The test harness lints this file as ``swarmkit_tpu/ops/fixture.py``.
+"""
+
+import jax.numpy as jnp                              # third-party: free
+
+from swarmkit_tpu.models.types import TaskState      # ops -> models
+from swarmkit_tpu.utils.metrics import registry      # ops -> utils
+from swarmkit_tpu.scheduler.nodeinfo import NodeInfo  # ops -> scheduler
+from swarmkit_tpu.obs.trace import tracer            # ops -> obs
+
+from . import hashing                                # within the package

@@ -1,0 +1,22 @@
+"""Fixture: lock-order cycle + blocking work under store locks (fires)."""
+
+
+class MemoryStore:
+    def forward_order(self):
+        with self._update_lock:
+            with self._lock:
+                self.apply()
+
+    def reverse_order(self):
+        # opposite nesting of forward_order: a lock-order cycle
+        with self._lock:
+            with self._update_lock:
+                self.apply()
+
+    def read_then_wait(self, proposer, waiter):
+        with self._lock:
+            proposer.wait_proposal(waiter)   # consensus under view lock
+
+    def commit_with_fetch(self, planner, handle):
+        with self._update_lock:
+            planner.fetch_group(handle)      # D2H under the writer lock

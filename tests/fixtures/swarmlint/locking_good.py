@@ -1,0 +1,31 @@
+"""Fixture: the corrected twin — one lock order, blocking work outside."""
+
+
+class MemoryStore:
+    def forward_order(self):
+        with self._update_lock:
+            with self._lock:
+                self.apply()
+
+    def same_order_elsewhere(self):
+        with self._update_lock:
+            with self._lock:
+                self.snapshot()
+
+    def read_then_wait(self, proposer, waiter):
+        with self._lock:
+            snapshot = self.snapshot()
+        proposer.wait_proposal(waiter)       # after release
+        return snapshot
+
+    def fetch_then_commit(self, planner, handle):
+        out = planner.fetch_group(handle)    # D2H before taking locks
+        with self._update_lock:
+            with self._lock:
+                self.apply(out)
+
+    def propose_under_update_lock(self, proposer, actions, cb, epoch):
+        # consensus under the WRITER lock is the sanctioned commit
+        # path (writers serialize through consensus by design)
+        with self._update_lock:
+            proposer.propose(actions, cb, epoch=epoch)

@@ -1,0 +1,11 @@
+"""Fixture: metric names violating the exposition grammar (must fire)."""
+
+from swarmkit_tpu.utils.metrics import registry
+
+
+def record(route):
+    registry.counter("swarm_Tick-Seconds")           # bad characters
+    registry.counter('swarm_planner_groups{route="a",mode="b"}')  # unsorted
+    registry.gauge(
+        f'swarm_health{{check="{route}",check="{route}"}}', 1.0)  # duplicate
+    registry.timer('swarm_store_lock{Holder="x"}')   # uppercase label key

@@ -1,0 +1,10 @@
+"""Fixture: the corrected twin — grammar-clean metric call sites."""
+
+from swarmkit_tpu.utils.metrics import registry
+
+
+def record(route, bucket):
+    registry.counter("swarm_scheduler_ticks")
+    registry.counter(f'swarm_planner_groups{{mode="b",route="{route}"}}')
+    registry.gauge(f'swarm_planner_compiles{{bucket="{bucket}"}}', 1.0)
+    registry.timer("swarm_store_lock_hold_seconds")

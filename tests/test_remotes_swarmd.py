@@ -476,3 +476,32 @@ def test_swarmd_agents_follow_leader_after_death():
         m1.stop()
         m2.stop()
         m0.stop()
+
+
+def test_swarmd_injected_clock_rng_seams(tmp_path):
+    """Swarmd(clock=, rng=) (matching Agent(rng=)): deadlines read the
+    injected clock, and a FROZEN clock still raises via the loop-count
+    backstop instead of hanging the harness."""
+    import random
+
+    vt = [1000.0]
+    sd = Swarmd(str(tmp_path), clock=lambda: vt[0],
+                rng=random.Random(7))
+    assert sd._clock() == 1000.0
+    assert sd._rng.random() == random.Random(7).random()
+
+    # advancing clock: deadline observed without real-time waiting
+    def cond():
+        vt[0] += 6.0
+        return False
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError):
+        sd._wait(cond, "deadline", timeout=5.0)
+    assert time.monotonic() - t0 < 2.0
+
+    # frozen clock: the backstop bounds the loop
+    frozen = Swarmd(str(tmp_path), clock=lambda: 100.0)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError):
+        frozen._wait(lambda: False, "frozen", timeout=0.05)
+    assert time.monotonic() - t0 < 5.0

@@ -460,3 +460,26 @@ def test_batch_flushes_on_byte_bound():
         n_commits3 += 1
     assert n_commits3 == 1, \
         "proposer-less batch must not split on bytes"
+
+
+def test_watch_get_timeout_backstop_under_frozen_virtual_clock():
+    """Subscription.get(timeout) deadlines read the now() seam; with a
+    FROZEN virtual clock installed the real-time backstop must still
+    raise TimeoutError (bounded, generous) instead of hanging the
+    consumer thread forever."""
+    import time
+
+    from swarmkit_tpu.models import types
+    from swarmkit_tpu.state.watch import Queue
+
+    q = Queue()
+    sub = q.subscribe()
+    types.set_time_source(lambda: 500.0)   # frozen
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            sub.get(timeout=0.05)
+        # backstop is timeout*16 + 1s; generous bound for slow CI
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        types.set_time_source(None)
