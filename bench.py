@@ -37,7 +37,8 @@ trial), not from ad-hoc dict fields.
 
 Env overrides: BENCH_NODES, BENCH_TASKS, BENCH_BASELINE_TASKS,
 BENCH_SKIP_HOST, BENCH_TRIALS, BENCH_SKIP_CONFIGS, BENCH_SKIP_E2E,
-BENCH_SKIP_OBS, BENCH_TRACE_OUT.
+BENCH_SKIP_OBS, BENCH_TRACE_OUT, BENCH_CFG6_SERVICES,
+BENCH_CFG7_SERVICES/NODES/TASKS, SWARM_PLANNER_MESH.
 """
 
 import gc
@@ -79,6 +80,34 @@ FLIGHTREC_OUT = os.environ.get("BENCH_FLIGHTREC_OUT",
 # every run appends its per-config summary here (bench_compare.py diffs
 # entries); set to "" to disable
 HISTORY_OUT = os.environ.get("BENCH_HISTORY", "BENCH_HISTORY.jsonl")
+
+
+def _mesh_devices() -> int:
+    """Planner mesh size (SWARM_PLANNER_MESH), 1 when unset/garbage —
+    same parse rules as parallel.sharded.mesh_from_env."""
+    raw = os.environ.get("SWARM_PLANNER_MESH", "").strip()
+    try:
+        return max(1, int(raw)) if raw else 1
+    except ValueError:
+        return 1
+
+
+def _mesh_crossover():
+    """The mesh crossover artifact (scripts/mesh_crossover.py), trimmed
+    to the headline fields, or None when it has not been measured."""
+    path = os.environ.get("BENCH_MESH_CROSSOVER", "MULTICHIP_r06.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return {
+        "winner_by_shape": doc.get("winner_by_shape"),
+        "placements_equal_across_mesh":
+            doc.get("placements_equal_across_mesh"),
+        "curves": {nb: s.get("curve")
+                   for nb, s in (doc.get("shapes") or {}).items()},
+    }
 
 
 def _cfg_enabled(n: int) -> bool:
@@ -479,9 +508,8 @@ def run_storm(planner_factory):
 
 
 def run_live_manager(planner_factory, external_firehose=False,
-                     n_services=None):
-    """Config 6: config-4's scale (100k pending tasks x 10k nodes, one
-    such service per ``n_services``) in PRODUCTION shape — a real
+                     n_services=None, n_nodes=None, total_tasks=None):
+    """Config 6/7: config-4's scale in PRODUCTION shape — a real
     single-voter raft proposer (on-disk WAL, consensus apply path)
     attached to the store, plus the control plane's subscriber mix
     (dispatcher sessions, orchestrator/reaper loops, metrics collector —
@@ -489,12 +517,16 @@ def run_live_manager(planner_factory, external_firehose=False,
     consumer threads).  Blocks ride one compact TaskBlockAction per
     chunk through raft and publish one coalesced EventTaskBlock.
 
-    ``n_services`` (default 2, env BENCH_CFG6_SERVICES) services of
-    N_TASKS each schedule in ONE tick — the multi-group shape a live
-    manager actually carries, and the shape the pipelined scheduler
-    overlaps: group i+1's device plan computes while group i's chunks
-    ride raft (the tick's ``plan_hidden_frac`` is the headline overlap
-    evidence for ROADMAP item 1).
+    ``n_services`` (default 2, env BENCH_CFG6_SERVICES) services
+    splitting ``total_tasks`` (default N_TASKS each) schedule in ONE
+    tick — the multi-group shape a live manager actually carries.  Runs
+    of fusable groups densify into ONE scan-over-groups program per
+    chunk (ops/fusedbatch.py), so the tick pays one device round-trip
+    ladder regardless of service count; chunk i+1 computes while group
+    i's chunks ride raft (``plan_hidden_frac`` is the overlap
+    evidence).  Config 7 reuses this harness at 10 services
+    (BENCH_CFG7_* env knobs scale it toward the 1M-task x 50k-node
+    target shape on hosts that hold it).
 
     ``external_firehose`` adds a watch-API-style client consuming EVERY
     task as a synthesized per-task event.  Synthesis runs on the
@@ -514,8 +546,30 @@ def run_live_manager(planner_factory, external_firehose=False,
 
     if n_services is None:
         n_services = int(os.environ.get("BENCH_CFG6_SERVICES", 2))
-    total_tasks = N_TASKS * n_services
-    store, svc, nodes, tasks = build_cluster(N_NODES, total_tasks,
+    if n_nodes is None:
+        n_nodes = N_NODES
+    if total_tasks is None:
+        total_tasks = N_TASKS * n_services
+
+    # warm-up at this config's exact fused jit signatures: same node
+    # bucket, same service count (group-slot/service-slot buckets), tiny
+    # task counts — compiles must never land in the timed tick (tracer
+    # off so the compile spans stay out of this config's phase window)
+    from swarmkit_tpu.obs import tracer as _tracer
+    was_tracing = _tracer.enabled
+    _tracer.disable()
+    try:
+        warm_store, *_ = build_cluster(n_nodes, 16 * n_services,
+                                       n_services=n_services)
+        warm_planner = planner_factory()
+        warm_planner.enable_small_group_routing = False
+        one_tick(warm_store, warm_planner)
+        del warm_store, warm_planner
+        _trim_heap()
+    finally:
+        _tracer.enabled = was_tracing
+
+    store, svc, nodes, tasks = build_cluster(n_nodes, total_tasks,
                                              n_services=n_services)
     tmp = tempfile.mkdtemp(prefix="bench-raft-")
     rn = RaftNode("b0", ["b0"], store,
@@ -560,22 +614,30 @@ def run_live_manager(planner_factory, external_firehose=False,
     metrics_sub = store.queue.subscribe(accepts_blocks=True)
     stop = threading.Event()
 
+    # consumers BLOCK on the subscription like the real components do
+    # (orchestrator/dispatcher loops wait in Subscription.get, they do
+    # not poll) — sleep-polling here both mismodels the components and
+    # taxes the tick with periodic GIL wakeups on this 1-core host
+
+    def _blocking_items(sub):
+        try:
+            head = sub.get(timeout=0.1)
+        except TimeoutError:
+            return []
+        return [head] + sub.drain()
+
     def consume(name, sub):
         got = 0
         while not stop.is_set():
-            items = sub.drain()
-            if items:
-                for it in items:
-                    if isinstance(it, EventTaskBlock):
-                        if name.startswith("session"):
-                            nid = session_nodes[int(name[7:])]
-                            got += len(it.per_node().get(nid, ()))
-                        else:
-                            got += len(it)   # control loop: O(1) skip
+            for it in _blocking_items(sub):
+                if isinstance(it, EventTaskBlock):
+                    if name.startswith("session"):
+                        nid = session_nodes[int(name[7:])]
+                        got += len(it.per_node().get(nid, ()))
                     else:
-                        got += 1
-            else:
-                time.sleep(0.01)
+                        got += len(it)   # control loop: O(1) skip
+                else:
+                    got += 1
         for it in sub.drain():
             got += len(it) if isinstance(it, EventTaskBlock) else 1
         counts[name] = got
@@ -596,11 +658,7 @@ def run_live_manager(planner_factory, external_firehose=False,
                     got += 1
 
         while not stop.is_set():
-            items = sub.drain()
-            if items:
-                absorb(items)
-            else:
-                time.sleep(0.01)
+            absorb(_blocking_items(sub))
         absorb(sub.drain())   # post-stop tail, like consume()
         counts["metrics"] = got
 
@@ -633,7 +691,7 @@ def run_live_manager(planner_factory, external_firehose=False,
         if external_firehose:
             assert counts["external_watch"] >= n_dec, counts
         return {
-            "nodes": N_NODES, "tasks": total_tasks,
+            "nodes": n_nodes, "tasks": total_tasks,
             "services": n_services,
             "pipeline_depth": sched.pipeline_depth,
             "decisions": n_dec,
@@ -642,6 +700,10 @@ def run_live_manager(planner_factory, external_firehose=False,
             "plan_s": round(planner.stats["plan_seconds"], 3),
             "commit_s": round(sched.stats["commit_seconds"], 3),
             "fallback_groups": routed["groups_fallback"],
+            "groups_fused": routed["groups_fused"],
+            "mesh_devices": (planner.mesh.shape["nodes"]
+                             if getattr(planner, "mesh", None) is not None
+                             else 1),
             "raft_entries_applied": rn.stats["applied"],
             "events_delivered": dict(counts),
             "path": "device+raft+watchers",
@@ -887,6 +949,14 @@ def main():
     if _cfg_enabled(5):
         with tracer.span("bench.config", "bench", cfg="cfg5"):
             configs["5_reschedule_storm"] = run_storm(tpu)
+    # shape_cost_x = per-decision cost of a config relative to the
+    # lab-shape headline (tpu_dps).  Configs 1-5 run the very harness
+    # the headline runs (no proposer, no watchers) — they ARE the lab
+    # shape, so their production-shape cost factor is 1.0 by
+    # construction; recording it (instead of the old None) keeps the
+    # history ledger's per-config shape_cost_x column well-defined.
+    for cfg in configs.values():
+        cfg.setdefault("shape_cost_x", 1.0)
     if _cfg_enabled(6):
         with tracer.span("bench.config", "bench", cfg="cfg6"):
             configs["6_live_manager_2x100k_x_10k"] = run_live_manager(tpu)
@@ -896,6 +966,22 @@ def main():
         # proposer/watchers); target <1.5x
         configs["6_live_manager_2x100k_x_10k"]["shape_cost_x"] = round(
             tpu_dps / live, 2) if live else None
+    if _cfg_enabled(7):
+        # many-service scale-out: 10 services fused into one program
+        # ladder per tick.  Defaults fit the dev container; the env
+        # knobs scale toward the 1M-task x 50k-node target shape on
+        # hosts that hold it (BENCH_CFG7_NODES=50000
+        # BENCH_CFG7_TASKS=1000000).
+        cfg7_services = int(os.environ.get("BENCH_CFG7_SERVICES", 10))
+        cfg7_nodes = int(os.environ.get("BENCH_CFG7_NODES", N_NODES))
+        cfg7_tasks = int(os.environ.get("BENCH_CFG7_TASKS", 500_000))
+        with tracer.span("bench.config", "bench", cfg="cfg7"):
+            configs["7_many_service_10x"] = run_live_manager(
+                tpu, n_services=cfg7_services, n_nodes=cfg7_nodes,
+                total_tasks=cfg7_tasks)
+        live7 = configs["7_many_service_10x"]["decisions_per_sec"]
+        configs["7_many_service_10x"]["shape_cost_x"] = round(
+            tpu_dps / live7, 2) if live7 else None
     if SKIP_E2E:
         e2e = None
     else:
@@ -922,7 +1008,8 @@ def main():
     # tick — when it ran, else the headline window.  bench_compare
     # fails a run whose overlap regressed to 0 with the pipeline on.
     from swarmkit_tpu.utils.pipeline import default_pipeline_depth
-    overlap_src = "cfg6" if "cfg6" in tables else "headline"
+    overlap_src = next((c for c in ("cfg6", "cfg7") if c in tables),
+                       "headline")
     overlap_tbl = tables.get(overlap_src, {})
 
     # health plane verdict over the finished run's registry: all-pass is
@@ -961,6 +1048,11 @@ def main():
         # plan/commit software pipeline: configured depth + the overlap
         # the trace actually measured (see overlap_src above)
         "pipeline_depth": default_pipeline_depth(),
+        # planner mesh size (SWARM_PLANNER_MESH; 1 = single device)
+        "planner_mesh_devices": _mesh_devices(),
+        # N∈{1,2,4,8} fused-chunk crossover curve, when measured
+        # (scripts/mesh_crossover.py writes the artifact it embeds)
+        "mesh_crossover": _mesh_crossover(),
         "plan_commit_overlap_s": overlap_tbl.get(
             "plan_commit_overlap_s", 0.0),
         "plan_hidden_frac": overlap_tbl.get("plan_hidden_frac", 0.0),
@@ -993,6 +1085,7 @@ def _append_history(artifact):
         "health": artifact["health"]["status"],
         "planner_compiles": sum(artifact["planner_compiles"].values()),
         "pipeline_depth": artifact["pipeline_depth"],
+        "planner_mesh_devices": artifact["planner_mesh_devices"],
         "plan_commit_overlap_s": artifact["plan_commit_overlap_s"],
         "plan_hidden_frac": artifact["plan_hidden_frac"],
         "plan_overlap_source": artifact["plan_overlap_source"],

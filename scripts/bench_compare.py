@@ -15,7 +15,11 @@ entries of the ledger (defaults: previous vs last).
 
 Exit status: 1 when any config (or the headline) regressed by more than
 ``--threshold`` (default 0.20 = the round-5 "regression-proof bench"
-bar), else 0.  Improvements and new/removed configs never fail the run.
+bar), when the NEW run's cfg6/cfg7 ``shape_cost_x`` exceeds
+``--max-shape-cost`` (default 1.5), when plan/commit overlap collapsed
+to 0 with the pipeline on, or when timed-region XLA compile counts grew
+(compile flatness after warm-up), else 0.  Improvements and new/removed
+configs never fail the run.
 """
 
 import argparse
@@ -24,17 +28,33 @@ import os
 import sys
 
 
+def _compiles(val):
+    """Compile counts appear as a per-bucket dict in artifacts and as a
+    pre-summed int in history records."""
+    if isinstance(val, dict):
+        return sum(val.values())
+    return int(val) if val else 0
+
+
 def _norm(doc):
     """Normalize an artifact or history record to
     {"headline": dps, "configs": {name: dps}} plus context fields."""
-    configs = {}
+    configs, shape_cost, compiles = {}, {}, {}
     for name, cfg in (doc.get("configs") or {}).items():
         dps = cfg.get("decisions_per_sec")
         if dps:
             configs[name] = float(dps)
+        if cfg.get("shape_cost_x") is not None:
+            shape_cost[name] = float(cfg["shape_cost_x"])
+        compiles[name] = _compiles(cfg.get("compiles"))
     return {
         "headline": float(doc.get("value") or 0.0),
         "configs": configs,
+        "shape_cost_x": shape_cost,
+        # XLA compiles that landed inside timed regions (headline +
+        # per config) — must stay flat after warm-up
+        "compiles": compiles,
+        "headline_compiles": _compiles(doc.get("planner_compiles")),
         "t": doc.get("t"),
         "health": (doc.get("health") or {}).get("status")
         if isinstance(doc.get("health"), dict) else doc.get("health"),
@@ -112,6 +132,17 @@ def main(argv=None) -> int:
     p.add_argument("--threshold", type=float, default=0.20,
                    help="max tolerated per-config decisions/s regression "
                         "(fraction, default 0.20)")
+    p.add_argument("--max-shape-cost", type=float,
+                   default=float(os.environ.get(
+                       "BENCH_MAX_SHAPE_COST", 1.5)),
+                   help="shape_cost_x ceiling for the live-manager "
+                        "configs cfg6/cfg7 (default 1.5, or env "
+                        "BENCH_MAX_SHAPE_COST); the NEW run exceeding "
+                        "it exits 1.  The bar is the bench-host "
+                        "target — on the slower dev container, where "
+                        "the miss is a known standing condition, set "
+                        "BENCH_MAX_SHAPE_COST so throughput "
+                        "regressions stay distinguishable from it")
     args = p.parse_args(argv)
 
     if args.history:
@@ -128,6 +159,9 @@ def main(argv=None) -> int:
         return 2
 
     rows, regressions = compare(old, new, args.threshold)
+    # absolute-bar gate failures, kept apart from throughput
+    # regressions so each exits 1 under its own name
+    gate_failures = []
     print(f"{'config':<28} {labels[0]:>16} {labels[1]:>16} {'delta':>9}")
     for name, a, b, delta, mark in rows:
         sa = f"{a:,.1f}" if a else "-"
@@ -156,19 +190,66 @@ def main(argv=None) -> int:
               f"(pipeline depth {old.get('pipeline_depth')} -> "
               f"{new.get('pipeline_depth')})")
     src = new.get("plan_overlap_source")
-    meaningful = src == "cfg6" or (src is None and (old_h or 0.0) > 0.0)
+    meaningful = src in ("cfg6", "cfg7") \
+        or (src is None and (old_h or 0.0) > 0.0)
     if ((new.get("pipeline_depth") or 1) > 1 and new_h is not None
             and not new_h and meaningful):
         print("\nplan/commit overlap regressed to 0 with the pipeline "
               "on", file=sys.stderr)
-        regressions.append("plan_hidden_frac")
+        gate_failures.append(("overlap-collapse", "plan_hidden_frac"))
+    # shape_cost_x gate: the live-manager configs' production-shape cost
+    # factor is an absolute bar (ROADMAP direction 1), judged on the NEW
+    # run alone — an old run that also missed it must not disarm it
+    _LIVE_CFGS = ("6_live_manager_2x100k_x_10k", "7_many_service_10x")
+    for name in _LIVE_CFGS:
+        sc_old = old.get("shape_cost_x", {}).get(name)
+        sc_new = new.get("shape_cost_x", {}).get(name)
+        if sc_old is not None or sc_new is not None:
+            print(f"shape_cost_x[{name}]: {sc_old} -> {sc_new} "
+                  f"(bar <= {args.max_shape_cost})")
+        if sc_new is not None and sc_new > args.max_shape_cost:
+            print(f"\n{name} shape_cost_x {sc_new} exceeds "
+                  f"{args.max_shape_cost}", file=sys.stderr)
+            gate_failures.append(("shape-cost-bar",
+                                  f"shape_cost_x:{name}={sc_new}"))
+    # compile-flatness gate: XLA compiles inside timed regions must not
+    # GROW — warm-up covers every signature a config touches, so any
+    # growth means a new shape leaked into a timed window.  Judged over
+    # the headline plus configs present in BOTH runs (a brand-new
+    # config's first-run compiles are its own warm-up problem, surfaced
+    # by its per-config row, not a regression of this run pair).
+    shared_cfgs = set(old.get("compiles", {})) & set(
+        new.get("compiles", {}))
+    old_c = old.get("headline_compiles", 0) + sum(
+        old["compiles"][c] for c in shared_cfgs)
+    new_c = new.get("headline_compiles", 0) + sum(
+        new["compiles"][c] for c in shared_cfgs)
+    print(f"planner_compiles (timed regions): {old_c} -> {new_c}")
+    if new_c > old_c:
+        print(f"\nplanner_compiles grew {old_c} -> {new_c}: a compile "
+              "landed inside a timed region", file=sys.stderr)
+        gate_failures.append(("compile-growth",
+                              f"planner_compiles {old_c}->{new_c}"))
+    # distinct summaries per gate: a shape-bar or compile miss is NOT a
+    # ">20% throughput regression" and must not read like one
+    failed = False
     if regressions:
         print(f"\n{len(regressions)} config(s) regressed more than "
               f"{args.threshold * 100:.0f}%: {', '.join(regressions)}",
               file=sys.stderr)
+        failed = True
+    if gate_failures:
+        by_gate = {}
+        for gate, detail in gate_failures:
+            by_gate.setdefault(gate, []).append(detail)
+        for gate, details in sorted(by_gate.items()):
+            print(f"gate failed [{gate}]: {', '.join(details)}",
+                  file=sys.stderr)
+        failed = True
+    if failed:
         return 1
     print(f"\nok: no config regressed more than "
-          f"{args.threshold * 100:.0f}%")
+          f"{args.threshold * 100:.0f}% and all gates passed")
     return 0
 
 

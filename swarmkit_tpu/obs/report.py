@@ -13,7 +13,11 @@ from typing import Any, Dict, List, Optional, Tuple
 # span names making up the device-plan phase vs the host-commit phase —
 # the pair whose overlap answers ROADMAP item 1's question ("is plan
 # hidden behind commit?")
-PLAN_PHASES = ("plan.dispatch", "plan.d2h", "plan.feasibility")
+PLAN_PHASES = ("plan.dispatch", "plan.d2h", "plan.feasibility",
+               # whole dispatch→fetch window of one plan (retro span):
+               # captures compute hidden behind commits that the d2h
+               # wait alone cannot see (ops/planner.py _note_inflight)
+               "plan.inflight")
 COMMIT_PHASES = ("sched.commit",)
 
 

@@ -1,0 +1,453 @@
+"""Fused many-service batch builder: one program per tick.
+
+The per-service planner pays one densify + one XLA dispatch + one D2H
+round-trip per (service, spec-version) group, so a tick of G services
+costs G device round-trips and G Python column builds — the
+``shape_cost_x`` scaling wall (ROADMAP direction 1).  This module packs
+an ordered run of *fusable* groups into ONE padded, shape-bucketed
+tasks×nodes program (``ops.kernel.plan_fused``): shared node columns are
+densified once, per-group columns land in bucketed group slots, and the
+groups' sequential semantics (group g sees groups 0..g-1 applied) ride
+the program's scan carry instead of host round-trips.  Placements are
+byte-identical to the per-group path by construction — the carry updates
+are exactly the per-group apply, restricted to the signals the kernel
+reads.
+
+A run is split into CHUNKS (``SWARM_FUSED_CHUNK`` groups each, always
+>= 2 chunks per run) so the pipelined scheduler can overlap chunk i+1's
+device compute with chunk i's host apply/commit; the carry is threaded
+chunk-to-chunk as device arrays and never fetched.
+
+Fusability is stricter than device-ability: a group that densifies fine
+per-group but carries signals the fused carry does not model (generic
+resources, host-published ports, multi-level spread trees,
+shutdown-marked stragglers) simply breaks the run and takes the
+per-group path — identical placements, one extra round-trip.  Any
+builder/bucket overflow degrades the same way: group-by-group, never a
+failed tick.
+
+Resource arithmetic is exact: the carry holds int64 nano-cpus/bytes and
+the kernel's floor-divisions match the host densifier bit-for-bit, so
+the fused program traces and dispatches under ``enable_x64`` (scoped —
+the rest of the process stays in default 32-bit mode).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from jax.experimental import enable_x64
+
+from ..models.objects import Task
+from ..models.types import PublishMode, TaskState
+from ..scheduler import constraint as constraint_mod
+from ..scheduler.filters import normalize_arch
+from .hashing import str_hash
+from .kernel import FusedCarry, FusedGroups, FusedShared, K_CLAMP
+
+# static shape buckets to bound recompiles (shared with the per-group
+# planner — ops/planner.py imports these so both paths use one ladder)
+CC_BUCKETS = (1, 4, 16)      # constraint slots
+P_BUCKETS = (1, 4)           # platform slots
+
+SENTINEL = (-1, -1)  # never matches any real hash column value
+
+
+def bucket(n: int, buckets) -> Optional[int]:
+    for b in buckets:
+        if n <= b:
+            return b
+    return None
+
+
+def n_bucket(n: int) -> int:
+    b = 1024
+    while b < n:
+        b *= 2
+    return b
+
+
+def l_bucket(n: int) -> int:
+    for b in (1, 16, 256, 4096):
+        if n <= b:
+            return b
+    return 1 << (n - 1).bit_length()
+
+
+def pow2_bucket(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length()
+
+
+def split_hash(h: int) -> Tuple[int, int]:
+    # two non-negative int32 halves (62 effective bits)
+    return (h >> 31) & 0x7FFFFFFF, h & 0x7FFFFFFF
+
+
+def x64():
+    """The scoped-x64 guard every fused trace/dispatch/transfer runs
+    under (int64 resource carry — see module docstring)."""
+    return enable_x64()
+
+
+def default_chunk_groups() -> int:
+    """Groups per fused chunk (SWARM_FUSED_CHUNK, default 4)."""
+    raw = os.environ.get("SWARM_FUSED_CHUNK", "")
+    try:
+        v = int(raw)
+    except ValueError:
+        return 4
+    return v if v > 0 else 4
+
+
+def chunk_sizes(g: int, chunk: int) -> List[int]:
+    """Split ``g`` groups into chunk sizes.  A run always yields >= 2
+    chunks (when it has >= 2 groups) so the pipelined tick has a chunk
+    of commits to overlap the next chunk's device compute with."""
+    chunk = max(1, chunk)
+    if g <= 1:
+        return [g] if g else []
+    if g <= chunk:
+        first = (g + 1) // 2
+        return [first, g - first]
+    out = []
+    rest = g
+    while rest > 0:
+        take = min(chunk, rest)
+        out.append(take)
+        rest -= take
+    return out
+
+
+# ------------------------------------------------- shared column builders
+#
+# Single source for the host-side densification the per-group planner
+# (ops/planner.py _build_device_inputs) and the fused builder both use —
+# placement parity between the two paths is load-bearing, so the column
+# semantics live in exactly one place.
+
+def fill_constraints(node_value: Callable, infos, n: int, constraints,
+                     con_hash: np.ndarray, con_op: np.ndarray,
+                     con_exp: np.ndarray) -> None:
+    """Fill one group's constraint columns: ``con_hash`` [Cc, 2, nb]
+    zeroed, ``con_op`` [Cc] pre-filled 2 (disabled), ``con_exp``
+    [Cc, 2] zeroed."""
+    for ci, con in enumerate(constraints):
+        values = [node_value(info, con.key) for info in infos]
+        if any(v is None for v in values):
+            # unknown key: node never matches, regardless of op
+            con_op[ci] = 0
+            con_exp[ci] = SENTINEL
+            continue
+        hi_lo = [split_hash(str_hash(v)) for v in values]
+        arr = np.array(hi_lo, np.int64).T  # [2, n]
+        con_hash[ci, :, :n] = arr
+        con_op[ci] = con.operator
+        con_exp[ci] = split_hash(str_hash(con.exp))
+
+
+def fill_platforms(platforms, plat: np.ndarray) -> None:
+    """Fill one group's platform rows (``plat`` [P, 4] pre-filled -1)."""
+    for pi, p in enumerate(platforms):
+        os_h = split_hash(str_hash(p.os)) if p.os else (0, 0)
+        arch = normalize_arch(p.architecture)
+        arch_h = (split_hash(str_hash(arch)) if arch else (0, 0))
+        plat[pi] = (*os_h, *arch_h)
+
+
+def node_platform_hashes(infos, nb: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Node platform.os / normalized-arch hash columns ([2, nb] each).
+    Nodes without a description get the sentinel (PlatformFilter
+    rejects them)."""
+    os_hash = np.zeros((2, nb), np.int32)
+    arch_hash = np.zeros((2, nb), np.int32)
+    for i, info in enumerate(infos):
+        desc = info.node.description
+        if desc and desc.platform:
+            os_hash[:, i] = split_hash(str_hash(desc.platform.os))
+            arch_hash[:, i] = split_hash(
+                str_hash(normalize_arch(desc.platform.architecture)))
+        else:
+            os_hash[:, i] = SENTINEL
+            arch_hash[:, i] = SENTINEL
+    return os_hash, arch_hash
+
+
+def needs_plugins(t: Task) -> bool:
+    from ..scheduler.filters import _references_volume_plugin
+    c = t.spec.container
+    if c is not None and any(_references_volume_plugin(m)
+                             for m in c.mounts):
+        return True
+    return (t.spec.log_driver is not None
+            and t.spec.log_driver.name not in ("", "none"))
+
+
+def plugin_mask(t: Task, infos, nb: int) -> np.ndarray:
+    """Plugin/volume-driver feasibility column for one group."""
+    from ..scheduler.filters import PluginFilter
+    extra_mask = np.ones(nb, bool)
+    pf = PluginFilter()
+    if pf.set_task(t):
+        for i, info in enumerate(infos):
+            extra_mask[i] = pf.check(info)
+    return extra_mask
+
+
+def flat_leaf(infos, nb: int, descriptor: str
+              ) -> Tuple[np.ndarray, int]:
+    """Flat (single-level) spread leaf ids keyed by the raw preference
+    value, first-appearance order.  Returns (leaf [nb], value count)."""
+    from ..scheduler.nodeset import _pref_value
+    leaf = np.zeros(nb, np.int32)
+    values: Dict[str, int] = {}
+    for i, info in enumerate(infos):
+        v = _pref_value(info, descriptor) or ""
+        leaf[i] = values.setdefault(v, len(values))
+    return leaf, max(len(values), 1)
+
+
+# ----------------------------------------------------------- fusability
+
+class GroupSpec:
+    """One fusable group's parsed routing facts, captured at probe time
+    and reused by the builder and the apply phase."""
+
+    __slots__ = ("group", "t", "k", "constraints", "platforms",
+                 "pref_descriptor", "wants_plugins", "cpu_d", "mem_d",
+                 "maxrep", "slot")
+
+    def __init__(self, group: Dict[str, Task], t: Task, k: int,
+                 constraints, platforms, pref_descriptor, wants_plugins,
+                 cpu_d: int, mem_d: int, maxrep: int):
+        self.group = group
+        self.t = t
+        self.k = k
+        self.constraints = constraints
+        self.platforms = platforms
+        self.pref_descriptor = pref_descriptor
+        self.wants_plugins = wants_plugins
+        self.cpu_d = cpu_d
+        self.mem_d = mem_d
+        self.maxrep = maxrep
+        self.slot = 0    # service slot, assigned at build time
+
+
+def probe_group(planner, group: Dict[str, Task]) -> Optional[GroupSpec]:
+    """Fusability check for one group: everything ``dispatch_group``
+    would device-plan MINUS the signals the fused carry does not model
+    (generic resources, host-published ports, multi-level spread,
+    shutdown-marked stragglers).  None = the group breaks the run and
+    takes the per-group path."""
+    t = next(iter(group.values()))
+    if not planner._supported(t):
+        return None
+    k = len(group)
+    if k == 0 or k > K_CLAMP:
+        return None
+    placement = t.spec.placement
+    prefs = [p for p in (placement.preferences if placement else [])
+             if p.spread]
+    if len(prefs) > 1:
+        return None    # multi-level spread: per-group hier path
+    res = t.spec.resources.reservations if t.spec.resources else None
+    if res and res.generic:
+        return None    # per-task claim bookkeeping: per-group path
+    if t.endpoint and any(p.publish_mode == PublishMode.HOST
+                          and p.published_port
+                          for p in t.endpoint.ports):
+        return None    # cross-group port claims: per-group path
+    if any(tk.desired_state > TaskState.COMPLETE
+           for tk in group.values()):
+        return None    # batched mirror counting needs active totals
+    constraints = []
+    if placement and placement.constraints:
+        try:
+            constraints = constraint_mod.parse(placement.constraints)
+        except constraint_mod.InvalidConstraint:
+            constraints = []
+    if bucket(len(constraints), CC_BUCKETS) is None:
+        return None    # constraint-slot overflow: per-group -> host
+    platforms = placement.platforms if placement else []
+    if bucket(max(len(platforms), 1), P_BUCKETS) is None:
+        return None
+    return GroupSpec(
+        group, t, k, constraints, platforms,
+        prefs[0].spread.spread_descriptor if prefs else None,
+        needs_plugins(t),
+        int(res.nano_cpus) if res else 0,
+        int(res.memory_bytes) if res else 0,
+        placement.max_replicas if placement else 0)
+
+
+# ------------------------------------------------------------ run builder
+
+class FusedChunk:
+    """One dispatch unit of a fused run."""
+
+    __slots__ = ("start", "count", "gb", "groups", "arrays", "tasks",
+                 "t0")
+
+    def __init__(self, start: int, count: int, gb: int,
+                 groups: FusedGroups, tasks: int):
+        self.start = start
+        self.count = count
+        self.gb = gb
+        self.groups = groups   # np-backed FusedGroups; dropped at dispatch
+        self.arrays = None     # dispatched (x, fail_counts, spill) triple
+        self.tasks = tasks
+        self.t0 = 0.0
+
+
+class FusedRun:
+    """A dispatched fused batch: chunks, device carry, and everything
+    the apply phase needs."""
+
+    __slots__ = ("sched", "specs", "cols", "shared", "carry", "chunks",
+                 "next_dispatch", "next_fetch", "last_fetch_end", "L",
+                 "nb", "cc", "pb", "sb", "aborted", "dispatch_dead",
+                 "applied")
+
+    def __init__(self, sched, specs, cols, shared, carry, chunks,
+                 L, nb, cc, pb, sb):
+        self.sched = sched
+        self.specs = specs
+        self.cols = cols
+        self.shared = shared
+        self.carry = carry
+        self.chunks = chunks
+        self.next_dispatch = 0
+        self.next_fetch = 0
+        self.last_fetch_end = 0.0   # perf_counter of the last fetch
+        self.L = L
+        self.nb = nb
+        self.cc = cc
+        self.pb = pb
+        self.sb = sb
+        self.aborted = False
+        self.dispatch_dead = False
+        self.applied = 0
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.specs)
+
+    def bucket_label(self, chunk: FusedChunk) -> str:
+        """Stable jit-signature name for one fused chunk shape."""
+        return (f"fused_g{chunk.gb}_nb{self.nb}_cc{self.cc}"
+                f"_p{self.pb}_L{self.L}_s{self.sb}")
+
+
+def build_run(planner, sched, specs: List[GroupSpec]
+              ) -> Optional[FusedRun]:
+    """Densify an ordered run of fusable groups into one fused batch.
+
+    Returns None when the cluster has no valid nodes or a shared bucket
+    cannot hold the run — the caller falls back to the per-group path
+    (same placements, amortization lost)."""
+    t0 = specs[0].t
+    cols = planner._densify(sched, t0)
+    infos, n, nb, valid, ready, cpu, mem, total = cols
+    if n == 0:
+        return None
+
+    # ---- shared buckets across the run
+    cc = max(bucket(len(sp.constraints), CC_BUCKETS) for sp in specs)
+    pb = max(bucket(max(len(sp.platforms), 1), P_BUCKETS)
+             for sp in specs)
+
+    # ---- service slots (groups of one service share a slot so the
+    # carry's per-service accumulator levels them together)
+    slot_map: Dict[str, int] = {}
+    for sp in specs:
+        sp.slot = slot_map.setdefault(sp.t.service_id, len(slot_map))
+    sb = pow2_bucket(len(slot_map))
+
+    svc0 = np.zeros((sb, nb), np.int32)
+    for i, info in enumerate(infos):
+        by_svc = info.active_tasks_count_by_service
+        if not by_svc:
+            continue
+        for sid, c in by_svc.items():
+            s = slot_map.get(sid)
+            if s is not None and c:
+                svc0[s, i] = c
+
+    if any(sp.platforms for sp in specs):
+        os_hash, arch_hash = node_platform_hashes(infos, nb)
+    else:
+        os_hash = np.zeros((2, nb), np.int32)
+        arch_hash = np.zeros((2, nb), np.int32)
+
+    # ---- spread leaves (flat; multi-level trees never fuse) + shared L
+    ts = planner.fail_ts()   # tick-frozen: parity with the per-group path
+    fail_idx = [i for i, info in enumerate(infos) if info.recent_failures]
+    leaves: List[Optional[np.ndarray]] = []
+    L = 1
+    for sp in specs:
+        if sp.pref_descriptor is not None:
+            leaf, n_values = flat_leaf(infos, nb, sp.pref_descriptor)
+            leaves.append(leaf)
+            L = max(L, l_bucket(n_values))
+        else:
+            leaves.append(None)
+
+    shared = FusedShared(valid=valid, ready=ready, os_hash=os_hash,
+                         arch_hash=arch_hash, svc0=svc0)
+    # carry snapshot: int64 resource columns (exact math on device),
+    # int32 totals; svc placements accumulate from zero within the run
+    carry = FusedCarry(
+        total=total.copy(), cpu=cpu.copy(), mem=mem.copy(),
+        svc_acc=np.zeros((sb, nb), np.int32))
+
+    # ---- chunk assembly
+    chunks: List[FusedChunk] = []
+    start = 0
+    for count in chunk_sizes(len(specs), default_chunk_groups()):
+        gb = pow2_bucket(count)
+        k = np.zeros(gb, np.int32)
+        slot = np.zeros(gb, np.int32)
+        maxrep = np.zeros(gb, np.int32)
+        cpu_d = np.zeros(gb, np.int64)
+        mem_d = np.zeros(gb, np.int64)
+        con_hash = np.zeros((gb, cc, 2, nb), np.int32)
+        con_op = np.full((gb, cc), 2, np.int32)
+        con_exp = np.zeros((gb, cc, 2), np.int32)
+        plat = np.full((gb, pb, 4), -1, np.int32)
+        failures = np.zeros((gb, nb), np.int32)
+        leaf = np.zeros((gb, nb), np.int32)
+        extra = np.ones((gb, nb), bool)
+        tasks = 0
+        for j in range(count):
+            sp = specs[start + j]
+            k[j] = sp.k
+            slot[j] = sp.slot
+            maxrep[j] = sp.maxrep
+            cpu_d[j] = sp.cpu_d
+            mem_d[j] = sp.mem_d
+            tasks += sp.k
+            if sp.constraints:
+                fill_constraints(planner._node_value, infos, n,
+                                 sp.constraints, con_hash[j], con_op[j],
+                                 con_exp[j])
+            if sp.platforms:
+                fill_platforms(sp.platforms, plat[j])
+            for i in fail_idx:
+                failures[j, i] = infos[i].count_recent_failures(ts, sp.t)
+            if leaves[start + j] is not None:
+                leaf[j] = leaves[start + j]
+            if sp.wants_plugins:
+                extra[j] = plugin_mask(sp.t, infos, nb)
+        chunks.append(FusedChunk(
+            start, count, gb,
+            FusedGroups(k=k, slot=slot, maxrep=maxrep, cpu_d=cpu_d,
+                        mem_d=mem_d, con_hash=con_hash, con_op=con_op,
+                        con_exp=con_exp, plat=plat, failures=failures,
+                        leaf=leaf, extra_mask=extra),
+            tasks))
+        start += count
+
+    return FusedRun(sched, specs, cols, shared, carry, chunks,
+                    L, nb, cc, pb, sb)

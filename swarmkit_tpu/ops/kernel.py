@@ -368,6 +368,127 @@ def plan_group_jit(nodes: NodeInputs, group: GroupInputs, L: int,
     return plan_group(nodes, group, L, hier=hier)
 
 
+# ------------------------------------------------------- fused many-service
+#
+# One program for the WHOLE tick: every pending (service, spec-version)
+# group is packed into shared static buckets (group slots G, constraint
+# slots Cc, platform slots P, spread-leaf slots L, service slots S) and
+# planned by a single XLA dispatch.  The groups are not independent — a
+# group's placements feed the next group's per-service counts, total
+# loads and remaining resources — so the fused program is a
+# `lax.scan` over group slots carrying the cluster state (FusedCarry),
+# which makes the sequential per-service semantics exact by
+# construction: scan step g computes precisely what a standalone
+# `plan_group` dispatch would see after groups 0..g-1 applied.
+#
+# Segment masking: each scan step scores ONLY its own group's inputs
+# (constraints, spread leaves, failure down-weights are per group-slot
+# rows; per-service counts live in `svc_acc[slot]` segments), so two
+# groups in one batch can never cross-contaminate each other's
+# feasibility or spread scoring — asserted by tests/test_fused.py.
+#
+# Resource accounting rides int64 (the host densifier's exact integer
+# comparisons, see module docstring): callers trace/dispatch under
+# `jax.experimental.enable_x64` (ops/fusedbatch.py) so avail//demand
+# floor-divisions match numpy bit-for-bit.
+
+class FusedShared(NamedTuple):
+    """Run-wide node state, densified once per fused run."""
+
+    valid: jnp.ndarray        # bool[N] padding mask
+    ready: jnp.ndarray        # bool[N] READY && ACTIVE
+    os_hash: jnp.ndarray      # i32[2, N] platform.os hash (hi, lo)
+    arch_hash: jnp.ndarray    # i32[2, N] normalized arch hash (hi, lo)
+    svc0: jnp.ndarray         # i32[S, N] base active tasks per service slot
+
+
+class FusedGroups(NamedTuple):
+    """Per-group inputs, stacked over the group axis G (scan xs).
+    Padded slots carry k=0 (they place nothing and leave the carry
+    untouched)."""
+
+    k: jnp.ndarray            # i32[G] tasks to place (0 = padding slot)
+    slot: jnp.ndarray         # i32[G] service slot into svc0/svc_acc
+    maxrep: jnp.ndarray       # i32[G] max replicas per node (0 = off)
+    cpu_d: jnp.ndarray        # i64[G] per-task nano-cpu reservation
+    mem_d: jnp.ndarray        # i64[G] per-task memory reservation
+    con_hash: jnp.ndarray     # i32[G, Cc, 2, N]
+    con_op: jnp.ndarray       # i32[G, Cc] 0 ==, 1 !=, 2 disabled
+    con_exp: jnp.ndarray      # i32[G, Cc, 2]
+    plat: jnp.ndarray         # i32[G, P, 4] (-1 row sentinel = unused)
+    failures: jnp.ndarray     # i32[G, N] recent failures for the group
+    leaf: jnp.ndarray         # i32[G, N] spread leaf id (0 when no prefs)
+    extra_mask: jnp.ndarray   # bool[G, N] plugin/volume masks
+
+
+class FusedCarry(NamedTuple):
+    """Cluster state threaded through the scan — and, across chunked
+    dispatches of one run, kept device-resident between calls (the
+    planner never fetches it; chunk i+1 consumes chunk i's carry as
+    device arrays)."""
+
+    total: jnp.ndarray        # i32[N] active tasks total
+    cpu: jnp.ndarray          # i64[N] available nano-cpus
+    mem: jnp.ndarray          # i64[N] available memory bytes
+    svc_acc: jnp.ndarray      # i32[S, N] tasks placed per service slot
+    #                           within this fused run
+
+
+def plan_fused(shared: FusedShared, groups: FusedGroups,
+               carry: FusedCarry, L: int, reduce: Reduce = _identity,
+               idx_offset: Optional[jnp.ndarray] = None):
+    """Plan a fused batch of task groups in one program.
+
+    Returns (x i32[G, N] tasks per node per group, fail_counts
+    i32[G, 7], spill bool[G], carry' FusedCarry).  Placements are
+    byte-identical to dispatching `plan_group` per group in order and
+    applying each result before densifying the next — the scan carry
+    IS that apply, restricted to the signals the kernel reads.
+    """
+    no_ports = jnp.zeros_like(shared.valid)
+
+    def step(state: FusedCarry, g):
+        # exact int64 resource math, matching the host densifier:
+        # res_ok &= avail >= demand and cap = min(cap, avail // demand)
+        # for each demanded resource, then clip to [0, K_CLAMP] in i32
+        res_ok = shared.valid
+        cap = jnp.full(state.cpu.shape, K_CLAMP, state.cpu.dtype)
+        for avail, d in ((state.cpu, g.cpu_d), (state.mem, g.mem_d)):
+            have = d > 0
+            res_ok = res_ok & (~have | (avail >= d))
+            cap = jnp.where(
+                have, jnp.minimum(cap, avail // jnp.maximum(d, 1)), cap)
+        res_cap = jnp.clip(cap, 0, K_CLAMP).astype(jnp.int32)
+        svc = shared.svc0[g.slot] + state.svc_acc[g.slot]
+        nodes = NodeInputs(
+            valid=shared.valid, ready=shared.ready, res_ok=res_ok,
+            res_cap=res_cap, svc_tasks=svc, total_tasks=state.total,
+            failures=g.failures, leaf=g.leaf, os_hash=shared.os_hash,
+            arch_hash=shared.arch_hash, port_conflict=no_ports,
+            extra_mask=g.extra_mask)
+        grp = GroupInputs(
+            k=g.k, con_hash=g.con_hash, con_op=g.con_op,
+            con_exp=g.con_exp, plat=g.plat, maxrep=g.maxrep,
+            port_limited=jnp.zeros((), jnp.bool_))
+        x, fail_counts, spill = plan_group(nodes, grp, L, reduce=reduce,
+                                           idx_offset=idx_offset)
+        nxt = FusedCarry(
+            total=state.total + x,
+            cpu=state.cpu - x.astype(state.cpu.dtype) * g.cpu_d,
+            mem=state.mem - x.astype(state.mem.dtype) * g.mem_d,
+            svc_acc=state.svc_acc.at[g.slot].add(x))
+        return nxt, (x, fail_counts, spill)
+
+    carry_out, (xs, fcs, spills) = jax.lax.scan(step, carry, groups)
+    return xs, fcs, spills, carry_out
+
+
+@functools.partial(jax.jit, static_argnames=("L",))
+def plan_fused_jit(shared: FusedShared, groups: FusedGroups,
+                   carry: FusedCarry, L: int):
+    return plan_fused(shared, groups, carry, L)
+
+
 # --------------------------------------------------------- pipeline stages
 #
 # The jitted entry above is ASYNC-DISPATCHED: calling it (stage 1)

@@ -44,9 +44,16 @@ from ..scheduler.nodeinfo import NodeInfo
 from ..models.types import TaskState, TaskStatus
 from ..obs.trace import tracer
 from ..utils.metrics import registry as _metrics
+from . import fusedbatch
+from .fusedbatch import (
+    CC_BUCKETS as _CC_BUCKETS, P_BUCKETS as _P_BUCKETS,
+    SENTINEL as _SENTINEL, bucket as _bucket, l_bucket as _l_bucket,
+    n_bucket as _n_bucket, split_hash as _split_hash,
+)
 from .hashing import str_hash
 from .kernel import (
-    GroupInputs, K_CLAMP, NodeInputs, fetch_plan, plan_group_jit,
+    GroupInputs, K_CLAMP, NodeInputs, fetch_plan, plan_fused_jit,
+    plan_group_jit,
 )
 
 log = logging.getLogger("tpu-planner")
@@ -54,10 +61,6 @@ log = logging.getLogger("tpu-planner")
 # cached Timer references (Registry.reset() resets in place)
 _PLAN_TIMER = _metrics.timer("swarm_planner_plan_latency")
 _COMPILE_TIMER = _metrics.timer("swarm_planner_compile_latency")
-
-# static shape buckets to bound recompiles
-_CC_BUCKETS = (1, 4, 16)      # constraint slots
-_P_BUCKETS = (1, 4)           # platform slots
 
 
 def _jit_cache_size(fn) -> Optional[int]:
@@ -103,33 +106,8 @@ def _observe_compile(fn, bucket: str, cache_before: Optional[int],
                            bucket=bucket)
 
 
-def _bucket(n: int, buckets) -> Optional[int]:
-    for b in buckets:
-        if n <= b:
-            return b
-    return None
-
-
-def _n_bucket(n: int) -> int:
-    b = 1024
-    while b < n:
-        b *= 2
-    return b
-
-
-def _l_bucket(n: int) -> int:
-    for b in (1, 16, 256, 4096):
-        if n <= b:
-            return b
-    return 1 << (n - 1).bit_length()
-
-
-def _split_hash(h: int) -> Tuple[int, int]:
-    # two non-negative int32 halves (62 effective bits)
-    return (h >> 31) & 0x7FFFFFFF, h & 0x7FFFFFFF
-
-
-_SENTINEL = (-1, -1)  # never matches any real hash column value
+# shape-bucket helpers live in ops/fusedbatch.py (single source for the
+# per-group and fused paths); the module-private names above are aliases
 
 
 def _fast_assign(task: Task, node_id: str, status) -> Task:
@@ -303,14 +281,48 @@ class _InFlightPlan:
 
 
 class TPUPlanner:
-    def __init__(self, plan_fn=None):
+    def __init__(self, plan_fn=None, fused_plan_fn=None, mesh=None):
         # plan_fn(nodes: NodeInputs, group: GroupInputs, L: int, hier)
         # -> (x i32[N], fail_counts i32[7], spill bool); hier carries
         # multi-level
         # spread segments (() for flat).  Defaults to the single-device jit
         # kernel; parallel/sharded.py provides a mesh-sharded
         # implementation with the same signature.
+        #
+        # SWARM_PLANNER_MESH=<D> shards the node axis over the first D
+        # devices (parallel/sharded.py ShardedPlanFn drives both the
+        # per-group and fused kernels); explicit plan_fn/mesh args win
+        # over the env knob.
+        import os as _os
+        if plan_fn is None and fused_plan_fn is None and mesh is None:
+            from ..parallel.sharded import mesh_from_env
+            mesh = mesh_from_env()
+        if mesh is not None:
+            from ..parallel.sharded import ShardedPlanFn
+            sharded = ShardedPlanFn(mesh)
+            plan_fn = plan_fn or sharded
+            fused_plan_fn = fused_plan_fn or sharded
+        self.mesh = mesh
         self._plan_fn = plan_fn or plan_group_jit
+        # fused entry: an object exposing .fused(shared, groups, carry,
+        # L) (+ optional .prepare_fused) — a ShardedPlanFn, or None for
+        # the single-device kernel.  A ShardedPlanFn passed as plan_fn
+        # serves both paths so the mesh is used consistently.
+        if fused_plan_fn is None and hasattr(self._plan_fn, "fused"):
+            fused_plan_fn = self._plan_fn
+        self._fused_fn = fused_plan_fn
+        # fused many-service batching (the one-program-per-tick path);
+        # SWARM_FUSED_PLANNER=0 reverts to per-group dispatches.  An
+        # injected plan_fn WITHOUT a fused twin owns the device path
+        # entirely: fusing around it with the default kernel would
+        # bypass the injected implementation (mesh fns, test stubs)
+        self.fused_enabled = \
+            _os.environ.get("SWARM_FUSED_PLANNER", "") != "0" \
+            and (plan_fn is None or self._fused_fn is not None)
+        self._fused_dead = False     # set on fused errors: rest of the
+        #                              tick rides the per-group path
+        self._fused_active = None    # in-flight FusedRun (tick aborts)
+        self._tick_ts = None         # failure-window ts frozen per tick
         self.last_explanation = ""
         self.stats = {"groups_planned": 0, "groups_fallback": 0,
                       "groups_small_to_host": 0,
@@ -346,6 +358,7 @@ class TPUPlanner:
     # _count so the stats dict and the metrics registry can never
     # disagree (bench reads the registry)
     _ROUTE = {"groups_planned": "device",
+              "groups_fused": "fused",
               "groups_fallback": "fallback",
               "groups_small_to_host": "host_small",
               "groups_spill_to_host": "spill",
@@ -364,6 +377,21 @@ class TPUPlanner:
         self.stats["plan_seconds"] += dt
         _PLAN_TIMER.observe(dt)
 
+    @staticmethod
+    def _note_inflight(dt: float) -> None:
+        """Retroactive ``plan.inflight`` span covering one plan's whole
+        dispatch→fetch window.  The d2h span alone under-reports hidden
+        work: compute that finished WHILE the host applied/committed an
+        earlier group leaves a near-zero d2h wait, which would read as
+        "no overlap" exactly when overlap worked best.  The in-flight
+        window is what the commit spans genuinely ran inside of —
+        obs/report.py counts it toward plan_hidden_frac.  Zero-duration
+        under a virtual clock, like plan.compile (seed-pure sim traces).
+        """
+        from ..models.types import time_source_installed
+        tracer.record_complete("plan.inflight", "plan",
+                               0.0 if time_source_installed() else dt)
+
     def _call_plan_fn(self, nodes_in, group_in, L, hier):
         """Every device-plan dispatch goes through here so XLA cache
         misses are *observed* per static shape bucket (jit cache-size
@@ -381,11 +409,27 @@ class TPUPlanner:
 
     def begin_tick(self, sched) -> None:
         self._in_tick = True
+        self._fused_dead = False
+        # one failure-window timestamp for the whole tick: the fused run
+        # stamps its down-weights once, so the per-group path must read
+        # the same instant or a failure aging out mid-tick breaks the
+        # placement parity contract under a wall clock
+        self._tick_ts = now()
         self._cache = self._build_columns(sched)
 
     def end_tick(self) -> None:
         self._in_tick = False
+        self._tick_ts = None
+        if self._fused_active is not None:   # abandoned run (aborted tick)
+            self.abort_fused_run(self._fused_active)
         self._cache = None
+
+    def fail_ts(self):
+        """Failure-window timestamp: frozen per tick so the fused and
+        per-group paths count the same recent failures (see
+        begin_tick); falls back to now() for out-of-tick densifies."""
+        ts = self._tick_ts
+        return ts if ts is not None else now()
 
     def _build_columns(self, sched):
         node_set = sched.node_set
@@ -506,6 +550,19 @@ class TPUPlanner:
             log.exception("launch-overhead probe failed")
             self._launch_overhead = 0.0
 
+    def _below_break_even(self, n_tasks: int) -> bool:
+        """True when a group is too small to amortize the device launch
+        overhead.  The single predicate every routing site shares —
+        dispatch_group, the host pre-validate path, and the fused-run
+        probe must agree on it, or fused and per-group routing drift
+        apart silently."""
+        if not self.enable_small_group_routing:
+            return False
+        if self._launch_overhead is None:
+            self._measure_launch_overhead()
+        return (n_tasks * self.host_cost_per_task
+                < 0.8 * self._launch_overhead)
+
     def _fallback(self) -> bool:
         # the host path will mutate NodeInfos the cached columns mirror
         self._count("groups_fallback")
@@ -574,11 +631,7 @@ class TPUPlanner:
             self._count("groups_breaker_to_host")
             self._cache = None   # host path mutates NodeInfos
             return None
-        if self.enable_small_group_routing and self._launch_overhead is None:
-            self._measure_launch_overhead()
-        if self.enable_small_group_routing and \
-                len(task_group) * self.host_cost_per_task \
-                < 0.8 * self._launch_overhead:
+        if self._below_break_even(len(task_group)):
             self._count("groups_small_to_host")
             self.breaker.abort_probe()   # never reached the device
             self._cache = None   # host path mutates NodeInfos
@@ -643,7 +696,7 @@ class TPUPlanner:
         # 20-40s XLA recompiles at runtime — a far worse trade.
         svc_tasks = np.zeros(nb, np.int32)
         failures = np.zeros(nb, np.int32)
-        ts = now()
+        ts = self.fail_ts()
         sid = t.service_id
         for i, info in enumerate(infos):
             c = info.active_tasks_count_by_service.get(sid, 0)
@@ -666,18 +719,9 @@ class TPUPlanner:
         con_hash = np.zeros((cc, 2, nb), np.int32)
         con_op = np.full(cc, 2, np.int32)     # 2 = disabled
         con_exp = np.zeros((cc, 2), np.int32)
-        for ci, con in enumerate(constraints):
-            values = [self._node_value(info, con.key) for info in infos]
-            if any(v is None for v in values):
-                # unknown key: node never matches, regardless of op
-                con_op[ci] = 0
-                con_exp[ci] = _SENTINEL
-                continue
-            hi_lo = [_split_hash(str_hash(v)) for v in values]
-            arr = np.array(hi_lo, np.int64).T  # [2, n]
-            con_hash[ci, :, :n] = arr
-            con_op[ci] = con.operator
-            con_exp[ci] = _split_hash(str_hash(con.exp))
+        fusedbatch.fill_constraints(self._node_value, infos, n,
+                                    constraints, con_hash, con_op,
+                                    con_exp)
 
         # ---- platforms
         platforms = placement.platforms if placement else []
@@ -685,24 +729,12 @@ class TPUPlanner:
         if pb is None:
             return None
         plat = np.full((pb, 4), -1, np.int32)
-        for pi, p in enumerate(platforms):
-            os_h = _split_hash(str_hash(p.os)) if p.os else (0, 0)
-            arch = normalize_arch(p.architecture)
-            arch_h = (_split_hash(str_hash(arch)) if arch else (0, 0))
-            plat[pi] = (*os_h, *arch_h)
-        os_hash = np.zeros((2, nb), np.int32)
-        arch_hash = np.zeros((2, nb), np.int32)
+        fusedbatch.fill_platforms(platforms, plat)
         if platforms:
-            for i, info in enumerate(infos):
-                desc = info.node.description
-                if desc and desc.platform:
-                    os_hash[:, i] = _split_hash(str_hash(desc.platform.os))
-                    arch_hash[:, i] = _split_hash(
-                        str_hash(normalize_arch(desc.platform.architecture)))
-                else:
-                    # no description: PlatformFilter rejects
-                    os_hash[:, i] = _SENTINEL
-                    arch_hash[:, i] = _SENTINEL
+            os_hash, arch_hash = fusedbatch.node_platform_hashes(infos, nb)
+        else:
+            os_hash = np.zeros((2, nb), np.int32)
+            arch_hash = np.zeros((2, nb), np.int32)
 
         # ---- resources: exact int64 mask + capacity, computed host-side so
         # device decisions match the host oracle's integer comparisons
@@ -747,21 +779,10 @@ class TPUPlanner:
                             w in info.used_host_ports for w in wanted)
 
         # ---- plugins (volume/network/log drivers): host-side mask
-        extra_mask = np.ones(nb, bool)
-        needs_plugins = False
-        c = t.spec.container
-        if c is not None and any(_references_volume_plugin(m)
-                                 for m in c.mounts):
-            needs_plugins = True
-        if t.spec.log_driver is not None and \
-                t.spec.log_driver.name not in ("", "none"):
-            needs_plugins = True
-        if needs_plugins:
-            from ..scheduler.filters import PluginFilter
-            pf = PluginFilter()
-            if pf.set_task(t):
-                for i, info in enumerate(infos):
-                    extra_mask[i] = pf.check(info)
+        if fusedbatch.needs_plugins(t):
+            extra_mask = fusedbatch.plugin_mask(t, infos, nb)
+        else:
+            extra_mask = np.ones(nb, bool)
 
         # ---- spread preferences -> hierarchical branch ids.  Each level's
         # segment id identifies the node's branch path prefix; the kernel's
@@ -773,13 +794,9 @@ class TPUPlanner:
                  if p.spread]
         if len(prefs) == 1:
             # the common flat case: one pass keyed by the raw value
-            from ..scheduler.nodeset import _pref_value
-            descriptor = prefs[0].spread.spread_descriptor
-            values: Dict[str, int] = {}
-            for i, info in enumerate(infos):
-                v = _pref_value(info, descriptor) or ""
-                leaf[i] = values.setdefault(v, len(values))
-            L = _l_bucket(max(len(values), 1))
+            leaf, n_values = fusedbatch.flat_leaf(
+                infos, nb, prefs[0].spread.spread_descriptor)
+            L = _l_bucket(n_values)
         elif prefs:
             from ..scheduler.nodeset import _pref_value
             descriptors = [p.spread.spread_descriptor for p in prefs]
@@ -924,13 +941,9 @@ class TPUPlanner:
             # dispatch_group so route breakdowns stay honest
             self._count("groups_breaker_to_host")
             return tasks
-        if self.enable_small_group_routing:
-            if self._launch_overhead is None:
-                self._measure_launch_overhead()
-            if len(tasks) * self.host_cost_per_task < \
-                    0.8 * self._launch_overhead:
-                self.breaker.abort_probe()
-                return tasks   # below device break-even: host loop
+        if self._below_break_even(len(tasks)):
+            self.breaker.abort_probe()
+            return tasks   # below device break-even: host loop
         import time as _time
         _plan_t0 = _time.perf_counter()
         with tracer.span("plan.build_inputs", "plan", tasks=len(tasks)):
@@ -1001,6 +1014,9 @@ class TPUPlanner:
         if self._inflight:
             self._inflight.clear()
             self._cache = None
+        if self._fused_active is not None:
+            self.abort_fused_run(self._fused_active)
+            self._cache = None
 
     def fetch_group(self, handle: _InFlightPlan) -> bool:
         """Pipeline stage 2: block on the dispatched plan's D2H, then
@@ -1042,6 +1058,7 @@ class TPUPlanner:
             return False
         handle.arrays = None
         self.breaker.record_success()
+        self._note_inflight(_time.perf_counter() - _plan_t0)
         if bool(spill):
             # a spread branch saturated: the host oracle's convergence
             # loop redistributes differently than the water-fill in that
@@ -1103,3 +1120,206 @@ class TPUPlanner:
         self._count("groups_planned")
         self._count("tasks_planned", placed)
         return True
+
+    # ----------------------------------------------- fused many-service
+
+    def probe_fused_run(self, sched, glist, start: int) -> list:
+        """Maximal run of consecutive fusable groups from ``glist``
+        [start:], as parsed GroupSpecs.  Empty when fusion is off, the
+        breaker is not closed (per-group routing owns probe accounting),
+        or the first group is not fusable — the scheduler then takes the
+        per-group path for exactly the groups a per-group tick would
+        route the same way."""
+        if not self.fused_enabled or self._fused_dead:
+            return []
+        if self.breaker.state != BREAKER_CLOSED:
+            return []
+        specs = []
+        for group in glist[start:]:
+            if self._below_break_even(len(group)):
+                break   # below device break-even: host path
+            spec = fusedbatch.probe_group(self, group)
+            if spec is None:
+                break
+            specs.append(spec)
+        return specs
+
+    def dispatch_fused_run(self, sched, specs):
+        """Densify + dispatch one fused run (>= 2 groups).  Returns a
+        FusedRun handle or None when the batch cannot be built or the
+        first dispatch fails — the caller falls back group-by-group
+        (identical placements; no mirror state was touched here)."""
+        try:
+            run = fusedbatch.build_run(self, sched, specs)
+        except Exception:
+            log.exception("fused batch build failed; per-group path")
+            self._fused_dead = True
+            return None
+        if run is None:
+            self._count("fused_overflows")
+            return None
+        try:
+            with fusedbatch.x64():
+                run.shared, run.carry = self._prepare_fused(run.shared,
+                                                            run.carry)
+            self._dispatch_fused_chunks(run)
+        except Exception:
+            log.exception("fused dispatch failed; per-group path")
+            self._count("groups_device_error")
+            self.breaker.record_failure()
+            self._fused_dead = True
+            return None
+        if run.dispatch_dead and run.next_dispatch == 0:
+            return None
+        self._fused_active = run
+        return run
+
+    def _prepare_fused(self, shared, carry):
+        """Device placement of a run's node state (called under the x64
+        guard): mesh plan fns shard it with NamedShardings; the
+        single-device path is a plain transfer.  Either way the arrays
+        stay device-resident across every chunk of the run."""
+        fn = self._fused_fn
+        if fn is not None and hasattr(fn, "prepare_fused"):
+            return fn.prepare_fused(shared, carry)
+        import jax.numpy as jnp
+        from .kernel import FusedCarry, FusedShared
+        return (FusedShared(*(jnp.asarray(a) for a in shared)),
+                FusedCarry(*(jnp.asarray(a) for a in carry)))
+
+    def _fused_jit_probe(self):
+        """The underlying jit callable whose cache growth is observed
+        for compile accounting (None when the plan fn hides it)."""
+        if self._fused_fn is None:
+            return plan_fused_jit
+        from ..parallel.sharded import plan_fused_sharded
+        return plan_fused_sharded
+
+    def _dispatch_fused_chunks(self, run) -> None:
+        """Dispatch chunks until two are in flight (or the run is fully
+        dispatched).  Two in flight = the device computes chunk i+1
+        while the host fetches/applies/commits chunk i; deeper would
+        only hold H2D buffers longer.  A dispatch failure marks the run
+        dispatch-dead: already-dispatched chunks still apply, the rest
+        of the tick rides the per-group path."""
+        import time as _time
+        while (not run.dispatch_dead and not run.aborted
+               and run.next_dispatch < len(run.chunks)
+               and run.next_dispatch - run.next_fetch < 2):
+            c = run.chunks[run.next_dispatch]
+            bucket = run.bucket_label(c)
+            probe = self._fused_jit_probe()
+            before = _jit_cache_size(probe)
+            c.t0 = _time.perf_counter()
+            try:
+                with tracer.span("plan.dispatch", "plan", tasks=c.tasks,
+                                 fused_groups=c.count):
+                    with fusedbatch.x64():
+                        fn = (self._fused_fn.fused
+                              if self._fused_fn is not None
+                              else plan_fused_jit)
+                        xs, fcs, spills, carry = fn(
+                            run.shared, c.groups, run.carry, run.L)
+            except Exception:
+                log.exception("fused chunk dispatch failed; remaining "
+                              "groups ride the per-group path")
+                self._count("groups_device_error")
+                self.breaker.record_failure()
+                self._fused_dead = True
+                run.dispatch_dead = True
+                return
+            _observe_compile(probe, bucket, before,
+                             _time.perf_counter() - c.t0)
+            c.arrays = (xs, fcs, spills)
+            c.groups = None   # release the np staging buffers
+            run.carry = carry   # device-resident; never fetched
+            run.next_dispatch += 1
+            self._count("fused_chunks")
+
+    def fetch_fused_chunk(self, run):
+        """Block on the next chunk's D2H and prime the following
+        dispatch.  Returns (x [G, N], fail_counts [G, 7], spill [G],
+        start, count) as numpy, or None when the run is exhausted or
+        died (remaining groups take the per-group path)."""
+        import time as _time
+        if run.aborted or run.next_fetch >= run.next_dispatch:
+            return None
+        c = run.chunks[run.next_fetch]
+        try:
+            with tracer.span("plan.d2h", "plan"):
+                xs, fcs, spills = fetch_plan(c.arrays)
+        except Exception:
+            log.exception("fused fetch failed; remaining groups ride "
+                          "the per-group path")
+            self._count("groups_device_error")
+            self.breaker.record_failure()
+            self._fused_dead = True
+            self._cache = None
+            self.abort_fused_run(run)
+            return None
+        c.arrays = None
+        run.next_fetch += 1
+        self.breaker.record_success()
+        end = _time.perf_counter()
+        # chunk windows overlap (two dispatches in flight): charge
+        # plan_seconds only the wall time this chunk ADDED beyond the
+        # previous fetch, or summed plan_s would exceed the tick wall
+        self._observe_plan(end - max(c.t0, run.last_fetch_end))
+        run.last_fetch_end = end
+        self._note_inflight(end - c.t0)
+        self._dispatch_fused_chunks(run)   # keep the pipeline primed
+        return (np.asarray(xs), np.asarray(fcs), np.asarray(spills),
+                c.start, c.count)
+
+    def apply_fused_group(self, run, gi: int, x_row, fail_row,
+                          decisions) -> int:
+        """Apply one fused group's placements to the scheduler mirrors /
+        decision draft — the same simple-path apply as ``fetch_group``
+        (fusability guarantees no generics/ports/shutdown stragglers).
+        Returns the number of tasks placed; the group dict retains any
+        unplaceable leftovers and ``last_explanation`` is set for the
+        caller's no-suitable-node pass."""
+        spec = run.specs[gi]
+        sched, t, task_group = run.sched, spec.t, spec.group
+        infos, n, nb, valid, ready, cpu, mem, total = run.cols
+        self.last_explanation = self._explain(fail_row)
+        x = np.asarray(x_row)
+        slots = np.repeat(np.arange(x.shape[0]), x).tolist()
+        items = list(task_group.items())
+        placed = min(len(items), len(slots))
+        with tracer.span("plan.apply", "plan", tasks=placed):
+            self._apply_assignments(sched, t, items[:placed],
+                                    slots[:placed], infos, decisions,
+                                    spec.cpu_d, spec.mem_d, x, cpu, mem,
+                                    total)
+        if placed == len(task_group):
+            task_group.clear()
+        else:
+            for task_id, _ in items[:placed]:
+                del task_group[task_id]
+        run.applied = gi + 1
+        self._count("groups_fused")
+        self._count("tasks_planned", placed)
+        return placed
+
+    def note_fused_spill(self, run) -> None:
+        """A fused group's spread branches saturated: the group goes to
+        the host oracle for exact reference parity (same flag as the
+        per-group path), which invalidates the column cache and aborts
+        the rest of the run — later groups were planned against this
+        group's device placement, which no longer happens."""
+        self._count("groups_spill_to_host")
+        self._cache = None
+        self.abort_fused_run(run)
+
+    def abort_fused_run(self, run) -> None:
+        """Release a fused run (normal completion or abort): drop
+        undispatched staging buffers and unfetched device arrays."""
+        run.aborted = True
+        for c in run.chunks:
+            c.arrays = None
+            c.groups = None
+        run.carry = None
+        run.shared = None
+        if self._fused_active is run:
+            self._fused_active = None

@@ -31,7 +31,10 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
-from ..ops.kernel import GroupInputs, NodeInputs, plan_group
+from ..ops.kernel import (
+    FusedCarry, FusedGroups, FusedShared, GroupInputs, NodeInputs,
+    plan_fused, plan_group,
+)
 
 NODE_AXIS = "nodes"
 
@@ -39,6 +42,31 @@ NODE_AXIS = "nodes"
 def make_mesh(devices=None, axis: str = NODE_AXIS) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(np.asarray(devices), (axis,))
+
+
+def mesh_from_env() -> Optional[Mesh]:
+    """Build the planner mesh the SWARM_PLANNER_MESH knob asks for:
+    an integer device count >1 selects the first D devices (D must be
+    available — on CPU images use XLA_FLAGS
+    --xla_force_host_platform_device_count).  Unset/1/garbage means
+    single-device (no mesh); asking for more devices than exist is a
+    loud no (misconfiguration must not silently run slower)."""
+    import os
+    raw = os.environ.get("SWARM_PLANNER_MESH", "").strip()
+    if not raw:
+        return None
+    try:
+        d = int(raw)
+    except ValueError:
+        return None
+    if d <= 1:
+        return None
+    devices = jax.devices()
+    if len(devices) < d:
+        raise RuntimeError(
+            f"SWARM_PLANNER_MESH={d} but only {len(devices)} device(s) "
+            "available")
+    return make_mesh(devices[:d])
 
 
 # PartitionSpecs: node-dimension sharded, everything else replicated.
@@ -87,6 +115,53 @@ def plan_group_sharded(nodes: NodeInputs, group: GroupInputs, L: int,
     return fn(nodes, group, hier)
 
 
+# Fused-batch PartitionSpecs: node-dimension sharded, group/service
+# axes replicated (G and S are small; the node axis is the scale axis).
+_FUSED_SHARED_SPECS = FusedShared(
+    valid=P(NODE_AXIS), ready=P(NODE_AXIS), os_hash=P(None, NODE_AXIS),
+    arch_hash=P(None, NODE_AXIS), svc0=P(None, NODE_AXIS))
+
+_FUSED_GROUP_SPECS = FusedGroups(
+    k=P(), slot=P(), maxrep=P(), cpu_d=P(), mem_d=P(),
+    con_hash=P(None, None, None, NODE_AXIS), con_op=P(), con_exp=P(),
+    plat=P(), failures=P(None, NODE_AXIS), leaf=P(None, NODE_AXIS),
+    extra_mask=P(None, NODE_AXIS))
+
+_FUSED_CARRY_SPECS = FusedCarry(
+    total=P(NODE_AXIS), cpu=P(NODE_AXIS), mem=P(NODE_AXIS),
+    svc_acc=P(None, NODE_AXIS))
+
+
+@functools.partial(jax.jit, static_argnames=("L", "mesh"))
+def plan_fused_sharded(shared: FusedShared, groups: FusedGroups,
+                       carry: FusedCarry, L: int, mesh: Mesh):
+    """Sharded fused batch: the same scan-over-groups program as
+    ops.kernel.plan_fused with the node axis split over the mesh.
+    Cross-shard traffic per group is unchanged from the per-group
+    sharded kernel (~120 psums of an [L]-vector per scan step); the
+    carry stays sharded across chunked calls, so chunk i+1 consumes
+    chunk i's device-resident state with zero host round-trips."""
+
+    n_devices = mesh.shape[NODE_AXIS]
+    local_n = shared.valid.shape[0] // n_devices
+
+    def kernel(shared_l, groups_l, carry_l):
+        reduce = lambda v: jax.lax.psum(v, NODE_AXIS)  # noqa: E731
+        offset = jax.lax.axis_index(NODE_AXIS).astype(jnp.int32) * local_n
+        return plan_fused(shared_l, groups_l, carry_l, L, reduce=reduce,
+                          idx_offset=offset)
+
+    # check_rep=False: same advisory-checker mistyping as
+    # plan_group_sharded above (scan carries inside psum kernels)
+    fn = shard_map(kernel, mesh=mesh,
+                   in_specs=(_FUSED_SHARED_SPECS, _FUSED_GROUP_SPECS,
+                             _FUSED_CARRY_SPECS),
+                   out_specs=(P(None, NODE_AXIS), P(), P(),
+                              _FUSED_CARRY_SPECS),
+                   check_rep=False)
+    return fn(shared, groups, carry)
+
+
 class ShardedPlanFn:
     """Drop-in ``plan_fn`` for ops.planner.TPUPlanner running on a mesh.
 
@@ -115,3 +190,30 @@ class ShardedPlanFn:
                 hier = (tuple((pad_last(seg), parent)
                               for seg, parent in upper), leaf_parent)
         return plan_group_sharded(nodes, group, L, self.mesh, hier)
+
+    # ------------------------------------------------------- fused batch
+
+    def _shard(self, value, specs):
+        put = jax.device_put
+        return type(value)(*(
+            put(np.asarray(a), NamedSharding(self.mesh, spec))
+            for a, spec in zip(value, specs)))
+
+    def prepare_fused(self, shared: FusedShared, carry: FusedCarry):
+        """Place a fused run's node state on the mesh once, so every
+        chunked dispatch reads device-resident shards instead of
+        re-transferring the resource matrices per call.  The node
+        bucket must divide evenly over the mesh (power-of-two buckets
+        and mesh sizes guarantee it — asserted, not padded, because
+        fused idx tie-keys must match the single-device program)."""
+        n = shared.valid.shape[0]
+        d = self.mesh.shape[NODE_AXIS]
+        if n % d:
+            raise ValueError(
+                f"fused node bucket {n} not divisible by mesh size {d}")
+        return (self._shard(shared, _FUSED_SHARED_SPECS),
+                self._shard(carry, _FUSED_CARRY_SPECS))
+
+    def fused(self, shared: FusedShared, groups: FusedGroups,
+              carry: FusedCarry, L: int):
+        return plan_fused_sharded(shared, groups, carry, L, self.mesh)

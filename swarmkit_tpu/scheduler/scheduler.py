@@ -563,8 +563,7 @@ class Scheduler:
                     self._run_group_pipeline(groups, one_off_tasks,
                                              decisions)
             else:
-                for group in self._tick_groups(groups, one_off_tasks):
-                    self._schedule_task_group(group, decisions)
+                self._run_groups_serial(groups, one_off_tasks, decisions)
         finally:
             if planner is not None and hasattr(planner, "end_tick"):
                 planner.end_tick()
@@ -648,13 +647,44 @@ class Scheduler:
         interleaving changes.  Returns (block decisions drafted,
         committed count, failed pairs); the tick is acked only after the
         last draft resolved.
+
+        Runs of >= 2 consecutive fusable groups take the FUSED
+        many-service path (ops/fusedbatch.py): one densify + one
+        scan-over-groups program per chunk instead of a round-trip per
+        group, with the same per-group drafts flowing to the committer
+        in the same order — a fused tick's store/event stream is
+        byte-identical to the per-group tick's.
         """
         planner = self.batch_planner
         committer = _TickCommitter(self)
         inflight: Optional[Tuple[object, Dict[str, Task]]] = None
         n_block = 0
+        glist = list(self._tick_groups(groups, one_off_tasks))
+        can_fuse = hasattr(planner, "probe_fused_run")
+        i = 0
         try:
-            for group in self._tick_groups(groups, one_off_tasks):
+            while i < len(glist):
+                # probe reads only task specs + planner routing state, so
+                # it is safe with a per-group plan still in flight
+                specs = (planner.probe_fused_run(self, glist, i)
+                         if can_fuse else [])
+                if len(specs) >= 2:
+                    if inflight is not None:
+                        n_block += self._finish_inflight(
+                            inflight, decisions, committer)
+                        inflight = None
+                    consumed, fused_block, spilled = self._run_fused(
+                        specs, decisions, committer)
+                    n_block += fused_block
+                    i += consumed
+                    if consumed and not spilled:
+                        continue
+                    # spilled at glist[i] (re-fusing replans against the
+                    # same node state and deterministically spills again)
+                    # or the run could not build/dispatch: glist[i]
+                    # falls through to the per-group path below
+                group = glist[i]
+                i += 1
                 if inflight is not None:
                     n_block += self._finish_inflight(inflight, decisions,
                                                      committer)
@@ -677,6 +707,85 @@ class Scheduler:
                 planner.discard_inflight()
             committed, failed = committer.close()
         return n_block, committed, failed
+
+    def _run_groups_serial(self, groups, one_off_tasks, decisions) -> None:
+        """Serial scheduling phase (pipeline_depth == 1, or no pipelined
+        planner): groups schedule synchronously and drafts commit at
+        tick end.  Fusable runs still take the fused many-service path —
+        it is thread-free (chunk fetches block inline), so the sim's
+        deterministic depth-1 control plane exercises the exact fused
+        program production runs."""
+        planner = self.batch_planner
+        can_fuse = (planner is not None
+                    and hasattr(planner, "probe_fused_run"))
+        glist = list(self._tick_groups(groups, one_off_tasks))
+        i = 0
+        while i < len(glist):
+            specs = (planner.probe_fused_run(self, glist, i)
+                     if can_fuse else [])
+            if len(specs) >= 2:
+                consumed, _, spilled = self._run_fused(specs, decisions,
+                                                       committer=None)
+                i += consumed
+                if consumed and not spilled:
+                    continue
+                # spilled group (glist[i]) goes per-group below
+            self._schedule_task_group(glist[i], decisions)
+            i += 1
+
+    def _run_fused(self, specs, decisions,
+                   committer: Optional[_TickCommitter]
+                   ) -> Tuple[int, int, bool]:
+        """Drive one fused run to completion: fetch each chunk (the next
+        chunk computes on device meanwhile), apply its groups in order,
+        and hand each group's draft to the committer (pipelined mode) or
+        leave it on ``block_draft`` for the end-of-tick commit (serial
+        mode) — exactly where the per-group path puts it.  Returns
+        (groups consumed, block decisions drafted to the committer,
+        spilled); a spill or a dead run stops early and the caller
+        continues per-group from the first unconsumed group — without
+        re-probing a spilled group for fusion, which would replan it
+        against identical node state and spill again."""
+        planner = self.batch_planner
+        run = planner.dispatch_fused_run(self, specs)
+        if run is None:
+            return 0, 0, False
+        n_block = 0
+        consumed = 0
+        try:
+            while True:
+                out = planner.fetch_fused_chunk(run)
+                if out is None:
+                    break
+                xs, fcs, spills, start, count = out
+                for j in range(count):
+                    gi = start + j
+                    if bool(spills[j]):
+                        # exact reference parity requires the host
+                        # oracle for this group; later groups were
+                        # planned against a placement that no longer
+                        # happens, so the run aborts here
+                        planner.note_fused_spill(run)
+                        return consumed, n_block, True
+                    planner.apply_fused_group(run, gi, xs[j], fcs[j],
+                                              decisions)
+                    group = run.specs[gi].group
+                    if group:
+                        self._no_suitable_node(
+                            group, decisions,
+                            explanation=getattr(planner,
+                                                "last_explanation", ""))
+                    consumed += 1
+                    if committer is not None and self.block_draft:
+                        draft, self.block_draft = self.block_draft, []
+                        n_block += sum(len(olds)
+                                       for olds, _, _ in draft)
+                        committer.submit(draft)
+                        committer.throttle(max(1,
+                                               self.pipeline_depth - 1))
+        finally:
+            planner.abort_fused_run(run)
+        return consumed, n_block, False
 
     def _finish_inflight(self, inflight, decisions,
                          committer: _TickCommitter) -> int:

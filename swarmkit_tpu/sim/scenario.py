@@ -515,6 +515,233 @@ def _pipelined_commit_churn(sim: Sim) -> float:
     return eng.clock.elapsed() + 3.0
 
 
+def _fused_differential_churn(sim: Sim) -> float:
+    """Differential: the FUSED many-service planner must place exactly
+    what the per-service planner places, per seed, under churn.
+
+    Two standalone stores ride the sim consensus (unbound
+    SimRaftProposers) while the raft-attached control plane churns in
+    the background: scheduler F plans with the fused path, scheduler P
+    with ``fused_enabled=False``.  Identical workloads and faults are
+    applied to both in lockstep and placements are compared after every
+    phase — any divergence is a violation.  Phases cover the degraded
+    routes too: host fallback (node.ip constraint group in every tick),
+    task-failure down-weighting, node drains, a PlannerBreaker trip
+    (both planners host-route, then half-open probe after the virtual
+    cooldown), and a leadership stepdown (commit failure -> rollback ->
+    requeue -> converge on the successor).
+    """
+    eng = sim.engine
+    sim.start_raft_workload(interval=0.8)
+    sim.cp.create_tasks(6)   # background control-plane traffic
+
+    while sim.leader() is None and eng.clock.elapsed() < 30.0:
+        eng.run_until(eng.clock.elapsed() + 0.5)
+    if sim.leader() is None:
+        sim.violations.record("fused-differential",
+                              "no ready leader within 30s")
+        return eng.clock.elapsed() + 5.0
+
+    from ..models import (
+        Annotations, Node, NodeAvailability, NodeDescription, NodeSpec,
+        NodeState, NodeStatus, Placement, PlacementPreference,
+        ReplicatedService, Resources, ResourceRequirements, Service,
+        ServiceMode, ServiceSpec, SpreadOver, Task, TaskSpec, TaskState,
+        TaskStatus, Version,
+    )
+    from ..models.types import now
+    from ..ops import TPUPlanner
+    from ..scheduler import Scheduler
+    from ..state.store import MemoryStore
+    from .cluster import SimRaftProposer
+
+    res = ResourceRequirements(
+        reservations=Resources(nano_cpus=10 ** 8, memory_bytes=64 << 20))
+    svc_specs = {
+        "fa": TaskSpec(resources=res),
+        "fb": TaskSpec(resources=res),
+        "fc": TaskSpec(placement=Placement(preferences=[
+            PlacementPreference(spread=SpreadOver(
+                spread_descriptor="node.labels.rack"))]),
+            resources=res),
+        # node.ip constraints stay on the host oracle: the fused run
+        # breaks around this group every tick (host-fallback parity)
+        "fd": TaskSpec(placement=Placement(
+            constraints=["node.ip!=10.0.0.9"])),
+    }
+
+    def build_store():
+        store = MemoryStore(proposer=SimRaftProposer(sim))
+        def mk(tx):
+            for i in range(12):
+                tx.create(Node(
+                    id=f"dn{i:02d}",
+                    spec=NodeSpec(annotations=Annotations(
+                        name=f"dn{i:02d}",
+                        labels={"rack": f"r{i % 3}"})),
+                    status=NodeStatus(state=NodeState.READY),
+                    description=NodeDescription(
+                        hostname=f"dn{i:02d}",
+                        resources=Resources(nano_cpus=8 * 10 ** 9,
+                                            memory_bytes=32 << 30))))
+            for sid, spec in svc_specs.items():
+                tx.create(Service(
+                    id=sid,
+                    spec=ServiceSpec(annotations=Annotations(name=sid),
+                                     mode=ServiceMode.REPLICATED,
+                                     replicated=ReplicatedService(
+                                         replicas=0),
+                                     task=spec),
+                    spec_version=Version(index=1)))
+        store.update(mk)
+        return store
+
+    seqs = {sid: 0 for sid in svc_specs}
+
+    def add_tasks(store, sid, n, base):
+        spec = svc_specs[sid]
+        def cb(tx):
+            for i in range(n):
+                tx.create(Task(
+                    id=f"{sid}-{base + i:04d}", service_id=sid,
+                    slot=base + i + 1,
+                    desired_state=TaskState.RUNNING, spec=spec,
+                    spec_version=Version(index=1),
+                    status=TaskStatus(state=TaskState.PENDING,
+                                      timestamp=now())))
+        store.update(cb)
+
+    stores, scheds, planners = [], [], []
+    for fused in (True, False):
+        store = build_store()
+        planner = TPUPlanner()
+        planner.enable_small_group_routing = False
+        planner.fused_enabled = fused
+        sched = Scheduler(store, batch_planner=planner,
+                          pipeline_depth=1)
+        store.view(sched._setup_tasks_list)
+        stores.append(store)
+        scheds.append(sched)
+        planners.append(planner)
+
+    def snap(store):
+        # placement claim only: ids, nodes, states.  Timestamps differ
+        # by construction (the two ticks run seconds apart in virtual
+        # time) and are not part of the equivalence being asserted.
+        return sorted((t.id, t.node_id, int(t.status.state))
+                      for t in store.view(lambda tx: tx.find(Task)))
+
+    def both(fn):
+        for store in stores:
+            fn(store)
+
+    def tick_and_compare(phase):
+        for sched in scheds:
+            sched._resync()
+            sched.tick()
+        a, b = snap(stores[0]), snap(stores[1])
+        if a != b:
+            diff = [(x, y) for x, y in zip(a, b) if x != y][:5]
+            sim.violations.record(
+                "fused-differential",
+                f"{phase}: fused placements diverged from per-service "
+                f"(first diffs: {diff})")
+
+    # ---- phase 1: clean multi-service tick
+    for sid, n in (("fa", 40), ("fb", 24), ("fc", 18), ("fd", 8)):
+        both(lambda s, sid=sid, n=n: add_tasks(s, sid, n, seqs[sid]))
+        seqs[sid] += {"fa": 40, "fb": 24, "fc": 18, "fd": 8}[sid]
+    tick_and_compare("clean-tick")
+    if planners[0].stats.get("groups_fused", 0) < 2:
+        sim.violations.record(
+            "fused-differential",
+            "fused path never engaged on the fused-side scheduler "
+            f"(stats {planners[0].stats})")
+    if planners[1].stats.get("groups_fused", 0):
+        sim.violations.record(
+            "fused-differential",
+            "per-service side took the fused path; differential is void")
+
+    # ---- phase 2: task failures (down-weighted scoring) + scale-up
+    def fail_tasks(store):
+        victims = [t for t in store.view(lambda tx: tx.find(Task))
+                   if t.service_id == "fa" and t.node_id][:6]
+        def cb(tx):
+            for v in victims:
+                cur = tx.get(Task, v.id)
+                if cur is None:
+                    continue
+                cur = cur.copy()
+                cur.status = TaskStatus(state=TaskState.FAILED,
+                                        timestamp=now(),
+                                        message="sim fault")
+                tx.update(cur)
+        store.update(cb)
+    both(fail_tasks)
+    eng.run_until(eng.clock.elapsed() + 1.0)
+    both(lambda s: add_tasks(s, "fa", 20, seqs["fa"]))
+    seqs["fa"] += 20
+    tick_and_compare("failure-churn")
+
+    # ---- phase 3: drain nodes, then place more work around them
+    def drain(store):
+        def cb(tx):
+            for nid in ("dn00", "dn05"):
+                cur = tx.get(Node, nid).copy()
+                cur.spec.availability = NodeAvailability.DRAIN
+                tx.update(cur)
+        store.update(cb)
+    both(drain)
+    both(lambda s: add_tasks(s, "fb", 16, seqs["fb"]))
+    both(lambda s: add_tasks(s, "fc", 12, seqs["fc"]))
+    seqs["fb"] += 16
+    seqs["fc"] += 12
+    tick_and_compare("drain-churn")
+
+    # ---- phase 4: breaker trip — BOTH planners degrade to the host
+    # oracle, then half-open probe after the virtual cooldown
+    for planner in planners:
+        for _ in range(planner.breaker.threshold):
+            planner.breaker.record_failure()
+    both(lambda s: add_tasks(s, "fa", 12, seqs["fa"]))
+    seqs["fa"] += 12
+    tick_and_compare("breaker-open")
+    if not planners[0].stats.get("groups_breaker_to_host"):
+        sim.violations.record(
+            "fused-differential",
+            "breaker-open tick did not host-route (degraded differential "
+            "not exercised)")
+    eng.run_until(eng.clock.elapsed()
+                  + planners[0].breaker.base_cooldown + 1.0)
+    both(lambda s: add_tasks(s, "fb", 12, seqs["fb"]))
+    seqs["fb"] += 12
+    tick_and_compare("breaker-probe")
+
+    # ---- phase 5: leadership stepdown mid-workload — both sides fail
+    # their commits, roll back, requeue, and converge on the successor
+    both(lambda s: add_tasks(s, "fa", 10, seqs["fa"]))
+    seqs["fa"] += 10
+    sim.stepdown_leader()
+    tick_and_compare("stepdown-requeue")
+    while sim.leader() is None and eng.clock.elapsed() < 90.0:
+        eng.run_until(eng.clock.elapsed() + 0.5)
+    if sim.leader() is None:
+        sim.violations.record("fused-differential",
+                              "no successor leader within 90s")
+    else:
+        tick_and_compare("post-stepdown-converge")
+        pending = len(scheds[0].unassigned_tasks)
+        if pending:
+            sim.violations.record(
+                "fused-differential",
+                f"{pending} tasks still unplaced after the successor "
+                "re-tick")
+    return eng.clock.elapsed() + 3.0
+
+
+_fused_differential_churn.raft_cp = True
+
+
 # ------------------------------------------------- failover scenarios
 #
 # These run the RAFT-ATTACHED control plane (Sim(raft_cp=True)): every
@@ -700,6 +927,7 @@ SCENARIOS: Dict[str, Callable[[Sim], float]] = {
     "clock-skew": _clock_skew,
     "agent-storm": _agent_storm,
     "pipelined-commit-churn": _pipelined_commit_churn,
+    "fused-differential-churn": _fused_differential_churn,
     "random-fuzz": _random_fuzz,
     # failover suite (raft-attached control plane); depth = store-level
     # chunk-pipelined proposal window

@@ -26,3 +26,27 @@ def plan_hier(scores, L):
 def _accumulate(scores):
     # reached from plan_hier: device code by closure
     return scores.sum().item()             # D2H sync in a helper
+
+
+@functools.partial(jax.jit, static_argnames=("L",))
+def plan_fused(shared, groups, carry, L):
+    # fused many-service program: the scan step is device code too
+    def step(state, g):
+        cap = np.minimum(state, g)          # numpy inside the scan step
+        spill = state.sum().item()          # D2H sync in the carry math
+        return state - g, (cap, spill)
+
+    out, ys = jax.lax.scan(step, carry, groups)
+    jax.device_get(out)                     # carry fetched mid-program
+    return ys
+
+
+@jax.jit
+def plan_fused_sharded(x):
+    from jax.experimental.shard_map import shard_map
+
+    def kernel(xl):
+        xl.block_until_ready()              # sync inside the mesh kernel
+        return xl.sum()
+
+    return shard_map(kernel, mesh=None, in_specs=None, out_specs=None)(x)

@@ -37,3 +37,32 @@ def fetch(arrays):
 def pad_inputs(a, width):
     # host driver: numpy padding before device placement is fine
     return np.pad(np.asarray(a), (0, width))
+
+
+@functools.partial(jax.jit, static_argnames=("L",))
+def plan_fused(shared, groups, carry, L):
+    # fused many-service program: the scan carry stays device-resident
+    def step(state, g):
+        cap = jnp.minimum(state, g)
+        spill = state.sum() > jnp.zeros((), jnp.float32)
+        return state - g, (cap, spill)
+
+    carry_out, ys = jax.lax.scan(step, carry, groups)
+    return ys, carry_out                    # caller keeps it on device
+
+
+@jax.jit
+def plan_fused_sharded(x):
+    from jax.experimental.shard_map import shard_map
+
+    def kernel(xl):
+        # cross-shard reduction, not a host sync
+        return jax.lax.psum(xl.sum(), "nodes")
+
+    return shard_map(kernel, mesh=None, in_specs=None, out_specs=None)(x)
+
+
+def dispatch_chunks(run, chunks):
+    # host driver: np staging + device placement happen OUTSIDE jit
+    staged = [np.asarray(c) for c in chunks]
+    return [jax.device_put(s) for s in staged]
