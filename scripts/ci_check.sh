@@ -11,6 +11,16 @@ echo "== swarmlint (scripts/swarmlint.py) =="
 python scripts/swarmlint.py || exit 1
 
 echo
+echo "== chaos sweep, fast subset (scripts/chaos_sweep.py --fast) =="
+# 3 seeds x rolling-upgrade-chaos: real rolling updates (pause /
+# rollback / failover handoff) under partition+churn, invariants +
+# coverage gate.  The 20-seed default-suite sweep and long-soak run in
+# the slow tier (tests/test_update_chaos.py -m slow).
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/chaos_sweep.py --fast --quiet > /tmp/_chaos_fast.json \
+    || { cat /tmp/_chaos_fast.json; exit 1; }
+
+echo
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -2,6 +2,10 @@
 """Seed-sweep the leader-failover scenarios (raft-attached control
 plane) and fail loudly on any invariant violation.
 
+Thin wrapper kept for CLI compatibility: the sweep implementation moved
+to scripts/chaos_sweep.py, which generalizes it to any scenario subset
+and adds the fault-type x component coverage report.
+
     python scripts/failover_fuzz.py --fuzz 20
     python scripts/failover_fuzz.py --fuzz 20 --scenario leader-crash-mid-tick
     python scripts/failover_fuzz.py --list
@@ -31,21 +35,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from swarmkit_tpu.sim.scenario import (          # noqa: E402
-    FAILOVER_SCENARIOS, SCENARIOS, run_scenario,
+    FAILOVER_SCENARIOS, SCENARIOS,
 )
-
-
-def sweep(scenarios, n_seeds: int, start_seed: int = 0,
-          progress=None) -> list:
-    """Run every (scenario, seed) pair; returns all SimReports."""
-    reports = []
-    for name in scenarios:
-        for seed in range(start_seed, start_seed + n_seeds):
-            r = run_scenario(name, seed)
-            reports.append(r)
-            if progress is not None:
-                progress(r)
-    return reports
+from chaos_sweep import sweep                    # noqa: E402,F401
 
 
 def main(argv=None) -> int:
@@ -82,7 +74,7 @@ def main(argv=None) -> int:
               f"attaches={ctl.get('attaches', 0)}", file=sys.stderr)
 
     reports = sweep(scenarios, args.fuzz, start_seed=args.start_seed,
-                    progress=progress)
+                    progress=progress, keep_trace=False)
     bad = [r for r in reports if not r.ok]
     print(json.dumps({
         "scenarios": list(scenarios),

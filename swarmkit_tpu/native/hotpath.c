@@ -522,6 +522,22 @@ dict_vals_equal(PyObject *a, PyObject *b)
     return PyObject_RichCompareBool(a, b, Py_EQ);
 }
 
+/* Index buckets are insertion-ordered {task_id: None} dicts, not sets:
+ * indexed find() results feed placement decisions, and set iteration
+ * order varies with hash randomization (per-process nondeterminism the
+ * sim's byte-identical-report contract forbids).  Discard = guarded
+ * delete; missing key is not an error (mirrors set.discard). */
+static int
+bucket_discard(PyObject *bucket, PyObject *key)
+{
+    int has = PyDict_Contains(bucket, key);
+    if (has < 0)
+        return -1;
+    if (has && PyDict_DelItem(bucket, key) < 0)
+        return -1;
+    return 0;
+}
+
 /* commit_apply(stamped, objects, by_node, reindex_cb) -> None
  *
  * Install each stamped task into the objects table; maintain the by_node
@@ -597,7 +613,7 @@ commit_apply(PyObject *self, PyObject *args)
                 if (!eq) {
                     if (onid && PyObject_IsTrue(onid)) {
                         PyObject *st = PyDict_GetItem(by_node, onid);
-                        if (st && PySet_Discard(st, tid) < 0) {
+                        if (st && bucket_discard(st, tid) < 0) {
                             Py_DECREF(od);
                             Py_DECREF(old);
                             Py_DECREF(d);
@@ -607,7 +623,7 @@ commit_apply(PyObject *self, PyObject *args)
                     if (nnid && PyObject_IsTrue(nnid)) {
                         PyObject *st = PyDict_GetItem(by_node, nnid);
                         if (!st) {
-                            PyObject *ns = PySet_New(NULL);
+                            PyObject *ns = PyDict_New();
                             if (!ns ||
                                 PyDict_SetItem(by_node, nnid, ns) < 0) {
                                 Py_XDECREF(ns);
@@ -619,7 +635,7 @@ commit_apply(PyObject *self, PyObject *args)
                             Py_DECREF(ns);
                             st = PyDict_GetItem(by_node, nnid);
                         }
-                        if (PySet_Add(st, tid) < 0) {
+                        if (PyDict_SetItem(st, tid, Py_None) < 0) {
                             Py_DECREF(od);
                             Py_DECREF(old);
                             Py_DECREF(d);
@@ -785,7 +801,7 @@ block_commit(PyObject *self, PyObject *args)
             }
             if (!eq) {
                 PyObject *os = PyDict_GetItem(by_node, onid);
-                if (os && PySet_Discard(os, tid) < 0) {
+                if (os && bucket_discard(os, tid) < 0) {
                     Py_DECREF(d);
                     goto fail;
                 }
@@ -797,7 +813,7 @@ block_commit(PyObject *self, PyObject *args)
             if (PyObject_IsTrue(nid)) {
                 run_set = PyDict_GetItem(by_node, nid);
                 if (!run_set) {
-                    PyObject *fresh = PySet_New(NULL);
+                    PyObject *fresh = PyDict_New();
                     if (!fresh ||
                         PyDict_SetItem(by_node, nid, fresh) < 0) {
                         Py_XDECREF(fresh);
@@ -809,7 +825,7 @@ block_commit(PyObject *self, PyObject *args)
                 }
             }
         }
-        if (run_set && PySet_Add(run_set, tid) < 0) {
+        if (run_set && PyDict_SetItem(run_set, tid, Py_None) < 0) {
             Py_DECREF(d);
             goto fail;
         }
@@ -1039,7 +1055,7 @@ block_apply(PyObject *self, PyObject *args)
                 goto fail;
             if (!eq) {
                 PyObject *os = PyDict_GetItem(by_node, onid);
-                if (os && PySet_Discard(os, tid) < 0)
+                if (os && bucket_discard(os, tid) < 0)
                     goto fail;
             }
         }
@@ -1049,7 +1065,7 @@ block_apply(PyObject *self, PyObject *args)
             if (PyObject_IsTrue(nid)) {
                 run_set = PyDict_GetItem(by_node, nid);
                 if (!run_set) {
-                    PyObject *fresh = PySet_New(NULL);
+                    PyObject *fresh = PyDict_New();
                     if (!fresh ||
                         PyDict_SetItem(by_node, nid, fresh) < 0) {
                         Py_XDECREF(fresh);
@@ -1060,7 +1076,7 @@ block_apply(PyObject *self, PyObject *args)
                 }
             }
         }
-        if (run_set && PySet_Add(run_set, tid) < 0)
+        if (run_set && PyDict_SetItem(run_set, tid, Py_None) < 0)
             goto fail;
     }
     Py_DECREF(fast);

@@ -88,6 +88,49 @@ _ROUTES = tuple(f'swarm_planner_groups{{route="{r}"}}'
                 for r in ("device", "fallback", "host_small", "spill",
                           "breaker"))
 
+_STATE_PREFIX = 'swarm_update_state{service="'
+
+
+def stuck_rollout_value() -> Callable[[Registry], Optional[float]]:
+    """Worst rollout condition across services: 0 = every rollout is
+    progressing (pass), 1 = a rollout sits PAUSED / ROLLBACK_PAUSED
+    after tripping its failure threshold (warn — operator attention,
+    not an outage), 2 = an ACTIVE rollout has stamped no forward
+    progress for longer than its own monitor window (fail — stuck, the
+    supervisor should have either advanced a slot or declared a
+    verdict by now).  None (pass) until a first update exports state.
+
+    Reads the gauges orchestrator/update.py exports on every committed
+    status write and slot completion: ``swarm_update_state{service=}``,
+    ``swarm_update_last_progress{service=}`` (progress stamp) and
+    ``swarm_update_monitor{service=}`` (per-rollout window)."""
+    from ..models.types import UpdateState
+    active = (float(UpdateState.UPDATING),
+              float(UpdateState.ROLLBACK_STARTED))
+    paused = (float(UpdateState.PAUSED),
+              float(UpdateState.ROLLBACK_PAUSED))
+
+    def get(reg: Registry) -> Optional[float]:
+        states = reg.gauges_snapshot(_STATE_PREFIX)
+        if not states:
+            return None
+        worst = 0.0
+        t = _types.now()
+        for name, state in states.items():
+            svc = name[len(_STATE_PREFIX):-len('"}')]
+            if state in paused:
+                worst = max(worst, 1.0)
+            elif state in active:
+                last = reg.get_gauge(
+                    f'swarm_update_last_progress{{service="{svc}"}}')
+                monitor = reg.get_gauge(
+                    f'swarm_update_monitor{{service="{svc}"}}')
+                if last is not None and monitor is not None \
+                        and t - last > monitor:
+                    worst = max(worst, 2.0)
+        return worst
+    return get
+
 
 def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
                    edge_warn: float = 10.0, edge_fail: float = 60.0,
@@ -126,6 +169,12 @@ def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
               gauge_value("swarm_planner_breaker_state"),
               1.0, 2.0, "state",
               ("swarm_planner_",)),
+        # rolling updates (orchestrator/update.py): 1 = paused at the
+        # failure threshold (warn), 2 = an active rollout stopped
+        # making progress past its monitor window (fail)
+        Check("stuck_rollout", stuck_rollout_value(),
+              1.0, 2.0, "state",
+              ("swarm_update_",)),
     ]
 
 

@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional
 
 from ..models.objects import Cluster, Node, Service, Task
 from ..models.types import NodeAvailability, NodeState, TaskState
@@ -46,14 +46,15 @@ class _GlobalService:
 
 class Orchestrator:
     def __init__(self, store: MemoryStore,
-                 restarts: Optional[RestartSupervisor] = None):
+                 restarts: Optional[RestartSupervisor] = None,
+                 updater: Optional[UpdateSupervisor] = None):
         self.store = store
         self.restarts = restarts or RestartSupervisor(store)
-        self.updater = UpdateSupervisor(store, self.restarts)
+        self.updater = updater or UpdateSupervisor(store, self.restarts)
         self.cluster: Optional[Cluster] = None
         self.nodes: Dict[str, Node] = {}      # non-drained, non-down nodes
         self.global_services: Dict[str, _GlobalService] = {}
-        self.restart_tasks: Set[str] = set()
+        self.restart_tasks: Dict[str, None] = {}   # insertion-ordered set
         self._stop = threading.Event()
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -174,7 +175,7 @@ class Orchestrator:
         if t.desired_state > TaskState.RUNNING:
             return
         if t.status.state > TaskState.RUNNING:
-            self.restart_tasks.add(t.id)
+            self.restart_tasks[t.id] = None
 
     # --------------------------------------------------------------- mirrors
 
@@ -315,7 +316,7 @@ class Orchestrator:
     def _tick_tasks(self) -> None:
         if not self.restart_tasks:
             return
-        restart_tasks, self.restart_tasks = self.restart_tasks, set()
+        restart_tasks, self.restart_tasks = self.restart_tasks, {}
 
         def cb(batch: Batch) -> None:
             for task_id in restart_tasks:
@@ -419,4 +420,4 @@ class Orchestrator:
             self._shutdown_task(batch, t)
             return
         if t.status.state > TaskState.RUNNING:
-            self.restart_tasks.add(t.id)
+            self.restart_tasks[t.id] = None

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..models.objects import Cluster, Node, Service, Task
 from ..models.types import TaskState
@@ -38,13 +38,14 @@ _RECONCILE_TIMER = _metrics.timer(
 
 class Orchestrator:
     def __init__(self, store: MemoryStore,
-                 restarts: Optional[RestartSupervisor] = None):
+                 restarts: Optional[RestartSupervisor] = None,
+                 updater: Optional[UpdateSupervisor] = None):
         self.store = store
         self.restarts = restarts or RestartSupervisor(store)
-        self.updater = UpdateSupervisor(store, self.restarts)
+        self.updater = updater or UpdateSupervisor(store, self.restarts)
         self.cluster: Optional[Cluster] = None
         self.reconcile_services: Dict[str, Service] = {}
-        self.restart_tasks: Set[str] = set()
+        self.restart_tasks: Dict[str, None] = {}   # insertion-ordered set
         self._stop = threading.Event()
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -159,7 +160,7 @@ class Orchestrator:
             return
         if t.status.state > TaskState.RUNNING or \
                 (t.node_id and common.invalid_node(n)):
-            self.restart_tasks.add(t.id)
+            self.restart_tasks[t.id] = None
 
     def _restart_tasks_by_node(self, node_id: str) -> None:
         for t in self.store.view(
@@ -168,7 +169,7 @@ class Orchestrator:
                 continue
             service = self.store.raw_get(Service, t.service_id)
             if common.is_replicated_service(service):
-                self.restart_tasks.add(t.id)
+                self.restart_tasks[t.id] = None
 
     # ----------------------------------------------------------------- ticks
 
@@ -180,7 +181,7 @@ class Orchestrator:
     def _tick_tasks(self) -> None:
         if not self.restart_tasks:
             return
-        restart_tasks, self.restart_tasks = self.restart_tasks, set()
+        restart_tasks, self.restart_tasks = self.restart_tasks, {}
 
         def cb(batch: Batch) -> None:
             for task_id in restart_tasks:
@@ -362,4 +363,4 @@ class Orchestrator:
             return
         if t.status.state > TaskState.RUNNING or \
                 (t.node_id and common.invalid_node(n)):
-            self.restart_tasks.add(t.id)
+            self.restart_tasks[t.id] = None

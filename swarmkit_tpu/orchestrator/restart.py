@@ -258,8 +258,14 @@ class Supervisor:
         """Move new_task READY->RUNNING after the delay elapses and the old
         task stops (or times out).  Returns the completion event
         (reference: restart.go:427 DelayStart)."""
+        # a task that was never assigned has no agent to report its stop
+        # — waiting on it would just burn task_timeout (rolling updates
+        # replacing a still-PENDING restart replacement hit this)
         wait_for_task = (wait_stop and old_task is not None
-                         and old_task.status.state <= TaskState.RUNNING)
+                         and old_task.status.state <= TaskState.RUNNING
+                         and (bool(old_task.node_id)
+                              or old_task.status.state
+                              >= TaskState.ASSIGNED))
         ds = _DelayedStart(
             new_task_id, now() + delay,
             old_task.id if wait_for_task else "",
@@ -301,10 +307,18 @@ class Supervisor:
         step under virtual time."""
         from ..state.watch import Subscription
         if self._sub is None:
+            if self._stopped:
+                return   # deposed: never resubscribe a dead supervisor
             with self._mu:
                 self._ensure_worker_locked()
         while True:
-            ev = self._sub.poll()
+            # re-read per iteration: a stop event's completion write
+            # pumps consensus (virtual time), and a deposal inside that
+            # pump runs stop() re-entrantly, nulling the subscription
+            sub = self._sub
+            if sub is None:
+                return
+            ev = sub.poll()
             if ev is None:
                 break
             if ev is not Subscription.WAKE:
@@ -318,8 +332,11 @@ class Supervisor:
             return False
         obj = ev.obj
         if isinstance(obj, Task):
-            return (ev.action == "update"
-                    and obj.status.state > TaskState.RUNNING)
+            # a deleted task (reaper cleanup) can never report a stop —
+            # release its waiters instead of sitting out task_timeout
+            return (ev.action == "delete"
+                    or (ev.action == "update"
+                        and obj.status.state > TaskState.RUNNING))
         if isinstance(obj, Node):
             return (ev.action == "delete"
                     or (ev.action == "update"

@@ -22,10 +22,16 @@ from .scenario import SCENARIOS, run_scenario
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m swarmkit_tpu.sim")
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--scenario", default="partition-churn",
-                   choices=sorted(SCENARIOS))
+    p.add_argument("--scenario", default=None,
+                   choices=sorted(SCENARIOS),
+                   help="scenario to run (single-run default: "
+                        "partition-churn; fuzz-mode default: rotate "
+                        "seeds through the whole registry pool)")
     p.add_argument("--fuzz", type=int, metavar="N", default=0,
-                   help="run N seeds of the random-fuzz scenario")
+                   help="run N seeds; without --scenario the seeds "
+                        "rotate through every pooled scenario "
+                        "(random-fuzz, failover, rolling-update chaos, "
+                        "legacy raft_cp variants)")
     p.add_argument("--start-seed", type=int, default=0)
     p.add_argument("--managers", type=int, default=3)
     p.add_argument("--agents", type=int, default=5)
@@ -47,12 +53,13 @@ def main(argv=None) -> int:
     if args.fuzz:
         def progress(r):
             mark = "ok" if r.ok else "FAIL"
-            print(f"seed {r.seed:6d} {mark} trace={r.trace_hash[:12]} "
+            print(f"seed {r.seed:6d} {r.scenario:26s} {mark} "
+                  f"trace={r.trace_hash[:12]} "
                   f"obs={r.obs_trace_sha256[:12]} events={r.events}",
                   file=sys.stderr)
 
         reports = fuzz(args.fuzz, start_seed=args.start_seed,
-                       progress=progress)
+                       scenario=args.scenario, progress=progress)
         if args.trace_json:
             for r in reports:
                 path = (args.trace_json if len(reports) == 1
@@ -67,24 +74,25 @@ def main(argv=None) -> int:
             # Chrome span trace — both pure functions of the seed, so two
             # runs of the same command are byte-identical end to end
             "runs": [
-                {"seed": r.seed, "ok": r.ok, "events": r.events,
-                 "trace_hash": r.trace_hash,
+                {"seed": r.seed, "scenario": r.scenario, "ok": r.ok,
+                 "events": r.events, "trace_hash": r.trace_hash,
                  "obs_trace_sha256": r.obs_trace_sha256}
                 for r in reports],
             "failures": [
-                {"seed": r.seed, "violations": r.violations,
+                {"seed": r.seed, "scenario": r.scenario,
+                 "violations": r.violations,
                  # the black box: spans/samples/store events/raft
                  # transitions around the violation, sha-stable per seed
                  "flightrec": r.flightrec_path,
                  "flightrec_sha256": r.flightrec_sha256,
                  "reproduce": f"python -m swarmkit_tpu.sim --seed "
-                              f"{r.seed} --scenario random-fuzz"}
+                              f"{r.seed} --scenario {r.scenario}"}
                 for r in bad],
             "ok": not bad,
         }, indent=2))
         return 1 if bad else 0
 
-    report = run_scenario(args.scenario, args.seed,
+    report = run_scenario(args.scenario or "partition-churn", args.seed,
                           n_managers=args.managers, n_agents=args.agents,
                           keep_trace=args.trace)
     if args.trace:

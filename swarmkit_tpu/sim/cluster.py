@@ -61,7 +61,8 @@ from ..utils.identity import set_id_source
 from .engine import SimEngine
 from .faults import NetConfig, SimNetwork
 from .invariants import (
-    RaftInvariants, TaskInvariants, Violations, entry_digest,
+    RaftInvariants, TaskInvariants, UpdateInvariants, Violations,
+    check_placement_quality, entry_digest,
 )
 
 #: entry-data prefix marking replicated control-plane store actions —
@@ -424,9 +425,26 @@ class SimAgent:
                                     f"{t.id}")
                 continue
             nxt = self.FSM_NEXT.get(state)
-            if nxt is not None:
+            if nxt is None or nxt > t.desired_state:
+                # hold at the desired band: a rolling update stages its
+                # replacement at desired READY until the old task stops
+                # (the restart supervisor then flips desired to RUNNING)
+                continue
+            poison = getattr(self.cp, "poison_versions", None)
+            if (poison and nxt == TaskState.RUNNING
+                    and t.spec_version is not None
+                    and t.spec_version.index in poison):
+                # rollout-poison fault: tasks of a poisoned spec version
+                # die on startup, deterministically — the update
+                # supervisor's failure monitor must pause or roll back
                 updates.append((t.id, TaskStatus(
-                    state=nxt, timestamp=now(), message="sim")))
+                    state=TaskState.FAILED, timestamp=now(),
+                    message="sim poison", err="injected version failure")))
+                self.engine.log(f"fault rollout-poison {self.node_id} "
+                                f"task {t.id}")
+                continue
+            updates.append((t.id, TaskStatus(
+                state=nxt, timestamp=now(), message="sim")))
         if updates:
             try:
                 d.update_task_status(self.node_id, self.session, updates)
@@ -770,21 +788,6 @@ class SimControlPlane:
         self.engine.log(f"restart replaced {len(to_replace)}")
 
 
-class _InertUpdater:
-    """Stand-in for the rolling-update supervisor inside the simulator:
-    the real one spawns one worker thread per service update, which
-    would break the single-threaded determinism contract.  Scale churn
-    and crash/restart replacement — what the failover scenarios
-    exercise — never need it; spec-rollout updates are out of sim scope
-    (covered by tests/test_orchestrator.py against real threads)."""
-
-    def update(self, cluster, service, slots) -> None:
-        return None
-
-    def cancel_all(self) -> None:
-        return None
-
-
 class SimMemberControl:
     """The real control plane cold-started on ONE member's replicated
     store: scheduler, dispatcher, restart supervisor, and the
@@ -798,6 +801,7 @@ class SimMemberControl:
         from ..orchestrator import (
             GlobalOrchestrator, ReplicatedOrchestrator, RestartSupervisor,
         )
+        from ..orchestrator.update import Supervisor as UpdateSupervisor
         self.member = member
         self.cp = cp
         self.detached = False
@@ -823,12 +827,19 @@ class SimMemberControl:
                                    pipeline_depth=1)
         self.scheduler.pipeline.add_filter(
             VolumesFilter(self.scheduler.volumes))
-        self.replicated = ReplicatedOrchestrator(store,
-                                                 restarts=self.restarts)
-        self.global_ = GlobalOrchestrator(store, restarts=self.restarts)
-        inert = _InertUpdater()
-        self.replicated.updater = inert
-        self.global_.updater = inert
+        # REAL rolling-update supervisors in threadless mode: the
+        # orchestrators' reconcile hands dirty slots to them, and
+        # step() pumps their FSMs under virtual time — spec rollouts
+        # (parallelism, delay, monitor window, pause/rollback) run
+        # through consensus exactly like production, zero threads
+        self.replicated = ReplicatedOrchestrator(
+            store, restarts=self.restarts,
+            updater=UpdateSupervisor(store, self.restarts,
+                                     start_worker=False))
+        self.global_ = GlobalOrchestrator(
+            store, restarts=self.restarts,
+            updater=UpdateSupervisor(store, self.restarts,
+                                     start_worker=False))
         # (orchestrator, subscription, tick) driver tuples — the event
         # loops of the real orchestrators, minus their threads
         self._drivers: List[tuple] = []
@@ -890,6 +901,13 @@ class SimMemberControl:
                 elif isinstance(ev, Event):
                     orch._handle_event(ev)
             tick()
+        # pump the rolling-update FSMs (their store writes ride
+        # consensus; a deposal inside one propagates like any other
+        # control write and the caller detaches)
+        for orch in (self.replicated, self.global_):
+            if self.detached:
+                return
+            orch.updater.drive()
         if self.detached:
             return
         self.restarts.drive()
@@ -904,6 +922,14 @@ class SimMemberControl:
         if self.detached:
             return
         self.detached = True
+        for orch in (self.replicated, self.global_):
+            try:
+                # threadless cancel: aborts in-flight rollouts without
+                # store writes; the successor's reconcile resumes them
+                # from the replicated update_status
+                orch.updater.cancel_all()
+            except Exception:
+                pass
         try:
             self.restarts.stop()     # cancels delayed starts; threadless
         except Exception:
@@ -960,6 +986,26 @@ class RaftControlPlane:
         self.desired_replicas = 0
         self._bootstrapped = False
         self.attaches = 0
+        # ---- rolling-update workload surface
+        #: spec versions whose tasks die on startup (rollout-poison
+        #: fault, consumed by SimAgent); healed by Sim.finish
+        self.poison_versions: set = set()
+        #: monotone spec-version mint for rollout(); the bootstrap
+        #: service is version 1
+        self._next_version = 1
+        #: FIFO of not-yet-applied rollouts — a queue, not a slot: a
+        #: rollout minted while an earlier one is still retrying across
+        #: a failover gap must not drop it (its registered expectation
+        #: would turn into a false convergence violation)
+        self._pending_rollouts: List[tuple] = []
+        self.rollouts = 0
+        #: scenario-registered convergence expectations, judged at
+        #: finish against the merged update-state history:
+        #: (version, frozenset of UpdateState ints, by_virtual_ts, label)
+        self.update_expectations: List[tuple] = []
+        #: opt-in post-convergence placement-quality bound (see
+        #: invariants.check_placement_quality); None disables
+        self.placement_quality_bound: Optional[float] = None
         self._dispatcher_totals = {"heartbeats": 0, "expirations": 0}
         self.proposers: Dict[str, SimRaftProposer] = {}
         for m in sim.managers:
@@ -971,6 +1017,11 @@ class RaftControlPlane:
         # per-member-store task invariants (rebuilt when a restart
         # replaces the store object)
         self._inv: Dict[str, tuple] = {}
+        # update-state history outlives checker replacement: a member
+        # whose store was crash-rebuilt gets a fresh checker, but the
+        # states its old checker observed still count toward the
+        # convergence expectations
+        self._update_history: List[tuple] = []
         self.agents: List[SimAgent] = [
             SimAgent(f"w{i}", self) for i in range(n_agents)]
         engine.every(control_interval, "control step", self.control_step)
@@ -1055,14 +1106,20 @@ class RaftControlPlane:
 
     # --------------------------------------------------------- control step
 
-    def _checker_for(self, m: SimManager) -> Optional[TaskInvariants]:
+    def _checker_for(self, m: SimManager) -> Optional[tuple]:
+        """(TaskInvariants, UpdateInvariants) for a member's replicated
+        store, rebuilt when a restart replaces the store object."""
         if m.store is None:
             return None
         entry = self._inv.get(m.id)
         if entry is None or entry[0] is not m.store:
-            entry = (m.store, TaskInvariants(self.violations, m.store))
+            if entry is not None:
+                self._update_history.extend(entry[2].history)
+            entry = (m.store,
+                     TaskInvariants(self.violations, m.store),
+                     UpdateInvariants(self.violations, m.store, tag=m.id))
             self._inv[m.id] = entry
-        return entry[1]
+        return entry[1:]
 
     def drain_deferred(self) -> None:
         """Apply any backlog of committed-but-deferred entries on the
@@ -1117,12 +1174,13 @@ class RaftControlPlane:
                     f"control step aborted: {type(e).__name__}")
             finally:
                 self.busy = False
-        # drain the per-store task invariants (single-threaded: nothing
-        # is in flight between control steps)
+        # drain the per-store task + update invariants (single-threaded:
+        # nothing is in flight between control steps)
         for m in sim.managers:
-            inv = self._checker_for(m)
-            if inv is not None:
-                inv.drain()
+            checkers = self._checker_for(m)
+            if checkers is not None:
+                for inv in checkers:
+                    inv.drain()
         return None
 
     # -------------------------------------------------------------- workload
@@ -1144,6 +1202,21 @@ class RaftControlPlane:
                             resources=Resources(nano_cpus=8 * 10 ** 9,
                                                 memory_bytes=32 << 30))))
             if tx.get(Service, "svc-sim") is None:
+                from ..models.types import (
+                    UpdateConfig, UpdateFailureAction,
+                )
+                # virtual-time-sized update/rollback knobs: a ROLLBACK
+                # runs under the RESTORED spec's rollback config
+                # (reference behavior), so the base spec must carry one
+                # or rollbacks crawl at the 30s-monitor defaults.  The
+                # rollback cadence pushes through churn (CONTINUE):
+                # chaos-injected task failures during a rollback would
+                # otherwise trip the threshold and PAUSE it (a rollback
+                # never rolls back), turning unlucky seeds into
+                # convergence-bound "violations" that are really
+                # correct FSM behavior
+                cadence = dict(parallelism=3, delay=0.2, monitor=1.5,
+                               max_failure_ratio=0.2)
                 tx.create(Service(
                     id="svc-sim",
                     spec=ServiceSpec(
@@ -1151,7 +1224,11 @@ class RaftControlPlane:
                         mode=ServiceMode.REPLICATED,
                         replicated=ReplicatedService(
                             replicas=self.desired_replicas),
-                        task=TaskSpec()),
+                        task=TaskSpec(),
+                        update=UpdateConfig(**cadence),
+                        rollback=UpdateConfig(
+                            failure_action=UpdateFailureAction.CONTINUE,
+                            **cadence)),
                     spec_version=Version(index=1)))
         store.update(cb)
         self._bootstrapped = True
@@ -1197,6 +1274,124 @@ class RaftControlPlane:
         (the orchestrator creates the tasks — ids are deterministic via
         the sim's id source)."""
         self.scale(self.desired_replicas + n)
+
+    # --------------------------------------------------------- spec rollouts
+
+    def rollout(self, image: str, update=None, rollback=None,
+                poison: bool = False) -> int:
+        """Spec-bump the sim service to ``image`` through the leader
+        store (controlapi.update_service shape: previous spec saved,
+        spec version minted, update_status cleared) — the replicated
+        orchestrator's UpdateSupervisor then rolls the slots over.
+        ``poison=True`` marks the minted version so agents fail its
+        tasks on startup (exercising pause/rollback).  Retries across
+        failover gaps; returns the minted spec version index."""
+        self._next_version += 1
+        version = self._next_version
+        if poison:
+            self.poison_versions.add(version)
+        self._pending_rollouts.append((image, version, update, rollback))
+        self.rollouts += 1
+        self.engine.log(f"workload rollout {image} v{version}"
+                        + (" poisoned" if poison else ""))
+        self._rollout_step()
+        return version
+
+    def _rollout_step(self) -> None:
+        if not self._pending_rollouts or self.stopped:
+            return
+        pending = self._pending_rollouts[0]
+        image, version, update, rollback = pending
+        mc = self.active
+        if (mc is None or mc.detached or self.busy
+                or not self._bootstrapped):
+            self.engine.after(0.5, "rollout retry", self._rollout_step)
+            return
+        self.busy = True
+        try:
+            def cb(tx):
+                svc = tx.get(Service, "svc-sim")
+                if svc is None:
+                    return
+                if svc.spec_version and svc.spec_version.index >= version:
+                    return   # already applied (idempotent retry)
+                svc = svc.copy()
+                old_spec = svc.spec
+                spec = old_spec.copy()
+                spec.task = spec.task.copy()
+                from ..models.specs import ContainerSpec
+                spec.task.container = ContainerSpec(image=image)
+                if update is not None:
+                    spec.update = update
+                if rollback is not None:
+                    spec.rollback = rollback
+                svc.previous_spec = old_spec
+                svc.previous_spec_version = svc.spec_version
+                svc.spec = spec
+                svc.spec_version = Version(index=version)
+                svc.update_status = None
+                tx.update(svc)
+            mc.store.update(cb)
+            if self._pending_rollouts \
+                    and self._pending_rollouts[0] is pending:
+                self._pending_rollouts.pop(0)
+            self.engine.log(f"workload rollout applied v{version}")
+            if self._pending_rollouts:
+                # a queued successor (minted during a failover gap)
+                # applies on its own step, not inside this one's
+                # busy window
+                self.engine.after(0.0, "rollout next", self._rollout_step)
+        except AGENT_RPC_ERRORS as e:
+            self.engine.log(f"workload rollout failed: {type(e).__name__}")
+            self.engine.after(0.5, "rollout retry", self._rollout_step)
+        finally:
+            self.busy = False
+
+    def expect_update(self, version: int, states, by: float,
+                      label: str = "update-convergence-within-bound"
+                      ) -> None:
+        """Register a convergence bound: version must be observed in one
+        of ``states`` (UpdateState values) by virtual time ``by``."""
+        self.update_expectations.append(
+            (version, frozenset(int(s) for s in states), by, label))
+
+    # ----------------------------------------------------- end-state checks
+
+    def _update_checkers(self) -> List[UpdateInvariants]:
+        return [entry[2] for entry in self._inv.values()]
+
+    def merged_update_history(self) -> List[tuple]:
+        """Archived history (from crash-replaced checkers) + every live
+        checker's — the single source both finish-time judging and the
+        stats report read."""
+        history = list(self._update_history)
+        history.extend(h for c in self._update_checkers()
+                       for h in c.history)
+        return history
+
+    def check_end_state(self, violations: Violations) -> None:
+        """Finish-time checks: flush deferred completion checks, judge
+        the registered convergence expectations against the merged
+        per-member histories (any member observing a state counts —
+        a crash-rebuilt store starts a fresh history), and apply the
+        opt-in placement-quality bound."""
+        for c in self._update_checkers():
+            c.finalize()
+        history = self.merged_update_history()
+        for version, states, by, label in self.update_expectations:
+            hit = [h for h in history
+                   if h[2] == version and h[3] in states and h[0] <= by]
+            if not hit:
+                seen = sorted({(h[2], h[3]) for h in history})
+                violations.record(
+                    label,
+                    f"version {version} never reached states {sorted(states)} "
+                    f"by t={by:.1f} (observed (version,state) pairs: "
+                    f"{seen})")
+        if self.placement_quality_bound is not None \
+                and self.store is not None:
+            check_placement_quality(violations, self.store,
+                                    self.placement_quality_bound)
 
 
 class Sim:
@@ -1300,6 +1495,10 @@ class Sim:
         to converge, then run end-state checks."""
         self.finishing = True
         self.net.heal_all()
+        # rollout-poison heals with every other fault: replacements of
+        # the once-poisoned version may now start, so a paused update
+        # settles instead of churning failed restarts through the grace
+        getattr(self.cp, "poison_versions", set()).clear()
         for m in self.managers:
             m.tick_scale = 1.0
             if not m.alive:
@@ -1347,6 +1546,7 @@ class Sim:
                         "failover-replacement",
                         f"{len(stuck)} tasks still unplaced after "
                         "heal+grace")
+            self.cp.check_end_state(self.violations)
 
     # ----------------------------------------------------------------- stats
 
@@ -1376,6 +1576,10 @@ class Sim:
             "expirations": disp.get("expirations", 0),
         }
         if isinstance(self.cp, RaftControlPlane):
+            from ..models.types import UpdateState
+            states = sorted({UpdateState(h[3]).name
+                             for h in self.cp.merged_update_history()
+                             if h[3] >= 0})
             out["control"] = {
                 "attaches": self.cp.attaches,
                 "stale_epoch_rejects": sum(
@@ -1385,5 +1589,7 @@ class Sim:
                                 for p in self.cp.proposers.values()),
                 "committed": sum(p.stats["committed"]
                                  for p in self.cp.proposers.values()),
+                "rollouts": self.cp.rollouts,
+                "update_states": states,
             }
         return out

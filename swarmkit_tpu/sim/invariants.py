@@ -19,15 +19,34 @@ Control-plane layer (checked against the leader store's event stream):
 * no double assignment — a task's node_id never changes once set
 * blocks are never failures — EventTaskBlock only ever carries
   assignment-band states (<= RUNNING), by contract
+
+Rolling-update layer (``UpdateInvariants``, stream-ordered like
+``TaskInvariants``; quality-not-just-safety framing per PAPERS.md
+2302.05446 — the control plane must bound convergence and placement
+quality under perturbation, not merely avoid unsafe states):
+
+* no-mixed-version-after-completion — once an update reports COMPLETED
+  (and a short settle absorbs racing restarts), every task slated to
+  keep running carries the completed spec version
+* rollback-restores-old-spec-everywhere — the same check at
+  ROLLBACK_COMPLETED against the restored version
+* pause-on-failure-threshold — a paused update must stop claiming new
+  slots for the paused version
+* update-convergence-within-bound — scenario-registered expectations
+  (``RaftControlPlane.expect_update``) judged against the observed
+  update-state history at finish
+* placement-quality (``check_placement_quality``) — post-convergence,
+  running tasks may not pile onto one node beyond a bound of the ideal
+  even spread
 """
 
 from __future__ import annotations
 
 import hashlib
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
-from ..models.objects import Node, Task
-from ..models.types import NodeState, TaskState, TERMINAL_STATES
+from ..models.objects import Node, Service, Task
+from ..models.types import NodeState, TaskState, TERMINAL_STATES, UpdateState
 from ..state.events import Event, EventTaskBlock
 
 
@@ -110,6 +129,20 @@ class TaskInvariants:
         self.sub = store.queue.subscribe(
             lambda ev: isinstance(ev, (Event, EventTaskBlock)),
             accepts_blocks=True)
+        # adopt the store's committed rows as the baseline: a checker
+        # attached to a crash-rebuilt store replays no history, and
+        # judging a pre-existing assignment as a fresh transition against
+        # a later-arriving node-DOWN event manufactures false positives
+        # (single-threaded: nothing commits between subscribe and seed)
+        def seed(tx):
+            for n in tx.find(Node):
+                self.node_states[n.id] = int(n.status.state)
+            for t in tx.find(Task):
+                self.states[t.id] = int(t.status.state)
+                self.desired[t.id] = int(t.desired_state)
+                if t.node_id:
+                    self.node_of[t.id] = t.node_id
+        store.view(seed)
 
     def drain(self) -> None:
         while True:
@@ -206,3 +239,239 @@ class TaskInvariants:
                         "assigned-node-live",
                         f"task {task_id[:8]} ASSIGNED to missing node "
                         f"{node_id[:8] if node_id else '<none>'}")
+
+
+class UpdateInvariants:
+    """Rolling-update invariants, tracked from one store's ordered event
+    stream (payloads only — the same discipline as TaskInvariants: a
+    member draining behind a catch-up burst must never be judged against
+    rows newer than the event in hand).
+
+    Completion checks are deferred by ``SETTLE`` virtual seconds: a
+    restart racing the updater can legitimately leave one old-version
+    task for a beat after COMPLETED lands (the next reconcile's updater
+    converges it — reference behavior).  A deferred check is dropped
+    when the service's spec version moved on (a newer rollout owns the
+    slots now); ``finalize()`` evaluates whatever is still pending at
+    scenario end regardless of settle.
+    """
+
+    #: virtual seconds a completion check waits before judging
+    SETTLE = 15.0
+
+    def __init__(self, violations: Violations, store, tag: str = ""):
+        self.v = violations
+        self.store = store
+        self.tag = tag
+        # task id -> immutable spec version index (0 = unversioned)
+        self.task_version: Dict[str, int] = {}
+        self.task_desired: Dict[str, int] = {}
+        self.task_service: Dict[str, str] = {}
+        self.task_slot: Dict[str, tuple] = {}
+        self.svc_version: Dict[str, int] = {}
+        self.svc_state: Dict[str, int] = {}      # UpdateState int; -1 = none
+        # sid -> the version a ROLLBACK_STARTED transition rolled back
+        # FROM (the restored spec hides it, but expectations are
+        # registered against the minted rollout version)
+        self._rollback_of: Dict[str, int] = {}
+        # sid -> {"version": paused rollout version, "slots": claimed set}
+        self.paused: Dict[str, dict] = {}
+        #: (t, sid, version, UpdateState int) — every observed transition
+        self.history: List[tuple] = []
+        #: deferred completion checks: (due_t, sid, version, name)
+        self._pending_checks: List[tuple] = []
+        self.sub = store.queue.subscribe(
+            lambda ev: isinstance(ev, Event)
+            and isinstance(ev.obj, (Task, Service)),
+            accepts_blocks=True)
+        # baseline adoption (see TaskInvariants): a crash-rebuilt store
+        # replays no history, so seed tasks and service update states
+        # from the committed rows — including a paused rollout's claimed
+        # slots, so pause-on-failure-threshold keeps enforcing
+        def seed(tx):
+            for t in tx.find(Task):
+                self.task_version[t.id] = \
+                    t.spec_version.index if t.spec_version else 0
+                self.task_desired[t.id] = int(t.desired_state)
+                self.task_service[t.id] = t.service_id
+                self.task_slot[t.id] = (t.slot, t.node_id)
+            for s in tx.find(Service):
+                version = s.spec_version.index if s.spec_version else 0
+                state = int(s.update_status.state) if s.update_status \
+                    else -1
+                self.svc_version[s.id] = version
+                self.svc_state[s.id] = state
+                if state in (int(UpdateState.PAUSED),
+                             int(UpdateState.ROLLBACK_PAUSED)):
+                    # claimed keys carry CREATE-time node ids on the
+                    # event path (replicated replacements are minted
+                    # with node_id "" before assignment), but committed
+                    # rows are already assigned — seed both shapes so a
+                    # legitimate restart replacement in an
+                    # already-claimed slot never reads as a fresh claim
+                    claimed = set()
+                    for tid, v in self.task_version.items():
+                        if v == version \
+                                and self.task_service.get(tid) == s.id:
+                            slot_key = self.task_slot[tid]
+                            claimed.add(slot_key)
+                            claimed.add((slot_key[0], ""))
+                    self.paused[s.id] = {"version": version,
+                                         "slots": claimed}
+        store.view(seed)
+
+    # ---------------------------------------------------------------- drain
+
+    def _now(self) -> float:
+        return self.v.engine.clock.elapsed()
+
+    def drain(self) -> None:
+        while True:
+            ev = self.sub.poll()
+            if ev is None:
+                break
+            obj = ev.obj
+            if isinstance(obj, Task):
+                self._observe_task(ev.action, obj)
+            elif isinstance(obj, Service):
+                self._observe_service(ev.action, obj)
+        self._run_due_checks(self._now())
+
+    def _observe_task(self, action: str, t: Task) -> None:
+        if action == "delete":
+            self.task_version.pop(t.id, None)
+            self.task_desired.pop(t.id, None)
+            self.task_service.pop(t.id, None)
+            self.task_slot.pop(t.id, None)
+            return
+        if action == "create":
+            version = t.spec_version.index if t.spec_version else 0
+            self.task_version[t.id] = version
+            self.task_service[t.id] = t.service_id
+            self.task_slot[t.id] = (t.slot, t.node_id)
+            self._check_pause_progress(t, version)
+        self.task_desired[t.id] = int(t.desired_state)
+
+    def _observe_service(self, action: str, s: Service) -> None:
+        if action == "delete":
+            self.svc_version.pop(s.id, None)
+            self.svc_state.pop(s.id, None)
+            self.paused.pop(s.id, None)
+            return
+        version = s.spec_version.index if s.spec_version else 0
+        state = int(s.update_status.state) if s.update_status else -1
+        prev_state = self.svc_state.get(s.id, -1)
+        prev_version = self.svc_version.get(s.id)
+        self.svc_version[s.id] = version
+        self.svc_state[s.id] = state
+        if state == prev_state and version == prev_version:
+            return
+        self.history.append((self._now(), s.id, version, state))
+        if state == int(UpdateState.ROLLBACK_STARTED) \
+                and prev_version is not None and prev_version != version:
+            self._rollback_of[s.id] = prev_version
+        rb = self._rollback_of.get(s.id)
+        if rb is not None and state in (int(UpdateState.ROLLBACK_STARTED),
+                                        int(UpdateState.ROLLBACK_PAUSED),
+                                        int(UpdateState.ROLLBACK_COMPLETED)):
+            # mirror rollback states onto the rolled-back version so
+            # expect_update(minted_version, ROLLBACK_COMPLETED) matches
+            self.history.append((self._now(), s.id, rb, state))
+        elif rb is not None and (state == -1 or version > rb):
+            self._rollback_of.pop(s.id, None)
+        if state != prev_state:
+            if state in (int(UpdateState.COMPLETED),
+                         int(UpdateState.ROLLBACK_COMPLETED)):
+                name = ("rollback-restores-old-spec-everywhere"
+                        if state == int(UpdateState.ROLLBACK_COMPLETED)
+                        else "no-mixed-version-after-completion")
+                self._pending_checks.append(
+                    (self._now() + self.SETTLE, s.id, version, name))
+            if state in (int(UpdateState.PAUSED),
+                         int(UpdateState.ROLLBACK_PAUSED)):
+                self.paused[s.id] = {
+                    "version": version,
+                    "slots": {self.task_slot[tid]
+                              for tid, v in self.task_version.items()
+                              if v == version
+                              and self.task_service.get(tid) == s.id
+                              and tid in self.task_slot}}
+            else:
+                self.paused.pop(s.id, None)
+
+    # -------------------------------------------------------------- checks
+
+    def _check_pause_progress(self, t: Task, version: int) -> None:
+        """A paused update must not claim NEW slots for the paused
+        version.  Restart replacements in already-claimed slots are
+        legitimate (pausing stops the rollout, not restart management)."""
+        p = self.paused.get(t.service_id)
+        if p is None or version != p["version"]:
+            return
+        key = (t.slot, t.node_id)
+        if key in p["slots"]:
+            return
+        p["slots"].add(key)   # record once per slot
+        self.v.record(
+            "pause-on-failure-threshold",
+            f"{self.tag}: service {t.service_id} claimed new slot "
+            f"{key} for version {version} while the update is paused")
+
+    def _run_due_checks(self, ts: float) -> None:
+        still = []
+        for due, sid, version, name in self._pending_checks:
+            if ts < due:
+                still.append((due, sid, version, name))
+                continue
+            self._judge_completion(sid, version, name)
+        self._pending_checks = still
+
+    def _judge_completion(self, sid: str, version: int, name: str) -> None:
+        if self.svc_version.get(sid) != version:
+            return   # a newer rollout owns the slots now
+        mixed = [
+            tid for tid, v in self.task_version.items()
+            if self.task_service.get(tid) == sid and v != version
+            and self.task_desired.get(tid, 0) <= int(TaskState.RUNNING)]
+        if mixed:
+            self.v.record(
+                name,
+                f"{self.tag}: service {sid} completed at version "
+                f"{version} but {len(mixed)} live task(s) carry other "
+                f"versions (e.g. {sorted(mixed)[:3]})")
+
+    def finalize(self) -> None:
+        """Scenario end: judge every still-pending completion check —
+        the end state must be clean regardless of settle windows."""
+        self.drain()
+        for _due, sid, version, name in self._pending_checks:
+            self._judge_completion(sid, version, name)
+        self._pending_checks = []
+
+
+def check_placement_quality(violations: Violations, store,
+                            bound: float = 3.0,
+                            record: str = "placement-quality") -> None:
+    """Post-convergence placement-quality bound: with every fault healed,
+    no live node may hold more than ``bound`` times the ideal even share
+    of the RUNNING tasks (quality, not just safety — a converged-but-
+    pathological packing is a scheduler regression chaos must catch)."""
+    tasks = [t for t in store.view(lambda tx: tx.find(Task))
+             if t.node_id
+             and t.desired_state == TaskState.RUNNING
+             and TaskState(t.status.state) == TaskState.RUNNING]
+    nodes = [n for n in store.view(lambda tx: tx.find(Node))
+             if n.status.state != NodeState.DOWN]
+    if not tasks or not nodes or len(tasks) < len(nodes):
+        return   # too sparse for a spread claim
+    per_node: Dict[str, int] = {}
+    for t in tasks:
+        per_node[t.node_id] = per_node.get(t.node_id, 0) + 1
+    ideal = len(tasks) / len(nodes)
+    worst = max(per_node.items(), key=lambda kv: (kv[1], kv[0]))
+    if worst[1] > bound * ideal:
+        violations.record(
+            record,
+            f"node {worst[0]} runs {worst[1]} of {len(tasks)} tasks "
+            f"(ideal {ideal:.1f}/node across {len(nodes)} live nodes, "
+            f"bound {bound:.1f}x)")

@@ -920,6 +920,250 @@ def _failover_churn_rollout(sim: Sim) -> float:
 _failover_churn_rollout.raft_cp = True
 
 
+# ----------------------------------------------- rolling-update scenarios
+#
+# The UpdateSupervisor is live inside the raft-attached control plane
+# (threadless drive mode): these scenarios run REAL spec rollouts —
+# parallelism, per-batch delay, monitor window, failure pause/rollback —
+# under partitions, crashes and churn, with convergence and version
+# invariants on top of the shared checkers (UpdateInvariants,
+# expect_update, placement quality).
+
+
+def _update_cfg(action, parallelism=3, delay=0.2, monitor=1.5,
+                ratio=0.2):
+    from ..models.types import UpdateConfig
+    return UpdateConfig(parallelism=parallelism, delay=delay,
+                        monitor=monitor, max_failure_ratio=ratio,
+                        failure_action=action)
+
+
+def _rolling_upgrade_chaos(sim: Sim) -> float:
+    """Rolling spec updates under chaos: a good rollout rides a leader
+    stepdown + leader partition (the in-flight rollout hands off to the
+    successor), a poisoned rollout triggers automatic rollback, and a
+    second poisoned rollout pauses at the failure threshold — each leg
+    bounded by update-convergence invariants, with agent churn and a
+    drop burst along the way."""
+    from ..models.types import UpdateFailureAction, UpdateState
+    eng = sim.engine
+    cp = sim.cp
+    sim.start_raft_workload(interval=0.8)
+    cp.scale(6)
+    cp.placement_quality_bound = 4.0
+
+    # leg 1: good rollout, CONTINUE action, leader churn mid-rollout
+    def leg1():
+        v = cp.rollout("img:2", update=_update_cfg(
+            UpdateFailureAction.CONTINUE))
+        cp.expect_update(v, (UpdateState.COMPLETED,), 55.0)
+    eng.at(eng.clock.start + 8.0, "rollout good", leg1)
+    eng.at(eng.clock.start + 11.0, "stepdown mid-rollout",
+           sim.stepdown_leader)
+
+    def partition_leader():
+        m = sim.leader()
+        if m is None:
+            return
+        sim.net.isolate(m.id)
+        eng.after(4.0, "heal leader partition",
+                  lambda: sim.net.rejoin(m.id))
+    eng.at(eng.clock.start + 16.0, "partition leader mid-rollout",
+           partition_leader)
+
+    # leg 2: poisoned rollout -> automatic rollback restores the old spec
+    def leg2():
+        v = cp.rollout("img:bad-rb", poison=True, update=_update_cfg(
+            UpdateFailureAction.ROLLBACK))
+        cp.expect_update(v, (UpdateState.ROLLBACK_COMPLETED,), 100.0)
+    eng.at(eng.clock.start + 45.0, "rollout poisoned (rollback)", leg2)
+
+    # leg 3: poisoned rollout with PAUSE -> halts at the threshold
+    def leg3():
+        v = cp.rollout("img:bad-pause", poison=True, update=_update_cfg(
+            UpdateFailureAction.PAUSE))
+        cp.expect_update(v, (UpdateState.PAUSED,), 110.0)
+    eng.at(eng.clock.start + 80.0, "rollout poisoned (pause)", leg3)
+
+    # background churn
+    a = cp.agents
+    eng.at(eng.clock.start + 20.0, "agent crash", a[2].crash)
+    eng.at(eng.clock.start + 30.0, "agent restart", a[2].restart)
+    eng.at(eng.clock.start + 50.0, "drop burst",
+           lambda: setattr(sim.net.config, "drop_p", 0.1))
+    eng.at(eng.clock.start + 58.0, "drop off",
+           lambda: setattr(sim.net.config, "drop_p", 0.0))
+    eng.at(eng.clock.start + 62.0, "agent partition",
+           lambda: a[4].partition(True))
+    eng.at(eng.clock.start + 72.0, "agent heal",
+           lambda: a[4].partition(False))
+    return 100.0
+
+
+_rolling_upgrade_chaos.raft_cp = True
+
+
+def _cascading_failure_rebalance(sim: Sim) -> float:
+    """Sequential node deaths during a rebalance: a scale-up lands while
+    nodes die one after another (heartbeat TTL -> DOWN -> restart
+    supervisor re-places), a leader crash rides the cascade, and the
+    post-convergence placement must still be balanced (quality bound),
+    not just complete."""
+    eng = sim.engine
+    cp = sim.cp
+    sim.start_raft_workload(interval=0.8)
+    cp.scale(6)
+    cp.placement_quality_bound = 3.5
+
+    eng.at(eng.clock.start + 8.0, "scale up (rebalance)",
+           lambda: cp.scale(14))
+    a = cp.agents
+    # the cascade: one death every ~6s while the scale-up places
+    eng.at(eng.clock.start + 10.0, "node death w0", a[0].crash)
+    eng.at(eng.clock.start + 16.0, "node death w1", a[1].crash)
+    eng.at(eng.clock.start + 22.0, "node death w2", a[2].crash)
+    eng.at(eng.clock.start + 21.0, "node return w0", a[0].restart)
+    eng.at(eng.clock.start + 28.0, "node return w1", a[1].restart)
+    eng.at(eng.clock.start + 34.0, "node return w2", a[2].restart)
+
+    def crash_leader():
+        m = sim.leader()
+        if m is None:
+            return
+        m.crash()
+        eng.after(6.0, "restart ex-leader", m.restart)
+    eng.at(eng.clock.start + 18.0, "crash leader mid-cascade",
+           crash_leader)
+
+    eng.at(eng.clock.start + 26.0, "scale down", lambda: cp.scale(10))
+    eng.at(eng.clock.start + 32.0, "scale up again",
+           lambda: cp.scale(16))
+    return 48.0
+
+
+_cascading_failure_rebalance.raft_cp = True
+
+
+def _long_soak(sim: Sim) -> float:
+    """Long-horizon virtual-time soak: repeated rollouts (every third
+    one poisoned and rolled back) over continuous mixed churn — agent
+    crash/partition cycles, manager crash/restart, leader stepdowns,
+    partitions, drop bursts, scale oscillation.  Default duration is
+    ``SWARM_SOAK_VIRTUAL_SECONDS`` (1200 = 20 virtual minutes; crank it
+    for multi-day soaks — the event budget scales with it).  Every good
+    rollout must converge within its bound and the end placement must
+    meet the quality bound."""
+    from ..models.types import UpdateFailureAction, UpdateState
+    eng = sim.engine
+    cp = sim.cp
+    duration = float(os.environ.get("SWARM_SOAK_VIRTUAL_SECONDS", "1200"))
+    sim.engine.max_events = max(sim.engine.max_events,
+                                int(duration) * 2000)
+    sim.start_raft_workload(interval=0.9)
+    cp.scale(8)
+    cp.placement_quality_bound = 4.0
+    rng = eng.fork_rng()
+    counter = {"n": 0}
+
+    def rollout_cycle():
+        if sim.finishing:
+            return False
+        if eng.clock.elapsed() > duration - 120.0:
+            return False   # last rollout must fit its convergence bound
+        counter["n"] += 1
+        n = counter["n"]
+        if n % 3 == 0:
+            v = cp.rollout(f"img:bad-{n}", poison=True,
+                           update=_update_cfg(
+                               UpdateFailureAction.ROLLBACK))
+            cp.expect_update(v, (UpdateState.ROLLBACK_COMPLETED,),
+                             eng.clock.elapsed() + 110.0)
+        else:
+            v = cp.rollout(f"img:{n}", update=_update_cfg(
+                UpdateFailureAction.CONTINUE))
+            cp.expect_update(v, (UpdateState.COMPLETED,),
+                             eng.clock.elapsed() + 110.0)
+        return None
+    eng.every(120.0, "soak rollout", rollout_cycle, phase=15.0)
+
+    def agent_churn():
+        if sim.finishing:
+            return False
+        up = [a for a in cp.agents if a.alive]
+        if len(up) > 3:
+            victim = rng.choice(up)
+            victim.crash()
+            eng.after(10.0 + rng.random() * 10.0, "soak agent restart",
+                      victim.restart)
+        return None
+    eng.every(45.0, "soak agent churn", agent_churn, phase=25.0)
+
+    def manager_churn():
+        if sim.finishing:
+            return False
+        alive = [m for m in sim.managers if m.alive]
+        if len(alive) <= 2:
+            return None
+        victim = rng.choice(alive)
+        victim.crash()
+        eng.after(5.0 + rng.random() * 5.0,
+                  f"soak restart {victim.id}", victim.restart)
+        return None
+    eng.every(140.0, "soak manager churn", manager_churn, phase=70.0)
+
+    def partition_cycle():
+        if sim.finishing:
+            return False
+        mids = [m.id for m in sim.managers]
+        lone = rng.choice(mids)
+        sim.net.split([lone], [m for m in mids if m != lone])
+        eng.after(4.0 + rng.random() * 4.0, "soak heal", sim.net.heal_all)
+        return None
+    eng.every(90.0, "soak partition", partition_cycle, phase=40.0)
+
+    def stepdown():
+        if sim.finishing:
+            return False
+        sim.stepdown_leader()
+        return None
+    eng.every(200.0, "soak stepdown", stepdown, phase=100.0)
+
+    def drop_burst():
+        if sim.finishing:
+            return False
+        sim.net.config.drop_p = 0.05 + rng.random() * 0.1
+        eng.after(3.0 + rng.random() * 4.0, "soak drops off",
+                  lambda: setattr(sim.net.config, "drop_p", 0.0))
+        return None
+    eng.every(150.0, "soak drop burst", drop_burst, phase=60.0)
+
+    def scale_wobble():
+        if sim.finishing:
+            return False
+        cp.scale(6 + (counter["n"] % 3) * 2)
+        return None
+    eng.every(160.0, "soak scale wobble", scale_wobble, phase=130.0)
+    return duration
+
+
+_long_soak.raft_cp = True
+
+
+def _raft_cp_variant(fn: Callable[[Sim], float],
+                     base: str) -> Callable[[Sim], float]:
+    """Route a legacy standalone-control-plane scenario through the
+    raft-attached control plane: same fault timeline, but the real
+    scheduler/dispatcher/orchestrators/updater run on the elected
+    leader's replicated store, under the failover invariants too."""
+    def scenario(sim: Sim) -> float:
+        return fn(sim)
+    scenario.raft_cp = True
+    scenario.__doc__ = (f"'{base}' driven through the raft-attached "
+                        "control plane (Sim(raft_cp=True)): "
+                        + (fn.__doc__ or "").strip())
+    return scenario
+
+
 SCENARIOS: Dict[str, Callable[[Sim], float]] = {
     "partition-churn": _partition_churn,
     "crash-leader-mid-commit": _crash_leader_mid_commit,
@@ -936,14 +1180,46 @@ SCENARIOS: Dict[str, Callable[[Sim], float]] = {
     "partition-pipelined-commit": _mk_partition_pipelined_commit(2),
     "partition-pipelined-commit-d1": _mk_partition_pipelined_commit(1),
     "failover-churn-rollout": _failover_churn_rollout,
+    # rolling-update suite (real UpdateSupervisor, threadless drive)
+    "rolling-upgrade-chaos": _rolling_upgrade_chaos,
+    "cascading-failure-rebalance": _cascading_failure_rebalance,
+    "long-soak": _long_soak,
+    # legacy scenarios routed through the raft-attached control plane
+    "partition-churn-rcp": _raft_cp_variant(_partition_churn,
+                                            "partition-churn"),
+    "crash-restart-churn-rcp": _raft_cp_variant(_crash_restart_churn,
+                                                "crash-restart-churn"),
+    "agent-storm-rcp": _raft_cp_variant(_agent_storm, "agent-storm"),
 }
 
-#: the failover sweep scripts/failover_fuzz.py seed-sweeps
+#: the failover sweep scripts/chaos_sweep.py seed-sweeps by default
 FAILOVER_SCENARIOS = (
     "leader-crash-mid-tick", "leader-crash-mid-tick-d1",
     "partition-pipelined-commit", "partition-pipelined-commit-d1",
     "failover-churn-rollout",
 )
+
+#: rolling-update chaos suite (ISSUE 8)
+UPDATE_SCENARIOS = (
+    "rolling-upgrade-chaos", "cascading-failure-rebalance", "long-soak",
+)
+
+#: legacy fault timelines re-driven through Sim(raft_cp=True)
+LEGACY_RCP_SCENARIOS = (
+    "partition-churn-rcp", "crash-restart-churn-rcp", "agent-storm-rcp",
+)
+
+#: scenarios the seed-rotating fuzzers (``python -m swarmkit_tpu.sim
+#: --fuzz`` without --scenario, and chaos_sweep --suite fuzz) draw from.
+#: Every registry entry must be here or in FUZZ_EXCLUDED with a reason —
+#: tests/test_update_chaos.py enforces the parity, so a new scenario
+#: cannot silently lag fuzz coverage.
+FUZZ_EXCLUDED: Dict[str, str] = {
+    "long-soak": "minutes of virtual time per run; swept by the "
+                 "dedicated slow soak test, not per-seed rotation",
+}
+FUZZ_POOL: tuple = tuple(
+    sorted(n for n in SCENARIOS if n not in FUZZ_EXCLUDED))
 
 
 # ------------------------------------------------------------------ runner

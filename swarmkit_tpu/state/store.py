@@ -364,9 +364,13 @@ class _Table:
     def __init__(self) -> None:
         self.objects: Dict[str, Any] = {}
         self.by_name: Dict[str, str] = {}            # lower(name) -> id
-        self.by_service: Dict[str, set] = {}          # tasks/volumes refcounts
-        self.by_node: Dict[str, set] = {}
-        self.by_slot: Dict[Tuple[str, int], set] = {}
+        # index buckets are insertion-ordered {id: None} dicts, NOT
+        # sets: indexed find() results feed placement decisions, and set
+        # iteration order varies with hash randomization — per-process
+        # nondeterminism the sim's byte-identical-report contract forbids
+        self.by_service: Dict[str, Dict[str, None]] = {}   # tasks/volumes
+        self.by_node: Dict[str, Dict[str, None]] = {}
+        self.by_slot: Dict[Tuple[str, int], Dict[str, None]] = {}
         # columnar task-block overlay: id -> (node_id, version, ts, state,
         # message).  A block commit records assignments here instead of
         # materializing per-task objects; reads materialize lazily (see
@@ -779,18 +783,18 @@ class MemoryStore:
     def _index(self, table: _Table, obj: Any) -> None:
         if isinstance(obj, Task):
             if obj.service_id:
-                table.by_service.setdefault(obj.service_id, set()).add(obj.id)
-                table.by_slot.setdefault((obj.service_id, obj.slot), set()).add(obj.id)
+                table.by_service.setdefault(obj.service_id, {})[obj.id] = None
+                table.by_slot.setdefault((obj.service_id, obj.slot), {})[obj.id] = None
             if obj.node_id:
-                table.by_node.setdefault(obj.node_id, set()).add(obj.id)
+                table.by_node.setdefault(obj.node_id, {})[obj.id] = None
 
     def _unindex(self, table: _Table, obj: Any) -> None:
         if isinstance(obj, Task):
             if obj.service_id:
-                table.by_service.get(obj.service_id, set()).discard(obj.id)
-                table.by_slot.get((obj.service_id, obj.slot), set()).discard(obj.id)
+                table.by_service.get(obj.service_id, {}).pop(obj.id, None)
+                table.by_slot.get((obj.service_id, obj.slot), {}).pop(obj.id, None)
             if obj.node_id:
-                table.by_node.get(obj.node_id, set()).discard(obj.id)
+                table.by_node.get(obj.node_id, {}).pop(obj.id, None)
 
     # ------------------------------------------------------- queries (locked)
 
@@ -850,13 +854,13 @@ class MemoryStore:
         table = self._tables[kind.collection]
         # fast paths via indexes
         if kind is Task:
-            ids: Optional[set] = None
+            ids: Optional[Dict[str, None]] = None
             if isinstance(by, ByService):
-                ids = table.by_service.get(by.service_id, set())
+                ids = table.by_service.get(by.service_id, {})
             elif isinstance(by, ByNode):
-                ids = table.by_node.get(by.node_id, set())
+                ids = table.by_node.get(by.node_id, {})
             elif isinstance(by, BySlot):
-                ids = table.by_slot.get((by.service_id, by.slot), set())
+                ids = table.by_slot.get((by.service_id, by.slot), {})
             if ids is not None:
                 if table.overlay:
                     # index-driven query: materialize only touched ids
@@ -1119,12 +1123,12 @@ class MemoryStore:
                         overlay[tid] = (nid, seq, ts, state, message)
                         old_nid = old.node_id
                         if old_nid and old_nid != nid:
-                            by_node.get(old_nid, set()).discard(tid)
+                            by_node.get(old_nid, {}).pop(tid, None)
                         if nid:
                             s = by_node.get(nid)
                             if s is None:
-                                s = by_node[nid] = set()
-                            s.add(tid)
+                                s = by_node[nid] = {}
+                            s[tid] = None
                         committed_idx.append(i)
                 finally:
                     # already-written overlay entries carry versions up to
@@ -1302,13 +1306,12 @@ class MemoryStore:
                                                 message)
                                 old_nid = old.node_id
                                 if old_nid and old_nid != nid:
-                                    by_node.get(old_nid,
-                                                set()).discard(tid)
+                                    by_node.get(old_nid, {}).pop(tid, None)
                                 if nid:
                                     s = by_node.get(nid)
                                     if s is None:
-                                        s = by_node[nid] = set()
-                                    s.add(tid)
+                                        s = by_node[nid] = {}
+                                    s[tid] = None
                         self._version = seq
                         self._log_change_locked(
                             ("block", chunk_base, olds_c, nids_c,
@@ -1414,9 +1417,9 @@ class MemoryStore:
                 self._index(table, obj)
             elif old.node_id != obj.node_id:
                 if old.node_id:
-                    by_node.get(old.node_id, set()).discard(obj.id)
+                    by_node.get(old.node_id, {}).pop(obj.id, None)
                 if obj.node_id:
-                    by_node.setdefault(obj.node_id, set()).add(obj.id)
+                    by_node.setdefault(obj.node_id, {})[obj.id] = None
 
     # --------------------------------------------------- raft follower replay
 
@@ -1487,12 +1490,12 @@ class MemoryStore:
             overlay[tid] = (nid, ver, ts, state, message)
             old_nid = cur.node_id
             if old_nid and old_nid != nid:
-                by_node.get(old_nid, set()).discard(tid)
+                by_node.get(old_nid, {}).pop(tid, None)
             if nid:
                 s = by_node.get(nid)
                 if s is None:
-                    s = by_node[nid] = set()
-                s.add(tid)
+                    s = by_node[nid] = {}
+                s[tid] = None
             applied.append((cur, nid, ver))
         self._version = max(self._version,
                             action.base_version + len(action.ids))

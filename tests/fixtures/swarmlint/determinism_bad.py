@@ -27,3 +27,17 @@ def make_rng():
 
 def draw():
     return random.random()                # global unseeded RNG
+
+
+class ThreadedSupervisor:
+    """The rolling-update regression shape (ISSUE 8): a per-service
+    worker thread pacing its monitor window off the bare wall clock —
+    under the sim's virtual time the window never elapses (or elapses
+    instantly), so the FSM is untestable and nondeterministic."""
+
+    monitor = 30.0
+
+    def run(self, slots):
+        deadline = time.time() + self.monitor   # bare wall clock
+        while slots and time.time() < deadline:  # and again in the loop
+            slots.pop()

@@ -29,3 +29,20 @@ class Worker:
 
     def measure(self):
         return time.perf_counter()        # duration measurement: allowed
+
+
+class DrivenSupervisor:
+    """The corrected twin of the threaded-supervisor shape: deadlines
+    read the ``now()`` seam and the FSM is pumped by ``drive()`` —
+    production wraps it in a thread, the simulator calls it directly
+    under virtual time (orchestrator/update.py's design)."""
+
+    monitor = 30.0
+
+    def begin(self, slots):
+        self._slots = list(slots)
+        self._deadline = now() + self.monitor   # the time seam
+
+    def drive(self):
+        if self._slots and now() < self._deadline:
+            self._slots.pop()

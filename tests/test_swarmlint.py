@@ -88,7 +88,10 @@ def test_rule_passes_clean_twin(rule):
 # distinct violation shapes; a refactor that quietly narrows a rule to
 # one shape must fail here, not in review.
 @pytest.mark.parametrize("rule,min_findings", [
-    ("determinism-seam", 6),   # time.time/monotonic/uuid4/urandom/Random/random.random
+    ("determinism-seam", 8),   # time.time/monotonic/uuid4/urandom/Random/
+    #                            random.random + the threaded-supervisor
+    #                            shape (2 bare wall-clock reads pacing a
+    #                            rollout monitor window — ISSUE 8)
     ("epoch-fencing", 4),      # 3 unfenced calls + 1 fencing-blind def
     ("lock-discipline", 3),    # order cycle + 2 blocking-under-lock
     ("layering", 4),           # state/manager/sim/orchestrator imports
