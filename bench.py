@@ -715,6 +715,160 @@ def run_live_manager(planner_factory, external_firehose=False,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_priority_jobs(planner_factory):
+    """Config 8: services + jobs + 3 priority bands on a FULL cluster —
+    the priority & preemption subsystem's production shape.  512 nodes
+    (8 cpu each, 4 slots at the 2-cpu reservation) run 1800 priority-0
+    tasks; a 400-task priority-2 band, a 120-task priority-1 band and a
+    64-completion replicated job (priority 1) then arrive in ONE tick.
+    Free capacity covers less than half of them, so the tick's
+    preemption pass (device victim kernel, ops/preempt.py) must evict
+    ~336 low-band tasks to place every arrival — the bench asserts all
+    arrivals ASSIGNED and reports the ``swarm_preemptions`` delta,
+    which scripts/bench_compare.py gates on appearing with ZERO
+    planner-compile growth in the timed window (the warm-up pass below
+    covers every (NB, V, PB) victim-kernel signature)."""
+    _trim_heap()
+    from swarmkit_tpu.models import (
+        Annotations, Node, NodeDescription, NodeSpec, NodeState,
+        NodeStatus, ReplicatedService, Resources, ResourceRequirements,
+        Service, ServiceMode, ServiceSpec, Task, TaskSpec, TaskState,
+        TaskStatus, Version,
+    )
+    from swarmkit_tpu.models.specs import ReplicatedJob
+    from swarmkit_tpu.scheduler import Scheduler
+    from swarmkit_tpu.state import MemoryStore
+    from swarmkit_tpu.utils import new_id
+    from swarmkit_tpu.utils.metrics import registry as _reg
+
+    N_N = int(os.environ.get("BENCH_CFG8_NODES", 512))
+    CPU = 2 * 10 ** 9
+    MEM = 1 << 30
+    N_LO, N_HI, N_MID, N_JOB = 1800, 400, 120, 64
+
+    def build():
+        store = MemoryStore()
+        nodes = []
+        for i in range(N_N):
+            nodes.append(Node(
+                id=new_id(),
+                spec=NodeSpec(annotations=Annotations(name=f"p{i:04d}")),
+                status=NodeStatus(state=NodeState.READY),
+                description=NodeDescription(
+                    hostname=f"p{i:04d}",
+                    resources=Resources(nano_cpus=8 * 10 ** 9,
+                                        memory_bytes=32 << 30))))
+        res = ResourceRequirements(
+            reservations=Resources(nano_cpus=CPU, memory_bytes=MEM))
+        bands = {"lo": (0, N_LO), "hi": (2, N_HI), "mid": (1, N_MID)}
+        specs = {name: TaskSpec(resources=res, priority=prio)
+                 for name, (prio, _n) in bands.items()}
+        tasks = []
+        svcs = []
+        for name, (prio, count) in bands.items():
+            svc = Service(
+                id=new_id(),
+                spec=ServiceSpec(
+                    annotations=Annotations(name=f"band-{name}"),
+                    mode=ServiceMode.REPLICATED,
+                    replicated=ReplicatedService(replicas=count),
+                    task=specs[name]),
+                spec_version=Version(index=1))
+            svcs.append(svc)
+            for s in range(count):
+                t = Task(id=new_id(), service_id=svc.id, slot=s + 1,
+                         desired_state=TaskState.RUNNING,
+                         spec=specs[name], spec_version=Version(index=1),
+                         status=TaskStatus(state=TaskState.PENDING))
+                if name == "lo":   # the resident band: already RUNNING
+                    t.node_id = nodes[s % N_N].id
+                    t.status = TaskStatus(state=TaskState.RUNNING)
+                tasks.append(t)
+        job_spec = TaskSpec(resources=res, priority=1)
+        job = Service(
+            id=new_id(),
+            spec=ServiceSpec(
+                annotations=Annotations(name="band-job"),
+                mode=ServiceMode.REPLICATED_JOB,
+                replicated_job=ReplicatedJob(total_completions=N_JOB),
+                task=job_spec),
+            spec_version=Version(index=1))
+        svcs.append(job)
+        for s in range(N_JOB):
+            tasks.append(Task(
+                id=new_id(), service_id=job.id, slot=s,
+                desired_state=TaskState.COMPLETE, spec=job_spec,
+                spec_version=Version(index=1),
+                job_iteration=Version(index=0),
+                status=TaskStatus(state=TaskState.PENDING)))
+
+        def mk(tx):
+            for n in nodes:
+                tx.create(n)
+            for s in svcs:
+                tx.create(s)
+        store.update(mk)
+        store.update(lambda tx: (
+            [tx.create(t) for t in tasks] and None))
+        return store
+
+    def one_pass(store):
+        planner = planner_factory()
+        sched = Scheduler(store, batch_planner=planner,
+                          preempt_budget=512)
+        store.view(sched._setup_tasks_list)
+        gc.collect()
+        gc.freeze()
+        t0 = time.perf_counter()
+        n_dec = sched.tick()
+        dt = time.perf_counter() - t0
+        gc.unfreeze()
+        return sched, planner, n_dec, dt
+
+    # warm-up: the identical workload once, tracer off — covers every
+    # planner AND victim-kernel jit signature this config touches
+    from swarmkit_tpu.obs import tracer as _tracer
+    was_tracing = _tracer.enabled
+    _tracer.disable()
+    try:
+        one_pass(build())
+        _trim_heap()
+    finally:
+        _tracer.enabled = was_tracing
+
+    store = build()
+    snap = _planner_counter_snapshot()
+    pre0 = _reg.get_counter('swarm_preemptions{reason="priority"}')
+    sched, planner, n_dec, dt = one_pass(store)
+    preemptions = int(
+        _reg.get_counter('swarm_preemptions{reason="priority"}') - pre0)
+    routed = _planner_counter_delta(snap)
+
+    pending_bands = N_HI + N_MID + N_JOB
+    placed = sum(
+        1 for t in store.view(lambda tx: tx.find(Task))
+        if t.node_id and t.status.state >= TaskState.ASSIGNED
+        and t.desired_state <= TaskState.COMPLETE)
+    assert placed >= N_LO - preemptions + pending_bands, \
+        f"cfg8: only {placed} live placed (preemptions={preemptions})"
+    assert preemptions > 0, "cfg8 ran without a single preemption"
+    return {
+        "nodes": N_N, "tasks": N_LO + pending_bands,
+        "pending_arrivals": pending_bands,
+        "priority_bands": 3,
+        "decisions": n_dec,
+        "decisions_per_sec": round(n_dec / dt, 1),
+        "tick_s": round(dt, 3),
+        "plan_s": round(planner.stats["plan_seconds"], 3),
+        "commit_s": round(sched.stats["commit_seconds"], 3),
+        "preemptions": preemptions,
+        "fallback_groups": routed["groups_fallback"],
+        "path": "device+preempt",
+        "shape_cost_x": 1.0,
+        "compiles": _compile_delta(snap),
+    }
+
+
 def run_e2e(n_agents=5, n_replicas=500):
     """swarm-bench equivalent: create an N-replica service and measure
     per-task time from service creation to RUNNING status committed
@@ -982,6 +1136,11 @@ def main():
         live7 = configs["7_many_service_10x"]["decisions_per_sec"]
         configs["7_many_service_10x"]["shape_cost_x"] = round(
             tpu_dps / live7, 2) if live7 else None
+    if _cfg_enabled(8):
+        # services + jobs + 3 priority bands: the preemption subsystem
+        # under load (victim kernel signatures warmed inside the config)
+        with tracer.span("bench.config", "bench", cfg="cfg8"):
+            configs["8_mixed_priority_jobs"] = run_priority_jobs(tpu)
     if SKIP_E2E:
         e2e = None
     else:
@@ -1096,6 +1255,7 @@ def _append_history(artifact):
                 "fallback_groups": cfg.get("fallback_groups"),
                 "compiles": sum(cfg.get("compiles", {}).values()),
                 "shape_cost_x": cfg.get("shape_cost_x"),
+                "preemptions": cfg.get("preemptions"),
             }
             for name, cfg in artifact["configs"].items()},
     }

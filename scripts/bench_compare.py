@@ -39,13 +39,15 @@ def _compiles(val):
 def _norm(doc):
     """Normalize an artifact or history record to
     {"headline": dps, "configs": {name: dps}} plus context fields."""
-    configs, shape_cost, compiles = {}, {}, {}
+    configs, shape_cost, compiles, preempts = {}, {}, {}, {}
     for name, cfg in (doc.get("configs") or {}).items():
         dps = cfg.get("decisions_per_sec")
         if dps:
             configs[name] = float(dps)
         if cfg.get("shape_cost_x") is not None:
             shape_cost[name] = float(cfg["shape_cost_x"])
+        if cfg.get("preemptions") is not None:
+            preempts[name] = int(cfg["preemptions"])
         compiles[name] = _compiles(cfg.get("compiles"))
     return {
         "headline": float(doc.get("value") or 0.0),
@@ -54,6 +56,8 @@ def _norm(doc):
         # XLA compiles that landed inside timed regions (headline +
         # per config) — must stay flat after warm-up
         "compiles": compiles,
+        # preemption counters per config (cfg8 must show them)
+        "preemptions": preempts,
         "headline_compiles": _compiles(doc.get("planner_compiles")),
         "t": doc.get("t"),
         "health": (doc.get("health") or {}).get("status")
@@ -212,6 +216,26 @@ def main(argv=None) -> int:
                   f"{args.max_shape_cost}", file=sys.stderr)
             gate_failures.append(("shape-cost-bar",
                                   f"shape_cost_x:{name}={sc_new}"))
+    # preemption gate: the mixed-priority config must show preemption
+    # counters (the subsystem actually fired) AND pay zero XLA compiles
+    # inside its timed window (the victim-kernel signatures are warmed
+    # by the config's own warm-up pass) — judged on the NEW run alone
+    _PRIO_CFG = "8_mixed_priority_jobs"
+    if _PRIO_CFG in new.get("configs", {}):
+        pre = new.get("preemptions", {}).get(_PRIO_CFG)
+        print(f"preemptions[{_PRIO_CFG}]: "
+              f"{old.get('preemptions', {}).get(_PRIO_CFG)} -> {pre}")
+        if not pre:
+            print(f"\n{_PRIO_CFG} ran without preemption counters — the "
+                  "priority subsystem never fired", file=sys.stderr)
+            gate_failures.append(("preemption-counters",
+                                  f"{_PRIO_CFG} preemptions={pre}"))
+        cfg8_compiles = new.get("compiles", {}).get(_PRIO_CFG, 0)
+        if cfg8_compiles:
+            print(f"\n{_PRIO_CFG} paid {cfg8_compiles} XLA compile(s) in "
+                  "its timed window", file=sys.stderr)
+            gate_failures.append(("preemption-compile-growth",
+                                  f"{_PRIO_CFG} compiles={cfg8_compiles}"))
     # compile-flatness gate: XLA compiles inside timed regions must not
     # GROW — warm-up covers every signature a config touches, so any
     # growth means a new shape leaked into a timed window.  Judged over

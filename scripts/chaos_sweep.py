@@ -45,8 +45,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from swarmkit_tpu.sim.scenario import (          # noqa: E402
-    FAILOVER_SCENARIOS, FUZZ_POOL, LEGACY_RCP_SCENARIOS, SCENARIOS,
-    UPDATE_SCENARIOS, run_scenario,
+    FAILOVER_SCENARIOS, FUZZ_POOL, LEGACY_RCP_SCENARIOS,
+    PREEMPT_SCENARIOS, SCENARIOS, UPDATE_SCENARIOS, run_scenario,
 )
 
 #: named scenario subsets.  "default" is what CI's slow sweep runs; the
@@ -55,9 +55,10 @@ from swarmkit_tpu.sim.scenario import (          # noqa: E402
 SUITES: Dict[str, tuple] = {
     "failover": FAILOVER_SCENARIOS,
     "update": UPDATE_SCENARIOS,
+    "preempt": PREEMPT_SCENARIOS,
     "legacy-rcp": LEGACY_RCP_SCENARIOS,
     "default": FAILOVER_SCENARIOS + UPDATE_SCENARIOS
-    + LEGACY_RCP_SCENARIOS,
+    + PREEMPT_SCENARIOS + LEGACY_RCP_SCENARIOS,
     "fuzz": FUZZ_POOL,
 }
 
@@ -73,6 +74,7 @@ _FIXED_COMPONENT = {
     "agent-crash": "agent", "agent-restart": "agent",
     "agent-partition": "agent", "task-failure-storm": "agent",
     "rollout-poison": "updater",
+    "preempt-burst": "scheduler",
     "cut": "network", "heal": "network", "split": "network",
     "heal-all": "network", "drop": "network", "drop-burst": "network",
     "clock-skew": "clock",
@@ -81,7 +83,8 @@ _FIXED_COMPONENT = {
 
 def classify(ftype: str, target: str) -> str:
     """Component a fault perturbs: manager (raft/control plane), agent,
-    network, updater (rollout workload), or clock."""
+    network, updater (rollout workload), scheduler (priority/preemption
+    pressure), or clock."""
     fixed = _FIXED_COMPONENT.get(ftype)
     if fixed is not None:
         return fixed
@@ -135,6 +138,10 @@ REQUIRED_CELLS: Dict[str, Set[Tuple[str, str]]] = {
         ("crash", "manager"), ("restart", "manager"),
         ("stepdown", "manager"), ("task-failure-storm", "agent"),
         ("agent-crash", "agent"), ("agent-restart", "agent")},
+    "preemption-storm": {
+        ("preempt-burst", "scheduler"), ("agent-crash", "agent"),
+        ("agent-restart", "agent"), ("stepdown", "manager"),
+        ("drop", "network")},
 }
 
 
@@ -230,7 +237,8 @@ def main(argv=None) -> int:
                    help="sweep exactly these scenarios (repeatable; "
                         "overrides --suite)")
     p.add_argument("--fast", action="store_true",
-                   help="CI subset: 3 seeds x rolling-upgrade-chaos "
+                   help="CI subset: 3 seeds x rolling-upgrade-chaos + "
+                        "preemption-storm "
                         "(overrides --fuzz/--suite/--scenario)")
     p.add_argument("--no-coverage-gate", action="store_true",
                    help="report the coverage matrix but never fail on "
@@ -250,7 +258,7 @@ def main(argv=None) -> int:
         return 0
 
     if args.fast:
-        scenarios: tuple = ("rolling-upgrade-chaos",)
+        scenarios: tuple = ("rolling-upgrade-chaos", "preemption-storm")
         n_seeds = 3
     else:
         if args.scenario:

@@ -12,10 +12,11 @@ python scripts/swarmlint.py || exit 1
 
 echo
 echo "== chaos sweep, fast subset (scripts/chaos_sweep.py --fast) =="
-# 3 seeds x rolling-upgrade-chaos: real rolling updates (pause /
-# rollback / failover handoff) under partition+churn, invariants +
-# coverage gate.  The 20-seed default-suite sweep and long-soak run in
-# the slow tier (tests/test_update_chaos.py -m slow).
+# 3 seeds x (rolling-upgrade-chaos + preemption-storm): real rolling
+# updates (pause / rollback / failover handoff) and priority preemption
+# under partition+churn, invariants + coverage gate.  The 20-seed
+# default-suite sweep and long-soak run in the slow tier
+# (tests/test_update_chaos.py / test_preemption.py -m slow).
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python scripts/chaos_sweep.py --fast --quiet > /tmp/_chaos_fast.json \
     || { cat /tmp/_chaos_fast.json; exit 1; }

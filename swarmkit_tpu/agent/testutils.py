@@ -77,14 +77,20 @@ class TestController(Controller):
 
 class TestExecutor(Executor):
     __test__ = False  # not a pytest class
-    def __init__(self, hostname: str = "test-node", **controller_kwargs):
+    def __init__(self, hostname: str = "test-node", resources=None,
+                 **controller_kwargs):
         self.hostname = hostname
+        # reported in describe(): without it a registration overwrites
+        # the node's description and zeroes its capacity, starving any
+        # reservation-carrying workload (None keeps legacy behavior)
+        self.resources = resources
         self.controller_kwargs = controller_kwargs
         self.controllers: Dict[str, TestController] = {}
         self._mu = threading.Lock()
 
     def describe(self) -> NodeDescription:
-        return NodeDescription(hostname=self.hostname)
+        return NodeDescription(hostname=self.hostname,
+                               resources=self.resources)
 
     def set_network_bootstrap_keys(self, keys) -> None:
         # recorded for tests asserting key-manager rotations reach agents

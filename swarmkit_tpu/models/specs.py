@@ -172,6 +172,11 @@ class TaskSpec:
     networks: List[NetworkAttachmentConfig] = field(default_factory=list)
     force_update: int = 0   # counter: bump to force task replacement
     resource_references: List[str] = field(default_factory=list)
+    # priority class: higher wins.  0 is the default band; only tasks
+    # with priority > 0 may preempt, and victims must be STRICTLY lower
+    # (scheduler/preempt.py).  Propagated from ServiceSpec.priority at
+    # task creation when unset (orchestrator/common.effective_task_spec).
+    priority: int = 0
 
     def copy(self) -> "TaskSpec":
         return TaskSpec(
@@ -184,7 +189,8 @@ class TaskSpec:
             log_driver=self.log_driver.copy() if self.log_driver else None,
             networks=[n.copy() for n in self.networks],
             force_update=self.force_update,
-            resource_references=list(self.resource_references))
+            resource_references=list(self.resource_references),
+            priority=self.priority)
 
 
 @dataclass
@@ -200,6 +206,10 @@ class ServiceSpec:
     rollback: Optional[UpdateConfig] = None
     networks: List[NetworkAttachmentConfig] = field(default_factory=list)
     endpoint: Optional[EndpointSpec] = None
+    # service-level priority class (authoring convenience): copied into
+    # each task's spec at creation when task.priority is unset, so the
+    # scheduler only ever reads task.spec.priority
+    priority: int = 0
 
     def replicas(self) -> int:
         if self.mode == ServiceMode.REPLICATED:
@@ -216,7 +226,8 @@ class ServiceSpec:
             update=self.update.copy() if self.update else None,
             rollback=self.rollback.copy() if self.rollback else None,
             networks=[n.copy() for n in self.networks],
-            endpoint=self.endpoint.copy() if self.endpoint else None)
+            endpoint=self.endpoint.copy() if self.endpoint else None,
+            priority=self.priority)
 
 
 @dataclass

@@ -175,6 +175,15 @@ def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
         Check("stuck_rollout", stuck_rollout_value(),
               1.0, 2.0, "state",
               ("swarm_update_",)),
+        # priority inversions (scheduler/preempt.py): pending positive-
+        # priority tasks still unplaced after the preemption pass while
+        # lower-priority work holds capacity — warn on the first one
+        # (budget/cooldown may legitimately defer a tick or two), fail
+        # when the important band is piling up behind the cheap one
+        Check("priority_inversion",
+              gauge_value("swarm_priority_inversion"),
+              1.0, 8.0, "tasks",
+              ("swarm_priority_", "swarm_preempt")),
     ]
 
 

@@ -1121,6 +1121,36 @@ class TPUPlanner:
         self._count("tasks_planned", placed)
         return True
 
+    # --------------------------------------------------- victim selection
+
+    def select_victims(self, cand, cpu_d: int, mem_d: int,
+                       n_picks: int, budget: int):
+        """Device preemption: the victims×nodes selection kernel
+        (ops/preempt.py), byte-identical to the host oracle.  Routed
+        through the SAME breaker seam as planning: an open breaker or
+        any device failure returns None and the scheduler's supervisor
+        runs the host oracle instead — selection never fails a tick."""
+        import time as _time
+        from . import preempt as _preempt
+        if not self.breaker.allow_device():
+            self._count("preempt_breaker_to_host")
+            return None
+        try:
+            before = _jit_cache_size(_preempt.select_victims_jit)
+            t0 = _time.perf_counter()
+            with tracer.span("plan.preempt", "plan", picks=n_picks):
+                picks, bucket, fn = _preempt.plan_victims(
+                    cand, cpu_d, mem_d, n_picks, budget)
+            _observe_compile(fn, bucket, before,
+                             _time.perf_counter() - t0)
+        except Exception:
+            log.exception("device victim selection failed; host oracle")
+            self._count("preempt_device_error")
+            self.breaker.record_failure()
+            return None
+        self.breaker.record_success()
+        return picks
+
     # ----------------------------------------------- fused many-service
 
     def probe_fused_run(self, sched, glist, start: int) -> list:

@@ -1,0 +1,167 @@
+"""Device victim selection: batched preemption as a victims×nodes
+score-matrix program.
+
+The host oracle (scheduler/preempt.py ``select_victims_host``) is a
+sequential greedy: per pick, every node's cheapest victim prefix is
+scored and the (cost, victim-count, node-index)-minimal node wins.
+That per-node prefix computation is a pure function of the candidate
+columns — so it runs as ONE vmap over the node axis (cumulative sums
+down the victim axis, one comparison ladder), and the sequential picks
+become a ``lax.scan`` whose carry (used-victim mask, per-node freed
+surplus, remaining victim budget, stop flag) IS the greedy's mutable
+state.  Every quantity is integer (resources i64 under the scoped
+``enable_x64`` guard shared with the fused planner, costs/counts i32),
+so the outputs are byte-identical to the oracle — asserted by the
+differential fuzz in tests/test_preemption.py across node/victim/pick
+buckets and seeds.
+
+Shape discipline follows the planner's bucket ladder: nodes pad to the
+shared ``n_bucket`` pow2 ladder, victim slots to ``V_BUCKETS``
+({4, 16, 64}, scheduler/preempt.py), picks to a pow2 bucket — one jit
+signature per (NB, VB, PB) triple, counted by the planner's compile
+observer like every other kernel.  Routing/fallback lives in
+ops/planner.py ``TPUPlanner.select_victims`` (PlannerBreaker-gated,
+any device failure degrades to the host oracle).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..scheduler.preempt import CandidateSet
+from . import fusedbatch
+
+#: packed tie-break key layout: cost << 27 | nvict << 20 | node index —
+#: cost < 2^27 (64 victims x (PRIO_CLAMP+1)), nvict < 2^7, index < 2^20
+_IDX_BITS = 20
+_NV_BITS = 7
+
+
+def pick_bucket(n: int) -> int:
+    """Pow2 pick-slot bucket (>= 1)."""
+    return fusedbatch.pow2_bucket(max(n, 1))
+
+
+def _node_prefix(ok_j, free_c, free_m, ex_c, ex_m, live_col,
+                 vcpu_col, vmem_col, w_col, cpu_d, mem_d):
+    """One node's cheapest victim prefix: (feasible, m, cost, nvict).
+    ``m`` is the smallest prefix length whose unused victims free enough
+    cpu AND memory on top of the node's (possibly negative) free pool.
+    vmapped over the node axis by ``select_victims_jit``."""
+    zero64 = jnp.zeros((1,), vcpu_col.dtype)
+    zero32 = jnp.zeros((1,), jnp.int32)
+    cum_c = jnp.concatenate(
+        [zero64, jnp.cumsum(jnp.where(live_col, vcpu_col, 0))])
+    cum_m = jnp.concatenate(
+        [zero64, jnp.cumsum(jnp.where(live_col, vmem_col, 0))])
+    cum_w = jnp.concatenate(
+        [zero32, jnp.cumsum(jnp.where(live_col, w_col, 0))])
+    cum_n = jnp.concatenate(
+        [zero32, jnp.cumsum(live_col.astype(jnp.int32))])
+    # fits[m] is monotone in m (freed resources are non-negative), so
+    # argmax finds the FIRST satisfying prefix — the oracle's break
+    fits = ((free_c + ex_c + cum_c >= cpu_d)
+            & (free_m + ex_m + cum_m >= mem_d))
+    m = jnp.argmax(fits).astype(jnp.int32)
+    feasible = ok_j & jnp.any(fits)
+    cost = jnp.take(cum_w, m)
+    nvict = jnp.take(cum_n, m)
+    return feasible, m, cost, nvict
+
+
+@functools.partial(jax.jit, static_argnames=("picks",))
+def select_victims_jit(ok, free_cpu, free_mem, vvalid, vprio, vcpu,
+                       vmem, cpu_d, mem_d, n_picks, budget, picks: int):
+    """Sequential greedy picks as a scan; returns (node i32[picks],
+    m i32[picks]) with -1/0 rows for inactive (stopped or > n_picks)
+    picks.  See module docstring for the exactness contract."""
+    V, N = vvalid.shape
+    weights = (vprio + 1).astype(jnp.int32)    # clamped host-side
+    slot_idx = jnp.arange(V, dtype=jnp.int32)
+    node_idx = jnp.arange(N, dtype=jnp.int64)
+    maxkey = jnp.iinfo(jnp.int64).max
+
+    prefix = jax.vmap(_node_prefix,
+                      in_axes=(0, 0, 0, 0, 0, 1, 1, 1, 1, None, None))
+
+    def step(state, p):
+        used, ex_c, ex_m, budget_rem, stopped = state
+        live = vvalid & ~used
+        feasible, m, cost, nvict = prefix(
+            ok, free_cpu, free_mem, ex_c, ex_m, live, vcpu, vmem,
+            weights, cpu_d, mem_d)
+        key = ((cost.astype(jnp.int64) << (_IDX_BITS + _NV_BITS))
+               | (nvict.astype(jnp.int64) << _IDX_BITS) | node_idx)
+        key = jnp.where(feasible, key, maxkey)
+        j = jnp.argmin(key).astype(jnp.int32)
+        any_f = jnp.take(feasible, j)
+        m_j = jnp.take(m, j)
+        nv_j = jnp.take(nvict, j)
+        over = nv_j > budget_rem
+        active = (p < n_picks) & ~stopped
+        do = active & any_f & ~over
+        sel = jnp.take(live, j, axis=1) & (slot_idx < m_j) & do
+        freed_c = jnp.sum(jnp.where(sel, jnp.take(vcpu, j, axis=1), 0))
+        freed_m = jnp.sum(jnp.where(sel, jnp.take(vmem, j, axis=1), 0))
+        used = used.at[:, j].set(used[:, j] | sel)
+        ex_c = ex_c.at[j].add(jnp.where(do, freed_c - cpu_d, 0))
+        ex_m = ex_m.at[j].add(jnp.where(do, freed_m - mem_d, 0))
+        budget_rem = budget_rem - jnp.where(do, nv_j, 0)
+        stopped = stopped | (active & (~any_f | over))
+        out_node = jnp.where(do, j, -1)
+        out_m = jnp.where(do, m_j, 0)
+        return (used, ex_c, ex_m, budget_rem, stopped), (out_node, out_m)
+
+    state = (jnp.zeros((V, N), bool),
+             jnp.zeros((N,), free_cpu.dtype),
+             jnp.zeros((N,), free_mem.dtype),
+             jnp.asarray(budget, jnp.int32),
+             jnp.zeros((), bool))
+    _, (nodes, ms) = jax.lax.scan(
+        step, state, jnp.arange(picks, dtype=jnp.int32))
+    return nodes, ms
+
+
+def plan_victims(cand: CandidateSet, cpu_d: int, mem_d: int,
+                 n_picks: int, budget: int
+                 ) -> Tuple[List[Tuple[int, int]], str, object]:
+    """Pad the host-built candidate arrays to their static buckets,
+    dispatch the kernel, fetch and unpad.  Returns (picks, bucket label,
+    jit fn) — the label and fn feed the planner's compile observer.
+    Raises on any device failure (the caller owns breaker/fallback)."""
+    V, n = cand.vvalid.shape
+    nb = fusedbatch.n_bucket(max(n, 1))
+    # the caller caps n_picks (supervisor: min(group size, budget)) so
+    # host and device run the SAME number of pick iterations
+    pb = pick_bucket(n_picks)
+    ok = np.zeros(nb, bool)
+    ok[:n] = cand.ok
+    free_cpu = np.zeros(nb, np.int64)
+    free_cpu[:n] = cand.free_cpu
+    free_mem = np.zeros(nb, np.int64)
+    free_mem[:n] = cand.free_mem
+    vvalid = np.zeros((V, nb), bool)
+    vvalid[:, :n] = cand.vvalid
+    vprio = np.zeros((V, nb), np.int32)
+    vprio[:, :n] = cand.vprio
+    vcpu = np.zeros((V, nb), np.int64)
+    vcpu[:, :n] = cand.vcpu
+    vmem = np.zeros((V, nb), np.int64)
+    vmem[:, :n] = cand.vmem
+    label = f"preempt_nb{nb}_v{V}_p{pb}"
+    with fusedbatch.x64():
+        nodes, ms = jax.device_get(select_victims_jit(
+            ok, free_cpu, free_mem, vvalid, vprio, vcpu, vmem,
+            np.int64(cpu_d), np.int64(mem_d), np.int32(n_picks),
+            np.int32(budget), pb))
+    picks: List[Tuple[int, int]] = []
+    for j, m in zip(nodes.tolist(), ms.tolist()):
+        if j < 0:
+            continue
+        picks.append((int(j), int(m)))
+    return picks, label, select_victims_jit

@@ -16,6 +16,9 @@ from ..models.types import (
     TaskStatus, UpdateConfig, UpdateFailureAction, now,
 )
 from ..scheduler import constraint as constraint_mod
+# the single priority accessor — defined next to the selection logic
+# that consumes it, re-exported here for orchestrator-side callers
+from ..scheduler.preempt import task_priority  # noqa: F401
 from ..state.store import Batch, MemoryStore, ReadTx
 from ..utils import new_id
 
@@ -70,6 +73,20 @@ def invalid_node(n: Optional[Node]) -> bool:
             or n.spec.availability == NodeAvailability.DRAIN)
 
 
+def effective_task_spec(service: Service):
+    """The task spec a task of this service actually carries: the
+    service-level priority class propagates into the spec at creation
+    when the task spec has none (the scheduler only reads
+    ``task.spec.priority``).  ``is_task_dirty`` compares against this
+    same spec so the propagation never reads as spec drift."""
+    spec = service.spec.task
+    svc_prio = getattr(service.spec, "priority", 0)
+    if svc_prio and not getattr(spec, "priority", 0):
+        spec = spec.copy()
+        spec.priority = svc_prio
+    return spec
+
+
 def new_task(cluster: Optional[Cluster], service: Service, slot: int,
              node_id: str = "") -> Task:
     """Task factory (reference: task.go:16 NewTask)."""
@@ -80,7 +97,7 @@ def new_task(cluster: Optional[Cluster], service: Service, slot: int,
     task = Task(
         id=new_id(),
         service_annotations=service.spec.annotations,
-        spec=service.spec.task,
+        spec=effective_task_spec(service),
         spec_version=service.spec_version.copy()
         if service.spec_version else None,
         service_id=service.id,
@@ -125,7 +142,9 @@ def is_task_dirty(service: Service, t: Task, n: Optional[Node]) -> bool:
             and t.spec_version.index == service.spec_version.index):
         return False
 
-    service_spec = service.spec.task
+    # compare against the spec tasks are actually minted with — a
+    # service-level priority propagated at creation is not drift
+    service_spec = effective_task_spec(service)
 
     # Not dirty if only placement constraints changed and the assigned node
     # still satisfies them.

@@ -55,6 +55,9 @@ class Orchestrator:
         self.nodes: Dict[str, Node] = {}      # non-drained, non-down nodes
         self.global_services: Dict[str, _GlobalService] = {}
         self.restart_tasks: Dict[str, None] = {}   # insertion-ordered set
+        # victims whose preemption marker already triggered a reconcile
+        # (pruned on task delete; see _handle_task_change)
+        self._preempt_seen: set = set()
         self._stop = threading.Event()
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -161,6 +164,7 @@ class Orchestrator:
         elif isinstance(obj, Task) and ev.action == "update":
             self._handle_task_change(obj)
         elif isinstance(obj, Task) and ev.action == "delete":
+            self._preempt_seen.discard(obj.id)
             # beyond the reference (global.go:164 only watches updates):
             # an out-of-band deletion (operator `task rm`) of a live
             # global task would otherwise leave its node without a
@@ -173,6 +177,14 @@ class Orchestrator:
         if t.service_id not in self.global_services:
             return
         if t.desired_state > TaskState.RUNNING:
+            # preempted by the scheduler: the node lost its replica with
+            # no node/service event to notice — reconcile to re-cover
+            # it, ONCE per victim (the marker persists through the
+            # victim's remaining lifecycle writes)
+            if "swarm.preempted.at" in t.annotations.labels \
+                    and t.id not in self._preempt_seen:
+                self._preempt_seen.add(t.id)
+                self._reconcile_services([t.service_id])
             return
         if t.status.state > TaskState.RUNNING:
             self.restart_tasks[t.id] = None

@@ -152,6 +152,15 @@ class Orchestrator:
         """A task changed (usually agent status): queue restart if it died
         or its node became invalid (reference: tasks.go:120)."""
         if t.desired_state > TaskState.RUNNING:
+            # a PREEMPTED task (scheduler marked it desired-SHUTDOWN to
+            # make room for a higher-priority band) empties its slot
+            # outside every other trigger — reconcile the service so the
+            # slot requeues at its own priority
+            if "swarm.preempted.at" in t.annotations.labels \
+                    and t.service_id:
+                service = self.store.raw_get(Service, t.service_id)
+                if common.is_replicated_service(service):
+                    self.reconcile_services[service.id] = service
             return
         n = self.store.raw_get(Node, t.node_id) if t.node_id else None
         service = self.store.raw_get(Service, t.service_id) \

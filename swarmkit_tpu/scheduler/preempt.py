@@ -1,0 +1,423 @@
+"""Priority preemption: host oracle + supervisor state.
+
+When a higher-priority task group comes back infeasible from the normal
+scheduling pass, the scheduler may evict ("preempt") strictly-lower-
+priority running tasks to make room.  This module is the HOST side of
+that capability:
+
+* ``build_candidates`` densifies the scheduler's NodeSet mirror into the
+  victims×nodes candidate arrays BOTH selection paths consume — the
+  single source that makes the device kernel (ops/preempt.py) byte-
+  identical to the host oracle by construction (the same discipline as
+  the planner's host-side ``res_ok`` columns).
+* ``select_victims_host`` is the oracle: a deterministic greedy that,
+  per pending task, picks the node whose cheapest victim prefix frees
+  enough resources — cost = Σ(victim priority + 1), ties broken by
+  victim count then node index.  The device kernel computes exactly the
+  same integers (differential-fuzzed in tests/test_preemption.py).
+* ``PreemptSupervisor`` owns the policy state: the per-tick victim
+  budget, the per-slot anti-thrash cooldown (stamped via
+  ``models.types.now()`` so the sim drives it under virtual time), the
+  victim-exit latency stamps, and the counters/gauges the obs plane
+  reads (``swarm_preemptions{reason=}``, ``swarm_priority_inversion``).
+
+Selection model (shared spec, mirrored bit-for-bit by the kernel):
+
+  Per node j, candidate victims are pre-sorted (priority asc, task id
+  asc) and truncated to the V bucket.  A pick needs the smallest prefix
+  m such that ``free[j] + extra[j] + Σ freed[s<m, unused] >= demand``
+  for BOTH cpu and memory; its cost is the prefix's unused weight sum.
+  Picks run sequentially: the chosen node's prefix is marked used and
+  its surplus (freed − demand) carries into ``extra`` for later picks;
+  a pick whose victim count exceeds the remaining budget STOPS the
+  selection (and everything after it), as does the first infeasible
+  pick — all integer math, so host and device agree exactly.
+
+Scope (documented waivers, mirroring the device planner's): preemption
+only triggers for priority > 0 pending work whose infeasibility is
+resource-shaped — groups demanding generic resources, host ports, or
+CSI volumes are skipped (``swarm_preempt_skipped{reason="unsupported"}``),
+and victims free only cpu/memory reservations.  Victims are always
+STRICTLY lower priority; equal-or-higher is excluded at candidate-build
+time and re-asserted by the sim's ``no-preempt-equal-or-higher``
+invariant.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.objects import Task
+from ..models.types import (
+    MountType, NodeAvailability, NodeState, PublishMode, TaskState, now,
+)
+from ..utils.metrics import registry as _metrics
+from .filters import Pipeline, ResourceFilter
+from .nodeinfo import NodeInfo, task_reservations
+
+log = logging.getLogger("preempt")
+
+#: victims considered per node, smallest bucket that fits (shape ladder
+#: shared with ops/preempt.py — one jit signature per bucket)
+V_BUCKETS = (4, 16, 64)
+
+#: victim weight clamp: cost sums must fit the device kernel's packed
+#: (cost, nvict, node) tie-break key (64 victims x 2^20 < 2^27)
+PRIO_CLAMP = (1 << 20) - 1
+
+#: default per-tick victim budget (SWARM_PREEMPT_BUDGET): bounds how
+#: much running work one tick may evict, so a priority storm degrades
+#: gradually instead of mass-evicting the cluster
+DEFAULT_BUDGET = 32
+
+#: default per-slot anti-thrash cooldown in seconds
+#: (SWARM_PREEMPT_COOLDOWN): a slot preempted once is exempt until the
+#: cooldown elapses, so a victim's requeued replacement cannot be
+#: evicted again immediately
+DEFAULT_COOLDOWN = 60.0
+
+# cached Timer references (Registry.reset() resets in place)
+_COMMIT_TIMER = _metrics.timer('swarm_preempt_latency{edge="commit"}')
+_EXIT_TIMER = _metrics.timer('swarm_preempt_latency{edge="victim_exit"}')
+
+
+def task_priority(t: Task) -> int:
+    """Priority class of a task (0 = default band; higher wins)."""
+    return getattr(t.spec, "priority", 0) if t.spec is not None else 0
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def v_bucket(n: int) -> Optional[int]:
+    for b in V_BUCKETS:
+        if n <= b:
+            return b
+    return None
+
+
+class CandidateSet:
+    """Densified victims×nodes candidates for ONE pending group.
+
+    Array shapes are the UNbucketed (n_nodes, per-node-truncated-to-V)
+    host view; ops/preempt.py pads them to the static buckets before
+    dispatch.  ``victims[j]`` maps victim slots back to mirror tasks.
+    """
+
+    __slots__ = ("infos", "ok", "free_cpu", "free_mem", "vvalid", "vprio",
+                 "vcpu", "vmem", "victims", "vb", "n_candidates")
+
+    def __init__(self, infos, ok, free_cpu, free_mem, vvalid, vprio,
+                 vcpu, vmem, victims, vb, n_candidates):
+        self.infos = infos
+        self.ok = ok
+        self.free_cpu = free_cpu
+        self.free_mem = free_mem
+        self.vvalid = vvalid
+        self.vprio = vprio
+        self.vcpu = vcpu
+        self.vmem = vmem
+        self.victims = victims
+        self.vb = vb
+        self.n_candidates = n_candidates
+
+
+def preemptable_group(t: Task) -> bool:
+    """Is this pending spec's infeasibility something preemption can
+    fix?  Resource-shaped demand only — the waivers mirror the device
+    planner's (``TPUPlanner._supported``)."""
+    res = t.spec.resources.reservations if t.spec.resources else None
+    if res is None or (not res.nano_cpus and not res.memory_bytes):
+        return False    # no resource demand: constraints, not capacity
+    if res.generic:
+        return False    # generic-resource claims: host bookkeeping only
+    if t.endpoint and any(p.publish_mode == PublishMode.HOST
+                          and p.published_port
+                          for p in t.endpoint.ports):
+        return False    # freed host ports are not modeled
+    if t.spec.placement and t.spec.placement.max_replicas:
+        # node eligibility is snapshotted once per group, but the
+        # selection may stack several preemptors on one node — which
+        # could breach max_replicas.  Waived, like the device path's
+        # per-task-claim cases.
+        return False
+    c = t.spec.container
+    if c is not None and any(m.type == MountType.CSI for m in c.mounts):
+        return False    # volume scheduling stays on the host path
+    return True
+
+
+def demand_of(t: Task) -> Tuple[int, int]:
+    res = t.spec.resources.reservations if t.spec.resources else None
+    if res is None:
+        return 0, 0
+    return int(res.nano_cpus), int(res.memory_bytes)
+
+
+def victim_slot_key(t: Task) -> tuple:
+    """Anti-thrash cooldown key: one slot of one service (node-keyed for
+    global services, like orchestrator slot tuples)."""
+    if t.slot:
+        return (t.service_id, t.slot, "")
+    return (t.service_id, 0, t.node_id)
+
+
+def build_candidates(sched, t: Task, prio: int,
+                     excluded_ids, cooldowns: Dict[tuple, float],
+                     cooldown: float,
+                     skipped_cooldown: Optional[List[int]] = None
+                     ) -> Optional[CandidateSet]:
+    """Densify the mirror into the shared candidate arrays for pending
+    spec ``t`` at priority ``prio``.  Returns None when no node has any
+    eligible victim (nothing to select over).
+
+    Node eligibility (``ok``) runs the host filter pipeline MINUS the
+    resource filter — preemption exists to fix resource infeasibility,
+    every other filter must already pass.  Victim eligibility: status
+    RUNNING, desired <= COMPLETE (service tasks run at RUNNING, job
+    tasks at COMPLETE), STRICTLY lower priority, not shut down by an
+    earlier pick this tick, and the slot not inside its cooldown.
+    """
+    infos: List[NodeInfo] = list(sched.node_set.nodes.values())
+    if not infos:
+        return None
+    n = len(infos)
+    ts = now()
+
+    pipe = Pipeline()
+    pipe._checklist = [e for e in pipe._checklist
+                       if not isinstance(e.f, ResourceFilter)]
+    pipe.set_task(t)
+
+    ok = np.zeros(n, bool)
+    free_cpu = np.zeros(n, np.int64)
+    free_mem = np.zeros(n, np.int64)
+    per_node: List[List[Task]] = []
+    max_v = 0
+    n_candidates = 0
+    skipped_cd = 0
+    for j, info in enumerate(infos):
+        node = info.node
+        live = (node.status.state == NodeState.READY
+                and node.spec.availability == NodeAvailability.ACTIVE)
+        ok[j] = live and pipe.process(info)
+        free_cpu[j] = info.available_resources.nano_cpus
+        free_mem[j] = info.available_resources.memory_bytes
+        cands: List[Task] = []
+        if ok[j]:
+            for vt in info.tasks.values():
+                # the node mirror's task objects serve membership and
+                # reservations — their STATUS can be stale (add_task
+                # only swaps objects on desired-state flips), so the
+                # current row comes from the scheduler's all_tasks view
+                vt = sched.all_tasks.get(vt.id, vt)
+                if vt.status.state != TaskState.RUNNING:
+                    continue
+                if vt.desired_state > TaskState.COMPLETE:
+                    continue
+                if task_priority(vt) >= prio:
+                    continue    # NEVER equal-or-higher
+                if vt.id in excluded_ids:
+                    continue
+                stamp = cooldowns.get(victim_slot_key(vt))
+                if stamp is not None and ts - stamp < cooldown:
+                    skipped_cd += 1
+                    continue
+                cands.append(vt)
+            # deterministic order: cheapest (lowest priority) first,
+            # task id as the tie-break — the prefix the selection eats
+            cands.sort(key=lambda v: (task_priority(v), v.id))
+        per_node.append(cands)
+        n_candidates += len(cands)
+        if len(cands) > max_v:
+            max_v = len(cands)
+    if skipped_cooldown is not None:
+        skipped_cooldown.append(skipped_cd)
+    if n_candidates == 0:
+        return None
+    vb = v_bucket(max_v)
+    if vb is None:
+        vb = V_BUCKETS[-1]    # truncate: keep the V cheapest per node
+    vvalid = np.zeros((vb, n), bool)
+    vprio = np.zeros((vb, n), np.int32)
+    vcpu = np.zeros((vb, n), np.int64)
+    vmem = np.zeros((vb, n), np.int64)
+    victims: List[List[Task]] = []
+    for j, cands in enumerate(per_node):
+        cands = cands[:vb]
+        victims.append(cands)
+        for s, vt in enumerate(cands):
+            res = task_reservations(vt)
+            vvalid[s, j] = True
+            # weight clamp: negative bands weigh like 0, huge bands
+            # saturate — selection ORDER already used the raw priority
+            vprio[s, j] = min(max(task_priority(vt), 0), PRIO_CLAMP)
+            vcpu[s, j] = int(res.nano_cpus)
+            vmem[s, j] = int(res.memory_bytes)
+    return CandidateSet(infos, ok, free_cpu, free_mem, vvalid, vprio,
+                        vcpu, vmem, victims, vb, n_candidates)
+
+
+def select_victims_host(cand: CandidateSet, cpu_d: int, mem_d: int,
+                        n_picks: int, budget: int
+                        ) -> List[Tuple[int, int]]:
+    """The oracle: sequential greedy picks over the candidate arrays.
+    Returns [(node_index, prefix_len)] — the EXACT integers the device
+    kernel must reproduce (tests/test_preemption.py fuzzes the pair).
+    """
+    vvalid = cand.vvalid
+    V, N = vvalid.shape
+    used = np.zeros((V, N), bool)
+    extra_cpu = [0] * N    # python ints: exact, like the i64 kernel
+    extra_mem = [0] * N
+    picks: List[Tuple[int, int]] = []
+    budget_rem = budget
+    for _ in range(n_picks):
+        best = None    # (cost, nvict, j, m)
+        for j in range(N):
+            if not cand.ok[j]:
+                continue
+            have_cpu = int(cand.free_cpu[j]) + extra_cpu[j]
+            have_mem = int(cand.free_mem[j]) + extra_mem[j]
+            cost = 0
+            nvict = 0
+            m = None
+            if have_cpu >= cpu_d and have_mem >= mem_d:
+                m = 0
+            else:
+                for s in range(V):
+                    if vvalid[s, j] and not used[s, j]:
+                        have_cpu += int(cand.vcpu[s, j])
+                        have_mem += int(cand.vmem[s, j])
+                        cost += int(cand.vprio[s, j]) + 1
+                        nvict += 1
+                    if have_cpu >= cpu_d and have_mem >= mem_d:
+                        m = s + 1
+                        break
+            if m is None:
+                continue
+            key = (cost, nvict, j)
+            if best is None or key < best[:3]:
+                best = (cost, nvict, j, m)
+        if best is None:
+            break    # infeasible: same demand for every pick, so stop
+        cost, nvict, j, m = best
+        if nvict > budget_rem:
+            break    # budget exhausted: stop (device mirrors this)
+        freed_cpu = 0
+        freed_mem = 0
+        for s in range(m):
+            if vvalid[s, j] and not used[s, j]:
+                used[s, j] = True
+                freed_cpu += int(cand.vcpu[s, j])
+                freed_mem += int(cand.vmem[s, j])
+        extra_cpu[j] += freed_cpu - cpu_d
+        extra_mem[j] += freed_mem - mem_d
+        budget_rem -= nvict
+        picks.append((j, m))
+    return picks
+
+
+def replay_pick_victims(cand: CandidateSet,
+                        picks: List[Tuple[int, int]]
+                        ) -> List[Tuple[int, List[Task]]]:
+    """Expand (node, prefix_len) picks into concrete victim tasks —
+    the same used-mask replay the selection ran, so host- and device-
+    computed picks map to identical task sets."""
+    used: Dict[int, set] = {}
+    out: List[Tuple[int, List[Task]]] = []
+    for j, m in picks:
+        taken = used.setdefault(j, set())
+        chosen = [cand.victims[j][s] for s in range(m)
+                  if s < len(cand.victims[j]) and s not in taken]
+        taken.update(s for s in range(m) if s < len(cand.victims[j]))
+        out.append((j, chosen))
+    return out
+
+
+class PreemptSupervisor:
+    """Per-scheduler preemption policy state: budget, cooldowns, latency
+    stamps, and the obs exports.  All time flows through
+    ``models.types.now()`` (virtual under the sim)."""
+
+    def __init__(self, budget: Optional[int] = None,
+                 cooldown: Optional[float] = None):
+        self.budget = budget if budget is not None \
+            else _env_int("SWARM_PREEMPT_BUDGET", DEFAULT_BUDGET)
+        self.cooldown = cooldown if cooldown is not None \
+            else _env_float("SWARM_PREEMPT_COOLDOWN", DEFAULT_COOLDOWN)
+        #: slot key -> stamp of the last preemption (anti-thrash)
+        self.cooldowns: Dict[tuple, float] = {}
+        #: victim task id -> commit stamp, resolved by the scheduler's
+        #: event mirror when the victim reaches a terminal state
+        self.pending_exits: Dict[str, float] = {}
+        #: victims shut down earlier in the current tick (excluded from
+        #: later groups' candidate sets — their resources are already
+        #: promised to committed preemptors)
+        self.shut_this_tick: set = set()
+        self.stats = {"preemptions": 0, "preempted_tasks_placed": 0,
+                      "inversions": 0, "budget_stops": 0}
+
+    # ------------------------------------------------------------ accounting
+
+    def begin_tick(self) -> int:
+        self.shut_this_tick = set()
+        # prune expired cooldown stamps: entries are only ever compared
+        # against the window, so dropping them here keeps the dict
+        # bounded by the slots preempted within one cooldown period
+        ts = now()
+        expired = [k for k, stamp in self.cooldowns.items()
+                   if ts - stamp >= self.cooldown]
+        for k in expired:
+            del self.cooldowns[k]
+        return self.budget
+
+    def note_preemptions(self, victims: List[Task], prio: int) -> None:
+        ts = now()
+        for vt in victims:
+            self.cooldowns[victim_slot_key(vt)] = ts
+            self.pending_exits[vt.id] = ts
+            self.shut_this_tick.add(vt.id)
+        self.stats["preemptions"] += len(victims)
+        _metrics.counter('swarm_preemptions{reason="priority"}',
+                         len(victims))
+
+    def note_skipped(self, reason: str, delta: int = 1) -> None:
+        if delta > 0:
+            _metrics.counter(f'swarm_preempt_skipped{{reason="{reason}"}}',
+                             delta)
+
+    def observe_commit_latency(self, t0: float) -> None:
+        _COMMIT_TIMER.observe(now() - t0)
+
+    def observe_task_gone(self, task_id: str) -> None:
+        """Scheduler event hook: a preempted victim reached a terminal
+        state (or was deleted) — close its exit-latency window."""
+        stamp = self.pending_exits.pop(task_id, None)
+        if stamp is not None:
+            _EXIT_TIMER.observe(now() - stamp)
+
+    def export_inversions(self, count: int) -> None:
+        """``swarm_priority_inversion``: pending higher-priority tasks a
+        feasible victim set existed for this tick but that were NOT
+        placed (budget stop / commit failure) — the signal the
+        ``priority_inversion`` health check judges."""
+        self.stats["inversions"] += count
+        _metrics.gauge("swarm_priority_inversion", float(count))

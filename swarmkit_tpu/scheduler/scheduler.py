@@ -32,9 +32,11 @@ from ..state.events import Event, EventCommit, EventSnapshotRestore
 from ..state.store import Batch, MemoryStore, ReadTx
 from ..state.watch import Closed
 from . import genericresource
+from . import preempt as preempt_mod
 from .filters import Pipeline, VolumesFilter
 from .nodeinfo import MAX_FAILURES, NodeInfo, task_reservations
 from .nodeset import DecisionTree, NodeSet
+from .preempt import PreemptSupervisor, task_priority
 from .volumes import VolumeSet
 
 log = logging.getLogger("scheduler")
@@ -172,7 +174,9 @@ class Scheduler:
                  batch_planner=None,
                  debounce_gap: float = COMMIT_DEBOUNCE_GAP,
                  max_latency: float = MAX_LATENCY,
-                 pipeline_depth: Optional[int] = None):
+                 pipeline_depth: Optional[int] = None,
+                 preempt_budget: Optional[int] = None,
+                 preempt_cooldown: Optional[float] = None):
         self.store = store
         # bounded-depth plan/commit software pipeline: while group i's
         # draft commits on the committer thread, group i+1's device plan
@@ -186,6 +190,12 @@ class Scheduler:
         self.debounce_gap = debounce_gap
         self.max_latency = max_latency
         self.unassigned_tasks: Dict[str, Task] = {}
+        # count of unassigned tasks in a positive priority band: while
+        # it is nonzero, a lower-priority task reaching RUNNING is a
+        # tick trigger — new preemption capacity just materialized
+        # (without this, a starving high-priority group would wait for
+        # an unrelated create/delete/node event to retry)
+        self._prio_pending = 0
         # incremental (service, spec-version) grouping of the unassigned
         # queue: maintained at enqueue/dequeue time so tick() does not pay
         # a per-task grouping pass (reference groups in tick,
@@ -206,6 +216,17 @@ class Scheduler:
         # tick instead of per-task objects
         self.block_draft: List[Tuple[List[Task], List[str], str]] = []
         self.block_mode = False
+
+        # priority preemption (scheduler/preempt.py): budget, anti-thrash
+        # cooldowns, and obs exports.  SWARM_PREEMPTION=0 disables the
+        # pass wholesale; with every priority at the default 0 band the
+        # pass is a no-op either way (positive priority opts a service
+        # into preempting).
+        import os as _os
+        self.preempt = PreemptSupervisor(budget=preempt_budget,
+                                         cooldown=preempt_cooldown)
+        self.preempt_enabled = \
+            _os.environ.get("SWARM_PREEMPTION", "") != "0"
 
         # leadership epoch captured at tick/preassigned-pass start; every
         # commit of that pass is pinned to it (None = unfenced proposer)
@@ -329,6 +350,7 @@ class Scheduler:
     def _resync(self) -> None:
         self.unassigned_tasks.clear()
         self.unassigned_groups.clear()
+        self._prio_pending = 0
         self.pending_preassigned_tasks.clear()
         self.preassigned_tasks.clear()
         self.all_tasks.clear()
@@ -361,6 +383,8 @@ class Scheduler:
 
     def _enqueue(self, t: Task) -> None:
         self.unassigned_tasks[t.id] = t
+        if task_priority(t) > 0:
+            self._prio_pending += 1
         sv = t.spec_version
         key = (t.service_id, sv.index) if sv is not None else None
         self.unassigned_groups.setdefault(key, {})[t.id] = t
@@ -368,6 +392,8 @@ class Scheduler:
     def _dequeue(self, task_id: str) -> None:
         t = self.unassigned_tasks.pop(task_id, None)
         if t is not None:
+            if task_priority(t) > 0:
+                self._prio_pending -= 1
             sv = t.spec_version
             key = (t.service_id, sv.index) if sv is not None else None
             group = self.unassigned_groups.get(key)
@@ -426,9 +452,15 @@ class Scheduler:
         info = self.node_set.node_info(t.node_id)
         if info is not None:
             info.add_task(t)
-        return False
+        # a lower-priority task reaching RUNNING while a positive band
+        # starves is preemption capacity arriving: tick
+        return (self._prio_pending > 0
+                and t.status.state == TaskState.RUNNING)
 
     def _delete_task(self, t: Task) -> bool:
+        # a preempted victim leaving the mirror (terminal status, or the
+        # orchestrator's dead-slot delete) closes its exit-latency window
+        self.preempt.observe_task_gone(t.id)
         self.all_tasks.pop(t.id, None)
         self.preassigned_tasks.discard(t.id)
         self.pending_preassigned_tasks.pop(t.id, None)
@@ -543,6 +575,7 @@ class Scheduler:
             groups = self.unassigned_groups
             self.unassigned_groups = {}
             self.unassigned_tasks.clear()
+            self._prio_pending = 0    # failures re-enqueue (re-count)
             one_off_tasks = groups.pop(None, {})
             if sp is not None:
                 sp.args = {"groups": len(groups),
@@ -603,6 +636,10 @@ class Scheduler:
                 self.volumes.release_volume(va.id, d.new.id)
             self._enqueue(d.old)
 
+        # priority preemption: higher-priority groups the normal pass
+        # left infeasible may evict strictly-lower-priority running work
+        n_decisions += self._preempt_pass()
+
         if not decisions and self.volumes.frees_pending:
             # releases without new decisions (task shutdowns) must still
             # queue node-unpublish for now-unused volumes (the decisions
@@ -622,17 +659,30 @@ class Scheduler:
         """The tick's task groups in scheduling order, with entries that
         were assigned out-of-band since enqueue dropped — one code path
         shared by the serial loop and the pipeline so group order (and
-        therefore commit/event order) is identical in both modes."""
+        therefore commit/event order) is identical in both modes.
+
+        Order is the PRIORITY-ORDERED pending queue: higher priority
+        classes schedule first so a constrained tick spends its capacity
+        on the important band.  The sort is stable over the insertion-
+        ordered group dicts (one-off tasks after service groups, as
+        before), so ties — including the all-default-priority case every
+        pre-priority workload is — keep the exact historical order and
+        placements stay byte-deterministic."""
+        entries: List[Tuple[int, Dict[str, Task]]] = []
         for group in groups.values():
             stale = [tid for tid, t in group.items()
                      if t is None or t.node_id]
             for tid in stale:
                 del group[tid]
             if group:
-                yield group
+                entries.append(
+                    (task_priority(next(iter(group.values()))), group))
         for t in one_off_tasks.values():
             if t is not None and not t.node_id:
-                yield {t.id: t}
+                entries.append((task_priority(t), {t.id: t}))
+        entries.sort(key=lambda e: -e[0])
+        for _, group in entries:
+            yield group
 
     def _run_group_pipeline(self, groups, one_off_tasks, decisions
                             ) -> Tuple[int, int, List[Tuple[Task, str]]]:
@@ -813,6 +863,189 @@ class Scheduler:
         # depth-1 unacked commits behind it
         committer.throttle(max(1, self.pipeline_depth - 1))
         return n
+
+    # ----------------------------------------------------------- preemption
+
+    def _preempt_pass(self) -> int:
+        """Evict strictly-lower-priority running tasks for pending
+        groups the normal scheduling pass could not place (the
+        priority & preemption subsystem — scheduler/preempt.py hosts
+        the oracle and policy state, ops/preempt.py the device kernel).
+
+        Each successful pick commits its victims' shutdown AND the
+        preemptor's assignment in one store transaction (the store pins
+        the write to the leadership epoch at commit start; the pass
+        itself refuses to run once the tick's reign is over), so the
+        orchestrators observe an atomic swap and requeue the victims'
+        slots at their own — lower — priority.  Returns the number of
+        preemptor tasks placed."""
+        sup = self.preempt
+        if sup is None or not self.preempt_enabled:
+            return 0
+        entries: List[Tuple[int, Dict[str, Task]]] = []
+        for key, group in self.unassigned_groups.items():
+            if not group:
+                continue
+            if key is None:
+                # the one-off bucket is heterogeneous (no shared spec):
+                # each task is its own singleton group, exactly as the
+                # normal pass schedules them (_tick_groups)
+                for t in group.values():
+                    if task_priority(t) > 0:
+                        entries.append((task_priority(t), {t.id: t}))
+                continue
+            prio = task_priority(next(iter(group.values())))
+            if prio > 0:    # only positive bands may preempt
+                entries.append((prio, group))
+        if not entries:
+            sup.export_inversions(0)
+            return 0
+        proposer = self.store._proposer
+        if proposer is not None \
+                and getattr(proposer, "leadership_epoch", None) \
+                != self._tick_epoch:
+            # the tick's reign is over: nothing may commit under it
+            sup.export_inversions(0)
+            return 0
+        entries.sort(key=lambda e: -e[0])    # stable: insertion ties
+        budget_rem = sup.begin_tick()
+        device = getattr(self.batch_planner, "select_victims", None)
+        placed_total = 0
+        inversions = 0
+        t_pass = now()
+        for prio, group in entries:
+            if budget_rem <= 0:
+                sup.note_skipped("budget", len(group))
+                inversions += len(group)
+                continue
+            t0 = next(iter(group.values()))
+            if not preempt_mod.preemptable_group(t0):
+                sup.note_skipped("unsupported", len(group))
+                continue
+            cpu_d, mem_d = preempt_mod.demand_of(t0)
+            skipped_cd: List[int] = []
+            cand = preempt_mod.build_candidates(
+                self, t0, prio, sup.shut_this_tick, sup.cooldowns,
+                sup.cooldown, skipped_cd)
+            if skipped_cd and skipped_cd[0]:
+                sup.note_skipped("cooldown", skipped_cd[0])
+            if cand is None:
+                continue
+            # host and device run the SAME capped pick count — the
+            # shared-iteration contract the differential fuzz pins
+            n_picks = min(len(group), budget_rem)
+            picks = None
+            if device is not None:
+                picks = device(cand, cpu_d, mem_d, n_picks, budget_rem)
+            if picks is None:
+                picks = preempt_mod.select_victims_host(
+                    cand, cpu_d, mem_d, n_picks, budget_rem)
+            if picks:
+                placed, victims_n = self._commit_preemption(
+                    group, t0, prio, cand, picks)
+                budget_rem -= victims_n
+                placed_total += placed
+            # still-pending positive-priority tasks with live lower-
+            # priority candidates = the inversion signal the
+            # priority_inversion health check judges.  Count against
+            # the unassigned queue, not the (possibly temporary
+            # singleton) group dict.
+            inversions += sum(1 for tid in group
+                              if tid in self.unassigned_tasks)
+        if placed_total:
+            sup.observe_commit_latency(t_pass)
+        sup.export_inversions(inversions)
+        self.stats["preemptions"] = sup.stats["preemptions"]
+        return placed_total
+
+    def _commit_preemption(self, group: Dict[str, Task], t0: Task,
+                           prio: int, cand, picks
+                           ) -> Tuple[int, int]:
+        """Commit the selected picks: one atomic transaction per pick
+        (victims' desired SHUTDOWN + preemption marker, preemptor's
+        ASSIGNED write), each re-validated against the store row so a
+        racing agent update skips the pick instead of corrupting it.
+        Returns (preemptors placed, victims shut down)."""
+        from ..models.types import Annotations
+        expanded = preempt_mod.replay_pick_victims(cand, picks)
+        items = list(group.items())
+        sup = self.preempt
+        placed = 0
+        victims_total = 0
+        ts = now()
+        for idx, (j, victims) in enumerate(expanded):
+            if idx >= len(items):
+                break
+            tid, _mirror = items[idx]
+            node_id = cand.infos[j].id
+            result: Dict[str, object] = {}
+
+            def cb(tx, tid=tid, node_id=node_id, victims=victims,
+                   result=result):
+                cur = tx.get(Task, tid)
+                if cur is None or cur.node_id \
+                        or cur.status.state != TaskState.PENDING \
+                        or cur.desired_state > TaskState.COMPLETE:
+                    return
+                vrows = []
+                for vt in victims:
+                    vcur = tx.get(Task, vt.id)
+                    if vcur is None \
+                            or vcur.desired_state > TaskState.COMPLETE \
+                            or vcur.status.state != TaskState.RUNNING \
+                            or vcur.node_id != vt.node_id:
+                        return    # a victim changed under us: skip pick
+                    vrows.append(vcur)
+                for vcur in vrows:
+                    nv = vcur.copy()
+                    nv.desired_state = TaskState.SHUTDOWN
+                    # replace-don't-mutate: fresh Annotations so the
+                    # committed marker never aliases the old object
+                    nv.annotations = Annotations(
+                        name=nv.annotations.name,
+                        labels={**nv.annotations.labels,
+                                "swarm.preempted.at": f"{ts:.3f}",
+                                "swarm.preempted.by": t0.service_id,
+                                "swarm.preempted.by.prio": str(prio),
+                                "swarm.preempted.prio": str(
+                                    task_priority(vcur))},
+                        indices=dict(nv.annotations.indices))
+                    tx.update(nv)
+                new_t = cur.copy()
+                new_t.node_id = node_id
+                new_t.status = TaskStatus(
+                    state=TaskState.ASSIGNED, timestamp=ts,
+                    message="scheduler assigned task to node "
+                            "(preempted lower-priority tasks)")
+                tx.update(new_t)
+                result["task"] = new_t
+                result["victims"] = victims
+
+            try:
+                self.store.update(cb)
+            except Exception:
+                # leadership loss or store failure: the pass stops; the
+                # group's remainder stays pending (counted as inversions)
+                log.exception("preemption transaction failed")
+                break
+            if "task" not in result:
+                # the pick was skipped (preemptor or a victim changed
+                # under us): STOP — later picks' feasibility may depend
+                # on this pick's evictions (same-node surplus carry),
+                # so committing them could overcommit the node.  The
+                # group's remainder retries next tick against fresh
+                # state.
+                break
+            new_t = result["task"]
+            self._dequeue(tid)
+            self.all_tasks[tid] = new_t
+            info = self.node_set.node_info(new_t.node_id)
+            if info is not None:
+                info.add_task(new_t)
+            sup.note_preemptions(result["victims"], prio)
+            victims_total += len(result["victims"])
+            placed += 1
+        return placed, victims_total
 
     def _commit_block_draft(self, want_ids: bool = True
                             ) -> Tuple[int, Optional[List[str]],

@@ -61,8 +61,8 @@ from ..utils.identity import set_id_source
 from .engine import SimEngine
 from .faults import NetConfig, SimNetwork
 from .invariants import (
-    RaftInvariants, TaskInvariants, UpdateInvariants, Violations,
-    check_placement_quality, entry_digest,
+    PreemptionInvariants, RaftInvariants, TaskInvariants,
+    UpdateInvariants, Violations, check_placement_quality, entry_digest,
 )
 
 #: entry-data prefix marking replicated control-plane store actions —
@@ -376,9 +376,16 @@ class SimAgent:
         cp.busy = True
         try:
             if self.session is None:
+                # the description carries the worker's resources: a
+                # registration without them would zero the node's
+                # capacity and starve reservation-carrying bands (the
+                # preemption scenarios schedule against these numbers)
                 self.session, _ = d.register(
                     self.node_id,
-                    description=NodeDescription(hostname=self.node_id))
+                    description=NodeDescription(
+                        hostname=self.node_id,
+                        resources=Resources(nano_cpus=8 * 10 ** 9,
+                                            memory_bytes=32 << 30)))
                 self.engine.log(f"agent {self.node_id} registered")
             else:
                 d.heartbeat(self.node_id, self.session)
@@ -423,6 +430,12 @@ class SimAgent:
                         message="sim fault", err="injected failure")))
                     self.engine.log(f"agent {self.node_id} failed task "
                                     f"{t.id}")
+                elif t.desired_state == TaskState.COMPLETE:
+                    # job task (jobs orchestrator): runs to completion
+                    # one agent step after reaching RUNNING
+                    updates.append((t.id, TaskStatus(
+                        state=TaskState.COMPLETE, timestamp=now(),
+                        message="sim job complete")))
                 continue
             nxt = self.FSM_NEXT.get(state)
             if nxt is None or nxt > t.desired_state:
@@ -799,7 +812,8 @@ class SimMemberControl:
 
     def __init__(self, member: SimManager, cp: "RaftControlPlane"):
         from ..orchestrator import (
-            GlobalOrchestrator, ReplicatedOrchestrator, RestartSupervisor,
+            GlobalOrchestrator, JobsOrchestrator, ReplicatedOrchestrator,
+            RestartSupervisor,
         )
         from ..orchestrator.update import Supervisor as UpdateSupervisor
         self.member = member
@@ -824,9 +838,18 @@ class SimMemberControl:
         # break determinism; store-level chunk-pipelined proposals
         # (pipeline_depth above) are the pipelining under test here
         self.scheduler = Scheduler(store, batch_planner=planner,
-                                   pipeline_depth=1)
+                                   pipeline_depth=1,
+                                   preempt_budget=cp.preempt_budget,
+                                   preempt_cooldown=cp.preempt_cooldown)
+        # checker-sensitivity seam: preemption off means a feasible
+        # higher-priority task can starve — no-priority-inversion fires
+        self.scheduler.preempt_enabled = cp.preemption_enabled
         self.scheduler.pipeline.add_filter(
             VolumesFilter(self.scheduler.volumes))
+        # jobs orchestrator (run-to-completion work coexisting with
+        # services): driven threadless like the other orchestrators, so
+        # job iterations survive leader failover via the replicated store
+        self.jobs = JobsOrchestrator(store, restarts=self.restarts)
         # REAL rolling-update supervisors in threadless mode: the
         # orchestrators' reconcile hands dirty slots to them, and
         # step() pumps their FSMs under virtual time — spec rollouts
@@ -860,7 +883,8 @@ class SimMemberControl:
         self._drivers.append((self.allocator, sub, self.allocator._tick))
         self.allocator._resync()
         for orch, tick in ((self.replicated, self.replicated._tick),
-                           (self.global_, self.global_._tick_tasks)):
+                           (self.global_, self.global_._tick_tasks),
+                           (self.jobs, self.jobs._tick)):
             sub = store.queue.subscribe(accepts_blocks=True)
             self._drivers.append((orch, sub, tick))
             taskinit.check_tasks(store, store.view(), orch, self.restarts)
@@ -1006,6 +1030,23 @@ class RaftControlPlane:
         #: opt-in post-convergence placement-quality bound (see
         #: invariants.check_placement_quality); None disables
         self.placement_quality_bound: Optional[float] = None
+        # ---- priority & preemption scenario surface
+        #: checker-sensitivity seam: False disables the scheduler's
+        #: preemption pass so no-priority-inversion must fire
+        self.preemption_enabled = True
+        #: scheduler knobs, applied at (re)attach (None = defaults)
+        self.preempt_budget: Optional[int] = None
+        self.preempt_cooldown: Optional[float] = None
+        #: PreemptionInvariants knobs (per-member checkers)
+        self.preempt_inversion_bound = 25.0
+        self.preempt_thrash_bound = 3
+        #: end-state expectation: the scenario requires >= 1 preemption
+        #: to have been observed (coverage, not safety)
+        self.expect_preemptions = False
+        #: (service_id, total_completions) end-state job expectations
+        self.job_expectations: List[tuple] = []
+        #: preemption records archived from crash-replaced checkers
+        self._preempt_archive: List[tuple] = []
         self._dispatcher_totals = {"heartbeats": 0, "expirations": 0}
         self.proposers: Dict[str, SimRaftProposer] = {}
         for m in sim.managers:
@@ -1107,17 +1148,23 @@ class RaftControlPlane:
     # --------------------------------------------------------- control step
 
     def _checker_for(self, m: SimManager) -> Optional[tuple]:
-        """(TaskInvariants, UpdateInvariants) for a member's replicated
-        store, rebuilt when a restart replaces the store object."""
+        """(TaskInvariants, UpdateInvariants, PreemptionInvariants) for
+        a member's replicated store, rebuilt when a restart replaces
+        the store object."""
         if m.store is None:
             return None
         entry = self._inv.get(m.id)
         if entry is None or entry[0] is not m.store:
             if entry is not None:
                 self._update_history.extend(entry[2].history)
+                self._preempt_archive.extend(entry[3].preempted)
             entry = (m.store,
                      TaskInvariants(self.violations, m.store),
-                     UpdateInvariants(self.violations, m.store, tag=m.id))
+                     UpdateInvariants(self.violations, m.store, tag=m.id),
+                     PreemptionInvariants(
+                         self.violations, m.store, tag=m.id,
+                         inversion_bound=self.preempt_inversion_bound,
+                         thrash_bound=self.preempt_thrash_bound))
             self._inv[m.id] = entry
         return entry[1:]
 
@@ -1275,6 +1322,82 @@ class RaftControlPlane:
         the sim's id source)."""
         self.scale(self.desired_replicas + n)
 
+    # ------------------------------------------- priority / jobs workloads
+
+    def _apply_workload(self, label: str, cb) -> None:
+        """Write a workload mutation through the leader store, retrying
+        across failover gaps (the scale()/rollout() discipline); ``cb``
+        must be idempotent — a dropped-but-committed proposal retries."""
+        mc = self.active
+        if (self.stopped or mc is None or mc.detached or self.busy
+                or not self._bootstrapped):
+            self.engine.after(0.5, f"{label} retry",
+                              lambda: self._apply_workload(label, cb))
+            return
+        self.busy = True
+        try:
+            mc.store.update(cb)
+            self.engine.log(f"workload {label}")
+        except AGENT_RPC_ERRORS as e:
+            self.engine.log(
+                f"workload {label} failed: {type(e).__name__}")
+            self.engine.after(0.5, f"{label} retry",
+                              lambda: self._apply_workload(label, cb))
+        finally:
+            self.busy = False
+
+    def add_service(self, sid: str, replicas: int, priority: int = 0,
+                    nano_cpus: int = 0, memory_bytes: int = 0) -> None:
+        """Create a replicated service in a priority band, optionally
+        with per-task reservations (the preemption scenarios' workload:
+        bands contending for finite node capacity).  The SERVICE-level
+        priority is used deliberately — it exercises the
+        ServiceSpec.priority -> task spec propagation path."""
+        from ..models.types import ResourceRequirements
+
+        def cb(tx):
+            if tx.get(Service, sid) is not None:
+                return
+            res = ResourceRequirements(reservations=Resources(
+                nano_cpus=nano_cpus, memory_bytes=memory_bytes))
+            tx.create(Service(
+                id=sid,
+                spec=ServiceSpec(
+                    annotations=Annotations(name=sid),
+                    mode=ServiceMode.REPLICATED,
+                    replicated=ReplicatedService(replicas=replicas),
+                    task=TaskSpec(resources=res),
+                    priority=priority),
+                spec_version=Version(index=1)))
+        self._apply_workload(
+            f"service {sid} x{replicas} prio={priority}", cb)
+
+    def run_job(self, sid: str, total: int, max_concurrent: int = 0,
+                priority: int = 0) -> None:
+        """Create a replicated run-to-completion job (jobs orchestrator:
+        ``total`` unique slots, at most ``max_concurrent`` in flight)."""
+        from ..models.specs import ReplicatedJob
+
+        def cb(tx):
+            if tx.get(Service, sid) is not None:
+                return
+            tx.create(Service(
+                id=sid,
+                spec=ServiceSpec(
+                    annotations=Annotations(name=sid),
+                    mode=ServiceMode.REPLICATED_JOB,
+                    replicated_job=ReplicatedJob(
+                        total_completions=total,
+                        max_concurrent=max_concurrent),
+                    task=TaskSpec(),
+                    priority=priority),
+                spec_version=Version(index=1)))
+        self._apply_workload(f"job {sid} x{total}", cb)
+
+    def expect_job_complete(self, sid: str, total: int) -> None:
+        """End-state bound: the job must show ``total`` completions."""
+        self.job_expectations.append((sid, total))
+
     # --------------------------------------------------------- spec rollouts
 
     def rollout(self, image: str, update=None, rollback=None,
@@ -1373,10 +1496,34 @@ class RaftControlPlane:
         """Finish-time checks: flush deferred completion checks, judge
         the registered convergence expectations against the merged
         per-member histories (any member observing a state counts —
-        a crash-rebuilt store starts a fresh history), and apply the
-        opt-in placement-quality bound."""
+        a crash-rebuilt store starts a fresh history), the preemption
+        requeue/coverage checks, the job-completion expectations, and
+        the opt-in placement-quality bound."""
         for c in self._update_checkers():
             c.finalize()
+        pre_checkers = [entry[3] for entry in self._inv.values()]
+        for c in pre_checkers:
+            c.finalize()
+        if self.expect_preemptions:
+            seen = len(self._preempt_archive) + max(
+                (c.seen_preemptions for c in pre_checkers), default=0)
+            if not seen:
+                violations.record(
+                    "preemptions-observed",
+                    "scenario expected priority preemption to fire but "
+                    "no preemption marker was ever committed")
+        if self.job_expectations and self.store is not None:
+            tasks = self.store.view(lambda tx: tx.find(Task))
+            for sid, total in self.job_expectations:
+                done = sum(1 for t in tasks
+                           if t.service_id == sid and t.status.state
+                           == int(TaskState.COMPLETE))
+                if done < total:
+                    violations.record(
+                        "job-completions-converge",
+                        f"job {sid}: {done}/{total} completions after "
+                        "heal+grace — job iterations lost across "
+                        "failover")
         history = self.merged_update_history()
         for version, states, by, label in self.update_expectations:
             hit = [h for h in history

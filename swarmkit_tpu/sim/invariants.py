@@ -449,6 +449,231 @@ class UpdateInvariants:
         self._pending_checks = []
 
 
+class PreemptionInvariants:
+    """Priority & preemption invariants, tracked from one store's
+    ordered event stream (payload discipline like TaskInvariants):
+
+    * no-preempt-equal-or-higher — every preemption marker
+      (``swarm.preempted.*`` annotations stamped by the scheduler's
+      atomic preemption tx) must name a victim priority STRICTLY below
+      the preemptor's; equal-or-higher anywhere is a safety violation.
+    * no-priority-inversion — a positive-priority task that stays
+      PENDING past ``inversion_bound`` virtual seconds while some node
+      it fits (resource-wise, counting the reservations of its
+      strictly-lower-priority running tasks as reclaimable) holds
+      lower-priority work is an inversion the preemption pass should
+      have resolved.
+    * preemption-thrash-bound — one slot preempted more than
+      ``thrash_bound`` times inside ``thrash_window`` virtual seconds
+      is thrash the anti-thrash cooldown exists to prevent.
+    * preempted-tasks-requeue (``finalize``) — every preempted victim's
+      slot must hold a NEWER runnable (or completed) task by scenario
+      end, unless the service shrank below the slot or was deleted:
+      preemption evicts work, it never loses it.
+    """
+
+    def __init__(self, violations: Violations, store, tag: str = "",
+                 inversion_bound: float = 25.0, thrash_bound: int = 3,
+                 thrash_window: float = 60.0):
+        self.v = violations
+        self.store = store
+        self.tag = tag
+        self.inversion_bound = inversion_bound
+        self.thrash_bound = thrash_bound
+        self.thrash_window = thrash_window
+        #: pending positive-priority unassigned tasks -> first-seen t
+        self.pending_since: Dict[str, float] = {}
+        self._judged: set = set()
+        self._seen_markers: set = set()
+        self._thrash_flagged: set = set()
+        self._slot_stamps: Dict[tuple, List[float]] = {}
+        #: (t, service_id, slot, node_id, victim_id) per observed marker
+        self.preempted: List[tuple] = []
+        self.seen_preemptions = 0
+        from ..scheduler.preempt import task_priority
+        self._priority = task_priority
+        self.sub = store.queue.subscribe(
+            lambda ev: isinstance(ev, Event)
+            and isinstance(ev.obj, Task), accepts_blocks=True)
+
+        # baseline adoption (TaskInvariants discipline): a crash-rebuilt
+        # store replays no history — seed pending-age tracking from the
+        # committed rows so a long-pending inversion survives the crash
+        def seed(tx):
+            ts = self._now()
+            for t in tx.find(Task):
+                if (not t.node_id
+                        and t.status.state == int(TaskState.PENDING)
+                        and t.desired_state <= int(TaskState.COMPLETE)
+                        and self._priority(t) > 0):
+                    self.pending_since[t.id] = ts
+                if "swarm.preempted.at" in t.annotations.labels:
+                    self._seen_markers.add(t.id)
+        store.view(seed)
+
+    def _now(self) -> float:
+        return self.v.engine.clock.elapsed()
+
+    # ---------------------------------------------------------------- drain
+
+    def drain(self) -> None:
+        while True:
+            ev = self.sub.poll()
+            if ev is None:
+                break
+            t = ev.obj
+            if ev.action == "delete":
+                self.pending_since.pop(t.id, None)
+                continue
+            if (not t.node_id
+                    and t.status.state == int(TaskState.PENDING)
+                    and t.desired_state <= int(TaskState.COMPLETE)
+                    and self._priority(t) > 0):
+                self.pending_since.setdefault(t.id, self._now())
+            else:
+                self.pending_since.pop(t.id, None)
+            labels = t.annotations.labels
+            if "swarm.preempted.at" in labels \
+                    and t.id not in self._seen_markers:
+                self._seen_markers.add(t.id)
+                self._observe_preemption(t, labels)
+        ts = self._now()
+        for tid, since in list(self.pending_since.items()):
+            if ts - since > self.inversion_bound:
+                self._judge_inversion(tid, ts)
+
+    def _observe_preemption(self, t: Task, labels: Dict[str, str]) -> None:
+        self.seen_preemptions += 1
+        try:
+            victim_prio = int(labels.get("swarm.preempted.prio", "0"))
+            by_prio = int(labels.get("swarm.preempted.by.prio", "0"))
+        except ValueError:
+            victim_prio, by_prio = 0, 0
+        if victim_prio >= by_prio:
+            self.v.record(
+                "no-preempt-equal-or-higher",
+                f"{self.tag}: task {t.id[:8]} (priority {victim_prio}) "
+                f"preempted by priority {by_prio} work — victims must "
+                "be strictly lower")
+        ts = self._now()
+        key = (t.service_id, t.slot, t.node_id if not t.slot else "")
+        stamps = [s for s in self._slot_stamps.get(key, [])
+                  if ts - s < self.thrash_window] + [ts]
+        self._slot_stamps[key] = stamps
+        if len(stamps) > self.thrash_bound \
+                and key not in self._thrash_flagged:
+            self._thrash_flagged.add(key)
+            self.v.record(
+                "preemption-thrash-bound",
+                f"{self.tag}: slot {key} preempted {len(stamps)} times "
+                f"inside {self.thrash_window:.0f}s (bound "
+                f"{self.thrash_bound}) — anti-thrash cooldown broken")
+        self.preempted.append((ts, t.service_id, t.slot, t.node_id,
+                               t.id))
+
+    # --------------------------------------------------------------- checks
+
+    def _judge_inversion(self, tid: str, ts: float) -> None:
+        """Judge one overdue pending task.  A clean verdict RE-ARMS the
+        stamp (the task is judged again after another bound) — an
+        inversion that only develops later must still be caught; a
+        recorded violation stops tracking (one report per task)."""
+        if tid in self._judged:
+            self.pending_since.pop(tid, None)
+            return
+        task = self.store.raw_get(Task, tid)
+        if task is None or task.node_id \
+                or task.status.state != int(TaskState.PENDING):
+            self.pending_since.pop(tid, None)
+            return
+        p = self._priority(task)
+        res = task.spec.resources.reservations if task.spec.resources \
+            else None
+        if res is None or (not res.nano_cpus and not res.memory_bytes) \
+                or res.generic:
+            # non-resource infeasibility: not preemption's job, and it
+            # cannot become one — stop tracking this task
+            self.pending_since.pop(tid, None)
+            return
+        cpu_d, mem_d = int(res.nano_cpus), int(res.memory_bytes)
+
+        def scan(tx):
+            from ..scheduler.nodeinfo import task_reservations
+            by_node: Dict[str, list] = {}
+            for t in tx.find(Task):
+                if t.node_id and t.desired_state <= int(TaskState.COMPLETE) \
+                        and t.status.state <= int(TaskState.RUNNING):
+                    by_node.setdefault(t.node_id, []).append(t)
+            for n in tx.find(Node):
+                if n.status.state != int(NodeState.READY) \
+                        or n.spec.availability != 0 \
+                        or not n.description or not n.description.resources:
+                    continue
+                free_cpu = int(n.description.resources.nano_cpus)
+                free_mem = int(n.description.resources.memory_bytes)
+                reclaim_cpu = reclaim_mem = 0
+                lower = False
+                for t in by_node.get(n.id, []):
+                    r = task_reservations(t)
+                    free_cpu -= int(r.nano_cpus)
+                    free_mem -= int(r.memory_bytes)
+                    if self._priority(t) < p \
+                            and t.status.state == int(TaskState.RUNNING):
+                        lower = True
+                        reclaim_cpu += int(r.nano_cpus)
+                        reclaim_mem += int(r.memory_bytes)
+                if lower and free_cpu + reclaim_cpu >= cpu_d \
+                        and free_mem + reclaim_mem >= mem_d:
+                    return n.id
+            return None
+
+        node = self.store.view(scan)
+        if node is not None:
+            self._judged.add(tid)
+            self.pending_since.pop(tid, None)
+            self.v.record(
+                "no-priority-inversion",
+                f"{self.tag}: task {tid[:8]} (priority {p}) pending > "
+                f"{self.inversion_bound:.0f}s while lower-priority work "
+                f"on node {node} covers its demand — preemption should "
+                "have resolved this")
+        else:
+            # clean right now: re-arm — an inversion may develop later
+            self.pending_since[tid] = ts
+
+    def finalize(self) -> None:
+        """Scenario end: every preempted slot must have been requeued —
+        a newer task occupies the (service, slot), or the service
+        legitimately shrank/vanished."""
+        self.drain()
+
+        def judge(tx):
+            missing = []
+            for ts, sid, slot, node_id, victim_id in self.preempted:
+                svc = tx.get(Service, sid)
+                if svc is None:
+                    continue
+                if svc.spec.replicated is not None \
+                        and svc.spec.replicated.replicas < slot:
+                    continue    # scaled below the slot: no requeue owed
+                again = [t for t in tx.find(Task)
+                         if t.service_id == sid and t.slot == slot
+                         and t.id != victim_id
+                         and (t.desired_state <= int(TaskState.COMPLETE)
+                              or t.status.state
+                              == int(TaskState.COMPLETE))]
+                if not again:
+                    missing.append((sid, slot, victim_id))
+            return missing
+
+        for sid, slot, victim_id in self.store.view(judge):
+            self.v.record(
+                "preempted-tasks-requeue",
+                f"{self.tag}: victim {victim_id[:8]} of service {sid} "
+                f"slot {slot} was never requeued — preemption lost "
+                "work")
+
+
 def check_placement_quality(violations: Violations, store,
                             bound: float = 3.0,
                             record: str = "placement-quality") -> None:

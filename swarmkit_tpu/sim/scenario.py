@@ -880,11 +880,19 @@ def _failover_churn_rollout(sim: Sim) -> float:
     """Scale rollout (up, down, up) under leader churn, agent churn and
     a task-failure storm: the restart supervisor and orchestrators must
     keep the replica count converging across two leadership hand-offs
-    with no lost or duplicated restarts."""
+    with no lost or duplicated restarts.  A replicated JOB rides along
+    (jobs orchestrator live in the raft-attached control plane): its
+    ``total_completions`` must all land despite the leader hand-offs —
+    job iterations survive failover via the replicated store."""
     eng = sim.engine
     cp = sim.cp
     sim.start_raft_workload(interval=0.8)
     cp.create_tasks(10)
+    # jobs under churn: 6 completions through a max_concurrent=2 window,
+    # spanning both leadership hand-offs below
+    eng.at(eng.clock.start + 6.0, "job under churn",
+           lambda: cp.run_job("job-churn", total=6, max_concurrent=2))
+    cp.expect_job_complete("job-churn", 6)
     eng.at(eng.clock.start + 10.0, "scale up", lambda: cp.scale(16))
     eng.at(eng.clock.start + 20.0, "scale down", lambda: cp.scale(6))
     eng.at(eng.clock.start + 28.0, "scale up again",
@@ -918,6 +926,59 @@ def _failover_churn_rollout(sim: Sim) -> float:
 
 
 _failover_churn_rollout.raft_cp = True
+
+
+def _preemption_storm(sim: Sim) -> float:
+    """Priority bands arriving under node churn and leader stepdown:
+    three replicated bands (priority 0 / 5 / 10) with per-task cpu
+    reservations contend for 5 workers x 4 slots.  Two node deaths
+    shrink capacity to 12 slots just as the higher bands arrive, so the
+    mid and high bands are infeasible without evicting the low band —
+    the scheduler's preemption pass (device victim kernel behind the
+    breaker seam, host oracle on fallback) must place them, the
+    orchestrators must requeue the evicted slots, and after heal the
+    whole workload (20 tasks) fits again.  Judged by the preemption
+    invariants (no-priority-inversion, no-preempt-equal-or-higher,
+    preemption-thrash-bound, preempted-tasks-requeue) plus the
+    preemptions-observed coverage check."""
+    eng = sim.engine
+    cp = sim.cp
+    cp.planner_factory = _device_planner    # device victim selection
+    cp.expect_preemptions = True
+    sim.start_raft_workload(interval=0.8)
+
+    CPU = 2 * 10 ** 9    # 4 slots per 8-cpu worker
+    eng.at(eng.clock.start + 6.0, "band lo",
+           lambda: cp.add_service("svc-lo", 12, priority=0,
+                                  nano_cpus=CPU))
+    # node churn: two workers die while the higher bands arrive
+    a = cp.agents
+    eng.at(eng.clock.start + 20.0, "node death w0", a[0].crash)
+    eng.at(eng.clock.start + 24.0, "node death w1", a[1].crash)
+    eng.at(eng.clock.start + 22.0, "band mid",
+           lambda: cp.add_service("svc-mid", 4, priority=5,
+                                  nano_cpus=CPU))
+
+    def high_band():
+        # the burst the coverage matrix requires: the high band lands on
+        # a shrunken cluster and must preempt its way in
+        eng.log("fault preempt-burst scheduler")
+        cp.add_service("svc-hi", 4, priority=10, nano_cpus=CPU)
+    eng.at(eng.clock.start + 30.0, "band high (preempt burst)",
+           high_band)
+
+    eng.at(eng.clock.start + 34.0, "stepdown mid-storm",
+           sim.stepdown_leader)
+    eng.at(eng.clock.start + 40.0, "drop burst",
+           lambda: setattr(sim.net.config, "drop_p", 0.1))
+    eng.at(eng.clock.start + 46.0, "drop off",
+           lambda: setattr(sim.net.config, "drop_p", 0.0))
+    eng.at(eng.clock.start + 50.0, "node return w0", a[0].restart)
+    eng.at(eng.clock.start + 54.0, "node return w1", a[1].restart)
+    return 80.0
+
+
+_preemption_storm.raft_cp = True
 
 
 # ----------------------------------------------- rolling-update scenarios
@@ -1180,6 +1241,8 @@ SCENARIOS: Dict[str, Callable[[Sim], float]] = {
     "partition-pipelined-commit": _mk_partition_pipelined_commit(2),
     "partition-pipelined-commit-d1": _mk_partition_pipelined_commit(1),
     "failover-churn-rollout": _failover_churn_rollout,
+    # priority & preemption (device victim kernel + host oracle)
+    "preemption-storm": _preemption_storm,
     # rolling-update suite (real UpdateSupervisor, threadless drive)
     "rolling-upgrade-chaos": _rolling_upgrade_chaos,
     "cascading-failure-rebalance": _cascading_failure_rebalance,
@@ -1203,6 +1266,9 @@ FAILOVER_SCENARIOS = (
 UPDATE_SCENARIOS = (
     "rolling-upgrade-chaos", "cascading-failure-rebalance", "long-soak",
 )
+
+#: priority & preemption suite (ISSUE 10)
+PREEMPT_SCENARIOS = ("preemption-storm",)
 
 #: legacy fault timelines re-driven through Sim(raft_cp=True)
 LEGACY_RCP_SCENARIOS = (

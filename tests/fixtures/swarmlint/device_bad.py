@@ -50,3 +50,15 @@ def plan_fused_sharded(x):
         return xl.sum()
 
     return shard_map(kernel, mesh=None, in_specs=None, out_specs=None)(x)
+
+
+@functools.partial(jax.jit, static_argnames=("picks",))
+def select_victims(vprio, vcpu, demand, budget, picks):
+    # preemption victim kernel: the pick scan is device code too
+    def pick(state, _):
+        cost = np.cumsum(vcpu)              # numpy in the pick step
+        best = int(cost.argmin())           # int() on a traced value
+        return state - best, best
+
+    out, chosen = jax.lax.scan(pick, budget, None, length=picks)
+    return jax.device_get(chosen)           # picks fetched mid-program

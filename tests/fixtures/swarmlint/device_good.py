@@ -66,3 +66,16 @@ def dispatch_chunks(run, chunks):
     # host driver: np staging + device placement happen OUTSIDE jit
     staged = [np.asarray(c) for c in chunks]
     return [jax.device_put(s) for s in staged]
+
+
+@functools.partial(jax.jit, static_argnames=("picks",))
+def select_victims(vprio, vcpu, demand, budget, picks):
+    # preemption victim kernel: prefix sums + argmin stay on device; the
+    # caller (host driver) fetches the finished pick arrays
+    def pick(state, _):
+        cost = jnp.cumsum(vcpu)
+        best = jnp.argmin(cost).astype(jnp.int32)
+        return state - best, best
+
+    out, chosen = jax.lax.scan(pick, budget, None, length=picks)
+    return chosen

@@ -168,6 +168,158 @@ def test_constraint_enforcer_evicts_on_label_change(store):
         ce.stop()
 
 
+# ---------------------------------------------------------------------------
+# reconciler-level BDD cases (reference: manager/orchestrator/jobs/
+# replicated/reconciler_test.go + global/reconciler_test.go — the
+# fake-reconciler pattern: drive reconcile_service directly, no threads)
+# ---------------------------------------------------------------------------
+
+def _reconciler(store):
+    from swarmkit_tpu.orchestrator.jobs import ReplicatedJobReconciler
+    from swarmkit_tpu.orchestrator.restart import Supervisor
+    return ReplicatedJobReconciler(store, Supervisor(store,
+                                                     start_worker=False))
+
+
+def _global_reconciler(store):
+    from swarmkit_tpu.orchestrator.jobs import GlobalJobReconciler
+    from swarmkit_tpu.orchestrator.restart import Supervisor
+    return GlobalJobReconciler(store, Supervisor(store,
+                                                 start_worker=False))
+
+
+def _set_state(store, task_id, state):
+    def cb(tx):
+        t = tx.get(Task, task_id).copy()
+        t.status = TaskStatus(state=state, timestamp=now())
+        tx.update(t)
+    store.update(cb)
+
+
+def test_reconciler_max_concurrent_window_refill(store):
+    """The in-flight window refills one-for-one as completions land,
+    never exceeding max_concurrent, until total_completions slots
+    exist (reconciler_test.go 'number of tasks' cases)."""
+    svc = make_replicated_job("w", total=5, max_concurrent=2)
+    store.update(lambda tx: tx.create(svc))
+    r = _reconciler(store)
+    r.reconcile_service(svc.id, None)
+    got = tasks_of(store, svc)
+    assert len(got) == 2 and {t.slot for t in got} == {0, 1}
+
+    # re-reconcile without progress: the window must NOT grow
+    r.reconcile_service(svc.id, None)
+    assert len(tasks_of(store, svc)) == 2
+
+    # one completion -> exactly one refill, in the next free slot
+    _set_state(store, got[0].id, TaskState.COMPLETE)
+    r.reconcile_service(svc.id, None)
+    got = tasks_of(store, svc)
+    assert len(got) == 3 and {t.slot for t in got} == {0, 1, 2}
+
+    # drain to done: 5 completions, no 6th slot ever created
+    for _ in range(6):
+        for t in tasks_of(store, svc):
+            if t.status.state != TaskState.COMPLETE:
+                _set_state(store, t.id, TaskState.COMPLETE)
+        r.reconcile_service(svc.id, None)
+    got = tasks_of(store, svc)
+    assert sorted(t.slot for t in got) == [0, 1, 2, 3, 4]
+    assert all(t.status.state == TaskState.COMPLETE for t in got)
+
+
+def test_reconciler_failed_task_restarts_in_window(store):
+    """A failed job task routes through the restart supervisor (new
+    task, same slot) and still counts against the window."""
+    svc = make_replicated_job("f", total=3, max_concurrent=2)
+    store.update(lambda tx: tx.create(svc))
+    r = _reconciler(store)
+    r.reconcile_service(svc.id, None)
+    first = tasks_of(store, svc)
+    _set_state(store, first[0].id, TaskState.FAILED)
+    r.reconcile_service(svc.id, None)
+    got = tasks_of(store, svc)
+    # the failed task is marked down and a replacement owns its slot;
+    # the window stays at 2 live tasks
+    live = [t for t in got if t.desired_state <= TaskState.COMPLETE]
+    assert len(live) == 2
+    assert {t.slot for t in live} == {t.slot for t in first}
+    dead = [t for t in got if t.id == first[0].id]
+    assert dead and dead[0].desired_state > TaskState.COMPLETE
+
+
+def test_reconciler_stale_job_iteration_removed(store):
+    """Bumping job_status.job_iteration marks every older-iteration
+    task REMOVE and refills the window at the new iteration
+    (reconciler_test.go 'removes tasks of old iterations')."""
+    from swarmkit_tpu.models.objects import JobStatus
+    svc = make_replicated_job("it", total=2, max_concurrent=2)
+    store.update(lambda tx: tx.create(svc))
+    r = _reconciler(store)
+    r.reconcile_service(svc.id, None)
+    old = tasks_of(store, svc)
+    assert all((t.job_iteration.index if t.job_iteration else 0) == 0
+               for t in old)
+
+    def bump(tx):
+        s = tx.get(Service, svc.id).copy()
+        s.job_status = JobStatus(job_iteration=Version(index=1))
+        tx.update(s)
+    store.update(bump)
+    r.reconcile_service(svc.id, None)
+    got = tasks_of(store, svc)
+    stale = [t for t in got if t.id in {o.id for o in old}]
+    fresh = [t for t in got if t.id not in {o.id for o in old}]
+    assert stale and all(t.desired_state == TaskState.REMOVE
+                         for t in stale)
+    assert len(fresh) == 2
+    assert all(t.job_iteration.index == 1 for t in fresh)
+    # REMOVE is idempotent: a second pass changes nothing
+    before = {t.id: t.desired_state for t in tasks_of(store, svc)}
+    r.reconcile_service(svc.id, None)
+    assert {t.id: t.desired_state
+            for t in tasks_of(store, svc)} == before
+
+
+def test_global_reconciler_node_join_fill_and_filters(store):
+    """Global jobs run once per constraint-matching node; joins fill,
+    paused/drained/constraint-failing nodes are excluded
+    (global/reconciler_test.go node cases)."""
+    from swarmkit_tpu.models.types import NodeAvailability
+    n1 = make_node("g1", labels={"tier": "batch"})
+    n2 = make_node("g2", labels={"tier": "web"})
+    store.update(lambda tx: (tx.create(n1), tx.create(n2)))
+    svc = Service(
+        id=new_id(),
+        spec=ServiceSpec(
+            annotations=Annotations(name="gj"),
+            task=TaskSpec(
+                container=ContainerSpec(image="job:1"),
+                placement=Placement(
+                    constraints=["node.labels.tier==batch"])),
+            mode=ServiceMode.GLOBAL_JOB),
+        spec_version=Version(index=1))
+    store.update(lambda tx: tx.create(svc))
+    r = _global_reconciler(store)
+    r.reconcile_service(svc.id, None)
+    got = tasks_of(store, svc)
+    assert [t.node_id for t in got] == [n1.id], \
+        "constraint must exclude the web node"
+
+    # node join: a new matching node gets its completion; a PAUSED one
+    # does not
+    n3 = make_node("g3", labels={"tier": "batch"})
+    n4 = make_node("g4", labels={"tier": "batch"})
+    n4.spec.availability = NodeAvailability.PAUSE
+    store.update(lambda tx: (tx.create(n3), tx.create(n4)))
+    r.reconcile_service(svc.id, None)
+    got = tasks_of(store, svc)
+    assert {t.node_id for t in got} == {n1.id, n3.id}
+    # idempotent once covered
+    r.reconcile_service(svc.id, None)
+    assert len(tasks_of(store, svc)) == 2
+
+
 def test_volume_enforcer_removes_tasks_on_drained_volume(store):
     vol = Volume(id=new_id(),
                  spec=VolumeSpec(annotations=Annotations(name="vol1")))

@@ -95,10 +95,13 @@ def test_rule_passes_clean_twin(rule):
     ("epoch-fencing", 4),      # 3 unfenced calls + 1 fencing-blind def
     ("lock-discipline", 3),    # order cycle + 2 blocking-under-lock
     ("layering", 4),           # state/manager/sim/orchestrator imports
-    ("device-path-purity", 8),  # float()/np./jax.debug/.item() + the
-    #                             fused shapes: np/.item() in a scan
-    #                             step, mid-program device_get,
-    #                             block_until_ready in a mesh kernel
+    ("device-path-purity", 11),  # float()/np./jax.debug/.item() + the
+    #                              fused shapes: np/.item() in a scan
+    #                              step, mid-program device_get,
+    #                              block_until_ready in a mesh kernel +
+    #                              the preempt-kernel shapes (ISSUE 10):
+    #                              np.cumsum/int() in the pick scan,
+    #                              picks fetched mid-program
     ("metric-hygiene", 4),     # bad chars/unsorted/duplicate/upper key
 ])
 def test_rule_sensitivity_floor(rule, min_findings):
