@@ -46,7 +46,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from swarmkit_tpu.sim.scenario import (          # noqa: E402
     FAILOVER_SCENARIOS, FUZZ_POOL, LEGACY_RCP_SCENARIOS,
-    PREEMPT_SCENARIOS, SCENARIOS, UPDATE_SCENARIOS, run_scenario,
+    PREEMPT_SCENARIOS, READ_SCENARIOS, SCENARIOS, UPDATE_SCENARIOS,
+    run_scenario,
 )
 
 #: named scenario subsets.  "default" is what CI's slow sweep runs; the
@@ -56,9 +57,10 @@ SUITES: Dict[str, tuple] = {
     "failover": FAILOVER_SCENARIOS,
     "update": UPDATE_SCENARIOS,
     "preempt": PREEMPT_SCENARIOS,
+    "read": READ_SCENARIOS,
     "legacy-rcp": LEGACY_RCP_SCENARIOS,
     "default": FAILOVER_SCENARIOS + UPDATE_SCENARIOS
-    + PREEMPT_SCENARIOS + LEGACY_RCP_SCENARIOS,
+    + PREEMPT_SCENARIOS + READ_SCENARIOS + LEGACY_RCP_SCENARIOS,
     "fuzz": FUZZ_POOL,
 }
 
@@ -75,6 +77,7 @@ _FIXED_COMPONENT = {
     "agent-partition": "agent", "task-failure-storm": "agent",
     "rollout-poison": "updater",
     "preempt-burst": "scheduler",
+    "stale-read-probe": "read-plane", "read-storm": "read-plane",
     "cut": "network", "heal": "network", "split": "network",
     "heal-all": "network", "drop": "network", "drop-burst": "network",
     "clock-skew": "clock",
@@ -141,6 +144,18 @@ REQUIRED_CELLS: Dict[str, Set[Tuple[str, str]]] = {
     "preemption-storm": {
         ("preempt-burst", "scheduler"), ("agent-crash", "agent"),
         ("agent-restart", "agent"), ("stepdown", "manager"),
+        ("drop", "network")},
+    # follower-served read plane: partition × read-plane (the stranded
+    # ex-leader must be PROBED, not just partitioned) and clock × lease
+    # (a skew fault must run while lease reads are in play)
+    "follower-read-failover": {
+        ("crash", "manager"), ("restart", "manager"),
+        ("isolate", "manager"), ("rejoin", "manager"),
+        ("stale-read-probe", "read-plane"), ("clock-skew", "clock"),
+        ("agent-crash", "agent"), ("agent-restart", "agent")},
+    "read-storm-degraded": {
+        ("read-storm", "read-plane"), ("stepdown", "manager"),
+        ("crash", "manager"), ("restart", "manager"),
         ("drop", "network")},
 }
 
@@ -238,7 +253,7 @@ def main(argv=None) -> int:
                         "overrides --suite)")
     p.add_argument("--fast", action="store_true",
                    help="CI subset: 3 seeds x rolling-upgrade-chaos + "
-                        "preemption-storm "
+                        "preemption-storm + follower-read-failover "
                         "(overrides --fuzz/--suite/--scenario)")
     p.add_argument("--no-coverage-gate", action="store_true",
                    help="report the coverage matrix but never fail on "
@@ -258,7 +273,8 @@ def main(argv=None) -> int:
         return 0
 
     if args.fast:
-        scenarios: tuple = ("rolling-upgrade-chaos", "preemption-storm")
+        scenarios: tuple = ("rolling-upgrade-chaos", "preemption-storm",
+                            "follower-read-failover")
         n_seeds = 3
     else:
         if args.scenario:

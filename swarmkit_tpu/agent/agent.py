@@ -160,6 +160,26 @@ class Agent:
                 except Exception as e:
                     if self._stop.is_set():
                         return
+                    # session failover: count the cause and make sure the
+                    # re-register targets a DIFFERENT manager — an
+                    # invalidated session or a closed assignment stream
+                    # usually means THIS manager is mid-teardown, and
+                    # hammering it just races the teardown
+                    from ..remotes import SESSION_ERROR_CODES, \
+                        count_reconnect
+                    reason = (
+                        "session_invalid"
+                        if getattr(e, "code", "") in SESSION_ERROR_CODES
+                        else "stream_closed"
+                        if isinstance(e, ConnectionError)
+                        else "transport"
+                        if isinstance(e, (OSError, TimeoutError))
+                        else "error")
+                    count_reconnect(reason)
+                    rotate = getattr(self.client,
+                                     "note_session_failure", None)
+                    if rotate is not None:
+                        rotate()
                     # jittered exponential backoff: the ceiling doubles
                     # per consecutive failure (capped), the actual sleep
                     # is drawn uniformly below it so a manager failover

@@ -42,11 +42,15 @@ NO_BLOCK_UNDER: Dict[str, Set[str]] = {
     "MemoryStore._lock": {
         "propose", "propose_async", "wait_proposal", "fetch_group",
         "dispatch_group", "schedule_group", "device_get",
-        "block_until_ready", "sleep",
+        "block_until_ready", "sleep", "read_barrier",
     },
+    # read_barrier under the UPDATE lock deadlocks a follower outright:
+    # the barrier waits for remote applies, and apply_store_actions
+    # needs the update lock the waiter is holding.  (propose/wait under
+    # it remain the sanctioned leader commit path.)
     "MemoryStore._update_lock": {
         "fetch_group", "dispatch_group", "schedule_group",
-        "device_get", "block_until_ready", "sleep",
+        "device_get", "block_until_ready", "sleep", "read_barrier",
     },
 }
 

@@ -32,7 +32,7 @@ import random
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..models.objects import Cluster, Config, Node, Secret, Task, Volume
 from ..models.types import NodeState, NodeStatus, TaskState, TaskStatus, now
@@ -65,19 +65,21 @@ DefaultConfig = Config_
 
 
 class DispatcherError(Exception):
-    pass
+    #: wire error code (net/server.py passes it through verbatim, so the
+    #: agent-side failover client can classify without importing manager)
+    code = "dispatcher"
 
 
 class ErrNodeNotFound(DispatcherError):
-    pass
+    code = "not_found"
 
 
 class ErrSessionInvalid(DispatcherError):
-    pass
+    code = "session_invalid"
 
 
 class ErrNodeNotRegistered(DispatcherError):
-    pass
+    code = "node_not_registered"
 
 
 class ErrRateLimited(DispatcherError):
@@ -336,8 +338,29 @@ class Dispatcher:
     def __init__(self, store: MemoryStore,
                  config: Optional[Config_] = None,
                  driver_provider=None,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 write_store=None,
+                 shard_filter: Optional[Callable[[str], bool]] = None):
         self.store = store
+        # FOLLOWER MODE (reads served off the local replicated store):
+        # every read — session checks, assignment snapshots/streams,
+        # status validation — stays on ``store``; every session-mutating
+        # WRITE (node READY/DOWN, task status batches, orphan moves)
+        # goes through ``write_store``, which a follower member points at
+        # a leader-forwarding proxy.  Default (None) is leader mode:
+        # reads and writes share one store, behavior unchanged.
+        self.write_store = write_store if write_store is not None \
+            else store
+        # session sharding: with a filter, this dispatcher owns only the
+        # nodes the filter accepts — markNodesUnknown grace deadlines are
+        # limited to its shard so a restarted member cannot DOWN nodes
+        # that re-registered with a different member
+        self.shard_filter = shard_filter
+        #: optional veto consulted when a registration-grace deadline
+        #: fires for a node with no local session: return False when the
+        #: node is known to hold a live session on ANOTHER member (the
+        #: control plane tracks ownership), True to proceed marking DOWN
+        self.reg_grace_check: Optional[Callable[[str], bool]] = None
         # heartbeat jitter source: injectable so the deterministic
         # simulator can seed it (production uses the module-level RNG)
         self._rng = rng or random
@@ -417,8 +440,36 @@ class Dispatcher:
         deadline = now() + grace
         # caller (start) already holds self._mu
         for n in nodes:
+            if self.shard_filter is not None \
+                    and not self.shard_filter(n.id):
+                continue   # another member's session shard
             if n.status.state != NodeState.DOWN:
                 self._push_deadline(deadline, "reg", n.id)
+
+    def adopt_registration_grace(self, node_ids) -> None:
+        """Adopt orphaned sessions (their owning member died): give each
+        node a registration-grace window on THIS dispatcher; whoever does
+        not re-register anywhere by then is marked DOWN so its tasks heal
+        elsewhere (the follower-mode analogue of markNodesUnknown)."""
+        grace = self._heartbeat_period() * self.config.grace_multiplier
+        deadline = now() + grace
+        with self._mu:
+            for nid in node_ids:
+                if nid not in self._nodes:
+                    self._push_deadline(deadline, "reg", nid)
+
+    def release_session(self, node_id: str, session_id: str) -> None:
+        """Graceful session handoff: drop the session WITHOUT marking the
+        node DOWN — the agent is re-registering with another member (e.g.
+        draining consumers off a freshly promoted leader).  An unknown or
+        mismatched session is a no-op (the handoff already happened)."""
+        with self._mu:
+            rn = self._nodes.get(node_id)
+            if rn is None or rn.session_id != session_id:
+                return
+            del self._nodes[node_id]
+        for stream in rn.streams:
+            stream.close(ErrSessionInvalid("session released"))
 
     def stop(self, flush: bool = True) -> None:
         """``flush=False`` drops buffered status updates instead of
@@ -613,7 +664,7 @@ class Dispatcher:
                 batch.update(one)
 
         try:
-            self.store.batch(cb)
+            self.write_store.batch(cb)
         except Exception:
             log.exception("moving tasks to orphaned failed")
 
@@ -730,9 +781,29 @@ class Dispatcher:
                 batch.update(one_v)
 
         try:
-            self.store.batch(cb)
-        except Exception:
-            log.exception("dispatcher update batch failed")
+            self.write_store.batch(cb)
+        except Exception as e:
+            from ..state.raft.node import NotLeader, ProposalDropped
+            if isinstance(e, (DispatcherError, NotLeader,
+                              ProposalDropped)):
+                # forwarding gap (follower mode during a leaderless
+                # window / a deposal mid-write): re-queue so the next
+                # flush retries instead of losing the statuses.  Newest
+                # wins: an update buffered since the pop supersedes the
+                # failed one.
+                log.warning("dispatcher update batch deferred "
+                            "(no leader): re-queued")
+                with self._updates_lock:
+                    for task_id, status in task_updates.items():
+                        self._task_updates.setdefault(task_id, status)
+                    for node_id, pair in node_updates.items():
+                        self._node_updates.setdefault(node_id, pair)
+                    self._unpublished_volumes |= unpublished
+            else:
+                # anything else is a poisoned item or a store bug:
+                # dropping it (with the trace) beats starving every
+                # later batch on an eternal retry
+                log.exception("dispatcher update batch failed")
         self._flush_timer.observe(time.perf_counter() - _flush_t0)
 
     # ------------------------------------------------------------ worker
@@ -785,8 +856,12 @@ class Dispatcher:
                     rn = self._nodes.get(node_id)
                     expired = rn is not None and rn.deadline <= ts
                 elif kind == "reg":
-                    # registration grace after a leadership change
-                    expired = node_id not in self._nodes
+                    # registration grace after a leadership change; the
+                    # ownership veto keeps a sharded dispatcher from
+                    # DOWNing a node with a live session elsewhere
+                    expired = node_id not in self._nodes \
+                        and (self.reg_grace_check is None
+                             or self.reg_grace_check(node_id))
                 else:
                     down_since = self._down_nodes.get(node_id)
                     expired = (down_since is not None

@@ -222,9 +222,16 @@ class NodeDescription:
     csi_info: List[NodeCSIInfo] = field(default_factory=list)
 
     def copy(self) -> "NodeDescription":
+        # None-tolerant: executors may report partial descriptions
+        # (e.g. resources only), and the store defensively copies every
+        # node write — a partial description must round-trip, not crash
         return NodeDescription(
-            self.hostname, self.platform.copy(), self.resources.copy(),
-            self.engine.copy(), self.tls_info, list(self.csi_info))
+            hostname=self.hostname,
+            platform=self.platform.copy() if self.platform else None,
+            resources=self.resources.copy() if self.resources else None,
+            engine=self.engine.copy() if self.engine else None,
+            tls_info=self.tls_info, fips=self.fips,
+            csi_info=list(self.csi_info))
 
 
 @dataclass

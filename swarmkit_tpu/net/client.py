@@ -33,6 +33,15 @@ class NotLeader(Exception):
     'not_leader'); callers should rotate to another manager."""
 
 
+class SessionInvalid(Exception):
+    """The dispatcher no longer recognizes this session (server codes
+    'session_invalid' / 'node_not_registered'): the link is healthy but
+    the session is gone — re-register, preferably with a DIFFERENT
+    manager (the old one may be mid-teardown)."""
+
+    code = "session_invalid"
+
+
 _ERROR_TYPES = {
     "not_leader": NotLeader,
     "invalid_argument": InvalidArgument,
@@ -40,6 +49,8 @@ _ERROR_TYPES = {
     "already_exists": AlreadyExists,
     "failed_precondition": FailedPrecondition,
     "unauthenticated": PermissionError,
+    "session_invalid": SessionInvalid,
+    "node_not_registered": SessionInvalid,
 }
 
 

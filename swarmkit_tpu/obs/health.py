@@ -132,6 +132,32 @@ def stuck_rollout_value() -> Callable[[Registry], Optional[float]]:
     return get
 
 
+def stale_read_risk_value(read_index_p99_bound: float = 2.0
+                          ) -> Callable[[Registry], Optional[float]]:
+    """Follower-served read plane risk: 2 (fail) the moment ANY stale
+    serve was counted (``swarm_stale_reads`` — the invariant-adjacent
+    counter the read barrier/lease checks increment when a view would
+    have been served behind the committed frontier; correct operation
+    keeps it at zero forever), 1 (warn) while lease reads are not being
+    served (``swarm_lease_enabled`` = 0: the latest barrier fell back to
+    a quorum round — clock-skew veto, lease churn, or no leader lease)
+    AND the read-index fallback's p99 is above bound — reads are safe
+    but every one pays a quorum round.  None (pass) until the read
+    plane exports its first signal."""
+    def get(reg: Registry) -> Optional[float]:
+        if reg.get_counter("swarm_stale_reads") > 0:
+            return 2.0
+        lease = reg.get_gauge("swarm_lease_enabled")
+        t = reg.get_timer("swarm_read_index_latency")
+        if lease is None and (t is None or t.count == 0):
+            return None
+        if lease == 0.0 and t is not None and t.count \
+                and t.quantiles()[0.99] > read_index_p99_bound:
+            return 1.0
+        return 0.0
+    return get
+
+
 def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
                    edge_warn: float = 10.0, edge_fail: float = 60.0,
                    fallback_warn: float = 0.1, fallback_fail: float = 0.5,
@@ -184,6 +210,15 @@ def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
               gauge_value("swarm_priority_inversion"),
               1.0, 8.0, "tasks",
               ("swarm_priority_", "swarm_preempt")),
+        # follower-served reads (state/raft read-index + leader lease):
+        # fail = a stale serve was ever counted (safety breach — the
+        # read plane served behind the committed frontier), warn = lease
+        # disabled AND the read-index fallback is slow (every read pays
+        # a quorum round)
+        Check("stale_read_risk", stale_read_risk_value(),
+              1.0, 2.0, "state",
+              ("swarm_read_", "swarm_lease_", "swarm_stale_",
+               "swarm_leader_read_")),
     ]
 
 

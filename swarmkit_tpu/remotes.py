@@ -17,7 +17,20 @@ import random
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from .utils.metrics import registry as _metrics
+
 log = logging.getLogger("remotes")
+
+#: error ``code`` attributes that mean "the session died but the link is
+#: healthy" — the failover client re-resolves to a DIFFERENT manager
+#: instead of hammering the one that just invalidated the session
+SESSION_ERROR_CODES = ("session_invalid", "node_not_registered")
+
+
+def count_reconnect(reason: str) -> None:
+    """One agent reconnect cause, by reason — the weighted-remotes
+    observability counter (`swarm_agent_reconnects{reason=}`)."""
+    _metrics.counter(f'swarm_agent_reconnects{{reason="{reason}"}}')
 
 # reference: remotes.go DefaultObservationWeight and bounds
 DEFAULT_OBSERVATION_WEIGHT = 10
@@ -282,7 +295,22 @@ class FailoverDispatcherClient:
                 # a healthy follower: rotate to another manager without
                 # down-weighting it (it may become leader any moment)
                 self._rotate(addr)
+            elif getattr(e, "code", "") in SESSION_ERROR_CODES:
+                # the session is gone (manager teardown, failover hand-
+                # off): the next register goes to a DIFFERENT member —
+                # re-registering with the invalidator just races its
+                # teardown.  No health down-weight: the link was fine.
+                self._rotate(addr)
             raise
+
+    def note_session_failure(self) -> None:
+        """Agent-side hook for session failures the call path could not
+        classify (assignment stream closed server-side): rotate off the
+        current manager so the re-register lands elsewhere."""
+        with self._mu:
+            cur = self._current
+        if cur is not None:
+            self._rotate(cur)
 
     def register(self, node_id, description=None):
         return self._call("register", node_id, description=description)

@@ -55,13 +55,14 @@ from ..scheduler.filters import VolumesFilter
 from ..state.raft.core import (
     ENTRY_CONF, Entry, HardState, LEADER, RaftCore,
 )
-from ..state.raft.node import NotLeader, ProposalDropped, StaleEpoch
+from ..state.raft.node import NotLeader, ProposalDropped, \
+    ReadUnavailable, StaleEpoch
 from ..state.store import MemoryStore
 from ..utils.identity import set_id_source
 from .engine import SimEngine
 from .faults import NetConfig, SimNetwork
 from .invariants import (
-    PreemptionInvariants, RaftInvariants, TaskInvariants,
+    PreemptionInvariants, RaftInvariants, ReadInvariants, TaskInvariants,
     UpdateInvariants, Violations, check_placement_quality, entry_digest,
 )
 
@@ -96,7 +97,7 @@ class SimManager:
         self.raft_inv = raft_inv
         self.alive = True
         self.stopped = False
-        self.tick_scale = 1.0    # clock-skew fault: >1 ticks slower
+        self._tick_scale = 1.0   # clock-skew fault: >1 ticks slower
         # durable state ("disk"): survives crashes, lost records only
         # through explicit truncation faults
         self._wal_records: List[tuple] = []   # ("hs", HardState)|("ent", Entry)
@@ -131,10 +132,31 @@ class SimManager:
         net.register(member_id, self._on_message)
         self._schedule_tick()
 
+    @property
+    def tick_scale(self) -> float:
+        return self._tick_scale
+
+    @tick_scale.setter
+    def tick_scale(self, value: float) -> None:
+        # clock-skew bookkeeping: while ANY member ticks off-rate, the
+        # lease's "no election fits in this window" argument is void —
+        # every core's lease_gate reads this registry
+        self._tick_scale = value
+        if value == 1.0:
+            self.engine.clock_skew_members.discard(self.id)
+        else:
+            self.engine.clock_skew_members.add(self.id)
+
     def _new_core(self) -> RaftCore:
         core = RaftCore(self.id, self.peers, rng=self.engine.fork_rng(),
                         prevote=True)
         core.on_transition = self._on_transition
+        # leader lease sized to one election timeout of VIRTUAL time
+        # (TICK seconds per raft tick), drift margin shaved in the core;
+        # auto-disabled while any clock-skew fault is live
+        core.lease_duration = core.election_tick * self.TICK
+        core.lease_gate = \
+            lambda: not self.engine.clock_skew_members
         return core
 
     def _on_transition(self, member_id: str, role: str, term: int) -> None:
@@ -341,6 +363,13 @@ class SimAgent:
         self.partitioned = False
         self.fail_p = 0.0          # per-step chance of failing a RUNNING task
         self.session: Optional[str] = None
+        # follower-served sessions (RaftControlPlane.follower_reads):
+        # the member currently owning this agent's session, plus the
+        # re-resolution backoff state (a failed member is avoided for a
+        # jittered window instead of hammered)
+        self._member_id: Optional[str] = None
+        self._avoid: Dict[str, float] = {}
+        self._fail_attempts = 0
         self._rng = cp.engine.fork_rng()
         self._schedule()
 
@@ -361,6 +390,9 @@ class SimAgent:
         if not self.alive or self.partitioned:
             return
         cp = self.cp
+        if getattr(cp, "follower_reads", False):
+            self._step_follower(cp)
+            return
         if getattr(cp, "busy", False):
             # a control-plane write is pumping virtual time through this
             # very event: touching the leader store now would deadlock
@@ -402,13 +434,106 @@ class SimAgent:
         finally:
             cp.busy = False
 
-    def _advance_tasks(self, d=None) -> None:
+    # --------------------------------------------- follower-served mode
+
+    def _resolve_member(self) -> Optional["SimManager"]:
+        """Session member by node-id hash over the member ring, skipping
+        dead/avoided members and — when an alternative exists — the
+        current leader: consumer sessions stay pinned to followers, off
+        the coordinator.  Sticky: the current member is kept while it
+        remains acceptable."""
+        import zlib
+        cp = self.cp
+        members = cp.sim.managers
+        t = self.engine.clock.elapsed()
+        leader = cp.sim.leader()
+
+        def ok(m, allow_leader):
+            return (m.alive and m.store is not None
+                    and self._avoid.get(m.id, 0.0) <= t
+                    and (allow_leader or m is not leader))
+
+        cur = next((m for m in members if m.id == self._member_id), None)
+        if cur is not None and self.session is not None \
+                and ok(cur, allow_leader=False):
+            return cur
+        start = zlib.crc32(self.node_id.encode()) % len(members)
+        fallback = None
+        for k in range(len(members)):
+            m = members[(start + k) % len(members)]
+            if ok(m, allow_leader=False):
+                return m
+            if fallback is None and ok(m, allow_leader=True):
+                fallback = m
+        return fallback    # only the leader (or nothing) is left
+
+    def _step_follower(self, cp) -> None:
+        """Follower-served session step: register/heartbeat against the
+        sharded member's local dispatcher, read assignments from ITS
+        replicated store, report status through it (the write forwards
+        to the leader).  On any session failure, re-resolve to a
+        DIFFERENT member with jittered backoff on the failed one."""
+        from ..remotes import backoff_with_jitter, count_reconnect
+        if cp.busy:
+            return
+        member = self._resolve_member()
+        if member is None:
+            return
+        d = cp.plane_for(member)
+        if d is None:
+            return
+        cp.drain_deferred()
+        cp.busy = True
+        try:
+            if self.session is None or self._member_id != member.id:
+                if self.session is not None \
+                        and self._member_id is not None:
+                    # graceful handoff: release the old session so the
+                    # previous member never TTL-expires us into DOWN
+                    old = cp.plane_for_id(self._member_id)
+                    if old is not None:
+                        old.release_session(self.node_id, self.session)
+                self.session, _ = d.register(
+                    self.node_id,
+                    description=NodeDescription(
+                        hostname=self.node_id,
+                        resources=Resources(nano_cpus=8 * 10 ** 9,
+                                            memory_bytes=32 << 30)))
+                cp.session_owner[self.node_id] = member.id
+                self._member_id = member.id
+                self._fail_attempts = 0
+                self.engine.log(f"agent {self.node_id} registered "
+                                f"on {member.id}")
+            else:
+                d.heartbeat(self.node_id, self.session)
+            cp.count_read(member)
+            self._advance_tasks(d, store=member.store)
+        except AGENT_RPC_ERRORS:
+            # session failover: avoid THIS member for a jittered window
+            # so the re-register lands on a different one
+            self.session = None
+            if cp.session_owner.get(self.node_id) == member.id:
+                cp.session_owner.pop(self.node_id, None)
+            self._member_id = None
+            self._avoid[member.id] = self.engine.clock.elapsed() + \
+                backoff_with_jitter(self._fail_attempts, self._rng,
+                                    base=0.5)
+            self._fail_attempts += 1
+            cp.read_stats["agent_reconnects"] += 1
+            count_reconnect("session_invalid")
+            self.engine.log(f"agent {self.node_id} session failover "
+                            f"off {member.id}")
+        finally:
+            cp.busy = False
+
+    def _advance_tasks(self, d=None, store=None) -> None:
         from ..state.store import ByNode
         if d is None:
             d = self.cp.dispatcher
             if d is None:
                 return
-        store = self.cp.store
+        if store is None:
+            store = self.cp.store
         if store is None:
             return
         tasks = store.view(
@@ -519,15 +644,27 @@ class SimRaftProposer:
     PUMP = 0.05      # virtual seconds per wait slice
     TIMEOUT = 30.0   # virtual seconds before a proposal is abandoned
 
+    #: virtual seconds an unanswered read-index request waits before the
+    #: barrier re-asks (the leader it targeted may be gone)
+    READ_RETRY = 1.0
+
     def __init__(self, sim: "Sim", member: Optional[SimManager] = None,
                  violations: Optional[Violations] = None):
         self.sim = sim
         self.member = member
         self.violations = violations
         self.enforce_fencing = True
+        #: checker-sensitivity seam: False serves linearizable reads
+        #: WITHOUT the barrier — follower-reads-never-uncommitted must
+        #: then catch the stale view
+        self.enforce_read_barrier = True
+        #: read-plane observer (ReadInvariants): judges every served view
+        self.read_observer = None
         self._pending: Dict[tuple, dict] = {}
         self.stats = {"proposed": 0, "committed": 0, "dropped": 0,
                       "stale_epoch_rejects": 0}
+        self.read_stats = {"reads": 0, "lease": 0, "read_index": 0,
+                           "unavailable": 0}
         if member is not None:
             member.apply_taps.append(self._on_apply)
         else:
@@ -627,6 +764,111 @@ class SimRaftProposer:
     def propose(self, actions, commit_cb=None, epoch=None) -> None:
         self.wait_proposal(self.propose_async(actions, commit_cb,
                                               epoch=epoch))
+
+    # ----------------------------------------------------- read barrier
+
+    def _skew_active(self) -> bool:
+        return bool(self.sim.engine.clock_skew_members)
+
+    def read_barrier(self, timeout: Optional[float] = None) -> dict:
+        """Linearizable read barrier on THIS member (the store's
+        ``read_view(linearizable=True)`` capability): resolve the
+        cluster's confirmed commit index through the raft read-index
+        protocol (leader-lease fast path when the core's lease is valid
+        and no clock-skew fault is live), then pump virtual time until
+        this member's applied state — including deferred store entries —
+        covers it.  Works on leaders AND followers; raises
+        ReadUnavailable when no leader confirms within ``timeout``
+        (degraded, never stale).  The ReadInvariants observer judges
+        every serve."""
+        from ..utils.metrics import registry as _metrics
+        m = self.member
+        if m is None:
+            return {"lease": False, "index": 0}
+        eng = self.sim.engine
+        obs = self.read_observer
+        token = obs.begin_read(m) if obs is not None else None
+        self.read_stats["reads"] += 1
+        t0 = eng.clock.elapsed()
+        if not self.enforce_read_barrier:
+            # sensitivity seam: serve the local view unverified — the
+            # follower-reads-never-uncommitted checker must fire when
+            # this member trails the committed frontier
+            if obs is not None:
+                obs.served(m, token, lease=False,
+                           skew_active=self._skew_active())
+            return {"lease": False, "index": m.core.applied_index}
+        deadline = t0 + (self.TIMEOUT if timeout is None else timeout)
+        store0 = m.store
+        core = m.core
+        minted: List[int] = []
+        seq: Optional[int] = None
+        asked_at = t0
+        barrier = lease = None
+        while True:
+            if not m.alive or m.stopped or m.store is not store0:
+                # crashed (or crash-restarted onto a rebuilt store) mid-
+                # barrier: the caller's view object is dead — fail, never
+                # serve it
+                self.read_stats["unavailable"] += 1
+                raise ReadUnavailable(f"{m.id} went down mid-read")
+            core = m.core   # a restart swaps the core object
+            if seq is None:
+                seq = core.request_read()
+                asked_at = eng.clock.elapsed()
+                if seq is not None:
+                    minted.append(seq)
+                    m.pump()   # flush the read_index message out
+            if seq is not None:
+                res = core.read_results.pop(seq, None)
+                if res is not None:
+                    index, ok, is_lease = res
+                    if ok:
+                        barrier, lease = index, is_lease
+                        break
+                    seq = None   # refused: retry against whoever leads
+                elif eng.clock.elapsed() - asked_at >= self.READ_RETRY:
+                    seq = None   # silence: the asked leader is likely gone
+            if eng.clock.elapsed() >= deadline:
+                self.read_stats["unavailable"] += 1
+                _metrics.counter(
+                    'swarm_lease_reads{result="unavailable"}')
+                for s in minted:
+                    core.read_results.pop(s, None)
+                raise ReadUnavailable(
+                    f"{m.id}: no leader confirmed a read barrier "
+                    f"within {deadline - t0:.1f}s")
+            eng.run_until(eng.clock.elapsed() + self.PUMP)
+        for s in minted:
+            core.read_results.pop(s, None)
+        # local catch-up: applied index past the barrier AND the store
+        # apply backlog drained (deferred entries are committed-but-
+        # unapplied — serving over them would miss sealed changes)
+        while True:
+            if not m.alive or m.stopped or m.store is not store0:
+                self.read_stats["unavailable"] += 1
+                raise ReadUnavailable(f"{m.id} went down mid-read")
+            m._drain_deferred()
+            if core.applied_index >= barrier \
+                    and not m._deferred_entries:
+                break
+            if eng.clock.elapsed() >= deadline:
+                self.read_stats["unavailable"] += 1
+                raise ReadUnavailable(
+                    f"{m.id}: applied {core.applied_index} never "
+                    f"reached the read barrier {barrier}")
+            eng.run_until(eng.clock.elapsed() + self.PUMP)
+        self.read_stats["lease" if lease else "read_index"] += 1
+        _metrics.counter('swarm_lease_reads{result="lease"}' if lease
+                         else 'swarm_lease_reads{result="read_index"}')
+        # same meaning as RaftNode's export: last read lease-served?
+        _metrics.gauge("swarm_lease_enabled", 1.0 if lease else 0.0)
+        _metrics.timer("swarm_read_index_latency").observe(
+            eng.clock.elapsed() - t0)
+        if obs is not None:
+            obs.served(m, token, lease=lease,
+                       skew_active=self._skew_active())
+        return {"lease": lease, "index": barrier}
 
     # ------------------------------------------------------------ apply tap
 
@@ -829,7 +1071,12 @@ class SimMemberControl:
             DispatcherConfig(heartbeat_period=2.0, heartbeat_epsilon=0.2,
                              grace_multiplier=3.0, rate_limit_period=0.0,
                              orphan_timeout=20.0),
-            rng=cp.engine.fork_rng())
+            rng=cp.engine.fork_rng(),
+            # follower-served mode: sessions live on the per-member read
+            # planes — the leader's control dispatcher owns no shard and
+            # must not grace-DOWN nodes that never register with it
+            shard_filter=(lambda nid: False) if cp.follower_reads
+            else None)
         from ..manager.allocator import Allocator
         self.allocator = Allocator(store)
         self.restarts = RestartSupervisor(store, start_worker=False)
@@ -970,6 +1217,166 @@ class SimMemberControl:
             pass
 
 
+class _LeaderWriteProxy:
+    """Write-side store surface for a follower-mode dispatcher: every
+    session-mutating write routes to the CURRENT leader's replicated
+    store (and from there through consensus back to every member's local
+    store, where the follower-served reads pick it up).  Raises
+    DispatcherError during leaderless gaps — the dispatcher's flush
+    paths re-queue and retry."""
+
+    def __init__(self, cp: "RaftControlPlane"):
+        self.cp = cp
+
+    def _store(self) -> MemoryStore:
+        mc = self.cp.active
+        if mc is None or mc.detached or not mc.member.alive:
+            raise DispatcherError("no leader to forward the write to")
+        return mc.store
+
+    def batch(self, cb):
+        return self._store().batch(cb)
+
+    def update(self, cb):
+        return self._store().update(cb)
+
+
+class SimWatcher:
+    """A watch-stream consumer pinned to follower members: attaches to a
+    member's replicated store through the REAL WatchServer surface,
+    consumes Task events with resume tokens, and on member loss (crash,
+    rebuild, overflow, promotion to leader) reattaches to a DIFFERENT
+    member resuming from its token — the payload stream must stay
+    gap-free and dup-free across every hop (WatchContinuity judges it at
+    scenario end).  ``ResumeCompacted`` is handled by snapshot re-sync:
+    re-list from a current view and open a fresh continuity segment."""
+
+    def __init__(self, cp: "RaftControlPlane", name: str, request,
+                 interval: float = 0.5):
+        from ..manager.watchapi import compile_filter
+        from .invariants import WatchContinuity
+        self.cp = cp
+        self.name = name
+        self.engine = cp.engine
+        self.request = request
+        self.index = len(cp.watchers)   # spreads watchers over members
+        self.continuity = WatchContinuity(
+            cp.violations, compile_filter(request), cp.sim.managers,
+            tag=name)
+        #: checker-sensitivity seam: added to the resume token on every
+        #: reattach (-1 re-delivers the last event = dup; +1 skips the
+        #: next = gap); 0 in correct operation
+        self.resume_skew = 0
+        self.member: Optional[SimManager] = None
+        self._store = None
+        self.stream = None
+        self.token: Optional[int] = None
+        #: continuity segments: {"start": version, "events": [(v, a, id)]}
+        #: — a new segment opens only on snapshot re-sync
+        self.segments: List[dict] = []
+        self.hops = 0
+        self.resyncs = 0
+        self.events_seen = 0
+        self._rng = cp.engine.fork_rng()
+        cp.engine.every(interval, f"watcher {name}", self.step,
+                        phase=self._rng.random() * interval)
+
+    def _pick_member(self) -> Optional[SimManager]:
+        members = self.cp.sim.managers
+        leader = self.cp.sim.leader()
+        followers = [m for m in members
+                     if m.alive and m.store is not None
+                     and m is not leader]
+        if followers:
+            return followers[(self.index + self.hops) % len(followers)]
+        return next((m for m in members
+                     if m.alive and m.store is not None), None)
+
+    def _attach(self) -> None:
+        from ..manager.watchapi import ResumeCompacted, WatchServer
+        m = self._pick_member()
+        if m is None:
+            return
+        if self.stream is not None:
+            try:
+                self.stream.close()
+            except Exception:
+                pass
+            self.stream = None
+        if self.member is not None and m is not self.member:
+            self.hops += 1
+        self.member = m
+        self._store = m.store
+        server = WatchServer(m.store)
+        if self.token is None:
+            # first attach: start the stream (and its continuity
+            # segment) at the member's current version
+            self.token = m.store.version
+            self.segments.append({"start": self.token, "events": []})
+            req = self._req(self.token)
+            self.stream = server.watch(req)
+            self.engine.log(f"watcher {self.name} attach {m.id} "
+                            f"v{self.token}")
+            return
+        try:
+            self.stream = server.watch(
+                self._req(self.token + self.resume_skew))
+            self.engine.log(f"watcher {self.name} resume {m.id} "
+                            f"v{self.token}")
+        except ResumeCompacted:
+            # snapshot re-sync: the changelog no longer reaches the
+            # token — re-list from a current view and restart continuity
+            self.resyncs += 1
+            self.token = m.store.version
+            self.segments.append({"start": self.token, "events": []})
+            self.stream = server.watch(self._req(self.token))
+            self.engine.log(f"watcher {self.name} resync {m.id} "
+                            f"v{self.token}")
+
+    def _req(self, resume_from: int):
+        import dataclasses
+        return dataclasses.replace(self.request,
+                                   resume_from_version=resume_from)
+
+    def step(self) -> object:
+        if self.cp.stopped:
+            return False
+        if self.cp.busy:
+            # a control-plane write is mid-flight on this very stack
+            # (single thread): attaching now would take watch_from's
+            # update lock under the held one — catch up next step
+            return None
+        self.drain()
+        return None
+
+    def drain(self) -> None:
+        m = self.member
+        stale = (m is None or not m.alive or m.store is not self._store
+                 or self.stream is None or self.stream.closed)
+        leader = self.cp.sim.leader()
+        if not stale and m is leader:
+            # drain off a freshly promoted leader: consumers belong on
+            # followers (when any are available)
+            if any(x for x in self.cp.sim.managers
+                   if x.alive and x.store is not None and x is not m):
+                stale = True
+        if stale:
+            self._attach()
+            if self.stream is None:
+                return
+        while True:
+            ev = self.stream.poll()
+            if ev is None:
+                break
+            if not self.segments:
+                self.segments.append({"start": 0, "events": []})
+            self.segments[-1]["events"].append(
+                (ev.version, ev.action, ev.obj.id))
+            self.token = ev.version
+            self.events_seen += 1
+        self.cp.count_read(self.member)
+
+
 class RaftControlPlane:
     """Raft-attached control plane (ROADMAP item 8): every member holds
     a replicated store, the full control plane runs on the current
@@ -1048,9 +1455,28 @@ class RaftControlPlane:
         #: preemption records archived from crash-replaced checkers
         self._preempt_archive: List[tuple] = []
         self._dispatcher_totals = {"heartbeats": 0, "expirations": 0}
+        # ---- follower-served read plane (ISSUE 11)
+        #: scenario knob: serve agent sessions + watch streams from the
+        #: members' replicated stores (sharded by node-id hash), writes
+        #: forwarded to the leader
+        self.follower_reads = False
+        #: node id -> member id currently owning its session (shared so
+        #: a sharded dispatcher never DOWNs a node registered elsewhere)
+        self.session_owner: Dict[str, str] = {}
+        self._planes: Dict[str, tuple] = {}   # member id -> (store, disp)
+        self._member_was_alive: Dict[str, bool] = {}
+        self.read_inv = ReadInvariants(violations, sim.managers)
+        self.watchers: List[SimWatcher] = []
+        self.read_stats = {"reads_leader": 0, "reads_follower": 0,
+                           "probe_ok": 0, "probe_unavailable": 0,
+                           "agent_reconnects": 0, "stale_probe_refused": 0}
+        #: end-state expectation (read-storm scenarios): probes must
+        #: degrade to read-index latency, never fail outright
+        self.expect_reads_never_fail = False
         self.proposers: Dict[str, SimRaftProposer] = {}
         for m in sim.managers:
             p = SimRaftProposer(sim, member=m, violations=violations)
+            p.read_observer = self.read_inv
             m.store._proposer = p
             m.store_proposer = p     # survives store rebuilds (restart)
             self.proposers[m.id] = p
@@ -1091,13 +1517,158 @@ class RaftControlPlane:
 
     @property
     def dispatcher_stats(self) -> Dict[str, int]:
-        """Accumulated across every leader's dispatcher (attach epochs)."""
+        """Accumulated across every leader's dispatcher (attach epochs)
+        and, in follower-served mode, every member's read plane."""
         totals = dict(self._dispatcher_totals)
         mc = self.active
         if mc is not None:
             for k in totals:
                 totals[k] += mc.dispatcher.stats.get(k, 0)
+        for _store, d in self._planes.values():
+            for k in totals:
+                totals[k] += d.stats.get(k, 0)
         return totals
+
+    # ------------------------------------------- follower-served reads
+
+    def enable_follower_reads(self) -> None:
+        """Switch the consumer plane to follower-served mode: agents
+        shard their sessions across members by node-id hash (preferring
+        non-leaders), served from each member's local replicated store;
+        only session-mutating writes forward to the leader."""
+        self.follower_reads = True
+
+    def _shard_member_id(self, node_id: str) -> str:
+        import zlib
+        members = self.sim.managers
+        return members[zlib.crc32(node_id.encode()) % len(members)].id
+
+    def plane_for_id(self, member_id: str) -> Optional[Dispatcher]:
+        entry = self._planes.get(member_id)
+        return entry[1] if entry is not None else None
+
+    def plane_for(self, m: SimManager) -> Optional[Dispatcher]:
+        """This member's follower-mode dispatcher over its replicated
+        store, rebuilt whenever a crash-restart replaced the store."""
+        if not self.follower_reads or m.store is None or not m.alive:
+            return None
+        entry = self._planes.get(m.id)
+        if entry is not None and entry[0] is m.store:
+            return entry[1]
+        if entry is not None:
+            for k in self._dispatcher_totals:
+                self._dispatcher_totals[k] += entry[1].stats.get(k, 0)
+            try:
+                entry[1].stop(flush=False)
+            except Exception:
+                pass
+        d = Dispatcher(
+            m.store,
+            DispatcherConfig(heartbeat_period=2.0, heartbeat_epsilon=0.2,
+                             grace_multiplier=3.0, rate_limit_period=0.0,
+                             orphan_timeout=20.0),
+            rng=self.engine.fork_rng(),
+            write_store=_LeaderWriteProxy(self),
+            shard_filter=lambda nid, mid=m.id:
+                self.session_owner.get(nid, self._shard_member_id(nid))
+                == mid)
+        # a reg-grace deadline only DOWNs a node with no live session on
+        # ANY member (ownership is control-plane-wide state)
+        d.reg_grace_check = \
+            lambda nid: self.session_owner.get(nid) is None
+        d.run(start_worker=False)
+        self._planes[m.id] = (m.store, d)
+        return d
+
+    def _reap_dead_member_sessions(self, member_id: str) -> None:
+        """A member died: its sessions are orphaned.  Clear ownership and
+        hand the nodes a registration-grace window on a surviving plane —
+        live agents re-register elsewhere well inside it; truly dead ones
+        get marked DOWN so their tasks heal."""
+        orphans = [nid for nid, mid in self.session_owner.items()
+                   if mid == member_id]
+        if not orphans:
+            return
+        for nid in orphans:
+            self.session_owner.pop(nid, None)
+        for m in self.sim.managers:
+            d = self.plane_for(m)
+            if d is not None:
+                d.adopt_registration_grace(orphans)
+                break
+
+    def count_read(self, member: Optional[SimManager]) -> None:
+        """Attribute one consumer read to the serving member's role and
+        refresh the leader-share gauge (the 'consumers off the
+        coordinator' headline number)."""
+        if member is None:
+            return
+        from ..utils.metrics import registry as _metrics
+        leader = self.sim.leader()
+        key = "reads_leader" if member is leader else "reads_follower"
+        self.read_stats[key] += 1
+        total = (self.read_stats["reads_leader"]
+                 + self.read_stats["reads_follower"])
+        _metrics.gauge("swarm_leader_read_share",
+                       self.read_stats["reads_leader"] / total)
+
+    def leader_read_share(self) -> float:
+        total = (self.read_stats["reads_leader"]
+                 + self.read_stats["reads_follower"])
+        return self.read_stats["reads_leader"] / total if total else 0.0
+
+    def linearizable_read(self, member: SimManager, cb,
+                          timeout: Optional[float] = None):
+        """One linearizable read served by ``member`` (leader or
+        follower): runs the read barrier, serves the local view, and
+        counts the read toward the leader-share gauge."""
+        self.count_read(member)
+        return member.store.read_view(cb, linearizable=True,
+                                      timeout=timeout)
+
+    def add_watchers(self, n: int, request=None,
+                     interval: float = 0.5) -> None:
+        """Attach ``n`` follower-pinned watch consumers (resume-token
+        continuity judged at scenario end)."""
+        from ..manager.watchapi import WatchRequest
+        for _ in range(n):
+            req = request if request is not None \
+                else WatchRequest(kinds=[Task])
+            self.watchers.append(SimWatcher(
+                self, f"watch{len(self.watchers)}", req,
+                interval=interval))
+
+    def start_read_probes(self, interval: float = 1.0,
+                          timeout: float = 20.0) -> None:
+        """Periodic linearizable read probes round-robining the follower
+        members (the read-storm workload): under churn they must degrade
+        to read-index latency — outright failures are counted and, with
+        ``expect_reads_never_fail``, judged at scenario end."""
+        state = {"i": 0}
+
+        def probe():
+            if self.stopped or self.sim.finishing:
+                return False
+            if self.busy:
+                return None   # a control write is mid-flight on this stack
+            members = [m for m in self.sim.managers
+                       if m.alive and m.store is not None]
+            leader = self.sim.leader()
+            cands = [m for m in members if m is not leader] or members
+            if not cands:
+                return None
+            m = cands[state["i"] % len(cands)]
+            state["i"] += 1
+            try:
+                self.linearizable_read(
+                    m, lambda tx: len(tx.find(Task)), timeout=timeout)
+                self.read_stats["probe_ok"] += 1
+            except ReadUnavailable:
+                self.read_stats["probe_unavailable"] += 1
+                self.engine.log(f"read probe unavailable on {m.id}")
+            return None
+
+        self.engine.every(interval, "read probe", probe, phase=0.3)
 
     # ---------------------------------------------------------- transitions
 
@@ -1228,6 +1799,30 @@ class RaftControlPlane:
             if checkers is not None:
                 for inv in checkers:
                     inv.drain()
+        if self.follower_reads:
+            # member deaths orphan their session shard; survivors adopt
+            # a registration-grace window for the affected nodes
+            for m in sim.managers:
+                was = self._member_was_alive.get(m.id, True)
+                if was and not m.alive:
+                    self._reap_dead_member_sessions(m.id)
+                self._member_was_alive[m.id] = m.alive
+            if not self.busy:
+                # drive every member's follower dispatcher threadless:
+                # TTL/grace deadlines + forwarded status flushes
+                self.busy = True
+                try:
+                    for m in sim.managers:
+                        d = self.plane_for(m)
+                        if d is None:
+                            continue
+                        d.process_deadlines()
+                        d._flush_updates()
+                finally:
+                    self.busy = False
+        for w in self.watchers:
+            w.continuity.ensure()
+            w.continuity.drain()
         return None
 
     # -------------------------------------------------------------- workload
@@ -1539,6 +2134,24 @@ class RaftControlPlane:
                 and self.store is not None:
             check_placement_quality(violations, self.store,
                                     self.placement_quality_bound)
+        # ---- read-plane end checks
+        for w in self.watchers:
+            w.drain()                 # catch up after the heal grace
+            w.continuity.ensure()
+            w.continuity.drain()
+            w.continuity.judge(w)
+        if self.watchers and not any(w.events_seen for w in self.watchers):
+            violations.record(
+                "watch-resume-no-gap-no-dup",
+                "watchers attached but consumed zero events — the "
+                "follower-served watch plane never carried the workload")
+        if self.expect_reads_never_fail \
+                and self.read_stats["probe_unavailable"]:
+            violations.record(
+                "read-storm-degraded",
+                f"{self.read_stats['probe_unavailable']} linearizable "
+                "read probe(s) failed outright under churn — reads must "
+                "degrade to read-index latency, never to errors")
 
 
 class Sim:
@@ -1739,4 +2352,15 @@ class Sim:
                 "rollouts": self.cp.rollouts,
                 "update_states": states,
             }
+            reads = dict(self.cp.read_stats)
+            for k in ("reads", "lease", "read_index", "unavailable"):
+                reads[k] = sum(p.read_stats[k]
+                               for p in self.cp.proposers.values())
+            reads["leader_share"] = round(
+                self.cp.leader_read_share(), 4)
+            reads["watch_events"] = sum(
+                w.events_seen for w in self.cp.watchers)
+            reads["watch_hops"] = sum(
+                w.hops for w in self.cp.watchers)
+            out["reads"] = reads
         return out

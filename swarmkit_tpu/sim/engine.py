@@ -31,6 +31,11 @@ class SimEngine:
         self.trace: List[str] = []
         self.events_run = 0
         self.max_events = 2_000_000  # runaway backstop
+        # member ids currently under a clock-skew fault (SimManager's
+        # tick_scale setter maintains it).  Shared on the engine because
+        # skew ANYWHERE voids every leader's lease math — the read plane
+        # checks this set before honoring a lease read.
+        self.clock_skew_members: set = set()
 
     # ------------------------------------------------------------ scheduling
 

@@ -981,6 +981,161 @@ def _preemption_storm(sim: Sim) -> float:
 _preemption_storm.raft_cp = True
 
 
+# ----------------------------------------- follower-served read plane
+#
+# ISSUE 11: the consumer plane (watch streams, agent sessions,
+# linearizable control-API reads) is served from FOLLOWER members'
+# replicated stores — raft read-index/lease reads underneath — and must
+# survive leader loss.  Judged by follower-reads-never-uncommitted,
+# lease-read-safe-under-skew and watch-resume-no-gap-no-dup on top of
+# the shared checkers.
+
+
+def _follower_read_failover(sim: Sim) -> float:
+    """Watchers + agent sessions pinned to followers while the leader
+    crashes, a partition strands an ex-leader whose expired lease must
+    refuse to serve, and a clock-skew fault forces lease reads to
+    auto-degrade to read-index rounds.  Watch streams must lose nothing
+    across member hops (resume-token continuity), agent sessions must
+    fail over to different members, and no read may ever be stale."""
+    from ..manager.watchapi import WatchRequest
+    from ..models import Task
+    from ..state.raft.node import ReadUnavailable
+    eng = sim.engine
+    cp = sim.cp
+    cp.enable_follower_reads()
+    sim.start_raft_workload(interval=0.8)
+    cp.create_tasks(10)
+    # one broad watcher, one using the per-kind field filters (the
+    # member-agnostic filter path): both judged for continuity
+    cp.add_watchers(1)
+    cp.add_watchers(1, request=WatchRequest(kinds=[Task],
+                                            service_ids=["svc-sim"]))
+    cp.start_read_probes(interval=1.5)
+
+    # leader crash mid-run: sessions + streams hop to survivors
+    def crash_leader():
+        m = sim.leader()
+        if m is None:
+            return
+        m.crash()
+        eng.after(6.0, "restart ex-leader", m.restart)
+    eng.at(eng.clock.start + 14.0, "crash leader", crash_leader)
+
+    # agent churn rides along (session re-resolution under backoff)
+    a = cp.agents
+    eng.at(eng.clock.start + 18.0, "agent crash", a[1].crash)
+    eng.at(eng.clock.start + 27.0, "agent restart", a[1].restart)
+
+    # crash the member a watcher is pinned to: its stream MUST resume on
+    # a different member from its token — the continuity checker judges
+    # the hop gap-free and dup-free
+    def crash_watch_member():
+        w = cp.watchers[0]
+        m = w.member
+        if m is None or not m.alive:
+            return
+        m.crash()
+        eng.after(6.0, "restart watch member", m.restart)
+    eng.at(eng.clock.start + 21.0, "crash watcher member",
+           crash_watch_member)
+
+    # partition the (new) leader and, mid-partition, make the stranded
+    # ex-leader TRY to serve a linearizable read: its lease is expired
+    # and its read-index round cannot reach a quorum — the read must
+    # come back unavailable (or fresh after heal), never stale
+    state: Dict[str, object] = {}
+
+    def cut_leader():
+        m = sim.leader()
+        if m is None:
+            return
+        state["ex"] = m
+        sim.net.isolate(m.id)
+        eng.after(8.0, "heal ex-leader partition",
+                  lambda: sim.net.rejoin(m.id))
+    eng.at(eng.clock.start + 26.0, "partition leader", cut_leader)
+
+    def stale_probe():
+        m = state.get("ex")
+        if m is None or not m.alive or m.store is None:
+            return
+        eng.log("fault stale-read-probe read-plane")
+        try:
+            cp.linearizable_read(m, lambda tx: len(tx.find(Task)),
+                                 timeout=4.0)
+            # success means the barrier confirmed FRESH data (e.g. the
+            # partition healed under it) — the invariants judge safety
+        except ReadUnavailable:
+            cp.read_stats["stale_probe_refused"] += 1
+    eng.at(eng.clock.start + 28.5, "stale-read probe", stale_probe)
+
+    # clock-skew fault: lease reads must auto-disable (degrade to
+    # read-index) for its whole duration
+    def skew_on():
+        lead = sim.leader()
+        victim = next((m for m in sim.managers
+                       if m.alive and m is not lead), sim.managers[0])
+        state["skewed"] = victim
+        victim.tick_scale = 2.0
+        eng.log(f"fault clock-skew {victim.id} x2")
+
+    def skew_off():
+        victim = state.get("skewed")
+        if victim is not None:
+            victim.tick_scale = 1.0
+            eng.log(f"fault clock-skew {victim.id} off")
+    eng.at(eng.clock.start + 38.0, "skew member", skew_on)
+    eng.at(eng.clock.start + 46.0, "unskew member", skew_off)
+
+    eng.at(eng.clock.start + 40.0, "more tasks",
+           lambda: cp.create_tasks(6))
+    return 55.0
+
+
+_follower_read_failover.raft_cp = True
+
+
+def _read_storm_degraded(sim: Sim) -> float:
+    """Continuous linearizable read load against follower members while
+    the leadership churns (stepdowns, a crash, a drop burst): every
+    probe must eventually serve — degraded to read-index latency during
+    gaps, NEVER an error, never stale — and the follower-pinned watch
+    streams must stay continuous throughout."""
+    eng = sim.engine
+    cp = sim.cp
+    cp.enable_follower_reads()
+    cp.expect_reads_never_fail = True
+    sim.start_raft_workload(interval=0.8)
+    cp.create_tasks(12)
+    cp.add_watchers(2)
+    eng.log("fault read-storm read-plane")
+    cp.start_read_probes(interval=1.0, timeout=25.0)
+
+    # rolling leader churn under the storm
+    for t in (10.0, 18.0, 34.0):
+        eng.at(eng.clock.start + t, "stepdown", sim.stepdown_leader)
+
+    def crash_leader():
+        m = sim.leader()
+        if m is None:
+            return
+        m.crash()
+        eng.after(6.0, "restart ex-leader", m.restart)
+    eng.at(eng.clock.start + 24.0, "crash leader", crash_leader)
+
+    eng.at(eng.clock.start + 30.0, "drop burst",
+           lambda: setattr(sim.net.config, "drop_p", 0.1))
+    eng.at(eng.clock.start + 36.0, "drop off",
+           lambda: setattr(sim.net.config, "drop_p", 0.0))
+    eng.at(eng.clock.start + 20.0, "more tasks",
+           lambda: cp.create_tasks(8))
+    return 48.0
+
+
+_read_storm_degraded.raft_cp = True
+
+
 # ----------------------------------------------- rolling-update scenarios
 #
 # The UpdateSupervisor is live inside the raft-attached control plane
@@ -1243,6 +1398,9 @@ SCENARIOS: Dict[str, Callable[[Sim], float]] = {
     "failover-churn-rollout": _failover_churn_rollout,
     # priority & preemption (device victim kernel + host oracle)
     "preemption-storm": _preemption_storm,
+    # follower-served read plane (read-index/lease reads, resume tokens)
+    "follower-read-failover": _follower_read_failover,
+    "read-storm-degraded": _read_storm_degraded,
     # rolling-update suite (real UpdateSupervisor, threadless drive)
     "rolling-upgrade-chaos": _rolling_upgrade_chaos,
     "cascading-failure-rebalance": _cascading_failure_rebalance,
@@ -1269,6 +1427,9 @@ UPDATE_SCENARIOS = (
 
 #: priority & preemption suite (ISSUE 10)
 PREEMPT_SCENARIOS = ("preemption-storm",)
+
+#: follower-served read plane (ISSUE 11)
+READ_SCENARIOS = ("follower-read-failover", "read-storm-degraded")
 
 #: legacy fault timelines re-driven through Sim(raft_cp=True)
 LEGACY_RCP_SCENARIOS = (

@@ -17,10 +17,26 @@ class Event:
     action: str              # "create" | "update" | "delete"
     obj: Any                 # the (new) object; for delete, the deleted object
     old: Any = None          # previous version on update, else None
+    # store version this change committed at (the watch resume token).
+    # 0 = unstamped: create/update events fall back to the object's own
+    # meta.version.index; the store stamps deletes explicitly (a delete
+    # burns a version index the payload cannot carry).
+    version: int = 0
 
     @property
     def collection(self) -> str:
         return self.obj.collection
+
+
+def event_version(ev: Event) -> int:
+    """The change's store version — the resume token a watch client hands
+    back to continue exactly after this event, on ANY member's replicated
+    store (version stamping is part of the replicated state, so tokens
+    survive reconnecting to a different member)."""
+    if ev.version:
+        return ev.version
+    meta = getattr(ev.obj, "meta", None)
+    return meta.version.index if meta is not None else 0
 
 
 class EventTaskBlock:

@@ -23,6 +23,8 @@ import random
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ...models.types import now as _now
+
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
 LEADER = "leader"
@@ -78,6 +80,7 @@ class Snapshot:
 @dataclass
 class Message:
     type: str            # vote / vote_resp / app / app_resp / snap
+                         # / read_index / read_index_resp
     term: int
     src: str
     dst: str
@@ -94,6 +97,16 @@ class Message:
     match_index: int = 0
     # snapshot
     snapshot: Optional[Snapshot] = None
+    # read-index protocol (etcd-raft MsgReadIndex/MsgReadIndexResp):
+    # heartbeat rounds carry a context id that successful followers echo
+    # so the leader can count a quorum for the reads pinned to the round
+    read_ctx: int = 0
+    # requester-minted id for one read-barrier request, echoed in the resp
+    read_seq: int = 0
+    # the leader's confirmed commit index for that request
+    read_index: int = 0
+    # the resp was served off the leader lease (no quorum round)
+    lease_read: bool = False
 
 
 @dataclass
@@ -174,6 +187,46 @@ class RaftCore:
         self._persisted_index = 0    # highest entry index known persisted
         self._hs_dirty = False
         self._pending_snapshot: Optional[Snapshot] = None
+        # ---- read-index / lease state (etcd-raft readOnly + lease read).
+        # The core stays sans-IO for consensus; the lease alone reads
+        # wall/virtual time through the models.types.now() seam, because
+        # a lease IS a clock claim ("no one can have been elected yet").
+        #: seconds a quorum-acked heartbeat round extends the lease; the
+        #: embedder sets this to (election_tick * tick_seconds) — it MUST
+        #: stay below the minimum election timeout or the lease argument
+        #: is void.  None disables the lease fast path (read-index only).
+        self.lease_duration: Optional[float] = None
+        #: fraction shaved off the lease for clock drift between members
+        #: (the reference design's clock-drift safety margin)
+        self.lease_drift_margin = 0.2
+        #: embedder veto: when set and returning False, lease reads are
+        #: refused (the sim wires this to "a clock-skew fault is active",
+        #: which voids the lease math — election timers no longer run at
+        #: spec rate).  None = no veto (production default).
+        self.lease_gate: Optional[Callable[[], bool]] = None
+        #: the clock the lease window is measured on.  Defaults to the
+        #: models.types.now() seam (virtual under the sim — lease math
+        #: must be a pure function of the seed there); production
+        #: embedders (RaftNode) override it with a MONOTONIC clock: a
+        #: backward wall-clock step (NTP) must shrink the lease to
+        #: nothing, never extend it past the election timeout.
+        self.lease_clock: Callable[[], float] = _now
+        self._lease_expiry = 0.0
+        #: local read-barrier results: read_seq -> (index, ok, lease)
+        self.read_results: Dict[int, Tuple[int, bool, bool]] = {}
+        #: called (read_seq, index, ok, lease) whenever a local read
+        #: resolves — the driver completes its blocked readers here
+        self.on_read_ready: Optional[
+            Callable[[int, int, bool, bool], None]] = None
+        #: plain tallies; embedders export them as metrics
+        self.read_stats = {"lease_served": 0, "read_index_served": 0,
+                           "lease_refused_gate": 0, "read_failed": 0}
+        self._read_seq = 0           # local request ids (this member)
+        self._read_ctx = 0           # heartbeat-round context (leader)
+        self._read_acks: Dict[int, set] = {}
+        self._hb_sent_at: Dict[int, float] = {}
+        # (ctx, requester, read_seq, index): reads pinned to a round
+        self._pending_reads: List[Tuple[int, str, int, int]] = []
         # check-quorum: a leader that cannot reach a majority steps down so
         # its blocked proposals fail fast (etcd-raft CheckQuorum behavior)
         self._quorum_elapsed = 0
@@ -322,6 +375,7 @@ class RaftCore:
             # created under it fail even if this member is re-elected
             # before they reach a fence point
             self.fence_epoch()
+            self._fail_pending_reads()
         if term > self.term:
             self.term = term
             self.voted_for = ""
@@ -353,6 +407,13 @@ class RaftCore:
     def _become_leader(self) -> None:
         self.role = LEADER
         self.leader_id = self.id
+        # a lease is earned per reign: the first quorum-acked heartbeat
+        # round under THIS term starts it (a carried-over expiry could
+        # overlap the previous leader's)
+        self._lease_expiry = 0.0
+        self._read_acks.clear()
+        self._hb_sent_at.clear()
+        self._pending_reads.clear()
         # mint a fresh leadership epoch.  max(): strictly greater than
         # every epoch this process ever minted or fenced, and — because
         # an election's term strictly exceeds every persisted term, and
@@ -450,6 +511,112 @@ class RaftCore:
         if len(self.peers) == 1:
             self._maybe_commit()
 
+    # ----------------------------------------------------- linearizable reads
+    #
+    # Read-index protocol (raft thesis §6.4, etcd-raft ReadIndex): a
+    # linearizable read needs the CURRENT leader's commit index, proven
+    # current by one heartbeat quorum round; the reader then waits until
+    # its local applied index passes that commit index.  The leader-lease
+    # fast path skips the round while the lease from the last
+    # quorum-acked heartbeat is still valid (minus a clock-drift margin):
+    # no other member can have won an election inside that window.
+
+    def lease_valid(self) -> bool:
+        """True while the leader lease covers a quorum-free read."""
+        if (self.role != LEADER or self.lease_duration is None
+                or not self.leader_ready):
+            return False
+        if len(self.peers) == 1:
+            return True
+        return self.lease_clock() < self._lease_expiry
+
+    def request_read(self) -> Optional[int]:
+        """Begin a read-barrier request on ANY member; returns a
+        ``read_seq`` whose result lands in ``read_results`` (and fires
+        ``on_read_ready``), or None when no leader is known to ask."""
+        self._read_seq += 1
+        seq = self._read_seq
+        if self.role == LEADER:
+            self._serve_read_index(self.id, seq)
+            return seq
+        if not self.leader_id:
+            self._read_seq -= 1
+            return None
+        self._msgs.append(Message(
+            type="read_index", term=self.term, src=self.id,
+            dst=self.leader_id, read_seq=seq))
+        return seq
+
+    def _serve_read_index(self, requester: str, read_seq: int) -> None:
+        """Leader side of one read request (local or remote)."""
+        if self.role != LEADER or not self.leader_ready:
+            self._read_reply(requester, read_seq, 0, ok=False)
+            return
+        index = self.commit_index
+        if len(self.peers) == 1:
+            self.read_stats["read_index_served"] += 1
+            self._read_reply(requester, read_seq, index, ok=True)
+            return
+        if self.lease_duration is not None \
+                and self.lease_gate is not None and not self.lease_gate():
+            # the embedder vetoed the lease (clock-skew fault active):
+            # fall through to the full quorum round
+            self.read_stats["lease_refused_gate"] += 1
+        elif self.lease_valid():
+            self.read_stats["lease_served"] += 1
+            self._read_reply(requester, read_seq, index, ok=True,
+                             lease=True)
+            return
+        ctx = self._read_ctx + 1
+        self._pending_reads.append((ctx, requester, read_seq, index))
+        self._broadcast_append(heartbeat=True)
+
+    def _read_reply(self, requester: str, read_seq: int, index: int,
+                    ok: bool, lease: bool = False) -> None:
+        if requester == self.id or not requester:
+            self.read_results[read_seq] = (index, ok, lease)
+            if self.on_read_ready is not None:
+                self.on_read_ready(read_seq, index, ok, lease)
+            return
+        self._msgs.append(Message(
+            type="read_index_resp", term=self.term, src=self.id,
+            dst=requester, read_seq=read_seq, read_index=index,
+            success=ok, lease_read=lease))
+
+    def _confirm_read_ctx(self, ctx: int) -> None:
+        """A heartbeat round got its quorum: renew the lease from the
+        round's SEND time (conservative — followers reset their election
+        timers no earlier than that) and resolve every read pinned to
+        this or an earlier round."""
+        sent = self._hb_sent_at.get(ctx)
+        if sent is not None and self.lease_duration is not None:
+            self._lease_expiry = max(
+                self._lease_expiry,
+                sent + self.lease_duration * (1.0 - self.lease_drift_margin))
+        # prune BOTH maps through ctx — a round whose every echo was
+        # lost never shows up in _read_acks, and its _hb_sent_at entry
+        # would otherwise outlive the reign (leak on a lossy link)
+        for c in [c for c in self._read_acks if c <= ctx]:
+            del self._read_acks[c]
+        for c in [c for c in self._hb_sent_at if c <= ctx]:
+            del self._hb_sent_at[c]
+        still = []
+        for (c, requester, seq, index) in self._pending_reads:
+            if c <= ctx:
+                self.read_stats["read_index_served"] += 1
+                self._read_reply(requester, seq, index, ok=True)
+            else:
+                still.append((c, requester, seq, index))
+        self._pending_reads = still
+
+    def _fail_pending_reads(self) -> None:
+        pending, self._pending_reads = self._pending_reads, []
+        for (_c, requester, seq, _index) in pending:
+            self.read_stats["read_failed"] += 1
+            self._read_reply(requester, seq, 0, ok=False)
+        self._read_acks.clear()
+        self._hb_sent_at.clear()
+
     # -------------------------------------------------------------- messages
 
     def step(self, m: Message) -> None:
@@ -484,6 +651,31 @@ class RaftCore:
             self._on_append_resp(m)
         elif m.type == "snap":
             self._on_snapshot(m)
+        elif m.type == "read_index":
+            if self.role == LEADER:
+                self._serve_read_index(m.src, m.read_seq)
+            else:
+                # not the leader anymore: refuse so the requester retries
+                # against whoever leads now
+                self._msgs.append(Message(
+                    type="read_index_resp", term=self.term, src=self.id,
+                    dst=m.src, read_seq=m.read_seq, success=False))
+        elif m.type == "read_index_resp":
+            self._on_read_index_resp(m)
+
+    def _on_read_index_resp(self, m: Message) -> None:
+        if m.success and m.term < self.term:
+            # a stale leader's grant must not complete a barrier minted
+            # under a newer view of the cluster; failures always deliver
+            # (they only trigger a retry)
+            return
+        if not m.success:
+            self.read_stats["read_failed"] += 1
+        self.read_results[m.read_seq] = (m.read_index, m.success,
+                                         m.lease_read)
+        if self.on_read_ready is not None:
+            self.on_read_ready(m.read_seq, m.read_index, m.success,
+                               m.lease_read)
 
     def _on_prevote(self, m: Message) -> None:
         """Answer a pre-vote probe; grants mutate NO local state.  Grant
@@ -568,11 +760,14 @@ class RaftCore:
         prev_term = self._term_at(m.prev_index)
         if prev_term is None or (m.prev_index > 0
                                  and prev_term != m.prev_term):
-            # log mismatch: ask the leader to back up
+            # log mismatch: ask the leader to back up.  The read context
+            # is still echoed — a mismatching follower has accepted this
+            # leader for the term, which is all a read quorum needs.
             self._msgs.append(Message(
                 type="app_resp", term=self.term, src=self.id, dst=m.src,
                 success=False,
-                match_index=min(m.prev_index - 1, self.last_index())))
+                match_index=min(m.prev_index - 1, self.last_index()),
+                read_ctx=m.read_ctx))
             return
         # append, truncating conflicts
         for e in m.entries:
@@ -594,11 +789,19 @@ class RaftCore:
             self._hs_dirty = True
         self._msgs.append(Message(
             type="app_resp", term=self.term, src=self.id, dst=m.src,
-            success=True, match_index=max(last_new, self.commit_index)))
+            success=True, match_index=max(last_new, self.commit_index),
+            read_ctx=m.read_ctx))
 
     def _on_append_resp(self, m: Message) -> None:
         if self.role != LEADER or m.term < self.term:
             return
+        if m.read_ctx:
+            # read-quorum accounting: success is irrelevant — any echo at
+            # our term is an acceptance of this leadership
+            acks = self._read_acks.setdefault(m.read_ctx, set())
+            acks.add(m.src)
+            if len(acks | {self.id}) > len(self.peers) // 2:
+                self._confirm_read_ctx(m.read_ctx)
         if m.success:
             self.match_index[m.src] = max(self.match_index.get(m.src, 0),
                                           m.match_index)
@@ -657,14 +860,21 @@ class RaftCore:
                 break
 
     def _broadcast_append(self, heartbeat: bool = False) -> None:
+        # every broadcast round doubles as a leadership proof: it carries
+        # a read-index context the followers echo on success, so the
+        # quorum count confirms pending reads and renews the lease
+        self._read_ctx += 1
+        ctx = self._read_ctx
+        self._hb_sent_at[ctx] = self.lease_clock()
         # sorted: message emission order must be a pure function of state,
         # not of str-hash-seeded set order, so the deterministic simulator
         # gets identical traces across processes
         for peer in sorted(self.peers):
             if peer != self.id:
-                self._send_append(peer, heartbeat=heartbeat)
+                self._send_append(peer, heartbeat=heartbeat, ctx=ctx)
 
-    def _send_append(self, peer: str, heartbeat: bool = False) -> None:
+    def _send_append(self, peer: str, heartbeat: bool = False,
+                     ctx: int = 0) -> None:
         next_i = self.next_index.get(peer, self.last_index() + 1)
         if next_i <= self.snap_index:
             # follower is behind our log start: needs a snapshot; the
@@ -679,7 +889,8 @@ class RaftCore:
         self._msgs.append(Message(
             type="app", term=self.term, src=self.id, dst=peer,
             prev_index=prev, prev_term=self._term_at(prev) or 0,
-            entries=list(entries), commit=self.commit_index))
+            entries=list(entries), commit=self.commit_index,
+            read_ctx=ctx))
 
     # ----------------------------------------------------------------- ready
 

@@ -38,10 +38,18 @@ log = logging.getLogger("raft")
 # holding them is safe); apply runs once per committed entry
 _APPLY_TIMER = _metrics.timer("swarm_raft_apply_latency")
 _PROPOSE_TIMER = _metrics.timer("swarm_raft_propose_latency")
+_READ_INDEX_TIMER = _metrics.timer("swarm_read_index_latency")
 
 
 class NotLeader(Exception):
     """Proposal sent to a non-leader member."""
+
+
+class ReadUnavailable(Exception):
+    """A linearizable read barrier could not be confirmed in time (no
+    reachable leader, or this member could not catch up to the barrier
+    index).  Retry against another member — the data was never served
+    stale."""
 
 
 class StaleEpoch(NotLeader):
@@ -92,10 +100,24 @@ class RaftNode(Proposer):
         # in the flight recorder's bounded ring for post-mortems
         from ...obs.flightrec import flightrec
         self.core.on_transition = flightrec.record_raft
+        # leader-lease sizing: one election timeout of real time, margin
+        # already shaved inside the core (lease_drift_margin).  The
+        # lease window is measured on the MONOTONIC clock: a backward
+        # wall-clock step (NTP) must never extend a lease past the
+        # election timeout, it can only shorten it.
+        self.core.lease_duration = \
+            self.core.election_tick * (tick_interval if tick_interval
+                                       is not None else self.TICK_INTERVAL)
+        # monotonic by design, see above
+        # swarmlint: disable=determinism-seam
+        self.core.lease_clock = time.monotonic
+        self.core.on_read_ready = self._on_read_ready
 
         self._inbox: "queue.Queue" = queue.Queue()
         self._waiters: Dict[int, _Waiter] = {}
         self._waiters_lock = threading.Lock()
+        self._read_waiters: Dict[int, dict] = {}
+        self._read_submitting = False   # raft-thread-only flag
         self._local_indices: set = set()
         self._stop = threading.Event()
         self._done = threading.Event()
@@ -230,6 +252,30 @@ class RaftNode(Proposer):
         if item[0] == "stepdown":
             if self.core.role == LEADER:
                 self.core.step_down()
+            return
+        if item[0] == "read":
+            _, slot, ev = item
+            # the flag marks the synchronous-resolution window: a
+            # callback firing inside request_read must leave the result
+            # in core.read_results for the pop below (raft thread only)
+            self._read_submitting = True
+            try:
+                seq = self.core.request_read()
+            finally:
+                self._read_submitting = False
+            if seq is None:
+                # no known leader to ask; the caller backs off and retries
+                slot["ok"] = False
+                ev.set()
+                return
+            res = self.core.read_results.pop(seq, None)
+            if res is not None:
+                # resolved synchronously (lease / single-member fast path)
+                slot["index"], slot["ok"], slot["lease"] = res
+                ev.set()
+            else:
+                with self._waiters_lock:
+                    self._read_waiters[seq] = (slot, ev)
             return
         if item[0] == "conf":
             _, op, member_id, addr, api_addr, waiter = item
@@ -443,9 +489,81 @@ class RaftNode(Proposer):
     def _fail_waiters(self) -> None:
         with self._waiters_lock:
             waiters, self._waiters = self._waiters, {}
+            read_waiters, self._read_waiters = self._read_waiters, {}
         for w in waiters.values():
             w.ok = False
             w.event.set()
+        for slot, ev in read_waiters.values():
+            slot["ok"] = False
+            ev.set()
+
+    # ---------------------------------------------------- linearizable reads
+
+    def _on_read_ready(self, seq: int, index: int, ok: bool,
+                       lease: bool) -> None:
+        """Core callback (raft thread): a read-barrier request resolved."""
+        with self._waiters_lock:
+            w = self._read_waiters.pop(seq, None)
+        if w is None:
+            if not self._read_submitting:
+                # nobody is waiting (the reader timed out or a
+                # leadership change failed its waiter) and this is not
+                # the synchronous-resolution window: drop the orphaned
+                # result or it leaks for the process lifetime
+                self.core.read_results.pop(seq, None)
+            # else: resolved synchronously inside request_read — the
+            # inbox handler reads it straight out of core.read_results
+            return
+        self.core.read_results.pop(seq, None)
+        slot, ev = w
+        slot["index"], slot["ok"], slot["lease"] = index, ok, lease
+        ev.set()
+
+    def read_barrier(self, timeout: float = 10.0) -> int:
+        """Linearizable read barrier (raft thesis §6.4): returns once this
+        member's applied state includes everything committed cluster-wide
+        at the moment of the call — served off the leader lease when
+        valid, a read-index heartbeat quorum round otherwise.  Callable
+        on ANY member; followers ask the leader for the confirmed commit
+        index and wait until their applied index passes it.  Raises
+        ReadUnavailable when no leader confirms within ``timeout`` —
+        degraded, never stale.  MUST NOT be called while holding the
+        store's locks (swarmlint lock-discipline enforces this)."""
+        t0 = time.perf_counter()
+        deadline = t0 + timeout
+        slot: dict = {}
+        while True:
+            slot = {}
+            ev = threading.Event()
+            self._inbox.put(("read", slot, ev))
+            ev.wait(timeout=max(0.001, deadline - time.perf_counter()))
+            if ev.is_set() and slot.get("ok"):
+                break
+            if time.perf_counter() >= deadline:
+                _metrics.counter('swarm_lease_reads{result="unavailable"}')
+                raise ReadUnavailable(
+                    f"{self.id}: no leader confirmed a read barrier "
+                    f"within {timeout:.1f}s")
+            # refused (leaderless gap / churn): brief backoff, retry
+            self._stop.wait(timeout=0.01)
+        index = slot["index"]
+        while self.core.applied_index < index:
+            if time.perf_counter() >= deadline:
+                _metrics.counter('swarm_lease_reads{result="lagging"}')
+                raise ReadUnavailable(
+                    f"{self.id}: applied index {self.core.applied_index} "
+                    f"never reached the barrier {index}")
+            self._stop.wait(timeout=0.002)
+        _READ_INDEX_TIMER.observe(time.perf_counter() - t0)
+        _metrics.counter('swarm_lease_reads{result="lease"}'
+                         if slot.get("lease")
+                         else 'swarm_lease_reads{result="read_index"}')
+        # one consistent meaning everywhere: "was the last read served
+        # off a lease" — on a follower the LEADER's lease answers its
+        # read_index request, and the resp's lease flag carries that
+        _metrics.gauge("swarm_lease_enabled",
+                       1.0 if slot.get("lease") else 0.0)
+        return index
 
     # ------------------------------------------------------------ membership
 

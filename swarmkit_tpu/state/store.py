@@ -584,6 +584,39 @@ class MemoryStore:
             return tx
         return cb(tx)
 
+    def read_view(self, cb: Optional[Callable[[ReadTx], Any]] = None,
+                  linearizable: bool = False,
+                  timeout: Optional[float] = None) -> Any:
+        """A read transaction with an optional linearizability guarantee.
+
+        ``linearizable=False`` is ``view``: the local replicated state,
+        which may trail the leader (serializable, never uncommitted —
+        followers only apply committed entries).  ``linearizable=True``
+        first runs the proposer's ``read_barrier`` capability (raft
+        read-index / leader lease): the barrier returns only once this
+        store has applied everything committed cluster-wide at call
+        time, so a FOLLOWER store serves linearizable reads without
+        touching the leader's store.  Raises the proposer's
+        ReadUnavailable when the barrier cannot be confirmed — degraded,
+        never stale.  Proposers without the capability (nil/test
+        proposers, a standalone store) serve directly: there is no
+        replication lag to wait out.
+
+        The barrier deliberately runs OUTSIDE both store locks (it blocks
+        on consensus; swarmlint's lock-discipline rule bans it under
+        ``_lock``/``_update_lock``)."""
+        if linearizable and self._proposer is not None:
+            barrier = getattr(self._proposer, "read_barrier", None)
+            if barrier is not None:
+                if timeout is None:
+                    barrier()
+                else:
+                    barrier(timeout=timeout)
+        tx = ReadTx(self)
+        if cb is None:
+            return tx
+        return cb(tx)
+
     def view_and_watch(self, cb: Callable[[ReadTx], Any],
                        predicate=None, limit: Optional[int] = None,
                        accepts_blocks: bool = False
@@ -682,6 +715,9 @@ class MemoryStore:
             for change, ev in zip(tx._changes, tx._events):
                 self._version += 1   # versions pre-stamped in update()
                 self._apply_locked(change)
+                # stamp the resume token (frozen dataclass: events are
+                # immutable to consumers; the store is their minter)
+                object.__setattr__(ev, "version", self._version)
                 self._log_change_locked(
                     ("one", self._version, ev.action, ev.obj, ev.old), 1)
         tx.closed = True
@@ -731,7 +767,8 @@ class MemoryStore:
             if hi <= from_version:
                 continue
             if entry[0] == "one":
-                out.append(Event(entry[2], entry[3], entry[4]))
+                out.append(Event(entry[2], entry[3], entry[4],
+                                 version=entry[1]))
                 continue
             _, base, olds, node_ids, state, message, ts = entry
             for i, old in enumerate(olds):
@@ -742,7 +779,7 @@ class MemoryStore:
                     "update",
                     _materialize_task(old, node_ids[i], ver, ts, state,
                                       message),
-                    old))
+                    old, version=ver))
         return out
 
     def watch_from(self, from_version: int, predicate=None
@@ -1459,6 +1496,9 @@ class MemoryStore:
                                             obj.meta.version.index)
                     self._apply_locked(StoreAction(change.action, obj))
                     ev = events[-1]
+                    # follower-side resume tokens must match the leader's
+                    # stamping bit-for-bit (same version counter flow)
+                    object.__setattr__(ev, "version", self._version)
                     self._log_change_locked(
                         ("one", self._version, ev.action, ev.obj, ev.old),
                         1)
@@ -1517,7 +1557,7 @@ class MemoryStore:
         for old, nid, ver in applied:
             ev = Event("update",
                        _materialize_task(old, nid, ver, ts, state,
-                                         message), old)
+                                         message), old, version=ver)
             self._log_change_locked(
                 ("one", ver, "update", ev.obj, ev.old), 1)
             events.append(ev)

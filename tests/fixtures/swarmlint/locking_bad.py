@@ -20,3 +20,7 @@ class MemoryStore:
     def commit_with_fetch(self, planner, handle):
         with self._update_lock:
             planner.fetch_group(handle)      # D2H under the writer lock
+
+    def serve_linearizable_locked(self, proposer):
+        with self._lock:
+            proposer.read_barrier()          # barrier wait under view lock

@@ -29,3 +29,10 @@ class MemoryStore:
         # path (writers serialize through consensus by design)
         with self._update_lock:
             proposer.propose(actions, cb, epoch=epoch)
+
+    def serve_linearizable(self, proposer, cb):
+        # read barrier FIRST, lock-free; the view takes the lock only
+        # per method call afterwards (read_view's sanctioned shape)
+        proposer.read_barrier()
+        with self._lock:
+            return self.snapshot()
