@@ -869,6 +869,187 @@ def run_priority_jobs(planner_factory):
     }
 
 
+def run_autoscale_tenant_storm(planner_factory):
+    """Config 9: autoscaler + tenant QoS under a burst (ISSUE 12).  256
+    nodes run a high-band tenant (400 tasks, must all place) while a
+    quota'd low-band tenant bursts: one service asks 500 tasks against
+    a 300-task quota (admission clamps the overflow), a second same-
+    tenant service's whole group arrives with the tenant exhausted —
+    the DEVICE quota-mask column rejects it end to end.  The timed
+    window covers one autoscaler drive (the supervisor's decision
+    write) plus the storm tick; scripts/bench_compare.py gates on
+    ``quota_clamps`` > 0 with ZERO XLA compiles inside the window (the
+    warm-up pass below covers the quota-mask signatures)."""
+    _trim_heap()
+    from swarmkit_tpu.models import (
+        Annotations, Node, NodeDescription, NodeSpec, NodeState,
+        NodeStatus, ReplicatedService, Resources, ResourceRequirements,
+        Service, ServiceMode, ServiceSpec, Task, TaskSpec, TaskState,
+        TaskStatus, Version,
+    )
+    from swarmkit_tpu.models.specs import AutoscaleConfig
+    from swarmkit_tpu.models.objects import Cluster
+    from swarmkit_tpu.models.specs import ClusterSpec
+    from swarmkit_tpu.models.types import TenantQuota
+    from swarmkit_tpu.orchestrator.autoscaler import (
+        Supervisor as AutoscaleSupervisor,
+    )
+    from swarmkit_tpu.scheduler import Scheduler
+    from swarmkit_tpu.scheduler.quota import TENANT_LABEL
+    from swarmkit_tpu.state import MemoryStore
+    from swarmkit_tpu.utils import new_id
+
+    N_N = int(os.environ.get("BENCH_CFG9_NODES", 256))
+    CPU = 2 * 10 ** 9
+    # band sizes derive from capacity (4 slots per 8-cpu node) so the
+    # config scales with BENCH_CFG9_NODES: the high band + the burst
+    # tenant's quota together stay ~70% of the cluster — the blocked
+    # service must fail on QUOTA, not on capacity
+    slots = N_N * 4
+    N_HI = slots * 2 // 5
+    QUOTA_TASKS = slots * 3 // 10
+    N_BURST = QUOTA_TASKS + max(slots // 5, 50)
+    N_BLOCKED = max(slots // 8, 16)
+
+    def build():
+        store = MemoryStore()
+        store.update(lambda tx: tx.create(Cluster(
+            id=new_id(),
+            spec=ClusterSpec(
+                annotations=Annotations(name="default"),
+                tenants={
+                    "burst": TenantQuota(nano_cpus=QUOTA_TASKS * CPU),
+                    "hi": TenantQuota(nano_cpus=1000 * CPU)}))))
+
+        def mk_nodes(tx):
+            for i in range(N_N):
+                tx.create(Node(
+                    id=new_id(),
+                    spec=NodeSpec(
+                        annotations=Annotations(name=f"q{i:04d}")),
+                    status=NodeStatus(state=NodeState.READY),
+                    description=NodeDescription(
+                        hostname=f"q{i:04d}",
+                        resources=Resources(nano_cpus=8 * 10 ** 9,
+                                            memory_bytes=32 << 30))))
+        store.update(mk_nodes)
+        res = ResourceRequirements(
+            reservations=Resources(nano_cpus=CPU, memory_bytes=1 << 30))
+        plan = (("hi", "hi", 2, N_HI, None),
+                ("burst", "burst", 0, N_BURST,
+                 AutoscaleConfig(min_replicas=2, max_replicas=N_BURST,
+                                 target_utilization=1.0,
+                                 stabilization_window=0.0)),
+                ("blocked", "burst", 0, N_BLOCKED, None))
+        svcs = {}
+
+        def mk_svcs(tx):
+            for name, tenant, prio, count, autoscale in plan:
+                spec = TaskSpec(resources=res, priority=prio)
+                svc = Service(
+                    id=new_id(),
+                    spec=ServiceSpec(
+                        annotations=Annotations(
+                            name=f"t-{name}",
+                            labels={TENANT_LABEL: tenant}),
+                        mode=ServiceMode.REPLICATED,
+                        # the burst service starts small so the timed
+                        # autoscaler drive commits a real scale-up
+                        # decision against the sampled load
+                        replicated=ReplicatedService(
+                            replicas=2 if autoscale else count),
+                        task=spec,
+                        autoscale=autoscale),
+                    spec_version=Version(index=1))
+                svcs[name] = svc
+                tx.create(svc)
+        store.update(mk_svcs)
+
+        def mk_tasks(tx):
+            for name, _tenant, prio, count, _a in plan:
+                svc = svcs[name]
+                for s in range(count):
+                    tx.create(Task(
+                        id=new_id(), service_id=svc.id, slot=s + 1,
+                        desired_state=TaskState.RUNNING,
+                        spec=svc.spec.task,
+                        spec_version=Version(index=1),
+                        service_annotations=svc.spec.annotations,
+                        status=TaskStatus(state=TaskState.PENDING)))
+        store.update(mk_tasks)
+        return store, svcs
+
+    def one_pass(store, svcs):
+        planner = planner_factory()
+        sched = Scheduler(store, batch_planner=planner)
+        store.view(sched._setup_tasks_list)
+        scaler = AutoscaleSupervisor(
+            store,
+            sampler=lambda sid: {"load": float(N_BURST)}
+            if sid == svcs["burst"].id else None,
+            start_worker=False)
+        gc.collect()
+        gc.freeze()
+        t0 = time.perf_counter()
+        scaler.drive()
+        n_dec = sched.tick()
+        dt = time.perf_counter() - t0
+        gc.unfreeze()
+        return sched, planner, scaler, n_dec, dt
+
+    from swarmkit_tpu.obs import tracer as _tracer
+    was_tracing = _tracer.enabled
+    _tracer.disable()
+    try:
+        one_pass(*build())   # warm-up: every jit signature incl. quota
+        _trim_heap()
+    finally:
+        _tracer.enabled = was_tracing
+
+    store, svcs = build()
+    snap = _planner_counter_snapshot()
+    sched, planner, scaler, n_dec, dt = one_pass(store, svcs)
+    routed = _planner_counter_delta(snap)
+    clamps = sched.stats.get("quota_clamps", 0)
+    assert scaler.stats["decisions"] > 0, \
+        "cfg9 autoscaler made no decision in the timed window"
+
+    tasks = store.view(lambda tx: tx.find(Task))
+    by_svc = {}
+    for t in tasks:
+        if t.node_id and t.status.state >= TaskState.ASSIGNED:
+            by_svc[t.service_id] = by_svc.get(t.service_id, 0) + 1
+    placed_hi = by_svc.get(svcs["hi"].id, 0)
+    placed_burst = by_svc.get(svcs["burst"].id, 0)
+    placed_blocked = by_svc.get(svcs["blocked"].id, 0)
+    assert placed_hi == N_HI, \
+        f"cfg9: high band placed {placed_hi}/{N_HI}"
+    assert placed_burst <= QUOTA_TASKS, \
+        f"cfg9: burst tenant exceeded its quota ({placed_burst})"
+    assert clamps > 0, "cfg9 ran without a single quota clamp"
+    assert placed_blocked == 0, \
+        f"cfg9: exhausted tenant still placed {placed_blocked}"
+    blocked_err = next(
+        (t.status.err for t in tasks
+         if t.service_id == svcs["blocked"].id), "")
+    assert "over tenant quota" in (blocked_err or ""), blocked_err
+    return {
+        "nodes": N_N, "tasks": N_HI + N_BURST + N_BLOCKED,
+        "tenants": 2,
+        "decisions": n_dec,
+        "decisions_per_sec": round(n_dec / dt, 1),
+        "tick_s": round(dt, 3),
+        "plan_s": round(planner.stats["plan_seconds"], 3),
+        "commit_s": round(sched.stats["commit_seconds"], 3),
+        "quota_clamps": clamps,
+        "autoscale_decisions": scaler.stats["decisions"],
+        "fallback_groups": routed["groups_fallback"],
+        "path": "device+quota-mask",
+        "shape_cost_x": 1.0,
+        "compiles": _compile_delta(snap),
+    }
+
+
 def run_e2e(n_agents=5, n_replicas=500):
     """swarm-bench equivalent: create an N-replica service and measure
     per-task time from service creation to RUNNING status committed
@@ -1141,6 +1322,13 @@ def main():
         # under load (victim kernel signatures warmed inside the config)
         with tracer.span("bench.config", "bench", cfg="cfg8"):
             configs["8_mixed_priority_jobs"] = run_priority_jobs(tpu)
+    if _cfg_enabled(9):
+        # autoscaler decision + quota-clamped tenant burst through the
+        # device quota-mask column (bench_compare gates clamps > 0 with
+        # compile-flat timed windows)
+        with tracer.span("bench.config", "bench", cfg="cfg9"):
+            configs["9_autoscale_tenant_storm"] = \
+                run_autoscale_tenant_storm(tpu)
     if SKIP_E2E:
         e2e = None
     else:
@@ -1256,6 +1444,7 @@ def _append_history(artifact):
                 "compiles": sum(cfg.get("compiles", {}).values()),
                 "shape_cost_x": cfg.get("shape_cost_x"),
                 "preemptions": cfg.get("preemptions"),
+                "quota_clamps": cfg.get("quota_clamps"),
             }
             for name, cfg in artifact["configs"].items()},
     }

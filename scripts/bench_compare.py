@@ -40,6 +40,7 @@ def _norm(doc):
     """Normalize an artifact or history record to
     {"headline": dps, "configs": {name: dps}} plus context fields."""
     configs, shape_cost, compiles, preempts = {}, {}, {}, {}
+    quota_clamps = {}
     for name, cfg in (doc.get("configs") or {}).items():
         dps = cfg.get("decisions_per_sec")
         if dps:
@@ -48,6 +49,8 @@ def _norm(doc):
             shape_cost[name] = float(cfg["shape_cost_x"])
         if cfg.get("preemptions") is not None:
             preempts[name] = int(cfg["preemptions"])
+        if cfg.get("quota_clamps") is not None:
+            quota_clamps[name] = int(cfg["quota_clamps"])
         compiles[name] = _compiles(cfg.get("compiles"))
     return {
         "headline": float(doc.get("value") or 0.0),
@@ -58,6 +61,8 @@ def _norm(doc):
         "compiles": compiles,
         # preemption counters per config (cfg8 must show them)
         "preemptions": preempts,
+        # tenant-quota clamps per config (cfg9 must show them)
+        "quota_clamps": quota_clamps,
         "headline_compiles": _compiles(doc.get("planner_compiles")),
         "t": doc.get("t"),
         "health": (doc.get("health") or {}).get("status")
@@ -236,6 +241,26 @@ def main(argv=None) -> int:
                   "its timed window", file=sys.stderr)
             gate_failures.append(("preemption-compile-growth",
                                   f"{_PRIO_CFG} compiles={cfg8_compiles}"))
+    # tenant-QoS gate: the autoscale/tenant-storm config must show
+    # quota clamps (admission control actually fired) AND pay zero XLA
+    # compiles inside its timed window (the quota-mask signatures are
+    # warmed by the config's own warm-up pass) — NEW run alone
+    _QOS_CFG = "9_autoscale_tenant_storm"
+    if _QOS_CFG in new.get("configs", {}):
+        qc = new.get("quota_clamps", {}).get(_QOS_CFG)
+        print(f"quota_clamps[{_QOS_CFG}]: "
+              f"{old.get('quota_clamps', {}).get(_QOS_CFG)} -> {qc}")
+        if not qc:
+            print(f"\n{_QOS_CFG} ran without quota clamps — tenant "
+                  "admission control never fired", file=sys.stderr)
+            gate_failures.append(("quota-clamp-counters",
+                                  f"{_QOS_CFG} quota_clamps={qc}"))
+        cfg9_compiles = new.get("compiles", {}).get(_QOS_CFG, 0)
+        if cfg9_compiles:
+            print(f"\n{_QOS_CFG} paid {cfg9_compiles} XLA compile(s) in "
+                  "its timed window", file=sys.stderr)
+            gate_failures.append(("quota-compile-growth",
+                                  f"{_QOS_CFG} compiles={cfg9_compiles}"))
     # compile-flatness gate: XLA compiles inside timed regions must not
     # GROW — warm-up covers every signature a config touches, so any
     # growth means a new shape leaked into a timed window.  Judged over

@@ -46,8 +46,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from swarmkit_tpu.sim.scenario import (          # noqa: E402
     FAILOVER_SCENARIOS, FUZZ_POOL, LEGACY_RCP_SCENARIOS,
-    PREEMPT_SCENARIOS, READ_SCENARIOS, SCENARIOS, UPDATE_SCENARIOS,
-    run_scenario,
+    PREEMPT_SCENARIOS, QOS_SCENARIOS, READ_SCENARIOS, SCENARIOS,
+    UPDATE_SCENARIOS, run_scenario,
 )
 
 #: named scenario subsets.  "default" is what CI's slow sweep runs; the
@@ -57,10 +57,12 @@ SUITES: Dict[str, tuple] = {
     "failover": FAILOVER_SCENARIOS,
     "update": UPDATE_SCENARIOS,
     "preempt": PREEMPT_SCENARIOS,
+    "qos": QOS_SCENARIOS,
     "read": READ_SCENARIOS,
     "legacy-rcp": LEGACY_RCP_SCENARIOS,
     "default": FAILOVER_SCENARIOS + UPDATE_SCENARIOS
-    + PREEMPT_SCENARIOS + READ_SCENARIOS + LEGACY_RCP_SCENARIOS,
+    + PREEMPT_SCENARIOS + QOS_SCENARIOS + READ_SCENARIOS
+    + LEGACY_RCP_SCENARIOS,
     "fuzz": FUZZ_POOL,
 }
 
@@ -77,6 +79,7 @@ _FIXED_COMPONENT = {
     "agent-partition": "agent", "task-failure-storm": "agent",
     "rollout-poison": "updater",
     "preempt-burst": "scheduler",
+    "autoscale-burst": "scheduler", "quota-clamp": "scheduler",
     "stale-read-probe": "read-plane", "read-storm": "read-plane",
     "cut": "network", "heal": "network", "split": "network",
     "heal-all": "network", "drop": "network", "drop-burst": "network",
@@ -144,6 +147,14 @@ REQUIRED_CELLS: Dict[str, Set[Tuple[str, str]]] = {
     "preemption-storm": {
         ("preempt-burst", "scheduler"), ("agent-crash", "agent"),
         ("agent-restart", "agent"), ("stepdown", "manager"),
+        ("drop", "network")},
+    # autoscaler + tenant QoS: the burst is injected, but the
+    # quota-clamp cell is logged only when the scheduler ACTUALLY
+    # clamped — a suite edit that stops clamping empties the cell
+    "tenant-storm": {
+        ("autoscale-burst", "scheduler"), ("quota-clamp", "scheduler"),
+        ("crash", "manager"), ("restart", "manager"),
+        ("agent-crash", "agent"), ("agent-restart", "agent"),
         ("drop", "network")},
     # follower-served read plane: partition × read-plane (the stranded
     # ex-leader must be PROBED, not just partitioned) and clock × lease
@@ -253,7 +264,8 @@ def main(argv=None) -> int:
                         "overrides --suite)")
     p.add_argument("--fast", action="store_true",
                    help="CI subset: 3 seeds x rolling-upgrade-chaos + "
-                        "preemption-storm + follower-read-failover "
+                        "preemption-storm + follower-read-failover, "
+                        "plus 1 tenant-storm seed "
                         "(overrides --fuzz/--suite/--scenario)")
     p.add_argument("--no-coverage-gate", action="store_true",
                    help="report the coverage matrix but never fail on "
@@ -272,10 +284,12 @@ def main(argv=None) -> int:
                 print(f"  {name:34s} {doc.split(chr(10))[0]}")
         return 0
 
+    extra_runs: tuple = ()    # (scenario, n_seeds) beyond the main sweep
     if args.fast:
         scenarios: tuple = ("rolling-upgrade-chaos", "preemption-storm",
                             "follower-read-failover")
         n_seeds = 3
+        extra_runs = (("tenant-storm", 1),)
     else:
         if args.scenario:
             scenarios = tuple(args.scenario)
@@ -295,7 +309,11 @@ def main(argv=None) -> int:
 
     reports = sweep(scenarios, n_seeds, start_seed=args.start_seed,
                     progress=progress)
-    out = verdict(reports, scenarios, n_seeds, args.start_seed,
+    for name, n in extra_runs:
+        reports.extend(sweep((name,), n, start_seed=args.start_seed,
+                             progress=progress))
+    out = verdict(reports, scenarios + tuple(n for n, _ in extra_runs),
+                  n_seeds, args.start_seed,
                   check_coverage=not args.no_coverage_gate)
     print(json.dumps(out, indent=2))
     return 0 if out["ok"] else 1

@@ -334,6 +334,182 @@ class _AssignmentSet:
         return AssignmentsMessage(type_, applies_to, results_in, changes)
 
 
+class BatchedAssignmentFanout:
+    """Batched, threadless assignment fan-out (ISSUE 12 satellite,
+    ROADMAP direction 3 residual).
+
+    The classic ``open_assignments`` path runs one thread per node
+    stream — fine for five agents, wrong for a thousand, and an
+    autoscaler burst multiplies per-task sends.  This fan-out keeps ONE
+    store subscription, routes events into per-node ``_AssignmentSet``
+    diffs, and ``flush()`` (driven from ``process_deadlines`` — the
+    worker thread in production, the control step in the sim) sends at
+    most ceil(pending / modification_batch_limit) INCREMENTAL messages
+    per node per flush: N task assignments to one node cost
+    <= ceil(N/batch) sends, not N round-trips.
+
+    Leader-gap discipline mirrors the status-flush re-queue machinery:
+    diffs accumulate while a stream is down and the re-registered
+    node's fresh ``open`` rebuilds a COMPLETE set from the store view —
+    nothing lost, nothing duplicated (unit-tested across a gap in
+    tests/test_autoscale.py).
+    """
+
+    def __init__(self, dispatcher: "Dispatcher"):
+        self.d = dispatcher
+        self._mu = threading.Lock()
+        # serializes open() against flush(): open's COMPLETE snapshot
+        # and its registration in _sets must be atomic w.r.t. a flush
+        # draining the shared subscription, or an assignment committed
+        # between the two is consumed for a node flush doesn't know yet
+        # and lost forever
+        self._drain_mu = threading.Lock()
+        self._sets: Dict[str, _AssignmentSet] = {}
+        self._streams: Dict[str, AssignmentStream] = {}
+        self._seq: Dict[str, int] = {}
+        self._applies: Dict[str, str] = {}
+        self.stats = {"sends": 0, "complete_sends": 0}
+        self._sub = dispatcher.store.queue.subscribe(
+            lambda ev: isinstance(ev, EventTaskBlock)
+            or (isinstance(ev, Event)
+                and isinstance(ev.obj, (Task, Volume))),
+            accepts_blocks=True)
+
+    # ------------------------------------------------------------- streams
+
+    def open(self, node_id: str, session_id: str) -> AssignmentStream:
+        """Open (or re-open) a node's stream: full COMPLETE set from the
+        current store view, then incremental batches via flush()."""
+        self.d._check_session(node_id, session_id)
+        stream = AssignmentStream(node_id)
+        aset = _AssignmentSet(node_id,
+                              driver_provider=self.d.driver_provider)
+        with self._drain_mu:
+            # session re-check + stream registration BEFORE any state
+            # lands in the maps: a failure here must leak nothing
+            with self.d._mu:
+                rn = self.d._nodes.get(node_id)
+                if rn is None or rn.session_id != session_id:
+                    raise ErrSessionInvalid(node_id)
+                rn.streams.append(stream)
+            initial = self.d.store.view(
+                lambda vx: list(vx.find(Task, ByNode(node_id))))
+            tx = self.d.store.view()
+            for t in initial:
+                aset.add_or_update_task(tx, t)
+            with self._mu:
+                self._sets[node_id] = aset
+                self._streams[node_id] = stream
+                self._seq[node_id] = 0
+                self._applies[node_id] = ""
+            self._send(node_id, aset, stream,
+                       AssignmentsMessage.COMPLETE)
+            self.stats["complete_sends"] += 1
+        return stream
+
+    def _drop(self, node_id: str) -> None:
+        with self._mu:
+            self._sets.pop(node_id, None)
+            self._streams.pop(node_id, None)
+            self._seq.pop(node_id, None)
+            self._applies.pop(node_id, None)
+
+    def _send(self, node_id: str, aset: _AssignmentSet,
+              stream: AssignmentStream, type_) -> None:
+        """Send aset's pending changes as <= ceil(n/batch) messages."""
+        limit = max(self.d.config.modification_batch_limit, 1)
+        while True:
+            if type_ == AssignmentsMessage.INCREMENTAL \
+                    and not aset.changes:
+                return
+            chunk: Dict[tuple, tuple] = {}
+            for key in list(aset.changes)[:limit]:
+                chunk[key] = aset.changes.pop(key)
+            self._seq[node_id] += 1
+            results_in = str(self._seq[node_id])
+            msg = AssignmentsMessage(type_, self._applies[node_id],
+                                     results_in, list(chunk.values()))
+            stream._push(msg)
+            self._applies[node_id] = results_in
+            self.stats["sends"] += 1
+            _metrics.counter(
+                f'swarm_dispatcher_assignments_sent{{type="{type_}"}}')
+            _metrics.counter("swarm_dispatcher_assignment_changes",
+                             len(msg.changes))
+            # a COMPLETE always goes out (even empty); its overflow (a
+            # node with more assignments than one batch) continues as
+            # incrementals
+            type_ = AssignmentsMessage.INCREMENTAL
+
+    # --------------------------------------------------------------- flush
+
+    def flush(self) -> None:
+        """Drain the shared subscription into the per-node sets, then
+        one batched send pass.  ``_drain_mu`` serializes against
+        ``open()`` so events for a node mid-registration are either in
+        its COMPLETE snapshot or routed here — never silently consumed
+        for an unknown node that registers a moment later."""
+        with self._drain_mu:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        with self._mu:
+            live = dict(self._sets)
+        tx = None
+        while True:
+            ev = self._sub.poll()
+            if ev is None:
+                break
+            if isinstance(ev, EventTaskBlock):
+                per_node = ev.per_node()
+                for node_id, aset in live.items():
+                    items = per_node.get(node_id)
+                    if not items:
+                        continue
+                    tx = tx if tx is not None else self.d.store.view()
+                    for old, _ver in items:
+                        t = self.d.store.raw_get(Task, old.id)
+                        if t is not None:
+                            aset.add_or_update_task(tx, t)
+                continue
+            obj = ev.obj
+            if isinstance(obj, Volume):
+                if ev.action != "delete":
+                    for aset in live.values():
+                        aset.update_volume(obj)
+                continue
+            aset = live.get(obj.node_id)
+            if aset is None:
+                continue
+            if ev.action == "delete":
+                aset.remove_task(obj)
+            else:
+                tx = tx if tx is not None else self.d.store.view()
+                aset.add_or_update_task(tx, obj)
+        for node_id, aset in live.items():
+            stream = self._streams.get(node_id)
+            if stream is None or stream.closed:
+                self._drop(node_id)
+                continue
+            if aset.changes:
+                self._send(node_id, aset, stream,
+                           AssignmentsMessage.INCREMENTAL)
+
+    def stop(self) -> None:
+        with self._mu:
+            streams = list(self._streams.values())
+            self._sets.clear()
+            self._streams.clear()
+        for s in streams:
+            s.close(DispatcherError("dispatcher stopped"))
+        if self._sub is not None:
+            try:
+                self.d.store.queue.unsubscribe(self._sub)
+            except Exception:
+                pass
+            self._sub = None
+
+
 class Dispatcher:
     def __init__(self, store: MemoryStore,
                  config: Optional[Config_] = None,
@@ -388,6 +564,10 @@ class Dispatcher:
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
         self._streams_threads: List[threading.Thread] = []
+        #: batched assignment fan-out (enable_batched_fanout): replaces
+        #: the thread-per-stream assignments loop with one subscription
+        #: + per-node batched flushes driven from process_deadlines
+        self.fanout: Optional[BatchedAssignmentFanout] = None
         self.stats = {"heartbeats": 0, "expirations": 0}
         # cached Timer references — no per-call registry lookup on the
         # flush/assignments paths (reset() resets these in place)
@@ -446,6 +626,13 @@ class Dispatcher:
             if n.status.state != NodeState.DOWN:
                 self._push_deadline(deadline, "reg", n.id)
 
+    def enable_batched_fanout(self) -> "BatchedAssignmentFanout":
+        """Switch ``open_assignments`` to the batched, threadless
+        fan-out (call after ``run``).  Idempotent."""
+        if self.fanout is None:
+            self.fanout = BatchedAssignmentFanout(self)
+        return self.fanout
+
     def adopt_registration_grace(self, node_ids) -> None:
         """Adopt orphaned sessions (their owning member died): give each
         node a registration-grace window on THIS dispatcher; whoever does
@@ -488,6 +675,9 @@ class Dispatcher:
         if self._worker is not None:
             self._worker.join(timeout=5)
             self._worker = None
+        if self.fanout is not None:
+            self.fanout.stop()
+            self.fanout = None
         if getattr(self, "_cluster_sub", None) is not None:
             self.store.queue.unsubscribe(self._cluster_sub)
             self._cluster_sub = None
@@ -880,13 +1070,19 @@ class Dispatcher:
                     "leadership change")
             elif kind == "orphan" and expired:
                 self._move_tasks_to_orphaned(node_id)
+        if self.fanout is not None:
+            self.fanout.flush()
 
     # ---------------------------------------------------------- assignments
 
     def open_assignments(self, node_id: str,
                          session_id: str) -> AssignmentStream:
         """Start an assignments stream for the node
-        (reference: dispatcher.go:1013)."""
+        (reference: dispatcher.go:1013).  With the batched fan-out
+        enabled there is no per-stream thread — diffs flow through the
+        shared flush pass."""
+        if self.fanout is not None:
+            return self.fanout.open(node_id, session_id)
         self._check_session(node_id, session_id)
         stream = AssignmentStream(node_id)
         with self._mu:

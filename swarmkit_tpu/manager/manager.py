@@ -369,6 +369,13 @@ class Manager:
             self.csi_manager = CSIManager(self.store, plugins=plugins)
             from .deallocator import Deallocator
             self.deallocator = Deallocator(self.store)
+            # horizontal autoscaler: production mode wraps one thread;
+            # the deterministic sim builds its own threadless supervisor
+            from ..orchestrator.autoscaler import (
+                Supervisor as AutoscaleSupervisor,
+            )
+            self.autoscaler = AutoscaleSupervisor(self.store)
+            self.autoscaler.start()
             for loop in (self.allocator, self.scheduler, self.replicated,
                          self.global_, self.jobs, self.reaper,
                          self.constraint_enforcer, self.volume_enforcer,
@@ -575,7 +582,8 @@ class Manager:
             # return empty
             self.control_api.log_broker = None
             log.info("manager %s lost leadership", self.node_id[:8])
-            loops = [getattr(self, "deallocator", None),
+            loops = [getattr(self, "autoscaler", None),
+                     getattr(self, "deallocator", None),
                      self.csi_manager, self.role_manager,
                      self.keymanager, self.volume_enforcer,
                      self.constraint_enforcer, self.reaper, self.jobs,
@@ -603,6 +611,7 @@ class Manager:
                     log.exception("stopping dispatcher failed")
             self.dispatcher = self.allocator = self.scheduler = None
             self.replicated = self.global_ = self.jobs = None
+            self.autoscaler = None
             self.csi_manager = None
             self.deallocator = None
             self.reaper = None

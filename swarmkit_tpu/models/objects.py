@@ -107,6 +107,7 @@ class Service:
     update_status: Optional[UpdateStatus] = None
     job_status: Optional["JobStatus"] = None
     pending_delete: bool = False
+    autoscale_status: Optional["AutoscaleStatus"] = None
 
     def copy(self) -> "Service":
         return Service(
@@ -117,7 +118,30 @@ class Service:
             self.endpoint.copy() if self.endpoint else None,
             self.update_status.copy() if self.update_status else None,
             dataclasses.replace(self.job_status) if self.job_status else None,
-            self.pending_delete)
+            self.pending_delete,
+            self.autoscale_status.copy() if self.autoscale_status else None)
+
+
+@dataclass
+class AutoscaleStatus:
+    """System-owned autoscaler resume state (orchestrator/autoscaler.py).
+
+    Written in the SAME transaction as every replica change, so a
+    successor leader's supervisor resumes the policy — stabilization
+    window, direction history, flap freeze — from the replicated row
+    instead of forgetting it across failover.  All stamps read
+    ``models.types.now()`` (virtual under the sim).
+    """
+
+    last_decision_at: float = 0.0
+    last_direction: int = 0          # -1 down, 0 none yet, +1 up
+    reversal_stamps: List[float] = field(default_factory=list)
+    frozen_until: float = 0.0        # flap breaker: no writes until then
+
+    def copy(self) -> "AutoscaleStatus":
+        return AutoscaleStatus(self.last_decision_at, self.last_direction,
+                               list(self.reversal_stamps),
+                               self.frozen_until)
 
 
 @dataclass

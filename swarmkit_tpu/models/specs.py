@@ -33,10 +33,43 @@ from .types import (
     RestartPolicy,
     SecretReference,
     TaskDefaults,
+    TenantQuota,
     TopologyRequirement,
     UpdateConfig,
     VolumeAccessMode,
 )
+
+
+@dataclass
+class AutoscaleConfig:
+    """Horizontal autoscaling policy for a replicated service
+    (orchestrator/autoscaler.py AutoscaleSupervisor).
+
+    Exactly one of ``target_utilization`` (observed load per replica;
+    the supervisor's sampler seam supplies the load signal) or
+    ``target_p99`` (pending->assigned p99 seconds from the obs
+    lifecycle timers) drives the loop; 0 disables that signal.  The
+    supervisor moves replicas by ``scale_up_step``/``scale_down_step``
+    at most once per ``stabilization_window``, inside
+    [min_replicas, max_replicas], with a +-``hysteresis`` deadband
+    around the target so metric noise cannot oscillate replicas; a
+    policy that still reverses direction ``flap_reversals`` times
+    inside the flap window freezes itself and raises a health warn
+    (the ``autoscale_flapping`` check).
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_utilization: float = 0.0
+    target_p99: float = 0.0
+    scale_up_step: int = 1
+    scale_down_step: int = 1
+    stabilization_window: float = 30.0
+    hysteresis: float = 0.1
+    flap_reversals: int = 3
+
+    def copy(self) -> "AutoscaleConfig":
+        return dataclasses.replace(self)
 
 
 @dataclass
@@ -210,6 +243,9 @@ class ServiceSpec:
     # each task's spec at creation when task.priority is unset, so the
     # scheduler only ever reads task.spec.priority
     priority: int = 0
+    # horizontal autoscaling policy (replicated services only); None =
+    # replicas are operator-owned
+    autoscale: Optional[AutoscaleConfig] = None
 
     def replicas(self) -> int:
         if self.mode == ServiceMode.REPLICATED:
@@ -227,7 +263,8 @@ class ServiceSpec:
             rollback=self.rollback.copy() if self.rollback else None,
             networks=[n.copy() for n in self.networks],
             endpoint=self.endpoint.copy() if self.endpoint else None,
-            priority=self.priority)
+            priority=self.priority,
+            autoscale=self.autoscale.copy() if self.autoscale else None)
 
 
 @dataclass
@@ -263,13 +300,18 @@ class ClusterSpec:
     ca_config: CAConfig = field(default_factory=CAConfig)
     task_defaults: TaskDefaults = field(default_factory=TaskDefaults)
     encryption_config: EncryptionConfig = field(default_factory=EncryptionConfig)
+    # multi-tenant QoS: per-tenant quotas keyed by tenant name (the
+    # ``swarm.tenant`` service-annotation label); enforced at admission
+    # by the scheduler (scheduler/quota.py TenantLedger)
+    tenants: Dict[str, TenantQuota] = field(default_factory=dict)
 
     def copy(self) -> "ClusterSpec":
         return ClusterSpec(
             self.annotations.copy(), dict(self.acceptance_policy),
             self.orchestration.copy(), self.raft.copy(),
             self.dispatcher.copy(), self.ca_config.copy(),
-            self.task_defaults.copy(), self.encryption_config.copy())
+            self.task_defaults.copy(), self.encryption_config.copy(),
+            {k: q.copy() for k, q in self.tenants.items()})
 
 
 @dataclass

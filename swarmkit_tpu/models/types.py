@@ -493,6 +493,26 @@ class DispatcherConfig:
 
 
 @dataclass
+class TenantQuota:
+    """Per-tenant resource quota (multi-tenant QoS, ClusterSpec.tenants).
+
+    A tenant is named by the ``swarm.tenant`` service-annotation label;
+    the quota caps the COMMITTED reservations of its assigned, live
+    tasks.  0 on any dimension = that dimension is unlimited.  The
+    scheduler enforces quotas at admission (scheduler/quota.py): a
+    tenant's burst is clamped before placement, never fought by
+    preemption after the fact.
+    """
+
+    nano_cpus: int = 0
+    memory_bytes: int = 0
+    max_tasks: int = 0
+
+    def copy(self) -> "TenantQuota":
+        return dataclasses.replace(self)
+
+
+@dataclass
 class RaftConfig:
     snapshot_interval: int = 10000
     keep_old_snapshots: int = 0

@@ -158,6 +158,32 @@ def stale_read_risk_value(read_index_p99_bound: float = 2.0
     return get
 
 
+_FLAP_PREFIX = 'swarm_autoscale_flapping{service="'
+_OOB_PREFIX = 'swarm_autoscale_out_of_bounds{service="'
+
+
+def autoscale_flapping_value() -> Callable[[Registry], Optional[float]]:
+    """Autoscaler condition across services: 2 (fail) when any
+    autoscaled service's replicas sit outside its [min, max] bounds —
+    the loop wrote (or inherited) an out-of-policy state; 1 (warn)
+    while any service's flap breaker is engaged — the policy froze
+    itself after too many direction reversals and needs operator
+    attention (or a better target); 0 otherwise.  None (pass) until a
+    supervisor exports its first gauge.  Reads the gauges
+    orchestrator/autoscaler.py exports on every drive."""
+    def get(reg: Registry) -> Optional[float]:
+        flaps = reg.gauges_snapshot(_FLAP_PREFIX)
+        oob = reg.gauges_snapshot(_OOB_PREFIX)
+        if not flaps and not oob:
+            return None
+        if any(v for v in oob.values()):
+            return 2.0
+        if any(v for v in flaps.values()):
+            return 1.0
+        return 0.0
+    return get
+
+
 def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
                    edge_warn: float = 10.0, edge_fail: float = 60.0,
                    fallback_warn: float = 0.1, fallback_fail: float = 0.5,
@@ -219,6 +245,12 @@ def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
               1.0, 2.0, "state",
               ("swarm_read_", "swarm_lease_", "swarm_stale_",
                "swarm_leader_read_")),
+        # autoscaler (orchestrator/autoscaler.py): 1 = a flap breaker is
+        # engaged (policy frozen after direction reversals), 2 = an
+        # autoscaled service's replicas are outside [min, max]
+        Check("autoscale_flapping", autoscale_flapping_value(),
+              1.0, 2.0, "state",
+              ("swarm_autoscale_", "swarm_tenant_quota_")),
     ]
 
 

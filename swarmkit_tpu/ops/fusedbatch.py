@@ -175,6 +175,17 @@ def node_platform_hashes(infos, nb: int) -> Tuple[np.ndarray, np.ndarray]:
     return os_hash, arch_hash
 
 
+def group_quota_blocked(sched, t: Task) -> bool:
+    """The frozen admission verdict for ``t``'s scheduling group: True
+    when the scheduler's tenant ledger blocked it this tick (the quota
+    mask column must reject every node).  Schedulers without the quota
+    plane (or with it disabled) never block."""
+    ledger = getattr(sched, "quota", None)
+    if ledger is None or not getattr(sched, "quota_enabled", False):
+        return False
+    return ledger.group_blocked(t)
+
+
 def needs_plugins(t: Task) -> bool:
     from ..scheduler.filters import _references_volume_plugin
     c = t.spec.container
@@ -217,11 +228,12 @@ class GroupSpec:
 
     __slots__ = ("group", "t", "k", "constraints", "platforms",
                  "pref_descriptor", "wants_plugins", "cpu_d", "mem_d",
-                 "maxrep", "slot")
+                 "maxrep", "slot", "quota_blocked")
 
     def __init__(self, group: Dict[str, Task], t: Task, k: int,
                  constraints, platforms, pref_descriptor, wants_plugins,
-                 cpu_d: int, mem_d: int, maxrep: int):
+                 cpu_d: int, mem_d: int, maxrep: int,
+                 quota_blocked: bool = False):
         self.group = group
         self.t = t
         self.k = k
@@ -233,9 +245,13 @@ class GroupSpec:
         self.mem_d = mem_d
         self.maxrep = maxrep
         self.slot = 0    # service slot, assigned at build time
+        # frozen tenant-quota admission verdict (group_quota_blocked):
+        # True builds an all-False quota mask row for this group
+        self.quota_blocked = quota_blocked
 
 
-def probe_group(planner, group: Dict[str, Task]) -> Optional[GroupSpec]:
+def probe_group(planner, sched,
+                group: Dict[str, Task]) -> Optional[GroupSpec]:
     """Fusability check for one group: everything ``dispatch_group``
     would device-plan MINUS the signals the fused carry does not model
     (generic resources, host-published ports, multi-level spread,
@@ -279,7 +295,8 @@ def probe_group(planner, group: Dict[str, Task]) -> Optional[GroupSpec]:
         needs_plugins(t),
         int(res.nano_cpus) if res else 0,
         int(res.memory_bytes) if res else 0,
-        placement.max_replicas if placement else 0)
+        placement.max_replicas if placement else 0,
+        quota_blocked=group_quota_blocked(sched, t))
 
 
 # ------------------------------------------------------------ run builder
@@ -307,11 +324,11 @@ class FusedRun:
 
     __slots__ = ("sched", "specs", "cols", "shared", "carry", "chunks",
                  "next_dispatch", "next_fetch", "last_fetch_end", "L",
-                 "nb", "cc", "pb", "sb", "aborted", "dispatch_dead",
-                 "applied")
+                 "nb", "cc", "pb", "sb", "has_quota", "aborted",
+                 "dispatch_dead", "applied")
 
     def __init__(self, sched, specs, cols, shared, carry, chunks,
-                 L, nb, cc, pb, sb):
+                 L, nb, cc, pb, sb, has_quota=False):
         self.sched = sched
         self.specs = specs
         self.cols = cols
@@ -326,6 +343,7 @@ class FusedRun:
         self.cc = cc
         self.pb = pb
         self.sb = sb
+        self.has_quota = has_quota
         self.aborted = False
         self.dispatch_dead = False
         self.applied = 0
@@ -336,8 +354,9 @@ class FusedRun:
 
     def bucket_label(self, chunk: FusedChunk) -> str:
         """Stable jit-signature name for one fused chunk shape."""
+        q = "_q1" if self.has_quota else ""
         return (f"fused_g{chunk.gb}_nb{self.nb}_cc{self.cc}"
-                f"_p{self.pb}_L{self.L}_s{self.sb}")
+                f"_p{self.pb}_L{self.L}_s{self.sb}{q}")
 
 
 def build_run(planner, sched, specs: List[GroupSpec]
@@ -402,7 +421,11 @@ def build_run(planner, sched, specs: List[GroupSpec]
         total=total.copy(), cpu=cpu.copy(), mem=mem.copy(),
         svc_acc=np.zeros((sb, nb), np.int32))
 
-    # ---- chunk assembly
+    # ---- chunk assembly.  Quota mask rows are built for the WHOLE run
+    # when ANY group in it is quota-blocked (one shape per run); a run
+    # with no blocked group ships quota_ok=None — the quota-free jit
+    # signature, untouched.
+    has_quota = any(sp.quota_blocked for sp in specs)
     chunks: List[FusedChunk] = []
     start = 0
     for count in chunk_sizes(len(specs), default_chunk_groups()):
@@ -419,9 +442,12 @@ def build_run(planner, sched, specs: List[GroupSpec]
         failures = np.zeros((gb, nb), np.int32)
         leaf = np.zeros((gb, nb), np.int32)
         extra = np.ones((gb, nb), bool)
+        quota = np.ones((gb, nb), bool) if has_quota else None
         tasks = 0
         for j in range(count):
             sp = specs[start + j]
+            if quota is not None and sp.quota_blocked:
+                quota[j] = False
             k[j] = sp.k
             slot[j] = sp.slot
             maxrep[j] = sp.maxrep
@@ -445,9 +471,9 @@ def build_run(planner, sched, specs: List[GroupSpec]
             FusedGroups(k=k, slot=slot, maxrep=maxrep, cpu_d=cpu_d,
                         mem_d=mem_d, con_hash=con_hash, con_op=con_op,
                         con_exp=con_exp, plat=plat, failures=failures,
-                        leaf=leaf, extra_mask=extra),
+                        leaf=leaf, extra_mask=extra, quota_ok=quota),
             tasks))
         start += count
 
     return FusedRun(sched, specs, cols, shared, carry, chunks,
-                    L, nb, cc, pb, sb)
+                    L, nb, cc, pb, sb, has_quota=has_quota)

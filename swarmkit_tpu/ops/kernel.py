@@ -106,6 +106,11 @@ class NodeInputs(NamedTuple):
     arch_hash: jnp.ndarray      # i32[2, N] normalized arch hash (hi, lo)
     port_conflict: jnp.ndarray  # bool[N] a requested host port is taken
     extra_mask: jnp.ndarray     # bool[N] plugin/volume masks ANDed host-side
+    # tenant-quota mask column (scheduler/quota.py): all-False when the
+    # group's tenant was exhausted at admission.  None (the default)
+    # keeps the quota-free jit signatures unchanged — the column is
+    # only materialized for blocked groups.
+    quota_ok: Optional[jnp.ndarray] = None   # bool[N] or None
 
 
 def _seg_sum_f32(x: jnp.ndarray, seg: jnp.ndarray, L: int) -> jnp.ndarray:
@@ -218,12 +223,18 @@ def feasibility_and_capacity(nodes: NodeInputs, group: GroupInputs,
 
     port_m = ~(group.port_limited & nodes.port_conflict)
     rep_m = (group.maxrep == 0) | (nodes.svc_tasks < group.maxrep)
+    # tenant-quota mask column: last in the checklist, mirroring the
+    # host pipeline's QuotaFilter position so short-circuit failure
+    # counts (and therefore explanations) agree between the paths
+    quota_m = nodes.quota_ok if nodes.quota_ok is not None \
+        else jnp.ones_like(ready_m)
 
     # --- short-circuit failure counts in pipeline order (pipeline.go:10-20)
     prior = nodes.valid
     fail_counts = []
     mask = prior
-    for m in (ready_m, res_m, plugin_m, con_m, plat_m, port_m, rep_m):
+    for m in (ready_m, res_m, plugin_m, con_m, plat_m, port_m, rep_m,
+              quota_m):
         fails = mask & ~m
         fail_counts.append(jnp.sum(fails.astype(jnp.int32)))
         mask = mask & m
@@ -419,6 +430,10 @@ class FusedGroups(NamedTuple):
     failures: jnp.ndarray     # i32[G, N] recent failures for the group
     leaf: jnp.ndarray         # i32[G, N] spread leaf id (0 when no prefs)
     extra_mask: jnp.ndarray   # bool[G, N] plugin/volume masks
+    # tenant-quota mask rows: all-False rows for groups whose tenant
+    # was exhausted at admission; None when no group in the run is
+    # quota-blocked (signature stability for quota-free workloads)
+    quota_ok: Optional[jnp.ndarray] = None   # bool[G, N] or None
 
 
 class FusedCarry(NamedTuple):
@@ -465,7 +480,8 @@ def plan_fused(shared: FusedShared, groups: FusedGroups,
             res_cap=res_cap, svc_tasks=svc, total_tasks=state.total,
             failures=g.failures, leaf=g.leaf, os_hash=shared.os_hash,
             arch_hash=shared.arch_hash, port_conflict=no_ports,
-            extra_mask=g.extra_mask)
+            extra_mask=g.extra_mask,
+            quota_ok=g.quota_ok if groups.quota_ok is not None else None)
         grp = GroupInputs(
             k=g.k, con_hash=g.con_hash, con_op=g.con_op,
             con_exp=g.con_exp, plat=g.plat, maxrep=g.maxrep,

@@ -81,8 +81,9 @@ def _bucket_label(nodes_in, group_in, L: int, hier) -> str:
     slots, platform slots, spread leaf bucket, spread depth.  Bounded
     cardinality — every component comes from a fixed bucket ladder."""
     depth = len(hier[0]) + 1 if hier else 0
+    q = "_q1" if nodes_in.quota_ok is not None else ""
     return (f"nb{nodes_in.valid.shape[0]}_cc{group_in.con_hash.shape[0]}"
-            f"_p{group_in.plat.shape[0]}_L{L}_h{depth}")
+            f"_p{group_in.plat.shape[0]}_L{L}_h{depth}{q}")
 
 
 def _observe_compile(fn, bucket: str, cache_before: Optional[int],
@@ -468,6 +469,11 @@ class TPUPlanner:
         lambda n: (f"host-mode port already in use on {n} nodes" if n != 1
                    else "host-mode port already in use on 1 node"),
         lambda n: "max replicas per node limit exceed",
+        # the quota mask column (scheduler/quota.py): must produce the
+        # exact string the host QuotaFilter.explain does — err-string
+        # parity between the paths is part of the differential contract
+        lambda n: (f"over tenant quota on {n} nodes" if n != 1
+                   else "over tenant quota on 1 node"),
     )
 
     def _explain(self, fail_counts: np.ndarray) -> str:
@@ -784,6 +790,15 @@ class TPUPlanner:
         else:
             extra_mask = np.ones(nb, bool)
 
+        # ---- tenant quota mask column: materialized (all-False) only
+        # for groups the ledger BLOCKED at admission — the frozen
+        # verdict, never recomputed here (the group's own in-tick
+        # charge must not flip it).  Unblocked groups ship None so the
+        # quota-free jit signatures stay untouched.
+        quota_ok = None
+        if fusedbatch.group_quota_blocked(sched, t):
+            quota_ok = np.zeros(nb, bool)
+
         # ---- spread preferences -> hierarchical branch ids.  Each level's
         # segment id identifies the node's branch path prefix; the kernel's
         # stage A equalizes allocations level by level (nodeset.go:50 tree)
@@ -834,7 +849,8 @@ class TPUPlanner:
             valid=valid, ready=ready, res_ok=res_ok, res_cap=res_cap,
             svc_tasks=svc_tasks, total_tasks=total, failures=failures,
             leaf=leaf, os_hash=os_hash, arch_hash=arch_hash,
-            port_conflict=port_conflict, extra_mask=extra_mask)
+            port_conflict=port_conflict, extra_mask=extra_mask,
+            quota_ok=quota_ok)
         group_in = GroupInputs(
             k=np.int32(k), con_hash=con_hash, con_op=con_op, con_exp=con_exp,
             plat=plat, maxrep=np.int32(
@@ -1123,13 +1139,15 @@ class TPUPlanner:
 
     # --------------------------------------------------- victim selection
 
-    def select_victims(self, cand, cpu_d: int, mem_d: int,
+    def select_victims(self, cand, cpu_d: int, mem_d: int, gen_d: int,
                        n_picks: int, budget: int):
         """Device preemption: the victims×nodes selection kernel
-        (ops/preempt.py), byte-identical to the host oracle.  Routed
-        through the SAME breaker seam as planning: an open breaker or
-        any device failure returns None and the scheduler's supervisor
-        runs the host oracle instead — selection never fails a tick."""
+        (ops/preempt.py), byte-identical to the host oracle — including
+        the single-kind generic-resource column (``gen_d``; 0 = none).
+        Routed through the SAME breaker seam as planning: an open
+        breaker or any device failure returns None and the scheduler's
+        supervisor runs the host oracle instead — selection never fails
+        a tick."""
         import time as _time
         from . import preempt as _preempt
         if not self.breaker.allow_device():
@@ -1140,7 +1158,7 @@ class TPUPlanner:
             t0 = _time.perf_counter()
             with tracer.span("plan.preempt", "plan", picks=n_picks):
                 picks, bucket, fn = _preempt.plan_victims(
-                    cand, cpu_d, mem_d, n_picks, budget)
+                    cand, cpu_d, mem_d, gen_d, n_picks, budget)
             _observe_compile(fn, bucket, before,
                              _time.perf_counter() - t0)
         except Exception:
@@ -1168,7 +1186,7 @@ class TPUPlanner:
         for group in glist[start:]:
             if self._below_break_even(len(group)):
                 break   # below device break-even: host path
-            spec = fusedbatch.probe_group(self, group)
+            spec = fusedbatch.probe_group(self, sched, group)
             if spec is None:
                 break
             specs.append(spec)

@@ -47,18 +47,22 @@ def pick_bucket(n: int) -> int:
     return fusedbatch.pow2_bucket(max(n, 1))
 
 
-def _node_prefix(ok_j, free_c, free_m, ex_c, ex_m, live_col,
-                 vcpu_col, vmem_col, w_col, cpu_d, mem_d):
+def _node_prefix(ok_j, free_c, free_m, free_g, ex_c, ex_m, ex_g,
+                 live_col, vcpu_col, vmem_col, vgen_col, w_col,
+                 cpu_d, mem_d, gen_d):
     """One node's cheapest victim prefix: (feasible, m, cost, nvict).
     ``m`` is the smallest prefix length whose unused victims free enough
-    cpu AND memory on top of the node's (possibly negative) free pool.
-    vmapped over the node axis by ``select_victims_jit``."""
+    cpu AND memory AND (single-kind discrete) generic units on top of
+    the node's (possibly negative) free pools.  vmapped over the node
+    axis by ``select_victims_jit``."""
     zero64 = jnp.zeros((1,), vcpu_col.dtype)
     zero32 = jnp.zeros((1,), jnp.int32)
     cum_c = jnp.concatenate(
         [zero64, jnp.cumsum(jnp.where(live_col, vcpu_col, 0))])
     cum_m = jnp.concatenate(
         [zero64, jnp.cumsum(jnp.where(live_col, vmem_col, 0))])
+    cum_g = jnp.concatenate(
+        [zero64, jnp.cumsum(jnp.where(live_col, vgen_col, 0))])
     cum_w = jnp.concatenate(
         [zero32, jnp.cumsum(jnp.where(live_col, w_col, 0))])
     cum_n = jnp.concatenate(
@@ -66,7 +70,8 @@ def _node_prefix(ok_j, free_c, free_m, ex_c, ex_m, live_col,
     # fits[m] is monotone in m (freed resources are non-negative), so
     # argmax finds the FIRST satisfying prefix — the oracle's break
     fits = ((free_c + ex_c + cum_c >= cpu_d)
-            & (free_m + ex_m + cum_m >= mem_d))
+            & (free_m + ex_m + cum_m >= mem_d)
+            & (free_g + ex_g + cum_g >= gen_d))
     m = jnp.argmax(fits).astype(jnp.int32)
     feasible = ok_j & jnp.any(fits)
     cost = jnp.take(cum_w, m)
@@ -75,8 +80,9 @@ def _node_prefix(ok_j, free_c, free_m, ex_c, ex_m, live_col,
 
 
 @functools.partial(jax.jit, static_argnames=("picks",))
-def select_victims_jit(ok, free_cpu, free_mem, vvalid, vprio, vcpu,
-                       vmem, cpu_d, mem_d, n_picks, budget, picks: int):
+def select_victims_jit(ok, free_cpu, free_mem, free_gen, vvalid, vprio,
+                       vcpu, vmem, vgen, cpu_d, mem_d, gen_d, n_picks,
+                       budget, picks: int):
     """Sequential greedy picks as a scan; returns (node i32[picks],
     m i32[picks]) with -1/0 rows for inactive (stopped or > n_picks)
     picks.  See module docstring for the exactness contract."""
@@ -87,14 +93,15 @@ def select_victims_jit(ok, free_cpu, free_mem, vvalid, vprio, vcpu,
     maxkey = jnp.iinfo(jnp.int64).max
 
     prefix = jax.vmap(_node_prefix,
-                      in_axes=(0, 0, 0, 0, 0, 1, 1, 1, 1, None, None))
+                      in_axes=(0, 0, 0, 0, 0, 0, 0, 1, 1, 1, 1, 1,
+                               None, None, None))
 
     def step(state, p):
-        used, ex_c, ex_m, budget_rem, stopped = state
+        used, ex_c, ex_m, ex_g, budget_rem, stopped = state
         live = vvalid & ~used
         feasible, m, cost, nvict = prefix(
-            ok, free_cpu, free_mem, ex_c, ex_m, live, vcpu, vmem,
-            weights, cpu_d, mem_d)
+            ok, free_cpu, free_mem, free_gen, ex_c, ex_m, ex_g, live,
+            vcpu, vmem, vgen, weights, cpu_d, mem_d, gen_d)
         key = ((cost.astype(jnp.int64) << (_IDX_BITS + _NV_BITS))
                | (nvict.astype(jnp.int64) << _IDX_BITS) | node_idx)
         key = jnp.where(feasible, key, maxkey)
@@ -108,18 +115,22 @@ def select_victims_jit(ok, free_cpu, free_mem, vvalid, vprio, vcpu,
         sel = jnp.take(live, j, axis=1) & (slot_idx < m_j) & do
         freed_c = jnp.sum(jnp.where(sel, jnp.take(vcpu, j, axis=1), 0))
         freed_m = jnp.sum(jnp.where(sel, jnp.take(vmem, j, axis=1), 0))
+        freed_g = jnp.sum(jnp.where(sel, jnp.take(vgen, j, axis=1), 0))
         used = used.at[:, j].set(used[:, j] | sel)
         ex_c = ex_c.at[j].add(jnp.where(do, freed_c - cpu_d, 0))
         ex_m = ex_m.at[j].add(jnp.where(do, freed_m - mem_d, 0))
+        ex_g = ex_g.at[j].add(jnp.where(do, freed_g - gen_d, 0))
         budget_rem = budget_rem - jnp.where(do, nv_j, 0)
         stopped = stopped | (active & (~any_f | over))
         out_node = jnp.where(do, j, -1)
         out_m = jnp.where(do, m_j, 0)
-        return (used, ex_c, ex_m, budget_rem, stopped), (out_node, out_m)
+        return (used, ex_c, ex_m, ex_g, budget_rem, stopped), \
+            (out_node, out_m)
 
     state = (jnp.zeros((V, N), bool),
              jnp.zeros((N,), free_cpu.dtype),
              jnp.zeros((N,), free_mem.dtype),
+             jnp.zeros((N,), free_gen.dtype),
              jnp.asarray(budget, jnp.int32),
              jnp.zeros((), bool))
     _, (nodes, ms) = jax.lax.scan(
@@ -127,7 +138,7 @@ def select_victims_jit(ok, free_cpu, free_mem, vvalid, vprio, vcpu,
     return nodes, ms
 
 
-def plan_victims(cand: CandidateSet, cpu_d: int, mem_d: int,
+def plan_victims(cand: CandidateSet, cpu_d: int, mem_d: int, gen_d: int,
                  n_picks: int, budget: int
                  ) -> Tuple[List[Tuple[int, int]], str, object]:
     """Pad the host-built candidate arrays to their static buckets,
@@ -145,6 +156,8 @@ def plan_victims(cand: CandidateSet, cpu_d: int, mem_d: int,
     free_cpu[:n] = cand.free_cpu
     free_mem = np.zeros(nb, np.int64)
     free_mem[:n] = cand.free_mem
+    free_gen = np.zeros(nb, np.int64)
+    free_gen[:n] = cand.free_gen
     vvalid = np.zeros((V, nb), bool)
     vvalid[:, :n] = cand.vvalid
     vprio = np.zeros((V, nb), np.int32)
@@ -153,12 +166,14 @@ def plan_victims(cand: CandidateSet, cpu_d: int, mem_d: int,
     vcpu[:, :n] = cand.vcpu
     vmem = np.zeros((V, nb), np.int64)
     vmem[:, :n] = cand.vmem
+    vgen = np.zeros((V, nb), np.int64)
+    vgen[:, :n] = cand.vgen
     label = f"preempt_nb{nb}_v{V}_p{pb}"
     with fusedbatch.x64():
         nodes, ms = jax.device_get(select_victims_jit(
-            ok, free_cpu, free_mem, vvalid, vprio, vcpu, vmem,
-            np.int64(cpu_d), np.int64(mem_d), np.int32(n_picks),
-            np.int32(budget), pb))
+            ok, free_cpu, free_mem, free_gen, vvalid, vprio, vcpu, vmem,
+            vgen, np.int64(cpu_d), np.int64(mem_d), np.int64(gen_d),
+            np.int32(n_picks), np.int32(budget), pb))
     picks: List[Tuple[int, int]] = []
     for j, m in zip(nodes.tolist(), ms.tolist()):
         if j < 0:

@@ -1,3 +1,4 @@
+from .autoscaler import Supervisor as AutoscaleSupervisor
 from .enforcers import ConstraintEnforcer, VolumeEnforcer
 from .global_ import Orchestrator as GlobalOrchestrator
 from .jobs import Orchestrator as JobsOrchestrator
@@ -7,7 +8,7 @@ from .taskreaper import TaskReaper
 from .update import Supervisor as UpdateSupervisor
 
 __all__ = [
-    "ConstraintEnforcer", "GlobalOrchestrator", "JobsOrchestrator",
-    "ReplicatedOrchestrator", "RestartSupervisor", "TaskReaper",
-    "UpdateSupervisor", "VolumeEnforcer",
+    "AutoscaleSupervisor", "ConstraintEnforcer", "GlobalOrchestrator",
+    "JobsOrchestrator", "ReplicatedOrchestrator", "RestartSupervisor",
+    "TaskReaper", "UpdateSupervisor", "VolumeEnforcer",
 ]

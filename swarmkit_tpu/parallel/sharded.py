@@ -70,12 +70,21 @@ def mesh_from_env() -> Optional[Mesh]:
 
 
 # PartitionSpecs: node-dimension sharded, everything else replicated.
+# quota_ok defaults to None here — specs must match the input pytree
+# STRUCTURE, and the quota mask column is only materialized for
+# quota-blocked groups (_node_specs switches the spec in per call).
 _NODE_SPECS = NodeInputs(
     valid=P(NODE_AXIS), ready=P(NODE_AXIS), res_ok=P(NODE_AXIS),
     res_cap=P(NODE_AXIS), svc_tasks=P(NODE_AXIS),
     total_tasks=P(NODE_AXIS), failures=P(NODE_AXIS), leaf=P(NODE_AXIS),
     os_hash=P(None, NODE_AXIS), arch_hash=P(None, NODE_AXIS),
     port_conflict=P(NODE_AXIS), extra_mask=P(NODE_AXIS))
+
+
+def _node_specs(nodes: NodeInputs) -> NodeInputs:
+    if nodes.quota_ok is None:
+        return _NODE_SPECS
+    return _NODE_SPECS._replace(quota_ok=P(NODE_AXIS))
 
 _GROUP_SPECS = GroupInputs(
     k=P(), con_hash=P(None, None, NODE_AXIS),
@@ -109,7 +118,8 @@ def plan_group_sharded(nodes: NodeInputs, group: GroupInputs, L: int,
     # [None, set(), None] vs [None, set(), {'nodes'}]); the checker is
     # advisory — the collectives themselves are unchanged
     fn = shard_map(kernel, mesh=mesh,
-                   in_specs=(_NODE_SPECS, _GROUP_SPECS, hier_specs),
+                   in_specs=(_node_specs(nodes), _GROUP_SPECS,
+                             hier_specs),
                    out_specs=(P(NODE_AXIS), P(), P()),
                    check_rep=False)
     return fn(nodes, group, hier)
@@ -126,6 +136,12 @@ _FUSED_GROUP_SPECS = FusedGroups(
     con_hash=P(None, None, None, NODE_AXIS), con_op=P(), con_exp=P(),
     plat=P(), failures=P(None, NODE_AXIS), leaf=P(None, NODE_AXIS),
     extra_mask=P(None, NODE_AXIS))
+
+
+def _fused_group_specs(groups: FusedGroups) -> FusedGroups:
+    if groups.quota_ok is None:
+        return _FUSED_GROUP_SPECS
+    return _FUSED_GROUP_SPECS._replace(quota_ok=P(None, NODE_AXIS))
 
 _FUSED_CARRY_SPECS = FusedCarry(
     total=P(NODE_AXIS), cpu=P(NODE_AXIS), mem=P(NODE_AXIS),
@@ -154,7 +170,8 @@ def plan_fused_sharded(shared: FusedShared, groups: FusedGroups,
     # check_rep=False: same advisory-checker mistyping as
     # plan_group_sharded above (scan carries inside psum kernels)
     fn = shard_map(kernel, mesh=mesh,
-                   in_specs=(_FUSED_SHARED_SPECS, _FUSED_GROUP_SPECS,
+                   in_specs=(_FUSED_SHARED_SPECS,
+                             _fused_group_specs(groups),
                              _FUSED_CARRY_SPECS),
                    out_specs=(P(None, NODE_AXIS), P(), P(),
                               _FUSED_CARRY_SPECS),
@@ -180,6 +197,8 @@ class ShardedPlanFn:
             pad = d - n % d
 
             def pad_last(a):
+                if a is None:   # absent quota mask column
+                    return None
                 width = [(0, 0)] * (a.ndim - 1) + [(0, pad)]
                 return np.pad(np.asarray(a), width)
 

@@ -35,9 +35,12 @@ Selection model (shared spec, mirrored bit-for-bit by the kernel):
 
 Scope (documented waivers, mirroring the device planner's): preemption
 only triggers for priority > 0 pending work whose infeasibility is
-resource-shaped — groups demanding generic resources, host ports, or
-CSI volumes are skipped (``swarm_preempt_skipped{reason="unsupported"}``),
-and victims free only cpu/memory reservations.  Victims are always
+resource-shaped — cpu/memory reservations plus AT MOST ONE discrete
+generic-resource kind (victims free all three; the selection carries a
+third resource column through host and device alike).  Groups demanding
+multiple generic kinds, NAMED generics, host ports, or CSI volumes are
+still skipped (``swarm_preempt_skipped{reason="unsupported"}`` — the
+waiver, narrowed from "any generic" by ISSUE 12).  Victims are always
 STRICTLY lower priority; equal-or-higher is excluded at candidate-build
 time and re-asserted by the sim's ``no-preempt-equal-or-higher``
 invariant.
@@ -53,7 +56,8 @@ import numpy as np
 
 from ..models.objects import Task
 from ..models.types import (
-    MountType, NodeAvailability, NodeState, PublishMode, TaskState, now,
+    GenericResourceKind, MountType, NodeAvailability, NodeState,
+    PublishMode, TaskState, now,
 )
 from ..utils.metrics import registry as _metrics
 from .filters import Pipeline, ResourceFilter
@@ -121,11 +125,13 @@ class CandidateSet:
     dispatch.  ``victims[j]`` maps victim slots back to mirror tasks.
     """
 
-    __slots__ = ("infos", "ok", "free_cpu", "free_mem", "vvalid", "vprio",
-                 "vcpu", "vmem", "victims", "vb", "n_candidates")
+    __slots__ = ("infos", "ok", "free_cpu", "free_mem", "free_gen",
+                 "vvalid", "vprio", "vcpu", "vmem", "vgen", "victims",
+                 "vb", "n_candidates")
 
     def __init__(self, infos, ok, free_cpu, free_mem, vvalid, vprio,
-                 vcpu, vmem, victims, vb, n_candidates):
+                 vcpu, vmem, victims, vb, n_candidates,
+                 free_gen=None, vgen=None):
         self.infos = infos
         self.ok = ok
         self.free_cpu = free_cpu
@@ -137,6 +143,12 @@ class CandidateSet:
         self.victims = victims
         self.vb = vb
         self.n_candidates = n_candidates
+        # third resource column (single discrete generic kind): zeros
+        # when the pending group demands none — the selection math is
+        # then identical to the two-resource shape
+        self.free_gen = free_gen if free_gen is not None \
+            else np.zeros_like(free_cpu)
+        self.vgen = vgen if vgen is not None else np.zeros_like(vcpu)
 
 
 def preemptable_group(t: Task) -> bool:
@@ -144,10 +156,16 @@ def preemptable_group(t: Task) -> bool:
     fix?  Resource-shaped demand only — the waivers mirror the device
     planner's (``TPUPlanner._supported``)."""
     res = t.spec.resources.reservations if t.spec.resources else None
-    if res is None or (not res.nano_cpus and not res.memory_bytes):
+    if res is None or (not res.nano_cpus and not res.memory_bytes
+                       and not res.generic):
         return False    # no resource demand: constraints, not capacity
-    if res.generic:
-        return False    # generic-resource claims: host bookkeeping only
+    if len(res.generic) > 1 or any(
+            g.res_type != GenericResourceKind.DISCRETE or g.value <= 0
+            for g in res.generic):
+        # narrowed waiver (ISSUE 12): ONE discrete generic kind rides
+        # the selection's third resource column; multi-kind and NAMED
+        # demands keep the host-bookkeeping waiver
+        return False
     if t.endpoint and any(p.publish_mode == PublishMode.HOST
                           and p.published_port
                           for p in t.endpoint.ports):
@@ -164,11 +182,31 @@ def preemptable_group(t: Task) -> bool:
     return True
 
 
-def demand_of(t: Task) -> Tuple[int, int]:
+def demand_of(t: Task) -> Tuple[int, int, Optional[Tuple[str, int]]]:
+    """(cpu, memory, generic) demand of a pending spec; ``generic`` is
+    the single discrete (kind, value) pair ``preemptable_group`` admits,
+    or None."""
     res = t.spec.resources.reservations if t.spec.resources else None
     if res is None:
-        return 0, 0
-    return int(res.nano_cpus), int(res.memory_bytes)
+        return 0, 0, None
+    gen = None
+    for g in res.generic:
+        if g.res_type == GenericResourceKind.DISCRETE and g.value > 0:
+            gen = (g.kind, int(g.value))
+            break
+    return int(res.nano_cpus), int(res.memory_bytes), gen
+
+
+def _gen_amount(resources, kind: str) -> int:
+    """Discrete units of ``kind`` in a Resources.generic list (NAMED
+    units count 1 apiece — one name is one unit)."""
+    total = 0
+    for g in resources.generic:
+        if g.kind != kind:
+            continue
+        total += 1 if g.res_type == GenericResourceKind.NAMED \
+            else int(g.value)
+    return total
 
 
 def victim_slot_key(t: Task) -> tuple:
@@ -182,7 +220,8 @@ def victim_slot_key(t: Task) -> tuple:
 def build_candidates(sched, t: Task, prio: int,
                      excluded_ids, cooldowns: Dict[tuple, float],
                      cooldown: float,
-                     skipped_cooldown: Optional[List[int]] = None
+                     skipped_cooldown: Optional[List[int]] = None,
+                     gen_kind: Optional[str] = None
                      ) -> Optional[CandidateSet]:
     """Densify the mirror into the shared candidate arrays for pending
     spec ``t`` at priority ``prio``.  Returns None when no node has any
@@ -209,6 +248,7 @@ def build_candidates(sched, t: Task, prio: int,
     ok = np.zeros(n, bool)
     free_cpu = np.zeros(n, np.int64)
     free_mem = np.zeros(n, np.int64)
+    free_gen = np.zeros(n, np.int64)
     per_node: List[List[Task]] = []
     max_v = 0
     n_candidates = 0
@@ -220,6 +260,8 @@ def build_candidates(sched, t: Task, prio: int,
         ok[j] = live and pipe.process(info)
         free_cpu[j] = info.available_resources.nano_cpus
         free_mem[j] = info.available_resources.memory_bytes
+        if gen_kind is not None:
+            free_gen[j] = _gen_amount(info.available_resources, gen_kind)
         cands: List[Task] = []
         if ok[j]:
             for vt in info.tasks.values():
@@ -259,6 +301,7 @@ def build_candidates(sched, t: Task, prio: int,
     vprio = np.zeros((vb, n), np.int32)
     vcpu = np.zeros((vb, n), np.int64)
     vmem = np.zeros((vb, n), np.int64)
+    vgen = np.zeros((vb, n), np.int64)
     victims: List[List[Task]] = []
     for j, cands in enumerate(per_node):
         cands = cands[:vb]
@@ -271,22 +314,30 @@ def build_candidates(sched, t: Task, prio: int,
             vprio[s, j] = min(max(task_priority(vt), 0), PRIO_CLAMP)
             vcpu[s, j] = int(res.nano_cpus)
             vmem[s, j] = int(res.memory_bytes)
+            if gen_kind is not None:
+                # victims free their RESERVED generics of the demanded
+                # kind (reservation-side, like cpu/memory)
+                vgen[s, j] = _gen_amount(res, gen_kind)
     return CandidateSet(infos, ok, free_cpu, free_mem, vvalid, vprio,
-                        vcpu, vmem, victims, vb, n_candidates)
+                        vcpu, vmem, victims, vb, n_candidates,
+                        free_gen=free_gen, vgen=vgen)
 
 
 def select_victims_host(cand: CandidateSet, cpu_d: int, mem_d: int,
-                        n_picks: int, budget: int
+                        gen_d: int, n_picks: int, budget: int
                         ) -> List[Tuple[int, int]]:
     """The oracle: sequential greedy picks over the candidate arrays.
     Returns [(node_index, prefix_len)] — the EXACT integers the device
-    kernel must reproduce (tests/test_preemption.py fuzzes the pair).
+    kernel must reproduce (tests/test_preemption.py fuzzes the pair,
+    including the generic-resource column).  ``gen_d`` is the single
+    discrete generic demand (0 = none; the third column is then inert).
     """
     vvalid = cand.vvalid
     V, N = vvalid.shape
     used = np.zeros((V, N), bool)
     extra_cpu = [0] * N    # python ints: exact, like the i64 kernel
     extra_mem = [0] * N
+    extra_gen = [0] * N
     picks: List[Tuple[int, int]] = []
     budget_rem = budget
     for _ in range(n_picks):
@@ -296,19 +347,23 @@ def select_victims_host(cand: CandidateSet, cpu_d: int, mem_d: int,
                 continue
             have_cpu = int(cand.free_cpu[j]) + extra_cpu[j]
             have_mem = int(cand.free_mem[j]) + extra_mem[j]
+            have_gen = int(cand.free_gen[j]) + extra_gen[j]
             cost = 0
             nvict = 0
             m = None
-            if have_cpu >= cpu_d and have_mem >= mem_d:
+            if have_cpu >= cpu_d and have_mem >= mem_d \
+                    and have_gen >= gen_d:
                 m = 0
             else:
                 for s in range(V):
                     if vvalid[s, j] and not used[s, j]:
                         have_cpu += int(cand.vcpu[s, j])
                         have_mem += int(cand.vmem[s, j])
+                        have_gen += int(cand.vgen[s, j])
                         cost += int(cand.vprio[s, j]) + 1
                         nvict += 1
-                    if have_cpu >= cpu_d and have_mem >= mem_d:
+                    if have_cpu >= cpu_d and have_mem >= mem_d \
+                            and have_gen >= gen_d:
                         m = s + 1
                         break
             if m is None:
@@ -323,13 +378,16 @@ def select_victims_host(cand: CandidateSet, cpu_d: int, mem_d: int,
             break    # budget exhausted: stop (device mirrors this)
         freed_cpu = 0
         freed_mem = 0
+        freed_gen = 0
         for s in range(m):
             if vvalid[s, j] and not used[s, j]:
                 used[s, j] = True
                 freed_cpu += int(cand.vcpu[s, j])
                 freed_mem += int(cand.vmem[s, j])
+                freed_gen += int(cand.vgen[s, j])
         extra_cpu[j] += freed_cpu - cpu_d
         extra_mem[j] += freed_mem - mem_d
+        extra_gen[j] += freed_gen - gen_d
         budget_rem -= nvict
         picks.append((j, m))
     return picks

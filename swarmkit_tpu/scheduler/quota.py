@@ -1,0 +1,237 @@
+"""Multi-tenant quota enforcement: the admission-side half of QoS.
+
+Tenants are priority bands with resource quotas (``ClusterSpec.tenants``,
+keyed by the ``swarm.tenant`` service-annotation label).  The scheduler
+enforces them **at admission**, before placement, so a misbehaving
+tenant's scale-up is clamped instead of being fought by preemption
+after the fact:
+
+* ``TenantLedger`` recomputes each tenant's committed usage (cpu/memory
+  reservations + task count of assigned, live tasks) from the
+  scheduler's mirror at tick start, then charges every admitted group
+  as the tick walks the priority-ordered queue — so group g+1 of a
+  tenant sees group g's admission, exactly like the fused planner's
+  carry sees earlier groups' placements.
+* A group whose tenant cannot admit even ONE task is *blocked*: it
+  still flows to the placement paths, where the **quota mask column**
+  (device program, ``NodeInputs.quota_ok`` — ops/kernel.py) or the
+  ``QuotaFilter`` (host pipeline, below) rejects every node, so the
+  tasks carry the proper ``no suitable node (over tenant quota ...)``
+  diagnostics on both paths, byte-identically.
+* A group the tenant can only partially afford is *clamped*: the
+  scheduler splits it, schedules the admitted prefix, and defers the
+  remainder with a quota message (``swarm_quota_clamps{tenant=}``).
+
+Verdicts are stamped once per (group, tick) at admission time and
+never recomputed downstream — an admitted group's own charge must not
+flip its verdict between admission and placement.  Preassigned
+(global-service) tasks are outside quota scope: their node is fixed
+before the scheduler sees them.
+
+The sim's ``quota-never-exceeded`` invariant (sim/invariants.py)
+re-derives usage from committed store events and fails the run the
+moment any tenant's committed usage exceeds its quota.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..models.objects import Cluster, Task
+from ..models.types import TaskState, TenantQuota
+from ..utils.metrics import registry as _metrics
+from .filters import Filter
+from .nodeinfo import task_reservations
+
+log = logging.getLogger("quota")
+
+#: the service-annotation label naming a service's tenant; propagated
+#: onto every task via ``Task.service_annotations`` (orchestrator
+#: common.new_task), so tenant resolution never needs a store lookup
+TENANT_LABEL = "swarm.tenant"
+
+
+def task_tenant(t: Task) -> str:
+    """Tenant of a task ("" = untenanted, never quota'd)."""
+    ann = t.service_annotations
+    if ann is None or not ann.labels:
+        return ""
+    return ann.labels.get(TENANT_LABEL, "")
+
+
+def group_key(t: Task) -> tuple:
+    """Identity of the scheduling group a task belongs to — the same
+    (service, spec-version) keying the scheduler's pending queue uses,
+    with one-off (version-less) tasks as their own singletons.  Both
+    the admission clamp and the QuotaFilter derive it from a task, so
+    a verdict stamped at admission is found again at placement."""
+    sv = t.spec_version
+    if sv is None:
+        return (t.service_id, -1, t.id)
+    return (t.service_id, sv.index, "")
+
+
+class TenantLedger:
+    """Per-tick tenant usage + admission arithmetic (all integers).
+
+    ``begin_tick`` rebuilds the committed-usage base from the
+    scheduler's fresh task mirror; ``admit``/``charge`` run as the tick
+    admits groups in priority order.  ``blocked_groups`` holds the
+    frozen per-group verdicts for this tick (see module docstring).
+    """
+
+    def __init__(self) -> None:
+        self.quotas: Dict[str, TenantQuota] = {}
+        #: tenant -> [nano_cpus, memory_bytes, tasks] committed+charged
+        self.used: Dict[str, List[int]] = {}
+        #: group keys whose tenant was exhausted at admission this tick
+        self.blocked_groups: set = set()
+        #: group key -> tasks charged at admission this tick; the
+        #: preemption pass adds this back when computing a group's
+        #: headroom (its own charge must not read as "no quota left" —
+        #: the charge IS its entitlement)
+        self.group_charges: Dict[tuple, int] = {}
+        #: task ids deferred by a partial clamp this tick (they carry
+        #: NO charge — preemption headroom must not count them)
+        self.deferred_tasks: set = set()
+        self.stats = {"clamped_tasks": 0, "blocked_groups": 0}
+
+    # ------------------------------------------------------------- config
+
+    def load_cluster(self, cluster: Optional[Cluster]) -> None:
+        self.quotas = dict(cluster.spec.tenants) if cluster is not None \
+            else {}
+
+    @property
+    def active(self) -> bool:
+        return bool(self.quotas)
+
+    # ------------------------------------------------------------ per tick
+
+    def begin_tick(self, all_tasks: Dict[str, Task]) -> None:
+        """Rebuild the usage base from the scheduler's mirror: assigned,
+        live (desired <= COMPLETE, status <= RUNNING) tasks of quota'd
+        tenants.  Also exports ``swarm_tenant_quota_used{tenant=}`` —
+        the fullest constrained dimension as a fraction of its quota."""
+        self.blocked_groups = set()
+        self.group_charges = {}
+        self.deferred_tasks = set()
+        if not self.quotas:
+            self.used = {}
+            return
+        used: Dict[str, List[int]] = {}
+        for t in all_tasks.values():
+            if (not t.node_id
+                    or t.desired_state > TaskState.COMPLETE
+                    or t.status.state > int(TaskState.RUNNING)
+                    or t.status.state < int(TaskState.ASSIGNED)):
+                continue
+            tenant = task_tenant(t)
+            if tenant not in self.quotas:
+                continue
+            res = task_reservations(t)
+            row = used.setdefault(tenant, [0, 0, 0])
+            row[0] += int(res.nano_cpus)
+            row[1] += int(res.memory_bytes)
+            row[2] += 1
+        self.used = used
+        for tenant, q in self.quotas.items():
+            row = used.get(tenant, (0, 0, 0))
+            frac = 0.0
+            for have, limit in ((row[0], q.nano_cpus),
+                                (row[1], q.memory_bytes),
+                                (row[2], q.max_tasks)):
+                if limit > 0:
+                    frac = max(frac, have / limit)
+            _metrics.gauge(
+                f'swarm_tenant_quota_used{{tenant="{tenant}"}}',
+                round(frac, 6))
+
+    def admit(self, tenant: str, cpu_d: int, mem_d: int,
+              k: int) -> Optional[int]:
+        """How many tasks of per-task demand (cpu_d, mem_d) the tenant's
+        remaining quota admits, capped at ``k``.  None = the tenant has
+        no quota (unlimited).  A quota'd tenant whose tasks reserve
+        nothing is only bounded by ``max_tasks``."""
+        q = self.quotas.get(tenant)
+        if q is None:
+            return None
+        row = self.used.get(tenant, (0, 0, 0))
+        rem = k
+        if q.max_tasks > 0:
+            rem = min(rem, q.max_tasks - row[2])
+        if q.nano_cpus > 0 and cpu_d > 0:
+            rem = min(rem, (q.nano_cpus - row[0]) // cpu_d)
+        if q.memory_bytes > 0 and mem_d > 0:
+            rem = min(rem, (q.memory_bytes - row[1]) // mem_d)
+        return max(int(rem), 0)
+
+    def charge(self, tenant: str, cpu_d: int, mem_d: int,
+               n: int) -> None:
+        """Charge ``n`` admitted tasks.  Optimistic: a task that later
+        fails to place re-enters the next tick's recomputed base, so an
+        in-tick overcharge can only under-admit, never over-admit."""
+        if tenant not in self.quotas or n <= 0:
+            return
+        row = self.used.setdefault(tenant, [0, 0, 0])
+        row[0] += cpu_d * n
+        row[1] += mem_d * n
+        row[2] += n
+
+    # ------------------------------------------------------------ verdicts
+
+    def note_group_charge(self, t: Task, n: int) -> None:
+        key = group_key(t)
+        self.group_charges[key] = self.group_charges.get(key, 0) + n
+
+    def group_charge(self, t: Task) -> int:
+        return self.group_charges.get(group_key(t), 0)
+
+    def preempt_headroom(self, t: Task, cpu_d: int, mem_d: int,
+                         group: Dict[str, Task]) -> Optional[int]:
+        """Tasks of this group the tenant's quota allows the PREEMPTION
+        pass to place: the live remainder (`admit`) plus the group's
+        own phantom charge — each admitted-but-unplaced task in
+        ``group`` was already charged at admission, so its entitlement
+        carries over (quota-deferred tasks carry none).  None = no
+        quota (unlimited)."""
+        tenant = task_tenant(t)
+        admit = self.admit(tenant, cpu_d, mem_d, len(group))
+        if admit is None:
+            return None
+        phantom = sum(1 for tid in group
+                      if tid not in self.deferred_tasks)
+        return admit + min(phantom, self.group_charge(t))
+
+    def block_group(self, t: Task) -> None:
+        self.blocked_groups.add(group_key(t))
+        self.stats["blocked_groups"] += 1
+
+    def group_blocked(self, t: Task) -> bool:
+        """Frozen admission verdict for the group ``t`` belongs to (the
+        quota mask column and the host QuotaFilter both read this)."""
+        return group_key(t) in self.blocked_groups
+
+
+class QuotaFilter(Filter):
+    """Host-pipeline half of the quota mask: enabled only for groups the
+    ledger blocked at admission, where it rejects every node — the same
+    all-false column the device program carries, so host and device
+    placements (and their ``no suitable node`` explanations) stay
+    byte-identical.  Appended LAST in the checklist, matching the quota
+    row's position in the kernel's short-circuit failure counts."""
+
+    def __init__(self, ledger: TenantLedger):
+        self.ledger = ledger
+
+    def set_task(self, t: Task) -> bool:
+        return self.ledger.active and self.ledger.group_blocked(t)
+
+    def check(self, n) -> bool:
+        return False
+
+    def explain(self, nodes: int) -> str:
+        if nodes == 1:
+            return "over tenant quota on 1 node"
+        return f"over tenant quota on {nodes} nodes"

@@ -21,7 +21,7 @@ import queue
 import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from ..models.objects import Node, Service, Task, Volume
+from ..models.objects import Cluster, Node, Service, Task, Volume
 from ..models.types import (
     Resources, TaskState, TaskStatus, now,
 )
@@ -29,7 +29,7 @@ from ..obs.trace import tracer
 from ..utils.metrics import registry as _metrics
 from ..utils.pipeline import default_pipeline_depth
 from ..state.events import Event, EventCommit, EventSnapshotRestore
-from ..state.store import Batch, MemoryStore, ReadTx
+from ..state.store import Batch, ByName, MemoryStore, ReadTx
 from ..state.watch import Closed
 from . import genericresource
 from . import preempt as preempt_mod
@@ -37,6 +37,7 @@ from .filters import Pipeline, VolumesFilter
 from .nodeinfo import MAX_FAILURES, NodeInfo, task_reservations
 from .nodeset import DecisionTree, NodeSet
 from .preempt import PreemptSupervisor, task_priority
+from .quota import QuotaFilter, TenantLedger, task_tenant
 from .volumes import VolumeSet
 
 log = logging.getLogger("scheduler")
@@ -228,6 +229,19 @@ class Scheduler:
         self.preempt_enabled = \
             _os.environ.get("SWARM_PREEMPTION", "") != "0"
 
+        # multi-tenant quota plane (scheduler/quota.py): admission-side
+        # clamp + the host half of the quota mask column.  The filter
+        # rides the shared pipeline so the host oracle's short-circuit
+        # failure counts (and explanations) match the device kernel's
+        # quota row.  SWARM_TENANT_QUOTA=0 disables enforcement
+        # wholesale; with no tenants on the ClusterSpec the plane is a
+        # no-op either way.
+        self.quota = TenantLedger()
+        self.quota_enabled = \
+            _os.environ.get("SWARM_TENANT_QUOTA", "") != "0"
+        self._quota_filter = QuotaFilter(self.quota)
+        self.pipeline.add_filter(self._quota_filter)
+
         # leadership epoch captured at tick/preassigned-pass start; every
         # commit of that pass is pinned to it (None = unfenced proposer)
         self._tick_epoch: Optional[int] = None
@@ -243,6 +257,8 @@ class Scheduler:
     # ------------------------------------------------------------------ setup
 
     def _setup_tasks_list(self, tx: ReadTx) -> None:
+        clusters = tx.find(Cluster, ByName("default"))
+        self.quota.load_cluster(clusters[0] if clusters else None)
         for volume in tx.find(Volume):
             if volume.volume_info and volume.volume_info.volume_id:
                 self.volumes.add_or_update_volume(volume)
@@ -377,6 +393,14 @@ class Scheduler:
             if obj.volume_info and obj.volume_info.volume_id:
                 self.volumes.add_or_update_volume(obj)
                 return True
+        if isinstance(obj, Cluster) and ev.action != "delete" \
+                and obj.spec.annotations.name == "default":
+            # live quota changes (the "default" cluster only — the one
+            # _setup_tasks_list reads; any other Cluster object must
+            # not wipe the quota table): a raised quota may unblock
+            # pending tenant work, so the next tick must run
+            self.quota.load_cluster(obj)
+            return True
         return False
 
     # --------------------------------------------------------- state mirror
@@ -566,6 +590,12 @@ class Scheduler:
         self._tick_epoch = getattr(self.store._proposer,
                                    "leadership_epoch", None)
         self.block_mode = self.store.supports_block_commit
+        # tenant-quota base usage for this tick, recomputed from the
+        # fresh mirror; admission charges accumulate on top of it as
+        # the priority-ordered queue below is walked
+        if self.quota_enabled:
+            self.quota.begin_tick(self.all_tasks)
+            self._ensure_quota_filter_last()
         decisions: Dict[str, SchedulingDecision] = {}
 
         # groups are maintained incrementally by _enqueue/_dequeue; take
@@ -654,7 +684,7 @@ class Scheduler:
         self.stats["tick_seconds"].append(now() - t0)
         return n_decisions
 
-    def _tick_groups(self, groups, one_off_tasks
+    def _tick_groups(self, groups, one_off_tasks, decisions=None
                      ) -> Iterable[Dict[str, Task]]:
         """The tick's task groups in scheduling order, with entries that
         were assigned out-of-band since enqueue dropped — one code path
@@ -682,7 +712,82 @@ class Scheduler:
                 entries.append((task_priority(t), {t.id: t}))
         entries.sort(key=lambda e: -e[0])
         for _, group in entries:
-            yield group
+            group = self._quota_admit(group, decisions)
+            if group:
+                yield group
+
+    # -------------------------------------------------------- tenant quota
+
+    def _ensure_quota_filter_last(self) -> None:
+        """The QuotaFilter's checklist position is load-bearing: it
+        must be LAST so the host pipeline's short-circuit failure
+        counts (and the resulting 'no suitable node' explanation) match
+        the device kernel's quota row, which is evaluated after every
+        other mask.  Filters appended later (VolumesFilter in run()/the
+        sim) would otherwise displace it — re-pin it each tick."""
+        checklist = self.pipeline._checklist
+        if checklist and checklist[-1].f is self._quota_filter:
+            return
+        for i, entry in enumerate(checklist):
+            if entry.f is self._quota_filter:
+                checklist.append(checklist.pop(i))
+                return
+
+    def _quota_admit(self, group: Dict[str, Task],
+                     decisions) -> Dict[str, Task]:
+        """Admission clamp for one group (scheduler/quota.py): charge
+        fully-admitted groups, split partially-affordable ones (the
+        deferred remainder re-queues with a quota message), and stamp a
+        frozen BLOCKED verdict on groups whose tenant cannot admit even
+        one task — those still flow to placement, where the quota mask
+        column / QuotaFilter rejects every node so both paths produce
+        identical ``over tenant quota`` diagnostics."""
+        ledger = self.quota
+        if not self.quota_enabled or not ledger.active:
+            return group
+        t0 = next(iter(group.values()))
+        tenant = task_tenant(t0)
+        res = task_reservations(t0)
+        cpu_d, mem_d = int(res.nano_cpus), int(res.memory_bytes)
+        admit = ledger.admit(tenant, cpu_d, mem_d, len(group))
+        if admit is None:
+            return group            # untenanted / unlimited
+        if admit >= len(group):
+            ledger.charge(tenant, cpu_d, mem_d, len(group))
+            ledger.note_group_charge(t0, len(group))
+            return group
+        if admit <= 0:
+            # exhausted: nothing charged — the mask/filter rejects the
+            # whole group at placement (diagnostics parity by design)
+            ledger.block_group(t0)
+            return group
+        # partial: admit the insertion-order prefix (deterministic),
+        # defer the rest
+        items = list(group.items())
+        admitted = dict(items[:admit])
+        ledger.charge(tenant, cpu_d, mem_d, admit)
+        ledger.note_group_charge(t0, admit)
+        self._quota_defer(tenant, items[admit:], decisions)
+        return admitted
+
+    def _quota_defer(self, tenant: str, items, decisions) -> None:
+        """Defer clamped tasks: quota message + re-queue for the next
+        tick (the _no_suitable_node discipline, with a quota-specific
+        error so operators see the clamp, not a capacity problem)."""
+        n = len(items)
+        self.stats["quota_clamps"] = self.stats.get("quota_clamps", 0) + n
+        _metrics.counter(f'swarm_quota_clamps{{tenant="{tenant}"}}', n)
+        ts = now()
+        for task_id, _t in items:
+            self.quota.deferred_tasks.add(task_id)
+        for task_id, t in items:
+            new_t = t.copy()
+            new_t.status.timestamp = ts
+            new_t.status.err = f'over tenant quota (tenant "{tenant}")'
+            self.all_tasks[task_id] = new_t
+            self._enqueue(new_t)
+            if decisions is not None:
+                decisions[task_id] = SchedulingDecision(t, new_t)
 
     def _run_group_pipeline(self, groups, one_off_tasks, decisions
                             ) -> Tuple[int, int, List[Tuple[Task, str]]]:
@@ -709,7 +814,7 @@ class Scheduler:
         committer = _TickCommitter(self)
         inflight: Optional[Tuple[object, Dict[str, Task]]] = None
         n_block = 0
-        glist = list(self._tick_groups(groups, one_off_tasks))
+        glist = list(self._tick_groups(groups, one_off_tasks, decisions))
         can_fuse = hasattr(planner, "probe_fused_run")
         i = 0
         try:
@@ -768,7 +873,7 @@ class Scheduler:
         planner = self.batch_planner
         can_fuse = (planner is not None
                     and hasattr(planner, "probe_fused_run"))
-        glist = list(self._tick_groups(groups, one_off_tasks))
+        glist = list(self._tick_groups(groups, one_off_tasks, decisions))
         i = 0
         while i < len(glist):
             specs = (planner.probe_fused_run(self, glist, i)
@@ -922,29 +1027,59 @@ class Scheduler:
             if not preempt_mod.preemptable_group(t0):
                 sup.note_skipped("unsupported", len(group))
                 continue
-            cpu_d, mem_d = preempt_mod.demand_of(t0)
+            cpu_d, mem_d, gen_d = preempt_mod.demand_of(t0)
+            headroom = None
+            if self.quota_enabled and self.quota.active:
+                # a tenant at (or over) its quota must not preempt its
+                # way past it — QoS clamps at admission, full stop.
+                # Headroom counts the group's OWN admission charge back
+                # in: tasks already admitted (and charged) this tick are
+                # entitled to preempt their way to placement.
+                headroom = self.quota.preempt_headroom(
+                    t0, cpu_d, mem_d, group)
+                if headroom is not None and headroom <= 0:
+                    sup.note_skipped("quota", len(group))
+                    continue
             skipped_cd: List[int] = []
             cand = preempt_mod.build_candidates(
                 self, t0, prio, sup.shut_this_tick, sup.cooldowns,
-                sup.cooldown, skipped_cd)
+                sup.cooldown, skipped_cd,
+                gen_kind=gen_d[0] if gen_d else None)
             if skipped_cd and skipped_cd[0]:
                 sup.note_skipped("cooldown", skipped_cd[0])
             if cand is None:
                 continue
             # host and device run the SAME capped pick count — the
-            # shared-iteration contract the differential fuzz pins
+            # shared-iteration contract the differential fuzz pins.
+            # A quota'd tenant's picks are additionally capped at its
+            # headroom (remaining quota + the group's own charge).
             n_picks = min(len(group), budget_rem)
+            if headroom is not None:
+                n_picks = min(n_picks, headroom)
+            gen_val = gen_d[1] if gen_d else 0
             picks = None
             if device is not None:
-                picks = device(cand, cpu_d, mem_d, n_picks, budget_rem)
+                picks = device(cand, cpu_d, mem_d, gen_val, n_picks,
+                               budget_rem)
             if picks is None:
                 picks = preempt_mod.select_victims_host(
-                    cand, cpu_d, mem_d, n_picks, budget_rem)
+                    cand, cpu_d, mem_d, gen_val, n_picks, budget_rem)
             if picks:
                 placed, victims_n = self._commit_preemption(
                     group, t0, prio, cand, picks)
                 budget_rem -= victims_n
                 placed_total += placed
+                if placed and self.quota_enabled and self.quota.active:
+                    # keep the ledger honest for later same-tenant
+                    # groups this pass: placements consume the group's
+                    # phantom charge first; only the excess (fresh
+                    # quota headroom) is new usage to charge
+                    consumed = min(placed, self.quota.group_charge(t0))
+                    self.quota.note_group_charge(t0, -consumed)
+                    extra = placed - consumed
+                    if extra > 0:
+                        self.quota.charge(task_tenant(t0), cpu_d,
+                                          mem_d, extra)
             # still-pending positive-priority tasks with live lower-
             # priority candidates = the inversion signal the
             # priority_inversion health check judges.  Count against

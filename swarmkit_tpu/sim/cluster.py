@@ -62,8 +62,9 @@ from ..utils.identity import set_id_source
 from .engine import SimEngine
 from .faults import NetConfig, SimNetwork
 from .invariants import (
-    PreemptionInvariants, RaftInvariants, ReadInvariants, TaskInvariants,
-    UpdateInvariants, Violations, check_placement_quality, entry_digest,
+    PreemptionInvariants, QosInvariants, RaftInvariants, ReadInvariants,
+    TaskInvariants, UpdateInvariants, Violations,
+    check_placement_quality, entry_digest,
 )
 
 #: entry-data prefix marking replicated control-plane store actions —
@@ -1091,8 +1092,19 @@ class SimMemberControl:
         # checker-sensitivity seam: preemption off means a feasible
         # higher-priority task can starve — no-priority-inversion fires
         self.scheduler.preempt_enabled = cp.preemption_enabled
+        # checker-sensitivity seam: quota enforcement off means a
+        # bursting tenant's committed usage runs past its quota —
+        # quota-never-exceeded fires
+        self.scheduler.quota_enabled = cp.quota_enabled
         self.scheduler.pipeline.add_filter(
             VolumesFilter(self.scheduler.volumes))
+        # the autoscaler in threadless mode: step() pumps drive() under
+        # virtual time; decisions read the scenario-driven sampler seam
+        from ..orchestrator.autoscaler import (
+            Supervisor as AutoscaleSupervisor,
+        )
+        self.autoscaler = AutoscaleSupervisor(
+            store, sampler=cp.autoscale_sampler, start_worker=False)
         # jobs orchestrator (run-to-completion work coexisting with
         # services): driven threadless like the other orchestrators, so
         # job iterations survive leader failover via the replicated store
@@ -1181,6 +1193,11 @@ class SimMemberControl:
             orch.updater.drive()
         if self.detached:
             return
+        # autoscale decisions ride consensus like every control write;
+        # a deposal inside one propagates and the caller detaches
+        self.autoscaler.drive()
+        if self.detached:
+            return
         self.restarts.drive()
 
     def detach(self) -> None:
@@ -1203,6 +1220,10 @@ class SimMemberControl:
                 pass
         try:
             self.restarts.stop()     # cancels delayed starts; threadless
+        except Exception:
+            pass
+        try:
+            self.autoscaler.stop()   # never writes; threadless no-op+flag
         except Exception:
             pass
         for _, sub, _ in self._drivers:
@@ -1437,6 +1458,28 @@ class RaftControlPlane:
         #: opt-in post-convergence placement-quality bound (see
         #: invariants.check_placement_quality); None disables
         self.placement_quality_bound: Optional[float] = None
+        # ---- autoscaler + tenant QoS scenario surface (ISSUE 12)
+        #: checker-sensitivity seam: False disables the scheduler's
+        #: quota plane so quota-never-exceeded must fire
+        self.quota_enabled = True
+        #: scenario-driven per-service load (demand units) feeding the
+        #: autoscalers' sampler seam deterministically
+        self.service_load: Dict[str, float] = {}
+        #: (kind, sid, replicas, by, label) autoscale expectations:
+        #: kind "reach" = some committed change >= replicas by ``by``;
+        #: kind "converge" = back at exactly ``replicas`` by ``by`` AND
+        #: at scenario end
+        self.autoscale_expectations: List[tuple] = []
+        #: (min_priority, t0, t1) burst windows for the cross-band p99
+        #: invariant
+        self.band_p99_expectations: List[tuple] = []
+        #: archived QoS material from crash-replaced checkers
+        self._qos_replicas_archive: List[tuple] = []
+        self._qos_samples_archive: List[tuple] = []
+        #: cumulative quota clamps across leader attach epochs (+ the
+        #: one-shot "fault quota-clamp scheduler" coverage line)
+        self.quota_clamp_total = 0
+        self._quota_clamps_prev = 0
         # ---- priority & preemption scenario surface
         #: checker-sensitivity seam: False disables the scheduler's
         #: preemption pass so no-priority-inversion must fire
@@ -1489,6 +1532,11 @@ class RaftControlPlane:
         # states its old checker observed still count toward the
         # convergence expectations
         self._update_history: List[tuple] = []
+        # the p99 bound's cadence: one control step + the scheduler's
+        # commit-debounce ceiling — the scheduler's own latency model,
+        # not a per-scenario constant (QosInvariants.band_p99_bound)
+        from ..scheduler.scheduler import MAX_LATENCY
+        self._qos_cadence = control_interval + MAX_LATENCY
         self.agents: List[SimAgent] = [
             SimAgent(f"w{i}", self) for i in range(n_agents)]
         engine.every(control_interval, "control step", self.control_step)
@@ -1689,7 +1737,17 @@ class RaftControlPlane:
         self.engine.log(f"control detach {mc.member.id}: {reason}")
         for k in self._dispatcher_totals:
             self._dispatcher_totals[k] += mc.dispatcher.stats.get(k, 0)
+        self.quota_clamp_total += \
+            mc.scheduler.stats.get("quota_clamps", 0)
         mc.detach()
+
+    def quota_clamps(self) -> int:
+        """Quota clamps across every leader's scheduler (attach epochs)."""
+        total = self.quota_clamp_total
+        mc = self.active
+        if mc is not None:
+            total += mc.scheduler.stats.get("quota_clamps", 0)
+        return total
 
     def _attach(self, member: SimManager) -> None:
         # the deposal window may have left committed entries deferred
@@ -1719,9 +1777,9 @@ class RaftControlPlane:
     # --------------------------------------------------------- control step
 
     def _checker_for(self, m: SimManager) -> Optional[tuple]:
-        """(TaskInvariants, UpdateInvariants, PreemptionInvariants) for
-        a member's replicated store, rebuilt when a restart replaces
-        the store object."""
+        """(TaskInvariants, UpdateInvariants, PreemptionInvariants,
+        QosInvariants) for a member's replicated store, rebuilt when a
+        restart replaces the store object."""
         if m.store is None:
             return None
         entry = self._inv.get(m.id)
@@ -1729,13 +1787,18 @@ class RaftControlPlane:
             if entry is not None:
                 self._update_history.extend(entry[2].history)
                 self._preempt_archive.extend(entry[3].preempted)
+                self._qos_replicas_archive.extend(
+                    entry[4].replica_history)
+                self._qos_samples_archive.extend(entry[4].band_samples)
             entry = (m.store,
                      TaskInvariants(self.violations, m.store),
                      UpdateInvariants(self.violations, m.store, tag=m.id),
                      PreemptionInvariants(
                          self.violations, m.store, tag=m.id,
                          inversion_bound=self.preempt_inversion_bound,
-                         thrash_bound=self.preempt_thrash_bound))
+                         thrash_bound=self.preempt_thrash_bound),
+                     QosInvariants(self.violations, m.store, tag=m.id,
+                                   cadence=self._qos_cadence))
             self._inv[m.id] = entry
         return entry[1:]
 
@@ -1823,7 +1886,116 @@ class RaftControlPlane:
         for w in self.watchers:
             w.continuity.ensure()
             w.continuity.drain()
+        # coverage line: the first ACTUAL quota clamp marks the cell —
+        # honest coverage, not a scripted log (chaos_sweep REQUIRED_CELLS)
+        qc = self.quota_clamps()
+        if qc and not self._quota_clamps_prev:
+            self.engine.log("fault quota-clamp scheduler")
+        self._quota_clamps_prev = qc
         return None
+
+    # ----------------------------------------------- autoscaler + QoS
+
+    def autoscale_sampler(self, service_id: str) -> Optional[dict]:
+        """The supervisors' sampler seam, driven by the scenario's
+        ``service_load`` — deterministic by construction (virtual time,
+        no registry reads)."""
+        load = self.service_load.get(service_id)
+        if load is None:
+            return None
+        return {"load": load}
+
+    def set_load(self, service_id: str, load: float) -> None:
+        """Set the observed demand for one service (the autoscaler's
+        input signal)."""
+        self.service_load[service_id] = load
+        self.engine.log(f"workload load {service_id}={load:g}")
+
+    def configure_tenants(self, tenants: Dict[str, object]) -> None:
+        """Create/replace the default Cluster's per-tenant quotas
+        (ClusterSpec.tenants); retried across failover gaps."""
+        from ..models.objects import Cluster
+        from ..models.specs import ClusterSpec
+
+        def cb(tx):
+            cur = tx.get(Cluster, "cluster-default")
+            if cur is None:
+                tx.create(Cluster(
+                    id="cluster-default",
+                    spec=ClusterSpec(
+                        annotations=Annotations(name="default"),
+                        tenants=dict(tenants))))
+            else:
+                cur = cur.copy()
+                cur.spec.tenants = dict(tenants)
+                tx.update(cur)
+        self._apply_workload(f"tenants {sorted(tenants)}", cb)
+
+    def expect_autoscale(self, sid: str, at_least: int,
+                         by: float) -> None:
+        """The scale-up must commit >= ``at_least`` replicas by ``by``
+        virtual seconds — across whatever failovers happen meanwhile."""
+        self.autoscale_expectations.append(
+            ("reach", sid, at_least, by, "autoscale-scale-up"))
+
+    def expect_autoscale_converge(self, sid: str, to: int,
+                                  by: float) -> None:
+        """Load removed => replicas must return to ``to`` by ``by`` AND
+        still be there at scenario end (autoscale-converges)."""
+        self.autoscale_expectations.append(
+            ("converge", sid, to, by, "autoscale-converges"))
+
+    def expect_band_p99(self, min_priority: int, t0: float,
+                        t1: float) -> None:
+        """Register a burst window for no-cross-band-p99-violation."""
+        self.band_p99_expectations.append((min_priority, t0, t1))
+
+    def _qos_checkers(self) -> List[QosInvariants]:
+        return [entry[4] for entry in self._inv.values()]
+
+    def merged_replica_history(self) -> List[tuple]:
+        """Committed replica changes, deduped across member checkers:
+        every member observes the same committed change SEQUENCE per
+        service (laggards see a prefix), so the merged history is the
+        longest observed sequence, stamped at the earliest observation
+        of each position."""
+        per_source: Dict[str, List[List[tuple]]] = {}
+        sources = [self._qos_replicas_archive] + [
+            c.replica_history for c in self._qos_checkers()]
+        for src in sources:
+            by_sid: Dict[str, List[tuple]] = {}
+            for t, sid, replicas in src:
+                by_sid.setdefault(sid, []).append((t, replicas))
+            for sid, seq in by_sid.items():
+                per_source.setdefault(sid, []).append(seq)
+        out: List[tuple] = []
+        for sid, seqs in per_source.items():
+            # one authoritative sequence per service: the longest (a
+            # crash-rebuilt checker's fresh tail is shorter and its
+            # changes were also observed by the surviving members);
+            # ties resolve to the earliest-stamped observer
+            best = min(seqs, key=lambda s: (-len(s), s[0][0] if s
+                                            else 0.0))
+            out.extend((t, sid, replicas) for t, replicas in best)
+        out.sort()
+        return out
+
+    def _merged_band_data(self):
+        """(samples, open_pending) deduped across member checkers +
+        archives: every member observes the same committed stream, so
+        first-writer-wins by task id."""
+        samples: Dict[str, tuple] = {}
+        for s in self._qos_samples_archive:
+            samples.setdefault(s[0], s)
+        for c in self._qos_checkers():
+            for s in c.band_samples:
+                samples.setdefault(s[0], s)
+        open_pending: Dict[str, tuple] = {}
+        for c in self._qos_checkers():
+            for tid, entry in c.pending_open.items():
+                if tid not in samples:
+                    open_pending.setdefault(tid, entry)
+        return list(samples.values()), list(open_pending.values())
 
     # -------------------------------------------------------------- workload
 
@@ -1942,27 +2114,34 @@ class RaftControlPlane:
             self.busy = False
 
     def add_service(self, sid: str, replicas: int, priority: int = 0,
-                    nano_cpus: int = 0, memory_bytes: int = 0) -> None:
+                    nano_cpus: int = 0, memory_bytes: int = 0,
+                    tenant: str = "", autoscale=None) -> None:
         """Create a replicated service in a priority band, optionally
         with per-task reservations (the preemption scenarios' workload:
-        bands contending for finite node capacity).  The SERVICE-level
-        priority is used deliberately — it exercises the
-        ServiceSpec.priority -> task spec propagation path."""
+        bands contending for finite node capacity), a tenant label
+        (quota enforcement — the ``swarm.tenant`` annotation the
+        orchestrator propagates onto every task), and an autoscaling
+        policy.  The SERVICE-level priority is used deliberately — it
+        exercises the ServiceSpec.priority -> task spec propagation
+        path."""
         from ..models.types import ResourceRequirements
+        from ..scheduler.quota import TENANT_LABEL
 
         def cb(tx):
             if tx.get(Service, sid) is not None:
                 return
             res = ResourceRequirements(reservations=Resources(
                 nano_cpus=nano_cpus, memory_bytes=memory_bytes))
+            labels = {TENANT_LABEL: tenant} if tenant else {}
             tx.create(Service(
                 id=sid,
                 spec=ServiceSpec(
-                    annotations=Annotations(name=sid),
+                    annotations=Annotations(name=sid, labels=labels),
                     mode=ServiceMode.REPLICATED,
                     replicated=ReplicatedService(replicas=replicas),
                     task=TaskSpec(resources=res),
-                    priority=priority),
+                    priority=priority,
+                    autoscale=autoscale),
                 spec_version=Version(index=1)))
         self._apply_workload(
             f"service {sid} x{replicas} prio={priority}", cb)
@@ -2134,6 +2313,49 @@ class RaftControlPlane:
                 and self.store is not None:
             check_placement_quality(violations, self.store,
                                     self.placement_quality_bound)
+        # ---- autoscaler + QoS end checks
+        for c in self._qos_checkers():
+            c.drain()
+        history = self.merged_replica_history()
+        final_replicas: Dict[str, int] = {}
+        if self.store is not None:
+            for s in self.store.view(lambda tx: tx.find(Service)):
+                if s.spec.replicated is not None:
+                    final_replicas[s.id] = s.spec.replicated.replicas
+        for kind, sid, replicas, by, label in self.autoscale_expectations:
+            if kind == "reach":
+                hit = [h for h in history
+                       if h[1] == sid and h[2] >= replicas
+                       and h[0] <= by]
+                if not hit:
+                    seen = [h[2] for h in history if h[1] == sid]
+                    violations.record(
+                        label,
+                        f"service {sid} never reached {replicas} "
+                        f"replicas by t={by:.1f} (observed {seen}) — "
+                        "the scale-up was lost (failover?)")
+            else:   # converge
+                hit = [h for h in history
+                       if h[1] == sid and h[2] == replicas
+                       and h[0] <= by]
+                if not hit or final_replicas.get(sid) != replicas:
+                    violations.record(
+                        label,
+                        f"service {sid}: load removed but replicas "
+                        f"never settled back at {replicas} by "
+                        f"t={by:.1f} (final "
+                        f"{final_replicas.get(sid)}) — the autoscaler "
+                        "failed to converge")
+        if self.band_p99_expectations:
+            qos = next(iter(self._qos_checkers()), None)
+            if qos is not None:
+                samples, open_pending = self._merged_band_data()
+                for min_prio, t0, t1 in self.band_p99_expectations:
+                    qos.check_band_p99(
+                        min_prio, t0, t1, violations,
+                        samples=samples,
+                        open_pending=[(p, since)
+                                      for p, since in open_pending])
         # ---- read-plane end checks
         for w in self.watchers:
             w.drain()                 # catch up after the heal grace
@@ -2342,6 +2564,9 @@ class Sim:
                              if h[3] >= 0})
             out["control"] = {
                 "attaches": self.cp.attaches,
+                "quota_clamps": self.cp.quota_clamps(),
+                "autoscale_changes": len(
+                    self.cp.merged_replica_history()),
                 "stale_epoch_rejects": sum(
                     p.stats["stale_epoch_rejects"]
                     for p in self.cp.proposers.values()),

@@ -45,7 +45,7 @@ from __future__ import annotations
 import hashlib
 from typing import Dict, List, Optional, Tuple
 
-from ..models.objects import Node, Service, Task
+from ..models.objects import Cluster, Node, Service, Task
 from ..models.types import NodeState, TaskState, TERMINAL_STATES, UpdateState
 from ..state.events import Event, EventTaskBlock
 
@@ -672,6 +672,314 @@ class PreemptionInvariants:
                 f"{self.tag}: victim {victim_id[:8]} of service {sid} "
                 f"slot {slot} was never requeued — preemption lost "
                 "work")
+
+
+class QosInvariants:
+    """Autoscaler + multi-tenant QoS invariants (ISSUE 12), tracked from
+    one store's ordered event stream (payload discipline like
+    TaskInvariants):
+
+    * quota-never-exceeded — committed per-tenant usage (cpu/memory
+      reservations + task count of assigned, live tasks) must stay <=
+      the ClusterSpec quota at every drain.  Usage is re-derived from
+      event payloads, independently of the scheduler's ledger.
+    * autoscale-within-bounds-and-rate — every committed replica change
+      on an autoscaled service must land inside [min, max], move at
+      most one configured step, and carry decision stamps
+      (``Service.autoscale_status.last_decision_at`` — the REPLICATED
+      stamp, so the check holds across leader failover) no closer than
+      the stabilization window.
+    * no-cross-band-p99-violation (``check_band_p99``) — a registered
+      burst window must not degrade higher bands' pending->assigned
+      p99 beyond a bound derived from the scheduler's own cadence
+      (control-step interval + commit-debounce latency) and the band's
+      own out-of-window behavior — never a per-scenario constant.
+      Tasks still pending at finalize count at their open-ended age, so
+      outright starvation cannot hide from a percentile.
+    * autoscale-converges — judged by the control plane's registered
+      expectations against ``replica_history`` (merged across members
+      and crash-rebuilt checkers, like the update-state history).
+    """
+
+    #: slack on the rate check: equal stamps one float ulp apart must
+    #: not fire
+    RATE_EPS = 1e-6
+
+    def __init__(self, violations: Violations, store, tag: str = "",
+                 cadence: float = 1.5):
+        self.v = violations
+        self.store = store
+        self.tag = tag
+        #: scheduler cadence (control interval + debounce max latency):
+        #: the latency floor the p99 bound derives from
+        self.cadence = cadence
+        self.quotas: Dict[str, object] = {}
+        #: task id -> (tenant, cpu, mem) currently counted toward usage
+        self._counted: Dict[str, tuple] = {}
+        self.usage: Dict[str, List[int]] = {}
+        self._quota_flagged: set = set()
+        #: service id -> (replicas, autoscale cfg, decision stamp)
+        self._svc_replicas: Dict[str, int] = {}
+        self._svc_stamp: Dict[str, float] = {}
+        self._bounds_flagged: set = set()
+        #: (t, service id, replicas) — every committed replica change
+        #: on an autoscaled service
+        self.replica_history: List[tuple] = []
+        #: task id -> (priority, first-PENDING stamp) still waiting
+        self.pending_open: Dict[str, tuple] = {}
+        #: (task id, priority, assign t, pending->assigned latency) —
+        #: the id lets the control plane dedupe samples across member
+        #: checkers (every member observes the same committed stream)
+        self.band_samples: List[tuple] = []
+        self.sub = store.queue.subscribe(
+            lambda ev: isinstance(ev, EventTaskBlock)
+            or (isinstance(ev, Event)
+                and isinstance(ev.obj, (Task, Service, Cluster))),
+            accepts_blocks=True)
+
+        from ..scheduler.nodeinfo import task_reservations
+        from ..scheduler.preempt import task_priority
+        from ..scheduler.quota import task_tenant
+        self._reservations = task_reservations
+        self._priority = task_priority
+        self._tenant = task_tenant
+
+        # baseline adoption (TaskInvariants discipline): a crash-rebuilt
+        # store replays no history — seed quotas, usage, service state
+        # and open pending stamps from the committed rows
+        def seed(tx):
+            ts = self._now()
+            for c in tx.find(Cluster):
+                if c.spec.annotations.name == "default":
+                    self.quotas = dict(c.spec.tenants)
+            for s in tx.find(Service):
+                if s.spec.autoscale is not None \
+                        and s.spec.replicated is not None:
+                    self._svc_replicas[s.id] = s.spec.replicated.replicas
+                    if s.autoscale_status is not None:
+                        self._svc_stamp[s.id] = \
+                            s.autoscale_status.last_decision_at
+            for t in tx.find(Task):
+                self._observe_task_row(t, ts)
+        store.view(seed)
+
+    def _now(self) -> float:
+        return self.v.engine.clock.elapsed()
+
+    # ---------------------------------------------------------------- drain
+
+    def drain(self) -> None:
+        while True:
+            ev = self.sub.poll()
+            if ev is None:
+                break
+            ts = self._now()
+            if isinstance(ev, EventTaskBlock):
+                # block payloads: per-task (old row, node) pairs plus
+                # the committed state column — the scheduler's columnar
+                # assignment commits arrive exactly this way
+                state = int(ev.state)
+                for nid, items in ev.per_node().items():
+                    for old, _ver in items:
+                        self._observe_task_payload(
+                            old, state, nid, int(old.desired_state), ts)
+                continue
+            obj = ev.obj
+            if isinstance(obj, Cluster):
+                # the "default" cluster owns the quota table (the same
+                # row the scheduler reads) — other Cluster objects must
+                # not wipe it
+                if ev.action != "delete" \
+                        and obj.spec.annotations.name == "default":
+                    self.quotas = dict(obj.spec.tenants)
+                continue
+            if isinstance(obj, Service):
+                self._observe_service(ev.action, obj, ts)
+                continue
+            if ev.action == "delete":
+                self._uncount(obj.id)
+                self.pending_open.pop(obj.id, None)
+                continue
+            self._observe_task_row(obj, ts)
+        self._check_quota()
+
+    # -------------------------------------------------------------- tenants
+
+    def _observe_task_row(self, t: Task, ts: float) -> None:
+        self._observe_task_payload(t, int(t.status.state), t.node_id,
+                                   int(t.desired_state), ts)
+
+    def _observe_task_payload(self, t: Task, state: int, node_id: str,
+                              desired: int, ts: float) -> None:
+        # usage: counted while assigned and live
+        live = (bool(node_id)
+                and int(TaskState.ASSIGNED) <= state
+                <= int(TaskState.RUNNING)
+                and desired <= int(TaskState.COMPLETE))
+        if live and t.id not in self._counted:
+            tenant = self._tenant(t)
+            if tenant in self.quotas:
+                res = self._reservations(t)
+                entry = (tenant, int(res.nano_cpus),
+                         int(res.memory_bytes))
+                self._counted[t.id] = entry
+                row = self.usage.setdefault(tenant, [0, 0, 0])
+                row[0] += entry[1]
+                row[1] += entry[2]
+                row[2] += 1
+        elif not live and t.id in self._counted:
+            self._uncount(t.id)
+        # pending->assigned band latency.  Terminal-past-RUNNING is
+        # checked FIRST: a task shut down while still PENDING (scale
+        # down, reaper) was never assigned and must not mint a sample.
+        if state > int(TaskState.RUNNING):
+            self.pending_open.pop(t.id, None)
+        elif (state == int(TaskState.PENDING) and not node_id
+                and desired <= int(TaskState.COMPLETE)):
+            self.pending_open.setdefault(t.id, (self._priority(t), ts))
+        elif state >= int(TaskState.ASSIGNED):
+            open_ = self.pending_open.pop(t.id, None)
+            if open_ is not None:
+                prio, since = open_
+                self.band_samples.append((t.id, prio, ts, ts - since))
+
+    def _uncount(self, task_id: str) -> None:
+        entry = self._counted.pop(task_id, None)
+        if entry is None:
+            return
+        tenant, cpu, mem = entry
+        row = self.usage.get(tenant)
+        if row is not None:
+            row[0] -= cpu
+            row[1] -= mem
+            row[2] -= 1
+
+    def _check_quota(self) -> None:
+        for tenant, q in self.quotas.items():
+            if tenant in self._quota_flagged:
+                continue
+            row = self.usage.get(tenant)
+            if row is None:
+                continue
+            over = []
+            for have, limit, unit in ((row[0], q.nano_cpus, "nano_cpus"),
+                                      (row[1], q.memory_bytes,
+                                       "memory_bytes"),
+                                      (row[2], q.max_tasks, "tasks")):
+                if limit > 0 and have > limit:
+                    over.append(f"{unit} {have} > {limit}")
+            if over:
+                self._quota_flagged.add(tenant)
+                self.v.record(
+                    "quota-never-exceeded",
+                    f"{self.tag}: tenant {tenant} committed usage "
+                    f"exceeds its quota ({'; '.join(over)}) — admission "
+                    "clamping is broken")
+
+    # ------------------------------------------------------------ autoscale
+
+    def _observe_service(self, action: str, s: Service,
+                         ts: float) -> None:
+        if action == "delete":
+            self._svc_replicas.pop(s.id, None)
+            self._svc_stamp.pop(s.id, None)
+            return
+        cfg = s.spec.autoscale
+        if cfg is None or s.spec.replicated is None:
+            self._svc_replicas.pop(s.id, None)
+            return
+        new = s.spec.replicated.replicas
+        prev = self._svc_replicas.get(s.id)
+        stamp = (s.autoscale_status.last_decision_at
+                 if s.autoscale_status is not None else 0.0)
+        prev_stamp = self._svc_stamp.get(s.id)
+        self._svc_replicas[s.id] = new
+        if stamp:
+            self._svc_stamp[s.id] = stamp
+        if prev is None or new == prev:
+            return
+        self.replica_history.append((ts, s.id, new))
+        problems = []
+        if not (cfg.min_replicas <= new <= cfg.max_replicas):
+            problems.append(
+                f"replicas {new} outside "
+                f"[{cfg.min_replicas}, {cfg.max_replicas}]")
+        step = cfg.scale_up_step if new > prev else cfg.scale_down_step
+        if abs(new - prev) > max(step, 1):
+            problems.append(
+                f"step {prev} -> {new} exceeds the configured "
+                f"{'up' if new > prev else 'down'} step {step}")
+        if (prev_stamp and stamp
+                and stamp - prev_stamp
+                < cfg.stabilization_window - self.RATE_EPS):
+            problems.append(
+                f"decision stamps {prev_stamp:.3f} -> {stamp:.3f} are "
+                f"closer than the {cfg.stabilization_window:.1f}s "
+                "stabilization window")
+        if problems and s.id not in self._bounds_flagged:
+            self._bounds_flagged.add(s.id)
+            self.v.record(
+                "autoscale-within-bounds-and-rate",
+                f"{self.tag}: service {s.id}: {'; '.join(problems)}")
+
+    # -------------------------------------------------------------- finalize
+
+    @staticmethod
+    def _p99(samples: List[float]) -> float:
+        if not samples:
+            return 0.0
+        ordered = sorted(samples)
+        return ordered[min(len(ordered) - 1,
+                           int(0.99 * len(ordered)))]
+
+    def band_p99_bound(self, baseline: List[float]) -> float:
+        """The derived bound: the scheduler's own cadence (a handful of
+        control steps + commit debounce) as the floor, or 3x the band's
+        out-of-window p99 when that behavior is worse — never a
+        per-scenario constant."""
+        return max(4.0 * self.cadence, 3.0 * self._p99(baseline))
+
+    def check_band_p99(self, min_priority: int, t0: float, t1: float,
+                       violations: Violations,
+                       samples: Optional[List[tuple]] = None,
+                       open_pending: Optional[List[tuple]] = None
+                       ) -> None:
+        """Judge one registered burst window: higher bands' (priority >=
+        ``min_priority``) pending->assigned p99 inside [t0, t1] must stay
+        under the derived bound.  ``samples``/``open_pending`` default to
+        this checker's own view (the control plane passes merged,
+        deduped collections)."""
+        samples = samples if samples is not None else self.band_samples
+        if open_pending is None:
+            open_pending = list(self.pending_open.values())
+        ts = self._now()
+        band = [(at, lat) for _tid, prio, at, lat in samples
+                if prio >= min_priority]
+        in_window = [lat for at, lat in band if t0 <= at <= t1]
+        # a task of the band still unassigned counts at its open-ended
+        # age — starvation must not escape the percentile
+        for prio, since in open_pending:
+            if prio >= min_priority and since <= t1:
+                in_window.append(ts - since)
+        if not in_window:
+            violations.record(
+                "no-cross-band-p99-violation",
+                f"band >= {min_priority} produced no pending->assigned "
+                f"samples in [{t0:.0f}, {t1:.0f}] — the burst window "
+                "never exercised the protected band")
+            return
+        baseline = [lat for at, lat in band if at < t0 or at > t1]
+        bound = self.band_p99_bound(baseline)
+        p99 = self._p99(in_window)
+        if p99 > bound:
+            violations.record(
+                "no-cross-band-p99-violation",
+                f"band >= {min_priority} pending->assigned p99 "
+                f"{p99:.2f}s inside the burst window exceeds the "
+                f"derived bound {bound:.2f}s (cadence {self.cadence}s, "
+                f"baseline p99 {self._p99(baseline):.2f}s over "
+                f"{len(baseline)} samples) — the burst leaked into the "
+                "protected band")
 
 
 class ReadInvariants:

@@ -981,6 +981,93 @@ def _preemption_storm(sim: Sim) -> float:
 _preemption_storm.raft_cp = True
 
 
+def _tenant_storm(sim: Sim) -> float:
+    """Autoscaler + multi-tenant QoS soak (ISSUE 12): a quota'd
+    low-band tenant's autoscaled service bursts (the scenario drives
+    its load signal up 16x) while a high-band tenant's service must
+    keep its pending->assigned p99 — judged by the derived cross-band
+    bound, not a constant.  The burst rides agent churn, a drop burst,
+    and a leader crash landing MID-SCALE-UP (the successor's
+    supervisor resumes the policy from the replicated
+    ``autoscale_status``, still inside bounds and rate).  Quotas clamp
+    the burst at admission — host filter AND device quota-mask column
+    (the planner factory is the device path) — so the low tenant's
+    committed usage can never exceed its quota and the high band never
+    waits on capacity the burst stole.  Load removal at the end must
+    converge replicas back to min.  Judged by quota-never-exceeded,
+    autoscale-within-bounds-and-rate, no-cross-band-p99-violation and
+    autoscale-converges on top of the shared checkers."""
+    from ..models.specs import AutoscaleConfig
+    from ..models.types import TenantQuota
+    eng = sim.engine
+    cp = sim.cp
+    cp.planner_factory = _device_planner    # quota mask on device
+    CPU = 2 * 10 ** 9    # 4 slots per 8-cpu worker (5 workers = 40 cpu)
+    eng.at(eng.clock.start + 4.0, "tenants",
+           lambda: cp.configure_tenants({
+               # t-lo: 12 cpu = 6 tasks — far below the burst's ask
+               "t-lo": TenantQuota(nano_cpus=12 * 10 ** 9),
+               # t-hi: room for the whole high band
+               "t-hi": TenantQuota(nano_cpus=24 * 10 ** 9)}))
+    eng.at(eng.clock.start + 6.0, "burst service",
+           lambda: cp.add_service(
+               "svc-burst", 2, priority=0, nano_cpus=CPU,
+               tenant="t-lo",
+               autoscale=AutoscaleConfig(
+                   min_replicas=2, max_replicas=16,
+                   target_utilization=1.0, scale_up_step=4,
+                   scale_down_step=6, stabilization_window=3.0)))
+    eng.at(eng.clock.start + 8.0, "high band",
+           lambda: cp.add_service("svc-hi", 4, priority=10,
+                                  nano_cpus=CPU, tenant="t-hi"))
+    # steady pre-burst load so the policy has a signal either way
+    eng.at(eng.clock.start + 10.0, "baseline load",
+           lambda: cp.set_load("svc-burst", 2.0))
+
+    def burst():
+        # the injected fault: a 16x tenant burst into the scheduler
+        eng.log("fault autoscale-burst scheduler")
+        cp.set_load("svc-burst", 32.0)
+    eng.at(eng.clock.start + 14.0, "tenant burst", burst)
+    # the burst's scale-up wants 16 replicas; quota admits 6 — clamps
+    # MUST be observed (coverage cell quota-clamp x scheduler), and the
+    # committed replica count must still reach the spec maximum across
+    # the leader crash below
+    cp.expect_autoscale("svc-burst", at_least=10, by=48.0)
+    cp.expect_band_p99(5, 14.0, 58.0)
+
+    # agent churn AWAY from the leader outage (its TTL-driven
+    # re-placement must ride a live leader)
+    a = cp.agents
+    eng.at(eng.clock.start + 18.0, "agent crash", a[3].crash)
+    eng.at(eng.clock.start + 36.0, "agent restart", a[3].restart)
+
+    # leader crash mid-scale-up: the supervisor is between steps of the
+    # burst ramp — the successor resumes from the replicated status
+    def crash_leader():
+        m = sim.leader()
+        if m is None:
+            return
+        m.crash()
+        eng.after(6.0, "restart ex-leader", m.restart)
+    eng.at(eng.clock.start + 26.0, "crash leader mid-scale-up",
+           crash_leader)
+
+    eng.at(eng.clock.start + 44.0, "drop burst",
+           lambda: setattr(sim.net.config, "drop_p", 0.1))
+    eng.at(eng.clock.start + 50.0, "drop off",
+           lambda: setattr(sim.net.config, "drop_p", 0.0))
+
+    # load removed: the policy must walk replicas back to min and stay
+    eng.at(eng.clock.start + 58.0, "load removed",
+           lambda: cp.set_load("svc-burst", 0.0))
+    cp.expect_autoscale_converge("svc-burst", to=2, by=95.0)
+    return 85.0
+
+
+_tenant_storm.raft_cp = True
+
+
 # ----------------------------------------- follower-served read plane
 #
 # ISSUE 11: the consumer plane (watch streams, agent sessions,
@@ -1398,6 +1485,8 @@ SCENARIOS: Dict[str, Callable[[Sim], float]] = {
     "failover-churn-rollout": _failover_churn_rollout,
     # priority & preemption (device victim kernel + host oracle)
     "preemption-storm": _preemption_storm,
+    # autoscaler + multi-tenant QoS (quota mask column + control loop)
+    "tenant-storm": _tenant_storm,
     # follower-served read plane (read-index/lease reads, resume tokens)
     "follower-read-failover": _follower_read_failover,
     "read-storm-degraded": _read_storm_degraded,
@@ -1427,6 +1516,9 @@ UPDATE_SCENARIOS = (
 
 #: priority & preemption suite (ISSUE 10)
 PREEMPT_SCENARIOS = ("preemption-storm",)
+
+#: autoscaler + multi-tenant QoS suite (ISSUE 12)
+QOS_SCENARIOS = ("tenant-storm",)
 
 #: follower-served read plane (ISSUE 11)
 READ_SCENARIOS = ("follower-read-failover", "read-storm-degraded")

@@ -296,7 +296,16 @@ def test_one_off_tasks_preempt_as_singletons():
 # fused-differential discipline — byte-identical picks, every bucket)
 # ---------------------------------------------------------------------------
 
-def _random_candidates(rng, n, V):
+def _random_candidates(rng, n, V, with_gen=False):
+    kwargs = {}
+    if with_gen:
+        # generic-resource victim bucket (ISSUE 12 waiver shrink): the
+        # third resource column must stay byte-identical across paths
+        kwargs["free_gen"] = np.array(
+            [rng.randrange(0, 4) for _ in range(n)], np.int64)
+        kwargs["vgen"] = np.array(
+            [[rng.randrange(0, 3) for _ in range(n)]
+             for _ in range(V)], np.int64)
     return hp.CandidateSet(
         infos=None,
         ok=np.array([rng.random() < 0.8 for _ in range(n)]),
@@ -312,24 +321,99 @@ def _random_candidates(rng, n, V):
                         for _ in range(n)] for _ in range(V)], np.int64),
         vmem=np.array([[rng.randrange(0, 4) * GB
                         for _ in range(n)] for _ in range(V)], np.int64),
-        victims=None, vb=V, n_candidates=1)
+        victims=None, vb=V, n_candidates=1, **kwargs)
 
 
-@pytest.mark.parametrize("n,V", [(7, 4), (40, 16), (17, 4)])
-def test_device_selection_matches_host_oracle(n, V):
+@pytest.mark.parametrize("n,V,with_gen",
+                         [(7, 4, False), (40, 16, False), (17, 4, False),
+                          (11, 4, True), (23, 16, True)])
+def test_device_selection_matches_host_oracle(n, V, with_gen):
     from swarmkit_tpu.ops import preempt as dp
     for seed in range(25):
         rng = random.Random(seed * 1000 + n * 7 + V)
-        cand = _random_candidates(rng, n, V)
+        cand = _random_candidates(rng, n, V, with_gen=with_gen)
         cpu_d = rng.randrange(1, 5) * 10 ** 9
         mem_d = rng.randrange(0, 3) * GB
+        gen_d = rng.randrange(1, 4) if with_gen else 0
         budget = rng.randrange(1, 20)
         n_picks = min(rng.randrange(1, 12), budget)
-        host = hp.select_victims_host(cand, cpu_d, mem_d, n_picks,
-                                      budget)
-        dev, _label, _fn = dp.plan_victims(cand, cpu_d, mem_d, n_picks,
-                                           budget)
+        host = hp.select_victims_host(cand, cpu_d, mem_d, gen_d,
+                                      n_picks, budget)
+        dev, _label, _fn = dp.plan_victims(cand, cpu_d, mem_d, gen_d,
+                                           n_picks, budget)
         assert host == dev, (seed, n, V, host, dev)
+
+
+def test_generic_demand_is_preemptable_and_places():
+    """The narrowed waiver end-to-end: a priority band demanding ONE
+    discrete generic kind evicts a lower-priority holder of that kind
+    (victims free generics too, not just cpu/memory)."""
+    from swarmkit_tpu.models.types import (
+        GenericResource, GenericResourceKind,
+    )
+    store = MemoryStore()
+    gpu = [GenericResource(kind="gpu", value=2,
+                           res_type=GenericResourceKind.DISCRETE)]
+
+    def mk(tx):
+        tx.create(Node(
+            id="n0", spec=NodeSpec(annotations=Annotations(name="n0")),
+            status=NodeStatus(state=NodeState.READY),
+            description=NodeDescription(
+                hostname="n0",
+                resources=Resources(nano_cpus=8 * 10 ** 9,
+                                    memory_bytes=16 * GB,
+                                    generic=list(gpu)))))
+        lo_spec = TaskSpec(priority=0, resources=ResourceRequirements(
+            reservations=Resources(nano_cpus=CPU, generic=list(gpu))))
+        hi_spec = TaskSpec(priority=9, resources=ResourceRequirements(
+            reservations=Resources(nano_cpus=CPU, generic=list(gpu))))
+        for sid, spec in (("g-lo", lo_spec), ("g-hi", hi_spec)):
+            tx.create(Service(
+                id=sid, spec=ServiceSpec(
+                    annotations=Annotations(name=sid),
+                    mode=ServiceMode.REPLICATED,
+                    replicated=ReplicatedService(replicas=1), task=spec),
+                spec_version=Version(index=1)))
+        assert hp.preemptable_group(Task(spec=hi_spec))
+        tx.create(Task(id="g-lo-r0", service_id="g-lo", slot=1,
+                       desired_state=TaskState.RUNNING, spec=lo_spec,
+                       spec_version=Version(index=1), node_id="n0",
+                       status=TaskStatus(state=TaskState.RUNNING,
+                                         timestamp=now())))
+        tx.create(Task(id="g-hi-p0", service_id="g-hi", slot=1,
+                       desired_state=TaskState.RUNNING, spec=hi_spec,
+                       spec_version=Version(index=1),
+                       status=TaskStatus(state=TaskState.PENDING,
+                                         timestamp=now())))
+    store.update(mk)
+    sched = Scheduler(store)
+    store.view(sched._setup_tasks_list)
+    sched.tick()
+    tasks = {t.id: t for t in store.view(lambda tx: tx.find(Task))}
+    assert tasks["g-lo-r0"].desired_state == TaskState.SHUTDOWN
+    assert tasks["g-hi-p0"].node_id == "n0"
+    assert sched.stats["preemptions"] == 1
+
+
+def test_multi_kind_generic_demand_still_waived():
+    from swarmkit_tpu.models.types import (
+        GenericResource, GenericResourceKind,
+    )
+    t = Task(spec=TaskSpec(
+        priority=5,
+        resources=ResourceRequirements(reservations=Resources(
+            nano_cpus=CPU,
+            generic=[GenericResource(kind="gpu", value=1),
+                     GenericResource(kind="fpga", value=1)]))))
+    assert not hp.preemptable_group(t)
+    named = Task(spec=TaskSpec(
+        priority=5,
+        resources=ResourceRequirements(reservations=Resources(
+            generic=[GenericResource(
+                kind="gpu", value_str="gpu-0",
+                res_type=GenericResourceKind.NAMED)]))))
+    assert not hp.preemptable_group(named)
 
 
 def test_device_and_host_schedulers_place_identically():
@@ -357,7 +441,7 @@ def test_breaker_open_routes_selection_to_host():
     planner = TPUPlanner()
     for _ in range(planner.breaker.threshold):
         planner.breaker.record_failure()
-    assert planner.select_victims(None, CPU, GB, 1, 8) is None
+    assert planner.select_victims(None, CPU, GB, 0, 1, 8) is None
     assert planner.stats.get("preempt_breaker_to_host", 0) >= 1
 
 
