@@ -670,8 +670,13 @@ def run_live_manager(planner_factory, external_firehose=False,
         t.start()
 
     try:
+        from swarmkit_tpu import native as _native
+        from swarmkit_tpu.utils.metrics import registry as _registry
         planner = planner_factory()
         snap = _planner_counter_snapshot()
+        fanout_timer = _registry.timer("swarm_watch_fanout_latency")
+        fanout0 = fanout_timer.total
+        fallbacks0 = _registry.get_counter("swarm_native_commit_fallbacks")
         sched, n_dec, dt = one_tick(store, planner)
         routed = _planner_counter_delta(snap)
         time.sleep(0.2)   # let consumers drain the tail
@@ -699,6 +704,23 @@ def run_live_manager(planner_factory, external_firehose=False,
             "tick_s": round(dt, 3),
             "plan_s": round(planner.stats["plan_seconds"], 3),
             "commit_s": round(sched.stats["commit_seconds"], 3),
+            # commit-plane headline fields (ISSUE 13): the commit phase
+            # wall, the watch fan-out synthesis cost (consumer side,
+            # includes the drain tail), and whether the native commit
+            # plane held (a fallback tick inside the timed window means
+            # it silently ran Python — bench_compare gates on it)
+            "commit_phase_s": round(sched.stats["commit_seconds"], 3),
+            "fanout_s": round(fanout_timer.total - fanout0, 3),
+            "native_commit": {
+                # enabled = the escape hatch (SWARM_NATIVE_COMMIT) was
+                # not pulled; active = the C module actually loaded.
+                # enabled-but-inactive or any fallback tick inside the
+                # timed window fails bench_compare's native-commit gate.
+                "enabled": _native.commit_enabled(),
+                "active": _native.get() is not None,
+                "fallbacks": int(_registry.get_counter(
+                    "swarm_native_commit_fallbacks") - fallbacks0),
+            },
             "fallback_groups": routed["groups_fallback"],
             "groups_fused": routed["groups_fused"],
             "mesh_devices": (planner.mesh.shape["nodes"]
@@ -1404,6 +1426,18 @@ def main():
             "plan_commit_overlap_s", 0.0),
         "plan_hidden_frac": overlap_tbl.get("plan_hidden_frac", 0.0),
         "plan_overlap_source": overlap_src,
+        # commit-plane headline (ISSUE 13): fraction of the commit wall
+        # hidden behind the plan, fan-out synthesis cost, and whether
+        # the native commit plane held in the live-manager window
+        "commit_hidden_frac": overlap_tbl.get("commit_hidden_frac", 0.0),
+        "fanout_s": next(
+            (configs[c]["fanout_s"] for c in
+             ("6_live_manager_2x100k_x_10k", "7_many_service_10x")
+             if c in configs and "fanout_s" in configs[c]), None),
+        "native_commit": next(
+            (configs[c]["native_commit"] for c in
+             ("6_live_manager_2x100k_x_10k", "7_many_service_10x")
+             if c in configs and "native_commit" in configs[c]), None),
         "health": health,
         "phase_table": tables,
         "configs": configs,
@@ -1436,6 +1470,10 @@ def _append_history(artifact):
         "plan_commit_overlap_s": artifact["plan_commit_overlap_s"],
         "plan_hidden_frac": artifact["plan_hidden_frac"],
         "plan_overlap_source": artifact["plan_overlap_source"],
+        "commit_phase_s": artifact["commit_phase_s"],
+        "commit_hidden_frac": artifact.get("commit_hidden_frac"),
+        "fanout_s": artifact.get("fanout_s"),
+        "native_commit": artifact.get("native_commit"),
         "configs": {
             name: {
                 "decisions_per_sec": cfg.get("decisions_per_sec"),
@@ -1445,6 +1483,9 @@ def _append_history(artifact):
                 "shape_cost_x": cfg.get("shape_cost_x"),
                 "preemptions": cfg.get("preemptions"),
                 "quota_clamps": cfg.get("quota_clamps"),
+                "commit_phase_s": cfg.get("commit_phase_s"),
+                "fanout_s": cfg.get("fanout_s"),
+                "native_commit": cfg.get("native_commit"),
             }
             for name, cfg in artifact["configs"].items()},
     }

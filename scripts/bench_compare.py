@@ -41,6 +41,7 @@ def _norm(doc):
     {"headline": dps, "configs": {name: dps}} plus context fields."""
     configs, shape_cost, compiles, preempts = {}, {}, {}, {}
     quota_clamps = {}
+    commit_phase, native_commit = {}, {}
     for name, cfg in (doc.get("configs") or {}).items():
         dps = cfg.get("decisions_per_sec")
         if dps:
@@ -51,8 +52,16 @@ def _norm(doc):
             preempts[name] = int(cfg["preemptions"])
         if cfg.get("quota_clamps") is not None:
             quota_clamps[name] = int(cfg["quota_clamps"])
+        if cfg.get("commit_phase_s") is not None:
+            commit_phase[name] = float(cfg["commit_phase_s"])
+        if cfg.get("native_commit") is not None:
+            native_commit[name] = cfg["native_commit"]
         compiles[name] = _compiles(cfg.get("compiles"))
     return {
+        # commit-plane fields (ISSUE 13): per-config commit wall and the
+        # native-plane evidence dict ({enabled, active, fallbacks})
+        "commit_phase_s": commit_phase,
+        "native_commit": native_commit,
         "headline": float(doc.get("value") or 0.0),
         "configs": configs,
         "shape_cost_x": shape_cost,
@@ -261,6 +270,36 @@ def main(argv=None) -> int:
                   "its timed window", file=sys.stderr)
             gate_failures.append(("quota-compile-growth",
                                   f"{_QOS_CFG} compiles={cfg9_compiles}"))
+    # commit-plane gates (ISSUE 13), judged on the live-manager configs:
+    # (a) the commit phase regressing >20% wall-clock loses the columnar
+    # plane's win even while decisions/s still clears the threshold;
+    # (b) a run whose native commit plane was enabled but inactive — or
+    # that counted fallback ticks inside the timed window — silently ran
+    # the Python oracle and must not pass as evidence.
+    for name in _LIVE_CFGS:
+        cp_old = old.get("commit_phase_s", {}).get(name)
+        cp_new = new.get("commit_phase_s", {}).get(name)
+        if cp_old is not None or cp_new is not None:
+            print(f"commit_phase_s[{name}]: {cp_old} -> {cp_new}")
+        if cp_old and cp_new and cp_new > cp_old * (1.0 + 0.20):
+            print(f"\n{name} commit_phase_s regressed "
+                  f"{cp_old} -> {cp_new} (>20%)", file=sys.stderr)
+            gate_failures.append(
+                ("commit-phase-regression",
+                 f"{name} commit_phase_s {cp_old}->{cp_new}"))
+        nc = new.get("native_commit", {}).get(name)
+        if nc:
+            print(f"native_commit[{name}]: enabled={nc.get('enabled')} "
+                  f"active={nc.get('active')} "
+                  f"fallbacks={nc.get('fallbacks')}")
+            if nc.get("enabled") and (
+                    not nc.get("active") or nc.get("fallbacks")):
+                print(f"\n{name}: native commit plane fell back to "
+                      "Python inside the timed window", file=sys.stderr)
+                gate_failures.append(
+                    ("native-commit-fallback",
+                     f"{name} active={nc.get('active')} "
+                     f"fallbacks={nc.get('fallbacks')}"))
     # compile-flatness gate: XLA compiles inside timed regions must not
     # GROW — warm-up covers every signature a config touches, so any
     # growth means a new shape leaked into a timed window.  Judged over

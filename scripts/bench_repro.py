@@ -11,8 +11,13 @@ carries all three and ``bench_compare.py --history`` can diff them.
 Bar (each configurable):
   * cfg6 decisions/sec        >= --min-dps        (default 220_000)
   * cfg6 shape_cost_x         <= --max-shape-cost (default 1.5)
-  * artifact plan_hidden_frac >  --min-hidden     (default 0.3; only
-    enforced while the pipeline is on, i.e. pipeline_depth > 1)
+  * artifact plan_hidden_frac >  --min-hidden     (default 0.15; only
+    enforced while the pipeline is on, i.e. pipeline_depth > 1 —
+    lowered from 0.3 when the columnar commit plane shrank the commit
+    wall the plan used to hide behind)
+  * cfg6 commit_phase_s       <= --max-commit-s   (default 0.665 =
+    0.5x the r06 commit wall, the ISSUE 13 acceptance bar)
+  * cfg6 native_commit must not have fallen back to Python
 
 Exit status: 0 when every repeat holds the bar, 1 otherwise.
 
@@ -65,6 +70,8 @@ def check(artifact, args):
     shape = cfg6.get("shape_cost_x")
     hidden = artifact.get("plan_hidden_frac", 0.0)
     depth = artifact.get("pipeline_depth", 1)
+    commit_s = cfg6.get("commit_phase_s")
+    native = cfg6.get("native_commit") or {}
     problems = []
     if dps < args.min_dps:
         problems.append(f"cfg6 {dps:,.0f} dec/s < {args.min_dps:,.0f}")
@@ -74,9 +81,17 @@ def check(artifact, args):
         problems.append(
             f"plan_hidden_frac {hidden} <= {args.min_hidden} with the "
             f"pipeline on (depth {depth})")
+    if commit_s is not None and commit_s > args.max_commit_s:
+        problems.append(
+            f"cfg6 commit_phase_s {commit_s} > {args.max_commit_s}")
+    if native.get("enabled") and (not native.get("active")
+                                  or native.get("fallbacks")):
+        problems.append(
+            f"native commit plane fell back to Python ({native})")
     row = {"headline": artifact.get("value"), "cfg6_dps": dps,
            "shape_cost_x": shape, "plan_hidden_frac": hidden,
-           "pipeline_depth": depth}
+           "pipeline_depth": depth, "commit_phase_s": commit_s,
+           "native_commit": native}
     return row, problems
 
 
@@ -88,9 +103,17 @@ def main(argv=None) -> int:
                    help="cfg6 decisions/sec floor (default 220000)")
     p.add_argument("--max-shape-cost", type=float, default=1.5,
                    help="cfg6 shape_cost_x ceiling (default 1.5)")
-    p.add_argument("--min-hidden", type=float, default=0.3,
+    p.add_argument("--min-hidden", type=float, default=0.15,
                    help="plan_hidden_frac floor while pipelined "
-                        "(default 0.3)")
+                        "(default 0.15; was 0.3 before the columnar "
+                        "commit plane — a 3x-smaller commit phase "
+                        "leaves less wall to hide the plan behind, so "
+                        "the overlap fraction legitimately shrank "
+                        "while the tick got strictly faster)")
+    p.add_argument("--max-commit-s", type=float, default=0.665,
+                   help="cfg6 commit_phase_s ceiling (default 0.665 = "
+                        "0.5x the r06 commit wall — the ISSUE 13 "
+                        "acceptance bar)")
     args = p.parse_args(argv)
 
     failures = 0
@@ -102,6 +125,7 @@ def main(argv=None) -> int:
               f"cfg6={row['cfg6_dps']:,.0f} dec/s  "
               f"shape_cost_x={row['shape_cost_x']}  "
               f"plan_hidden_frac={row['plan_hidden_frac']}  "
+              f"commit_phase_s={row['commit_phase_s']}  "
               f"depth={row['pipeline_depth']}")
         for prob in problems:
             print(f"  - {prob}", file=sys.stderr)

@@ -81,6 +81,9 @@ _FIXED_COMPONENT = {
     "preempt-burst": "scheduler",
     "autoscale-burst": "scheduler", "quota-clamp": "scheduler",
     "stale-read-probe": "read-plane", "read-storm": "read-plane",
+    # columnar commit plane: logged once per raft-attached run when a
+    # binary block entry rides consensus with the native decode active
+    "native-commit-plane": "store",
     "cut": "network", "heal": "network", "split": "network",
     "heal-all": "network", "drop": "network", "drop-burst": "network",
     "clock-skew": "clock",
@@ -147,7 +150,15 @@ REQUIRED_CELLS: Dict[str, Set[Tuple[str, str]]] = {
     "preemption-storm": {
         ("preempt-burst", "scheduler"), ("agent-crash", "agent"),
         ("agent-restart", "agent"), ("stepdown", "manager"),
-        ("drop", "network")},
+        ("drop", "network"),
+        # the raft-attached scheduler's block commits must ride the
+        # NATIVE columnar commit plane (ISSUE 13) — an empty cell means
+        # it silently fell back to the Python oracle sweep-wide
+        ("native-commit-plane", "store")},
+    # fused-vs-per-service differential under churn, now also the
+    # columnar-commit-plane coverage anchor for the fuzz suite
+    "fused-differential-churn": {
+        ("native-commit-plane", "store")},
     # autoscaler + tenant QoS: the burst is injected, but the
     # quota-clamp cell is logged only when the scheduler ACTUALLY
     # clamped — a suite edit that stops clamping empties the cell

@@ -7,6 +7,23 @@ set -o pipefail
 
 cd "$(dirname "$0")/.."
 
+echo "== native hotpath freshness (hash check + rebuild) =="
+# the committed .so must match the committed hotpath.c: rebuild when the
+# source hash stamp disagrees, and FAIL if it still disagrees afterwards
+# (a stale .so silently serving old semantics is a correctness bug, not
+# a perf nit — the commit plane's fallback counter would hide it)
+SRC_SHA=$(sha256sum swarmkit_tpu/native/hotpath.c | cut -d' ' -f1)
+STAMP_FILE=swarmkit_tpu/native/_hotpath.src.sha256
+if [ "$(cat "$STAMP_FILE" 2>/dev/null | tr -d '[:space:]')" != "$SRC_SHA" ]; then
+    echo "stale or missing native stamp; rebuilding _hotpath"
+    (cd swarmkit_tpu/native && python build.py) >/dev/null 2>&1
+fi
+if [ "$(cat "$STAMP_FILE" 2>/dev/null | tr -d '[:space:]')" != "$SRC_SHA" ]; then
+    echo "FAIL: _hotpath .so is stale vs hotpath.c and rebuild did not fix it"
+    exit 1
+fi
+
+echo
 echo "== swarmlint (scripts/swarmlint.py) =="
 python scripts/swarmlint.py || exit 1
 

@@ -43,14 +43,20 @@ NO_BLOCK_UNDER: Dict[str, Set[str]] = {
         "propose", "propose_async", "wait_proposal", "fetch_group",
         "dispatch_group", "schedule_group", "device_get",
         "block_until_ready", "sleep", "read_barrier",
+        "fanout_expand", "expand_events",
     },
     # read_barrier under the UPDATE lock deadlocks a follower outright:
     # the barrier waits for remote applies, and apply_store_actions
     # needs the update lock the waiter is holding.  (propose/wait under
-    # it remain the sanctioned leader commit path.)
+    # it remain the sanctioned leader commit path.)  The GIL-released
+    # native watch fan-out (fanout_expand / its expand_events wrapper,
+    # ISSUE 13) is consumer-thread work by contract: under the WRITER
+    # lock it would tax every committer with O(block) synthesis the
+    # coalesced-event design exists to avoid.
     "MemoryStore._update_lock": {
         "fetch_group", "dispatch_group", "schedule_group",
         "device_get", "block_until_ready", "sleep", "read_barrier",
+        "fanout_expand", "expand_events",
     },
 }
 

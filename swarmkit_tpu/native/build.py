@@ -1,11 +1,21 @@
 """Build the _hotpath C extension in place (invoked as a subprocess by
-swarmkit_tpu.native on first import; see __init__.py)."""
+swarmkit_tpu.native on first import; see __init__.py).
 
+After a successful build the source hash is stamped next to the .so
+(``_hotpath.src.sha256``): the loader and ``scripts/ci_check.sh`` both
+compare it against the current ``hotpath.c`` so a stale prebuilt .so can
+never silently serve an edited source file.
+"""
+
+import hashlib
 import os
 
 from setuptools import Extension, setup
 
-os.chdir(os.path.dirname(os.path.abspath(__file__)))
+HERE = os.path.dirname(os.path.abspath(__file__))
+STAMP = os.path.join(HERE, "_hotpath.src.sha256")
+
+os.chdir(HERE)
 
 setup(
     name="swarmkit-tpu-hotpath",
@@ -14,3 +24,8 @@ setup(
         Extension("_hotpath", ["hotpath.c"], extra_compile_args=["-O2"])
     ],
 )
+
+with open(os.path.join(HERE, "hotpath.c"), "rb") as f:
+    digest = hashlib.sha256(f.read()).hexdigest()
+with open(STAMP, "w") as f:
+    f.write(digest + "\n")

@@ -11,6 +11,24 @@
  *                    (replaces the per-task half of store.bulk_update_tasks)
  *   commit_apply   - install stamped tasks into the store table + indexes
  *
+ * The columnar commit plane (ISSUE 13) extends the seam to the other
+ * side of consensus and to watch delivery:
+ *
+ *   block_decode         - parse the compact binary task-block raft
+ *                          entry (serde.BLOCK_ENTRY_MAGIC) into a
+ *                          TaskBlockAction; the byte scan runs with the
+ *                          GIL released
+ *   block_apply_follower - follower-side apply of a decoded block into
+ *                          the task overlay + by_node index, one batched
+ *                          index pass per chunk
+ *   fanout_expand        - synthesize the per-task watch Events of one
+ *                          EventTaskBlock (the Python oracle is
+ *                          events.EventTaskBlock.expand_events)
+ *   fanout_filter        - per-subscriber predicate pre-filter over an
+ *                          expanded event list
+ *   per_node_group       - node_id -> [(old, version)] grouping for
+ *                          block-aware dispatcher sessions
+ *
  * Semantics are identical to the pure-Python implementations, which remain
  * as fallbacks (and as the differential-test oracle).  The reference has no
  * native code (SURVEY.md section 2); this is a deliberate tpu-framework
@@ -22,11 +40,13 @@
  */
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <stdint.h>
+#include <string.h>
 
 static PyObject *s_dict, *s_meta, *s_version, *s_index, *s_created_at,
     *s_updated_at, *s_status, *s_node_id, *s_networks, *s_volumes, *s_agr,
     *s_id, *s_state, *s_message, *s_err, *s_service_id, *s_slot, *s_old,
-    *s_new, *s_update;
+    *s_new, *s_update, *s_task_block;
 static PyObject *empty_tuple;
 
 static PyObject *
@@ -1086,6 +1106,581 @@ fail:
     return NULL;
 }
 
+/* ------------------------------------------------------------------ *
+ * Columnar commit plane (ISSUE 13): binary block entries, follower    *
+ * apply, and native watch fan-out.                                   *
+ * ------------------------------------------------------------------ */
+
+/* Little-endian readers over an untrusted byte buffer.  The container
+ * targets x86_64; plain memcpy reads are both alignment-safe and
+ * little-endian there (serde.block_to_bytes writes `<` struct codes). */
+static uint32_t
+rd_u32(const char *p)
+{
+    uint32_t v;
+    memcpy(&v, p, 4);
+    return v;
+}
+
+static int64_t
+rd_i64(const char *p)
+{
+    int64_t v;
+    memcpy(&v, p, 8);
+    return v;
+}
+
+static int32_t
+rd_i32(const char *p)
+{
+    int32_t v;
+    memcpy(&v, p, 4);
+    return v;
+}
+
+static double
+rd_f64(const char *p)
+{
+    double v;
+    memcpy(&v, p, 8);
+    return v;
+}
+
+/* Split a NUL-joined blob of `count` strings into a fresh tuple.  The
+ * offset scan runs with the GIL released (pure byte work); the string
+ * objects are built afterwards under the GIL. */
+static PyObject *
+split_nul_blob(const char *blob, Py_ssize_t len, Py_ssize_t count)
+{
+    if (count == 0) {
+        if (len != 0) {
+            PyErr_SetString(PyExc_ValueError, "block: dangling blob");
+            return NULL;
+        }
+        return PyTuple_New(0);
+    }
+    Py_ssize_t *offs = PyMem_Malloc((count + 1) * sizeof(Py_ssize_t));
+    if (!offs)
+        return PyErr_NoMemory();
+    Py_ssize_t found = 0;
+    int ok = 1;
+    Py_BEGIN_ALLOW_THREADS
+    offs[0] = 0;
+    found = 1;
+    const char *p = blob;
+    const char *end = blob + len;
+    for (; p < end && found < count;) {
+        const char *nul = memchr(p, '\0', end - p);
+        if (nul == NULL)
+            break;
+        offs[found++] = (nul - blob) + 1;
+        p = nul + 1;
+    }
+    offs[count] = len + 1;  /* sentinel: final string ends at len */
+    if (found != count)
+        ok = 0;
+    else if (memchr(p, '\0', end - p) != NULL)
+        /* extra separators beyond count-1: the Python oracle's split()
+         * would yield more strings and raise — match it exactly */
+        ok = 0;
+    Py_END_ALLOW_THREADS
+    if (!ok) {
+        PyMem_Free(offs);
+        PyErr_SetString(PyExc_ValueError, "block: string count mismatch");
+        return NULL;
+    }
+    PyObject *out = PyTuple_New(count);
+    if (!out) {
+        PyMem_Free(offs);
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < count; i++) {
+        Py_ssize_t start = offs[i];
+        Py_ssize_t stop = offs[i + 1] - 1;  /* drop the separator */
+        PyObject *s = PyUnicode_DecodeUTF8(blob + start, stop - start,
+                                           "strict");
+        if (!s) {
+            PyMem_Free(offs);
+            Py_DECREF(out);
+            return NULL;
+        }
+        PyTuple_SET_ITEM(out, i, s);
+    }
+    PyMem_Free(offs);
+    return out;
+}
+
+/* block_decode(data: bytes, taskblock_cls) -> TaskBlockAction
+ *
+ * Parse the compact binary task-block raft entry (layout documented in
+ * state/serde.py block_to_bytes, magic "SKB1") straight into a
+ * TaskBlockAction — no JSON dicts, no per-item Python loop on the
+ * caller's side.  The differential oracle is serde.block_from_bytes. */
+static PyObject *
+block_decode(PyObject *self, PyObject *args)
+{
+    Py_buffer buf;
+    PyObject *cls;
+    if (!PyArg_ParseTuple(args, "y*O", &buf, &cls))
+        return NULL;
+    const char *p = buf.buf;
+    Py_ssize_t len = buf.len;
+    PyObject *ids = NULL, *msg = NULL, *nids = NULL, *out = NULL;
+    PyObject *runs = NULL;
+#define NEED(nbytes)                                                          \
+    do {                                                                      \
+        if (len - off < (Py_ssize_t)(nbytes)) {                               \
+            PyErr_SetString(PyExc_ValueError, "block: truncated entry");      \
+            goto done;                                                        \
+        }                                                                     \
+    } while (0)
+    Py_ssize_t off = 0;
+    NEED(32);
+    if (memcmp(p, "SKB1", 4) != 0) {
+        PyErr_SetString(PyExc_ValueError, "block: bad magic");
+        goto done;
+    }
+    uint32_t n = rd_u32(p + 4);
+    int64_t base = rd_i64(p + 8);
+    int32_t state = rd_i32(p + 16);
+    double ts = rd_f64(p + 20);
+    uint32_t msg_len = rd_u32(p + 28);
+    off = 32;
+    NEED(msg_len);
+    msg = PyUnicode_DecodeUTF8(p + off, msg_len, "strict");
+    if (!msg)
+        goto done;
+    off += msg_len;
+    NEED(4);
+    uint32_t ids_len = rd_u32(p + off);
+    off += 4;
+    NEED(ids_len);
+    ids = split_nul_blob(p + off, ids_len, n);
+    if (!ids)
+        goto done;
+    off += ids_len;
+    NEED(4);
+    uint32_t n_runs = rd_u32(p + off);
+    off += 4;
+    NEED((size_t)n_runs * 4 + 4);
+    const char *counts = p + off;
+    off += (Py_ssize_t)n_runs * 4;
+    uint32_t nid_len = rd_u32(p + off);
+    off += 4;
+    NEED(nid_len);
+    runs = split_nul_blob(p + off, nid_len, n_runs);
+    if (!runs)
+        goto done;
+    off += nid_len;
+    if (off != len) {
+        PyErr_SetString(PyExc_ValueError, "block: trailing bytes");
+        goto done;
+    }
+    /* expand the node-id runs into the full n-length column */
+    nids = PyTuple_New(n);
+    if (!nids)
+        goto done;
+    {
+        Py_ssize_t k = 0;
+        for (uint32_t r = 0; r < n_runs; r++) {
+            uint32_t cnt = rd_u32(counts + (size_t)r * 4);
+            PyObject *nid = PyTuple_GET_ITEM(runs, r);
+            for (uint32_t c = 0; c < cnt; c++) {
+                if (k >= (Py_ssize_t)n) {
+                    PyErr_SetString(PyExc_ValueError,
+                                    "block: run counts exceed n");
+                    goto done;
+                }
+                Py_INCREF(nid);
+                PyTuple_SET_ITEM(nids, k++, nid);
+            }
+        }
+        if (k != (Py_ssize_t)n) {
+            PyErr_SetString(PyExc_ValueError,
+                            "block: run counts short of n");
+            goto done;
+        }
+    }
+    {
+        PyObject *base_obj = PyLong_FromLongLong(base);
+        PyObject *state_obj = PyLong_FromLong(state);
+        PyObject *ts_obj = PyFloat_FromDouble(ts);
+        if (base_obj && state_obj && ts_obj)
+            out = PyObject_CallFunctionObjArgs(
+                cls, s_task_block, ids, nids, base_obj, state_obj, msg,
+                ts_obj, NULL);
+        Py_XDECREF(base_obj);
+        Py_XDECREF(state_obj);
+        Py_XDECREF(ts_obj);
+    }
+done:
+    Py_XDECREF(ids);
+    Py_XDECREF(msg);
+    Py_XDECREF(nids);
+    Py_XDECREF(runs);
+    PyBuffer_Release(&buf);
+    return out;
+#undef NEED
+}
+
+/* block_apply_follower(ids, node_ids, objects, overlay, by_node, ts,
+ *                      state, message, base_version) -> olds list | None
+ *
+ * Follower-side fast path of MemoryStore._apply_task_block_locked: when
+ * EVERY id resolves to a stored object and none has a pending overlay
+ * entry (the healthy-log case), install the overlay tuples and maintain
+ * the by_node index in ONE batched pass per chunk (run-cached bucket,
+ * insertion order preserved) and return the pre-assignment stored tasks
+ * in block order.  Any miss returns None untouched — the Python loop
+ * then runs the full per-item semantics (materialization, skipped-id
+ * contiguity handling). */
+static PyObject *
+block_apply_follower(PyObject *self, PyObject *args)
+{
+    PyObject *ids, *node_ids, *objects, *overlay, *by_node;
+    PyObject *ts, *state, *message;
+    long long base;
+    if (!PyArg_ParseTuple(args, "OOO!O!O!OOOL", &ids, &node_ids,
+                          &PyDict_Type, &objects, &PyDict_Type, &overlay,
+                          &PyDict_Type, &by_node, &ts, &state, &message,
+                          &base))
+        return NULL;
+    PyObject *ids_f = PySequence_Fast(ids, "ids must be a sequence");
+    if (!ids_f)
+        return NULL;
+    PyObject *nids_f = PySequence_Fast(node_ids,
+                                       "node_ids must be a sequence");
+    if (!nids_f) {
+        Py_DECREF(ids_f);
+        return NULL;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(ids_f);
+    PyObject *olds = NULL;
+    if (PySequence_Fast_GET_SIZE(nids_f) != n) {
+        PyErr_SetString(PyExc_ValueError, "ids/node_ids mismatch");
+        goto fail;
+    }
+    /* screen: every id stored, none overlaid — else the Python slow
+     * path owns the whole block (mixed fast/slow would reorder the
+     * version assignment the changelog contract pins) */
+    olds = PyList_New(n);
+    if (!olds)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *tid = PySequence_Fast_GET_ITEM(ids_f, i);
+        PyObject *cur = PyDict_GetItemWithError(objects, tid);
+        if (!cur) {
+            if (PyErr_Occurred())
+                goto fail;
+            Py_DECREF(olds);
+            Py_DECREF(ids_f);
+            Py_DECREF(nids_f);
+            Py_RETURN_NONE;
+        }
+        int in_overlay = PyDict_Contains(overlay, tid);
+        if (in_overlay < 0)
+            goto fail;
+        if (in_overlay) {
+            Py_DECREF(olds);
+            Py_DECREF(ids_f);
+            Py_DECREF(nids_f);
+            Py_RETURN_NONE;
+        }
+        Py_INCREF(cur);
+        PyList_SET_ITEM(olds, i, cur);
+    }
+    /* apply: overlay entries + one batched by_node pass (run-cached) */
+    {
+        PyObject *run_nid = NULL;
+        PyObject *run_set = NULL;
+        long long seq = base;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *tid = PySequence_Fast_GET_ITEM(ids_f, i);
+            PyObject *nid = PySequence_Fast_GET_ITEM(nids_f, i);
+            seq++;
+            PyObject *ver = PyLong_FromLongLong(seq);
+            if (!ver)
+                goto fail;
+            PyObject *entry = PyTuple_Pack(5, nid, ver, ts, state,
+                                           message);
+            Py_DECREF(ver);
+            if (!entry || PyDict_SetItem(overlay, tid, entry) < 0) {
+                Py_XDECREF(entry);
+                goto fail;
+            }
+            Py_DECREF(entry);
+            PyObject *cur = PyList_GET_ITEM(olds, i);
+            PyObject **cdp = _PyObject_GetDictPtr(cur);
+            PyObject *onid = (cdp && *cdp)
+                ? PyDict_GetItem(*cdp, s_node_id) : NULL;
+            if (onid && PyObject_IsTrue(onid) && onid != nid) {
+                int eq = dict_vals_equal(onid, nid);
+                if (eq < 0)
+                    goto fail;
+                if (!eq) {
+                    PyObject *os = PyDict_GetItem(by_node, onid);
+                    if (os && bucket_discard(os, tid) < 0)
+                        goto fail;
+                }
+            }
+            if (nid != run_nid) {
+                run_nid = nid;
+                run_set = NULL;
+                if (PyObject_IsTrue(nid)) {
+                    run_set = PyDict_GetItem(by_node, nid);
+                    if (!run_set) {
+                        PyObject *fresh = PyDict_New();
+                        if (!fresh ||
+                            PyDict_SetItem(by_node, nid, fresh) < 0) {
+                            Py_XDECREF(fresh);
+                            goto fail;
+                        }
+                        Py_DECREF(fresh);
+                        run_set = PyDict_GetItem(by_node, nid);
+                    }
+                }
+            }
+            if (run_set && PyDict_SetItem(run_set, tid, Py_None) < 0)
+                goto fail;
+        }
+    }
+    Py_DECREF(ids_f);
+    Py_DECREF(nids_f);
+    return olds;
+fail:
+    Py_XDECREF(olds);
+    Py_DECREF(ids_f);
+    Py_DECREF(nids_f);
+    return NULL;
+}
+
+/* fanout_expand(olds, node_ids, base_version, ts, status, event_cls)
+ *   -> list[Event]
+ *
+ * Synthesize the per-task update Events of one EventTaskBlock in a
+ * single native pass: clone each pre-assignment task (Task.copy
+ * semantics — shared spec, isolated meta/status/list containers), stamp
+ * node_id / the shared assigned status / version base+1+i /
+ * updated_at=ts, and wrap it in event_cls("update", new, old).  The
+ * pure-Python oracle is EventTaskBlock.expand_events; `status` is the
+ * TaskStatus every materialized task shares (same value the oracle
+ * builds per task — plan_apply's shared-status precedent). */
+static PyObject *
+fanout_expand(PyObject *self, PyObject *args)
+{
+    PyObject *olds, *node_ids, *ts, *status, *event_cls;
+    long long base;
+    if (!PyArg_ParseTuple(args, "OOLOOO", &olds, &node_ids, &base, &ts,
+                          &status, &event_cls))
+        return NULL;
+    PyObject *olds_f = PySequence_Fast(olds, "olds must be a sequence");
+    if (!olds_f)
+        return NULL;
+    PyObject *nids_f = PySequence_Fast(node_ids,
+                                       "node_ids must be a sequence");
+    if (!nids_f) {
+        Py_DECREF(olds_f);
+        return NULL;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(olds_f);
+    PyObject *out = NULL;
+    if (PySequence_Fast_GET_SIZE(nids_f) != n) {
+        PyErr_SetString(PyExc_ValueError, "olds/node_ids mismatch");
+        goto fail;
+    }
+    out = PyList_New(n);
+    if (!out)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *old = PySequence_Fast_GET_ITEM(olds_f, i);
+        PyObject *nid = PySequence_Fast_GET_ITEM(nids_f, i);
+        PyObject *d = NULL;
+        PyObject *nt = shallow_clone(old, &d);
+        if (!nt)
+            goto fail;
+        PyObject *meta = PyDict_GetItem(d, s_meta);
+        PyObject *nm = NULL;
+        if (meta) {
+            nm = clone_meta(meta);
+            if (!nm || PyDict_SetItem(d, s_meta, nm) < 0)
+                goto item_fail;
+        }
+        if (PyDict_SetItem(d, s_status, status) < 0 ||
+            PyDict_SetItem(d, s_node_id, nid) < 0 ||
+            copy_list_field(d, s_networks) < 0 ||
+            copy_list_field(d, s_volumes) < 0 ||
+            copy_list_field(d, s_agr) < 0)
+            goto item_fail;
+        if (nm) {
+            PyObject *nv = PyObject_GetAttr(nm, s_version);
+            PyObject *ver = PyLong_FromLongLong(base + 1 + i);
+            int err = !nv || !ver ||
+                      PyObject_SetAttr(nv, s_index, ver) < 0 ||
+                      PyObject_SetAttr(nm, s_updated_at, ts) < 0;
+            Py_XDECREF(nv);
+            Py_XDECREF(ver);
+            if (err)
+                goto item_fail;
+        }
+        {
+            PyObject *ev = PyObject_CallFunctionObjArgs(
+                event_cls, s_update, nt, old, NULL);
+            if (!ev)
+                goto item_fail;
+            PyList_SET_ITEM(out, i, ev);
+        }
+        Py_XDECREF(nm);
+        Py_DECREF(d);
+        Py_DECREF(nt);
+        continue;
+    item_fail:
+        Py_XDECREF(nm);
+        Py_XDECREF(d);
+        Py_DECREF(nt);
+        goto fail;
+    }
+    Py_DECREF(olds_f);
+    Py_DECREF(nids_f);
+    return out;
+fail:
+    Py_XDECREF(out);
+    Py_DECREF(olds_f);
+    Py_DECREF(nids_f);
+    return NULL;
+}
+
+/* fanout_filter(events, predicate) -> list
+ *
+ * Per-subscriber predicate pre-filter over an expanded event list: one
+ * tight native loop instead of a Python-level comprehension per
+ * subscriber.  A predicate exception drops only the offending event —
+ * the same granularity as Subscription._expand's Python fallback. */
+static PyObject *
+fanout_filter(PyObject *self, PyObject *args)
+{
+    PyObject *events, *pred;
+    if (!PyArg_ParseTuple(args, "OO", &events, &pred))
+        return NULL;
+    PyObject *events_f = PySequence_Fast(events,
+                                         "events must be a sequence");
+    if (!events_f)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(events_f);
+    PyObject *out = PyList_New(0);
+    if (!out) {
+        Py_DECREF(events_f);
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *ev = PySequence_Fast_GET_ITEM(events_f, i);
+        PyObject *r = PyObject_CallOneArg(pred, ev);
+        if (!r) {
+            /* drop the offending event only — but, like the oracle's
+             * `except Exception`, let KeyboardInterrupt/SystemExit/
+             * MemoryError unwind instead of eating them */
+            if (!PyErr_ExceptionMatches(PyExc_Exception)) {
+                Py_DECREF(out);
+                Py_DECREF(events_f);
+                return NULL;
+            }
+            PyErr_Clear();
+            continue;
+        }
+        int keep = PyObject_IsTrue(r);
+        Py_DECREF(r);
+        if (keep < 0) {
+            if (!PyErr_ExceptionMatches(PyExc_Exception)) {
+                Py_DECREF(out);
+                Py_DECREF(events_f);
+                return NULL;
+            }
+            PyErr_Clear();   /* truthiness raised: drop the event */
+            keep = 0;
+        }
+        if (keep && PyList_Append(out, ev) < 0) {
+            Py_DECREF(out);
+            Py_DECREF(events_f);
+            return NULL;
+        }
+    }
+    Py_DECREF(events_f);
+    return out;
+}
+
+/* per_node_group(olds, node_ids, base_version) -> dict
+ *
+ * node_id -> [(old_task, version), ...] grouping of one block (the
+ * dispatcher sessions' O(1) membership probe), built in one native
+ * pass with a run-cached bucket.  Oracle: EventTaskBlock.per_node. */
+static PyObject *
+per_node_group(PyObject *self, PyObject *args)
+{
+    PyObject *olds, *node_ids;
+    long long base;
+    if (!PyArg_ParseTuple(args, "OOL", &olds, &node_ids, &base))
+        return NULL;
+    PyObject *olds_f = PySequence_Fast(olds, "olds must be a sequence");
+    if (!olds_f)
+        return NULL;
+    PyObject *nids_f = PySequence_Fast(node_ids,
+                                       "node_ids must be a sequence");
+    if (!nids_f) {
+        Py_DECREF(olds_f);
+        return NULL;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(olds_f);
+    PyObject *out = NULL;
+    if (PySequence_Fast_GET_SIZE(nids_f) != n) {
+        PyErr_SetString(PyExc_ValueError, "olds/node_ids mismatch");
+        goto fail;
+    }
+    out = PyDict_New();
+    if (!out)
+        goto fail;
+    {
+        PyObject *run_nid = NULL;
+        PyObject *run_lst = NULL;   /* borrowed */
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *nid = PySequence_Fast_GET_ITEM(nids_f, i);
+            if (nid != run_nid || run_lst == NULL) {
+                run_nid = nid;
+                run_lst = PyDict_GetItemWithError(out, nid);
+                if (!run_lst) {
+                    if (PyErr_Occurred())
+                        goto fail;
+                    PyObject *fresh = PyList_New(0);
+                    if (!fresh ||
+                        PyDict_SetItem(out, nid, fresh) < 0) {
+                        Py_XDECREF(fresh);
+                        goto fail;
+                    }
+                    Py_DECREF(fresh);
+                    run_lst = PyDict_GetItem(out, nid);
+                }
+            }
+            PyObject *ver = PyLong_FromLongLong(base + 1 + i);
+            if (!ver)
+                goto fail;
+            PyObject *pair = PyTuple_Pack(
+                2, PySequence_Fast_GET_ITEM(olds_f, i), ver);
+            Py_DECREF(ver);
+            if (!pair || PyList_Append(run_lst, pair) < 0) {
+                Py_XDECREF(pair);
+                goto fail;
+            }
+            Py_DECREF(pair);
+        }
+    }
+    Py_DECREF(olds_f);
+    Py_DECREF(nids_f);
+    return out;
+fail:
+    Py_XDECREF(out);
+    Py_DECREF(olds_f);
+    Py_DECREF(nids_f);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"plan_apply", plan_apply, METH_VARARGS,
      "Clone and register planner decisions."},
@@ -1101,6 +1696,16 @@ static PyMethodDef methods[] = {
      "Validate, version-check, and stamp one commit chunk."},
     {"commit_apply", commit_apply, METH_VARARGS,
      "Install stamped tasks into the store table and indexes."},
+    {"block_decode", block_decode, METH_VARARGS,
+     "Parse a binary columnar task-block raft entry (GIL-released scan)."},
+    {"block_apply_follower", block_apply_follower, METH_VARARGS,
+     "Follower-side block apply: overlay + batched by_node index pass."},
+    {"fanout_expand", fanout_expand, METH_VARARGS,
+     "Synthesize the per-task watch Events of one EventTaskBlock."},
+    {"fanout_filter", fanout_filter, METH_VARARGS,
+     "Per-subscriber predicate pre-filter over an expanded event list."},
+    {"per_node_group", per_node_group, METH_VARARGS,
+     "node_id -> [(old, version)] grouping of one block."},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_hotpath",
@@ -1135,6 +1740,7 @@ PyInit__hotpath(void)
     INTERN(s_old, "old");
     INTERN(s_new, "new");
     INTERN(s_update, "update");
+    INTERN(s_task_block, "task_block");
 #undef INTERN
     empty_tuple = PyTuple_New(0);
     if (!empty_tuple)

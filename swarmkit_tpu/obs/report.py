@@ -114,6 +114,10 @@ def phase_table(doc: Dict[str, Any],
         # fraction of device-plan wall time hidden behind host commit;
         # 0.0 today (sequential) — the pipelining PR moves this
         "plan_hidden_frac": round(overlap / plan_s, 4) if plan_s else 0.0,
+        # the mirror fraction: host-commit wall time hidden behind the
+        # device plan — the commit-plane headline ISSUE 13 tracks
+        "commit_hidden_frac": round(overlap / commit_s, 4)
+        if commit_s else 0.0,
     }
 
 

@@ -77,10 +77,18 @@ def dump_wal(state_dir: str, unlock_key: str = "") -> List[dict]:
             rec["entry_type"] = "noop"
         elif e.data:
             try:
-                actions = serde.loads_dict(e.data)
+                # the shared entry grammar: binary columnar task blocks
+                # (serde.BLOCK_ENTRY_MAGIC) and JSON change lists both
+                # decode through the same seam the apply paths use
+                actions = serde.entry_to_actions(e.data)
                 rec["actions"] = [
-                    {"action": a["action"], "collection": a["collection"],
-                     "id": a["obj"].get("id", "")}
+                    {"action": "task_block",
+                     "collection": "tasks",
+                     "items": len(a.ids),
+                     "base_version": a.base_version}
+                    if a.action == "task_block" else
+                    {"action": a.action, "collection": a.obj.collection,
+                     "id": a.obj.id}
                     for a in actions]
             except Exception:
                 rec["actions"] = "<undecodable>"

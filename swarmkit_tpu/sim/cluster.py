@@ -40,6 +40,7 @@ pure function of (scenario, seed).
 from __future__ import annotations
 
 import json
+import os
 from typing import Callable, Dict, List, Optional
 
 from ..manager.dispatcher import Config_ as DispatcherConfig, Dispatcher, \
@@ -250,8 +251,7 @@ class SimManager:
     def _apply_store_entry(self, e: Entry) -> None:
         from ..state import serde
         try:
-            actions = [serde.action_from_dict(d)
-                       for d in serde.loads_dict(e.data[len(CP_MAGIC):])]
+            actions = serde.entry_to_actions(e.data[len(CP_MAGIC):])
             self.store.apply_store_actions(actions)
         except Exception as exc:
             # a member store that cannot apply a committed entry is
@@ -664,6 +664,10 @@ class SimRaftProposer:
         self._pending: Dict[tuple, dict] = {}
         self.stats = {"proposed": 0, "committed": 0, "dropped": 0,
                       "stale_epoch_rejects": 0}
+        # one-shot "fault native-commit-plane store" coverage line (see
+        # propose_async): logged when the first binary block entry rides
+        # consensus with the native decode plane active
+        self._native_cov_logged = False
         self.read_stats = {"reads": 0, "lease": 0, "read_index": 0,
                            "unavailable": 0}
         if member is not None:
@@ -706,7 +710,18 @@ class SimRaftProposer:
             target = self.sim.leader()
             if target is None:
                 raise RuntimeError("no ready raft leader to propose to")
-        data = serde.dumps([serde.action_to_dict(a) for a in actions])
+        data = serde.actions_to_entry_data(actions)
+        if data.startswith(serde.BLOCK_ENTRY_MAGIC) \
+                and not self._native_cov_logged:
+            # one-shot coverage line: the chaos sweep's fault-type x
+            # component gate (scripts/chaos_sweep.py REQUIRED_CELLS)
+            # requires the NATIVE columnar commit plane to have actually
+            # carried a block through consensus — an empty cell means
+            # the native path silently rotted out of the sweep
+            self._native_cov_logged = True
+            from .. import native
+            if native.get_commit() is not None:
+                self.sim.engine.log("fault native-commit-plane store")
         if self.member is not None:
             data = CP_MAGIC + data
         index = target.core.propose(data)
@@ -1625,6 +1640,12 @@ class RaftControlPlane:
         d.reg_grace_check = \
             lambda nid: self.session_owner.get(nid) is None
         d.run(start_worker=False)
+        if os.environ.get("SWARM_BATCH_FANOUT", "1") != "0":
+            # batched assignment fan-out is the DEFAULT consumer plane
+            # (ISSUE 13 satellite; opt-out escape hatch only): one store
+            # subscription per plane, per-node batched flushes driven
+            # from process_deadlines — the ≤⌈N/batch⌉-sends contract
+            d.enable_batched_fanout()
         self._planes[m.id] = (m.store, d)
         return d
 

@@ -11,6 +11,13 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Tuple, Type
 
+from ..utils.metrics import registry as _metrics
+
+# cached Timer reference (Registry.reset() resets it in place): total
+# wall time spent synthesizing per-task events out of coalesced blocks —
+# the watch fan-out cost the bench reports as ``fanout_s``
+_FANOUT_TIMER = _metrics.timer("swarm_watch_fanout_latency")
+
 
 @dataclass(frozen=True)
 class Event:
@@ -69,20 +76,35 @@ class EventTaskBlock:
 
     def expand_events(self):
         """Synthesized per-task Events (cached; thread-safe because the
-        build is idempotent and the final assignment is atomic)."""
+        build is idempotent and the final assignment is atomic).  One
+        native pass when the commit plane's hot path is available
+        (hotpath.c fanout_expand); the list comprehension below is the
+        fallback and its differential oracle.  Runs on CONSUMER threads
+        only — never under the store locks (swarmlint lock-discipline
+        bans fanout_expand under them)."""
         events = self._events
         if events is None:
+            from .. import native
             from .store import _materialize_task
             base = self.base_version
             state, message, ts = self.state, self.message, self.ts
-            events = [
-                Event("update",
-                      _materialize_task(old, nid, base + 1 + i, ts,
-                                        state, message),
-                      old)
-                for i, (old, nid) in enumerate(zip(self.olds,
-                                                   self.node_ids))
-            ]
+            hp = native.get_commit()
+            with _FANOUT_TIMER.time():
+                if hp is not None:
+                    from ..models.types import TaskState, TaskStatus
+                    status = TaskStatus(state=TaskState(state),
+                                        timestamp=ts, message=message)
+                    events = hp.fanout_expand(self.olds, self.node_ids,
+                                              base, ts, status, Event)
+                else:
+                    events = [
+                        Event("update",
+                              _materialize_task(old, nid, base + 1 + i,
+                                                ts, state, message),
+                              old)
+                        for i, (old, nid) in enumerate(zip(self.olds,
+                                                           self.node_ids))
+                    ]
             self._events = events
         return events
 
@@ -91,16 +113,26 @@ class EventTaskBlock:
         shared).  Block-aware per-node consumers (dispatcher sessions)
         use this for an O(1) membership probe instead of filtering the
         synthesized per-task stream — with S agent sessions that turns
-        O(tasks x S) predicate work into O(tasks + S)."""
+        O(tasks x S) predicate work into O(tasks + S).  Native pass when
+        available (hotpath.c per_node_group); the loop below is the
+        oracle."""
         grouped = self._per_node
         if grouped is None:
-            grouped = {}
+            from .. import native
             base = self.base_version
-            for i, (old, nid) in enumerate(zip(self.olds, self.node_ids)):
-                lst = grouped.get(nid)
-                if lst is None:
-                    lst = grouped[nid] = []
-                lst.append((old, base + 1 + i))
+            hp = native.get_commit()
+            if hp is not None:
+                with _FANOUT_TIMER.time():
+                    grouped = hp.per_node_group(self.olds, self.node_ids,
+                                                base)
+            else:
+                grouped = {}
+                for i, (old, nid) in enumerate(zip(self.olds,
+                                                   self.node_ids)):
+                    lst = grouped.get(nid)
+                    if lst is None:
+                        lst = grouped[nid] = []
+                    lst.append((old, base + 1 + i))
             self._per_node = grouped
         return grouped
 

@@ -452,8 +452,7 @@ class RaftNode(Proposer):
             # does not diverge from the cluster (reference: processEntry's
             # no-wait branch, raft.go:1907)
         try:
-            actions = [serde.action_from_dict(d)
-                       for d in serde.loads_dict(e.data)]
+            actions = serde.entry_to_actions(e.data)
             self.store.apply_store_actions(actions)
         except Exception:
             log.exception("applying raft entry %d failed", e.index)
@@ -628,7 +627,10 @@ class RaftNode(Proposer):
                 f"{self.id}: proposal epoch {epoch} fenced "
                 f"(current {cur})")
         t0 = time.perf_counter()
-        data = serde.dumps([serde.action_to_dict(a) for a in actions])
+        # columnar block commits serialize to the compact binary form
+        # (decoded natively on every member); other change lists keep
+        # the JSON form — one shared grammar (serde.entry_to_actions)
+        data = serde.actions_to_entry_data(actions)
         waiter = _Waiter(event=threading.Event(), term=self.core.term,
                          index=0, commit_cb=commit_cb, t0=t0,
                          epoch=epoch)

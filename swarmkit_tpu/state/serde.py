@@ -156,6 +156,168 @@ def snapshot_from_bytes(data: bytes) -> Dict[str, Any]:
     }
 
 
+# --------------------------------------------------------------------------
+# Columnar task-block raft entries (ISSUE 13): a scheduler block rides
+# consensus as ONE compact binary payload instead of a JSON change list —
+# no per-task object churn on either side of the wire.  Decoding has a
+# native fast path (hotpath.c block_decode, GIL-released byte scan);
+# ``block_from_bytes`` below is its pure-Python differential oracle.
+# --------------------------------------------------------------------------
+
+#: binary task-block entry magic; JSON change lists start with "[" so
+#: the two wire forms can never be confused
+BLOCK_ENTRY_MAGIC = b"SKB1"
+
+# layout (little-endian, no alignment padding):
+#   0:4   magic "SKB1"
+#   4:8   u32  n (item count)
+#   8:16  i64  base_version
+#  16:20  i32  state
+#  20:28  f64  ts
+#  28:32  u32  message byte length, then the message (utf-8)
+#   +     u32  ids blob length, then n ids NUL-joined (utf-8)
+#   +     u32  run count R, R*u32 run lengths,
+#         u32  node-id blob length, then R node ids NUL-joined
+# Node ids are run-length encoded: the planner emits placements sorted
+# by node, so runs are long (same observation the JSON form exploits).
+_BLOCK_HEADER = "<4sIqidI"
+
+
+def block_to_bytes(action) -> Optional[bytes]:
+    """Binary wire form of a TaskBlockAction, or None when an id/node id
+    contains NUL or the message is not UTF-8-cleanly representable —
+    callers then fall back to the JSON change-list form (the same
+    odd-alphabet escape the JSON encoding's ids_list/node_ids takes)."""
+    import struct
+    ids = action.ids
+    node_ids = action.node_ids
+    if any("\x00" in s for s in ids) \
+            or any("\x00" in s for s in node_ids):
+        return None
+    ids_blob = "\x00".join(ids)
+    counts = []
+    run_nids = []
+    for nid in node_ids:
+        if run_nids and nid == run_nids[-1]:
+            counts[-1] += 1
+        else:
+            run_nids.append(nid)
+            counts.append(1)
+    try:
+        msg = action.message.encode("utf-8")
+        ids_b = ids_blob.encode("utf-8")
+        nid_b = "\x00".join(run_nids).encode("utf-8")
+    except UnicodeEncodeError:
+        return None
+    r = len(run_nids)
+    return b"".join((
+        struct.pack(_BLOCK_HEADER, BLOCK_ENTRY_MAGIC, len(ids),
+                    action.base_version, action.state, action.ts,
+                    len(msg)),
+        msg,
+        struct.pack("<I", len(ids_b)), ids_b,
+        struct.pack(f"<I{r}I", r, *counts),
+        struct.pack("<I", len(nid_b)), nid_b,
+    ))
+
+
+def block_from_bytes(data: bytes):
+    """Pure-Python decoder for ``block_to_bytes`` output — the
+    differential oracle for the native ``block_decode``.  Raises
+    ValueError on any truncated/corrupt entry (same contract as the
+    native decoder: a bad WAL record must fail loudly, not crash)."""
+    import struct
+    from .store import TaskBlockAction
+    try:
+        return _block_from_bytes(data, struct, TaskBlockAction)
+    except (struct.error, IndexError, UnicodeDecodeError) as e:
+        raise ValueError(f"block: {e}") from e
+
+
+def _block_from_bytes(data: bytes, struct, TaskBlockAction):
+    magic, n, base, state, ts, msg_len = struct.unpack_from(
+        _BLOCK_HEADER, data, 0)
+    if magic != BLOCK_ENTRY_MAGIC:
+        raise ValueError("block: bad magic")
+    off = struct.calcsize(_BLOCK_HEADER)
+    message = data[off:off + msg_len].decode("utf-8")
+    off += msg_len
+    (ids_len,) = struct.unpack_from("<I", data, off)
+    off += 4
+    if len(data) - off < ids_len:
+        raise ValueError("block: truncated ids blob")
+    ids_blob = data[off:off + ids_len].decode("utf-8")
+    off += ids_len
+    if n == 0:
+        if ids_len:
+            raise ValueError("block: dangling blob")
+        ids = ()
+    else:
+        ids = tuple(ids_blob.split("\x00"))
+    if len(ids) != n:
+        raise ValueError("block: string count mismatch")
+    (r,) = struct.unpack_from("<I", data, off)
+    off += 4
+    counts = struct.unpack_from(f"<{r}I", data, off)
+    off += 4 * r
+    (nid_len,) = struct.unpack_from("<I", data, off)
+    off += 4
+    if len(data) - off < nid_len:
+        raise ValueError("block: truncated node-id blob")
+    nid_blob = data[off:off + nid_len].decode("utf-8")
+    off += nid_len
+    if off != len(data):
+        raise ValueError("block: trailing bytes")
+    if r == 0:
+        if nid_len:
+            raise ValueError("block: dangling blob")
+        run_nids = []
+    else:
+        run_nids = nid_blob.split("\x00")
+    if len(run_nids) != r:
+        raise ValueError("block: string count mismatch")
+    node_ids: list = []
+    for nid, count in zip(run_nids, counts):
+        node_ids.extend([nid] * count)
+    if len(node_ids) != n:
+        raise ValueError("block: run counts mismatch n")
+    return TaskBlockAction("task_block", ids, tuple(node_ids), base,
+                           state, message, ts)
+
+
+def actions_to_entry_data(actions) -> bytes:
+    """Serialize a change list into raft entry payload bytes.  A single
+    columnar TaskBlockAction takes the compact binary block form unless
+    the commit-plane escape hatch (SWARM_NATIVE_COMMIT=0) or an odd id
+    alphabet forces the JSON change-list form; both raft routes
+    (RaftNode, the sim's member-bound proposer) call this so leaders
+    and followers agree on one wire grammar."""
+    if len(actions) == 1 and getattr(actions[0], "action", None) \
+            == "task_block":
+        from .. import native
+        if native.commit_enabled():
+            data = block_to_bytes(actions[0])
+            if data is not None:
+                return data
+    return dumps([action_to_dict(a) for a in actions])
+
+
+def entry_to_actions(data: bytes) -> list:
+    """Decode raft entry payload bytes into a change list — the single
+    decode seam both raft routes apply through.  Binary block entries
+    decode natively when available (regardless of the encode-side
+    escape hatch: replicated bytes must always apply); everything else
+    is the JSON change-list form."""
+    if data[:4] == BLOCK_ENTRY_MAGIC:
+        from .. import native
+        hp = native.get_commit()
+        if hp is not None:
+            from .store import TaskBlockAction
+            return [hp.block_decode(data, TaskBlockAction)]
+        return [block_from_bytes(data)]
+    return [action_from_dict(d) for d in loads_dict(data)]
+
+
 def action_to_dict(action) -> Dict[str, Any]:
     """One replicated store mutation (reference: api.StoreAction).
     Columnar task blocks serialize as parallel id/node arrays plus the

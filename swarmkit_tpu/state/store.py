@@ -1116,6 +1116,10 @@ class MemoryStore:
             hp = native.get()
             with self._lock:
                 seq = self._version
+                # slow-path index updates batch into ONE pass per chunk
+                # (_batch_index_tasks) — runs in the finally so an
+                # overlay entry can never outlive its index update
+                pend_index: List[Tuple[str, str, str]] = []
                 try:
                     slow: Sequence[int] = range(len(old_tasks))
                     if hp is not None:
@@ -1158,16 +1162,10 @@ class MemoryStore:
                         seq += 1
                         nid = node_ids[i]
                         overlay[tid] = (nid, seq, ts, state, message)
-                        old_nid = old.node_id
-                        if old_nid and old_nid != nid:
-                            by_node.get(old_nid, {}).pop(tid, None)
-                        if nid:
-                            s = by_node.get(nid)
-                            if s is None:
-                                s = by_node[nid] = {}
-                            s[tid] = None
+                        pend_index.append((tid, old.node_id, nid))
                         committed_idx.append(i)
                 finally:
+                    self._batch_index_tasks(by_node, pend_index)
                     # already-written overlay entries carry versions up to
                     # seq — the counter must advance past them even if a
                     # callback raised, or the next commit would reissue
@@ -1334,6 +1332,7 @@ class MemoryStore:
                                 by_node, ts, state, message, chunk_base)
                         else:
                             seq = chunk_base
+                            pend_index = []
                             for i in chunk:
                                 seq += 1
                                 old = old_tasks[i]
@@ -1341,14 +1340,9 @@ class MemoryStore:
                                 nid = node_ids[i]
                                 overlay[tid] = (nid, seq, ts, state,
                                                 message)
-                                old_nid = old.node_id
-                                if old_nid and old_nid != nid:
-                                    by_node.get(old_nid, {}).pop(tid, None)
-                                if nid:
-                                    s = by_node.get(nid)
-                                    if s is None:
-                                        s = by_node[nid] = {}
-                                    s[tid] = None
+                                pend_index.append((tid, old.node_id, nid))
+                            # one batched index pass per chunk
+                            self._batch_index_tasks(by_node, pend_index)
                         self._version = seq
                         self._log_change_locked(
                             ("block", chunk_base, olds_c, nids_c,
@@ -1506,17 +1500,68 @@ class MemoryStore:
                 self.queue.publish(ev)
             self.queue.publish(EventCommit(self._version))
 
+    @staticmethod
+    def _batch_index_tasks(by_node: Dict[str, Dict[str, None]],
+                           triples) -> None:
+        """One by_node index pass per committed chunk: ``triples`` is an
+        iterable of (task_id, old_node_id, new_node_id) in commit order.
+        Consecutive same-node placements (the planner emits them sorted
+        by node) share one bucket lookup; buckets stay insertion-ordered
+        ``{id: None}`` dicts and receive ids in exactly the order the
+        per-item loops would have inserted them — the PR 8 determinism
+        contract."""
+        last_nid: Optional[str] = None
+        bucket: Optional[Dict[str, None]] = None
+        for tid, old_nid, nid in triples:
+            if old_nid and old_nid != nid:
+                b = by_node.get(old_nid)
+                if b is not None:
+                    b.pop(tid, None)
+            if nid != last_nid:
+                last_nid = nid
+                if nid:
+                    bucket = by_node.get(nid)
+                    if bucket is None:
+                        bucket = by_node[nid] = {}
+                else:
+                    bucket = None
+            if bucket is not None:
+                bucket[tid] = None
+
     def _apply_task_block_locked(self, action: "TaskBlockAction"):
         """Apply one replicated columnar block (caller holds both locks).
         Uses the leader's version numbering (base+1..base+n) so overlay
         entries converge bit-for-bit.  Returns one event to publish (an
         EventTaskBlock normally, a list of per-item Events if ids were
-        skipped), or None when nothing resolved."""
+        skipped), or None when nothing resolved.
+
+        The healthy-log case (every id stored, none overlaid) runs as
+        one native pass — overlay writes plus a batched by_node index
+        pass per chunk (hotpath.c block_apply_follower); the Python loop
+        below is the fallback and the oracle, and the only path that can
+        handle diverged/overlaid ids."""
+        from .. import native
         table = self._tables["tasks"]
         objects = table.objects
         overlay = table.overlay
         by_node = table.by_node
         state, message, ts = action.state, action.message, action.ts
+        hp = native.get_commit()
+        if hp is not None:
+            olds = hp.block_apply_follower(
+                action.ids, action.node_ids, objects, overlay, by_node,
+                ts, state, message, action.base_version)
+            if olds is not None:
+                self._version = max(
+                    self._version, action.base_version + len(action.ids))
+                if not olds:
+                    return None
+                nids = list(action.node_ids)
+                self._log_change_locked(
+                    ("block", action.base_version, olds, nids, state,
+                     message, ts), len(olds))
+                return EventTaskBlock(olds, nids, action.base_version,
+                                      state, message, ts)
         applied: List[Tuple[Task, str, int]] = []
         for j, (tid, nid) in enumerate(zip(action.ids, action.node_ids)):
             cur = objects.get(tid)
@@ -1528,15 +1573,10 @@ class MemoryStore:
                 continue
             ver = action.base_version + 1 + j
             overlay[tid] = (nid, ver, ts, state, message)
-            old_nid = cur.node_id
-            if old_nid and old_nid != nid:
-                by_node.get(old_nid, {}).pop(tid, None)
-            if nid:
-                s = by_node.get(nid)
-                if s is None:
-                    s = by_node[nid] = {}
-                s[tid] = None
             applied.append((cur, nid, ver))
+        self._batch_index_tasks(
+            by_node,
+            ((cur.id, cur.node_id, nid) for cur, nid, _v in applied))
         self._version = max(self._version,
                             action.base_version + len(action.ids))
         if not applied:

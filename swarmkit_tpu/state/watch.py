@@ -94,7 +94,11 @@ class Subscription:
         _cond held: expansion is O(len(block)) and must never stall the
         publishing (committing) thread, which takes _cond in _publish.
         A predicate exception drops only the offending event, matching
-        the per-event publish path's granularity."""
+        the per-event publish path's granularity.  The expansion is
+        shared across subscribers (cached on the block, native when
+        available); the per-subscriber predicate filter runs as one
+        native pass too (hotpath.c fanout_filter) with the loop below
+        as fallback and oracle."""
         try:
             events = block.expand_events()
         except Exception:
@@ -102,6 +106,10 @@ class Subscription:
         pred = self._predicate
         if pred is None:
             return list(events)
+        from .. import native
+        hp = native.get_commit()
+        if hp is not None:
+            return hp.fanout_filter(events, pred)
         out = []
         for e in events:
             try:

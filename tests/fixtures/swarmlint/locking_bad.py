@@ -24,3 +24,11 @@ class MemoryStore:
     def serve_linearizable_locked(self, proposer):
         with self._lock:
             proposer.read_barrier()          # barrier wait under view lock
+
+    def publish_block_expanded(self, hp, block, status, event_cls):
+        # GIL-released native fan-out under the WRITER lock: the watch
+        # synthesis belongs on consumer threads, never the commit path
+        with self._update_lock:
+            hp.fanout_expand(block.olds, block.node_ids,
+                             block.base_version, block.ts, status,
+                             event_cls)

@@ -36,3 +36,11 @@ class MemoryStore:
         proposer.read_barrier()
         with self._lock:
             return self.snapshot()
+
+    def publish_block(self, block):
+        # the commit path publishes the COALESCED block under the lock
+        # (O(subscribers) buffering); native fan-out expansion runs on
+        # the consumer's thread, after release
+        with self._update_lock:
+            self.queue.publish(block)
+        return block.expand_events()

@@ -702,6 +702,104 @@ def test_checker_fires_on_dropped_resume_token():
 # ----------------------------------------------------------- slow sweeps
 
 @pytest.mark.slow
+def test_follower_planes_get_batched_fanout_by_default(monkeypatch):
+    """ISSUE 13 satellite: the follower-served dispatcher planes come up
+    with the batched assignment fan-out ON (opt-out via
+    SWARM_BATCH_FANOUT=0, not opt-in), and a session gap through a
+    plane rebuilds a COMPLETE set with nothing lost or duplicated."""
+    _quiet()
+    from swarmkit_tpu.models import TaskState, TaskStatus
+    from swarmkit_tpu.state.store import ByNode
+
+    # pin the default-on half against an inherited escape hatch
+    monkeypatch.delenv("SWARM_BATCH_FANOUT", raising=False)
+
+    def _mk_assigned(sim, nid, start, n):
+        """Assigned tasks for ``nid`` written through the LEADER store
+        (they replicate to every member's plane store)."""
+        leader_store = sim.leader().store
+
+        def cb(tx):
+            for i in range(start, start + n):
+                t = mk_task(i, sid="fan-svc")
+                t.node_id = nid
+                t.status = TaskStatus(state=TaskState.ASSIGNED)
+                tx.create(t)
+        leader_store.update(cb)
+
+    def _agentless_sim(seed=3):
+        # no sim agents: the test drives the plane's session itself, and
+        # a main-thread leader write must never race an agent's
+        # leader-forwarded write (that shape deadlocks by design — the
+        # scenarios route all traffic through the engine)
+        from swarmkit_tpu.sim.cluster import Sim
+        sim = Sim(seed, raft_cp=True, n_agents=0)
+        eng = sim.engine
+        while (sim.cp.active is None or not sim.cp._bootstrapped) \
+                and eng.clock.elapsed() < 30.0:
+            eng.run_until(eng.clock.elapsed() + 0.5)
+        assert sim.cp.active is not None
+        return sim
+
+    with _agentless_sim() as sim:
+        cp = sim.cp
+        cp.enable_follower_reads()
+        leader = sim.leader()
+        follower = next(m for m in sim.managers if m is not leader)
+        plane = cp.plane_for(follower)
+        assert plane is not None
+        assert plane.fanout is not None, \
+            "follower plane must default to the batched fan-out"
+        # a session + stream through the PLANE (reads local, writes
+        # forwarded to the leader), then a gap and a rebuild
+        nid = "fanout-w0"
+        leader.store.update(lambda tx: tx.create(_mk_node(nid)))
+        cp.session_owner[nid] = follower.id
+        eng = sim.engine
+        eng.run_until(eng.clock.elapsed() + 2.0)
+        session, _ = plane.register(nid)
+        stream = plane.open_assignments(nid, session)
+        assert stream.get(timeout=0).type == "complete"
+        # assignments land via replication; the flush pass batches them
+        _mk_assigned(sim, nid, 0, 5)
+        eng.run_until(eng.clock.elapsed() + 4.0)
+        plane.process_deadlines()
+        inc = []
+        while True:
+            try:
+                inc.append(stream.get(timeout=0))
+            except TimeoutError:
+                break
+        assert inc and all(m.type == "incremental" for m in inc)
+        # the gap: session released mid-flow, more assignments land,
+        # plane flushes with the stream down (no crash, nothing lost)
+        plane.release_session(nid, session)
+        assert stream.closed
+        _mk_assigned(sim, nid, 5, 3)
+        eng.run_until(eng.clock.elapsed() + 4.0)
+        plane.process_deadlines()
+        session2, _ = plane.register(nid)
+        stream2 = plane.open_assignments(nid, session2)
+        rebuilt = stream2.get(timeout=0)
+        assert rebuilt.type == "complete"
+        want = sorted(
+            t.id for t in follower.store.view(
+                lambda tx: tx.find(Task, ByNode(nid))))
+        got = sorted(obj.id for _a, kind, obj in rebuilt.changes
+                     if kind == "task")
+        assert got == want and len(want) == 8
+        assert len(got) == len(set(got))
+    # opt-out: the escape hatch restores the thread-per-stream plane
+    monkeypatch.setenv("SWARM_BATCH_FANOUT", "0")
+    with _agentless_sim(seed=5) as sim2:
+        cp2 = sim2.cp
+        cp2.enable_follower_reads()
+        leader2 = sim2.leader()
+        follower2 = next(m for m in sim2.managers if m is not leader2)
+        plane2 = cp2.plane_for(follower2)
+        assert plane2 is not None and plane2.fanout is None
+
+
 def test_read_scenarios_20_seed_sweep_byte_identical():
     from swarmkit_tpu.sim.scenario import run_scenario
     _quiet()

@@ -93,9 +93,11 @@ def test_rule_passes_clean_twin(rule):
     #                            shape (2 bare wall-clock reads pacing a
     #                            rollout monitor window — ISSUE 8)
     ("epoch-fencing", 4),      # 3 unfenced calls + 1 fencing-blind def
-    ("lock-discipline", 4),    # order cycle + 2 blocking-under-lock +
+    ("lock-discipline", 5),    # order cycle + 2 blocking-under-lock +
     #                            read_barrier under the view lock
-    #                            (ISSUE 11 follower-read shape)
+    #                            (ISSUE 11 follower-read shape) +
+    #                            GIL-released native fan-out under the
+    #                            writer lock (ISSUE 13 commit plane)
     ("layering", 4),           # state/manager/sim/orchestrator imports
     ("device-path-purity", 11),  # float()/np./jax.debug/.item() + the
     #                              fused shapes: np/.item() in a scan
