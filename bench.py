@@ -38,7 +38,8 @@ trial), not from ad-hoc dict fields.
 Env overrides: BENCH_NODES, BENCH_TASKS, BENCH_BASELINE_TASKS,
 BENCH_SKIP_HOST, BENCH_TRIALS, BENCH_SKIP_CONFIGS, BENCH_SKIP_E2E,
 BENCH_SKIP_OBS, BENCH_TRACE_OUT, BENCH_CFG6_SERVICES,
-BENCH_CFG7_SERVICES/NODES/TASKS, SWARM_PLANNER_MESH.
+BENCH_CFG7_SERVICES/NODES/TASKS,
+BENCH_CFG10_NODES/BASE_TASKS/WINDOWS/SEED, SWARM_PLANNER_MESH.
 """
 
 import gc
@@ -1072,6 +1073,279 @@ def run_autoscale_tenant_storm(planner_factory):
     }
 
 
+def run_steady_state_churn(planner_factory):
+    """Config 10: SUSTAINED decisions/sec under Poisson churn — the
+    streaming scheduler's production shape (ISSUE 14).  A big cluster
+    sits in steady state (base tasks RUNNING everywhere) while every
+    window brings small Poisson batches of arrivals and exits; each
+    window ends in one scheduler tick driven through the real store
+    watch feed (the streaming delta source).  The SAME seeded workload
+    runs twice: once with the streaming plane on (device-resident node
+    state, dirty-row refresh) and once forced to full replans
+    (``SWARM_STREAMING_PLANNER=0`` posture) — the headline is the
+    sustained-rate ratio, and placements must be byte-identical
+    between the two passes.  scripts/bench_compare.py gates on the
+    streaming plane being ACTIVE (incremental ticks > 0), zero XLA
+    compiles inside the timed windows, and the pending->assigned p99
+    not regressing >20% run-over-run (the obs lifecycle timer,
+    measured per window from the same watch feed)."""
+    _trim_heap()
+    import random as _random
+    from swarmkit_tpu.models import (
+        Annotations, Node, NodeDescription, NodeSpec, NodeState,
+        NodeStatus, ReplicatedService, Resources, ResourceRequirements,
+        Service, ServiceMode, ServiceSpec, Task, TaskSpec, TaskState,
+        TaskStatus, Version,
+    )
+    from swarmkit_tpu.models.types import now
+    from swarmkit_tpu.obs.lifecycle import LifecycleTracker
+    from swarmkit_tpu.utils.sampling import poisson as _poisson
+    from swarmkit_tpu.scheduler import Scheduler
+    from swarmkit_tpu.state import MemoryStore
+    from swarmkit_tpu.state.events import Event, EventSnapshotRestore
+    from swarmkit_tpu.utils.metrics import Registry
+
+    from swarmkit_tpu.models import Placement, PlacementPreference, \
+        Platform, SpreadOver
+
+    N_N = int(os.environ.get("BENCH_CFG10_NODES", 8192))
+    N_BASE = int(os.environ.get("BENCH_CFG10_BASE_TASKS", 12_000))
+    WINDOWS = int(os.environ.get("BENCH_CFG10_WINDOWS", 12))
+    SEED = int(os.environ.get("BENCH_CFG10_SEED", 1))
+    CPU = 10 ** 8
+    MEM = 64 << 20
+    SVCS = ("ca", "cb", "cc", "cd", "ce", "cf")
+    LAM_ARRIVE = 40.0      # for the window's (rotating) arrival service
+    LAM_EXIT = 18.0        # per window
+
+    # production spec shapes: constraints, platform requirements and a
+    # spread preference — the per-group column builders these demand
+    # (constraint/platform hash columns, spread leaves) are exactly the
+    # feasibility-mask precursors the resident state keeps, so the
+    # full-replan side pays their O(cluster) Python densification per
+    # tick while the streaming side refreshes dirty rows
+    res = ResourceRequirements(
+        reservations=Resources(nano_cpus=CPU, memory_bytes=MEM))
+    specs = {
+        "ca": TaskSpec(resources=res),
+        "cb": TaskSpec(resources=res, placement=Placement(
+            constraints=["node.labels.tier==web"],
+            platforms=[Platform(os="linux", architecture="amd64")])),
+        "cc": TaskSpec(resources=res, placement=Placement(
+            preferences=[PlacementPreference(spread=SpreadOver(
+                spread_descriptor="node.labels.rack"))])),
+        "cd": TaskSpec(resources=res, placement=Placement(
+            constraints=["node.labels.rack!=r03"],
+            platforms=[Platform(os="linux", architecture="amd64")])),
+        "ce": TaskSpec(resources=res, placement=Placement(
+            constraints=["node.hostname!=c99999"],
+            platforms=[Platform(os="linux", architecture="amd64")],
+            preferences=[PlacementPreference(spread=SpreadOver(
+                spread_descriptor="node.labels.rack"))])),
+        "cf": TaskSpec(resources=res, placement=Placement(
+            constraints=["node.labels.rack!=r07"],
+            platforms=[Platform(os="linux", architecture="amd64")])),
+    }
+    # arrivals rotate over the production-shaped services; the plain
+    # service stays as base load
+    ARRIVE_SVCS = ("cb", "cc", "cd", "ce", "cf")
+
+    def workload_script(windows):
+        """Precompute the whole churn (seeded) so both passes replay
+        byte-identical arrivals/exits.  Each window's arrivals hit ONE
+        (rotating) service — the steady-state shape: small bursts, not
+        every service at once, so the full-replan side re-densifies the
+        whole cluster for a single group's worth of decisions."""
+        rng = _random.Random(SEED)
+        script = []
+        for w in range(windows):
+            sid = ARRIVE_SVCS[w % len(ARRIVE_SVCS)]
+            arrivals = {sid: max(1, _poisson(rng, LAM_ARRIVE))}
+            script.append((arrivals, _poisson(rng, LAM_EXIT)))
+        return script
+
+    def build():
+        store = MemoryStore()
+        nodes = [Node(
+            id=f"c{i:05d}",
+            spec=NodeSpec(annotations=Annotations(
+                name=f"c{i:05d}",
+                labels={"tier": "web" if i % 2 else "db",
+                        "rack": f"r{i % 16:02d}"})),
+            status=NodeStatus(state=NodeState.READY),
+            description=NodeDescription(
+                hostname=f"c{i:05d}",
+                platform=Platform(os="linux", architecture="amd64"),
+                resources=Resources(nano_cpus=8 * 10 ** 9,
+                                    memory_bytes=32 << 30)))
+            for i in range(N_N)]
+        store.update(lambda tx: [tx.create(n) for n in nodes])
+
+        def mk_svcs(tx):
+            for sid in SVCS:
+                tx.create(Service(
+                    id=sid,
+                    spec=ServiceSpec(
+                        annotations=Annotations(name=sid),
+                        mode=ServiceMode.REPLICATED,
+                        replicated=ReplicatedService(replicas=0),
+                        task=specs[sid]),
+                    spec_version=Version(index=1)))
+        store.update(mk_svcs)
+
+        def mk_base(tx):
+            for k in range(N_BASE):
+                sid = SVCS[k % len(SVCS)]
+                tx.create(Task(
+                    id=f"{sid}-base{k:06d}", service_id=sid,
+                    slot=k + 1, desired_state=TaskState.RUNNING,
+                    spec=specs[sid], spec_version=Version(index=1),
+                    node_id=nodes[k % N_N].id,
+                    status=TaskStatus(state=TaskState.RUNNING)))
+        store.update(mk_base)
+        return store
+
+    def one_pass(streaming, windows):
+        store = build()
+        planner = planner_factory()
+        planner.enable_small_group_routing = False
+        planner.streaming_enabled = streaming
+        sched = Scheduler(store, batch_planner=planner,
+                          pipeline_depth=1)
+        _, sub = store.view_and_watch(
+            lambda tx: sched._setup_tasks_list(tx), accepts_blocks=True)
+        lreg = Registry()
+        lt = LifecycleTracker(registry=lreg)
+        seqs = {sid: 0 for sid in SVCS}
+        script = workload_script(windows)
+
+        def pump():
+            while True:
+                ev = sub.poll()
+                if ev is None:
+                    return
+                lt.handle_event(ev)
+                if isinstance(ev, EventSnapshotRestore):
+                    sched._resync()
+                elif isinstance(ev, Event):
+                    sched._handle_event(ev)
+
+        def add(sid, n):
+            spec = specs[sid]
+            base = seqs[sid]
+
+            def cb(tx):
+                ts = now()
+                for k in range(n):
+                    tx.create(Task(
+                        id=f"{sid}-a{base + k:06d}", service_id=sid,
+                        slot=N_BASE + base + k + 1,
+                        desired_state=TaskState.RUNNING, spec=spec,
+                        spec_version=Version(index=1),
+                        status=TaskStatus(state=TaskState.PENDING,
+                                          timestamp=ts)))
+            store.update(cb)
+            seqs[sid] = base + n
+
+        exited = {"n": 0}
+
+        def exit_some(k):
+            # deterministic victims: oldest base tasks first — the
+            # same ids in both passes
+            start = exited["n"]
+            victims = [f"{SVCS[j % len(SVCS)]}-base{j:06d}"
+                       for j in range(start, min(start + k, N_BASE))]
+            exited["n"] = start + len(victims)
+
+            def cb(tx):
+                ts = now()
+                for tid in victims:
+                    cur = tx.get(Task, tid)
+                    if cur is None:
+                        continue
+                    cur = cur.copy()
+                    cur.status = TaskStatus(state=TaskState.COMPLETE,
+                                            timestamp=ts,
+                                            message="churn exit")
+                    tx.update(cur)
+            store.update(cb)
+
+        sched.tick()   # cold tick outside the timed window
+        gc.collect()
+        gc.freeze()
+        decisions = 0
+        t0 = time.perf_counter()
+        for arrivals, exits in script:
+            for sid, n in arrivals.items():
+                if n:
+                    add(sid, n)
+            if exits:
+                exit_some(exits)
+            pump()
+            decisions += sched.tick()
+        dt = time.perf_counter() - t0
+        gc.unfreeze()
+        pump()
+        store.queue.unsubscribe(sub)
+        placements = sorted(
+            (t.id, t.node_id) for t in store.view(
+                lambda tx: tx.find(Task)))
+        import hashlib
+        digest = hashlib.sha256(
+            repr(placements).encode()).hexdigest()
+        edge = lt.summary().get("pending->assigned", {})
+        return (sched, planner, decisions, dt, digest,
+                edge.get("p99"))
+
+    # warm-up: both postures once, tracer off — covers every planner
+    # jit signature (incl. the streaming scatter buckets) this config
+    # touches
+    from swarmkit_tpu.obs import tracer as _tracer
+    was_tracing = _tracer.enabled
+    _tracer.disable()
+    try:
+        one_pass(True, 3)
+        one_pass(False, 2)
+        _trim_heap()
+    finally:
+        _tracer.enabled = was_tracing
+
+    snap = _planner_counter_snapshot()
+    (sched_s, planner_s, dec_s, dt_s, digest_s,
+     p99_s) = one_pass(True, WINDOWS)
+    (_sched_f, planner_f, dec_f, dt_f, digest_f,
+     _p99_f) = one_pass(False, WINDOWS)
+    routed = _planner_counter_delta(snap)
+    compiles = _compile_delta(snap)
+
+    assert dec_s == dec_f, (dec_s, dec_f)
+    assert digest_s == digest_f, \
+        "cfg10: streaming placements diverged from full-replan"
+    st = planner_s.streaming_snapshot()
+    assert st["enabled"] and st["incremental_ticks"] > 0, st
+    assert not planner_f.streaming_snapshot()["enabled"]
+    dps_s = dec_s / dt_s if dt_s else 0.0
+    dps_f = dec_f / dt_f if dt_f else 0.0
+    return {
+        "nodes": N_N, "base_tasks": N_BASE, "windows": WINDOWS,
+        "decisions": dec_s,
+        "decisions_per_sec": round(dps_s, 1),
+        "full_replan_decisions_per_sec": round(dps_f, 1),
+        "streaming_speedup": round(dps_s / dps_f, 2) if dps_f else None,
+        "tick_s": round(dt_s, 3),
+        "plan_s": round(planner_s.stats["plan_seconds"], 3),
+        "commit_s": round(sched_s.stats["commit_seconds"], 3),
+        "pending_assigned_p99_s": round(p99_s, 4)
+        if p99_s is not None else None,
+        "placements_identical": digest_s == digest_f,
+        "streaming": st,
+        "fallback_groups": routed["groups_fallback"],
+        "path": "device+streaming",
+        "shape_cost_x": 1.0,
+        "compiles": compiles,
+    }
+
+
 def run_e2e(n_agents=5, n_replicas=500):
     """swarm-bench equivalent: create an N-replica service and measure
     per-task time from service creation to RUNNING status committed
@@ -1351,6 +1625,15 @@ def main():
         with tracer.span("bench.config", "bench", cfg="cfg9"):
             configs["9_autoscale_tenant_storm"] = \
                 run_autoscale_tenant_storm(tpu)
+    if _cfg_enabled(10):
+        # sustained decisions/sec under Poisson churn: the streaming
+        # scheduler's incremental ticks vs forced full replans, same
+        # seeded workload, placements byte-identical (bench_compare
+        # gates the plane being active + compile-flat windows + the
+        # pending->assigned p99 regression bound)
+        with tracer.span("bench.config", "bench", cfg="cfg10"):
+            configs["10_steady_state_churn"] = \
+                run_steady_state_churn(tpu)
     if SKIP_E2E:
         e2e = None
     else:
@@ -1438,6 +1721,10 @@ def main():
             (configs[c]["native_commit"] for c in
              ("6_live_manager_2x100k_x_10k", "7_many_service_10x")
              if c in configs and "native_commit" in configs[c]), None),
+        # streaming scheduler (ISSUE 14): resident-state evidence from
+        # the sustained-churn config's streaming pass
+        "streaming": (configs.get("10_steady_state_churn") or {}
+                      ).get("streaming"),
         "health": health,
         "phase_table": tables,
         "configs": configs,
@@ -1474,6 +1761,7 @@ def _append_history(artifact):
         "commit_hidden_frac": artifact.get("commit_hidden_frac"),
         "fanout_s": artifact.get("fanout_s"),
         "native_commit": artifact.get("native_commit"),
+        "streaming": artifact.get("streaming"),
         "configs": {
             name: {
                 "decisions_per_sec": cfg.get("decisions_per_sec"),
@@ -1486,6 +1774,10 @@ def _append_history(artifact):
                 "commit_phase_s": cfg.get("commit_phase_s"),
                 "fanout_s": cfg.get("fanout_s"),
                 "native_commit": cfg.get("native_commit"),
+                "streaming": cfg.get("streaming"),
+                "streaming_speedup": cfg.get("streaming_speedup"),
+                "pending_assigned_p99_s": cfg.get(
+                    "pending_assigned_p99_s"),
             }
             for name, cfg in artifact["configs"].items()},
     }

@@ -42,6 +42,7 @@ def _norm(doc):
     configs, shape_cost, compiles, preempts = {}, {}, {}, {}
     quota_clamps = {}
     commit_phase, native_commit = {}, {}
+    streaming, p99 = {}, {}
     for name, cfg in (doc.get("configs") or {}).items():
         dps = cfg.get("decisions_per_sec")
         if dps:
@@ -56,6 +57,10 @@ def _norm(doc):
             commit_phase[name] = float(cfg["commit_phase_s"])
         if cfg.get("native_commit") is not None:
             native_commit[name] = cfg["native_commit"]
+        if cfg.get("streaming") is not None:
+            streaming[name] = cfg["streaming"]
+        if cfg.get("pending_assigned_p99_s") is not None:
+            p99[name] = float(cfg["pending_assigned_p99_s"])
         compiles[name] = _compiles(cfg.get("compiles"))
     return {
         # commit-plane fields (ISSUE 13): per-config commit wall and the
@@ -72,6 +77,11 @@ def _norm(doc):
         "preemptions": preempts,
         # tenant-quota clamps per config (cfg9 must show them)
         "quota_clamps": quota_clamps,
+        # streaming-scheduler evidence per config (cfg10): the
+        # {enabled, incremental_ticks, dirty_frac, resyncs, fallbacks}
+        # dict and the pending->assigned p99 the regression bound judges
+        "streaming": streaming,
+        "pending_assigned_p99_s": p99,
         "headline_compiles": _compiles(doc.get("planner_compiles")),
         "t": doc.get("t"),
         "health": (doc.get("health") or {}).get("status")
@@ -270,6 +280,47 @@ def main(argv=None) -> int:
                   "its timed window", file=sys.stderr)
             gate_failures.append(("quota-compile-growth",
                                   f"{_QOS_CFG} compiles={cfg9_compiles}"))
+    # streaming-scheduler gates (ISSUE 14), judged on the NEW run:
+    # (a) the churn config with the plane ENABLED but never actually
+    # running an incremental tick silently measured full replans and
+    # must not pass as streaming evidence; (b) zero XLA compiles inside
+    # its timed windows (its warm-up covers the scatter + plan
+    # signatures); (c) the pending->assigned p99 regressing >20% loses
+    # the latency bound the config exists to hold, even while raw
+    # decisions/s stays inside the threshold.
+    _STREAM_CFG = "10_steady_state_churn"
+    if _STREAM_CFG in new.get("configs", {}):
+        sm = new.get("streaming", {}).get(_STREAM_CFG) or {}
+        print(f"streaming[{_STREAM_CFG}]: enabled={sm.get('enabled')} "
+              f"incremental={sm.get('incremental_ticks')} "
+              f"dirty_frac={sm.get('dirty_frac')} "
+              f"resyncs={sm.get('resyncs')} "
+              f"fallbacks={sm.get('fallbacks')}")
+        if sm.get("enabled") and not sm.get("incremental_ticks"):
+            print(f"\n{_STREAM_CFG}: streaming plane enabled but never "
+                  "ran an incremental tick", file=sys.stderr)
+            gate_failures.append(
+                ("streaming-inactive",
+                 f"{_STREAM_CFG} incremental_ticks="
+                 f"{sm.get('incremental_ticks')}"))
+        cfg10_compiles = new.get("compiles", {}).get(_STREAM_CFG, 0)
+        if cfg10_compiles:
+            print(f"\n{_STREAM_CFG} paid {cfg10_compiles} XLA "
+                  "compile(s) in its timed window", file=sys.stderr)
+            gate_failures.append(
+                ("streaming-compile-growth",
+                 f"{_STREAM_CFG} compiles={cfg10_compiles}"))
+        p99_old = old.get("pending_assigned_p99_s", {}).get(_STREAM_CFG)
+        p99_new = new.get("pending_assigned_p99_s", {}).get(_STREAM_CFG)
+        if p99_old is not None or p99_new is not None:
+            print(f"pending_assigned_p99_s[{_STREAM_CFG}]: "
+                  f"{p99_old} -> {p99_new}")
+        if p99_old and p99_new and p99_new > p99_old * (1.0 + 0.20):
+            print(f"\n{_STREAM_CFG} pending->assigned p99 regressed "
+                  f"{p99_old} -> {p99_new} (>20%)", file=sys.stderr)
+            gate_failures.append(
+                ("streaming-p99-regression",
+                 f"{_STREAM_CFG} p99 {p99_old}->{p99_new}"))
     # commit-plane gates (ISSUE 13), judged on the live-manager configs:
     # (a) the commit phase regressing >20% wall-clock loses the columnar
     # plane's win even while decisions/s still clears the threshold;

@@ -47,7 +47,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from swarmkit_tpu.sim.scenario import (          # noqa: E402
     FAILOVER_SCENARIOS, FUZZ_POOL, LEGACY_RCP_SCENARIOS,
     PREEMPT_SCENARIOS, QOS_SCENARIOS, READ_SCENARIOS, SCENARIOS,
-    UPDATE_SCENARIOS, run_scenario,
+    STREAMING_SCENARIOS, UPDATE_SCENARIOS, run_scenario,
 )
 
 #: named scenario subsets.  "default" is what CI's slow sweep runs; the
@@ -59,10 +59,11 @@ SUITES: Dict[str, tuple] = {
     "preempt": PREEMPT_SCENARIOS,
     "qos": QOS_SCENARIOS,
     "read": READ_SCENARIOS,
+    "streaming": STREAMING_SCENARIOS,
     "legacy-rcp": LEGACY_RCP_SCENARIOS,
     "default": FAILOVER_SCENARIOS + UPDATE_SCENARIOS
     + PREEMPT_SCENARIOS + QOS_SCENARIOS + READ_SCENARIOS
-    + LEGACY_RCP_SCENARIOS,
+    + STREAMING_SCENARIOS + LEGACY_RCP_SCENARIOS,
     "fuzz": FUZZ_POOL,
 }
 
@@ -84,6 +85,9 @@ _FIXED_COMPONENT = {
     # columnar commit plane: logged once per raft-attached run when a
     # binary block entry rides consensus with the native decode active
     "native-commit-plane": "store",
+    # streaming scheduler: logged when a leader handoff ACTUALLY
+    # rebuilt the resident device-input state (epoch resync observed)
+    "streaming-resync": "scheduler",
     "cut": "network", "heal": "network", "split": "network",
     "heal-all": "network", "drop": "network", "drop-burst": "network",
     "clock-skew": "clock",
@@ -159,6 +163,12 @@ REQUIRED_CELLS: Dict[str, Set[Tuple[str, str]]] = {
     # columnar-commit-plane coverage anchor for the fuzz suite
     "fused-differential-churn": {
         ("native-commit-plane", "store")},
+    # streaming scheduler twin-store differential: the stepdown must
+    # happen AND the successor reign's refresh must actually resync
+    # resident state — an empty cell means the handoff path rotted
+    "steady-state-churn": {
+        ("stepdown", "manager"),
+        ("streaming-resync", "scheduler")},
     # autoscaler + tenant QoS: the burst is injected, but the
     # quota-clamp cell is logged only when the scheduler ACTUALLY
     # clamped — a suite edit that stops clamping empties the cell
@@ -276,8 +286,8 @@ def main(argv=None) -> int:
     p.add_argument("--fast", action="store_true",
                    help="CI subset: 3 seeds x rolling-upgrade-chaos + "
                         "preemption-storm + follower-read-failover, "
-                        "plus 1 tenant-storm seed "
-                        "(overrides --fuzz/--suite/--scenario)")
+                        "plus 1 tenant-storm and 1 steady-state-churn "
+                        "seed (overrides --fuzz/--suite/--scenario)")
     p.add_argument("--no-coverage-gate", action="store_true",
                    help="report the coverage matrix but never fail on "
                         "an empty cell (for ad-hoc subsets)")
@@ -300,7 +310,7 @@ def main(argv=None) -> int:
         scenarios: tuple = ("rolling-upgrade-chaos", "preemption-storm",
                             "follower-read-failover")
         n_seeds = 3
-        extra_runs = (("tenant-storm", 1),)
+        extra_runs = (("tenant-storm", 1), ("steady-state-churn", 1))
     else:
         if args.scenario:
             scenarios = tuple(args.scenario)

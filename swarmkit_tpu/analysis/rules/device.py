@@ -17,7 +17,18 @@ called from one within the same module) this rule flags:
 * ``jax.debug.*`` — debug callbacks in the hot path recompile and
   serialize the program.
 
-Host-side driver code in the same modules (``TPUPlanner``, the
+The streaming scheduler's resident device state (ops/streaming.py,
+ISSUE 14) adds the DONATION shapes: a jit program built with
+``donate_argnums`` hands its input buffers to XLA — the old array
+object is dead the moment the call dispatches.  In the HOST drivers of
+the same modules this rule therefore also flags **reuse of a donated
+buffer after dispatch**: an argument passed at a donated position of a
+donating jitted callable that is read again later in the same function
+without being rebound from the call's result.  (The companion hazard —
+a host read of a resident array *inside* the program — is the np./
+.item() class above and already fires.)
+
+Other host-side driver code in the same modules (``TPUPlanner``, the
 ``ShardedPlanFn`` padding wrapper) is untouched: syncs are its job.
 """
 
@@ -44,6 +55,36 @@ def _is_jit_decorator(dec: ast.AST, imports: ImportMap) -> bool:
             return imports.resolve(dec.args[0]) in ("jax.jit", "jit")
         return False
     return imports.resolve(dec) in ("jax.jit", "jit")
+
+
+def _donated_positions(call: ast.Call) -> Optional[Set[int]]:
+    """Donated arg positions from a ``jax.jit``/``partial(jax.jit, …)``
+    call's ``donate_argnums`` keyword; None when absent/unparsable."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in v.elts):
+            return {e.value for e in v.elts}
+        return None
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Dotted source form of a Name/Attribute chain ("self.cpu_dev"),
+    None for anything fancier."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
 
 
 def _module_functions(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
@@ -101,6 +142,82 @@ class DevicePathPurity(Checker):
         out: List[Finding] = []
         for name in sorted(device):
             out.extend(self._check_fn(mod, fns[name], imports))
+
+        # ---- donation discipline in the HOST drivers: collect the
+        # module's donating jitted callables, then flag any donated
+        # buffer read again after dispatch without a rebind
+        donating: Dict[str, Set[int]] = {}
+        for fn_name, fn in fns.items():
+            for dec in fn.decorator_list:
+                if isinstance(dec, ast.Call) \
+                        and _is_jit_decorator(dec, imports):
+                    pos = _donated_positions(dec)
+                    if pos:
+                        donating[fn_name] = pos
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and imports.resolve(node.value.func) in ("jax.jit",
+                                                            "jit"):
+                pos = _donated_positions(node.value)
+                if pos:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            donating[tgt.id] = pos
+        if donating:
+            for fn in fns.values():
+                out.extend(self._check_donation_reuse(mod, fn, donating))
+        return out
+
+    def _check_donation_reuse(self, mod: ModuleInfo,
+                              fn: ast.FunctionDef,
+                              donating: Dict[str, Set[int]]
+                              ) -> List[Finding]:
+        """Lexical donated-buffer-reuse scan over one (host) function:
+        for every call to a donating jitted callable, any read of a
+        donated argument below the call — with no intervening rebind —
+        is a dead buffer being consumed."""
+        out: List[Finding] = []
+        loads: Dict[str, List[int]] = {}
+        stores: Dict[str, List[int]] = {}
+        for node in ast.walk(fn):
+            d = _dotted(node) if isinstance(
+                node, (ast.Name, ast.Attribute)) else None
+            if d is None:
+                continue
+            ctx = getattr(node, "ctx", None)
+            if isinstance(ctx, ast.Store):
+                stores.setdefault(d, []).append(node.lineno)
+            elif isinstance(ctx, ast.Load):
+                loads.setdefault(d, []).append(node.lineno)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in donating):
+                continue
+            call_end = getattr(node, "end_lineno", None) or node.lineno
+            for p in donating[node.func.id]:
+                if p >= len(node.args) or any(
+                        isinstance(a, ast.Starred)
+                        for a in node.args[:p + 1]):
+                    continue   # starred unpacking: positions unknowable
+                d = _dotted(node.args[p])
+                if d is None:
+                    continue   # subscript/call args: not tracked
+                for load_line in loads.get(d, ()):
+                    if load_line <= call_end:
+                        continue   # the call's own argument lines
+                    if any(node.lineno <= s <= load_line
+                           for s in stores.get(d, ())):
+                        continue   # rebound from the result: fine
+                    out.append(mod.finding(
+                        self.name, node,
+                        f"donated buffer {d!r} (arg {p} of "
+                        f"{node.func.id}) read again at line "
+                        f"{load_line} after dispatch: donation hands "
+                        "the buffer to XLA — rebind it from the "
+                        "call's result"))
+                    break
         return out
 
     def _check_fn(self, mod: ModuleInfo, fn: ast.FunctionDef,

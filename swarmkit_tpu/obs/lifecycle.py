@@ -41,6 +41,19 @@ def _edge_timer_name(frm: str, to: str) -> str:
     return f'swarm_task_lifecycle{{from="{frm}",to="{to}"}}'
 
 
+def service_edge_timer_name(service_id: str) -> str:
+    """Per-service pending->assigned timer (the autoscaler's
+    ``target_p99`` signal — orchestrator/autoscaler.py reads it)."""
+    return f'swarm_task_lifecycle_service{{service="{service_id}"}}'
+
+
+#: bounded per-service timer cardinality: beyond this many distinct
+#: services the per-service edge stops growing new timers (counted on
+#: ``swarm_task_lifecycle_service_overflow``) — the global edge timer
+#: keeps covering them, so no latency sample is ever lost
+SERVICE_TIMER_CAP = 64
+
+
 class LifecycleTracker:
     def __init__(self, store=None, registry: Optional[Registry] = None):
         self.store = store
@@ -48,6 +61,8 @@ class LifecycleTracker:
         self._mu = threading.Lock()
         # task id -> (state, stamped timestamp of that state)
         self._last: Dict[str, Tuple[int, float]] = {}
+        # services with a per-service pending->assigned timer (bounded)
+        self._svc_timers: Dict[str, None] = {}
         self._stop = threading.Event()
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -55,12 +70,28 @@ class LifecycleTracker:
     # ------------------------------------------------------------- observing
 
     def _observe_edge(self, from_state: int, to_state: int,
-                      dt: float) -> None:
+                      dt: float, service_id: str = "") -> None:
         frm = ("created" if from_state < 0
                else TaskState(from_state).name.lower())
         to = TaskState(to_state).name.lower()
         self.registry.timer(_edge_timer_name(frm, to)).observe(
             max(0.0, dt))
+        # the scheduling-latency edge additionally feeds a per-service
+        # timer (bounded cardinality) so per-service SLO policies — the
+        # autoscaler's target_p99 — read their OWN signal instead of
+        # the cluster-wide aggregate
+        if (service_id
+                and from_state == int(TaskState.PENDING)
+                and to_state == int(TaskState.ASSIGNED)):
+            if service_id not in self._svc_timers:
+                if len(self._svc_timers) >= SERVICE_TIMER_CAP:
+                    self.registry.counter(
+                        "swarm_task_lifecycle_service_overflow")
+                    return
+                self._svc_timers[service_id] = None
+            self.registry.timer(
+                service_edge_timer_name(service_id)).observe(
+                max(0.0, dt))
 
     def observe_task(self, t: Task, old: Optional[Task] = None) -> None:
         """Record the FSM edge a create/update event represents."""
@@ -77,7 +108,8 @@ class LifecycleTracker:
                     self._observe_edge(-1, state, ts - created)
             elif state > prev[0]:
                 if prev[1]:
-                    self._observe_edge(prev[0], state, ts - prev[1])
+                    self._observe_edge(prev[0], state, ts - prev[1],
+                                       getattr(t, "service_id", ""))
             else:
                 # same-state refresh or a backward write (never a forward
                 # edge): keep the earlier stamp
@@ -169,11 +201,12 @@ class _BlockView:
     """Minimal Task-shaped view of one block-committed assignment (id +
     new status), avoiding per-task materialization on the watch path."""
 
-    __slots__ = ("id", "meta", "status")
+    __slots__ = ("id", "meta", "status", "service_id")
 
     def __init__(self, old: Task, state: int, ts: float):
         self.id = old.id
         self.meta = old.meta
+        self.service_id = old.service_id
         self.status = _StatusView(state, ts)
 
 

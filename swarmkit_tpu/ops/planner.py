@@ -340,6 +340,14 @@ class TPUPlanner:
         # begin_tick, updated incrementally by the apply phase, invalidated
         # by host-path fallbacks (which mutate NodeInfos behind our back)
         self._cache = None
+        # streaming scheduler (ops/streaming.py): the node columns above
+        # — and their device copies — stay RESIDENT across ticks and
+        # refresh from the scheduler's dirty-set tracker in O(churn);
+        # the full O(cluster) rebuild demotes to the counted fallbacks.
+        # SWARM_STREAMING_PLANNER=0 reverts to per-tick rebuilds.
+        self.streaming_enabled = \
+            _os.environ.get("SWARM_STREAMING_PLANNER", "") != "0"
+        self._streaming = None
         # degraded-mode circuit breaker: consecutive device failures trip
         # the whole planner to host fallback instead of failing ticks
         self.breaker = PlannerBreaker()
@@ -416,7 +424,43 @@ class TPUPlanner:
         # the same instant or a failure aging out mid-tick breaks the
         # placement parity contract under a wall clock
         self._tick_ts = now()
-        self._cache = self._build_columns(sched)
+        st = self._streaming_for(sched)
+        if st is not None:
+            self._cache = st.refresh(sched)
+        else:
+            self._cache = self._build_columns(sched)
+
+    def _streaming_for(self, sched):
+        """The resident-state plane when it may serve this scheduler:
+        hatch on AND the scheduler carries the dirty-set delta feed
+        (scheduler/deltatrack.py).  Lazily constructed — planners that
+        only ever see tracker-less harnesses never pay for it."""
+        if not self.streaming_enabled \
+                or getattr(sched, "delta", None) is None:
+            return None
+        if self._streaming is None:
+            from .streaming import ResidentState
+            self._streaming = ResidentState(self._node_value)
+        return self._streaming
+
+    def _resident_for(self, cols):
+        """The resident state iff ``cols`` came from it (identity on
+        the infos list) — the guard every streaming fast path sits
+        behind, so a planner fed foreign columns can never read stale
+        resident caches."""
+        st = self._streaming
+        if st is not None and cols and cols[0] is st.infos:
+            return st
+        return None
+
+    def streaming_snapshot(self):
+        """Bench/obs surface: the ``streaming_*`` artifact fields."""
+        st = self._streaming
+        if st is None or not self.streaming_enabled:
+            return {"enabled": False, "dirty_frac": None, "resyncs": 0,
+                    "fallbacks": 0, "incremental_ticks": 0,
+                    "full_ticks": 0, "rows": 0, "device_syncs": 0}
+        return st.snapshot()
 
     def end_tick(self) -> None:
         self._in_tick = False
@@ -520,7 +564,13 @@ class TPUPlanner:
         """
         if self._cache is not None:
             return self._cache
-        cols = self._build_columns(sched)
+        st = self._streaming_for(sched)
+        if st is not None:
+            # O(churn): host-path mutations were hook-marked dirty, so
+            # the resident columns refresh row-wise instead of rebuilding
+            cols = st.refresh(sched)
+        else:
+            cols = self._build_columns(sched)
         if getattr(self, "_in_tick", False):
             # re-cache after an invalidation: the fresh columns already
             # reflect any host-path mutations
@@ -688,10 +738,19 @@ class TPUPlanner:
         Shared by group planning and preassigned validation.  Returns None
         when a static bucket overflows (caller falls back to the host
         path)."""
-        infos, n, nb, valid, ready, cpu, mem, total = self._densify(sched, t)
+        cols = self._densify(sched, t)
+        infos, n, nb, valid, ready, cpu, mem, total = cols
         if n == 0:
             return (infos, 0, nb, valid, cpu, mem, total, None, None, 1,
                     (), 0, 0, [], False)
+        # resident fast paths (ops/streaming.py): per-service counts,
+        # failure rows, platform hashes, constraint hash columns and
+        # flat spread leaves come from row-wise-maintained caches —
+        # O(touched rows) instead of an O(cluster) Python loop per
+        # group.  Values are byte-identical to the loops below by
+        # construction (same per-row formulas); the loops remain as the
+        # tracker-less/hatch-off path AND the differential oracle.
+        st = self._resident_for(cols)
 
         # ---- per-service arrays.  NOTE: every input keeps its full node
         # shape even when it carries no signal — shrinking no-signal
@@ -700,16 +759,21 @@ class TPUPlanner:
         # combination is a distinct jit signature, so cluster-state flips
         # (first failure, first active task) and new spec shapes trigger
         # 20-40s XLA recompiles at runtime — a far worse trade.
-        svc_tasks = np.zeros(nb, np.int32)
-        failures = np.zeros(nb, np.int32)
         ts = self.fail_ts()
         sid = t.service_id
-        for i, info in enumerate(infos):
-            c = info.active_tasks_count_by_service.get(sid, 0)
-            if c:
-                svc_tasks[i] = c
-            if info.recent_failures:
-                failures[i] = info.count_recent_failures(ts, t)
+        failures = np.zeros(nb, np.int32)
+        if st is not None:
+            svc_tasks = st.svc_tasks_col(sched, sid)
+            if st.fail_rows:
+                st.fill_failures(failures, ts, t)
+        else:
+            svc_tasks = np.zeros(nb, np.int32)
+            for i, info in enumerate(infos):
+                c = info.active_tasks_count_by_service.get(sid, 0)
+                if c:
+                    svc_tasks[i] = c
+                if info.recent_failures:
+                    failures[i] = info.count_recent_failures(ts, t)
 
         # ---- constraints
         placement = t.spec.placement
@@ -725,9 +789,14 @@ class TPUPlanner:
         con_hash = np.zeros((cc, 2, nb), np.int32)
         con_op = np.full(cc, 2, np.int32)     # 2 = disabled
         con_exp = np.zeros((cc, 2), np.int32)
-        fusedbatch.fill_constraints(self._node_value, infos, n,
-                                    constraints, con_hash, con_op,
-                                    con_exp)
+        if constraints:
+            if st is not None:
+                st.fill_constraints(sched, constraints, con_hash,
+                                    con_op, con_exp)
+            else:
+                fusedbatch.fill_constraints(self._node_value, infos, n,
+                                            constraints, con_hash,
+                                            con_op, con_exp)
 
         # ---- platforms
         platforms = placement.platforms if placement else []
@@ -737,7 +806,11 @@ class TPUPlanner:
         plat = np.full((pb, 4), -1, np.int32)
         fusedbatch.fill_platforms(platforms, plat)
         if platforms:
-            os_hash, arch_hash = fusedbatch.node_platform_hashes(infos, nb)
+            if st is not None:
+                os_hash, arch_hash = st.platform_hashes()
+            else:
+                os_hash, arch_hash = fusedbatch.node_platform_hashes(
+                    infos, nb)
         else:
             os_hash = np.zeros((2, nb), np.int32)
             arch_hash = np.zeros((2, nb), np.int32)
@@ -809,8 +882,13 @@ class TPUPlanner:
                  if p.spread]
         if len(prefs) == 1:
             # the common flat case: one pass keyed by the raw value
-            leaf, n_values = fusedbatch.flat_leaf(
-                infos, nb, prefs[0].spread.spread_descriptor)
+            # (resident leaf column when the streaming plane holds one)
+            descriptor = prefs[0].spread.spread_descriptor
+            if st is not None:
+                leaf, n_values = st.flat_leaf(sched, descriptor)
+            else:
+                leaf, n_values = fusedbatch.flat_leaf(infos, nb,
+                                                      descriptor)
             L = _l_bucket(n_values)
         elif prefs:
             from ..scheduler.nodeset import _pref_value
@@ -872,15 +950,22 @@ class TPUPlanner:
         from .. import native
         hp = native.get()
         all_tasks = sched.all_tasks
+        # resident row lists when the streaming plane owns these infos
+        # (identity-guarded) — kills two O(cluster) list builds per group
+        st = self._streaming
+        if st is not None and st.infos is not infos:
+            st = None
         if getattr(sched, "block_mode", False):
             # columnar end-to-end: no per-task object materialization —
             # each group stages one (olds, nids, message) column triple and
             # commits as one array-shaped store call
             # (store.commit_task_block); mirrors keep the pre-assignment
             # object (membership + reservations are what they serve)
-            node_id_by_i = [info.node.id for info in infos]
+            node_id_by_i = st.node_ids if st is not None \
+                else [info.node.id for info in infos]
             if hp is not None:
-                task_dict_by_i = [info.tasks for info in infos]
+                task_dict_by_i = st.task_dicts if st is not None \
+                    else [info.tasks for info in infos]
                 olds, nids = hp.block_stage(items, slots, node_id_by_i,
                                             task_dict_by_i)
             else:
@@ -894,8 +979,10 @@ class TPUPlanner:
         elif hp is not None:
             shared_status = TaskStatus(
                 state=TaskState.ASSIGNED, timestamp=now(), message=message)
-            node_id_by_i = [info.node.id for info in infos]
-            task_dict_by_i = [info.tasks for info in infos]
+            node_id_by_i = st.node_ids if st is not None \
+                else [info.node.id for info in infos]
+            task_dict_by_i = st.task_dicts if st is not None \
+                else [info.tasks for info in infos]
             hp.plan_apply(items, slots, node_id_by_i, task_dict_by_i,
                           shared_status, all_tasks, decisions,
                           SchedulingDecision)
@@ -917,6 +1004,11 @@ class TPUPlanner:
             total[idx] += hit
             cpu[idx] -= hit.astype(np.int64) * cpu_d
             mem[idx] -= hit.astype(np.int64) * mem_d
+        # the batched mirror arithmetic below bypasses the NodeInfo
+        # mutation hooks: mark the touched rows dirty directly so the
+        # resident device-input state refreshes them next absorb
+        delta = getattr(sched, "delta", None)
+        mark = delta.mark if delta is not None else None
         for i in idx.tolist():
             cnt = int(counts[i])
             info = infos[i]
@@ -926,6 +1018,8 @@ class TPUPlanner:
             ar = info.available_resources
             ar.nano_cpus -= cnt * cpu_d
             ar.memory_bytes -= cnt * mem_d
+            if mark is not None:
+                mark(info.node.id)
 
     def validate_preassigned(self, sched, tasks, decisions) -> list:
         """Validate preassigned tasks (same service) against their FIXED
@@ -1226,12 +1320,38 @@ class TPUPlanner:
         """Device placement of a run's node state (called under the x64
         guard): mesh plan fns shard it with NamedShardings; the
         single-device path is a plain transfer.  Either way the arrays
-        stay device-resident across every chunk of the run."""
+        stay device-resident across every chunk of the run.
+
+        With the streaming plane fresh (no mirror mutation since the
+        resident device sync), the five node-state columns are ALREADY
+        on device — the run seeds its FusedShared/FusedCarry from the
+        resident arrays and skips their H2D transfer entirely.  Values
+        equal the host mirrors bit-for-bit (the donated scatter applies
+        the same per-row updates), so placements cannot change."""
         fn = self._fused_fn
         if fn is not None and hasattr(fn, "prepare_fused"):
             return fn.prepare_fused(shared, carry)
         import jax.numpy as jnp
         from .kernel import FusedCarry, FusedShared
+        # identity guard, like every other streaming fast path: the
+        # run's shared.valid IS the resident host column iff build_run
+        # densified from the resident state — a run built from foreign
+        # columns (hatch off, tracker-less sched) must never be seeded
+        # from another scheduler's resident device arrays
+        st = self._streaming
+        if st is not None and (not self.streaming_enabled
+                               or shared.valid is not st.valid):
+            st = None
+        dev = st.device_carry() if st is not None else None
+        if dev is not None:
+            d_valid, d_ready, d_cpu, d_mem, d_total = dev
+            self._count("streaming_device_carries")
+            return (FusedShared(valid=d_valid, ready=d_ready,
+                                os_hash=jnp.asarray(shared.os_hash),
+                                arch_hash=jnp.asarray(shared.arch_hash),
+                                svc0=jnp.asarray(shared.svc0)),
+                    FusedCarry(total=d_total, cpu=d_cpu, mem=d_mem,
+                               svc_acc=jnp.asarray(carry.svc_acc)))
         return (FusedShared(*(jnp.asarray(a) for a in shared)),
                 FusedCarry(*(jnp.asarray(a) for a in carry)))
 

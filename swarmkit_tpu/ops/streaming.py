@@ -1,0 +1,611 @@
+"""Device-resident node state for the streaming scheduler (ISSUE 14).
+
+``TPUPlanner._build_columns`` re-densifies the whole NodeSet mirror into
+SoA columns every tick — O(cluster) Python work per tick, even when the
+tick's churn touched three nodes.  ``ResidentState`` keeps those columns
+(and the per-group column *precursors*: per-service task counts, node
+platform hashes, constraint hash columns, spread leaves, failure rows)
+alive across ticks and refreshes only the rows the scheduler's
+``DeltaTracker`` marked dirty — the hardware-task-scheduler move of
+amortizing decision cost across a persistent structure (PAPERS.md: HTS
+1907.00271, DaphneSched 2308.01607).
+
+Two tiers of residency:
+
+* **host mirror** — numpy columns updated row-wise from the NodeInfo
+  ground truth.  These feed the per-group kernel inputs and the exact
+  int64 resource math, so incremental refresh is byte-identical to a
+  full rebuild by construction (same per-row formulas, same row order —
+  appends match the NodeSet dict's insertion order; removals demand a
+  full rebuild because row index is a placement tie-break key).
+* **device arrays** — jnp copies of the five node-state columns
+  (valid/ready/cpu/mem/total), updated in place by a **donated** scatter
+  program (``_scatter_rows_jit``: ``donate_argnums`` lets XLA reuse the
+  resident buffers instead of allocating per delta — the pjit/donation
+  idiom in SNIPPETS.md [1]/[2]).  The fused planner seeds its
+  ``FusedShared``/``FusedCarry`` node columns from them when fresh,
+  skipping the per-run H2D of the big columns.  The resident arrays are
+  never read back to host mid-program — D2H belongs to the fetch stage
+  (swarmlint device-path-purity).
+
+Fallback matrix (every full rebuild is counted; the escape hatch
+``SWARM_STREAMING_PLANNER=0`` turns the whole plane off):
+
+=====================  =======================================
+cold start             first refresh ever (counted ``cold``)
+leader handoff         tick epoch != resident epoch → resync —
+                       a successor must rebuild from its own
+                       replicated store before trusting rows
+node removal / store   row order shifts → full rebuild
+resync
+node-bucket overflow   cluster outgrew ``nb`` → rebuild into
+                       the next pow2 bucket
+tracker divergence     mirror count != resident count (a missed
+                       hook) → rebuild, never trust drifted rows
+=====================  =======================================
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import warnings
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..models.types import NodeAvailability, NodeState
+from ..utils.metrics import registry as _metrics
+from . import fusedbatch
+from .fusedbatch import SENTINEL, n_bucket, split_hash
+from .hashing import str_hash
+
+log = logging.getLogger("tpu-streaming")
+
+_REFRESH_TIMER = _metrics.timer("swarm_streaming_refresh_latency")
+
+#: dirty-row scatter buckets (jit signatures stay bounded); a refresh
+#: dirtier than the top bucket re-uploads the columns wholesale
+D_BUCKETS = (16, 256, 4096)
+
+#: per-service column cache bound (FIFO eviction — oldest-built goes
+#: first; deterministic): steady-state workloads cycle a few dozen
+#: services, and an evicted column simply rebuilds on next demand
+SVC_CACHE_CAP = 64
+CON_CACHE_CAP = 32
+LEAF_CACHE_CAP = 16
+
+_UNSET = object()
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+def _scatter_rows_jit(valid, ready, cpu, mem, total, idx,
+                      u_valid, u_ready, u_cpu, u_mem, u_total):
+    """In-place dirty-row update of the resident device columns.  The
+    five resident arrays are DONATED: XLA writes the updates into the
+    existing buffers instead of allocating a cluster-sized copy per
+    delta batch.  Padded index slots carry ``nb`` (out of bounds) and
+    drop."""
+    kw = dict(mode="drop")
+    return (valid.at[idx].set(u_valid, **kw),
+            ready.at[idx].set(u_ready, **kw),
+            cpu.at[idx].set(u_cpu, **kw),
+            mem.at[idx].set(u_mem, **kw),
+            total.at[idx].set(u_total, **kw))
+
+
+def _d_bucket(d: int) -> Optional[int]:
+    for b in D_BUCKETS:
+        if d <= b:
+            return b
+    return None
+
+
+class _ConColumn:
+    """One cached constraint-key hash column: per-node value hashes
+    (hi/lo int32) plus whether ANY node's value was unknown (the whole
+    constraint then disables with the sentinel, matching
+    ``fusedbatch.fill_constraints``)."""
+
+    __slots__ = ("hash", "none_count")
+
+    def __init__(self, nb: int):
+        self.hash = np.zeros((2, nb), np.int32)
+        self.none_count = 0
+
+
+class ResidentState:
+    """Persistent densified node state, refreshed O(churn) per tick."""
+
+    def __init__(self, node_value: Callable, device: bool = True):
+        #: planner._node_value — constraint-key lookup per NodeInfo
+        self._node_value = node_value
+        self.infos: Optional[List] = None
+        self.row_of: Dict[str, int] = {}
+        self.n = 0
+        self.nb = 0
+        self.valid = self.ready = None
+        self.cpu = self.mem = self.total = None
+        self.os_hash = self.arch_hash = None
+        # platform hashes are maintained LAZILY: workloads without
+        # platform requirements never pay the 2x str_hash per row
+        self._want_platforms = False
+        self.node_ids: List[str] = []
+        self.task_dicts: List[dict] = []
+        #: rows whose NodeInfo has a (possibly expired) failure record —
+        #: mirrors the ``if info.recent_failures`` guard of the per-group
+        #: failure loop, so the fill visits the same rows it would
+        self.fail_rows: Dict[int, None] = {}
+        self.svc_cols: Dict[str, np.ndarray] = {}
+        self.con_cols: Dict[str, _ConColumn] = {}
+        self.leaf_cols: Dict[str, Tuple[np.ndarray, Dict[str, int],
+                                        List[str]]] = {}
+        self.epoch = _UNSET
+        self._tracker = None
+        # device tier
+        self.device_enabled = device
+        self.dev: Optional[tuple] = None     # (valid, ready, cpu, mem, total)
+        self._dev_version = -1
+        # rows recomputed by a HOST-ONLY absorb (mid-tick accessors):
+        # the device tier has not seen them yet — the next device sync
+        # must scatter them too, or it would stamp itself fresh while
+        # silently missing those rows' updates
+        self._pending_dev_rows: Dict[int, None] = {}
+        self.stats = {"colds": 0, "resyncs": 0, "fallbacks": 0,
+                      "incremental": 0, "full": 0, "rows": 0,
+                      "dirty_frac": 0.0, "device_syncs": 0,
+                      "svc_evictions": 0}
+
+    # ------------------------------------------------------------- refresh
+
+    def refresh(self, sched) -> list:
+        """Bring the resident columns up to date with the scheduler's
+        mirror and sync the device tier; returns the planner cols list
+        ``[infos, n, nb, valid, ready, cpu, mem, total]``.  O(dirty)
+        when incremental, O(cluster) on the counted fallbacks."""
+        import time as _time
+        t0 = _time.perf_counter()
+        rows = self._absorb(sched, device=True, tick=True)
+        _REFRESH_TIMER.observe(_time.perf_counter() - t0)
+        if rows is not None and self.n:
+            frac = len(rows) / float(self.n)
+            self.stats["dirty_frac"] = frac
+            _metrics.gauge("swarm_streaming_dirty_frac", frac)
+        return self.cols()
+
+    def absorb(self, sched) -> None:
+        """Host-only incremental catch-up (mid-tick accessors call this
+        before reading cached columns).  Cheap no-op when the tracker
+        has nothing pending."""
+        self._absorb(sched, device=False)
+
+    def cols(self) -> list:
+        return [self.infos, self.n, self.nb, self.valid, self.ready,
+                self.cpu, self.mem, self.total]
+
+    def _absorb(self, sched, device: bool,
+                tick: bool = False) -> Optional[list]:
+        tracker = getattr(sched, "delta", None)
+        if tracker is None:
+            # no delta feed: behave like the non-streaming planner
+            self._rebuild(sched, "no-tracker", count="fallbacks")
+            return None
+        if self._tracker is not None and tracker is not self._tracker:
+            # a different scheduler's mirror: its mutations were never
+            # observed here — never trust the resident rows
+            self._tracker = tracker
+            tracker.drain()
+            self._rebuild(sched, "tracker-swap", count="fallbacks")
+            self.epoch = getattr(sched, "_tick_epoch", None)
+            if device:
+                self._device_upload()
+            return None
+        self._tracker = tracker
+        epoch = getattr(sched, "_tick_epoch", None)
+        if not tracker.pending and self.infos is not None \
+                and epoch == self.epoch:
+            if tick:
+                self.stats["incremental"] += 1
+                self.stats["dirty_frac"] = 0.0
+                _metrics.counter(
+                    'swarm_streaming_ticks{mode="incremental"}')
+            if device:
+                self._device_sync([])   # flushes any host-only backlog
+            return []
+        dirty, added, full_reason = tracker.drain()
+        if self.infos is None:
+            full_reason = full_reason or "cold"
+        if full_reason is not None:
+            self._rebuild(sched, full_reason)
+            self.epoch = epoch
+            if device:
+                self._device_upload()
+            return None
+        if self.epoch is not _UNSET and epoch != self.epoch:
+            # leader handoff (or the first fenced tick after an unfenced
+            # one): the resident state was built under another reign —
+            # rebuild from the replicated store before trusting it
+            self._rebuild(sched, "epoch", count="resyncs")
+            self.epoch = epoch
+            if device:
+                self._device_upload()
+            return None
+        node_set = sched.node_set
+        rows: List[int] = []
+        for nid in added:
+            if nid in self.row_of:
+                self._rebuild(sched, "divergence", count="fallbacks")
+                self.epoch = epoch
+                if device:
+                    self._device_upload()
+                return None
+            info = node_set.nodes.get(nid)
+            if info is None or self.n >= self.nb:
+                reason = "overflow" if info is not None else "divergence"
+                self._rebuild(sched, reason, count="fallbacks")
+                self.epoch = epoch
+                if device:
+                    self._device_upload()
+                return None
+            i = self.n
+            self.n += 1
+            self.row_of[nid] = i
+            self.infos.append(info)
+            self.node_ids.append(nid)
+            self.task_dicts.append(info.tasks)
+            self.valid[i] = True
+            self._recompute_row(i, info, append=True)
+            rows.append(i)
+        if self.n != len(node_set.nodes):
+            self._rebuild(sched, "divergence", count="fallbacks")
+            self.epoch = epoch
+            if device:
+                self._device_upload()
+            return None
+        for nid in dirty:
+            i = self.row_of.get(nid)
+            if i is None:
+                continue   # marked after removal was already demanded
+            info = node_set.nodes.get(nid)
+            if info is not self.infos[i]:
+                # the NodeInfo OBJECT was swapped (not mutated in
+                # place): the resident row mirrors a dead object
+                self._rebuild(sched, "divergence", count="fallbacks")
+                self.epoch = epoch
+                if device:
+                    self._device_upload()
+                return None
+            self._recompute_row(i, info)
+            rows.append(i)
+        if tick:
+            self.stats["incremental"] += 1
+            _metrics.counter('swarm_streaming_ticks{mode="incremental"}')
+        self.stats["rows"] += len(rows)
+        if rows:
+            _metrics.counter("swarm_streaming_rows", len(rows))
+        if device:
+            self._device_sync(rows)
+        else:
+            # host-only drain: the device tier is now behind for these
+            # rows — queue them for the next device sync
+            for i in rows:
+                self._pending_dev_rows[i] = None
+        return rows
+
+    # ------------------------------------------------------------ row math
+
+    def _recompute_row(self, i: int, info, append: bool = False) -> None:
+        """One row from the NodeInfo ground truth — the exact per-row
+        formulas ``_build_columns`` / ``node_platform_hashes`` apply, so
+        an incremental row equals its full-rebuild value bit-for-bit."""
+        node = info.node
+        self.ready[i] = (
+            node.status.state == NodeState.READY
+            and node.spec.availability == NodeAvailability.ACTIVE)
+        self.cpu[i] = info.available_resources.nano_cpus
+        self.mem[i] = info.available_resources.memory_bytes
+        self.total[i] = info.active_tasks_count
+        if self._want_platforms:
+            self._recompute_platform_row(i, info)
+        if info.recent_failures:
+            self.fail_rows[i] = None
+        else:
+            self.fail_rows.pop(i, None)
+        by_svc = info.active_tasks_count_by_service
+        for sid, col in self.svc_cols.items():
+            col[i] = by_svc.get(sid, 0)
+        for key in list(self.con_cols):
+            self._recompute_con_row(key, i, info)
+        for desc_key in list(self.leaf_cols):
+            self._recompute_leaf_row(desc_key, i, info, append)
+
+    def _recompute_platform_row(self, i: int, info) -> None:
+        desc = info.node.description
+        if desc and desc.platform:
+            from ..scheduler.filters import normalize_arch
+            self.os_hash[:, i] = split_hash(str_hash(desc.platform.os))
+            self.arch_hash[:, i] = split_hash(
+                str_hash(normalize_arch(desc.platform.architecture)))
+        else:
+            self.os_hash[:, i] = SENTINEL
+            self.arch_hash[:, i] = SENTINEL
+
+    def _recompute_con_row(self, key: str, i: int, info) -> None:
+        entry = self.con_cols[key]
+        v = self._node_value(info, key)
+        # real value hashes are split into non-negative halves, so the
+        # (-1, -1) sentinel doubles as the per-row "was unknown" flag
+        was_none = bool(entry.hash[0, i] == SENTINEL[0]
+                        and entry.hash[1, i] == SENTINEL[1])
+        if v is None:
+            entry.hash[:, i] = SENTINEL
+            if not was_none:
+                entry.none_count += 1
+        else:
+            entry.hash[:, i] = split_hash(str_hash(v))
+            if was_none:
+                entry.none_count -= 1
+
+    def _recompute_leaf_row(self, desc_key: str, i: int, info,
+                            append: bool) -> None:
+        from ..scheduler.nodeset import _pref_value
+        entry = self.leaf_cols.get(desc_key)
+        if entry is None:
+            return   # already invalidated earlier in this absorb pass
+        leaf, ids, values = entry
+        v = _pref_value(info, desc_key) or ""
+        if append:
+            values.append(v)
+            leaf[i] = ids.setdefault(v, len(ids))
+            return
+        if values[i] == v:
+            return
+        # a value change can renumber OTHER rows (leaf ids are
+        # first-appearance ordered in row order, and branch index is a
+        # spread tie-break the kernel reads): drop the cached column —
+        # it rebuilds lazily, exactly as a full rebuild would number it
+        del self.leaf_cols[desc_key]
+
+    # ------------------------------------------------------- full rebuild
+
+    def _rebuild(self, sched, reason: str, count: Optional[str] = None
+                 ) -> None:
+        if count is None:
+            count = ("colds" if reason == "cold"
+                     else "resyncs" if reason == "epoch"
+                     else "fallbacks")
+        self.stats[count] += 1
+        self.stats["full"] += 1
+        self.stats["dirty_frac"] = 1.0
+        _metrics.counter('swarm_streaming_ticks{mode="full"}')
+        _metrics.counter(
+            f'swarm_streaming_resyncs{{reason="{reason}"}}')
+        node_set = sched.node_set
+        infos = list(node_set.nodes.values())
+        n = len(infos)
+        nb = n_bucket(max(n, 1))
+        self.infos = infos
+        self.n = n
+        self.nb = nb
+        self.row_of = {info.node.id: i for i, info in enumerate(infos)}
+        self.node_ids = [info.node.id for info in infos]
+        self.task_dicts = [info.tasks for info in infos]
+        self.valid = np.zeros(nb, bool)
+        self.valid[:n] = True
+        self.ready = np.zeros(nb, bool)
+        self.cpu = np.zeros(nb, np.int64)
+        self.mem = np.zeros(nb, np.int64)
+        self.total = np.zeros(nb, np.int32)
+        self.os_hash = np.zeros((2, nb), np.int32)
+        self.arch_hash = np.zeros((2, nb), np.int32)
+        self.fail_rows = {}
+        # column caches rebuild lazily at their new width; a full
+        # device upload covers every row, so the host-only backlog dies
+        self.svc_cols = {}
+        self.con_cols = {}
+        self.leaf_cols = {}
+        self._pending_dev_rows = {}
+        for i, info in enumerate(infos):
+            self._recompute_row(i, info)
+
+    # -------------------------------------------------- cached precursors
+
+    def svc_tasks_col(self, sched, service_id: str) -> np.ndarray:
+        """Per-service active-task column (read-only to callers)."""
+        self.absorb(sched)
+        col = self.svc_cols.get(service_id)
+        if col is None:
+            if len(self.svc_cols) >= SVC_CACHE_CAP:
+                self.svc_cols.pop(next(iter(self.svc_cols)))
+                self.stats["svc_evictions"] += 1
+            col = np.zeros(self.nb, np.int32)
+            for i, info in enumerate(self.infos):
+                c = info.active_tasks_count_by_service.get(service_id, 0)
+                if c:
+                    col[i] = c
+            self.svc_cols[service_id] = col
+        return col
+
+    def fill_failures(self, failures: np.ndarray, ts: float, t) -> None:
+        """Failure down-weights for one group (rows with failure
+        records only — the same rows the O(N) guard loop would visit)."""
+        infos = self.infos
+        for i in self.fail_rows:
+            failures[i] = infos[i].count_recent_failures(ts, t)
+
+    def platform_hashes(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Resident node platform hash columns; built in full on first
+        demand (a platform-requiring group appeared), row-maintained
+        from then on."""
+        if not self._want_platforms:
+            self._want_platforms = True
+            for i, info in enumerate(self.infos):
+                self._recompute_platform_row(i, info)
+        return self.os_hash, self.arch_hash
+
+    def fill_constraints(self, sched, constraints, con_hash, con_op,
+                         con_exp) -> None:
+        """Streaming twin of ``fusedbatch.fill_constraints``: per-key
+        node-value hash columns are resident and refreshed per dirty
+        row, so a group build is a vectorized copy instead of an O(N)
+        Python hashing loop."""
+        self.absorb(sched)
+        n = self.n
+        for ci, con in enumerate(constraints):
+            entry = self.con_cols.get(con.key)
+            if entry is None:
+                if len(self.con_cols) >= CON_CACHE_CAP:
+                    del self.con_cols[next(iter(self.con_cols))]
+                entry = _ConColumn(self.nb)
+                self.con_cols[con.key] = entry
+                for i, info in enumerate(self.infos):
+                    self._recompute_con_row(con.key, i, info)
+            if entry.none_count > 0:
+                # unknown key on some node: node never matches,
+                # regardless of op (fill_constraints parity)
+                con_op[ci] = 0
+                con_exp[ci] = SENTINEL
+                continue
+            con_hash[ci, :, :n] = entry.hash[:, :n]
+            con_op[ci] = con.operator
+            con_exp[ci] = split_hash(str_hash(con.exp))
+
+    def flat_leaf(self, sched, descriptor: str
+                  ) -> Tuple[np.ndarray, int]:
+        """Streaming twin of ``fusedbatch.flat_leaf`` — leaf ids stay
+        first-appearance ordered in ROW order (a tie-break the kernel
+        reads), so value changes that would renumber rebuild the
+        column."""
+        self.absorb(sched)
+        entry = self.leaf_cols.get(descriptor)
+        if entry is None:
+            from ..scheduler.nodeset import _pref_value
+            if len(self.leaf_cols) >= LEAF_CACHE_CAP:
+                self.leaf_cols.pop(next(iter(self.leaf_cols)))
+            leaf = np.zeros(self.nb, np.int32)
+            ids: Dict[str, int] = {}
+            values: List[str] = []
+            for i, info in enumerate(self.infos):
+                v = _pref_value(info, descriptor) or ""
+                values.append(v)
+                leaf[i] = ids.setdefault(v, len(ids))
+            entry = (leaf, ids, values)
+            self.leaf_cols[descriptor] = entry
+        leaf, ids, _values = entry
+        return leaf, max(len(ids), 1)
+
+    # --------------------------------------------------------- device tier
+
+    def _device_upload(self) -> None:
+        """Fresh device placement of the five node-state columns (full
+        rebuild, or a delta too wide for the scatter buckets).  Covers
+        every row, so the host-only backlog is consumed by definition."""
+        if not self.device_enabled:
+            return
+        self._pending_dev_rows = {}
+        try:
+            import jax.numpy as jnp
+            with fusedbatch.x64():
+                self.dev = tuple(jnp.asarray(a) for a in (
+                    self.valid, self.ready, self.cpu, self.mem,
+                    self.total))
+        except Exception:
+            log.exception("resident device upload failed; host tier only")
+            self.device_enabled = False
+            self.dev = None
+            _metrics.counter("swarm_streaming_device_disabled")
+            return
+        self.stats["device_syncs"] += 1
+        self._dev_version = self._tracker.version \
+            if self._tracker is not None else -1
+
+    def _device_sync(self, rows: List[int]) -> None:
+        """Scatter dirty rows — plus any host-only backlog — into the
+        resident device arrays via the donated update program; wide
+        deltas re-upload wholesale."""
+        if not self.device_enabled:
+            return
+        if self.dev is None:
+            self._pending_dev_rows = {}
+            self._device_upload()
+            return
+        if self._pending_dev_rows:
+            backlog = self._pending_dev_rows
+            self._pending_dev_rows = {}
+            for i in rows:
+                backlog[i] = None
+            rows = list(backlog)
+        if not rows:
+            self._dev_version = self._tracker.version \
+                if self._tracker is not None else -1
+            return
+        db = _d_bucket(len(rows))
+        if db is None:
+            self._device_upload()
+            return
+        idx = np.full(db, self.nb, np.int32)   # pad = out of bounds, drops
+        idx[:len(rows)] = rows
+        u_valid = np.zeros(db, bool)
+        u_ready = np.zeros(db, bool)
+        u_cpu = np.zeros(db, np.int64)
+        u_mem = np.zeros(db, np.int64)
+        u_total = np.zeros(db, np.int32)
+        for j, i in enumerate(rows):
+            u_valid[j] = self.valid[i]
+            u_ready[j] = self.ready[i]
+            u_cpu[j] = self.cpu[i]
+            u_mem[j] = self.mem[i]
+            u_total[j] = self.total[i]
+        from .planner import _jit_cache_size, _observe_compile
+        import time as _time
+        bucket = f"stream_nb{self.nb}_d{db}"
+        before = _jit_cache_size(_scatter_rows_jit)
+        t0 = _time.perf_counter()
+        try:
+            with warnings.catch_warnings():
+                # CPU backends may decline donation with a warning; the
+                # program is correct either way (donation is the TPU win)
+                warnings.filterwarnings("ignore", message=".*onat.*")
+                with fusedbatch.x64():
+                    self.dev = _scatter_rows_jit(
+                        *self.dev, idx, u_valid, u_ready, u_cpu, u_mem,
+                        u_total)
+        except Exception:
+            log.exception("resident device scatter failed; re-uploading")
+            self.dev = None
+            self._device_upload()
+            return
+        _observe_compile(_scatter_rows_jit, bucket, before,
+                         _time.perf_counter() - t0)
+        self.stats["device_syncs"] += 1
+        self._dev_version = self._tracker.version \
+            if self._tracker is not None else -1
+
+    def device_carry(self):
+        """The resident device columns (valid, ready, cpu, mem, total)
+        — only when they provably mirror the host columns (no marks
+        since the last sync); None otherwise.  Consumers treat them as
+        immutable snapshots (jax arrays are)."""
+        if self.dev is None or self._tracker is None:
+            return None
+        if self._tracker.version != self._dev_version \
+                or self._tracker.pending:
+            return None
+        return self.dev
+
+    # --------------------------------------------------------------- bench
+
+    def snapshot(self) -> Dict[str, object]:
+        """Artifact-shaped stats: the ``streaming_*`` fields bench and
+        bench_compare gate on."""
+        return {
+            "enabled": True,
+            "dirty_frac": round(self.stats["dirty_frac"], 4),
+            "resyncs": self.stats["resyncs"],
+            "fallbacks": self.stats["fallbacks"],
+            "incremental_ticks": self.stats["incremental"],
+            "full_ticks": self.stats["full"],
+            "rows": self.stats["rows"],
+            "device_syncs": self.stats["device_syncs"],
+        }

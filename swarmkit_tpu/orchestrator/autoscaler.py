@@ -62,9 +62,14 @@ def registry_sampler(registry=None) -> Callable[[str], Optional[dict]]:
     """Production sampler: per-service load from the
     ``swarm_service_load{service=}`` gauge (exported by whatever
     measures demand — an ingress proxy, a queue depth exporter) and the
-    pending->assigned p99 from the obs lifecycle timer.  The sim
-    replaces this wholesale with a deterministic scenario-driven
-    sampler — that indirection is the whole point of the seam."""
+    pending->assigned p99 from the obs lifecycle timers — the SERVICE'S
+    OWN ``swarm_task_lifecycle_service{service=}`` timer when it has
+    samples (a quiet service must not scale on a noisy neighbor's
+    latency), the cluster-wide edge timer as the fallback for services
+    past the bounded per-service cardinality cap.  The sim replaces
+    this wholesale with a deterministic scenario-driven sampler — that
+    indirection is the whole point of the seam."""
+    from ..obs.lifecycle import service_edge_timer_name
     reg = registry if registry is not None else _metrics
 
     def sample(service_id: str) -> Optional[dict]:
@@ -73,8 +78,10 @@ def registry_sampler(registry=None) -> Callable[[str], Optional[dict]]:
             f'swarm_service_load{{service="{service_id}"}}')
         if load is not None:
             out["load"] = load
-        t = reg.get_timer(
-            'swarm_task_lifecycle{from="pending",to="assigned"}')
+        t = reg.get_timer(service_edge_timer_name(service_id))
+        if t is None or not t.count:
+            t = reg.get_timer(
+                'swarm_task_lifecycle{from="pending",to="assigned"}')
         if t is not None and t.count:
             out["p99"] = t.quantiles()[0.99]
         return out or None

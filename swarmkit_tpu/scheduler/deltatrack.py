@@ -1,0 +1,102 @@
+"""Dirty-set tracking for the streaming scheduler (ISSUE 14).
+
+The planner's device inputs are densified from the scheduler's NodeSet
+mirror.  Rebuilding them every tick costs O(cluster) in Python loops —
+fine for one giant cold tick, wrong for steady-state churn where each
+tick touches a handful of nodes.  ``DeltaTracker`` folds every mirror
+mutation (node create/update/remove, task commit/exit/failure — the
+store watch deltas the scheduler's event loop already consumes) into a
+per-node dirty set, so ``ops/streaming.ResidentState`` can refresh the
+resident columns in O(churn) instead of O(cluster).
+
+Wiring (no new watch plane — the deltas ride the scheduler's existing
+block-aware subscription):
+
+* ``NodeSet.tracker`` holds the scheduler's tracker; every NodeInfo
+  added through ``add_or_update_node`` gets its ``on_dirty`` hook bound
+  to ``tracker.mark``, so ``add_task``/``remove_task``/``task_failed``
+  — the only mutation paths for counts, reservations and failures —
+  mark the node without the scheduler enumerating call sites.
+* Structural changes take the conservative route: node REMOVALS (and
+  store resyncs) demand a full rebuild, because the full-rebuild row
+  order is the NodeSet dict's insertion order and a removal shifts
+  every later row (row index is a placement tie-break key — it must
+  never drift between the incremental and full paths).  Node ADDS are
+  appended in arrival order, which matches the dict's append order
+  exactly, so they stay incremental.
+
+The tracker is deliberately dumb: it records *which* rows changed,
+never *what* changed — the resident state recomputes marked rows from
+the NodeInfo ground truth, so a redundant mark costs one row recompute
+and a missed mark is the only correctness hazard (guarded by the sim's
+``incremental-equals-full-replan`` twin-store differential).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+#: adds beyond this collapse to a full rebuild — the tracker must stay
+#: O(churn), and a churn window that replaced half the cluster is not
+#: a churn window
+MAX_TRACKED_ADDS = 4096
+
+
+class DeltaTracker:
+    __slots__ = ("dirty", "added", "full_reason", "version")
+
+    def __init__(self) -> None:
+        self.dirty: Dict[str, None] = {}
+        self.added: List[str] = []
+        #: pending full-rebuild demand ("cold" until the first drain)
+        self.full_reason: Optional[str] = "cold"
+        #: bumped on every mutation — device-residency freshness token
+        self.version = 0
+
+    # ------------------------------------------------------------- marking
+
+    def mark(self, node_id: str) -> None:
+        """A node's resident row went stale (counts, reservations,
+        readiness, labels — any of it).  Insertion-ordered and
+        deduplicated, so drain order is deterministic."""
+        self.dirty[node_id] = None
+        self.version += 1
+
+    def note_add(self, node_id: str) -> None:
+        """A node joined the mirror (appended to the NodeSet dict)."""
+        if self.full_reason is not None:
+            return
+        if len(self.added) >= MAX_TRACKED_ADDS:
+            self.require_full("add-overflow")
+            return
+        self.added.append(node_id)
+        self.version += 1
+
+    def note_remove(self, node_id: str) -> None:
+        """A node left the mirror: later rows shift, so the next
+        refresh must rebuild (row order is a placement tie-break)."""
+        self.require_full("node-remove")
+
+    def require_full(self, reason: str) -> None:
+        """Demand a full rebuild at the next refresh.  The first reason
+        wins (it is the root cause; later ones are consequences)."""
+        if self.full_reason is None:
+            self.full_reason = reason
+        self.version += 1
+
+    # ------------------------------------------------------------ draining
+
+    def drain(self) -> Tuple[Dict[str, None], List[str], Optional[str]]:
+        """Take (dirty ids, added ids, full-rebuild reason) and reset.
+        ``dirty`` iterates in mark order, ``added`` in arrival order —
+        both deterministic under the sim's seeded event loop."""
+        out = (self.dirty, self.added, self.full_reason)
+        self.dirty = {}
+        self.added = []
+        self.full_reason = None
+        return out
+
+    @property
+    def pending(self) -> bool:
+        return bool(self.dirty or self.added
+                    or self.full_reason is not None)

@@ -44,11 +44,17 @@ class NodeInfo:
     __slots__ = (
         "node", "tasks", "active_tasks_count", "active_tasks_count_by_service",
         "available_resources", "used_host_ports", "recent_failures",
-        "last_cleanup",
+        "last_cleanup", "on_dirty",
     )
 
     def __init__(self, node: Node, tasks: Optional[Dict[str, Task]] = None,
                  available: Optional[Resources] = None):
+        # streaming-scheduler dirty hook (scheduler/deltatrack.py):
+        # bound to the tracker's mark() when the NodeSet carries one, so
+        # every count/reservation/failure mutation below invalidates the
+        # node's resident device-input row without the scheduler having
+        # to enumerate call sites
+        self.on_dirty = None
         self.node = node
         self.tasks: Dict[str, Task] = {}
         self.active_tasks_count = 0
@@ -71,6 +77,8 @@ class NodeInfo:
         old = self.tasks.pop(t.id, None)
         if old is None:
             return False
+        if self.on_dirty is not None:
+            self.on_dirty(self.node.id)
         if old.desired_state <= TaskState.COMPLETE:
             self.active_tasks_count -= 1
             self.active_tasks_count_by_service[t.service_id] = (
@@ -99,6 +107,8 @@ class NodeInfo:
         if old is not None:
             if (t.desired_state <= TaskState.COMPLETE
                     and old.desired_state > TaskState.COMPLETE):
+                if self.on_dirty is not None:
+                    self.on_dirty(self.node.id)
                 self.tasks[t.id] = t
                 self.active_tasks_count += 1
                 self.active_tasks_count_by_service[t.service_id] = (
@@ -106,13 +116,20 @@ class NodeInfo:
                 return True
             if (t.desired_state > TaskState.COMPLETE
                     and old.desired_state <= TaskState.COMPLETE):
+                if self.on_dirty is not None:
+                    self.on_dirty(self.node.id)
                 self.tasks[t.id] = t
                 self.active_tasks_count -= 1
                 self.active_tasks_count_by_service[t.service_id] = (
                     self.active_tasks_count_by_service.get(t.service_id, 0) - 1)
                 return True
+            # object refresh with no count/reservation change: the
+            # resident row is untouched — do not dirty it (status-only
+            # task progressions are the highest-volume event class)
             return False
 
+        if self.on_dirty is not None:
+            self.on_dirty(self.node.id)
         self.tasks[t.id] = t
         reservations = task_reservations(t)
         self.available_resources.memory_bytes -= reservations.memory_bytes
@@ -145,6 +162,8 @@ class NodeInfo:
         self.last_cleanup = ts
 
     def task_failed(self, t: Task) -> None:
+        if self.on_dirty is not None:
+            self.on_dirty(self.node.id)
         ts = now()
         if ts - self.last_cleanup >= MONITOR_FAILURES:
             self._cleanup_failures(ts)

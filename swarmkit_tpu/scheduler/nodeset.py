@@ -124,14 +124,29 @@ class NodeSet:
 
     def __init__(self) -> None:
         self.nodes: Dict[str, NodeInfo] = {}
+        # streaming-scheduler delta feed (scheduler/deltatrack.py):
+        # membership changes and per-node mutations (via the NodeInfo
+        # on_dirty hook bound below) fold into the tracker's dirty set
+        self.tracker = None
 
     def node_info(self, node_id: str) -> Optional[NodeInfo]:
         return self.nodes.get(node_id)
 
     def add_or_update_node(self, n: NodeInfo) -> None:
+        tracker = self.tracker
+        if tracker is not None:
+            n.on_dirty = tracker.mark
+            if n.id in self.nodes:
+                # existing-id replacement: the resident row mirrors the
+                # OLD NodeInfo object — mark so it re-reads this one
+                tracker.mark(n.id)
+            else:
+                tracker.note_add(n.id)
         self.nodes[n.id] = n
 
     def remove(self, node_id: str) -> None:
+        if self.tracker is not None and node_id in self.nodes:
+            self.tracker.note_remove(node_id)
         self.nodes.pop(node_id, None)
 
     def tree(self, service_id: str,

@@ -33,6 +33,7 @@ from ..state.store import Batch, ByName, MemoryStore, ReadTx
 from ..state.watch import Closed
 from . import genericresource
 from . import preempt as preempt_mod
+from .deltatrack import DeltaTracker
 from .filters import Pipeline, VolumesFilter
 from .nodeinfo import MAX_FAILURES, NodeInfo, task_reservations
 from .nodeset import DecisionTree, NodeSet
@@ -205,7 +206,13 @@ class Scheduler:
                                      Dict[str, Task]] = {}
         self.pending_preassigned_tasks: Dict[str, Task] = {}
         self.preassigned_tasks: set = set()
+        # streaming-scheduler delta feed: node create/update/remove and
+        # task commit/exit events (this loop's existing block-aware
+        # subscription) fold into per-node dirty bits the planner's
+        # resident device-input state refreshes from (ops/streaming.py)
+        self.delta = DeltaTracker()
         self.node_set = NodeSet()
+        self.node_set.tracker = self.delta
         self.all_tasks: Dict[str, Task] = {}
         self.pipeline = Pipeline()
         self.volumes = VolumeSet()
@@ -371,6 +378,9 @@ class Scheduler:
         self.preassigned_tasks.clear()
         self.all_tasks.clear()
         self.node_set = NodeSet()
+        self.node_set.tracker = self.delta
+        # a wholesale re-mirror invalidates every resident row at once
+        self.delta.require_full("resync-store")
         # clear in place: the pipeline's VolumesFilter holds a reference
         self.volumes.clear()
         self.store.view(lambda tx: self._setup_tasks_list(tx))
@@ -514,6 +524,8 @@ class Scheduler:
         else:
             info.node = n
             info.available_resources = resources
+            # in-place node swap bypasses the NodeInfo mutation hooks
+            self.delta.mark(n.id)
 
     # -------------------------------------------------------------- decisions
 

@@ -22,6 +22,7 @@ from typing import Callable, Dict, List, Optional
 from ..obs.flightrec import flightrec
 from ..obs.sampler import Sampler
 from ..obs.trace import tracer
+from ..utils.sampling import poisson as _poisson
 from .cluster import Sim
 from .faults import NetConfig
 
@@ -740,6 +741,313 @@ def _fused_differential_churn(sim: Sim) -> float:
 
 
 _fused_differential_churn.raft_cp = True
+
+
+def _steady_state_churn(sim: Sim) -> float:
+    """Differential: the STREAMING scheduler (device-resident node
+    state, dirty-row incremental refreshes — ops/streaming.py) must
+    place exactly what a forced full-replan scheduler places, per seed,
+    under sustained Poisson churn.
+
+    Twin stores ride the sim consensus through epoch-reporting
+    ``SimRaftProposer``s while the raft-attached control plane churns
+    in the background: scheduler S refreshes resident columns from the
+    delta tracker (the real watch feed, pumped between ticks exactly
+    like the production event loop), scheduler F runs with
+    ``streaming_enabled=False`` — the ``SWARM_STREAMING_PLANNER=0``
+    posture, O(cluster) rebuild every tick.  Identical workloads and
+    faults apply to both in lockstep; any placement divergence is an
+    ``incremental-equals-full-replan`` violation.  Phases cover every
+    row of the streaming fallback matrix: steady arrivals/exits/
+    failures (incremental ticks, the common case), node availability
+    churn + a spread service (dirty node rows, resident leaf columns),
+    a host-routed constraint group every tick (hook-marked host
+    mutations), node add + node REMOVE (append vs forced-full), and a
+    leader stepdown (epoch change -> the successor-reign resync that
+    rebuilds resident state before trusting it — the
+    ``streaming-resync x scheduler`` coverage cell)."""
+    eng = sim.engine
+    rng = eng.fork_rng()
+    sim.start_raft_workload(interval=0.8)
+    sim.cp.create_tasks(4)   # background control-plane traffic
+
+    while sim.leader() is None and eng.clock.elapsed() < 30.0:
+        eng.run_until(eng.clock.elapsed() + 0.5)
+    if sim.leader() is None:
+        sim.violations.record("incremental-equals-full-replan",
+                              "no ready leader within 30s")
+        return eng.clock.elapsed() + 5.0
+
+    from ..models import (
+        Annotations, Node, NodeAvailability, NodeDescription, NodeSpec,
+        NodeState, NodeStatus, Placement, PlacementPreference,
+        ReplicatedService, Resources, ResourceRequirements, Service,
+        ServiceMode, ServiceSpec, SpreadOver, Task, TaskSpec, TaskState,
+        TaskStatus, Version,
+    )
+    from ..models.types import now
+    from ..ops import TPUPlanner
+    from ..scheduler import Scheduler
+    from ..state.events import Event, EventSnapshotRestore
+    from ..state.store import MemoryStore
+    from .cluster import SimRaftProposer
+
+    class _EpochedProposer(SimRaftProposer):
+        """Unbound proposer that still reports a fencing epoch (the
+        current leader's) so the twin schedulers' tick pinning — and
+        the streaming plane's resync-on-handoff — see reigns change."""
+
+        @property
+        def leadership_epoch(self):
+            m = self.sim.leader()
+            return m.core.leadership_epoch if m is not None else None
+
+    res = ResourceRequirements(
+        reservations=Resources(nano_cpus=10 ** 8, memory_bytes=64 << 20))
+    svc_specs = {
+        "ga": TaskSpec(resources=res),
+        "gb": TaskSpec(resources=res),
+        # spread service: exercises the resident leaf columns
+        "gc": TaskSpec(placement=Placement(preferences=[
+            PlacementPreference(spread=SpreadOver(
+                spread_descriptor="node.labels.rack"))]),
+            resources=res),
+        # node.ip constraints stay on the host oracle: every tick ends
+        # with hook-marked host-path mirror mutations the dirty set
+        # must absorb (NOT a full rebuild)
+        "gd": TaskSpec(placement=Placement(
+            constraints=["node.ip!=10.0.0.9"])),
+    }
+
+    def mk_node(tx, i: int):
+        tx.create(Node(
+            id=f"sn{i:02d}",
+            spec=NodeSpec(annotations=Annotations(
+                name=f"sn{i:02d}", labels={"rack": f"r{i % 3}"})),
+            status=NodeStatus(state=NodeState.READY),
+            description=NodeDescription(
+                hostname=f"sn{i:02d}",
+                resources=Resources(nano_cpus=8 * 10 ** 9,
+                                    memory_bytes=32 << 30))))
+
+    def build_store():
+        store = MemoryStore(proposer=_EpochedProposer(sim))
+
+        def mk(tx):
+            for i in range(14):
+                mk_node(tx, i)
+            for sid, spec in svc_specs.items():
+                tx.create(Service(
+                    id=sid,
+                    spec=ServiceSpec(annotations=Annotations(name=sid),
+                                     mode=ServiceMode.REPLICATED,
+                                     replicated=ReplicatedService(
+                                         replicas=0),
+                                     task=spec),
+                    spec_version=Version(index=1)))
+        store.update(mk)
+        return store
+
+    seqs = {sid: 0 for sid in svc_specs}
+
+    def add_tasks(store, sid, n):
+        spec = svc_specs[sid]
+        base = seqs[sid]
+
+        def cb(tx):
+            for i in range(n):
+                tx.create(Task(
+                    id=f"{sid}-{base + i:04d}", service_id=sid,
+                    slot=base + i + 1,
+                    desired_state=TaskState.RUNNING, spec=spec,
+                    spec_version=Version(index=1),
+                    status=TaskStatus(state=TaskState.PENDING,
+                                      timestamp=now())))
+        store.update(cb)
+
+    stores, scheds, planners, subs = [], [], [], []
+    for streaming in (True, False):
+        store = build_store()
+        planner = TPUPlanner()
+        planner.enable_small_group_routing = False
+        planner.streaming_enabled = streaming
+        sched = Scheduler(store, batch_planner=planner,
+                          pipeline_depth=1)
+        _, sub = store.view_and_watch(
+            lambda tx, s=sched: s._setup_tasks_list(tx),
+            accepts_blocks=True)
+        stores.append(store)
+        scheds.append(sched)
+        planners.append(planner)
+        subs.append(sub)
+
+    def pump(i):
+        """Drain the store watch into the scheduler exactly as its
+        production event loop would — this IS the streaming delta feed
+        (blocks are the scheduler's own commits and are skipped, like
+        the run() loop skips them)."""
+        sched, sub = scheds[i], subs[i]
+        while True:
+            ev = sub.poll()
+            if ev is None:
+                return
+            if isinstance(ev, EventSnapshotRestore):
+                sched._resync()
+            elif isinstance(ev, Event):
+                sched._handle_event(ev)
+
+    def snap(store):
+        return sorted((t.id, t.node_id, int(t.status.state))
+                      for t in store.view(lambda tx: tx.find(Task)))
+
+    def both(fn):
+        for store in stores:
+            fn(store)
+
+    violated = {"n": 0}
+
+    def tick_and_compare(phase):
+        for i in range(2):
+            pump(i)
+            scheds[i].tick()
+        a, b = snap(stores[0]), snap(stores[1])
+        if a != b and violated["n"] < 3:   # first divergences only
+            violated["n"] += 1
+            diff = [(x, y) for x, y in zip(a, b) if x != y][:5]
+            sim.violations.record(
+                "incremental-equals-full-replan",
+                f"{phase}: streaming placements diverged from "
+                f"full-replan (first diffs: {diff})")
+
+    def fail_some(store, sid, k):
+        victims = sorted(
+            (t for t in store.view(lambda tx: tx.find(Task))
+             if t.service_id == sid and t.node_id
+             and t.status.state == TaskState.ASSIGNED), key=lambda t: t.id
+        )[:k]
+
+        def cb(tx):
+            for v in victims:
+                cur = tx.get(Task, v.id)
+                if cur is None:
+                    continue
+                cur = cur.copy()
+                cur.status = TaskStatus(state=TaskState.FAILED,
+                                        timestamp=now(),
+                                        message="sim churn exit")
+                tx.update(cur)
+        store.update(cb)
+
+    # ---- phase 1: seed the steady state
+    for sid, n in (("ga", 16), ("gb", 12), ("gc", 10), ("gd", 4)):
+        both(lambda s, sid=sid, n=n: add_tasks(s, sid, n))
+        seqs[sid] += n
+    tick_and_compare("seed")
+
+    # ---- phase 2: sustained Poisson churn — arrivals, exits/failures,
+    # node availability flips.  This is the tick shape the streaming
+    # plane exists for: every refresh must be incremental.
+    for w in range(12):
+        for sid, lam in (("ga", 1.6), ("gb", 1.2), ("gc", 0.9),
+                         ("gd", 0.5)):
+            n = _poisson(rng, lam)
+            if n:
+                both(lambda s, sid=sid, n=n: add_tasks(s, sid, n))
+                seqs[sid] += n
+        exits = _poisson(rng, 1.1)
+        if exits:
+            sid = ("ga", "gb", "gc")[w % 3]
+            both(lambda s, sid=sid, k=exits: fail_some(s, sid, k))
+        if w % 4 == 2:
+            flip = f"sn{rng.randrange(14):02d}"
+
+            def avail(store, nid=flip, drain=(w % 8 == 2)):
+                def cb(tx):
+                    cur = tx.get(Node, nid)
+                    if cur is None:
+                        return
+                    cur = cur.copy()
+                    cur.spec.availability = (
+                        NodeAvailability.DRAIN if drain
+                        else NodeAvailability.ACTIVE)
+                    tx.update(cur)
+                store.update(cb)
+            both(avail)
+        eng.run_until(eng.clock.elapsed() + 0.7)
+        tick_and_compare(f"churn-w{w}")
+
+    st_stats = planners[0].streaming_snapshot()
+    if st_stats["incremental_ticks"] < 8:
+        sim.violations.record(
+            "incremental-equals-full-replan",
+            "streaming side barely ran incrementally "
+            f"({st_stats}) — the differential is void")
+    if planners[1].streaming_snapshot()["enabled"]:
+        sim.violations.record(
+            "incremental-equals-full-replan",
+            "full-replan side had streaming enabled; differential void")
+
+    # ---- phase 3: membership churn — a node joins (append row), a
+    # node leaves (forced full rebuild; row order shifted)
+    def add_node(store):
+        store.update(lambda tx: mk_node(tx, 14))
+    both(add_node)
+    both(lambda s: add_tasks(s, "ga", 6))
+    seqs["ga"] += 6
+    tick_and_compare("node-join")
+
+    def del_node(store):
+        def cb(tx):
+            cur = tx.get(Node, "sn03")
+            if cur is not None:
+                tx.delete(Node, "sn03")
+        store.update(cb)
+    both(del_node)
+    both(lambda s: add_tasks(s, "gb", 6))
+    seqs["gb"] += 6
+    tick_and_compare("node-leave")
+
+    # ---- phase 4: leader stepdown mid-churn — commits fail, roll
+    # back, requeue; the successor reign's first refresh must RESYNC
+    # the resident state (epoch change), not trust pre-handoff rows
+    both(lambda s: add_tasks(s, "ga", 5))
+    seqs["ga"] += 5
+    pre_resyncs = planners[0].streaming_snapshot()["resyncs"]
+    sim.stepdown_leader()
+    tick_and_compare("stepdown-requeue")
+    while sim.leader() is None and eng.clock.elapsed() < 90.0:
+        eng.run_until(eng.clock.elapsed() + 0.5)
+    if sim.leader() is None:
+        sim.violations.record("incremental-equals-full-replan",
+                              "no successor leader within 90s")
+        return eng.clock.elapsed() + 3.0
+    tick_and_compare("post-stepdown-converge")
+    post_resyncs = planners[0].streaming_snapshot()["resyncs"]
+    if post_resyncs > pre_resyncs:
+        # coverage cell (scripts/chaos_sweep.py REQUIRED_CELLS): a
+        # leader handoff ACTUALLY rebuilt resident state this run
+        eng.log("fault streaming-resync scheduler")
+    else:
+        sim.violations.record(
+            "incremental-equals-full-replan",
+            "leader handoff did not resync the resident state "
+            f"(resyncs {pre_resyncs} -> {post_resyncs})")
+
+    # ---- phase 5: converged steady state again
+    both(lambda s: add_tasks(s, "gb", 4))
+    seqs["gb"] += 4
+    eng.run_until(eng.clock.elapsed() + 0.7)
+    tick_and_compare("post-handoff-churn")
+    pending = len(scheds[0].unassigned_tasks)
+    if pending:
+        sim.violations.record(
+            "incremental-equals-full-replan",
+            f"{pending} schedulable tasks still unplaced after the "
+            "post-handoff re-tick")
+    return eng.clock.elapsed() + 3.0
+
+
+_steady_state_churn.raft_cp = True
 
 
 # ------------------------------------------------- failover scenarios
@@ -1475,6 +1783,8 @@ SCENARIOS: Dict[str, Callable[[Sim], float]] = {
     "agent-storm": _agent_storm,
     "pipelined-commit-churn": _pipelined_commit_churn,
     "fused-differential-churn": _fused_differential_churn,
+    # streaming scheduler: incremental vs full-replan twin differential
+    "steady-state-churn": _steady_state_churn,
     "random-fuzz": _random_fuzz,
     # failover suite (raft-attached control plane); depth = store-level
     # chunk-pipelined proposal window
@@ -1522,6 +1832,9 @@ QOS_SCENARIOS = ("tenant-storm",)
 
 #: follower-served read plane (ISSUE 11)
 READ_SCENARIOS = ("follower-read-failover", "read-storm-degraded")
+
+#: streaming scheduler differential (ISSUE 14)
+STREAMING_SCENARIOS = ("steady-state-churn",)
 
 #: legacy fault timelines re-driven through Sim(raft_cp=True)
 LEGACY_RCP_SCENARIOS = (

@@ -1436,21 +1436,32 @@ class MemoryStore:
         return committed, failed, stamped, actions, events
 
     def _commit_apply_py(self, stamped: List[Task], table: _Table) -> None:
+        """Pure-Python apply for ``bulk_update_tasks``.  by_node index
+        writes batch through ``_batch_index_tasks`` — ONE pass per
+        chunk, like the block-commit paths — instead of a dict
+        probe-and-pop per task.  Order preservation: the pending batch
+        flushes BEFORE any item that takes the full ``_unindex``/
+        ``_index`` route (a service/slot change also touches by_node),
+        so every bucket still receives ids in exactly per-item commit
+        order — the insertion-ordered ``{id: None}`` contract."""
         objects = table.objects
         by_node = table.by_node
+        pend_index: List[Tuple[str, str, str]] = []
         for obj in stamped:
             old = objects.get(obj.id)
             objects[obj.id] = obj
             if old is None:
                 continue
             if old.service_id != obj.service_id or old.slot != obj.slot:
+                if pend_index:
+                    self._batch_index_tasks(by_node, pend_index)
+                    pend_index = []
                 self._unindex(table, old)
                 self._index(table, obj)
             elif old.node_id != obj.node_id:
-                if old.node_id:
-                    by_node.get(old.node_id, {}).pop(obj.id, None)
-                if obj.node_id:
-                    by_node.setdefault(obj.node_id, {})[obj.id] = None
+                pend_index.append((obj.id, old.node_id, obj.node_id))
+        if pend_index:
+            self._batch_index_tasks(by_node, pend_index)
 
     # --------------------------------------------------- raft follower replay
 

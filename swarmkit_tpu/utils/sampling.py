@@ -1,0 +1,22 @@
+"""Seeded distribution samplers shared by the sim and the bench.
+
+One definition so the deterministic scenarios and the churn bench draw
+from the same distribution — a numerical tweak applied to one can never
+silently diverge the other.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def poisson(rng, lam: float) -> int:
+    """Knuth's inversion sampler off an injected ``random.Random`` —
+    deterministic per seed, no numpy draw-order coupling."""
+    limit = math.exp(-lam)
+    k, p = 0, 1.0
+    while True:
+        p *= rng.random()
+        if p <= limit:
+            return k
+        k += 1

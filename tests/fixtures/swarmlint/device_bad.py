@@ -62,3 +62,26 @@ def select_victims(vprio, vcpu, demand, budget, picks):
 
     out, chosen = jax.lax.scan(pick, budget, None, length=picks)
     return jax.device_get(chosen)           # picks fetched mid-program
+
+
+def _update_rows(cpu, idx, vals):
+    host = np.asarray(cpu)     # host read of a resident array mid-program
+    return cpu.at[idx].set(vals), host
+
+
+# resident-state update program: donated buffers update in place
+update_resident = jax.jit(_update_rows, donate_argnums=(0,))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def scatter_pair(cpu, mem, idx, vals):
+    return cpu.at[idx].set(vals), mem.at[idx].set(vals)
+
+
+def drive_streaming(cpu, mem, idx, vals):
+    # host driver around the donated update program
+    new_cpu, _host = update_resident(cpu, idx, vals)
+    stale = cpu.sum()          # reusing a donated buffer after dispatch
+    cpu2, mem2 = scatter_pair(new_cpu, mem, idx, vals)
+    total = mem.sum()          # the second donated buffer, same bug
+    return cpu2, mem2, stale + total

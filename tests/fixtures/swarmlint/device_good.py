@@ -79,3 +79,24 @@ def select_victims(vprio, vcpu, demand, budget, picks):
 
     out, chosen = jax.lax.scan(pick, budget, None, length=picks)
     return chosen
+
+
+def _update_rows(cpu, idx, vals):
+    # resident-state update: pure device math, values stay on device
+    return cpu.at[idx].set(vals)
+
+
+update_resident = jax.jit(_update_rows, donate_argnums=(0,))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def scatter_pair(cpu, mem, idx, vals):
+    return cpu.at[idx].set(vals), mem.at[idx].set(vals)
+
+
+def drive_streaming(cpu, mem, idx, vals):
+    # host driver: every donated buffer is REBOUND from the call's
+    # result before any further read — the old buffer is never consumed
+    cpu = update_resident(cpu, idx, vals)
+    cpu, mem = scatter_pair(cpu, mem, idx, vals)
+    return cpu.sum() + mem.sum(), cpu, mem

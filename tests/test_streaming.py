@@ -1,0 +1,675 @@
+"""Streaming scheduler (ISSUE 14): device-resident node state +
+dirty-row incremental ticks.
+
+The contract is the byte-identity discipline every planner path in this
+repo holds: with the streaming plane on, placements, store snapshot
+state and the watch-event stream must be identical to the forced
+full-replan path (``SWARM_STREAMING_PLANNER=0``) for the same churn —
+the refresh only changes HOW the device inputs are maintained, never
+what they contain.  Every row of the fallback matrix (cold, epoch
+resync, node remove, overflow/divergence) demotes to the counted full
+rebuild; the sim's ``steady-state-churn`` twin-store differential
+proves the whole plane live, and its checker-sensitivity twin proves a
+corrupted resident row cannot hide.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from swarmkit_tpu.models import (
+    Annotations, Node, NodeAvailability, NodeDescription, NodeSpec,
+    NodeState, NodeStatus, Placement, PlacementPreference,
+    ReplicatedService, Resources, ResourceRequirements, Service,
+    ServiceMode, ServiceSpec, SpreadOver, Task, TaskSpec, TaskState,
+    TaskStatus, Version,
+)
+from swarmkit_tpu.models import types as model_types
+from swarmkit_tpu.ops import TPUPlanner
+from swarmkit_tpu.ops.streaming import ResidentState
+from swarmkit_tpu.scheduler import Scheduler
+from swarmkit_tpu.scheduler.deltatrack import DeltaTracker
+from swarmkit_tpu.sim.scenario import run_scenario
+from swarmkit_tpu.state import MemoryStore
+from swarmkit_tpu.state.events import (
+    Event, EventCommit, EventSnapshotRestore, EventTaskBlock,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import chaos_sweep  # noqa: E402
+
+
+@pytest.fixture
+def frozen_clock():
+    model_types.set_time_source(lambda: 1_700_000_000.0)
+    try:
+        yield
+    finally:
+        model_types.set_time_source(None)
+
+
+_RES = ResourceRequirements(
+    reservations=Resources(nano_cpus=10 ** 8, memory_bytes=64 << 20))
+
+
+def _mk_node(i, cpus=8 * 10 ** 9, mem=32 << 30):
+    return Node(
+        id=f"n{i:04d}",
+        spec=NodeSpec(annotations=Annotations(
+            name=f"node-{i:04d}",
+            labels={"rack": f"r{i % 3}",
+                    "tier": "web" if i % 2 else "db"})),
+        status=NodeStatus(state=NodeState.READY),
+        description=NodeDescription(
+            hostname=f"node-{i:04d}",
+            resources=Resources(nano_cpus=cpus, memory_bytes=mem)))
+
+
+def _mk_service(sid, n_tasks, spec):
+    svc = Service(
+        id=sid,
+        spec=ServiceSpec(annotations=Annotations(name=f"svc-{sid}"),
+                         mode=ServiceMode.REPLICATED,
+                         replicated=ReplicatedService(replicas=n_tasks),
+                         task=spec),
+        spec_version=Version(index=1))
+    tasks = [Task(id=f"{sid}-t{k:04d}", service_id=sid, slot=k + 1,
+                  desired_state=TaskState.RUNNING, spec=spec,
+                  spec_version=Version(index=1),
+                  status=TaskStatus(state=TaskState.PENDING,
+                                    timestamp=model_types.now()))
+             for k in range(n_tasks)]
+    return svc, tasks
+
+
+def _build_store(n_nodes=24):
+    store = MemoryStore()
+    store.update(lambda tx: [tx.create(_mk_node(i))
+                             for i in range(n_nodes)])
+    specs = {
+        "sva": TaskSpec(resources=_RES),
+        "svb": TaskSpec(resources=_RES,
+                        placement=Placement(
+                            constraints=["node.labels.tier==web"])),
+        "svc": TaskSpec(resources=_RES,
+                        placement=Placement(preferences=[
+                            PlacementPreference(spread=SpreadOver(
+                                spread_descriptor="node.labels.rack"))])),
+    }
+    seeded = {"sva": 20, "svb": 12, "svc": 9}
+
+    def mk(tx):
+        for sid, spec in specs.items():
+            svc, tasks = _mk_service(sid, seeded[sid], spec)
+            tx.create(svc)
+            for t in tasks:
+                tx.create(t)
+    store.update(mk)
+    return store, specs, dict(seeded)
+
+
+def _event_key(ev):
+    if isinstance(ev, EventTaskBlock):
+        return ("block", tuple(o.id for o in ev.olds),
+                tuple(ev.node_ids), ev.base_version, ev.state, ev.message)
+    if isinstance(ev, EventCommit):
+        return ("commit", ev.version)
+    if isinstance(ev, Event):
+        obj = ev.obj
+        return (ev.action, obj.id, getattr(obj, "node_id", None),
+                int(obj.status.state) if hasattr(obj, "status") else None,
+                obj.meta.version.index)
+    return ("other", repr(ev))
+
+
+def _pump(sched, sub):
+    while True:
+        ev = sub.poll()
+        if ev is None:
+            return
+        if isinstance(ev, EventSnapshotRestore):
+            sched._resync()
+        elif isinstance(ev, Event):
+            sched._handle_event(ev)
+
+
+def _churn_run(streaming: bool, fused: bool = True):
+    """Multi-tick churn driven through the scheduler's real event feed:
+    arrivals, exits/failures, an availability flip, a node join, a node
+    leave — every streaming code path in one run."""
+    store, specs, seqs = _build_store()
+    planner = TPUPlanner()
+    planner.enable_small_group_routing = False
+    planner.fused_enabled = fused
+    planner.streaming_enabled = streaming
+    sched = Scheduler(store, batch_planner=planner, pipeline_depth=1)
+    _, sub = store.view_and_watch(
+        lambda tx: sched._setup_tasks_list(tx), accepts_blocks=True)
+    obs = store.queue.subscribe(accepts_blocks=True)
+
+    def add(sid, n):
+        spec = specs[sid]
+        base = seqs[sid]
+
+        def cb(tx):
+            for k in range(n):
+                tx.create(Task(
+                    id=f"{sid}-t{base + k:04d}", service_id=sid,
+                    slot=base + k + 1, desired_state=TaskState.RUNNING,
+                    spec=spec, spec_version=Version(index=1),
+                    status=TaskStatus(state=TaskState.PENDING)))
+        store.update(cb)
+        seqs[sid] = base + n
+
+    def fail_some(sid, k):
+        victims = sorted(
+            (t for t in store.view(lambda tx: tx.find(Task))
+             if t.service_id == sid and t.node_id), key=lambda t: t.id
+        )[:k]
+
+        def cb(tx):
+            for v in victims:
+                cur = tx.get(Task, v.id)
+                if cur is None:
+                    continue
+                cur = cur.copy()
+                cur.status = TaskStatus(
+                    state=TaskState.FAILED,
+                    timestamp=model_types.now(), message="churn exit")
+                tx.update(cur)
+        store.update(cb)
+
+    def flip(nid, avail):
+        def cb(tx):
+            cur = tx.get(Node, nid).copy()
+            cur.spec.availability = avail
+            tx.update(cur)
+        store.update(cb)
+
+    decisions = sched.tick()                       # tick 1: cold build
+    add("sva", 5)
+    add("svc", 3)
+    fail_some("sva", 2)
+    _pump(sched, sub)
+    decisions += sched.tick()                      # tick 2: incremental
+    add("svb", 4)
+    flip("n0002", NodeAvailability.DRAIN)
+    _pump(sched, sub)
+    decisions += sched.tick()                      # tick 3: incremental
+    store.update(lambda tx: tx.create(_mk_node(24)))
+    add("sva", 4)
+    _pump(sched, sub)
+    decisions += sched.tick()                      # tick 4: append row
+    store.update(lambda tx: tx.delete(Node, "n0005"))
+    add("svc", 4)
+    _pump(sched, sub)
+    decisions += sched.tick()                      # tick 5: node-remove
+    add("svb", 3)
+    flip("n0002", NodeAvailability.ACTIVE)
+    _pump(sched, sub)
+    decisions += sched.tick()                      # tick 6: incremental
+
+    events = [_event_key(e) for e in obs.drain()]
+    store.queue.unsubscribe(obs)
+    store.queue.unsubscribe(sub)
+    tasks = store.view(lambda tx: tx.find(Task))
+    state = sorted((t.id, t.node_id, int(t.status.state),
+                    t.status.message, t.meta.version.index)
+                   for t in tasks)
+    return decisions, state, events, sched, planner
+
+
+# ------------------------------------------------------------- tracker
+
+def test_delta_tracker_basics():
+    tr = DeltaTracker()
+    assert tr.full_reason == "cold"
+    d, a, full = tr.drain()
+    assert full == "cold" and not d and not a
+    tr.mark("n1")
+    tr.mark("n2")
+    tr.mark("n1")
+    tr.note_add("n3")
+    assert tr.pending
+    d, a, full = tr.drain()
+    assert list(d) == ["n1", "n2"] and a == ["n3"] and full is None
+    tr.note_remove("n1")
+    tr.mark("n2")
+    d, a, full = tr.drain()
+    assert full == "node-remove" and list(d) == ["n2"]
+    assert not tr.pending
+
+
+def test_delta_tracker_add_overflow_collapses():
+    tr = DeltaTracker()
+    tr.drain()
+    from swarmkit_tpu.scheduler import deltatrack
+    for i in range(deltatrack.MAX_TRACKED_ADDS + 1):
+        tr.note_add(f"n{i}")
+    _, _, full = tr.drain()
+    assert full == "add-overflow"
+
+
+# --------------------------------------------------------- byte parity
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_streaming_churn_byte_identical_to_full_replan(frozen_clock,
+                                                       fused):
+    """The whole plane: placements, final store state and the
+    watch-event stream must be byte-identical between the streaming
+    and forced full-replan paths across a churn of arrivals, exits,
+    failures, availability flips, a node join and a node leave."""
+    ds, ss, es, _sched_s, planner_s = _churn_run(True, fused=fused)
+    df, sf, ef, _sched_f, planner_f = _churn_run(False, fused=fused)
+    assert (ds, ss, es) == (df, sf, ef)
+    snap = planner_s.streaming_snapshot()
+    # incremental ticks actually happened (the differential is not
+    # vacuous) and the forced-full side never built resident state
+    assert snap["enabled"] and snap["incremental_ticks"] >= 3, snap
+    assert snap["fallbacks"] >= 1, snap          # the node-remove tick
+    assert not planner_f.streaming_snapshot()["enabled"]
+
+
+def test_resident_columns_match_full_rebuild(frozen_clock):
+    """Direct column equality: after churn, every resident host column
+    equals a from-scratch ``_build_columns`` densify."""
+    _ds, _ss, _es, sched, planner = _churn_run(True)
+    st = planner._streaming
+    assert st is not None
+    cols = planner._build_columns(sched)
+    infos, n, nb, valid, ready, cpu, mem, total = cols
+    assert st.n == n and st.nb == nb
+    assert [i.node.id for i in st.infos] == [i.node.id for i in infos]
+    np.testing.assert_array_equal(st.valid, valid)
+    np.testing.assert_array_equal(st.ready, ready)
+    np.testing.assert_array_equal(st.cpu, cpu)
+    np.testing.assert_array_equal(st.mem, mem)
+    np.testing.assert_array_equal(st.total, total)
+    # per-service columns vs the per-group loop's values
+    for sid in ("sva", "svb", "svc"):
+        want = np.zeros(nb, np.int32)
+        for i, info in enumerate(infos):
+            want[i] = info.active_tasks_count_by_service.get(sid, 0)
+        np.testing.assert_array_equal(
+            st.svc_tasks_col(sched, sid), want, err_msg=sid)
+    # platform hashes vs the full pass (the resident tier builds them
+    # lazily on first demand, then maintains rows)
+    from swarmkit_tpu.ops import fusedbatch
+    os_h, arch_h = fusedbatch.node_platform_hashes(infos, nb)
+    ros, rarch = st.platform_hashes()
+    np.testing.assert_array_equal(ros, os_h)
+    np.testing.assert_array_equal(rarch, arch_h)
+
+
+def test_epoch_change_forces_resync(frozen_clock):
+    """A tick under a different leadership epoch must rebuild the
+    resident state (successor-reign discipline) and count a resync."""
+    store, _specs, _seqs = _build_store(n_nodes=8)
+    planner = TPUPlanner()
+    planner.enable_small_group_routing = False
+    sched = Scheduler(store, batch_planner=planner, pipeline_depth=1)
+    store.view(sched._setup_tasks_list)
+    sched._tick_epoch = 3
+    planner.begin_tick(sched)
+    planner.end_tick()
+    st = planner._streaming
+    assert st.stats["resyncs"] == 0
+    sched._tick_epoch = 3
+    planner.begin_tick(sched)
+    planner.end_tick()
+    assert st.stats["incremental"] >= 1
+    sched._tick_epoch = 4          # the reign changed
+    planner.begin_tick(sched)
+    planner.end_tick()
+    assert st.stats["resyncs"] == 1, st.stats
+
+
+def test_streaming_env_hatch(monkeypatch):
+    monkeypatch.setenv("SWARM_STREAMING_PLANNER", "0")
+    assert not TPUPlanner().streaming_enabled
+    monkeypatch.delenv("SWARM_STREAMING_PLANNER")
+    assert TPUPlanner().streaming_enabled
+
+
+def test_device_carry_feeds_fused_run(frozen_clock):
+    """With the resident device tier fresh, the fused run seeds its
+    node-state columns from device (no H2D) — and places exactly what
+    the host-seeded run places."""
+    ds, ss, es, _sched, planner = _churn_run(True, fused=True)
+    assert planner.stats.get("streaming_device_carries", 0) >= 1, \
+        planner.stats
+    assert planner.stats.get("groups_fused", 0) >= 2
+    df, sf, ef, _sched_f, _planner_f = _churn_run(False, fused=True)
+    assert (ds, ss, es) == (df, sf, ef)
+
+
+def test_resident_device_columns_mirror_host(frozen_clock):
+    """The donated-scatter device tier tracks the host mirror exactly
+    at refresh points (between refreshes the host tier runs ahead and
+    ``device_carry`` refuses to serve — asserted below)."""
+    _ds, _ss, _es, sched, planner = _churn_run(True)
+    st = planner._streaming
+    # the last tick's applies marked rows after the final device sync:
+    # the device tier must refuse to serve until the next refresh
+    assert st._tracker.pending or st._tracker.version != st._dev_version
+    assert st.device_carry() is None
+    st.refresh(sched)
+    assert st.device_carry() is not None
+    assert st.dev is not None
+    d_valid, d_ready, d_cpu, d_mem, d_total = [
+        np.asarray(a) for a in st.dev]
+    np.testing.assert_array_equal(d_valid, st.valid)
+    np.testing.assert_array_equal(d_ready, st.ready)
+    np.testing.assert_array_equal(d_cpu, st.cpu)
+    np.testing.assert_array_equal(d_mem, st.mem)
+    np.testing.assert_array_equal(d_total, st.total)
+    assert st.stats["device_syncs"] >= 2
+
+
+def test_device_backlog_from_host_only_absorbs(frozen_clock):
+    """Review regression (PR 14): a HOST-ONLY absorb (the mid-tick
+    accessor path — group A's apply marks drained by group B's column
+    build) updates host rows the device tier has not seen.  The next
+    refresh must scatter that backlog — not stamp the device tier
+    fresh while silently missing those rows' reservation deductions."""
+    store, _specs, _seqs = _build_store(n_nodes=8)
+    planner = TPUPlanner()
+    planner.enable_small_group_routing = False
+    sched = Scheduler(store, batch_planner=planner, pipeline_depth=1)
+    store.view(sched._setup_tasks_list)
+    planner.begin_tick(sched)
+    planner.end_tick()
+    st = planner._streaming
+    assert st.device_carry() is not None
+    # mid-tick-style mutation: mirror changes + mark, then a HOST-ONLY
+    # absorb (what svc_tasks_col does between groups)
+    info = sched.node_set.nodes["n0000"]
+    info.available_resources.nano_cpus -= 12345
+    sched.delta.mark("n0000")
+    st.absorb(sched)
+    assert st.cpu[0] == info.available_resources.nano_cpus
+    assert st._pending_dev_rows, "host-only drain left no device backlog"
+    # stale device must refuse to serve until synced
+    assert st.device_carry() is None
+    st.refresh(sched)
+    assert not st._pending_dev_rows
+    assert st.device_carry() is not None
+    assert int(np.asarray(st.dev[2])[0]) == int(st.cpu[0]), \
+        "refresh stamped the device tier fresh without the backlog rows"
+
+
+# ------------------------------------------------------ sim differential
+
+def test_steady_state_churn_scenario():
+    """The twin-store differential: streaming placements must equal
+    full-replan placements per seed under Poisson churn, membership
+    churn and a leader stepdown (which must resync resident state)."""
+    r = run_scenario("steady-state-churn", seed=7, keep_trace=True)
+    assert r.ok, r.violations
+    assert any("streaming-resync scheduler" in line for line in r.trace)
+
+
+def test_steady_state_churn_detects_corrupt_resident_row(monkeypatch):
+    """Checker sensitivity: perturbing a resident row WITHOUT marking
+    it dirty must diverge placements, and the
+    incremental-equals-full-replan differential must catch it — a
+    comparison that can't fire is a no-op."""
+    orig = ResidentState.refresh
+
+    def corrupt(self, sched):
+        cols = orig(self, sched)
+        if self.n:
+            self.cpu[: max(1, self.n // 2)] = 0
+        return cols
+
+    monkeypatch.setattr(ResidentState, "refresh", corrupt)
+    r = run_scenario("steady-state-churn", seed=7)
+    assert any("incremental-equals-full-replan" in v and "diverged" in v
+               for v in r.violations), r.violations
+
+
+def test_chaos_sweep_requires_streaming_resync_cell():
+    """The sweep's coverage gate carries the streaming-resync x
+    scheduler cell for the new scenario, and a trace without it is
+    reported uncovered."""
+    cells = chaos_sweep.required_cells(("steady-state-churn",))
+    assert ("streaming-resync", "scheduler") in cells
+    assert chaos_sweep.classify("streaming-resync", "") == "scheduler"
+    matrix = chaos_sweep.coverage_matrix(
+        [["0.000001 fault stepdown m0"]])
+    assert chaos_sweep.uncovered(matrix, cells)
+    matrix = chaos_sweep.coverage_matrix(
+        [["0.000001 fault streaming-resync scheduler",
+          "0.000002 fault stepdown m0"]])
+    assert ("streaming-resync", "scheduler") not in \
+        chaos_sweep.uncovered(matrix, cells)
+
+
+# -------------------------------------------- satellite: per-service p99
+
+def test_per_service_lifecycle_timer_and_autoscaler_signal():
+    from swarmkit_tpu.obs.lifecycle import (
+        SERVICE_TIMER_CAP, LifecycleTracker, service_edge_timer_name,
+    )
+    from swarmkit_tpu.orchestrator.autoscaler import registry_sampler
+    from swarmkit_tpu.utils.metrics import Registry
+
+    reg = Registry()
+    lt = LifecycleTracker(registry=reg)
+
+    def observe(sid, tid, dt):
+        t0 = Task(id=tid, service_id=sid, spec=TaskSpec(),
+                  status=TaskStatus(state=TaskState.PENDING,
+                                    timestamp=100.0))
+        lt.observe_task(t0)
+        t1 = Task(id=tid, service_id=sid, spec=TaskSpec(),
+                  status=TaskStatus(state=TaskState.ASSIGNED,
+                                    timestamp=100.0 + dt))
+        lt.observe_task(t1)
+
+    for k in range(8):
+        observe("slow-svc", f"s{k}", 4.0)
+        observe("fast-svc", f"f{k}", 0.01)
+    slow_t = reg.get_timer(service_edge_timer_name("slow-svc"))
+    fast_t = reg.get_timer(service_edge_timer_name("fast-svc"))
+    assert slow_t.count == 8 and fast_t.count == 8
+    # the global edge timer still aggregates everything
+    glob = reg.get_timer(
+        'swarm_task_lifecycle{from="pending",to="assigned"}')
+    assert glob.count == 16
+
+    # the autoscaler's target_p99 reads the service's OWN signal — a
+    # fast service next to a slow neighbor must not see 4s latencies
+    sample = registry_sampler(reg)
+    assert sample("slow-svc")["p99"] == pytest.approx(4.0)
+    assert sample("fast-svc")["p99"] == pytest.approx(0.01)
+    # unknown service falls back to the global aggregate
+    assert sample("other-svc")["p99"] == pytest.approx(4.0)
+
+    # bounded cardinality: beyond the cap no new per-service timer
+    # appears, the overflow counter ticks, the global edge still counts
+    for k in range(SERVICE_TIMER_CAP + 4):
+        observe(f"many-{k}", f"m{k}", 0.1)
+    assert reg.get_counter(
+        "swarm_task_lifecycle_service_overflow") >= 1
+    n_svc_timers = sum(
+        1 for name in reg.timers
+        if name.startswith("swarm_task_lifecycle_service{"))
+    assert n_svc_timers <= SERVICE_TIMER_CAP
+
+
+def test_block_commit_feeds_per_service_timer(frozen_clock):
+    """The columnar commit path (EventTaskBlock) carries service ids
+    through to the per-service timer."""
+    from swarmkit_tpu.obs.lifecycle import (
+        LifecycleTracker, service_edge_timer_name,
+    )
+    from swarmkit_tpu.utils.metrics import Registry
+    reg = Registry()
+    lt = LifecycleTracker(registry=reg)
+    store, _specs, _seqs = _build_store(n_nodes=8)
+    sub = store.queue.subscribe(accepts_blocks=True)
+    planner = TPUPlanner()
+    planner.enable_small_group_routing = False
+    sched = Scheduler(store, batch_planner=planner, pipeline_depth=1)
+    store.view(sched._setup_tasks_list)
+    sched.tick()
+    while True:
+        ev = sub.poll()
+        if ev is None:
+            break
+        lt.handle_event(ev)
+    store.queue.unsubscribe(sub)
+    t = reg.get_timer(service_edge_timer_name("sva"))
+    assert t is not None and t.count > 0
+
+
+# ------------------------------------- satellite: bulk index batching
+
+def test_bulk_update_tasks_batches_by_node_index(frozen_clock,
+                                                 monkeypatch):
+    """The non-block bulk path routes by_node writes through
+    _batch_index_tasks; buckets keep the insertion-ordered {id: None}
+    contract, including around items that take the full reindex route
+    (service change) mid-chunk."""
+    from swarmkit_tpu import native
+    monkeypatch.setattr(native, "get", lambda: None)   # python path
+    store = MemoryStore()
+    store.update(lambda tx: [tx.create(_mk_node(i)) for i in range(2)])
+    spec = TaskSpec(resources=_RES)
+
+    def mk(tx):
+        for svc_id in ("ba", "bb"):
+            svc, _ = _mk_service(svc_id, 0, spec)
+            tx.create(svc)
+        for k in range(6):
+            tx.create(Task(
+                id=f"bt{k}", service_id="ba", slot=k + 1,
+                desired_state=TaskState.RUNNING, spec=spec,
+                spec_version=Version(index=1),
+                status=TaskStatus(state=TaskState.PENDING)))
+    store.update(mk)
+
+    calls = []
+    orig = MemoryStore._batch_index_tasks
+
+    def spy(by_node, triples):
+        triples = list(triples)
+        calls.append(triples)
+        return orig(by_node, triples)
+
+    monkeypatch.setattr(MemoryStore, "_batch_index_tasks",
+                        staticmethod(spy))
+    news = []
+    for k in range(6):
+        t = store.raw_get(Task, f"bt{k}").copy()
+        t.node_id = "n0000" if k < 4 else "n0001"
+        if k == 2:
+            t.service_id = "bb"    # mid-chunk full-reindex item
+        t.status = TaskStatus(state=TaskState.ASSIGNED,
+                              timestamp=model_types.now(),
+                              message="m")
+        news.append(t)
+    committed, failed = store.bulk_update_tasks(
+        news, on_missing=lambda t: None, on_assigned=lambda t: True)
+    assert len(committed) == 6 and not failed
+    # batching actually happened and the reindex item split the batch
+    # (pending triples flushed BEFORE the service-changed item's
+    # _unindex/_index, which itself writes by_node per-item)
+    assert len(calls) >= 2
+    by_node = store._tables["tasks"].by_node
+    # per-item commit order preserved inside each bucket — including
+    # around the full-reindex item
+    assert list(by_node["n0000"]) == ["bt0", "bt1", "bt2", "bt3"]
+    assert list(by_node["n0001"]) == ["bt4", "bt5"]
+    assert "bt2" in store._tables["tasks"].by_service.get("bb", {})
+
+
+# ----------------------------------------------- bench_compare gates
+
+def test_bench_compare_streaming_gates(tmp_path):
+    """bench_compare exits 1 when cfg10's streaming plane was enabled
+    but inactive, when its timed window paid an XLA compile, or when
+    the pending->assigned p99 regressed > 20%; clean runs pass."""
+    import bench_compare
+
+    def record(incremental=12, compiles=0, p99=0.2, enabled=True):
+        return {"t": 1.0, "value": 250000.0, "unit": "d/s",
+                "metric": "m", "health": "pass",
+                "planner_compiles": 0,
+                "configs": {
+                    "10_steady_state_churn": {
+                        "decisions_per_sec": 900.0,
+                        "shape_cost_x": 1.0, "compiles": compiles,
+                        "streaming": {
+                            "enabled": enabled, "dirty_frac": 0.01,
+                            "resyncs": 0, "fallbacks": 0,
+                            "incremental_ticks": incremental},
+                        "pending_assigned_p99_s": p99}},
+                "pipeline_depth": 1, "plan_hidden_frac": 0.0,
+                "plan_commit_overlap_s": 0.0,
+                "plan_overlap_source": "headline"}
+
+    hist = tmp_path / "hist.jsonl"
+
+    def run(old, new):
+        with open(hist, "w") as f:
+            f.write(json.dumps(old) + "\n")
+            f.write(json.dumps(new) + "\n")
+        return bench_compare.main(["--history", str(hist)])
+
+    assert run(record(), record()) == 0
+    # enabled-but-inactive: the run silently measured full replans
+    assert run(record(), record(incremental=0)) == 1
+    # hatch off is exempt (not streaming evidence, but not a lie)
+    assert run(record(), record(incremental=0, enabled=False)) == 0
+    # a compile landed inside the timed window
+    assert run(record(), record(compiles=1)) == 1
+    # pending->assigned p99 regression > 20%
+    assert run(record(p99=0.2), record(p99=0.3)) == 1
+    assert run(record(p99=0.2), record(p99=0.22)) == 0
+
+
+# ---------------------------------------------------------------- slow
+
+@pytest.mark.slow
+def test_steady_state_churn_wide_sweep():
+    """Acceptance: 20 seeds of steady-state-churn, all green under the
+    incremental-equals-full-replan differential, required coverage
+    (incl. streaming-resync x scheduler) present, byte-identical
+    re-runs for sampled seeds."""
+    run_scenario("steady-state-churn", 0)   # warm the jit signatures
+    reports = chaos_sweep.sweep(("steady-state-churn",), n_seeds=20)
+    out = chaos_sweep.verdict(reports, ("steady-state-churn",), 20, 0)
+    assert out["ok"], json.dumps(
+        {"failures": out["failures"],
+         "uncovered": out["coverage"]["uncovered"]}, indent=2)
+    by_seed = {r.seed: r for r in reports}
+    for seed in (0, 7, 13):
+        r2 = run_scenario("steady-state-churn", seed, keep_trace=True)
+        assert r2.trace_hash == by_seed[seed].trace_hash, seed
+
+
+@pytest.mark.slow
+def test_steady_state_churn_hashseed_independent():
+    """Byte-identical across PYTHONHASHSEED: hash-ordered containers
+    must not leak into the dirty-set drain order or placements."""
+    code = ("from swarmkit_tpu.sim.scenario import run_scenario;"
+            "r = run_scenario('steady-state-churn', 0);"
+            "print(r.trace_hash, r.ok)")
+    outs = []
+    for hs in ("0", "31337"):
+        env = dict(os.environ, PYTHONHASHSEED=hs, JAX_PLATFORMS="cpu")
+        p = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                           env=env, capture_output=True, text=True,
+                           timeout=600)
+        assert p.returncode == 0, p.stderr[-2000:]
+        outs.append(p.stdout.strip().splitlines()[-1])
+    assert outs[0] == outs[1], outs
+    assert outs[0].endswith("True"), outs

@@ -99,13 +99,17 @@ def test_rule_passes_clean_twin(rule):
     #                            GIL-released native fan-out under the
     #                            writer lock (ISSUE 13 commit plane)
     ("layering", 4),           # state/manager/sim/orchestrator imports
-    ("device-path-purity", 11),  # float()/np./jax.debug/.item() + the
+    ("device-path-purity", 14),  # float()/np./jax.debug/.item() + the
     #                              fused shapes: np/.item() in a scan
     #                              step, mid-program device_get,
     #                              block_until_ready in a mesh kernel +
     #                              the preempt-kernel shapes (ISSUE 10):
     #                              np.cumsum/int() in the pick scan,
-    #                              picks fetched mid-program
+    #                              picks fetched mid-program + the
+    #                              donation shapes (ISSUE 14): host
+    #                              read of a resident array inside the
+    #                              donated update program, 2x reuse of
+    #                              a donated buffer after dispatch
     ("metric-hygiene", 4),     # bad chars/unsorted/duplicate/upper key
 ])
 def test_rule_sensitivity_floor(rule, min_findings):
