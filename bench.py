@@ -1346,6 +1346,177 @@ def run_steady_state_churn(planner_factory):
     }
 
 
+def run_fragmentation(planner_factory):
+    """Config 11: placement-strategy fragmentation (ISSUE 15).  400
+    uniform nodes (16 cpu) receive mixed-size replicas — 800 small
+    (1 cpu), 300 medium (4 cpu), 200 large (8 cpu) plus a 100-task
+    node.ip-CIDR-constrained service (the closed device-path waiver:
+    ``fallback_groups`` must stay 0) — in ONE tick, twice: every
+    service under the ``spread`` strategy, then the identical workload
+    under ``binpack``.  Reported per pass: decisions/sec (the spread
+    pass is "spread through the strategy seam" — bench_compare gates
+    its regression at 10%) and the STRANDED-CAPACITY fraction: the
+    share of free cpu sitting on partially-loaded nodes in slices too
+    small to hold one more large replica.  bench_compare gates
+    binpack < spread on that fraction, zero strategy fallbacks, and
+    compile-flat timed windows (the warm-up pass covers the strategy
+    kernels' signatures)."""
+    _trim_heap()
+    from swarmkit_tpu.models import (
+        Annotations, Node, NodeDescription, NodeSpec, NodeState,
+        NodeStatus, Placement, ReplicatedService, Resources,
+        ResourceRequirements, Service, ServiceMode, ServiceSpec, Task,
+        TaskSpec, TaskState, TaskStatus, Version,
+    )
+    from swarmkit_tpu.scheduler import Scheduler
+    from swarmkit_tpu.state import MemoryStore
+    from swarmkit_tpu.utils import new_id
+    from swarmkit_tpu.utils.metrics import registry as _reg
+
+    N_N = int(os.environ.get("BENCH_CFG11_NODES", 400))
+    CPU_UNIT = 10 ** 9
+    NODE_CPU = 16 * CPU_UNIT
+    LARGE_D = 8 * CPU_UNIT
+    MIXES = (("small", 1, 800), ("medium", 4, 300), ("large", 8, 200))
+    N_IP = 100
+
+    def build(strategy):
+        store = MemoryStore()
+        nodes = []
+        for i in range(N_N):
+            # two /16s: the CIDR-constrained service may only use 10.0/16
+            addr = f"10.{i % 2}.{(i // 2) // 250}.{(i // 2) % 250 + 1}"
+            nodes.append(Node(
+                id=new_id(),
+                spec=NodeSpec(annotations=Annotations(name=f"f{i:04d}")),
+                status=NodeStatus(state=NodeState.READY, addr=addr),
+                description=NodeDescription(
+                    hostname=f"f{i:04d}",
+                    resources=Resources(nano_cpus=NODE_CPU,
+                                        memory_bytes=64 << 30))))
+        svcs, tasks = [], []
+
+        def add_service(name, cpus, count, constraints=None):
+            spec = TaskSpec(
+                resources=ResourceRequirements(reservations=Resources(
+                    nano_cpus=cpus * CPU_UNIT,
+                    memory_bytes=(cpus << 30) // 4)),
+                placement=Placement(constraints=constraints or [],
+                                    strategy=strategy))
+            svc = Service(
+                id=new_id(),
+                spec=ServiceSpec(annotations=Annotations(name=name),
+                                 mode=ServiceMode.REPLICATED,
+                                 replicated=ReplicatedService(
+                                     replicas=count),
+                                 task=spec),
+                spec_version=Version(index=1))
+            svcs.append(svc)
+            for s in range(count):
+                tasks.append(Task(
+                    id=new_id(), service_id=svc.id, slot=s + 1,
+                    desired_state=TaskState.RUNNING, spec=spec,
+                    spec_version=Version(index=1),
+                    status=TaskStatus(state=TaskState.PENDING)))
+
+        for name, cpus, count in MIXES:
+            add_service(f"frag-{name}", cpus, count)
+        add_service("frag-ip", 1, N_IP,
+                    constraints=["node.ip==10.0.0.0/16"])
+
+        def mk(tx):
+            for n in nodes:
+                tx.create(n)
+            for s in svcs:
+                tx.create(s)
+        store.update(mk)
+        store.update(lambda tx: (
+            [tx.create(t) for t in tasks] and None))
+        n_tasks = sum(c for _, _, c in MIXES) + N_IP
+        return store, n_tasks
+
+    def one_pass(strategy):
+        store, n_tasks = build(strategy)
+        planner = planner_factory()
+        sched = Scheduler(store, batch_planner=planner)
+        store.view(sched._setup_tasks_list)
+        gc.collect()
+        gc.freeze()
+        t0 = time.perf_counter()
+        n_dec = sched.tick()
+        dt = time.perf_counter() - t0
+        gc.unfreeze()
+        placed = sum(
+            1 for t in store.view(lambda tx: tx.find(Task))
+            if t.node_id and t.status.state >= TaskState.ASSIGNED)
+        assert placed == n_tasks, \
+            f"cfg11/{strategy}: {placed}/{n_tasks} placed"
+        # stranded capacity: free cpu on PARTIALLY loaded nodes in
+        # slices too small for one more large replica, as a fraction
+        # of all free cpu
+        free = [info.available_resources.nano_cpus
+                for info in sched.node_set.nodes.values()]
+        total_free = sum(free)
+        stranded = sum(f for f in free if 0 < f < LARGE_D)
+        frac = stranded / total_free if total_free else 0.0
+        ip_nodes = {t.node_id for t in store.view(
+            lambda tx: tx.find(Task))
+            if t.node_id and t.spec.placement
+            and t.spec.placement.constraints}
+        addr_of = {n.id: n.status.addr for n in store.view(
+            lambda tx: tx.find(Node))}
+        assert all(addr_of[nid].startswith("10.0.")
+                   for nid in ip_nodes), "cfg11: CIDR constraint leaked"
+        return planner, sched, n_dec, dt, frac
+
+    # warm-up: both strategies once, tracer off — covers the spread
+    # AND strategy-kernel jit signatures this config touches
+    from swarmkit_tpu.obs import tracer as _tracer
+    was_tracing = _tracer.enabled
+    _tracer.disable()
+    try:
+        one_pass("spread")
+        one_pass("binpack")
+        _trim_heap()
+    finally:
+        _tracer.enabled = was_tracing
+
+    snap = _planner_counter_snapshot()
+    fb0 = sum(_reg.get_counter(
+        f'swarm_strategy_fallbacks{{strategy="{s}"}}')
+        for s in ("spread", "binpack"))
+    dev0 = _reg.get_counter(
+        'swarm_strategy_groups{route="device",strategy="binpack"}')
+    _, _, dec_sp, dt_sp, frac_sp = one_pass("spread")
+    planner_bp, _, dec_bp, dt_bp, frac_bp = one_pass("binpack")
+    routed = _planner_counter_delta(snap)
+    fallbacks = int(sum(_reg.get_counter(
+        f'swarm_strategy_fallbacks{{strategy="{s}"}}')
+        for s in ("spread", "binpack")) - fb0)
+    binpack_device_groups = int(_reg.get_counter(
+        'swarm_strategy_groups{route="device",strategy="binpack"}')
+        - dev0)
+    return {
+        "nodes": N_N,
+        "tasks": sum(c for _, _, c in MIXES) + N_IP,
+        "decisions": dec_sp,
+        "decisions_per_sec": round(dec_sp / dt_sp, 1),
+        "spread_decisions_per_sec": round(dec_sp / dt_sp, 1),
+        "binpack_decisions_per_sec": round(dec_bp / dt_bp, 1),
+        "stranded_frac_spread": round(frac_sp, 4),
+        "stranded_frac_binpack": round(frac_bp, 4),
+        "stranded_improvement_x": round(frac_sp / frac_bp, 2)
+        if frac_bp else None,
+        "strategy_fallbacks": fallbacks,
+        "binpack_device_groups": binpack_device_groups,
+        "tick_s": round(dt_sp, 3),
+        "fallback_groups": routed["groups_fallback"],
+        "path": "device+strategy",
+        "shape_cost_x": 1.0,
+        "compiles": _compile_delta(snap),
+    }
+
+
 def run_e2e(n_agents=5, n_replicas=500):
     """swarm-bench equivalent: create an N-replica service and measure
     per-task time from service creation to RUNNING status committed
@@ -1634,6 +1805,15 @@ def main():
         with tracer.span("bench.config", "bench", cfg="cfg10"):
             configs["10_steady_state_churn"] = \
                 run_steady_state_churn(tpu)
+    if _cfg_enabled(11):
+        # mixed-size replicas under spread vs binpack through the
+        # strategy seam: stranded-capacity fraction + the node.ip-CIDR
+        # device column (bench_compare gates binpack < spread, zero
+        # strategy fallbacks, fallback_groups 0, compile-flat windows,
+        # and spread dec/s regression <= 10%)
+        with tracer.span("bench.config", "bench", cfg="cfg11"):
+            configs["11_fragmentation_strategies"] = \
+                run_fragmentation(tpu)
     if SKIP_E2E:
         e2e = None
     else:
@@ -1778,6 +1958,14 @@ def _append_history(artifact):
                 "streaming_speedup": cfg.get("streaming_speedup"),
                 "pending_assigned_p99_s": cfg.get(
                     "pending_assigned_p99_s"),
+                "spread_decisions_per_sec": cfg.get(
+                    "spread_decisions_per_sec"),
+                "binpack_decisions_per_sec": cfg.get(
+                    "binpack_decisions_per_sec"),
+                "stranded_frac_spread": cfg.get("stranded_frac_spread"),
+                "stranded_frac_binpack": cfg.get(
+                    "stranded_frac_binpack"),
+                "strategy_fallbacks": cfg.get("strategy_fallbacks"),
             }
             for name, cfg in artifact["configs"].items()},
     }

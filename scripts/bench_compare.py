@@ -43,6 +43,7 @@ def _norm(doc):
     quota_clamps = {}
     commit_phase, native_commit = {}, {}
     streaming, p99 = {}, {}
+    strategy = {}
     for name, cfg in (doc.get("configs") or {}).items():
         dps = cfg.get("decisions_per_sec")
         if dps:
@@ -61,6 +62,16 @@ def _norm(doc):
             streaming[name] = cfg["streaming"]
         if cfg.get("pending_assigned_p99_s") is not None:
             p99[name] = float(cfg["pending_assigned_p99_s"])
+        if cfg.get("stranded_frac_spread") is not None:
+            strategy[name] = {
+                "stranded_frac_spread": cfg.get("stranded_frac_spread"),
+                "stranded_frac_binpack": cfg.get(
+                    "stranded_frac_binpack"),
+                "spread_decisions_per_sec": cfg.get(
+                    "spread_decisions_per_sec"),
+                "strategy_fallbacks": cfg.get("strategy_fallbacks"),
+                "fallback_groups": cfg.get("fallback_groups"),
+            }
         compiles[name] = _compiles(cfg.get("compiles"))
     return {
         # commit-plane fields (ISSUE 13): per-config commit wall and the
@@ -82,6 +93,10 @@ def _norm(doc):
         # dict and the pending->assigned p99 the regression bound judges
         "streaming": streaming,
         "pending_assigned_p99_s": p99,
+        # strategy-seam evidence per config (cfg11): fragmentation pair,
+        # spread-through-the-seam dec/s, and the fallback counters the
+        # gates pin at zero
+        "strategy": strategy,
         "headline_compiles": _compiles(doc.get("planner_compiles")),
         "t": doc.get("t"),
         "health": (doc.get("health") or {}).get("status")
@@ -321,6 +336,59 @@ def main(argv=None) -> int:
             gate_failures.append(
                 ("streaming-p99-regression",
                  f"{_STREAM_CFG} p99 {p99_old}->{p99_new}"))
+    # strategy-seam gates (ISSUE 15), judged on the NEW run's cfg11:
+    # (a) binpack must actually beat spread on the stranded-capacity
+    # fraction — the whole point of shipping the policy; (b) zero
+    # strategy fallbacks for spread/binpack (every group served by its
+    # selected strategy); (c) fallback_groups 0 (the node.ip-CIDR
+    # device column holds — constrained services no longer leave the
+    # device path); (d) compile-flat timed windows; (e) spread THROUGH
+    # the seam regressing >10% vs the old run loses the seam's
+    # no-overhead contract even inside the global 20% threshold.
+    _FRAG_CFG = "11_fragmentation_strategies"
+    if _FRAG_CFG in new.get("configs", {}):
+        sg = new.get("strategy", {}).get(_FRAG_CFG) or {}
+        sf, bf = (sg.get("stranded_frac_spread"),
+                  sg.get("stranded_frac_binpack"))
+        print(f"strategy[{_FRAG_CFG}]: stranded spread={sf} "
+              f"binpack={bf} fallbacks={sg.get('strategy_fallbacks')} "
+              f"fallback_groups={sg.get('fallback_groups')}")
+        if sf is None or bf is None or not bf < sf:
+            print(f"\n{_FRAG_CFG}: binpack did not beat spread on "
+                  f"stranded capacity ({bf} vs {sf})", file=sys.stderr)
+            gate_failures.append(("strategy-fragmentation",
+                                  f"binpack={bf} spread={sf}"))
+        if sg.get("strategy_fallbacks"):
+            print(f"\n{_FRAG_CFG}: strategy fallbacks counted",
+                  file=sys.stderr)
+            gate_failures.append(
+                ("strategy-fallback",
+                 f"strategy_fallbacks={sg.get('strategy_fallbacks')}"))
+        if sg.get("fallback_groups"):
+            print(f"\n{_FRAG_CFG}: node.ip-constrained groups left the "
+                  "device path", file=sys.stderr)
+            gate_failures.append(
+                ("strategy-device-waiver",
+                 f"fallback_groups={sg.get('fallback_groups')}"))
+        cfg11_compiles = new.get("compiles", {}).get(_FRAG_CFG, 0)
+        if cfg11_compiles:
+            print(f"\n{_FRAG_CFG} paid {cfg11_compiles} XLA compile(s) "
+                  "in its timed window", file=sys.stderr)
+            gate_failures.append(("strategy-compile-growth",
+                                  f"compiles={cfg11_compiles}"))
+        sp_old = (old.get("strategy", {}).get(_FRAG_CFG) or {}).get(
+            "spread_decisions_per_sec")
+        sp_new = sg.get("spread_decisions_per_sec")
+        if sp_old is not None or sp_new is not None:
+            print(f"spread_decisions_per_sec[{_FRAG_CFG}]: "
+                  f"{sp_old} -> {sp_new}")
+        if sp_old and sp_new and sp_new < sp_old * 0.90:
+            print(f"\n{_FRAG_CFG} spread-through-the-seam dec/s "
+                  f"regressed {sp_old} -> {sp_new} (>10%)",
+                  file=sys.stderr)
+            gate_failures.append(
+                ("strategy-spread-regression",
+                 f"spread dps {sp_old}->{sp_new}"))
     # commit-plane gates (ISSUE 13), judged on the live-manager configs:
     # (a) the commit phase regressing >20% wall-clock loses the columnar
     # plane's win even while decisions/s still clears the threshold;

@@ -65,6 +65,14 @@ _BANNED_CALLS = {
 _RANDOM_GLOBAL_FNS = {"random", "randint", "uniform", "choice", "shuffle",
                       "randrange", "sample", "betavariate", "gauss"}
 
+# numpy's global-RNG twins (ISSUE 15: the learned-scorer strategy made
+# numpy arrays a production data path — weight loading must read the
+# checked-in artifact, NEVER fall back to a random init; device kernels
+# must not mint noise outside an injected seeded Generator)
+_NUMPY_GLOBAL_FNS = {"rand", "randn", "randint", "random", "choice",
+                     "shuffle", "permutation", "normal", "uniform",
+                     "seed"}
+
 
 def _is_or_default(node: ast.Call) -> bool:
     """True for the injected-seam constructor-default idiom
@@ -107,4 +115,18 @@ class DeterminismSeam(Checker):
                     self.name, node,
                     f"{dotted}() draws from the global unseeded RNG; use "
                     "an injected random.Random(seed)"))
+            elif dotted == "numpy.random.default_rng" and not node.args \
+                    and not node.keywords:
+                out.append(mod.finding(
+                    self.name, node,
+                    "numpy.random.default_rng() with no seed: pass an "
+                    "explicit seed (learned-scorer weights load from the "
+                    "checked-in artifact, never a random init)"))
+            elif dotted.startswith("numpy.random.") \
+                    and dotted.rsplit(".", 1)[1] in _NUMPY_GLOBAL_FNS:
+                out.append(mod.finding(
+                    self.name, node,
+                    f"{dotted}() draws from numpy's global RNG; use a "
+                    "seeded numpy.random.default_rng(seed) (and never "
+                    "random-init scorer weights)"))
         return out

@@ -32,6 +32,7 @@ from ..models.types import (
 from ..state.events import Event, EventCommit, EventSnapshotRestore
 from ..state.store import Batch, ByName, MemoryStore
 from ..state.watch import Closed
+from .netdriver import NetworkDriverRegistry
 
 log = logging.getLogger("allocator")
 
@@ -216,10 +217,17 @@ class Allocator:
 
     def __init__(self, store: MemoryStore,
                  address_pools: Optional[List[str]] = None,
-                 subnet_size: int = 24):
+                 subnet_size: int = 24,
+                 network_drivers: Optional[NetworkDriverRegistry] = None):
         self.store = store
         self.ports = PortAllocator()
         self.ipam = IPAM(address_pools, subnet_size)
+        # pluggable network-driver seam (manager/netdriver.py): the
+        # driver named by NetworkSpec.driver_config owns each network's
+        # subnet + address lifecycle; the default wraps self.ipam (read
+        # through a getter, so _resync's IPAM rebuild stays visible)
+        self.net_drivers = network_drivers or NetworkDriverRegistry(
+            lambda: self.ipam)
         self._stop = threading.Event()
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -277,17 +285,19 @@ class Allocator:
     def _restore_ipam(self, tx) -> None:
         for net in tx.find(Network):
             if net.ipam is not None:
-                self.ipam.restore_network(net)
+                self.net_drivers.for_network(net).restore_network(net)
             else:
                 self._pending_networks[net.id] = net
+        drv = self.net_drivers.for_id
         for s in tx.find(Service):
             if s.endpoint is not None:
                 for vip in s.endpoint.virtual_ips:
-                    self.ipam.restore_ip(vip.network_id, vip.addr)
+                    drv(vip.network_id).restore_ip(vip.network_id,
+                                                   vip.addr)
         for t in tx.find(Task):
             for att in t.networks:
                 for addr in att.addresses:
-                    self.ipam.restore_ip(att.network_id, addr)
+                    drv(att.network_id).restore_ip(att.network_id, addr)
 
     def _resync(self) -> None:
         self._pending_tasks.clear()
@@ -296,6 +306,10 @@ class Allocator:
         self.ports = PortAllocator()
         self.ipam = IPAM([str(p) for p in self.ipam.pools],
                          self.ipam.subnet_size)
+        # driver bindings rebuild from the fresh view below (the default
+        # driver reads self.ipam through its getter, so the instance
+        # swap above is already visible to it)
+        self.net_drivers.reset_bindings()
 
         def init(tx):
             self._restore_ipam(tx)
@@ -319,7 +333,8 @@ class Allocator:
                 self._pending_tasks.pop(obj.id, None)
                 for att in obj.networks:
                     for addr in att.addresses:
-                        self.ipam.release_ip(att.network_id, addr)
+                        self.net_drivers.for_id(att.network_id) \
+                            .release_ip(att.network_id, addr)
             elif obj.status.state == TaskState.NEW:
                 self._pending_tasks[obj.id] = obj
         elif isinstance(obj, Service):
@@ -327,13 +342,15 @@ class Allocator:
                 self.ports.release(obj.endpoint)
                 if obj.endpoint is not None:
                     for vip in obj.endpoint.virtual_ips:
-                        self.ipam.release_ip(vip.network_id, vip.addr)
+                        self.net_drivers.for_id(vip.network_id) \
+                            .release_ip(vip.network_id, vip.addr)
                 self._pending_services.pop(obj.id, None)
             elif self._service_needs_allocation(obj):
                 self._pending_services[obj.id] = obj
         elif isinstance(obj, Network):
             if ev.action == "delete":
-                self.ipam.release_network(obj.id)
+                self.net_drivers.release_binding(obj.id) \
+                    .release_network(obj.id)
                 self._pending_networks.pop(obj.id, None)
             elif obj.ipam is None:
                 self._pending_networks[obj.id] = obj
@@ -394,8 +411,15 @@ class Allocator:
                     if cur is None or cur.ipam is not None:
                         return
                     cur = cur.copy()
+                    cfg = getattr(cur.spec, "driver_config", None)
+                    if cfg and cfg.name \
+                            and not self.net_drivers.known(cfg.name):
+                        log.warning("network %s names unknown driver "
+                                    "%r; using the default IPAM",
+                                    network.id, cfg.name)
                     try:
-                        cur.ipam = self.ipam.allocate_network(cur)
+                        cur.ipam = self.net_drivers.for_network(cur) \
+                            .allocate_network(cur)
                     except ValueError as e:
                         log.warning("network %s allocation failed: %s",
                                     network.id, e)
@@ -473,14 +497,18 @@ class Allocator:
                     old_vips = {v.network_id: v
                                 for v in (old_endpoint.virtual_ips
                                           if old_endpoint else [])}
+                    drv = self.net_drivers.for_id
                     try:
                         for nid in net_ids:
                             if nid in old_vips:
                                 vips.append(old_vips.pop(nid))
                                 continue
+                            # VIP row kept even for addressing-free
+                            # drivers (addr ""): the needs-allocation
+                            # check counts VIPs per network id
                             vip = EndpointVIP(
                                 network_id=nid,
-                                addr=self.ipam.allocate_ip(nid))
+                                addr=drv(nid).allocate_ip(nid))
                             vips.append(vip)
                             fresh.append(vip)
                     except ValueError as e:
@@ -488,13 +516,15 @@ class Allocator:
                         # partial endpoint (a partial write re-triggers
                         # allocation on its own commit — a hot loop)
                         for vip in fresh:
-                            self.ipam.release_ip(vip.network_id, vip.addr)
+                            drv(vip.network_id).release_ip(
+                                vip.network_id, vip.addr)
                         unwind_ports()
                         log.warning("service %s VIP allocation failed: "
                                     "%s", cur.id, e)
                         return
                     for stale in old_vips.values():
-                        self.ipam.release_ip(stale.network_id, stale.addr)
+                        drv(stale.network_id).release_ip(
+                            stale.network_id, stale.addr)
                     if old_endpoint is not None and not old_vips and \
                             [(p.protocol, p.target_port, p.published_port,
                               p.publish_mode) for p in ports] == \
@@ -554,16 +584,19 @@ class Allocator:
                         pairs = list({nid: (nid, cfg) for nid, cfg in
                                       zip(net_ids, net_cfgs)}.values())
                         attachments = []
+                        drv = self.net_drivers.for_id
                         try:
                             for nid, cfg in pairs:
+                                addr = drv(nid).allocate_ip(nid)
                                 attachments.append(NetworkAttachment(
                                     network_id=nid,
-                                    addresses=[self.ipam.allocate_ip(nid)],
+                                    addresses=[addr] if addr else [],
                                     aliases=list(cfg.aliases)))
                         except ValueError as e:
                             for att in attachments:
                                 for a in att.addresses:
-                                    self.ipam.release_ip(att.network_id, a)
+                                    drv(att.network_id).release_ip(
+                                        att.network_id, a)
                             log.warning("task %s address allocation "
                                         "failed: %s", t.id, e)
                             return

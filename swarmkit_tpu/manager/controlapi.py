@@ -25,6 +25,7 @@ from ..models.types import (
     EndpointResolutionMode, NodeRole, PublishMode, TaskState, Version, now,
 )
 from ..scheduler import constraint as constraint_mod
+from ..scheduler import strategy as strategy_mod
 from ..state.store import (
     AlreadyExists as StoreExists, ByKind, ByName, ByNamePrefix,
     ByReferencedSecret, ByReferencedConfig, MemoryStore, NameConflict,
@@ -133,6 +134,22 @@ def _validate_task_spec(task_spec) -> None:
             constraint_mod.parse(placement.constraints)
         except constraint_mod.InvalidConstraint as e:
             raise InvalidArgument(str(e))
+    if placement is not None:
+        name = (placement.strategy or "").lower()
+        if name and strategy_mod.resolve(name) is None:
+            raise InvalidArgument(
+                f"Placement: unknown placement_strategy {name!r} "
+                f"(known: {', '.join(sorted(strategy_mod.REGISTRY))})")
+        for key, val in (placement.strategy_weights or {}).items():
+            if key not in strategy_mod.WEIGHT_KEYS:
+                raise InvalidArgument(
+                    f"Placement: unknown strategy weight {key!r} "
+                    f"(known: {', '.join(strategy_mod.WEIGHT_KEYS)})")
+            if not isinstance(val, int) or isinstance(val, bool) \
+                    or not 0 <= val <= strategy_mod.W_CLAMP:
+                raise InvalidArgument(
+                    f"Placement: strategy weight {key!r} must be an "
+                    f"integer in [0, {strategy_mod.W_CLAMP}]")
     c = task_spec.container
     if c is None and task_spec.generic_runtime is None \
             and task_spec.attachment is None:

@@ -211,6 +211,19 @@ class TaskSpec:
     # task creation when unset (orchestrator/common.effective_task_spec).
     priority: int = 0
 
+    def __post_init__(self) -> None:
+        # strategy-seam differential knob: SWARM_DEFAULT_PLACEMENT_
+        # STRATEGY stamps every spec whose strategy is unset — the
+        # seam-identity twin runs the SAME scenario with "" and an
+        # explicit "spread" and asserts byte-identical behavior
+        # (tests/test_strategy.py).  Unset (production) this is a no-op.
+        if not self.placement.strategy:
+            import os
+            default = os.environ.get(
+                "SWARM_DEFAULT_PLACEMENT_STRATEGY", "")
+            if default:
+                self.placement.strategy = default
+
     def copy(self) -> "TaskSpec":
         return TaskSpec(
             container=self.container.copy() if self.container else None,

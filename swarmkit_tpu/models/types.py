@@ -430,10 +430,21 @@ class Placement:
     preferences: List[PlacementPreference] = field(default_factory=list)
     platforms: List[Platform] = field(default_factory=list)
     max_replicas: int = 0   # per-node cap; 0 = unlimited
+    # placement-scoring strategy (scheduler/strategy.py registry):
+    # "" / "spread" (default, reference semantics), "binpack",
+    # "weighted", "learned".  Validated by controlapi; an unknown name
+    # on a task written behind the API degrades to spread (counted).
+    strategy: str = ""
+    # per-service term weights for the "weighted" strategy (keys:
+    # spread/cpu/mem/generic; ints clamped to [0, W_CLAMP] — see
+    # scheduler/strategy.py); ignored by the other strategies
+    strategy_weights: Dict[str, int] = field(default_factory=dict)
 
     def copy(self) -> "Placement":
         return Placement(list(self.constraints), list(self.preferences),
-                         [p.copy() for p in self.platforms], self.max_replicas)
+                         [p.copy() for p in self.platforms],
+                         self.max_replicas, self.strategy,
+                         dict(self.strategy_weights))
 
 
 @dataclass

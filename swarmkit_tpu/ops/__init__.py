@@ -1,6 +1,7 @@
 from .hashing import str_hash
 from .kernel import (
-    GroupInputs, NodeInputs, feasibility_and_capacity, plan_group,
-    plan_group_jit, seg_waterfill,
+    GroupInputs, NodeInputs, StrategyInputs, feasibility_and_capacity,
+    plan_group, plan_group_jit, plan_strategy, plan_strategy_jit,
+    seg_packfill, seg_waterfill, spread_score, strategy_score,
 )
 from .planner import TPUPlanner

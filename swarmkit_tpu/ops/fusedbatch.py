@@ -44,6 +44,7 @@ from jax.experimental import enable_x64
 from ..models.objects import Task
 from ..models.types import PublishMode, TaskState
 from ..scheduler import constraint as constraint_mod
+from ..scheduler import strategy as strategy_mod
 from ..scheduler.filters import normalize_arch
 from .hashing import str_hash
 from .kernel import FusedCarry, FusedGroups, FusedShared, K_CLAMP
@@ -128,6 +129,24 @@ def chunk_sizes(g: int, chunk: int) -> List[int]:
 # placement parity between the two paths is load-bearing, so the column
 # semantics live in exactly one place.
 
+def con_column_key(con) -> "Tuple[Optional[str], Optional[str]]":
+    """(column_key, expected_value) for one constraint's hash column.
+    Plain keys compare the raw node value against the raw expression;
+    node.ip compiles through constraint.ip_column_spec (canonical
+    address / containing-network-at-prefix values — the hash/prefix
+    column).  (None, None) = the constraint can never match (malformed
+    node.ip): callers encode an op-== row against the sentinel, which
+    rejects every node regardless of the written operator — exactly
+    the host ``_match_ip`` malformed behavior."""
+    if con.key.lower() == "node.ip":   # exact: "node.iptables" is an
+        #                                UNKNOWN key (host rejects all)
+        spec = constraint_mod.ip_column_spec(con)
+        if spec is None:
+            return None, None
+        return spec
+    return con.key, con.exp
+
+
 def fill_constraints(node_value: Callable, infos, n: int, constraints,
                      con_hash: np.ndarray, con_op: np.ndarray,
                      con_exp: np.ndarray) -> None:
@@ -135,7 +154,12 @@ def fill_constraints(node_value: Callable, infos, n: int, constraints,
     zeroed, ``con_op`` [Cc] pre-filled 2 (disabled), ``con_exp``
     [Cc, 2] zeroed."""
     for ci, con in enumerate(constraints):
-        values = [node_value(info, con.key) for info in infos]
+        col_key, expected = con_column_key(con)
+        if col_key is None:
+            con_op[ci] = 0
+            con_exp[ci] = SENTINEL
+            continue
+        values = [node_value(info, col_key) for info in infos]
         if any(v is None for v in values):
             # unknown key: node never matches, regardless of op
             con_op[ci] = 0
@@ -145,7 +169,7 @@ def fill_constraints(node_value: Callable, infos, n: int, constraints,
         arr = np.array(hi_lo, np.int64).T  # [2, n]
         con_hash[ci, :, :n] = arr
         con_op[ci] = con.operator
-        con_exp[ci] = split_hash(str_hash(con.exp))
+        con_exp[ci] = split_hash(str_hash(expected))
 
 
 def fill_platforms(platforms, plat: np.ndarray) -> None:
@@ -259,6 +283,12 @@ def probe_group(planner, sched,
     takes the per-group path."""
     t = next(iter(group.values()))
     if not planner._supported(t):
+        return None
+    sinfo = strategy_mod.resolve(strategy_mod.strategy_of(t))
+    if sinfo is None or sinfo.sid != strategy_mod.STRAT_SPREAD:
+        # non-spread strategies break the run: the fused scan's score
+        # stage is spread (one program shape for the whole run); they
+        # ride the per-group strategy kernel instead
         return None
     k = len(group)
     if k == 0 or k > K_CLAMP:

@@ -53,6 +53,10 @@ import jax
 import jax.numpy as jnp
 
 from ..scheduler.nodeinfo import MAX_FAILURES  # single source of truth
+from ..scheduler.strategy import (  # strategy-seam shared envelope
+    BP_CLAMP, FEAT_CLAMP, HR_CLAMP, MLP_SHIFT, SCORE_CLAMP,
+    STRAT_BINPACK, STRAT_LEARNED, STRAT_SPREAD, STRAT_WEIGHTED,
+)
 
 F_BIG = 1 << 22          # failure down-weight step (dominates svc counts)
 FAILURE_CLAMP = 63       # keeps e = svc + failures*F_BIG inside int32
@@ -281,14 +285,12 @@ def plan_group(nodes: NodeInputs, group: GroupInputs, L: int,
         idx = idx + idx_offset
 
     svc = jnp.clip(nodes.svc_tasks, 0, SVC_CLAMP)
-    downweight = jnp.where(nodes.failures >= MAX_FAILURES,
-                           jnp.clip(nodes.failures, 0, FAILURE_CLAMP), 0)
     # The waterfill needs a true per-node e.  broadcast_to is a no-op for
     # today's full-width inputs; it future-proofs against callers shipping
     # broadcastable length-1 stand-ins for no-signal arrays (tried for H2D
     # savings and currently off — see the recompile trade-off note in
     # planner._build_device_inputs before re-enabling).
-    e = jnp.broadcast_to(svc + downweight * F_BIG,
+    e = jnp.broadcast_to(spread_score(nodes),
                          nodes.ready.shape).astype(jnp.int32)
 
     # ---- stage A: allocation down the branch hierarchy
@@ -377,6 +379,167 @@ def plan_group(nodes: NodeInputs, group: GroupInputs, L: int,
 def plan_group_jit(nodes: NodeInputs, group: GroupInputs, L: int,
                    hier: Tuple = ()) -> jnp.ndarray:
     return plan_group(nodes, group, L, hier=hier)
+
+
+# ------------------------------------------------------- strategy seam
+#
+# The scoring stage is pluggable (scheduler/strategy.py registry):
+# every strategy shares the SAME feasibility masks, bucket ladder and
+# placement primitives (seg_waterfill / seg_packfill below); only the
+# per-node score column differs.  Spread keeps riding plan_group /
+# plan_fused untouched (its score is `spread_score` — the factored
+# pre-seam computation, byte-identical by construction); the
+# alternative strategies run through `plan_strategy_jit`, a separate
+# jitted entry so spread's jit signatures cannot change.  Each device
+# strategy's host oracle lives in scheduler/strategy.py: identical
+# integer columns, identical integer formulas, bit-equal placements —
+# the planner's breaker can demote any strategy group to the host
+# oracle mid-tick without moving a single task.
+
+def _downweight(failures: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(failures >= MAX_FAILURES,
+                     jnp.clip(failures, 0, FAILURE_CLAMP), 0)
+
+
+def spread_score(nodes: NodeInputs) -> jnp.ndarray:
+    """The spread strategy's effective level: per-service count,
+    failure-down-weighted (scheduler.go:708 nodeLess) — exactly the
+    pre-seam inline computation, now the seam's default scorer."""
+    svc = jnp.clip(nodes.svc_tasks, 0, SVC_CLAMP)
+    return svc + _downweight(nodes.failures) * F_BIG
+
+
+class StrategyInputs(NamedTuple):
+    """Per-group strategy columns/parameters, densified host-side
+    (exact int64 headroom divisions, mirrored by the host oracle).
+    Unused members ship as zeros — the static ``strategy`` argument
+    already separates jit signatures, so no Optional-field games."""
+
+    hr_cpu: jnp.ndarray   # i32[N] cpu headroom in demand units
+    hr_mem: jnp.ndarray   # i32[N] memory headroom in demand units
+    hr_gen: jnp.ndarray   # i32[N] generic-resource headroom (min kind)
+    weights: jnp.ndarray  # i32[4] weighted terms [spread,cpu,mem,gen]
+    w1: jnp.ndarray       # i32[F, H] learned-scorer layer 1
+    b1: jnp.ndarray       # i32[H]
+    w2: jnp.ndarray       # i32[H]
+    b2: jnp.ndarray       # i32[] scalar
+
+
+def seg_packfill(key: jnp.ndarray, cap: jnp.ndarray,
+                 k_seg: jnp.ndarray, seg: jnp.ndarray, L: int,
+                 reduce: Reduce = _identity) -> jnp.ndarray:
+    """Sequential (pack) fill within each segment: nodes take their
+    full capacity in ascending ``key`` order until k is placed — the
+    binpack placement primitive.  Keys must be unique per segment
+    (callers pack the node index into the low bits).  Same
+    threshold-search shape as seg_waterfill's tie stage, so it runs
+    under shard_map with the identical ``reduce`` contract."""
+    cap = cap.astype(jnp.int32)
+    kf = k_seg.astype(jnp.float32)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = lo + (hi - lo) // 2   # avoids int32 overflow of lo + hi
+        cnt = reduce(_seg_sum_f32(
+            jnp.where(key <= mid[seg], cap, 0), seg, L))
+        ge = cnt >= kf
+        return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
+
+    lo = jnp.full((L,), -1, jnp.int32)
+    hi = jnp.full((L,), 1 << 30, jnp.int32)  # keys are < 2^30
+    lo, hi = jax.lax.fori_loop(0, TIE_ITERS, body, (lo, hi))
+    thr = hi   # minimal key threshold with fill >= k (2^30 infeasible)
+
+    x = jnp.where(key < thr[seg], cap, 0)
+    f = reduce(_seg_sum_f32(x, seg, L))
+    # remainder is exact: whenever r > 0, f < k <= K_CLAMP < 2^24
+    r = jnp.maximum(kf - f, 0.0)
+    # keys are unique, so at most one element per segment sits AT the
+    # threshold; by minimality of thr its capacity covers r
+    grant = (key == thr[seg]) & (r[seg] > 0.0)
+    return x + jnp.where(grant, jnp.minimum(
+        cap, r[seg].astype(jnp.int32)), 0)
+
+
+def _learned_score(nodes: NodeInputs, sin: StrategyInputs
+                   ) -> jnp.ndarray:
+    """Fixed-point MLP score — the device twin of
+    scheduler/strategy.learned_score_host (identical int32 ops)."""
+    f = jnp.stack([
+        jnp.clip(nodes.svc_tasks, 0, FEAT_CLAMP),
+        jnp.clip(nodes.total_tasks, 0, FEAT_CLAMP),
+        jnp.clip(nodes.failures, 0, FEAT_CLAMP),
+        jnp.clip(sin.hr_cpu, 0, FEAT_CLAMP),
+        jnp.clip(sin.hr_mem, 0, FEAT_CLAMP),
+        nodes.ready.astype(jnp.int32) * FEAT_CLAMP,
+    ], axis=-1).astype(jnp.int32)                       # [N, F]
+    # explicit multiply-add contractions (not jnp.dot): integer, exact,
+    # and XLA maps the broadcast+reduce well on TPU
+    h = jnp.sum(f[:, :, None] * sin.w1[None, :, :], axis=1) + sin.b1
+    h = jnp.clip(jnp.right_shift(h, MLP_SHIFT), 0, FEAT_CLAMP)
+    out = jnp.sum(h * sin.w2[None, :], axis=1) + sin.b2
+    return jnp.clip(jnp.right_shift(out, MLP_SHIFT), 0, SCORE_CLAMP)
+
+
+def strategy_score(nodes: NodeInputs, sin: StrategyInputs,
+                   strategy: int) -> jnp.ndarray:
+    """The pluggable scoring stage: per-node effective level (lower =
+    preferred) for the waterfill strategies.  Formulas mirror
+    scheduler/strategy.py's numpy oracles term for term."""
+    if strategy == STRAT_WEIGHTED:
+        w = sin.weights
+        return (w[0] * jnp.clip(nodes.svc_tasks, 0, SVC_CLAMP)
+                + w[1] * (HR_CLAMP - sin.hr_cpu)
+                + w[2] * (HR_CLAMP - sin.hr_mem)
+                + w[3] * (HR_CLAMP - sin.hr_gen)
+                + _downweight(nodes.failures) * F_BIG)
+    if strategy == STRAT_LEARNED:
+        return (_learned_score(nodes, sin)
+                + _downweight(nodes.failures) * F_BIG)
+    return spread_score(nodes)
+
+
+def plan_strategy(nodes: NodeInputs, group: GroupInputs,
+                  sin: StrategyInputs, strategy: int,
+                  reduce: Reduce = _identity,
+                  idx_offset: Optional[jnp.ndarray] = None):
+    """Place one task group under a non-spread strategy.  Shares the
+    fused feasibility/capacity stage (and therefore the fail-count
+    diagnostics) with plan_group; strategies ignore spread-preference
+    trees (the strategy owns the scoring stage), so placement is one
+    flat segment.  Returns the same (x, fail_counts, spill) triple as
+    plan_group — spill is constantly False (no spread branches to
+    saturate), so the planner's fetch path is shared unchanged."""
+    mask, cap, fail_counts = feasibility_and_capacity(nodes, group,
+                                                      reduce)
+    n = nodes.ready.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    if idx_offset is not None:
+        idx = idx + idx_offset
+    seg = jnp.zeros(n, jnp.int32)
+    kk = jnp.minimum(group.k, K_CLAMP).reshape(1)
+    if strategy == STRAT_BINPACK:
+        score = jnp.where(
+            nodes.failures >= MAX_FAILURES,
+            BP_CLAMP + 1 + jnp.clip(nodes.failures, 0, FAILURE_CLAMP),
+            jnp.clip(nodes.res_cap, 0, BP_CLAMP))
+        key = (score << IDX_BITS) | idx
+        x = seg_packfill(key, cap, kk, seg, 1, reduce=reduce)
+    else:
+        e = jnp.broadcast_to(
+            strategy_score(nodes, sin, strategy),
+            nodes.ready.shape).astype(jnp.int32)
+        tie = (jnp.clip(nodes.total_tasks, 0, TOTAL_CLAMP)
+               << IDX_BITS) | idx
+        x = seg_waterfill(e=e, cap=cap, tie=tie, k_seg=kk, seg=seg,
+                          L=1, reduce=reduce)
+    return x, fail_counts, jnp.zeros((), jnp.bool_)
+
+
+@functools.partial(jax.jit, static_argnames=("strategy",))
+def plan_strategy_jit(nodes: NodeInputs, group: GroupInputs,
+                      sin: StrategyInputs, strategy: int):
+    return plan_strategy(nodes, group, sin, strategy)
 
 
 # ------------------------------------------------------- fused many-service

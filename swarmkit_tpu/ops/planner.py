@@ -39,6 +39,7 @@ from ..models.types import (
     Version, now,
 )
 from ..scheduler import constraint as constraint_mod
+from ..scheduler import strategy as strategy_mod
 from ..scheduler.filters import normalize_arch, _references_volume_plugin
 from ..scheduler.nodeinfo import NodeInfo
 from ..models.types import TaskState, TaskStatus
@@ -52,8 +53,8 @@ from .fusedbatch import (
 )
 from .hashing import str_hash
 from .kernel import (
-    GroupInputs, K_CLAMP, NodeInputs, fetch_plan, plan_fused_jit,
-    plan_group_jit,
+    GroupInputs, K_CLAMP, NodeInputs, StrategyInputs, fetch_plan,
+    plan_fused_jit, plan_group_jit, plan_strategy_jit,
 )
 
 log = logging.getLogger("tpu-planner")
@@ -371,7 +372,8 @@ class TPUPlanner:
               "groups_fallback": "fallback",
               "groups_small_to_host": "host_small",
               "groups_spill_to_host": "spill",
-              "groups_breaker_to_host": "breaker"}
+              "groups_breaker_to_host": "breaker",
+              "groups_strategy_host": "strategy_host"}
 
     def _count(self, key: str, delta: int = 1) -> None:
         self.stats[key] = self.stats.get(key, 0) + delta
@@ -413,6 +415,69 @@ class TPUPlanner:
         _observe_compile(self._plan_fn, bucket, before,
                          _time.perf_counter() - t0)
         return out
+
+    def _call_strategy_fn(self, nodes_in, group_in, sin, sinfo):
+        """Strategy-kernel dispatch twin of ``_call_plan_fn``: same
+        compile observation, per-strategy bucket suffix (each static
+        strategy id is its own jit signature)."""
+        import time as _time
+        bucket = (_bucket_label(nodes_in, group_in, 1, ())
+                  + f"_st{sinfo.sid}")
+        before = _jit_cache_size(plan_strategy_jit)
+        t0 = _time.perf_counter()
+        out = plan_strategy_jit(nodes_in, group_in, sin, sinfo.sid)
+        _observe_compile(plan_strategy_jit, bucket, before,
+                         _time.perf_counter() - t0)
+        return out
+
+    def _build_strategy_inputs(self, built, t, sinfo) -> StrategyInputs:
+        """Densify the strategy-seam columns for one group: per-resource
+        headroom in demand units (exact int64 floor divisions — the
+        host oracle's build_host_columns applies the identical per-row
+        formula), the per-service weight vector, and the learned
+        scorer's fixed artifact weights.  Unused members ship as zeros;
+        the static strategy id keeps signatures apart."""
+        (infos, n, nb, valid, cpu, mem, total, _nodes_in, _group_in,
+         _L, _hier, cpu_d, mem_d, gen_wanted, _port_limited) = built
+        HR = strategy_mod.HR_CLAMP
+        if cpu_d > 0:
+            hr_cpu = np.clip(cpu // cpu_d, 0, HR).astype(np.int32)
+        else:
+            hr_cpu = np.full(nb, HR, np.int32)
+        if mem_d > 0:
+            hr_mem = np.clip(mem // mem_d, 0, HR).astype(np.int32)
+        else:
+            hr_mem = np.full(nb, HR, np.int32)
+        hr_gen = np.full(nb, HR, np.int32)
+        if gen_wanted:
+            for i, info in enumerate(infos):
+                gen_min = HR
+                for g in gen_wanted:
+                    avail = 0
+                    for r in info.available_resources.generic:
+                        if r.kind == g.kind:
+                            avail += (1 if r.res_type
+                                      == GenericResourceKind.NAMED
+                                      else r.value)
+                    gen_min = min(gen_min,
+                                  int(min(max(avail // g.value, 0), HR)))
+                hr_gen[i] = gen_min
+        if sinfo.uses_weights:
+            weights = strategy_mod.weights_of(t)
+        else:
+            weights = np.zeros(4, np.int32)
+        if sinfo.uses_learned:
+            w1, b1, w2, b2 = strategy_mod.learned_params()
+        else:
+            f = len(strategy_mod.MLP_FEATURES)
+            w1 = np.zeros((f, 1), np.int32)
+            b1 = np.zeros(1, np.int32)
+            w2 = np.zeros(1, np.int32)
+            b2 = np.zeros((), np.int32)
+        return StrategyInputs(hr_cpu=hr_cpu, hr_mem=hr_mem,
+                              hr_gen=hr_gen, weights=weights,
+                              w1=w1, b1=b1, w2=w2,
+                              b2=np.asarray(b2, np.int32))
 
     # ------------------------------------------------------- per-tick caching
 
@@ -538,12 +603,8 @@ class TPUPlanner:
             prefs = [p for p in placement.preferences if p.spread]
             if len(prefs) > 4:
                 return False  # absurdly deep spread tree: host path
-            try:
-                for con in constraint_mod.parse(placement.constraints or []):
-                    if con.key.lower() == "node.ip":
-                        return False  # CIDR semantics: host path
-            except constraint_mod.InvalidConstraint:
-                pass  # host path treats as disabled; we can too
+            # node.ip constraints (exact AND CIDR) ride the hash/prefix
+            # columns (constraint.ip_column_spec) — no longer a waiver
         res = t.spec.resources.reservations if t.spec.resources else None
         if res:
             for g in res.generic:
@@ -630,6 +691,12 @@ class TPUPlanner:
         lk = key.lower()
         if lk == "node.id":
             return node.id
+        if lk == "node.ip" or lk.startswith("node.ip/"):
+            # hash/prefix column keys minted by constraint.ip_column_spec:
+            # "node.ip" = canonical address, "node.ip/<p>" = canonical
+            # containing network at prefix length p
+            return constraint_mod.ip_node_value(
+                node.status.addr if node.status else "", lk)
         if lk == "node.hostname":
             return node.description.hostname if node.description else ""
         if lk == "node.role":
@@ -681,6 +748,22 @@ class TPUPlanner:
         if not self._supported(t):
             self._fallback()
             return None
+        sinfo = strategy_mod.resolve(strategy_mod.strategy_of(t))
+        if sinfo is None:
+            # unknown strategy name (written behind the API): the host
+            # path serves it through the spread tree and counts the
+            # strategy fallback
+            self._fallback()
+            return None
+        if sinfo.sid != strategy_mod.STRAT_SPREAD \
+                and self._plan_fn is not plan_group_jit:
+            # an injected plan_fn (mesh ShardedPlanFn, test stubs) owns
+            # the device path and has no strategy twin: the group rides
+            # its HOST ORACLE — identical placements by the seam's
+            # bit-parity contract, one densify on the host instead
+            self._count("groups_strategy_host")
+            self._cache = None   # host path mutates NodeInfos
+            return None
         if not self.breaker.allow_device():
             # degraded mode: a sick device routes every group to the
             # host oracle until the breaker's cooldown/probe admits it
@@ -705,8 +788,9 @@ class TPUPlanner:
             raise RuntimeError(
                 "dispatch_group with a plan already in flight: fetch it "
                 "first (its apply feeds this group's input columns)")
+        flat = sinfo.sid != strategy_mod.STRAT_SPREAD
         with tracer.span("plan.build_inputs", "plan", tasks=k):
-            built = self._build_device_inputs(sched, t, k)
+            built = self._build_device_inputs(sched, t, k, flat=flat)
         if built is None:
             self.breaker.abort_probe()
             self._fallback()
@@ -718,26 +802,36 @@ class TPUPlanner:
             built[10]
         try:
             with tracer.span("plan.dispatch", "plan", tasks=k):
-                arrays = self._call_plan_fn(nodes_in, group_in, L, hier)
+                if flat:
+                    sin = self._build_strategy_inputs(built, t, sinfo)
+                    arrays = self._call_strategy_fn(nodes_in, group_in,
+                                                    sin, sinfo)
+                else:
+                    arrays = self._call_plan_fn(nodes_in, group_in, L,
+                                                hier)
         except Exception:
             # device dispatch failure degrades THIS group to the host
             # path and feeds the breaker — a sick device trips to
             # wholesale host fallback instead of failing the tick
+            # (strategy groups land on their host oracle: bit-equal)
             log.exception("device dispatch failed; group routed to host")
             self._count("groups_device_error")
             self.breaker.record_failure()
             self._cache = None
             return None
+        if flat:
+            strategy_mod.count_group(sinfo.name, "device")
         handle = _InFlightPlan(sched, t, task_group, decisions, built,
                                _plan_t0, arrays)
         self._inflight.append(handle)
         return handle
 
-    def _build_device_inputs(self, sched, t, k):
+    def _build_device_inputs(self, sched, t, k, flat=False):
         """Densify the cluster + one task-group spec into kernel inputs.
         Shared by group planning and preassigned validation.  Returns None
         when a static bucket overflows (caller falls back to the host
-        path)."""
+        path).  ``flat``: skip the spread-preference tree (non-spread
+        strategies own the scoring stage — one flat segment)."""
         cols = self._densify(sched, t)
         infos, n, nb, valid, ready, cpu, mem, total = cols
         if n == 0:
@@ -878,8 +972,9 @@ class TPUPlanner:
         leaf = np.zeros(nb, np.int32)
         L = 1
         hier = ()
-        prefs = [p for p in (placement.preferences if placement else [])
-                 if p.spread]
+        prefs = [] if flat else \
+            [p for p in (placement.preferences if placement else [])
+             if p.spread]
         if len(prefs) == 1:
             # the common flat case: one pass keyed by the raw value
             # (resident leaf column when the streaming plane holds one)

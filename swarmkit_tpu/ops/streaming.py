@@ -450,17 +450,28 @@ class ResidentState:
         node-value hash columns are resident and refreshed per dirty
         row, so a group build is a vectorized copy instead of an O(N)
         Python hashing loop."""
+        from .fusedbatch import con_column_key
         self.absorb(sched)
         n = self.n
         for ci, con in enumerate(constraints):
-            entry = self.con_cols.get(con.key)
+            # node.ip constraints resolve to prefix-specific column
+            # keys ("node.ip/<p>") whose per-node values _node_value
+            # computes — the resident row maintenance below them is
+            # key-agnostic (fill_constraints parity)
+            col_key, expected = con_column_key(con)
+            if col_key is None:
+                # malformed node.ip: never matches, regardless of op
+                con_op[ci] = 0
+                con_exp[ci] = SENTINEL
+                continue
+            entry = self.con_cols.get(col_key)
             if entry is None:
                 if len(self.con_cols) >= CON_CACHE_CAP:
                     del self.con_cols[next(iter(self.con_cols))]
                 entry = _ConColumn(self.nb)
-                self.con_cols[con.key] = entry
+                self.con_cols[col_key] = entry
                 for i, info in enumerate(self.infos):
-                    self._recompute_con_row(con.key, i, info)
+                    self._recompute_con_row(col_key, i, info)
             if entry.none_count > 0:
                 # unknown key on some node: node never matches,
                 # regardless of op (fill_constraints parity)
@@ -469,7 +480,7 @@ class ResidentState:
                 continue
             con_hash[ci, :, :n] = entry.hash[:, :n]
             con_op[ci] = con.operator
-            con_exp[ci] = split_hash(str_hash(con.exp))
+            con_exp[ci] = split_hash(str_hash(expected))
 
     def flat_leaf(self, sched, descriptor: str
                   ) -> Tuple[np.ndarray, int]:

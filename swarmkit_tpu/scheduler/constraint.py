@@ -117,6 +117,51 @@ def node_matches(constraints: Sequence[Constraint], n: Node) -> bool:
     return True
 
 
+def ip_column_spec(c: Constraint):
+    """Device-path compilation of a node.ip constraint: returns
+    (column_key, expected_value) such that hashing each node's
+    ``ip_node_value(addr, column_key)`` and comparing against
+    ``hash(expected_value)`` under the constraint's ==/!= operator
+    reproduces ``_match_ip`` exactly — exact IPs compare canonical
+    address strings, CIDRs compare the canonical CONTAINING NETWORK at
+    the expression's prefix length (the "hash/prefix column").
+    Returns None for a malformed expression: the host rejects every
+    node regardless of operator, which the caller encodes as an
+    op-==-against-sentinel row."""
+    try:
+        want = ipaddress.ip_address(c.exp)
+        return "node.ip", str(want)
+    except ValueError:
+        pass
+    try:
+        subnet = ipaddress.ip_network(c.exp, strict=False)
+        return f"node.ip/{subnet.prefixlen}", str(subnet)
+    except ValueError:
+        return None
+
+
+def ip_node_value(addr: str, column_key: str) -> str:
+    """A node's match value for one node.ip column key: the canonical
+    address ("node.ip") or the canonical network containing the
+    address at the key's prefix length ("node.ip/<p>").  Unparsable or
+    empty addresses yield "" — never equal to a real canonical form,
+    matching the host's node_ip-is-None behavior (== rejects,
+    != accepts)."""
+    try:
+        ip = ipaddress.ip_address(addr) if addr else None
+    except ValueError:
+        ip = None
+    if ip is None:
+        return ""
+    if column_key == "node.ip":
+        return str(ip)
+    try:
+        prefix = int(column_key.rsplit("/", 1)[1])
+        return str(ipaddress.ip_network(f"{ip}/{prefix}", strict=False))
+    except ValueError:
+        return ""
+
+
 def _match_ip(c: Constraint, addr: str) -> bool:
     try:
         node_ip = ipaddress.ip_address(addr) if addr else None

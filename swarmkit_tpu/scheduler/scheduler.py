@@ -33,6 +33,7 @@ from ..state.store import Batch, ByName, MemoryStore, ReadTx
 from ..state.watch import Closed
 from . import genericresource
 from . import preempt as preempt_mod
+from . import strategy as strategy_mod
 from .deltatrack import DeltaTracker
 from .filters import Pipeline, VolumesFilter
 from .nodeinfo import MAX_FAILURES, NodeInfo, task_reservations
@@ -1481,8 +1482,34 @@ class Scheduler:
                              decisions: Dict[str, SchedulingDecision]
                              ) -> None:
         """The host oracle path: spread tree + sorted round-robin
-        (reference: scheduler.go:694 scheduleTaskGroup)."""
+        (reference: scheduler.go:694 scheduleTaskGroup).  Non-spread
+        strategies route to their host oracle (scheduler/strategy.py) —
+        bit-equal to the device strategy kernel, so breaker/fallback
+        demotions never move a task; an UNKNOWN strategy name degrades
+        to the spread tree and counts the strategy fallback."""
         t = next(iter(task_group.values()))
+        sname = strategy_mod.strategy_of(t)
+        if sname != strategy_mod.SPREAD:
+            sinfo = strategy_mod.resolve(sname)
+            if sinfo is not None:
+                try:
+                    with tracer.span("sched.strategy_host", "sched",
+                                     tasks=len(task_group)):
+                        strategy_mod.schedule_group_host(
+                            self, task_group, decisions, sinfo)
+                except Exception:
+                    # a broken strategy (e.g. an unreadable learned-
+                    # weights artifact) degrades to the spread tree —
+                    # counted, never a failed tick
+                    log.exception("strategy %s host oracle failed; "
+                                  "spread path serves the group", sname)
+                    strategy_mod.count_fallback(sname)
+                else:
+                    if task_group:
+                        self._no_suitable_node(task_group, decisions)
+                    return
+            else:
+                strategy_mod.count_fallback(sname)
         self.pipeline.set_task(t)
         ts = now()
 

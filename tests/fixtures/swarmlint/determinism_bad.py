@@ -41,3 +41,15 @@ class ThreadedSupervisor:
         deadline = time.time() + self.monitor   # bare wall clock
         while slots and time.time() < deadline:  # and again in the loop
             slots.pop()
+
+
+def load_scorer_weights(path=None):
+    """The learned-scorer weight-loading shape (ISSUE 15): a missing
+    artifact silently random-inits the policy — placements stop being
+    reproducible AND host/device parity is gone."""
+    import numpy as np
+    if path is None:
+        w1 = np.random.default_rng().normal(size=(6, 8))  # unseeded gen
+        b1 = np.random.rand(8)                  # numpy global RNG draw
+        return w1, b1
+    return None

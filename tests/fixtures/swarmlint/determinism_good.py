@@ -46,3 +46,20 @@ class DrivenSupervisor:
     def drive(self):
         if self._slots and now() < self._deadline:
             self._slots.pop()
+
+
+def load_scorer_weights(path):
+    """Learned-scorer weights load ONLY from the checked-in artifact —
+    deterministic, and a missing file is an error, not a random init."""
+    import json
+
+    with open(path) as f:
+        return json.load(f)
+
+
+def synthesize_trace(seed):
+    # offline tooling may draw noise — through an explicitly seeded
+    # generator, never the global RNG
+    import numpy as np
+
+    return np.random.default_rng(seed).normal(size=8)

@@ -85,3 +85,12 @@ def drive_streaming(cpu, mem, idx, vals):
     cpu2, mem2 = scatter_pair(new_cpu, mem, idx, vals)
     total = mem.sum()          # the second donated buffer, same bug
     return cpu2, mem2, stale + total
+
+
+@functools.partial(jax.jit, static_argnames=("strategy",))
+def plan_strategy(caps, scores, weights, strategy):
+    # pluggable scoring stage (ISSUE 15): the strategy kernel is device
+    # code like any other plan fn — host sorts and D2H casts poison it
+    order = np.argsort(scores)             # numpy sort in the score stage
+    worst = float(scores.max())            # D2H cast on a traced score
+    return caps[order], worst

@@ -100,3 +100,13 @@ def drive_streaming(cpu, mem, idx, vals):
     cpu = update_resident(cpu, idx, vals)
     cpu, mem = scatter_pair(cpu, mem, idx, vals)
     return cpu.sum() + mem.sum(), cpu, mem
+
+
+@functools.partial(jax.jit, static_argnames=("strategy",))
+def plan_strategy(caps, scores, weights, strategy):
+    # pluggable scoring stage (ISSUE 15): sorts, shifts and the MLP
+    # contraction stay on device; the host driver fetches the finished
+    # placements in one round-trip
+    order = jnp.argsort(scores)
+    packed = jnp.right_shift(scores, 7)
+    return caps[order], jnp.max(packed)

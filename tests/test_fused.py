@@ -164,14 +164,19 @@ def test_fused_fewer_dispatches_than_groups(frozen_clock):
 
 
 def test_fused_mixed_with_unfusable_groups(frozen_clock):
-    """Unfusable groups (here: a spread service and a host-path node.ip
-    constraint) break the run and ride their usual routes; surrounding
-    fusable groups still fuse; everything matches the per-group path."""
+    """Unfusable groups (here: a spread service and a host-path CSI
+    volume mount; node.ip constraints ride the device hash/prefix
+    columns now, so they no longer qualify) break the run and ride
+    their usual routes; surrounding fusable groups still fuse;
+    everything matches the per-group path."""
+    from swarmkit_tpu.models.specs import ContainerSpec
+    from swarmkit_tpu.models.types import Mount, MountType
     specs = [
         TaskSpec(resources=_RES),
         TaskSpec(resources=_RES),
-        TaskSpec(placement=Placement(
-            constraints=["node.ip!=10.0.0.1"])),     # host fallback
+        TaskSpec(container=ContainerSpec(
+            image="x", mounts=[Mount(type=MountType.CSI, source="vol",
+                                     target="/data")])),  # host fallback
         TaskSpec(placement=Placement(preferences=[
             PlacementPreference(spread=SpreadOver(
                 spread_descriptor="node.labels.rack"))]),

@@ -1,0 +1,224 @@
+"""Network-allocator driver seam tests (ROADMAP item 10 / ISSUE 15).
+
+A registered driver — selected per network by
+``NetworkSpec.driver_config`` — owns that network's subnet and address
+lifecycle; the built-in IPAM stays the default (unchanged behavior),
+``inert`` completes allocation without addressing, and release paths
+route by network id back to the owning driver.
+"""
+
+import time
+
+from swarmkit_tpu.manager.allocator import Allocator
+from swarmkit_tpu.manager.controlapi import ControlAPI
+from swarmkit_tpu.manager.netdriver import (
+    InertNetworkDriver, NetworkDriver, NetworkDriverRegistry,
+)
+from swarmkit_tpu.models import (
+    Annotations, Network, NetworkAttachmentConfig, Task, TaskState,
+)
+from swarmkit_tpu.models.specs import (
+    ContainerSpec, NetworkSpec, ReplicatedService, ServiceMode,
+    ServiceSpec, TaskSpec,
+)
+from swarmkit_tpu.models.types import (
+    Driver, IPAMConfig, IPAMOptions, TaskStatus,
+)
+from swarmkit_tpu.state import MemoryStore
+from swarmkit_tpu.utils import new_id
+
+
+def poll(fn, timeout=5.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.01)
+    raise AssertionError(f"poll timed out: {msg}")
+
+
+class FakeDriver(NetworkDriver):
+    """Records every call; hands out predictable addresses."""
+
+    name = "fake"
+
+    def __init__(self):
+        self.calls = []
+        self._n = 0
+
+    def allocate_network(self, net):
+        self.calls.append(("allocate_network", net.id))
+        return IPAMOptions(configs=[IPAMConfig(subnet="192.168.0.0/24",
+                                               gateway="192.168.0.1")])
+
+    def restore_network(self, net):
+        self.calls.append(("restore_network", net.id))
+
+    def release_network(self, network_id):
+        self.calls.append(("release_network", network_id))
+
+    def allocate_ip(self, network_id):
+        self._n += 1
+        addr = f"192.168.0.{self._n + 1}/24"
+        self.calls.append(("allocate_ip", network_id, addr))
+        return addr
+
+    def restore_ip(self, network_id, addr):
+        self.calls.append(("restore_ip", network_id, addr))
+
+    def release_ip(self, network_id, addr):
+        self.calls.append(("release_ip", network_id, addr))
+
+
+def _service_spec(name, network_target):
+    return ServiceSpec(
+        annotations=Annotations(name=name),
+        mode=ServiceMode.REPLICATED,
+        replicated=ReplicatedService(replicas=1),
+        task=TaskSpec(
+            container=ContainerSpec(image="img"),
+            networks=[NetworkAttachmentConfig(target=network_target)]))
+
+
+def _new_task(svc, spec):
+    return Task(id=new_id(), service_id=svc.id, slot=1,
+                spec=spec.task.copy(),
+                status=TaskStatus(state=TaskState.NEW),
+                desired_state=TaskState.RUNNING)
+
+
+def test_fake_driver_observes_allocate_and_free():
+    """The seam's acceptance test: a registered fake driver sees the
+    allocate/free calls for its networks — network subnet, service VIP
+    and per-task address — while release routes back by network id."""
+    store = MemoryStore()
+    api = ControlAPI(store)
+    alloc = Allocator(store)
+    fake = FakeDriver()
+    alloc.net_drivers.register("fake", fake)
+    alloc.start()
+    try:
+        net = api.create_network(NetworkSpec(
+            annotations=Annotations(name="fakenet"),
+            driver_config=Driver(name="fake")))
+        poll(lambda: store.view(
+            lambda tx: tx.get(Network, net.id).ipam is not None),
+            msg="fake network allocated")
+        assert ("allocate_network", net.id) in fake.calls
+        got = store.view(lambda tx: tx.get(Network, net.id))
+        assert got.ipam.configs[0].subnet == "192.168.0.0/24"
+
+        spec = _service_spec("fakesvc", "fakenet")
+        svc = api.create_service(spec)
+        poll(lambda: api.get_service(svc.id).endpoint is not None
+             and api.get_service(svc.id).endpoint.virtual_ips,
+             msg="VIP allocated")
+        vip = api.get_service(svc.id).endpoint.virtual_ips[0]
+        assert vip.addr.startswith("192.168.0.")
+        assert ("allocate_ip", net.id, vip.addr) in fake.calls
+
+        t = _new_task(svc, spec)
+        store.update(lambda tx: tx.create(t))
+        poll(lambda: store.view(
+            lambda tx: tx.get(Task, t.id).status.state
+            == TaskState.PENDING), msg="task allocated")
+        task = store.view(lambda tx: tx.get(Task, t.id))
+        assert task.networks and task.networks[0].addresses
+        task_addr = task.networks[0].addresses[0]
+        assert ("allocate_ip", net.id, task_addr) in fake.calls
+
+        # frees route back to the owning driver by network id
+        store.update(lambda tx: tx.delete(Task, t.id))
+        poll(lambda: ("release_ip", net.id, task_addr) in fake.calls,
+             msg="task address released")
+        api.remove_service(svc.id)
+        poll(lambda: ("release_ip", net.id, vip.addr) in fake.calls,
+             msg="vip released")
+        store.update(lambda tx: tx.delete(Network, net.id))
+        poll(lambda: ("release_network", net.id) in fake.calls,
+             msg="network released")
+    finally:
+        alloc.stop()
+
+
+def test_inert_driver_allocates_without_addressing():
+    """inert networks complete allocation (tasks reach PENDING) with no
+    VIP addresses and no per-task addresses."""
+    store = MemoryStore()
+    api = ControlAPI(store)
+    alloc = Allocator(store)
+    alloc.start()
+    try:
+        net = api.create_network(NetworkSpec(
+            annotations=Annotations(name="inertnet"),
+            driver_config=Driver(name="inert")))
+        poll(lambda: store.view(
+            lambda tx: tx.get(Network, net.id).ipam is not None),
+            msg="inert network allocated")
+        assert store.view(
+            lambda tx: tx.get(Network, net.id)).ipam.configs == []
+
+        spec = _service_spec("inertsvc", "inertnet")
+        svc = api.create_service(spec)
+        poll(lambda: api.get_service(svc.id).endpoint is not None
+             and api.get_service(svc.id).endpoint.virtual_ips,
+             msg="VIP row present")
+        vip = api.get_service(svc.id).endpoint.virtual_ips[0]
+        assert vip.addr == ""    # row kept (needs-allocation math), no addr
+
+        t = _new_task(svc, spec)
+        store.update(lambda tx: tx.create(t))
+        poll(lambda: store.view(
+            lambda tx: tx.get(Task, t.id).status.state
+            == TaskState.PENDING), msg="task allocated")
+        task = store.view(lambda tx: tx.get(Task, t.id))
+        assert task.networks and task.networks[0].addresses == []
+    finally:
+        alloc.stop()
+
+
+def test_default_ipam_unchanged_and_unknown_name_falls_back():
+    """Networks without a driver name keep the built-in IPAM exactly;
+    an unknown driver name falls back to it (allocation must not wedge
+    on a typo'd spec)."""
+    store = MemoryStore()
+    api = ControlAPI(store)
+    alloc = Allocator(store)
+    alloc.start()
+    try:
+        plain = api.create_network(NetworkSpec(
+            annotations=Annotations(name="plain")))
+        typo = api.create_network(NetworkSpec(
+            annotations=Annotations(name="typo"),
+            driver_config=Driver(name="no-such-driver")))
+        poll(lambda: store.view(
+            lambda tx: all(tx.get(Network, i).ipam is not None
+                           for i in (plain.id, typo.id))),
+            msg="both networks allocated")
+        nets = store.view(lambda tx: [tx.get(Network, i)
+                                      for i in (plain.id, typo.id)])
+        subnets = [n.ipam.configs[0].subnet for n in nets]
+        assert all(s.startswith("10.") and s.endswith("/24")
+                   for s in subnets), subnets
+        assert len(set(subnets)) == 2
+    finally:
+        alloc.stop()
+
+
+def test_registry_binding_and_reset():
+    reg = NetworkDriverRegistry(lambda: None)
+    fake = FakeDriver()
+    reg.register("fake", fake)
+    net = Network(id="nid", spec=NetworkSpec(
+        annotations=Annotations(name="n"),
+        driver_config=Driver(name="fake")))
+    assert reg.for_network(net) is fake
+    assert reg.for_id("nid") is fake
+    assert isinstance(reg.for_id("unknown"), NetworkDriver)
+    assert reg.release_binding("nid") is fake
+    assert reg.for_id("nid") is not fake   # binding gone -> default
+    reg.for_network(net)
+    reg.reset_bindings()
+    assert reg.for_id("nid") is not fake
+    assert isinstance(reg._drivers["inert"], InertNetworkDriver)

@@ -88,10 +88,14 @@ def test_rule_passes_clean_twin(rule):
 # distinct violation shapes; a refactor that quietly narrows a rule to
 # one shape must fail here, not in review.
 @pytest.mark.parametrize("rule,min_findings", [
-    ("determinism-seam", 8),   # time.time/monotonic/uuid4/urandom/Random/
+    ("determinism-seam", 10),  # time.time/monotonic/uuid4/urandom/Random/
     #                            random.random + the threaded-supervisor
     #                            shape (2 bare wall-clock reads pacing a
-    #                            rollout monitor window — ISSUE 8)
+    #                            rollout monitor window — ISSUE 8) + the
+    #                            learned-scorer weight-loading shapes
+    #                            (ISSUE 15): unseeded
+    #                            numpy.random.default_rng() + a global
+    #                            numpy RNG draw random-initing weights
     ("epoch-fencing", 4),      # 3 unfenced calls + 1 fencing-blind def
     ("lock-discipline", 5),    # order cycle + 2 blocking-under-lock +
     #                            read_barrier under the view lock
@@ -99,7 +103,7 @@ def test_rule_passes_clean_twin(rule):
     #                            GIL-released native fan-out under the
     #                            writer lock (ISSUE 13 commit plane)
     ("layering", 4),           # state/manager/sim/orchestrator imports
-    ("device-path-purity", 14),  # float()/np./jax.debug/.item() + the
+    ("device-path-purity", 16),  # float()/np./jax.debug/.item() + the
     #                              fused shapes: np/.item() in a scan
     #                              step, mid-program device_get,
     #                              block_until_ready in a mesh kernel +
@@ -109,7 +113,10 @@ def test_rule_passes_clean_twin(rule):
     #                              donation shapes (ISSUE 14): host
     #                              read of a resident array inside the
     #                              donated update program, 2x reuse of
-    #                              a donated buffer after dispatch
+    #                              a donated buffer after dispatch + the
+    #                              strategy-kernel shapes (ISSUE 15):
+    #                              numpy sort in the score stage, D2H
+    #                              float() cast on a traced score
     ("metric-hygiene", 4),     # bad chars/unsorted/duplicate/upper key
 ])
 def test_rule_sensitivity_floor(rule, min_findings):
