@@ -1517,6 +1517,208 @@ def run_fragmentation(planner_factory):
     }
 
 
+def run_gang_pipeline(planner_factory):
+    """Config 12: gang scheduling & pipeline workflows (ISSUE 16).
+    400 uniform nodes (16 cpu) receive a mixed gang fleet — 24
+    single-service gangs of 8 (4-cpu members), 40 of 4 (2-cpu), and
+    8 cross-service gangs of 8 stitched by ``gang_id`` (the fused
+    ``gang_fit`` route) — plus a 3-stage pipeline a -> b -> c (120
+    replicas each).  Tick 1 admits every gang atomically and places
+    stage a while b and c hold at the DAG gate; releasing b then c
+    drains the pipeline over two more ticks.  The identical workload
+    with gang/pipeline fields stripped runs the plain path in one
+    tick for comparison.  bench_compare gates: zero partially-placed
+    gangs, zero gang deferrals, the gate actually held (gated
+    deferrals > 0) then drained, device gang route (0 host-oracle
+    verdicts), compile-flat timed windows, and the gang tick's dec/s
+    within 4x of the plain tick's."""
+    _trim_heap()
+    from swarmkit_tpu.models import (
+        Annotations, GangConfig, Node, NodeDescription, NodeSpec,
+        NodeState, NodeStatus, PipelineStatus, Placement,
+        ReplicatedService, Resources, ResourceRequirements, Service,
+        ServiceMode, ServiceSpec, Task, TaskSpec, TaskState,
+        TaskStatus, Version,
+    )
+    from swarmkit_tpu.scheduler import Scheduler
+    from swarmkit_tpu.state import MemoryStore
+    from swarmkit_tpu.utils import new_id
+    from swarmkit_tpu.utils.metrics import registry as _reg
+
+    N_N = int(os.environ.get("BENCH_CFG12_NODES", 400))
+    CPU_UNIT = 10 ** 9
+    NODE_CPU = 16 * CPU_UNIT
+    GANGS = (("gang8", 24, 8, 4), ("gang4", 40, 4, 2))  # name,n,size,cpu
+    N_XGANG = 8          # cross-service gangs: 2 services x 4 members
+    N_STAGE = 120        # replicas per pipeline stage
+
+    def build(gang):
+        store = MemoryStore()
+        nodes = [Node(
+            id=new_id(),
+            spec=NodeSpec(annotations=Annotations(name=f"g{i:04d}")),
+            status=NodeStatus(state=NodeState.READY,
+                              addr=f"10.{i // 250}.0.{i % 250 + 1}"),
+            description=NodeDescription(
+                hostname=f"g{i:04d}",
+                resources=Resources(nano_cpus=NODE_CPU,
+                                    memory_bytes=64 << 30)))
+            for i in range(N_N)]
+        svcs, tasks = [], []
+
+        def add_service(name, cpus, count, min_size=0, gang_id="",
+                        depends_on=()):
+            placement = (Placement(gang=GangConfig(min_size=min_size))
+                         if gang and min_size else Placement())
+            spec = TaskSpec(
+                resources=ResourceRequirements(reservations=Resources(
+                    nano_cpus=cpus * CPU_UNIT,
+                    memory_bytes=(cpus << 30) // 4)),
+                placement=placement,
+                gang_id=gang_id if gang else "")
+            svc = Service(
+                id=new_id(),
+                spec=ServiceSpec(
+                    annotations=Annotations(name=name),
+                    mode=ServiceMode.REPLICATED,
+                    replicated=ReplicatedService(replicas=count),
+                    task=spec,
+                    depends_on=list(depends_on) if gang else []),
+                spec_version=Version(index=1))
+            svcs.append(svc)
+            for s in range(count):
+                tasks.append(Task(
+                    id=new_id(), service_id=svc.id, slot=s + 1,
+                    desired_state=TaskState.RUNNING, spec=spec,
+                    spec_version=Version(index=1),
+                    status=TaskStatus(state=TaskState.PENDING)))
+
+        for prefix, n_gangs, size, cpus in GANGS:
+            for g in range(n_gangs):
+                add_service(f"{prefix}-{g:02d}", cpus, size,
+                            min_size=size)
+        for g in range(N_XGANG):
+            for half in "ab":
+                add_service(f"xgang-{g}{half}", 2, 4, min_size=8,
+                            gang_id=f"xg{g}")
+        add_service("stage-a", 1, N_STAGE)
+        add_service("stage-b", 1, N_STAGE, depends_on=("stage-a",))
+        add_service("stage-c", 1, N_STAGE, depends_on=("stage-b",))
+
+        def mk(tx):
+            for n in nodes:
+                tx.create(n)
+            for s in svcs:
+                tx.create(s)
+        store.update(mk)
+        store.update(lambda tx: (
+            [tx.create(t) for t in tasks] and None))
+        return store, svcs, len(tasks)
+
+    def release(store, svcs, name):
+        sid = next(s.id for s in svcs
+                   if s.spec.annotations.name == name)
+
+        def cb(tx):
+            cur = tx.get(Service, sid).copy()
+            cur.pipeline_status = PipelineStatus(state="released")
+            tx.update(cur)
+        store.update(cb)
+
+    def placed_ids(store):
+        return {t.id for t in store.view(lambda tx: tx.find(Task))
+                if t.node_id and t.status.state >= TaskState.ASSIGNED}
+
+    def one_pass(gang):
+        store, svcs, n_tasks = build(gang)
+        planner = planner_factory()
+        sched = Scheduler(store, batch_planner=planner)
+        store.view(sched._setup_tasks_list)
+        gc.collect()
+        gc.freeze()
+        t0 = time.perf_counter()
+        dec1 = sched.tick()
+        dt1 = time.perf_counter() - t0
+        gc.unfreeze()
+        placed1 = placed_ids(store)
+        gated = 0
+        if gang:
+            # gate evidence: b and c held at tick 1, then drain after
+            # their releases — the DAG-gated rollout end to end
+            by_svc = {s.id: s.spec.annotations.name for s in svcs}
+            gated = sum(
+                1 for t in store.view(lambda tx: tx.find(Task))
+                if t.id not in placed1
+                and by_svc[t.service_id] in ("stage-b", "stage-c"))
+            release(store, svcs, "stage-b")
+            sched.tick()
+            release(store, svcs, "stage-c")
+            sched.tick()
+        n_placed = len(placed_ids(store))
+        assert n_placed == n_tasks, \
+            f"cfg12/gang={gang}: {n_placed}/{n_tasks} placed"
+        # atomicity evidence: every gang unit fully placed or fully
+        # pending after tick 1 — a strict subset is a violation
+        partial = 0
+        if gang:
+            from swarmkit_tpu.scheduler.gang import gang_unit, is_gang
+            units = {}
+            for t in store.view(lambda tx: tx.find(Task)):
+                if is_gang(t):
+                    units.setdefault(gang_unit(t), []).append(
+                        t.id in placed1)
+            partial = sum(1 for flags in units.values()
+                          if any(flags) and not all(flags))
+        return dec1, dt1, gated, partial
+
+    # warm-up: both shapes once, tracer off — covers the gang_fit
+    # (_gf/_gfF) and plain-path jit signatures this config touches
+    from swarmkit_tpu.obs import tracer as _tracer
+    was_tracing = _tracer.enabled
+    _tracer.disable()
+    try:
+        one_pass(True)
+        one_pass(False)
+        _trim_heap()
+    finally:
+        _tracer.enabled = was_tracing
+
+    snap = _planner_counter_snapshot()
+    base = {k: _reg.get_counter(k) for k in (
+        "swarm_gang_admitted", "swarm_gang_deferred",
+        "swarm_planner_gang_fit_host",
+        "swarm_planner_gang_fit_device",
+        "swarm_planner_gang_fit_fused")}
+    dec_g, dt_g, gated, partial = one_pass(True)
+    dec_p, dt_p, _, _ = one_pass(False)
+    delta = {k: int(_reg.get_counter(k) - v) for k, v in base.items()}
+    n_gangs = sum(n for _, n, _, _ in GANGS) + N_XGANG
+    return {
+        "nodes": N_N,
+        "tasks": dec_p,
+        "decisions": dec_g,
+        "decisions_per_sec": round(dec_g / dt_g, 1),
+        "gang_decisions_per_sec": round(dec_g / dt_g, 1),
+        "plain_decisions_per_sec": round(dec_p / dt_p, 1),
+        "gang_vs_plain_x": round((dec_p / dt_p) / (dec_g / dt_g), 2)
+        if dec_g else None,
+        "gangs": n_gangs,
+        "gangs_admitted": delta["swarm_gang_admitted"],
+        "gang_deferred": delta["swarm_gang_deferred"],
+        "gang_atomicity_violations": partial,
+        "gang_fit_host_verdicts": delta["swarm_planner_gang_fit_host"],
+        "gang_fit_device_verdicts":
+            delta["swarm_planner_gang_fit_device"]
+            + delta["swarm_planner_gang_fit_fused"],
+        "pipeline_gated_deferrals": gated,
+        "pipeline_stages": 3,
+        "tick_s": round(dt_g, 3),
+        "path": "device+gang",
+        "shape_cost_x": 1.0,
+        "compiles": _compile_delta(snap),
+    }
+
+
 def run_e2e(n_agents=5, n_replicas=500):
     """swarm-bench equivalent: create an N-replica service and measure
     per-task time from service creation to RUNNING status committed
@@ -1814,6 +2016,14 @@ def main():
         with tracer.span("bench.config", "bench", cfg="cfg11"):
             configs["11_fragmentation_strategies"] = \
                 run_fragmentation(tpu)
+    if _cfg_enabled(12):
+        # mixed gang fleet + 3-stage DAG-gated pipeline through the
+        # atomic-admission path (bench_compare gates zero partial
+        # gangs, zero gang deferrals, the gate holding then draining,
+        # device gang route, compile-flat windows, and the gang
+        # tick's dec/s within 4x of the plain tick)
+        with tracer.span("bench.config", "bench", cfg="cfg12"):
+            configs["12_gang_pipeline"] = run_gang_pipeline(tpu)
     if SKIP_E2E:
         e2e = None
     else:
@@ -1966,6 +2176,15 @@ def _append_history(artifact):
                 "stranded_frac_binpack": cfg.get(
                     "stranded_frac_binpack"),
                 "strategy_fallbacks": cfg.get("strategy_fallbacks"),
+                "gangs_admitted": cfg.get("gangs_admitted"),
+                "gang_deferred": cfg.get("gang_deferred"),
+                "gang_atomicity_violations": cfg.get(
+                    "gang_atomicity_violations"),
+                "gang_fit_host_verdicts": cfg.get(
+                    "gang_fit_host_verdicts"),
+                "pipeline_gated_deferrals": cfg.get(
+                    "pipeline_gated_deferrals"),
+                "gang_vs_plain_x": cfg.get("gang_vs_plain_x"),
             }
             for name, cfg in artifact["configs"].items()},
     }

@@ -44,6 +44,7 @@ def _norm(doc):
     commit_phase, native_commit = {}, {}
     streaming, p99 = {}, {}
     strategy = {}
+    gangs = {}
     for name, cfg in (doc.get("configs") or {}).items():
         dps = cfg.get("decisions_per_sec")
         if dps:
@@ -72,6 +73,18 @@ def _norm(doc):
                 "strategy_fallbacks": cfg.get("strategy_fallbacks"),
                 "fallback_groups": cfg.get("fallback_groups"),
             }
+        if cfg.get("gangs_admitted") is not None:
+            gangs[name] = {
+                "gangs_admitted": cfg.get("gangs_admitted"),
+                "gang_deferred": cfg.get("gang_deferred"),
+                "gang_atomicity_violations": cfg.get(
+                    "gang_atomicity_violations"),
+                "gang_fit_host_verdicts": cfg.get(
+                    "gang_fit_host_verdicts"),
+                "pipeline_gated_deferrals": cfg.get(
+                    "pipeline_gated_deferrals"),
+                "gang_vs_plain_x": cfg.get("gang_vs_plain_x"),
+            }
         compiles[name] = _compiles(cfg.get("compiles"))
     return {
         # commit-plane fields (ISSUE 13): per-config commit wall and the
@@ -97,6 +110,10 @@ def _norm(doc):
         # spread-through-the-seam dec/s, and the fallback counters the
         # gates pin at zero
         "strategy": strategy,
+        # gang/pipeline evidence per config (cfg12): atomic-admission
+        # counters, the gate-held count, and the gang-vs-plain dec/s
+        # ratio the regression bound judges
+        "gangs": gangs,
         "headline_compiles": _compiles(doc.get("planner_compiles")),
         "t": doc.get("t"),
         "health": (doc.get("health") or {}).get("status")
@@ -389,6 +406,69 @@ def main(argv=None) -> int:
             gate_failures.append(
                 ("strategy-spread-regression",
                  f"spread dps {sp_old}->{sp_new}"))
+    # gang/pipeline gates (ISSUE 16), judged on the NEW run's cfg12:
+    # (a) zero partially-placed gangs — a strict subset committing is
+    # exactly the failure the atomic admission path exists to prevent;
+    # (b) every gang admitted with zero deferrals (ample-capacity
+    # config: a deferral means admission broke, not that the cluster
+    # was full); (c) zero host-oracle gang verdicts (the device
+    # gang_fit route held; the oracle is the breaker fallback, not the
+    # steady path); (d) the DAG gate actually held — downstream stages
+    # deferred at tick 1 — then drained (the config asserts full
+    # placement internally); (e) compile-flat timed windows; (f) the
+    # gang tick's dec/s within 4x of the plain tick's on the SAME
+    # workload — the admission path's overhead bound.
+    _GANG_CFG = "12_gang_pipeline"
+    if _GANG_CFG in new.get("configs", {}):
+        gg = new.get("gangs", {}).get(_GANG_CFG) or {}
+        print(f"gangs[{_GANG_CFG}]: "
+              f"admitted={gg.get('gangs_admitted')} "
+              f"deferred={gg.get('gang_deferred')} "
+              f"atomicity_violations="
+              f"{gg.get('gang_atomicity_violations')} "
+              f"host_verdicts={gg.get('gang_fit_host_verdicts')} "
+              f"gated={gg.get('pipeline_gated_deferrals')} "
+              f"vs_plain={gg.get('gang_vs_plain_x')}x")
+        if gg.get("gang_atomicity_violations"):
+            print(f"\n{_GANG_CFG}: partially-placed gang unit(s) "
+                  "committed", file=sys.stderr)
+            gate_failures.append(
+                ("gang-atomicity",
+                 f"violations={gg.get('gang_atomicity_violations')}"))
+        if not gg.get("gangs_admitted") or gg.get("gang_deferred"):
+            print(f"\n{_GANG_CFG}: gang admission did not converge "
+                  f"(admitted={gg.get('gangs_admitted')} "
+                  f"deferred={gg.get('gang_deferred')})",
+                  file=sys.stderr)
+            gate_failures.append(
+                ("gang-admission",
+                 f"admitted={gg.get('gangs_admitted')} "
+                 f"deferred={gg.get('gang_deferred')}"))
+        if gg.get("gang_fit_host_verdicts"):
+            print(f"\n{_GANG_CFG}: gang feasibility fell back to the "
+                  "host oracle", file=sys.stderr)
+            gate_failures.append(
+                ("gang-device-route",
+                 f"host_verdicts={gg.get('gang_fit_host_verdicts')}"))
+        if not gg.get("pipeline_gated_deferrals"):
+            print(f"\n{_GANG_CFG}: downstream pipeline stages were "
+                  "never gated — the DAG gate did not hold",
+                  file=sys.stderr)
+            gate_failures.append(
+                ("pipeline-gate",
+                 f"gated={gg.get('pipeline_gated_deferrals')}"))
+        cfg12_compiles = new.get("compiles", {}).get(_GANG_CFG, 0)
+        if cfg12_compiles:
+            print(f"\n{_GANG_CFG} paid {cfg12_compiles} XLA "
+                  "compile(s) in its timed window", file=sys.stderr)
+            gate_failures.append(("gang-compile-growth",
+                                  f"compiles={cfg12_compiles}"))
+        ratio = gg.get("gang_vs_plain_x")
+        if ratio is not None and ratio > 4.0:
+            print(f"\n{_GANG_CFG}: gang tick dec/s fell more than 4x "
+                  f"below the plain tick's ({ratio}x)", file=sys.stderr)
+            gate_failures.append(("gang-admission-overhead",
+                                  f"gang_vs_plain_x={ratio}"))
     # commit-plane gates (ISSUE 13), judged on the live-manager configs:
     # (a) the commit phase regressing >20% wall-clock loses the columnar
     # plane's win even while decisions/s still clears the threshold;

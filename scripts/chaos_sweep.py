@@ -45,7 +45,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from swarmkit_tpu.sim.scenario import (          # noqa: E402
-    FAILOVER_SCENARIOS, FUZZ_POOL, LEGACY_RCP_SCENARIOS,
+    FAILOVER_SCENARIOS, FUZZ_POOL, GANG_SCENARIOS, LEGACY_RCP_SCENARIOS,
     PREEMPT_SCENARIOS, QOS_SCENARIOS, READ_SCENARIOS, SCENARIOS,
     STREAMING_SCENARIOS, UPDATE_SCENARIOS, run_scenario,
 )
@@ -60,10 +60,11 @@ SUITES: Dict[str, tuple] = {
     "qos": QOS_SCENARIOS,
     "read": READ_SCENARIOS,
     "streaming": STREAMING_SCENARIOS,
+    "gang": GANG_SCENARIOS,
     "legacy-rcp": LEGACY_RCP_SCENARIOS,
     "default": FAILOVER_SCENARIOS + UPDATE_SCENARIOS
     + PREEMPT_SCENARIOS + QOS_SCENARIOS + READ_SCENARIOS
-    + STREAMING_SCENARIOS + LEGACY_RCP_SCENARIOS,
+    + STREAMING_SCENARIOS + GANG_SCENARIOS + LEGACY_RCP_SCENARIOS,
     "fuzz": FUZZ_POOL,
 }
 
@@ -81,6 +82,8 @@ _FIXED_COMPONENT = {
     "rollout-poison": "updater",
     "preempt-burst": "scheduler",
     "autoscale-burst": "scheduler", "quota-clamp": "scheduler",
+    "gang-deadlock": "scheduler",
+    "pipeline-stage": "orchestrator", "stage-poison": "agent",
     "stale-read-probe": "read-plane", "read-storm": "read-plane",
     # columnar commit plane: logged once per raft-attached run when a
     # binary block entry rides consensus with the native decode active
@@ -189,6 +192,19 @@ REQUIRED_CELLS: Dict[str, Set[Tuple[str, str]]] = {
         ("read-storm", "read-plane"), ("stepdown", "manager"),
         ("crash", "manager"), ("restart", "manager"),
         ("drop", "network")},
+    # gang scheduling: two half-placeable gangs must actually contend
+    # (the injection cell), under agent churn and a stepdown
+    "gang-deadlock": {
+        ("gang-deadlock", "scheduler"), ("agent-crash", "agent"),
+        ("agent-restart", "agent"), ("stepdown", "manager"),
+        ("drop", "network")},
+    # pipeline workflows: the poisoned mid stage must be injected AND
+    # at least one of its tasks must actually die on startup
+    "pipeline-chaos": {
+        ("pipeline-stage", "orchestrator"),
+        ("stage-poison", "agent"),
+        ("crash", "manager"), ("restart", "manager"),
+        ("stepdown", "manager"), ("drop", "network")},
 }
 
 
@@ -286,8 +302,9 @@ def main(argv=None) -> int:
     p.add_argument("--fast", action="store_true",
                    help="CI subset: 3 seeds x rolling-upgrade-chaos + "
                         "preemption-storm + follower-read-failover, "
-                        "plus 1 tenant-storm and 1 steady-state-churn "
-                        "seed (overrides --fuzz/--suite/--scenario)")
+                        "plus 1 tenant-storm, 1 steady-state-churn, "
+                        "1 gang-deadlock and 1 pipeline-chaos seed "
+                        "(overrides --fuzz/--suite/--scenario)")
     p.add_argument("--no-coverage-gate", action="store_true",
                    help="report the coverage matrix but never fail on "
                         "an empty cell (for ad-hoc subsets)")
@@ -310,7 +327,8 @@ def main(argv=None) -> int:
         scenarios: tuple = ("rolling-upgrade-chaos", "preemption-storm",
                             "follower-read-failover")
         n_seeds = 3
-        extra_runs = (("tenant-storm", 1), ("steady-state-churn", 1))
+        extra_runs = (("tenant-storm", 1), ("steady-state-churn", 1),
+                      ("gang-deadlock", 1), ("pipeline-chaos", 1))
     else:
         if args.scenario:
             scenarios = tuple(args.scenario)

@@ -11,7 +11,7 @@ NotFound / AlreadyExists / FailedPrecondition.
 from __future__ import annotations
 
 import re
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..models.objects import (
     Cluster, Config, Extension, Network, Node, Resource, Secret, Service,
@@ -150,6 +150,14 @@ def _validate_task_spec(task_spec) -> None:
                 raise InvalidArgument(
                     f"Placement: strategy weight {key!r} must be an "
                     f"integer in [0, {strategy_mod.W_CLAMP}]")
+        gang = placement.gang
+        if gang is not None:
+            if not isinstance(gang.min_size, int) \
+                    or isinstance(gang.min_size, bool) \
+                    or gang.min_size < 0:
+                raise InvalidArgument(
+                    "Placement: gang min_size must be a non-negative "
+                    "integer")
     c = task_spec.container
     if c is None and task_spec.generic_runtime is None \
             and task_spec.attachment is None:
@@ -263,6 +271,21 @@ def validate_service_spec(spec: Optional[ServiceSpec]) -> None:
     if spec.mode not in (ServiceMode.REPLICATED_JOB, ServiceMode.GLOBAL_JOB):
         _validate_update(spec.update)
     _validate_endpoint_spec(spec.endpoint)
+    # pipeline DAG edges: local shape checks here; the cross-service
+    # cycle walk needs the store (ControlAPI._check_dependency_cycles)
+    name = spec.annotations.name
+    for dep in spec.depends_on or []:
+        if not dep:
+            raise InvalidArgument(
+                "ServiceSpec: depends_on entries must be non-empty "
+                "service names")
+        if dep == name:
+            raise InvalidArgument(
+                f'ServiceSpec: service "{name}" cannot depend on itself')
+    if spec.on_upstream_failure not in ("", "halt", "rollback"):
+        raise InvalidArgument(
+            f"ServiceSpec: unknown on_upstream_failure "
+            f"{spec.on_upstream_failure!r} (known: halt, rollback)")
 
 
 class ControlAPI:
@@ -316,6 +339,43 @@ class ControlAPI:
                 for p in service.endpoint.ports:
                     in_use(p, service)
 
+    def _check_dependency_cycles(self, spec: ServiceSpec,
+                                 service_id: str) -> None:
+        """Reject a depends_on edge set that would close a cycle through
+        the existing services — pipeline DAGs must stay acyclic
+        (orchestrator/pipeline.py walks them assuming so).  Edges to
+        not-yet-created services are allowed (forward references; the
+        gate fails safe while the upstream is absent)."""
+        if not spec.depends_on:
+            return
+        edges: Dict[str, List[str]] = {}
+        for service in self.store.view(lambda tx: tx.find(Service)):
+            if service_id and service.id == service_id:
+                continue
+            edges[service.spec.annotations.name] = \
+                list(service.spec.depends_on or [])
+        name = spec.annotations.name
+        edges[name] = list(spec.depends_on)
+        path: List[str] = []
+        on_path = set()
+
+        def visit(n: str) -> None:
+            if n in on_path:
+                cycle = path[path.index(n):] + [n]
+                raise InvalidArgument(
+                    "ServiceSpec: depends_on cycle: "
+                    + " -> ".join(cycle))
+            if n not in edges:
+                return
+            path.append(n)
+            on_path.add(n)
+            for up in edges[n]:
+                visit(up)
+            on_path.discard(n)
+            path.pop()
+
+        visit(name)
+
     def _check_secret_existence(self, tx, spec: ServiceSpec) -> None:
         c = spec.task.container
         if c is None:
@@ -348,6 +408,7 @@ class ControlAPI:
         """reference: service.go:727 CreateService."""
         validate_service_spec(spec)
         self._check_port_conflicts(spec, "")
+        self._check_dependency_cycles(spec, "")
         spec = _normalized_service_spec(spec)
         service = Service(id=new_id(), spec=spec,
                           spec_version=Version(index=1))
@@ -375,6 +436,7 @@ class ControlAPI:
         """reference: service.go:817 UpdateService."""
         validate_service_spec(spec)
         self._check_port_conflicts(spec, service_id)
+        self._check_dependency_cycles(spec, service_id)
 
         def cb(tx):
             service = tx.get(Service, service_id)

@@ -108,6 +108,7 @@ class Service:
     job_status: Optional["JobStatus"] = None
     pending_delete: bool = False
     autoscale_status: Optional["AutoscaleStatus"] = None
+    pipeline_status: Optional["PipelineStatus"] = None
 
     def copy(self) -> "Service":
         return Service(
@@ -119,7 +120,8 @@ class Service:
             self.update_status.copy() if self.update_status else None,
             dataclasses.replace(self.job_status) if self.job_status else None,
             self.pending_delete,
-            self.autoscale_status.copy() if self.autoscale_status else None)
+            self.autoscale_status.copy() if self.autoscale_status else None,
+            self.pipeline_status.copy() if self.pipeline_status else None)
 
 
 @dataclass
@@ -142,6 +144,27 @@ class AutoscaleStatus:
         return AutoscaleStatus(self.last_decision_at, self.last_direction,
                                list(self.reversal_stamps),
                                self.frozen_until)
+
+
+@dataclass
+class PipelineStatus:
+    """System-owned pipeline-gate state (orchestrator/pipeline.py).
+
+    Written on the Service row by the PipelineSupervisor — replicated,
+    so a successor leader's supervisor resumes the DAG rollout exactly
+    where the crashed one left it.  ``state`` is "waiting" (upstreams
+    not ready yet; the scheduler defers this stage's tasks), "released"
+    (sticky: the stage has been handed to the scheduler), or "halted"
+    (an upstream is poisoned; cascaded downstream).  Stamps read
+    ``models.types.now()`` (virtual under the sim).
+    """
+
+    state: str = "waiting"
+    reason: str = ""
+    updated_at: float = 0.0
+
+    def copy(self) -> "PipelineStatus":
+        return PipelineStatus(self.state, self.reason, self.updated_at)
 
 
 @dataclass

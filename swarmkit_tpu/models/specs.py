@@ -210,6 +210,10 @@ class TaskSpec:
     # (scheduler/preempt.py).  Propagated from ServiceSpec.priority at
     # task creation when unset (orchestrator/common.effective_task_spec).
     priority: int = 0
+    # gang membership key (scheduler/gang.py).  Tasks sharing a gang_id
+    # are admitted all-or-nothing; "" plus Placement.gang means "gang =
+    # the service itself".  Old records decode to "" (gang off).
+    gang_id: str = ""
 
     def __post_init__(self) -> None:
         # strategy-seam differential knob: SWARM_DEFAULT_PLACEMENT_
@@ -236,7 +240,8 @@ class TaskSpec:
             networks=[n.copy() for n in self.networks],
             force_update=self.force_update,
             resource_references=list(self.resource_references),
-            priority=self.priority)
+            priority=self.priority,
+            gang_id=self.gang_id)
 
 
 @dataclass
@@ -259,6 +264,15 @@ class ServiceSpec:
     # horizontal autoscaling policy (replicated services only); None =
     # replicas are operator-owned
     autoscale: Optional[AutoscaleConfig] = None
+    # pipeline DAG edges: names of upstream services that must be
+    # RUNNING (or, for jobs, complete) before this service's tasks are
+    # released to the scheduler (orchestrator/pipeline.py).  Validated
+    # acyclic by controlapi; old records decode to [] (no gating).
+    depends_on: List[str] = field(default_factory=list)
+    # what the pipeline supervisor does to THIS stage when an upstream
+    # is poisoned: "halt" (default; freeze, surface reason) or
+    # "rollback" (scale to zero replicas until the upstream recovers)
+    on_upstream_failure: str = ""
 
     def replicas(self) -> int:
         if self.mode == ServiceMode.REPLICATED:
@@ -277,7 +291,9 @@ class ServiceSpec:
             networks=[n.copy() for n in self.networks],
             endpoint=self.endpoint.copy() if self.endpoint else None,
             priority=self.priority,
-            autoscale=self.autoscale.copy() if self.autoscale else None)
+            autoscale=self.autoscale.copy() if self.autoscale else None,
+            depends_on=list(self.depends_on),
+            on_upstream_failure=self.on_upstream_failure)
 
 
 @dataclass

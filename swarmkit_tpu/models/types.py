@@ -423,6 +423,26 @@ class PlacementPreference:
 
 
 @dataclass
+class GangConfig:
+    """All-or-nothing (gang) placement policy (scheduler/gang.py).
+
+    A service or job carrying a gang config is admitted atomically: the
+    scheduler places every pending member of the gang in one
+    epoch-pinned commit, or defers the whole gang — never a partial
+    placement that strands quota or deadlocks against another
+    half-placed gang.  ``min_size`` is the member count that must place
+    together; 0 means "the whole pending group".  Topology packing or
+    spreading hints are expressed through the ordinary constraint/
+    spread-preference machinery on the same Placement.
+    """
+
+    min_size: int = 0
+
+    def copy(self) -> "GangConfig":
+        return GangConfig(self.min_size)
+
+
+@dataclass
 class Placement:
     """reference: api/types.proto:909"""
 
@@ -439,12 +459,15 @@ class Placement:
     # spread/cpu/mem/generic; ints clamped to [0, W_CLAMP] — see
     # scheduler/strategy.py); ignored by the other strategies
     strategy_weights: Dict[str, int] = field(default_factory=dict)
+    # all-or-nothing admission; None = ordinary per-task placement
+    gang: Optional[GangConfig] = None
 
     def copy(self) -> "Placement":
         return Placement(list(self.constraints), list(self.preferences),
                          [p.copy() for p in self.platforms],
                          self.max_replicas, self.strategy,
-                         dict(self.strategy_weights))
+                         dict(self.strategy_weights),
+                         self.gang.copy() if self.gang else None)
 
 
 @dataclass

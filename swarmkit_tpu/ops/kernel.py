@@ -697,3 +697,47 @@ def feasibility_jit(nodes: NodeInputs, group: GroupInputs):
     mask, cap, fail_counts = feasibility_and_capacity(
         nodes, group, lambda v: v)
     return mask, cap, fail_counts
+
+
+# ----------------------------------------------------------- gang admission
+#
+# Gang scheduling (scheduler/gang.py) needs ONE device answer per gang:
+# can the cluster absorb all k members simultaneously?  That is the
+# fused filter pipeline's capacity column reduced to a single
+# comparison — sum(cap) >= k — so the kernel reuses
+# feasibility_and_capacity verbatim and inherits its numeric contract:
+# per-node cap <= K_CLAMP, and the f32 total is exact below 2^24 while
+# anything above keeps enough relative accuracy to stay far beyond
+# K_CLAMP, so the comparison is always decided correctly (see module
+# docstring).
+
+def gang_fit(nodes: NodeInputs, group: GroupInputs,
+             reduce: Reduce = _identity):
+    """All-members-feasible reduction: (fit bool scalar, fail_counts
+    i32[8]).  ``fit`` is True iff the summed per-node capacity covers
+    the whole gang; the per-filter failure counts feed the same
+    ``no suitable node (...)`` deferral diagnostics the plan path
+    emits."""
+    mask, cap, fail_counts = feasibility_and_capacity(nodes, group, reduce)
+    total = reduce(jnp.sum(cap.astype(jnp.float32)))
+    kf = jnp.minimum(group.k, K_CLAMP).astype(jnp.float32)
+    return total >= kf, fail_counts
+
+
+@jax.jit
+def gang_fit_jit(nodes: NodeInputs, group: GroupInputs):
+    return gang_fit(nodes, group, lambda v: v)
+
+
+@jax.jit
+def gang_fit_fused_jit(nodes: NodeInputs, groups: GroupInputs):
+    """Fused gang route: every array in ``nodes``/``groups`` carries a
+    leading gang axis G (host-side stack of the same per-gang
+    densifications the per-gang route uses; ``quota_ok`` must be
+    stacked for all gangs or None for all).  Each gang is judged
+    against the same base cluster state — atomic admission re-walks
+    gangs in deterministic order and re-validates in the commit
+    transaction, so the precheck is deliberately independent per
+    gang."""
+    return jax.vmap(lambda n, g: gang_fit(n, g, lambda v: v))(
+        nodes, groups)

@@ -54,7 +54,8 @@ from .fusedbatch import (
 from .hashing import str_hash
 from .kernel import (
     GroupInputs, K_CLAMP, NodeInputs, StrategyInputs, fetch_plan,
-    plan_fused_jit, plan_group_jit, plan_strategy_jit,
+    gang_fit_fused_jit, gang_fit_jit, plan_fused_jit, plan_group_jit,
+    plan_strategy_jit,
 )
 
 log = logging.getLogger("tpu-planner")
@@ -1357,6 +1358,117 @@ class TPUPlanner:
             return None
         self.breaker.record_success()
         return picks
+
+    # -------------------------------------------- gang feasibility check
+
+    def gang_feasible(self, sched, t: Task, k: int) -> Optional[bool]:
+        """Group-level all-members-feasible verdict for a gang member
+        group (ops/kernel.py ``gang_fit``): True/False when a verdict
+        was computed, None when no verdict is available (static bucket
+        overflow) and the caller should decide by placement attempt +
+        rollback instead.  Device behind the planner breaker with the
+        bit-equal numpy host oracle (scheduler/gang.py) serving
+        demotions — a breaker flip never changes an admission verdict.
+        """
+        built = self._build_device_inputs(sched, t, k)
+        if built is None:
+            return None
+        (infos, n, nb, valid, cpu, mem, total, nodes_in, group_in,
+         L, hier, cpu_d, mem_d, gen_wanted, port_limited) = built
+        if n == 0:
+            return False
+        bucket = _bucket_label(nodes_in, group_in, L, hier) + "_gf"
+        return self._gang_fit_one(nodes_in, group_in, bucket)
+
+    def _gang_fit_one(self, nodes_in, group_in, bucket: str) -> bool:
+        """One gang_fit verdict: device kernel behind the breaker, the
+        numpy host oracle on open breaker or device failure."""
+        import time as _time
+        if self.breaker.allow_device():
+            try:
+                before = _jit_cache_size(gang_fit_jit)
+                t0 = _time.perf_counter()
+                with tracer.span("plan.gang_fit", "plan",
+                                 k=int(group_in.k)):
+                    fit, _fc = gang_fit_jit(nodes_in, group_in)
+                    fit = bool(fit)
+                _observe_compile(gang_fit_jit, bucket, before,
+                                 _time.perf_counter() - t0)
+            except Exception:
+                log.exception("device gang_fit failed; host oracle")
+                self._count("gang_device_error")
+                self.breaker.record_failure()
+            else:
+                self.breaker.record_success()
+                self._count("gang_fit_device")
+                return fit
+        from ..scheduler import gang as gang_mod
+        self._count("gang_fit_host")
+        fit, _fc = gang_mod.gang_fit_host(nodes_in, group_in)
+        return fit
+
+    def gang_feasible_many(self, sched, wants) -> list:
+        """Fused gang route: verdicts for ``wants`` = [(t, k), ...].
+        Same-signature groups (identical bucket label, same quota-mask
+        presence) stack on a leading gang axis and judge in ONE
+        ``gang_fit_fused_jit`` call (bucket suffix ``_gfF``);
+        singletons and breaker demotions take the per-group route.
+        Returns [Optional[bool]] aligned with ``wants``."""
+        import time as _time
+        results: list = [None] * len(wants)
+        by_bucket: Dict[str, list] = {}
+        for i, (t, k) in enumerate(wants):
+            built = self._build_device_inputs(sched, t, k)
+            if built is None:
+                continue
+            (infos, n, nb, valid, cpu, mem, total, nodes_in, group_in,
+             L, hier, cpu_d, mem_d, gen_wanted, port_limited) = built
+            if n == 0:
+                results[i] = False
+                continue
+            label = _bucket_label(nodes_in, group_in, L, hier)
+            by_bucket.setdefault(label, []).append(
+                (i, nodes_in, group_in))
+        for label, rows in by_bucket.items():
+            if len(rows) < 2 or not self.breaker.allow_device():
+                for i, nodes_in, group_in in rows:
+                    results[i] = self._gang_fit_one(
+                        nodes_in, group_in, label + "_gf")
+                continue
+            try:
+                stacked_nodes = NodeInputs(*[
+                    None if f == "quota_ok"
+                    and rows[0][1].quota_ok is None
+                    else np.stack([getattr(r[1], f) for r in rows])
+                    for f in NodeInputs._fields])
+                stacked_groups = GroupInputs(*[
+                    np.stack([getattr(r[2], f) for r in rows])
+                    for f in GroupInputs._fields])
+                before = _jit_cache_size(gang_fit_fused_jit)
+                t0 = _time.perf_counter()
+                with tracer.span("plan.gang_fit_fused", "plan",
+                                 gangs=len(rows)):
+                    fits, _fcs = gang_fit_fused_jit(stacked_nodes,
+                                                    stacked_groups)
+                    fits = [bool(f) for f in fits]
+                _observe_compile(gang_fit_fused_jit, label + "_gfF",
+                                 before, _time.perf_counter() - t0)
+            except Exception:
+                log.exception("fused gang_fit failed; host oracle")
+                self._count("gang_device_error")
+                self.breaker.record_failure()
+                from ..scheduler import gang as gang_mod
+                for i, nodes_in, group_in in rows:
+                    self._count("gang_fit_host")
+                    fit, _fc = gang_mod.gang_fit_host(nodes_in,
+                                                      group_in)
+                    results[i] = fit
+            else:
+                self.breaker.record_success()
+                self._count("gang_fit_fused", len(rows))
+                for (i, _n, _g), fit in zip(rows, fits):
+                    results[i] = fit
+        return results
 
     # ----------------------------------------------- fused many-service
 

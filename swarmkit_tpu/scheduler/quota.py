@@ -179,6 +179,21 @@ class TenantLedger:
         row[1] += mem_d * n
         row[2] += n
 
+    def uncharge(self, tenant: str, cpu_d: int, mem_d: int,
+                 n: int) -> None:
+        """Roll back ``n`` tasks' charge — the gang admission path
+        (scheduler/gang.py) charges every member group up front and
+        must return the whole charge when the unit defers on a
+        shortfall, so later groups in the same tick see the quota the
+        gang did NOT consume.  (``charge`` ignores n <= 0 by design,
+        hence the dedicated inverse.)"""
+        if tenant not in self.quotas or n <= 0:
+            return
+        row = self.used.setdefault(tenant, [0, 0, 0])
+        row[0] -= cpu_d * n
+        row[1] -= mem_d * n
+        row[2] -= n
+
     # ------------------------------------------------------------ verdicts
 
     def note_group_charge(self, t: Task, n: int) -> None:

@@ -31,6 +31,7 @@ from ..utils.pipeline import default_pipeline_depth
 from ..state.events import Event, EventCommit, EventSnapshotRestore
 from ..state.store import Batch, ByName, MemoryStore, ReadTx
 from ..state.watch import Closed
+from . import gang as gang_mod
 from . import genericresource
 from . import preempt as preempt_mod
 from . import strategy as strategy_mod
@@ -249,6 +250,11 @@ class Scheduler:
             _os.environ.get("SWARM_TENANT_QUOTA", "") != "0"
         self._quota_filter = QuotaFilter(self.quota)
         self.pipeline.add_filter(self._quota_filter)
+
+        # gang scheduling (scheduler/gang.py): all-or-nothing placement
+        # units + the pipeline gate.  Pure no-op bookkeeping until a
+        # spec opts in via Placement.gang / ServiceSpec.depends_on.
+        self.gang = gang_mod.GangState()
 
         # leadership epoch captured at tick/preassigned-pass start; every
         # commit of that pass is pinned to it (None = unfenced proposer)
@@ -488,8 +494,10 @@ class Scheduler:
         if info is not None:
             info.add_task(t)
         # a lower-priority task reaching RUNNING while a positive band
-        # starves is preemption capacity arriving: tick
-        return (self._prio_pending > 0
+        # starves is preemption capacity arriving: tick.  Capacity-
+        # blocked gang units (ROADMAP item 7 residual) are starved the
+        # same way despite their 0 band, so they extend the trigger.
+        return ((self._prio_pending > 0 or bool(self.gang.blocked))
                 and t.status.state == TaskState.RUNNING)
 
     def _delete_task(self, t: Task) -> bool:
@@ -624,6 +632,15 @@ class Scheduler:
                 sp.args = {"groups": len(groups),
                            "one_off": len(one_off_tasks)}
 
+        # gang units leave the normal walk and admit atomically first
+        # (scheduler/gang.py) — a pure no-op extraction when no task
+        # opts in, so non-gang ticks stay byte-identical
+        gang_units = gang_mod.take_gangs(groups, one_off_tasks)
+        if gang_units or self.gang.blocked or self.gang.first_pending:
+            self.gang.prune([k for k, _ in gang_units])
+        n_gang = (gang_mod.admit_gangs(self, gang_units, decisions)
+                  if gang_units else 0)
+
         planner = self.batch_planner
         use_pipeline = (self.pipeline_depth > 1 and self.block_mode
                         and planner is not None
@@ -644,7 +661,7 @@ class Scheduler:
             if planner is not None and hasattr(planner, "end_tick"):
                 planner.end_tick()
 
-        n_decisions = len(decisions) + pipe_block + sum(
+        n_decisions = n_gang + len(decisions) + pipe_block + sum(
             len(olds) for olds, _, _ in self.block_draft)
         with tracer.span("sched.commit", "sched", decisions=n_decisions):
             t_commit = now()
@@ -725,6 +742,12 @@ class Scheduler:
                 entries.append((task_priority(t), {t.id: t}))
         entries.sort(key=lambda e: -e[0])
         for _, group in entries:
+            # pipeline gate (scheduler/gang.py): a group whose service
+            # awaits an upstream DAG stage defers before admission so
+            # gated work never consumes quota or placement capacity
+            group = gang_mod.pipeline_gate(self, group, decisions)
+            if not group:
+                continue
             group = self._quota_admit(group, decisions)
             if group:
                 yield group
@@ -1009,11 +1032,17 @@ class Scheduler:
                 # each task is its own singleton group, exactly as the
                 # normal pass schedules them (_tick_groups)
                 for t in group.values():
-                    if task_priority(t) > 0:
+                    if task_priority(t) > 0 \
+                            or gang_mod.preempt_entitled(self, t):
                         entries.append((task_priority(t), {t.id: t}))
                 continue
-            prio = task_priority(next(iter(group.values())))
-            if prio > 0:    # only positive bands may preempt
+            t0 = next(iter(group.values()))
+            prio = task_priority(t0)
+            # positive bands may preempt; so may capacity-blocked or
+            # aged gang units in the 0 band (ROADMAP item 7 residual:
+            # the trigger predicate used to require priority > 0, so a
+            # quota-entitled gang starved forever behind it)
+            if prio > 0 or gang_mod.preempt_entitled(self, t0):
                 entries.append((prio, group))
         if not entries:
             sup.export_inversions(0)
@@ -1039,6 +1068,11 @@ class Scheduler:
             t0 = next(iter(group.values()))
             if not preempt_mod.preemptable_group(t0):
                 sup.note_skipped("unsupported", len(group))
+                continue
+            if gang_mod.is_gated(self, t0):
+                # a pipeline-gated group cannot schedule even with the
+                # capacity: evicting victims for it would be pure loss
+                sup.note_skipped("gated", len(group))
                 continue
             cpu_d, mem_d, gen_d = preempt_mod.demand_of(t0)
             headroom = None
@@ -1078,8 +1112,13 @@ class Scheduler:
                 picks = preempt_mod.select_victims_host(
                     cand, cpu_d, mem_d, gen_val, n_picks, budget_rem)
             if picks:
+                # gang groups evict ONLY (assign=False): per-pick
+                # assignment would commit a strict subset of the gang;
+                # the freed capacity lets the unit place atomically on
+                # the next tick instead
                 placed, victims_n = self._commit_preemption(
-                    group, t0, prio, cand, picks)
+                    group, t0, prio, cand, picks,
+                    assign=not gang_mod.is_gang(t0))
                 budget_rem -= victims_n
                 placed_total += placed
                 if placed and self.quota_enabled and self.quota.active:
@@ -1107,12 +1146,17 @@ class Scheduler:
         return placed_total
 
     def _commit_preemption(self, group: Dict[str, Task], t0: Task,
-                           prio: int, cand, picks
+                           prio: int, cand, picks,
+                           assign: bool = True
                            ) -> Tuple[int, int]:
         """Commit the selected picks: one atomic transaction per pick
         (victims' desired SHUTDOWN + preemption marker, preemptor's
         ASSIGNED write), each re-validated against the store row so a
         racing agent update skips the pick instead of corrupting it.
+        ``assign=False`` (gang groups) commits the victims' shutdown
+        WITHOUT placing the preemptor — a gang member may only commit
+        with its whole unit (scheduler/gang.py), so the pass frees the
+        capacity and the unit places atomically on a later tick.
         Returns (preemptors placed, victims shut down)."""
         from ..models.types import Annotations
         expanded = preempt_mod.replay_pick_victims(cand, picks)
@@ -1130,11 +1174,13 @@ class Scheduler:
 
             def cb(tx, tid=tid, node_id=node_id, victims=victims,
                    result=result):
-                cur = tx.get(Task, tid)
-                if cur is None or cur.node_id \
-                        or cur.status.state != TaskState.PENDING \
-                        or cur.desired_state > TaskState.COMPLETE:
-                    return
+                cur = None
+                if assign:
+                    cur = tx.get(Task, tid)
+                    if cur is None or cur.node_id \
+                            or cur.status.state != TaskState.PENDING \
+                            or cur.desired_state > TaskState.COMPLETE:
+                        return
                 vrows = []
                 for vt in victims:
                     vcur = tx.get(Task, vt.id)
@@ -1159,14 +1205,15 @@ class Scheduler:
                                     task_priority(vcur))},
                         indices=dict(nv.annotations.indices))
                     tx.update(nv)
-                new_t = cur.copy()
-                new_t.node_id = node_id
-                new_t.status = TaskStatus(
-                    state=TaskState.ASSIGNED, timestamp=ts,
-                    message="scheduler assigned task to node "
-                            "(preempted lower-priority tasks)")
-                tx.update(new_t)
-                result["task"] = new_t
+                if assign:
+                    new_t = cur.copy()
+                    new_t.node_id = node_id
+                    new_t.status = TaskStatus(
+                        state=TaskState.ASSIGNED, timestamp=ts,
+                        message="scheduler assigned task to node "
+                                "(preempted lower-priority tasks)")
+                    tx.update(new_t)
+                    result["task"] = new_t
                 result["victims"] = victims
 
             try:
@@ -1176,7 +1223,7 @@ class Scheduler:
                 # group's remainder stays pending (counted as inversions)
                 log.exception("preemption transaction failed")
                 break
-            if "task" not in result:
+            if "victims" not in result:
                 # the pick was skipped (preemptor or a victim changed
                 # under us): STOP — later picks' feasibility may depend
                 # on this pick's evictions (same-node surplus carry),
@@ -1184,15 +1231,16 @@ class Scheduler:
                 # group's remainder retries next tick against fresh
                 # state.
                 break
-            new_t = result["task"]
-            self._dequeue(tid)
-            self.all_tasks[tid] = new_t
-            info = self.node_set.node_info(new_t.node_id)
-            if info is not None:
-                info.add_task(new_t)
+            if assign:
+                new_t = result["task"]
+                self._dequeue(tid)
+                self.all_tasks[tid] = new_t
+                info = self.node_set.node_info(new_t.node_id)
+                if info is not None:
+                    info.add_task(new_t)
+                placed += 1
             sup.note_preemptions(result["victims"], prio)
             victims_total += len(result["victims"])
-            placed += 1
         return placed, victims_total
 
     def _commit_block_draft(self, want_ids: bool = True
@@ -1479,8 +1527,8 @@ class Scheduler:
         self._schedule_group_host(task_group, decisions)
 
     def _schedule_group_host(self, task_group: Dict[str, Task],
-                             decisions: Dict[str, SchedulingDecision]
-                             ) -> None:
+                             decisions: Dict[str, SchedulingDecision],
+                             defer_leftover: bool = True) -> None:
         """The host oracle path: spread tree + sorted round-robin
         (reference: scheduler.go:694 scheduleTaskGroup).  Non-spread
         strategies route to their host oracle (scheduler/strategy.py) —
@@ -1505,7 +1553,7 @@ class Scheduler:
                                   "spread path serves the group", sname)
                     strategy_mod.count_fallback(sname)
                 else:
-                    if task_group:
+                    if task_group and defer_leftover:
                         self._no_suitable_node(task_group, decisions)
                     return
             else:
@@ -1534,7 +1582,9 @@ class Scheduler:
                                       self.pipeline.process, node_less)
             self._schedule_n_tasks_on_subtree(len(task_group), task_group,
                                               tree, decisions, node_less)
-        if task_group:
+        if task_group and defer_leftover:
+            # gang scratch placement (defer_leftover=False) leaves the
+            # shortfall in task_group for the caller's atomic rollback
             self._no_suitable_node(task_group, decisions)
 
     def _schedule_n_tasks_on_subtree(self, n: int,

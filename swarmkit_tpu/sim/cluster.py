@@ -63,6 +63,7 @@ from ..utils.identity import set_id_source
 from .engine import SimEngine
 from .faults import NetConfig, SimNetwork
 from .invariants import (
+    GangInvariants, PipelineInvariants,
     PreemptionInvariants, QosInvariants, RaftInvariants, ReadInvariants,
     TaskInvariants, UpdateInvariants, Violations,
     check_placement_quality, entry_digest,
@@ -580,6 +581,19 @@ class SimAgent:
                     state=TaskState.FAILED, timestamp=now(),
                     message="sim poison", err="injected version failure")))
                 self.engine.log(f"fault rollout-poison {self.node_id} "
+                                f"task {t.id}")
+                continue
+            poison_svc = getattr(self.cp, "poison_services", None)
+            if (poison_svc and nxt == TaskState.RUNNING
+                    and t.service_id in poison_svc):
+                # stage-poison fault (pipeline-chaos): every task of the
+                # marked service dies on startup — the pipeline
+                # supervisor must observe the failures and halt the
+                # downstream stages
+                updates.append((t.id, TaskStatus(
+                    state=TaskState.FAILED, timestamp=now(),
+                    message="sim poison", err="injected stage failure")))
+                self.engine.log(f"fault stage-poison {self.node_id} "
                                 f"task {t.id}")
                 continue
             updates.append((t.id, TaskStatus(
@@ -1120,6 +1134,11 @@ class SimMemberControl:
         )
         self.autoscaler = AutoscaleSupervisor(
             store, sampler=cp.autoscale_sampler, start_worker=False)
+        # pipeline DAG supervisor (ISSUE 16), threadless like the
+        # autoscaler: release/halt verdicts ride consensus on Service
+        # rows, so the successor leader's supervisor resumes them
+        from ..orchestrator.pipeline import PipelineSupervisor
+        self.pipeline = PipelineSupervisor(store, start_worker=False)
         # jobs orchestrator (run-to-completion work coexisting with
         # services): driven threadless like the other orchestrators, so
         # job iterations survive leader failover via the replicated store
@@ -1213,6 +1232,10 @@ class SimMemberControl:
         self.autoscaler.drive()
         if self.detached:
             return
+        # pipeline release/halt verdicts ride consensus the same way
+        self.pipeline.drive()
+        if self.detached:
+            return
         self.restarts.drive()
 
     def detach(self) -> None:
@@ -1239,6 +1262,10 @@ class SimMemberControl:
             pass
         try:
             self.autoscaler.stop()   # never writes; threadless no-op+flag
+        except Exception:
+            pass
+        try:
+            self.pipeline.stop()     # never writes; threadless no-op+flag
         except Exception:
             pass
         for _, sub, _ in self._drivers:
@@ -1457,6 +1484,9 @@ class RaftControlPlane:
         #: spec versions whose tasks die on startup (rollout-poison
         #: fault, consumed by SimAgent); healed by Sim.finish
         self.poison_versions: set = set()
+        #: service ids whose tasks die on startup (stage-poison fault,
+        #: pipeline-chaos); healed by Sim.finish like poison_versions
+        self.poison_services: set = set()
         #: monotone spec-version mint for rollout(); the bootstrap
         #: service is version 1
         self._next_version = 1
@@ -1510,6 +1540,13 @@ class RaftControlPlane:
         self.expect_preemptions = False
         #: (service_id, total_completions) end-state job expectations
         self.job_expectations: List[tuple] = []
+        # ---- gang & pipeline scenario surface (ISSUE 16)
+        #: (service_id, want_running, label) end-state expectations: the
+        #: service must show >= want_running RUNNING tasks at finish
+        self.service_expectations: List[tuple] = []
+        #: (service_id, pipeline state, label) end-state expectations on
+        #: the replicated PipelineStatus verdict
+        self.pipeline_expectations: List[tuple] = []
         #: preemption records archived from crash-replaced checkers
         self._preempt_archive: List[tuple] = []
         self._dispatcher_totals = {"heartbeats": 0, "expirations": 0}
@@ -1799,8 +1836,9 @@ class RaftControlPlane:
 
     def _checker_for(self, m: SimManager) -> Optional[tuple]:
         """(TaskInvariants, UpdateInvariants, PreemptionInvariants,
-        QosInvariants) for a member's replicated store, rebuilt when a
-        restart replaces the store object."""
+        QosInvariants, GangInvariants, PipelineInvariants) for a
+        member's replicated store, rebuilt when a restart replaces the
+        store object."""
         if m.store is None:
             return None
         entry = self._inv.get(m.id)
@@ -1819,7 +1857,10 @@ class RaftControlPlane:
                          inversion_bound=self.preempt_inversion_bound,
                          thrash_bound=self.preempt_thrash_bound),
                      QosInvariants(self.violations, m.store, tag=m.id,
-                                   cadence=self._qos_cadence))
+                                   cadence=self._qos_cadence),
+                     GangInvariants(self.violations, m.store, tag=m.id),
+                     PipelineInvariants(self.violations, m.store,
+                                        tag=m.id))
             self._inv[m.id] = entry
         return entry[1:]
 
@@ -2136,16 +2177,23 @@ class RaftControlPlane:
 
     def add_service(self, sid: str, replicas: int, priority: int = 0,
                     nano_cpus: int = 0, memory_bytes: int = 0,
-                    tenant: str = "", autoscale=None) -> None:
+                    tenant: str = "", autoscale=None,
+                    gang_min: int = 0, gang_id: str = "",
+                    depends_on=None,
+                    on_upstream_failure: str = "halt") -> None:
         """Create a replicated service in a priority band, optionally
         with per-task reservations (the preemption scenarios' workload:
         bands contending for finite node capacity), a tenant label
         (quota enforcement — the ``swarm.tenant`` annotation the
-        orchestrator propagates onto every task), and an autoscaling
-        policy.  The SERVICE-level priority is used deliberately — it
-        exercises the ServiceSpec.priority -> task spec propagation
-        path."""
-        from ..models.types import ResourceRequirements
+        orchestrator propagates onto every task), an autoscaling
+        policy, gang placement (``gang_min`` > 0 opts every task into
+        an all-or-nothing unit keyed by ``gang_id`` or the service),
+        and pipeline dependencies (``depends_on`` upstream service
+        names gate the stage behind the PipelineSupervisor).  The
+        SERVICE-level priority is used deliberately — it exercises the
+        ServiceSpec.priority -> task spec propagation path."""
+        from ..models.types import GangConfig, Placement, \
+            ResourceRequirements
         from ..scheduler.quota import TENANT_LABEL
 
         def cb(tx):
@@ -2154,18 +2202,26 @@ class RaftControlPlane:
             res = ResourceRequirements(reservations=Resources(
                 nano_cpus=nano_cpus, memory_bytes=memory_bytes))
             labels = {TENANT_LABEL: tenant} if tenant else {}
+            placement = Placement(gang=GangConfig(min_size=gang_min)) \
+                if gang_min > 0 else Placement()
             tx.create(Service(
                 id=sid,
                 spec=ServiceSpec(
                     annotations=Annotations(name=sid, labels=labels),
                     mode=ServiceMode.REPLICATED,
                     replicated=ReplicatedService(replicas=replicas),
-                    task=TaskSpec(resources=res),
+                    task=TaskSpec(resources=res, placement=placement,
+                                  gang_id=gang_id),
                     priority=priority,
-                    autoscale=autoscale),
+                    autoscale=autoscale,
+                    depends_on=list(depends_on or ()),
+                    on_upstream_failure=on_upstream_failure),
                 spec_version=Version(index=1)))
         self._apply_workload(
-            f"service {sid} x{replicas} prio={priority}", cb)
+            f"service {sid} x{replicas} prio={priority}"
+            + (f" gang>={gang_min}" if gang_min > 0 else "")
+            + (f" after={','.join(depends_on)}" if depends_on else ""),
+            cb)
 
     def run_job(self, sid: str, total: int, max_concurrent: int = 0,
                 priority: int = 0) -> None:
@@ -2192,6 +2248,19 @@ class RaftControlPlane:
     def expect_job_complete(self, sid: str, total: int) -> None:
         """End-state bound: the job must show ``total`` completions."""
         self.job_expectations.append((sid, total))
+
+    def expect_service_running(self, sid: str, running: int,
+                               label: str = "gang-converges") -> None:
+        """End-state bound: >= ``running`` tasks of ``sid`` RUNNING at
+        finish (the gang scenarios' convergence claim: every deferred
+        gang eventually placed in full)."""
+        self.service_expectations.append((sid, running, label))
+
+    def expect_pipeline_state(self, sid: str, state: str,
+                              label: str = "pipeline-converges") -> None:
+        """End-state bound on the replicated pipeline verdict of
+        ``sid`` ("released" / "halted" / "waiting")."""
+        self.pipeline_expectations.append((sid, state, label))
 
     # --------------------------------------------------------- spec rollouts
 
@@ -2319,6 +2388,35 @@ class RaftControlPlane:
                         f"job {sid}: {done}/{total} completions after "
                         "heal+grace — job iterations lost across "
                         "failover")
+        # ---- gang & pipeline end checks (ISSUE 16)
+        if self.service_expectations and self.store is not None:
+            tasks = self.store.view(lambda tx: tx.find(Task))
+            for sid, want, label in self.service_expectations:
+                running = sum(
+                    1 for t in tasks
+                    if t.service_id == sid
+                    and TaskState(t.status.state) == TaskState.RUNNING
+                    and t.desired_state <= TaskState.RUNNING)
+                if running < want:
+                    violations.record(
+                        label,
+                        f"service {sid}: {running}/{want} tasks RUNNING "
+                        "after heal+grace — the gang/stage never "
+                        "converged")
+        if self.pipeline_expectations and self.store is not None:
+            svc_rows = {s.id: s for s in self.store.view(
+                lambda tx: tx.find(Service))}
+            for sid, want_state, label in self.pipeline_expectations:
+                s = svc_rows.get(sid)
+                st = s.pipeline_status if s is not None else None
+                got = st.state if st is not None else "waiting"
+                if got != want_state:
+                    reason = (f" (reason: {st.reason})"
+                              if st is not None and st.reason else "")
+                    violations.record(
+                        label,
+                        f"pipeline stage {sid}: verdict {got!r} at "
+                        f"finish, expected {want_state!r}{reason}")
         history = self.merged_update_history()
         for version, states, by, label in self.update_expectations:
             hit = [h for h in history
@@ -2502,6 +2600,7 @@ class Sim:
         # the once-poisoned version may now start, so a paused update
         # settles instead of churning failed restarts through the grace
         getattr(self.cp, "poison_versions", set()).clear()
+        getattr(self.cp, "poison_services", set()).clear()
         for m in self.managers:
             m.tick_scale = 1.0
             if not m.alive:
@@ -2539,11 +2638,23 @@ class Sim:
             # hand-off lost work
             store = self.cp.store
             if store is not None:
+                tasks, services = store.view(
+                    lambda tx: (tx.find(Task), tx.find(Service)))
+                # pipeline-gated stages are intentionally unplaced: a
+                # halted (or never-released) stage's pending tasks are
+                # the DAG gate working, not lost work
+                gated = set()
+                for s in services:
+                    if s.spec.depends_on:
+                        st = s.pipeline_status
+                        if st is None or st.state != "released":
+                            gated.add(s.id)
                 stuck = [
-                    t for t in store.view(lambda tx: tx.find(Task))
+                    t for t in tasks
                     if t.desired_state == TaskState.RUNNING
                     and TaskState(t.status.state) == TaskState.PENDING
-                    and not t.node_id]
+                    and not t.node_id
+                    and t.service_id not in gated]
                 if stuck:
                     self.violations.record(
                         "failover-replacement",

@@ -38,6 +38,20 @@ quality under perturbation, not merely avoid unsafe states):
 * placement-quality (``check_placement_quality``) — post-convergence,
   running tasks may not pile onto one node beyond a bound of the ideal
   even spread
+
+Gang/pipeline layer (ISSUE 16; ``GangInvariants`` /
+``PipelineInvariants``, payload discipline like ``TaskInvariants``
+plus commit boundaries from ``EventCommit``):
+
+* gang-atomicity — no committed transaction may assign a strict subset
+  of a gang unit's pending members; judged at each ``EventCommit``
+  with a short grace window so concurrent orchestrator churn (a
+  replacement materializing between the scheduler's snapshot and its
+  commit) resolves instead of flagging
+* pipeline-order — a task of a ``depends_on`` stage must never reach
+  RUNNING before every upstream stage has had at least one task
+  RUNNING (the supervisor's release bar is stricter — full replicas —
+  so this is the safe observable core of DAG ordering)
 """
 
 from __future__ import annotations
@@ -47,7 +61,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..models.objects import Cluster, Node, Service, Task
 from ..models.types import NodeState, TaskState, TERMINAL_STATES, UpdateState
-from ..state.events import Event, EventTaskBlock
+from ..state.events import Event, EventCommit, EventTaskBlock, commit_or
 
 
 class InvariantViolation(AssertionError):
@@ -980,6 +994,245 @@ class QosInvariants:
                 f"baseline p99 {self._p99(baseline):.2f}s over "
                 f"{len(baseline)} samples) — the burst leaked into the "
                 "protected band")
+
+
+class GangInvariants:
+    """Gang-scheduling atomicity (ISSUE 16), tracked from one store's
+    ordered event stream with commit boundaries:
+
+    * gang-atomicity — no committed transaction may assign a strict
+      subset of a gang unit: at every ``EventCommit``, a unit that had
+      members assigned in the batch while OTHER members of the unit
+      remain pending is *suspected*.  A suspicion resolves silently if
+      those members stop being pending (placed by the immediately
+      following tick, or shut down) within ``GRACE`` seconds — that is
+      the legal race where the orchestrator materializes a replacement
+      between the scheduler's snapshot and its commit.  A suspicion
+      that outlives the grace window is a real partial placement and
+      fails the run.
+
+    Pending membership is derived from event payloads only (never
+    current store rows), TaskInvariants discipline; a crash-rebuilt
+    checker seeds from the committed rows.
+    """
+
+    #: seconds a strict-subset suspicion may stay open before it is a
+    #: violation — a few scheduler cadences, so the one-tick
+    #: snapshot/commit race always resolves and a deferred
+    #: "partially placed" remainder never does
+    GRACE = 10.0
+
+    def __init__(self, violations: Violations, store, tag: str = ""):
+        self.v = violations
+        self.store = store
+        self.tag = tag
+        from ..scheduler.gang import gang_unit, is_gang
+        self._gang_unit = gang_unit
+        self._is_gang = is_gang
+        #: pending gang members: task id -> unit key
+        self._pending: Dict[str, str] = {}
+        #: unit -> task ids assigned in the current commit batch
+        self._batch: Dict[str, set] = {}
+        #: unit -> (suspected-at, frozenset of left-behind task ids)
+        self._suspect: Dict[str, tuple] = {}
+        self._flagged: set = set()
+        self.stats = {"commits_judged": 0, "suspicions": 0,
+                      "resolved": 0}
+        self.sub = store.queue.subscribe(
+            commit_or(lambda ev: isinstance(ev, EventTaskBlock)
+                      or (isinstance(ev, Event)
+                          and isinstance(ev.obj, Task))),
+            accepts_blocks=True)
+        # baseline adoption: seed the pending set from committed rows
+        # (assignments already committed are history, not a batch)
+        def seed(tx):
+            for t in tx.find(Task):
+                self._observe(t, int(t.status.state), t.node_id,
+                              int(t.desired_state))
+        store.view(seed)
+        self._batch.clear()
+
+    def _now(self) -> float:
+        return self.v.engine.clock.elapsed()
+
+    def drain(self) -> None:
+        while True:
+            ev = self.sub.poll()
+            if ev is None:
+                break
+            if isinstance(ev, EventCommit):
+                self._judge_batch()
+                continue
+            if isinstance(ev, EventTaskBlock):
+                state = int(ev.state)
+                for nid, items in ev.per_node().items():
+                    for old, _ver in items:
+                        self._observe(old, state, nid,
+                                      int(old.desired_state))
+                continue
+            obj = ev.obj
+            if ev.action == "delete":
+                self._pending.pop(obj.id, None)
+                continue
+            self._observe(obj, int(obj.status.state), obj.node_id,
+                          int(obj.desired_state))
+        self._age_suspicions()
+
+    def _observe(self, t: Task, state: int, node_id: str,
+                 desired: int) -> None:
+        if not self._is_gang(t):
+            return
+        unit = self._gang_unit(t)
+        if (not node_id and state == int(TaskState.PENDING)
+                and desired <= int(TaskState.COMPLETE)):
+            self._pending[t.id] = unit
+            return
+        was_pending = self._pending.pop(t.id, None) is not None
+        if (was_pending and node_id
+                and state >= int(TaskState.ASSIGNED)
+                and desired <= int(TaskState.COMPLETE)):
+            self._batch.setdefault(unit, set()).add(t.id)
+        # anything else — shut down, failed, orphaned — just stops
+        # being pending; only pending->assigned joins the batch
+
+    def _judge_batch(self) -> None:
+        batch, self._batch = self._batch, {}
+        if not batch:
+            return
+        self.stats["commits_judged"] += 1
+        for unit, assigned in batch.items():
+            if unit in self._flagged or unit in self._suspect:
+                continue
+            left = frozenset(tid for tid, u in self._pending.items()
+                             if u == unit)
+            if left:
+                self.stats["suspicions"] += 1
+                self._suspect[unit] = (self._now(), left, len(assigned))
+
+    def _age_suspicions(self) -> None:
+        if not self._suspect:
+            return
+        ts = self._now()
+        for unit in list(self._suspect):
+            since, left, n_assigned = self._suspect[unit]
+            still = [tid for tid in left if self._pending.get(tid) == unit]
+            if not still:
+                self.stats["resolved"] += 1
+                del self._suspect[unit]
+                continue
+            if ts - since > self.GRACE and unit not in self._flagged:
+                self._flagged.add(unit)
+                del self._suspect[unit]
+                self.v.record(
+                    "gang-atomicity",
+                    f'{self.tag}: a commit at t={since:.1f} assigned '
+                    f'{n_assigned} member(s) of gang "{unit}" while '
+                    f'{len(still)} member(s) stayed pending '
+                    f'{ts - since:.1f}s past the commit — a strict '
+                    "subset of a gang was committed")
+
+
+class PipelineInvariants:
+    """Pipeline DAG ordering (ISSUE 16), tracked from one store's
+    ordered event stream:
+
+    * pipeline-order — a task of a service that names upstream
+      dependencies (``ServiceSpec.depends_on``) must never be observed
+      RUNNING before every upstream service has had at least one task
+      reach RUNNING (COMPLETE counts: a finished job ran).  The
+      supervisor's release bar is stricter (full replicas / total
+      completions), so this is the safe observable core — it cannot
+      false-positive on upstream churn after release, yet fires the
+      moment the gate is bypassed.
+
+    Ever-RUNNING is sticky per service; a crash-rebuilt checker seeds
+    it leniently (status >= RUNNING) from committed rows so failover
+    cannot mint false positives.
+    """
+
+    def __init__(self, violations: Violations, store, tag: str = ""):
+        self.v = violations
+        self.store = store
+        self.tag = tag
+        #: service id -> upstream names; service name -> id
+        self._depends: Dict[str, List[str]] = {}
+        self._by_name: Dict[str, str] = {}
+        #: service ids with >= 1 task ever observed RUNNING
+        self._ever_ran: set = set()
+        self._flagged: set = set()
+        self.sub = store.queue.subscribe(
+            lambda ev: isinstance(ev, EventTaskBlock)
+            or (isinstance(ev, Event)
+                and isinstance(ev.obj, (Task, Service))),
+            accepts_blocks=True)
+
+        def seed(tx):
+            for s in tx.find(Service):
+                self._observe_service("update", s)
+            for t in tx.find(Task):
+                if t.status.state >= int(TaskState.RUNNING):
+                    self._ever_ran.add(t.service_id)
+        store.view(seed)
+
+    def drain(self) -> None:
+        while True:
+            ev = self.sub.poll()
+            if ev is None:
+                break
+            if isinstance(ev, EventTaskBlock):
+                # assignment-band block commits never carry RUNNING by
+                # contract; guarded anyway so a future block shape
+                # cannot silently skip the ordering check
+                if int(ev.state) == int(TaskState.RUNNING):
+                    for _nid, items in ev.per_node().items():
+                        for old, _ver in items:
+                            self._observe_running(old)
+                continue
+            obj = ev.obj
+            if isinstance(obj, Service):
+                self._observe_service(ev.action, obj)
+                continue
+            if ev.action == "delete":
+                continue
+            state = int(obj.status.state)
+            if state == int(TaskState.RUNNING) \
+                    or state == int(TaskState.COMPLETE):
+                self._observe_running(obj)
+
+    def _observe_service(self, action: str, s: Service) -> None:
+        name = s.spec.annotations.name
+        if action == "delete":
+            self._depends.pop(s.id, None)
+            if self._by_name.get(name) == s.id:
+                del self._by_name[name]
+            return
+        self._by_name[name] = s.id
+        deps = list(s.spec.depends_on or ())
+        if deps:
+            self._depends[s.id] = deps
+        else:
+            self._depends.pop(s.id, None)
+
+    def _observe_running(self, t: Task) -> None:
+        sid = t.service_id
+        deps = self._depends.get(sid)
+        if deps and sid not in self._flagged:
+            not_ready = []
+            for dep in deps:
+                up = self._by_name.get(dep)
+                if up is None or up not in self._ever_ran:
+                    not_ready.append(dep)
+            if not_ready:
+                self._flagged.add(sid)
+                self.v.record(
+                    "pipeline-order",
+                    f"{self.tag}: task {t.id} of pipeline stage "
+                    f"{sid} reached RUNNING before upstream stage(s) "
+                    f"{', '.join(repr(d) for d in not_ready)} ever ran "
+                    "— the DAG gate was bypassed")
+        # sticky AFTER the check (self-edges are rejected by the
+        # control API, so ordering here cannot self-satisfy)
+        self._ever_ran.add(sid)
 
 
 class ReadInvariants:

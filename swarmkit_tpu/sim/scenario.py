@@ -1376,6 +1376,127 @@ def _tenant_storm(sim: Sim) -> float:
 _tenant_storm.raft_cp = True
 
 
+def _gang_deadlock(sim: Sim) -> float:
+    """Gang scheduling under contention (ISSUE 16): two all-or-nothing
+    gangs of 8 tasks each land on a cluster shrunk to 12 slots — each
+    gang fits alone, both together do not.  Partial placement would
+    livelock them (each holding half the capacity, neither complete);
+    atomic admission plus the deterministic (-priority, age, key)
+    admission order must place one gang in a single commit and defer
+    the other INTACT.  A leader stepdown mid-contention rebuilds the
+    deferral bookkeeping on the successor, an agent crash evicts part
+    of the placed gang (its replacements must re-place without
+    demanding a whole new gang — placed-live members count toward
+    min_size), and when the dead workers return the deferred gang must
+    place in full.  Judged by the gang-atomicity invariant (no commit
+    ever assigns a strict subset of a unit) plus end-state convergence
+    of BOTH gangs."""
+    eng = sim.engine
+    cp = sim.cp
+    cp.planner_factory = _device_planner    # gang_fit on device
+    sim.start_raft_workload(interval=0.8)
+
+    CPU = 2 * 10 ** 9    # 4 slots per 8-cpu worker
+    a = cp.agents
+    # shrink to 3 workers x 4 slots = 12 before the gangs arrive
+    eng.at(eng.clock.start + 6.0, "node death w0", a[0].crash)
+    eng.at(eng.clock.start + 7.0, "node death w1", a[1].crash)
+
+    def gangs():
+        # the injected fault: two half-placeable gangs race for 12
+        # slots — the deadlock gang scheduling exists to break
+        eng.log("fault gang-deadlock scheduler")
+        cp.add_service("svc-gang-a", 8, gang_min=8, nano_cpus=CPU)
+        cp.add_service("svc-gang-b", 8, gang_min=8, nano_cpus=CPU)
+    eng.at(eng.clock.start + 10.0, "two contending gangs", gangs)
+
+    # leader churn mid-contention: the deferred unit's age/blocked
+    # bookkeeping is leader-local and must rebuild on the successor
+    eng.at(eng.clock.start + 20.0, "stepdown mid-contention",
+           sim.stepdown_leader)
+    # agent churn under the placed gang: its replacements re-place
+    # against placed-live min_size accounting, still atomically
+    eng.at(eng.clock.start + 26.0, "agent crash w2", a[2].crash)
+    eng.at(eng.clock.start + 34.0, "agent return w2", a[2].restart)
+    eng.at(eng.clock.start + 38.0, "drop burst",
+           lambda: setattr(sim.net.config, "drop_p", 0.1))
+    eng.at(eng.clock.start + 44.0, "drop off",
+           lambda: setattr(sim.net.config, "drop_p", 0.0))
+    # capacity returns: the deferred gang must now place in full
+    eng.at(eng.clock.start + 48.0, "node return w0", a[0].restart)
+    eng.at(eng.clock.start + 52.0, "node return w1", a[1].restart)
+    cp.expect_service_running("svc-gang-a", 8)
+    cp.expect_service_running("svc-gang-b", 8)
+    return 80.0
+
+
+_gang_deadlock.raft_cp = True
+
+
+def _pipeline_chaos(sim: Sim) -> float:
+    """Pipeline DAG rollout under churn (ISSUE 16): a 3-deep workflow
+    (stage-a -> stage-b -> {stage-c, stage-d}) where the supervisor
+    must release each stage only once its upstream is fully running —
+    across a leader crash landing between releases (verdicts are
+    replicated on the Service rows, so the successor resumes them).
+    stage-b is poisoned from the start: its tasks die on startup, so it
+    accumulates failure observations past the poison threshold and the
+    supervisor must HALT both downstream stages — stage-c freezes
+    (halt), stage-d scales to zero (rollback policy) — while stage-b
+    itself stays released and churns restarts until the global heal.
+    Judged by the pipeline-order invariant (no downstream task RUNNING
+    before its upstream ever ran) plus the end-state verdicts."""
+    eng = sim.engine
+    cp = sim.cp
+    sim.start_raft_workload(interval=0.8)
+
+    def poison():
+        # the injected fault: the mid stage is poisoned — every task
+        # dies on startup until the end-of-scenario heal
+        eng.log("fault pipeline-stage orchestrator")
+        cp.poison_services.add("svc-stage-b")
+    eng.at(eng.clock.start + 4.0, "poison mid stage", poison)
+
+    eng.at(eng.clock.start + 6.0, "stage a",
+           lambda: cp.add_service("svc-stage-a", 4))
+    eng.at(eng.clock.start + 8.0, "stage b",
+           lambda: cp.add_service("svc-stage-b", 4,
+                                  depends_on=["svc-stage-a"]))
+    eng.at(eng.clock.start + 10.0, "stages c+d", lambda: (
+        cp.add_service("svc-stage-c", 3, depends_on=["svc-stage-b"],
+                       on_upstream_failure="halt"),
+        cp.add_service("svc-stage-d", 3, depends_on=["svc-stage-b"],
+                       on_upstream_failure="rollback")))
+
+    # leader crash between stage releases: the successor's supervisor
+    # resumes from the replicated pipeline_status verdicts
+    def crash_leader():
+        m = sim.leader()
+        if m is None:
+            return
+        m.crash()
+        eng.after(6.0, "restart ex-leader", m.restart)
+    eng.at(eng.clock.start + 14.0, "crash leader mid-rollout",
+           crash_leader)
+    eng.at(eng.clock.start + 30.0, "stepdown", sim.stepdown_leader)
+    eng.at(eng.clock.start + 36.0, "drop burst",
+           lambda: setattr(sim.net.config, "drop_p", 0.1))
+    eng.at(eng.clock.start + 42.0, "drop off",
+           lambda: setattr(sim.net.config, "drop_p", 0.0))
+
+    cp.expect_service_running("svc-stage-a", 4,
+                              label="pipeline-converges")
+    # released before the poison verdicts land downstream; churns
+    # restarts until the heal clears the poison, then converges
+    cp.expect_pipeline_state("svc-stage-b", "released")
+    cp.expect_pipeline_state("svc-stage-c", "halted")
+    cp.expect_pipeline_state("svc-stage-d", "halted")
+    return 75.0
+
+
+_pipeline_chaos.raft_cp = True
+
+
 # ----------------------------------------- follower-served read plane
 #
 # ISSUE 11: the consumer plane (watch streams, agent sessions,
@@ -1797,6 +1918,9 @@ SCENARIOS: Dict[str, Callable[[Sim], float]] = {
     "preemption-storm": _preemption_storm,
     # autoscaler + multi-tenant QoS (quota mask column + control loop)
     "tenant-storm": _tenant_storm,
+    # gang scheduling & pipeline workflows (atomic admission, DAG gate)
+    "gang-deadlock": _gang_deadlock,
+    "pipeline-chaos": _pipeline_chaos,
     # follower-served read plane (read-index/lease reads, resume tokens)
     "follower-read-failover": _follower_read_failover,
     "read-storm-degraded": _read_storm_degraded,
@@ -1829,6 +1953,9 @@ PREEMPT_SCENARIOS = ("preemption-storm",)
 
 #: autoscaler + multi-tenant QoS suite (ISSUE 12)
 QOS_SCENARIOS = ("tenant-storm",)
+
+#: gang scheduling & pipeline workflows suite (ISSUE 16)
+GANG_SCENARIOS = ("gang-deadlock", "pipeline-chaos")
 
 #: follower-served read plane (ISSUE 11)
 READ_SCENARIOS = ("follower-read-failover", "read-storm-degraded")
