@@ -118,6 +118,24 @@ def _norm(doc):
         "t": doc.get("t"),
         "health": (doc.get("health") or {}).get("status")
         if isinstance(doc.get("health"), dict) else doc.get("health"),
+        # per-check health states ({check: pass|warn|fail}) — artifacts
+        # carry them under health.checks, history records flattened as
+        # health_checks; pre-ISSUE-17 records report None and are
+        # exempt from the saturation gates
+        "health_checks": (doc.get("health") or {}).get("checks")
+        if isinstance(doc.get("health"), dict)
+        else doc.get("health_checks"),
+        # observability cost of the journeys+tracing plane (ISSUE 17):
+        # headline overhead percentage and the XLA compiles that landed
+        # inside the overhead-measurement window (must be 0 or the
+        # delta measures compilation, not observability)
+        "obs_overhead_pct": (doc.get("obs") or {}).get("overhead_pct")
+        if isinstance(doc.get("obs"), dict)
+        else doc.get("obs_overhead_pct"),
+        "obs_window_compiles": (doc.get("obs") or {}).get(
+            "window_compiles")
+        if isinstance(doc.get("obs"), dict)
+        else doc.get("obs_window_compiles"),
         # plan/commit overlap evidence (artifacts and history records
         # both carry these since the pipelined-scheduler PR; older runs
         # report None and are exempt from the overlap gate)
@@ -517,6 +535,45 @@ def main(argv=None) -> int:
               "landed inside a timed region", file=sys.stderr)
         gate_failures.append(("compile-growth",
                               f"planner_compiles {old_c}->{new_c}"))
+    # observability gates (ISSUE 17), judged on the NEW run alone:
+    # (a) obs-overhead bound — the headline tick with journeys +
+    # tracing + a live store tap enabled must run within 3% of the
+    # dark tick, else the observability plane is taxing the hot path;
+    # (b) the overhead-measurement window must be compile-free — a
+    # compile inside either half means the delta measured XLA, not
+    # observability; (c) the saturation SLO checks fed by the run's
+    # own registry — scheduler-plane occupancy and raft apply lag —
+    # reporting FAIL means a plane saturated during the run.
+    ov_old = old.get("obs_overhead_pct")
+    ov_new = new.get("obs_overhead_pct")
+    if ov_old is not None or ov_new is not None:
+        print(f"obs_overhead_pct: {ov_old} -> {ov_new} (bar <= 3.0)")
+    if ov_new is not None and ov_new > 3.0:
+        print(f"\nobservability overhead {ov_new}% exceeds the 3% "
+              "bound with journeys+tracing enabled", file=sys.stderr)
+        gate_failures.append(("obs-overhead",
+                              f"overhead_pct={ov_new}"))
+    owc = new.get("obs_window_compiles")
+    if owc is not None:
+        print(f"obs_window_compiles: "
+              f"{old.get('obs_window_compiles')} -> {owc}")
+    if owc:
+        print(f"\nobs-overhead window paid {owc} XLA compile(s) — the "
+              "overhead delta is not trustworthy", file=sys.stderr)
+        gate_failures.append(("obs-compile-growth",
+                              f"window_compiles={owc}"))
+    hc_old = old.get("health_checks") or {}
+    hc_new = new.get("health_checks") or {}
+    for check, gate in (
+            ("scheduler_occupancy", "scheduler-occupancy-saturation"),
+            ("apply_lag", "apply-lag-saturation")):
+        st = hc_new.get(check)
+        if st is not None or hc_old.get(check) is not None:
+            print(f"health[{check}]: {hc_old.get(check)} -> {st}")
+        if st == "fail":
+            print(f"\nsaturation check {check} FAILED on the new run",
+                  file=sys.stderr)
+            gate_failures.append((gate, f"{check}={st}"))
     # distinct summaries per gate: a shape-bar or compile miss is NOT a
     # ">20% throughput regression" and must not read like one
     failed = False

@@ -5,6 +5,7 @@ Usage:
     python scripts/trace_report.py bench_trace.json --validate
     python scripts/trace_report.py sim_trace.json --json
     python scripts/trace_report.py --diff A.json B.json
+    python scripts/trace_report.py --critical-path BENCH_ART.json
 
 Works on any trace the obs tracer emits: ``bench.py``'s BENCH_TRACE_OUT,
 ``python -m swarmkit_tpu.sim --trace-json``, or a ``/debug/trace``
@@ -16,6 +17,12 @@ is printed per config; otherwise one table covers the whole trace.
 deltas (A = baseline, B = candidate), matched per config window where
 both traces carry the same ``bench.config`` markers — the same
 ``obs/report.py`` aggregation the bench artifact embeds.
+``--critical-path ART`` takes a bench ARTIFACT (not a trace): it joins
+the task-journey attribution of time-to-running p99 with the per-plane
+saturation windows and prints one row per plane — which plane owns the
+slow tail, and whether that plane's occupancy/backlog corroborates it.
+Exits 1 when the attribution is missing, empty, or does not account
+for ~100% of the tail (the CI wiring keys on that).
 """
 
 import argparse
@@ -76,18 +83,118 @@ def _run_diff(path_a: str, path_b: str, as_json: bool) -> int:
     return 0
 
 
+def _load_artifact(path):
+    """A saved bench artifact may carry log noise before the JSON line;
+    take the last line that parses (bench_compare discipline)."""
+    with open(path) as f:
+        text = f.read().strip()
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    raise SystemExit(f"{path}: no JSON document found")
+
+
+def _run_critical_path(path: str, as_json: bool) -> int:
+    """Join the artifact's journey attribution with its plane windows:
+    one row per plane of the time-to-running p99 tail.  Non-zero exit
+    on malformed or empty attribution — ci_check.sh runs this against
+    the fast bench config as the observability smoke gate."""
+    art = _load_artifact(path)
+    attr = art.get("journey_attribution")
+    planes = art.get("planes") or {}
+    problems = []
+    e2e = art.get("e2e_time_to_running")
+    if not isinstance(attr, dict) and isinstance(e2e, dict) \
+            and str(e2e.get("error", "")).startswith("skipped:"):
+        # the e2e config self-skipped for an environmental reason (no
+        # `cryptography` for the manager's CA bootstrap): there is no
+        # attribution to judge, which is not an observability failure
+        msg = (f"critical-path: e2e config was skipped "
+               f"({e2e['error']}); nothing to attribute")
+        if as_json:
+            print(json.dumps({"source": path, "skipped": e2e["error"],
+                              "attribution": None, "problems": []},
+                             indent=2, sort_keys=True))
+        else:
+            print(msg, file=sys.stderr)
+        return 0
+    if not isinstance(attr, dict):
+        problems.append("artifact carries no journey_attribution "
+                        "(bench ran without the e2e config, or "
+                        "journeys were disabled)")
+        attr = {}
+    by_plane = attr.get("planes") or {}
+    if not problems and not attr.get("cohort"):
+        problems.append("attribution cohort is empty — no complete "
+                        "created->running journeys were sampled")
+    if not problems and not by_plane:
+        problems.append("attribution has a cohort but no per-plane "
+                        "rows")
+    frac_sum = sum(float(r.get("frac") or 0.0)
+                   for r in by_plane.values())
+    if not problems and abs(frac_sum - 1.0) > 0.02:
+        problems.append(f"per-plane fractions sum to {frac_sum:.4f}, "
+                        "not ~1.0 — the edges no longer partition the "
+                        "journey interval")
+    doc = {"source": path, "attribution": attr,
+           "plane_windows": planes, "frac_sum": round(frac_sum, 6),
+           "problems": problems}
+    if as_json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 1 if problems else 0
+    if problems:
+        for pr in problems:
+            print(f"critical-path: {pr}", file=sys.stderr)
+        return 1
+    print(f"time-to-running p{int(attr['p'] * 100)} critical path "
+          f"({attr['cohort']} tail task(s) of {attr['tasks']} "
+          f"complete, {attr['total_s']:.4f}s attributed)")
+    hdr = (f"{'plane':<12} {'seconds':>10} {'frac':>7} "
+           f"{'occupancy':>10} {'depth':>7} {'oldest_s':>9} "
+           f"{'drops':>6}")
+    print(hdr)
+    order = sorted(by_plane, key=lambda pl: -by_plane[pl]["seconds"])
+    for pl in order:
+        row = by_plane[pl]
+        w = planes.get(pl) or {}
+        print(f"{pl:<12} {row['seconds']:>10.4f} "
+              f"{row['frac'] * 100:>6.1f}% "
+              f"{w.get('occupancy', 0.0):>10.4f} "
+              f"{w.get('queue_depth', 0.0):>7.0f} "
+              f"{w.get('oldest_age_s', 0.0):>9.3f} "
+              f"{w.get('drops', 0):>6d}")
+    spectators = sorted(set(planes) - set(by_plane))
+    if spectators:
+        print(f"planes with no tail share: {', '.join(spectators)}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python scripts/trace_report.py")
     p.add_argument("trace", nargs="+",
-                   help="Chrome trace-event JSON file(s); two with --diff")
+                   help="Chrome trace-event JSON file(s); two with "
+                        "--diff; a bench artifact with --critical-path")
     p.add_argument("--validate", action="store_true",
                    help="schema-check only; exit 1 on problems")
     p.add_argument("--json", action="store_true",
                    help="emit the phase table(s) as JSON")
     p.add_argument("--diff", action="store_true",
                    help="side-by-side phase diff of two traces (A B)")
+    p.add_argument("--critical-path", action="store_true",
+                   help="per-plane attribution of time-to-running p99 "
+                        "from a bench ARTIFACT (exit 1 when empty or "
+                        "malformed)")
     args = p.parse_args(argv)
 
+    if args.critical_path:
+        if len(args.trace) != 1:
+            p.error("--critical-path takes exactly one bench artifact")
+        return _run_critical_path(args.trace[0], args.json)
     if args.diff:
         if len(args.trace) != 2:
             p.error("--diff takes exactly two trace files")

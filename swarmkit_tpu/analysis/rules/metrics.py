@@ -15,7 +15,13 @@ exposition endpoint guarantees:
 * the number of *distinct literal labelsets* per base name stays under
   the cardinality bound — the static shadow of the runtime check (label
   values interpolated at runtime are each one labelset here; the live
-  cardinality guard on real label values stays in tests).
+  cardinality guard on real label values stays in tests);
+* no *per-entity* label keys (``task``/``node``/``session``/... — see
+  ``UNBOUNDED_LABEL_KEYS``): a counter or gauge keyed by a task or
+  node id mints one series per entity and grows with cluster size, not
+  with code.  Bounded domains — ``service``, ``tenant``, ``plane``,
+  ``check`` — stay legal; per-entity detail belongs in task journeys
+  and the flight recorder, not the metrics registry.
 
 F-string label *values* are treated as opaque placeholders; f-string
 fragments inside the base name must still produce a grammar-valid name
@@ -37,6 +43,17 @@ MAX_LABEL_CARDINALITY = 64
 
 _REGISTRY_METHODS = {"counter", "gauge", "timer", "get_counter",
                      "get_gauge", "get_timer", "observe"}
+
+#: label keys that identify one ENTITY per value: a series per task,
+#: node, slot, or session is unbounded cardinality — it scales with the
+#: cluster, not the codebase.  (service/tenant/plane/check are bounded
+#: operator-facing domains and stay legal.)
+UNBOUNDED_LABEL_KEYS = {
+    "task", "task_id", "taskid",
+    "node", "node_id", "nodeid",
+    "slot", "container", "container_id",
+    "session", "session_id", "agent", "agent_id",
+}
 
 #: receiver names that identify the metrics registry: calls on these get
 #: the FULL grammar check, including the swarm_ namespace prefix (a call
@@ -130,7 +147,15 @@ class MetricHygiene(Checker):
                         f"metric {shown!r}: label {norm!r} is not "
                         'key="value" with a lowercase key'))
                     continue
-                keys.append(pair.split("=", 1)[0])
+                key = pair.split("=", 1)[0]
+                keys.append(key)
+                if key in UNBOUNDED_LABEL_KEYS:
+                    out.append(mod.finding(
+                        self.name, node,
+                        f"metric {shown!r}: label key {key!r} is "
+                        "per-entity (one series per task/node/session "
+                        "is unbounded cardinality) — aggregate, or "
+                        "use a bounded key like service/tenant/plane"))
             if keys != sorted(keys):
                 out.append(mod.finding(
                     self.name, node,

@@ -36,7 +36,10 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..models.objects import Cluster, Config, Node, Secret, Task, Volume
 from ..models.types import NodeState, NodeStatus, TaskState, TaskStatus, now
+from ..obs import planes as _planes
+from ..obs.journey import journeys as _journeys
 from ..obs.trace import tracer
+from ..state import serde as _serde
 from ..state.events import Event, EventSnapshotRestore, EventTaskBlock
 from ..state.store import Batch, ByNode, MemoryStore
 from ..state.watch import Closed, Subscription
@@ -114,6 +117,24 @@ class AssignmentsMessage:
         self.applies_to = applies_to
         self.results_in = results_in
         self.changes = changes  # list of (action, kind, obj)
+
+
+def _note_assignment_ship(msg: "AssignmentsMessage") -> None:
+    """Per-shipped-batch observability: the ``assigned_sent`` journey
+    milestone for every task update in the batch (the one leader-local
+    milestone — delivery is not replicated state) and the serialized
+    size of the batch as the assignment-set bytes gauge."""
+    nbytes = 0
+    for change in msg.changes:
+        action, kind, obj = change
+        if kind == "task" and action == "update":
+            _journeys.note_sent(obj.id)
+        try:
+            nbytes += len(_serde.dumps(obj))
+        except Exception:
+            pass   # unserializable stub: size stays an estimate
+    _metrics.gauge("swarm_dispatcher_assignment_set_bytes",
+                   float(nbytes))
 
 
 class AssignmentStream:
@@ -436,6 +457,7 @@ class BatchedAssignmentFanout:
                 f'swarm_dispatcher_assignments_sent{{type="{type_}"}}')
             _metrics.counter("swarm_dispatcher_assignment_changes",
                              len(msg.changes))
+            _note_assignment_ship(msg)
             # a COMPLETE always goes out (even empty); its overflow (a
             # node with more assignments than one batch) continues as
             # incrementals
@@ -449,8 +471,11 @@ class BatchedAssignmentFanout:
         ``open()`` so events for a node mid-registration are either in
         its COMPLETE snapshot or routed here — never silently consumed
         for an unknown node that registers a moment later."""
+        t0 = time.perf_counter()
         with self._drain_mu:
             self._flush_locked()
+        _planes.plane(_planes.DISPATCHER).note_busy(
+            time.perf_counter() - t0)
 
     def _flush_locked(self) -> None:
         with self._mu:
@@ -575,6 +600,31 @@ class Dispatcher:
             "swarm_dispatcher_update_batch_latency")
         self._build_timer = _metrics.timer(
             "swarm_dispatcher_assignments_build")
+
+        # dispatcher-plane saturation probe (obs/planes.py): session
+        # count as its own gauge (a bounded per-shard scalar) and the
+        # fan-out's pending-change backlog as the plane queue depth.
+        # plane() is resolved per call — planes.reset() rebinds the
+        # table.  Weakref: the probe must not pin a stopped dispatcher.
+        # Co-resident dispatchers (HA tests): last constructed owns it.
+        import weakref
+        _ref = weakref.ref(self)
+
+        def _disp_probe():
+            d = _ref()
+            if d is None:
+                return {}
+            with d._mu:
+                sessions = float(len(d._nodes))
+            _metrics.gauge("swarm_dispatcher_sessions", sessions)
+            depth = 0.0
+            fan = d.fanout
+            if fan is not None:
+                with fan._mu:
+                    depth = float(sum(len(s.changes)
+                                      for s in fan._sets.values()))
+            return {"depth": depth}
+        _planes.plane(_planes.DISPATCHER).set_probe(_disp_probe)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -1120,6 +1170,7 @@ class Dispatcher:
                 f'swarm_dispatcher_assignments_sent{{type="{type_}"}}')
             _metrics.counter("swarm_dispatcher_assignment_changes",
                              len(msg.changes))
+            _note_assignment_ship(msg)
             applies_to = results_in
 
         def pred(ev):

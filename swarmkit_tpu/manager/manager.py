@@ -149,6 +149,13 @@ class Manager:
             self._crash_hook_installed = True
         flightrec.enabled = True
         flightrec.watch_store(self.store)
+        # the journey ledger rides the recorder's store tap (one watch
+        # consumer for both): every member minting milestones from
+        # replicated stamps is what lets a journey survive failover
+        # stitched (obs/journey.py)
+        from ..obs.journey import journeys
+        flightrec.journey_sink = journeys.handle_event
+        journeys.enabled = True
         self.sampler.rebase()
         self.sampler.start(interval=self.obs_sample_interval,
                            on_sample=self.health.evaluate)

@@ -157,14 +157,25 @@ class PipelineStatus:
     (sticky: the stage has been handed to the scheduler), or "halted"
     (an upstream is poisoned; cascaded downstream).  Stamps read
     ``models.types.now()`` (virtual under the sim).
+
+    ``failed_ids`` replicates the poison OBSERVATIONS (distinct task
+    ids seen FAILED/REJECTED), not just the verdict: a successor
+    leader's supervisor resumes the count where the deposed one left
+    it, so 2 observations before a crash plus 1 after still trip the
+    ``POISON_FAILURES`` threshold.  Bounded: failed task rows, like
+    the services they belong to, are garbage-collected by the task
+    reaper, and the list only grows while the stage is actually
+    flapping toward a halt verdict.
     """
 
     state: str = "waiting"
     reason: str = ""
     updated_at: float = 0.0
+    failed_ids: List[str] = field(default_factory=list)
 
     def copy(self) -> "PipelineStatus":
-        return PipelineStatus(self.state, self.reason, self.updated_at)
+        return PipelineStatus(self.state, self.reason, self.updated_at,
+                              list(self.failed_ids))
 
 
 @dataclass

@@ -14,8 +14,10 @@ and the derived planes on top.
 """
 
 from . import debugpages  # noqa: F401  (installs /debug/* endpoint hook)
+from . import planes  # noqa: F401  (per-plane saturation signals)
 from .flightrec import FlightRecorder, flightrec
 from .health import Check, HealthEvaluator
+from .journey import JourneyLedger, journeys
 from .lifecycle import LifecycleTracker
 from .report import (
     diff_phase_tables, format_diff, format_table, phase_table,
@@ -25,8 +27,9 @@ from .sampler import Sampler
 from .trace import Span, Tracer, tracer
 
 __all__ = [
-    "Check", "FlightRecorder", "HealthEvaluator", "LifecycleTracker",
-    "Sampler", "Span", "Tracer", "diff_phase_tables", "flightrec",
-    "format_diff", "format_table", "phase_table", "tracer",
+    "Check", "FlightRecorder", "HealthEvaluator", "JourneyLedger",
+    "LifecycleTracker", "Sampler", "Span", "Tracer",
+    "diff_phase_tables", "flightrec", "format_diff", "format_table",
+    "journeys", "phase_table", "planes", "tracer",
     "validate_chrome_trace",
 ]

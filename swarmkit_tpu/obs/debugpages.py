@@ -50,6 +50,18 @@ def _h_flightrec(server, query) -> Tuple[bytes, int, str]:
     return flightrec.dump_json().encode(), 200, "application/json"
 
 
+def _h_planes(server, query) -> Tuple[bytes, int, str]:
+    """Per-plane saturation report + journey-ledger summary.  Must
+    render on a fresh manager with zero observations and on a deposed
+    ex-leader alike (ISSUE 17 bugfix sweep): both arms below only read
+    module-level state that always exists."""
+    from .journey import journeys
+    from .planes import report_all
+    doc = {"planes": report_all(), "journeys": journeys.summary()}
+    body = json.dumps(doc, sort_keys=True, indent=1).encode()
+    return body, 200, "application/json"
+
+
 def _install(server: "httpdebug.DebugServer") -> None:
     server.register("/debug/trace",
                     lambda query: _h_trace(server, query),
@@ -64,6 +76,11 @@ def _install(server: "httpdebug.DebugServer") -> None:
                     "flight-recorder post-mortem dump (JSON): recent "
                     "spans, metric samples, store events, raft "
                     "transitions")
+    server.register("/debug/planes",
+                    lambda query: _h_planes(server, query),
+                    "per-plane saturation report (occupancy, queue "
+                    "depth, oldest-item age, drops/defers) + journey "
+                    "ledger summary")
 
 
 httpdebug.register_default_endpoints(_install)

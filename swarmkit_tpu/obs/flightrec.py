@@ -92,6 +92,11 @@ class FlightRecorder:
         # single slot, so two managers in one process (HA tests) can
         # each tap their own store without stealing the other's.
         self._store_subs: Dict[int, tuple] = {}
+        #: optional per-raw-event tap (obs.journey.JourneyLedger
+        #: .handle_event): the journey ledger rides the SAME store
+        #: subscriptions instead of adding its own, so the watch plane
+        #: pays one consumer for both
+        self.journey_sink = None
 
     def _fresh_rings(self) -> None:
         (max_spans, max_samples, max_store_events, max_raft,
@@ -139,6 +144,22 @@ class FlightRecorder:
         if id(q) not in self._store_subs:
             self._store_subs[id(q)] = (
                 q, q.subscribe(accepts_blocks=True))
+        # watch-plane saturation probe: the recorder's taps are the
+        # canonical store consumers, so their summed backlog (in store
+        # versions — Subscription.backlog counts block expansions) is
+        # the consumer plane's lag.  Registered here, not in state/
+        # watch.py: the state layer must not import obs (layering rule).
+        from . import planes as _planes
+        _planes.plane(_planes.WATCH).set_probe(self._watch_backlog)
+
+    def _watch_backlog(self) -> Dict[str, float]:
+        depth = 0.0
+        for _q, sub in list(self._store_subs.values()):
+            try:
+                depth += float(sub.backlog())
+            except Exception:
+                pass
+        return {"depth": depth}
 
     def unwatch_store(self, store=None) -> None:
         """Detach a store tap — only ``store``'s when given (a stopping
@@ -163,11 +184,17 @@ class FlightRecorder:
         many rows were recorded."""
         t = _types.now()
         n = 0
+        sink = self.journey_sink
         for q, sub in list(self._store_subs.values()):
             while True:
                 ev = sub.poll()
                 if ev is None:
                     break
+                if sink is not None:
+                    try:
+                        sink(ev)
+                    except Exception:
+                        log.exception("journey sink failed")
                 row = self._summarize_event(t, ev)
                 if row is not None and self.enabled:
                     self.store_events.append(row)
@@ -206,13 +233,14 @@ class FlightRecorder:
         with self._lock:
             return (self.spans, self.samples, self.store_events,
                     self.raft, self.notes, self.enabled,
-                    self.deterministic, dict(self._store_subs))
+                    self.deterministic, dict(self._store_subs),
+                    self.journey_sink)
 
     def restore_state(self, state) -> None:
         with self._lock:
             (self.spans, self.samples, self.store_events, self.raft,
              self.notes, self.enabled, self.deterministic,
-             self._store_subs) = state
+             self._store_subs, self.journey_sink) = state
 
     # ----------------------------------------------------------------- dump
 
@@ -241,6 +269,21 @@ class FlightRecorder:
             from ..utils.metrics import registry
             doc["counters"] = dict(sorted(
                 registry.counters_snapshot().items()))
+        # full journeys of invariant-implicated tasks: a violation note
+        # naming a sampled task id gets that task's complete milestone
+        # ledger in the post-mortem, so "task X stuck" arrives WITH
+        # where in the pipeline it stuck.  Seed-pure in deterministic
+        # captures (notes and milestones both are).
+        ledger = getattr(self.journey_sink, "__self__", None)
+        if ledger is not None and hasattr(ledger, "journeys"):
+            viol = [str(m) for _t, m in doc["notes"]
+                    if str(m).startswith("INVARIANT")]
+            if viol:
+                imp = {tid: ms
+                       for tid, ms in ledger.journeys().items()
+                       if any(tid in n for n in viol)}
+                if imp:
+                    doc["implicated_journeys"] = imp
         return doc
 
     def dump_json(self) -> str:

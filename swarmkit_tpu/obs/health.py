@@ -184,6 +184,64 @@ def autoscale_flapping_value() -> Callable[[Registry], Optional[float]]:
     return get
 
 
+def plane_saturation_value(plane_name: str, occ_warn: float = 0.85,
+                           age_n: int = 4, age_floor: float = 0.5
+                           ) -> Callable[[Registry], Optional[float]]:
+    """Saturation condition for one serving plane (obs/planes.py): 1
+    (warn) while the rolled occupancy sits at/above ``occ_warn`` — the
+    plane is near its capacity ceiling; 2 (fail) when the plane's
+    oldest-item age grew STRICTLY monotonically across the last
+    ``age_n`` evaluations and is above ``age_floor`` — the backlog is
+    unbounded, work is aging out faster than the plane drains it.
+    None (pass) until the plane exports its first gauges (a fresh
+    manager with zero observations is healthy, not unknown)."""
+    occ_name = f'swarm_plane_occupancy{{plane="{plane_name}"}}'
+    age_name = f'swarm_plane_oldest_age_s{{plane="{plane_name}"}}'
+    history: deque = deque(maxlen=age_n)
+
+    def get(reg: Registry) -> Optional[float]:
+        occ = reg.get_gauge(occ_name)
+        age = reg.get_gauge(age_name)
+        if occ is None and age is None:
+            return None
+        if age is not None:
+            history.append(age)
+        if len(history) == age_n and history[-1] >= age_floor \
+                and all(b > a for a, b in
+                        zip(history, list(history)[1:])):
+            return 2.0
+        if occ is not None and occ >= occ_warn:
+            return 1.0
+        return 0.0
+    return get
+
+
+def apply_lag_value(warn_entries: float = 256.0, n: int = 4
+                    ) -> Callable[[Registry], Optional[float]]:
+    """Raft apply-plane lag (commit_index - applied_index, exported as
+    the ``raft_apply`` plane's queue depth): 1 (warn) at/above
+    ``warn_entries`` — the committer is behind but may be catching up;
+    2 (fail) when the lag is over the bar AND grew strictly across the
+    last ``n`` evaluations — a stalled committer, the backlog can only
+    grow.  None (pass) before the raft plane exports."""
+    name = 'swarm_plane_queue_depth{plane="raft_apply"}'
+    history: deque = deque(maxlen=n)
+
+    def get(reg: Registry) -> Optional[float]:
+        lag = reg.get_gauge(name)
+        if lag is None:
+            return None
+        history.append(lag)
+        if len(history) == n and lag >= warn_entries \
+                and all(b > a for a, b in
+                        zip(history, list(history)[1:])):
+            return 2.0
+        if lag >= warn_entries:
+            return 1.0
+        return 0.0
+    return get
+
+
 def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
                    edge_warn: float = 10.0, edge_fail: float = 60.0,
                    fallback_warn: float = 0.1, fallback_fail: float = 0.5,
@@ -251,6 +309,17 @@ def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
         Check("autoscale_flapping", autoscale_flapping_value(),
               1.0, 2.0, "state",
               ("swarm_autoscale_", "swarm_tenant_quota_")),
+        # per-plane saturation (obs/planes.py, ISSUE 17): 1 = the
+        # scheduler plane's tick occupancy is sustained at/over 85%,
+        # 2 = its pending-backlog age grows without bound
+        Check("scheduler_occupancy", plane_saturation_value("scheduler"),
+              1.0, 2.0, "state",
+              ("swarm_plane_", "swarm_scheduler_")),
+        # raft apply plane: 1 = apply lag over the entry bar, 2 = a
+        # stalled committer (lag over the bar and strictly growing)
+        Check("apply_lag", apply_lag_value(),
+              1.0, 2.0, "state",
+              ("swarm_plane_", "swarm_raft_")),
     ]
 
 

@@ -62,6 +62,10 @@ class Sampler:
         """Record one row: cumulative deltas since ``rebase()``.  Always
         returns the row; recording respects the recorder's enable
         flag."""
+        # close the plane occupancy windows on the same cadence as the
+        # rows that carry them: the rolled gauges land in this sample
+        from . import planes as _planes
+        _planes.roll_all()
         reg = self.registry
         t = _types.now()
         counters = {

@@ -43,6 +43,7 @@ from ..scheduler import strategy as strategy_mod
 from ..scheduler.filters import normalize_arch, _references_volume_plugin
 from ..scheduler.nodeinfo import NodeInfo
 from ..models.types import TaskState, TaskStatus
+from ..obs import planes as _planes
 from ..obs.trace import tracer
 from ..utils.metrics import registry as _metrics
 from . import fusedbatch
@@ -361,6 +362,17 @@ class TPUPlanner:
         # in-flight plan with group COMMITS (bounded by the scheduler's
         # pipeline_depth), never with another plan.
         self._inflight: deque = deque()
+
+        # device-plane saturation probe (obs/planes.py): dispatch-queue
+        # depth read lazily at window-roll time.  plane() resolved per
+        # call — planes.reset() rebinds the table; weakref so the probe
+        # never pins a dead planner; last-constructed planner owns it
+        # (same discipline as raft/scheduler).
+        import weakref
+        _ref = weakref.ref(self)
+        _planes.plane(_planes.DEVICE).set_probe(
+            lambda: ({"depth": float(len(_ref()._inflight))}
+                     if _ref() is not None else {}))
 
     # ------------------------------------------------------------- accounting
 
@@ -1248,6 +1260,7 @@ class TPUPlanner:
         k = len(task_group)
         # one round-trip for all outputs: D2H latency dominates over
         # tunneled links, so never fetch twice
+        _d2h_t0 = _time.perf_counter()
         try:
             with tracer.span("plan.d2h", "plan"):
                 x, fail_counts, spill = fetch_plan(handle.arrays)
@@ -1263,6 +1276,10 @@ class TPUPlanner:
             self._cache = None
             return False
         handle.arrays = None
+        # the d2h wait IS the device plane's busy window: the host is
+        # stalled on the accelerator, which is what saturation means here
+        _planes.plane(_planes.DEVICE).note_busy(
+            _time.perf_counter() - _d2h_t0)
         self.breaker.record_success()
         self._note_inflight(_time.perf_counter() - _plan_t0)
         if bool(spill):
@@ -1620,6 +1637,7 @@ class TPUPlanner:
         if run.aborted or run.next_fetch >= run.next_dispatch:
             return None
         c = run.chunks[run.next_fetch]
+        _d2h_t0 = _time.perf_counter()
         try:
             with tracer.span("plan.d2h", "plan"):
                 xs, fcs, spills = fetch_plan(c.arrays)
@@ -1636,6 +1654,7 @@ class TPUPlanner:
         run.next_fetch += 1
         self.breaker.record_success()
         end = _time.perf_counter()
+        _planes.plane(_planes.DEVICE).note_busy(end - _d2h_t0)
         # chunk windows overlap (two dispatches in flight): charge
         # plan_seconds only the wall time this chunk ADDED beyond the
         # previous fetch, or summed plan_s would exceed the tick wall

@@ -26,8 +26,10 @@ step under virtual time.  Verdicts write ``Service.pipeline_status``
 (models/objects.py) through ``store.update`` — epoch-pinned at commit,
 replicated with the row — so a successor leader's supervisor resumes
 released/halted stages exactly where the deposed one left them.
-Failure OBSERVATION counts are leader-local (re-counted after
-failover); verdicts, being replicated, are not.
+Failure OBSERVATIONS replicate too: every drive folds newly seen
+distinct failed-task ids into ``PipelineStatus.failed_ids`` on the
+committed row, so a poison count at 2/3 on a crashed leader trips on
+the successor's first new observation instead of restarting at zero.
 
 ``_cascade_enabled`` is the checker-sensitivity seam: with it off a
 poisoned upstream no longer halts downstream stages and the sim's
@@ -119,7 +121,15 @@ class PipelineSupervisor:
         by_service: Dict[str, List[Task]] = {}
         for t in tasks:
             by_service.setdefault(t.service_id, []).append(t)
-        poisoned = self._observe_failures(services, by_service)
+        # only pipeline participants (stages + their upstreams) carry
+        # replicated observations — an unrelated service's failures are
+        # the restart supervisor's business, not a pipeline verdict
+        dep_names = {d for s in stages for d in s.spec.depends_on}
+        relevant = {s.id for s in stages} | {
+            s.id for s in services
+            if s.spec.annotations.name in dep_names}
+        poisoned = self._observe_failures(services, by_service,
+                                          relevant)
 
         for svc in sorted(stages, key=lambda s: s.id):
             try:
@@ -129,16 +139,28 @@ class PipelineSupervisor:
                     raise
                 log.exception("pipeline decision for %s failed", svc.id)
 
-    def _observe_failures(self, services, by_service) -> Set[str]:
+    def _observe_failures(self, services, by_service,
+                          relevant: Set[str]) -> Set[str]:
         """Accumulate per-service failure observations; returns the ids
-        of services currently over the poison threshold."""
+        of services currently over the poison threshold.  Observations
+        for pipeline participants (``relevant``) merge with — and fold
+        back into — the replicated ``PipelineStatus.failed_ids`` so
+        the count survives leader failover."""
         poisoned: Set[str] = set()
         for svc in services:
             seen = self._failed_seen.setdefault(svc.id, set())
+            st = svc.pipeline_status
+            if st is not None and st.failed_ids:
+                # a prior leader's (or our own committed) observations
+                seen.update(st.failed_ids)
             for t in by_service.get(svc.id, []):
                 if t.status.state in (TaskState.FAILED,
                                       TaskState.REJECTED):
                     seen.add(t.id)
+            if svc.id in relevant:
+                have = set(st.failed_ids) if st is not None else set()
+                if seen - have:
+                    self._persist_failures(svc.id, set(seen))
             if len(seen) >= POISON_FAILURES:
                 poisoned.add(svc.id)
         return poisoned
@@ -202,6 +224,29 @@ class PipelineSupervisor:
 
     # ---------------------------------------------------------------- writes
 
+    def _persist_failures(self, sid: str, seen: Set[str]) -> None:
+        """Fold newly observed distinct failed-task ids into the
+        replicated row (ISSUE 16 residual: the poison threshold must
+        trip across a leader crash at 2/3 observations)."""
+
+        def cb(tx: WriteTx) -> None:
+            cur = tx.get(Service, sid)
+            if cur is None:
+                return
+            st = cur.pipeline_status
+            have = set(st.failed_ids) if st is not None else set()
+            merged = sorted(have | seen)
+            if st is not None and merged == sorted(st.failed_ids):
+                return    # raced with our own earlier commit: no-op
+            cur = cur.copy()
+            cur.pipeline_status = (cur.pipeline_status.copy()
+                                   if cur.pipeline_status is not None
+                                   else PipelineStatus())
+            cur.pipeline_status.failed_ids = merged
+            tx.update(cur)
+
+        self._update(cb, "persist pipeline failure observations")
+
     def _release(self, svc: Service) -> None:
         sid = svc.id
         state: Dict[str, bool] = {}
@@ -215,7 +260,8 @@ class PipelineSupervisor:
                 return    # released already, or halted meanwhile
             cur = cur.copy()
             cur.pipeline_status = PipelineStatus(
-                state="released", reason="", updated_at=now())
+                state="released", reason="", updated_at=now(),
+                failed_ids=list(cur_st.failed_ids) if cur_st else [])
             tx.update(cur)
             state["written"] = True
 
@@ -240,7 +286,8 @@ class PipelineSupervisor:
                 return
             cur = cur.copy()
             cur.pipeline_status = PipelineStatus(
-                state="halted", reason=reason, updated_at=now())
+                state="halted", reason=reason, updated_at=now(),
+                failed_ids=list(cur_st.failed_ids) if cur_st else [])
             if rollback and cur.spec.replicated is not None:
                 # rollback policy: drain the stage — the orchestrator
                 # shuts the running tasks down as replicas go to zero

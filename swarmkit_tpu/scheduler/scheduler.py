@@ -25,6 +25,7 @@ from ..models.objects import Cluster, Node, Service, Task, Volume
 from ..models.types import (
     Resources, TaskState, TaskStatus, now,
 )
+from ..obs import planes as _planes
 from ..obs.trace import tracer
 from ..utils.metrics import registry as _metrics
 from ..utils.pipeline import default_pipeline_depth
@@ -90,6 +91,8 @@ class _TickCommitter:
         ticket = {"draft": draft, "done": threading.Event(),
                   "committed": 0, "failed": [], "missing": []}
         self._tickets.append(ticket)
+        _metrics.gauge("swarm_scheduler_chunk_inflight",
+                       float(len(self._tickets) - self._resolved))
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._run, name="sched-commit", daemon=True)
@@ -104,6 +107,8 @@ class _TickCommitter:
         while len(self._tickets) - self._resolved > max_inflight:
             self._tickets[self._resolved]["done"].wait()
             self._resolved += 1
+        _metrics.gauge("swarm_scheduler_chunk_inflight",
+                       float(len(self._tickets) - self._resolved))
 
     @staticmethod
     def _fail_all(ticket: dict) -> None:
@@ -267,6 +272,31 @@ class Scheduler:
         from collections import deque
         self.stats = {"ticks": 0, "decisions": 0, "commit_seconds": 0.0,
                       "tick_seconds": deque(maxlen=1024)}
+
+        # scheduler-plane saturation probe (obs/planes.py): backlog
+        # depth and oldest pending age, read lazily at window-roll time.
+        # plane() is resolved per call — planes.reset() rebinds the
+        # table and a cached PlaneStats would go stale.  The probe holds
+        # a WEAKREF: it must never pin a dead scheduler's task graph
+        # (bench builds one per trial).  Co-resident schedulers (HA
+        # tests): last constructed owns the probe.
+        import weakref
+        _ref = weakref.ref(self)
+
+        def _sched_probe():
+            sched = _ref()
+            if sched is None:
+                return {}
+            tasks = list(sched.unassigned_tasks.values())
+            depth = float(len(tasks)
+                          + len(sched.pending_preassigned_tasks))
+            oldest = 0.0
+            stamps = [t.status.timestamp for t in tasks
+                      if t.status is not None and t.status.timestamp]
+            if stamps:
+                oldest = max(0.0, now() - min(stamps))
+            return {"depth": depth, "oldest_age": oldest}
+        _planes.plane(_planes.SCHEDULER).set_probe(_sched_probe)
 
     # ------------------------------------------------------------------ setup
 
@@ -600,7 +630,9 @@ class Scheduler:
             n = self._tick_inner()
             if sp is not None:
                 sp.args = {"decisions": n}
-        _TICK_TIMER.observe(now() - t0)
+        _dt = now() - t0
+        _TICK_TIMER.observe(_dt)
+        _planes.plane(_planes.SCHEDULER).note_busy(_dt)
         return n
 
     def _tick_inner(self) -> int:

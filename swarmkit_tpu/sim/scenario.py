@@ -19,7 +19,9 @@ import os
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from ..obs import planes as planes_mod
 from ..obs.flightrec import flightrec
+from ..obs.journey import journeys
 from ..obs.sampler import Sampler
 from ..obs.trace import tracer
 from ..utils.sampling import poisson as _poisson
@@ -47,6 +49,12 @@ class SimReport:
     # seed (virtual timestamps, delta-based samples)
     flightrec_path: str = ""
     flightrec_sha256: str = ""
+    # per-task journey ledger (obs/journey.py) captured at scenario
+    # exit: milestones ride replicated stamps, so the dump — and its
+    # sha — is a pure function of (scenario, seed), leader crashes
+    # included (stitched across members, asserted in tests/test_obs.py)
+    journeys_dump: dict = field(default_factory=dict)
+    journeys_sha256: str = ""
 
     def __post_init__(self) -> None:
         if self.obs_trace and not self.obs_trace_sha256:
@@ -64,6 +72,8 @@ class SimReport:
         if self.flightrec_path:
             out["flightrec_path"] = self.flightrec_path
             out["flightrec_sha256"] = self.flightrec_sha256
+        if self.journeys_sha256:
+            out["journeys_sha256"] = self.journeys_sha256
         return out
 
 
@@ -2008,6 +2018,8 @@ def run_scenario(name: str, seed: int, n_managers: int = 3,
         # manager process.
         saved = tracer.save_state()
         fr_saved = flightrec.save_state()
+        pl_saved = planes_mod.save_state()
+        j_saved = journeys.save_state()
         tracer.reset()
         tracer.enable()
         # the black box records continuously under virtual time:
@@ -2018,6 +2030,14 @@ def run_scenario(name: str, seed: int, n_managers: int = 3,
         # function of the seed.
         flightrec.reset(deterministic=True)
         flightrec.enabled = True
+        # journeys at full sample under the virtual clock: every member
+        # mints milestones from replicated stamps via the recorder's
+        # store taps, so the ledger stitches across leader crashes and
+        # its bytes are seed-pure (JOURNEY_CAP bounds memory)
+        planes_mod.reset()
+        journeys.reset(sample_rate=1.0)
+        journeys.enabled = True
+        flightrec.journey_sink = journeys.handle_event
         # raft-attached mode taps every member's replicated store (the
         # leader's commits and the followers' replayed applies both land
         # in the black box); standalone taps the one control-plane store.
@@ -2052,13 +2072,21 @@ def run_scenario(name: str, seed: int, n_managers: int = 3,
         finally:
             tracer.disable()
             obs_trace = tracer.to_json()
+            # fold any store events still buffered into the ledger
+            # before capturing it (the dump below reads it too)
+            flightrec.poll_store()
+            j_dump = journeys.dump()
+            j_sha = hashlib.sha256(journeys.dump_bytes()).hexdigest()
             if crashed or sim.violations.items:
                 fr_path, fr_sha = _dump_flightrec(name, seed,
                                                   flightrec_dir)
             flightrec.enabled = False
+            journeys.enabled = False
             for s in fr_stores:                     # only the sim's taps
                 flightrec.unwatch_store(s)
             flightrec.restore_state(fr_saved)
+            journeys.restore_state(j_saved)
+            planes_mod.restore_state(pl_saved)
             tracer.restore_state(saved)
     return SimReport(
         scenario=name, seed=seed, duration=duration + grace,
@@ -2067,7 +2095,8 @@ def run_scenario(name: str, seed: int, n_managers: int = 3,
         violations=list(sim.violations.items), stats=stats,
         trace=list(sim.engine.trace) if keep_trace else [],
         obs_trace=obs_trace, flightrec_path=fr_path,
-        flightrec_sha256=fr_sha)
+        flightrec_sha256=fr_sha, journeys_dump=j_dump,
+        journeys_sha256=j_sha)
 
 
 def _dump_flightrec(name: str, seed: int,

@@ -114,6 +114,22 @@ class RaftNode(Proposer):
         self.core.on_read_ready = self._on_read_ready
 
         self._inbox: "queue.Queue" = queue.Queue()
+        # plane saturation probes (obs/planes.py): inbox depth is the
+        # commit plane's queue, commit-applied lag is the apply plane's.
+        # Pulled at roll time — the hot paths stay untouched; weakref so
+        # a probe never pins a stopped node.  With co-resident nodes
+        # (HA tests) the last-constructed node owns the probe;
+        # production runs one node per process.
+        import weakref
+        from ...obs import planes as _planes
+        _ref = weakref.ref(self)
+        _planes.plane(_planes.RAFT).set_probe(
+            lambda: ({"depth": float(_ref()._inbox.qsize())}
+                     if _ref() is not None else {}))
+        _planes.plane(_planes.RAFT_APPLY).set_probe(
+            lambda: ({"depth": float(max(
+                0, _ref().core.commit_index - _ref().core.applied_index))}
+                if _ref() is not None else {}))
         self._waiters: Dict[int, _Waiter] = {}
         self._waiters_lock = threading.Lock()
         self._read_waiters: Dict[int, dict] = {}
@@ -327,8 +343,13 @@ class RaftNode(Proposer):
     def _process_ready(self) -> None:
         while self.core.has_ready():
             rd = self.core.ready()
-            # 1. persist before anything else
+            # 1. persist before anything else (the fsync batch: its
+            # share of wall time is the raft plane's occupancy)
+            _save_t0 = time.perf_counter()
             self.logger.save(rd.hard_state, rd.entries)
+            from ...obs import planes as _planes
+            _planes.plane(_planes.RAFT).note_busy(
+                time.perf_counter() - _save_t0)
             if rd.snapshot is not None and rd.snapshot.data:
                 self.logger.save_snapshot(rd.snapshot, rd.snapshot.index)
                 self.store.restore_bytes(rd.snapshot.data)
@@ -445,7 +466,10 @@ class RaftNode(Proposer):
                         ok = False
                 waiter.ok = ok
                 waiter.event.set()
-                _APPLY_TIMER.observe(time.perf_counter() - _apply_t0)
+                _dt = time.perf_counter() - _apply_t0
+                _APPLY_TIMER.observe(_dt)
+                from ...obs import planes as _planes
+                _planes.plane(_planes.RAFT_APPLY).note_busy(_dt)
                 return
             # the waiter was cancelled (leadership churn) but the entry
             # committed anyway: apply it like a remote entry so this store
@@ -456,7 +480,10 @@ class RaftNode(Proposer):
             self.store.apply_store_actions(actions)
         except Exception:
             log.exception("applying raft entry %d failed", e.index)
-        _APPLY_TIMER.observe(time.perf_counter() - _apply_t0)
+        _dt = time.perf_counter() - _apply_t0
+        _APPLY_TIMER.observe(_dt)
+        from ...obs import planes as _planes
+        _planes.plane(_planes.RAFT_APPLY).note_busy(_dt)
 
     def _maybe_snapshot(self) -> None:
         """reference: raft.go:781 needsSnapshot + doSnapshot."""

@@ -212,6 +212,24 @@ class Subscription:
                     self._splice_front_locked(events[1:])
             return events[0]
 
+    def backlog(self) -> int:
+        """Buffered, unconsumed entries — coalesced blocks count their
+        expansion size, so with one entry per committed version this is
+        the subscription's lag in store versions (the watch plane's
+        queue-depth probe reads it; observability only, never consumes)."""
+        with self._cond:
+            items = list(self._buf)
+        n = 0
+        for it in items:
+            if getattr(it, "expand_events", None) is not None:
+                try:
+                    n += len(it)
+                    continue
+                except Exception:
+                    pass
+            n += 1
+        return n
+
     def drain(self) -> List[Any]:
         with self._cond:
             raw = list(self._buf)

@@ -699,6 +699,56 @@ def test_supervisor_poison_halts_and_rolls_back_downstream():
     assert _status(store, "b").state == "halted"
 
 
+def test_supervisor_poison_count_survives_leader_crash():
+    """ISSUE 16 residual: failure observations replicate via
+    ``PipelineStatus.failed_ids``.  A leader crashing at 2/3
+    observations must NOT reset the poison count — the successor's
+    supervisor (fresh ``_failed_seen``) trips the threshold on its
+    first new observation."""
+    store = MemoryStore()
+    _mk_service(store, "a", replicas=2)
+    _mk_service(store, "b", depends_on=("a",))
+    sup1 = PipelineSupervisor(store, start_worker=False)
+    # 2/3: below the threshold, but the observations must commit
+    _set_tasks(store, "a", [TaskState.FAILED, TaskState.FAILED])
+    sup1.drive()
+    assert _status(store, "b") is None or \
+        _status(store, "b").state != "halted"
+    st_a = _status(store, "a")
+    assert st_a is not None and len(st_a.failed_ids) == 2
+    # leader crash: the successor's supervisor has no local memory and
+    # the old tasks are gone (reaped) — only the replicated row remains
+    sup2 = PipelineSupervisor(store, start_worker=False)
+    _set_tasks(store, "a", [TaskState.FAILED])    # 3rd distinct id
+    sup2.drive()
+    st_b = _status(store, "b")
+    assert st_b is not None and st_b.state == "halted"
+    assert "poisoned" in st_b.reason
+    # all three observations are on the replicated row now
+    assert len(_status(store, "a").failed_ids) == POISON_FAILURES
+
+
+def test_supervisor_verdict_preserves_failed_ids():
+    """Release/halt verdict writes must carry ``failed_ids`` forward —
+    a stage that is both a downstream (gets verdicts) and an upstream
+    (accrues observations) must not lose its count to a verdict."""
+    store = MemoryStore()
+    _mk_service(store, "a", replicas=1)
+    _mk_service(store, "b", replicas=2, depends_on=("a",))
+    _mk_service(store, "c", depends_on=("b",))
+    sup = PipelineSupervisor(store, start_worker=False)
+    # b accrues one failure observation (below threshold), then its
+    # upstream readies and b gets a released verdict
+    _set_tasks(store, "b", [TaskState.FAILED])
+    sup.drive()
+    assert len(_status(store, "b").failed_ids) == 1
+    _set_tasks(store, "a", [TaskState.RUNNING])
+    sup.drive()
+    st_b = _status(store, "b")
+    assert st_b.state == "released"
+    assert len(st_b.failed_ids) == 1
+
+
 def test_supervisor_halted_upstream_cascades():
     store = MemoryStore()
     _mk_service(store, "a", replicas=1)

@@ -1,0 +1,420 @@
+"""ISSUE 17: per-plane saturation metrics + causal task journeys.
+
+Covers the PlaneStats window/probe contract, the journey ledger's
+milestone grammar and critical-path attribution, checker sensitivity
+for the two new saturation SLO checks (a stalled committer and a
+saturated scheduler plane MUST fail; their healthy twins MUST stay
+green), PYTHONHASHSEED-independence of the ledger and ``/debug/planes``
+bytes, journey byte-identity across a raft-attached leader crash
+(stitched, not truncated), and the render-on-empty bugfix sweep for
+``/debug/health`` + ``/debug/planes``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from swarmkit_tpu.models import Meta, Task, TaskState, TaskStatus
+from swarmkit_tpu.obs import planes as planes_mod
+from swarmkit_tpu.obs.health import (
+    FAIL, PASS, WARN, Check, HealthEvaluator, apply_lag_value,
+    default_checks, plane_saturation_value,
+)
+from swarmkit_tpu.obs.flightrec import FlightRecorder
+from swarmkit_tpu.obs.journey import (
+    JOURNEY_CAP, JourneyLedger, journeys,
+)
+from swarmkit_tpu.obs.planes import PlaneStats
+from swarmkit_tpu.sim.clock import VirtualClock
+from swarmkit_tpu.utils.metrics import Registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def fresh_planes():
+    """Isolate the module plane table (rebound by reset, so the saved
+    capture survives) and the shared journey ledger."""
+    p_saved = planes_mod.save_state()
+    j_saved = journeys.save_state()
+    planes_mod.reset()
+    journeys.reset(sample_rate=1.0, cap=JOURNEY_CAP)
+    yield
+    planes_mod.restore_state(p_saved)
+    journeys.restore_state(j_saved)
+
+
+# ------------------------------------------------------------ plane windows
+
+def test_plane_occupancy_window_and_gauges():
+    reg = Registry()
+    with VirtualClock(1000.0) as clk:
+        p = PlaneStats("scheduler", registry=reg)
+        # construction consumes no time; the first roll opens the window
+        assert p.roll()["occupancy"] == 0.0
+        p.note_busy(2.0)
+        clk.advance_to(1004.0)        # 2s busy over a 4s window
+        snap = p.roll()
+        assert snap["occupancy"] == 0.5
+        assert reg.get_gauge(
+            'swarm_plane_occupancy{plane="scheduler"}') == 0.5
+        # the window resets: a fresh roll with no busy time reads 0
+        clk.advance_to(1008.0)
+        assert p.roll()["occupancy"] == 0.0
+        # busy time is clamped: over-reporting never exceeds 1.0
+        p.note_busy(100.0)
+        clk.advance_to(1009.0)
+        assert p.roll()["occupancy"] == 1.0
+
+
+def test_plane_probe_depth_age_and_drop_counters():
+    reg = Registry()
+    with VirtualClock(1000.0) as clk:
+        p = PlaneStats("raft", registry=reg)
+        p.set_probe(lambda: {"depth": 7.0, "oldest_age": 1.5})
+        clk.advance_to(1001.0)
+        snap = p.roll()
+        assert snap["queue_depth"] == 7.0
+        assert snap["oldest_age_s"] == 1.5
+        assert reg.get_gauge(
+            'swarm_plane_queue_depth{plane="raft"}') == 7.0
+        p.drop(); p.defer(2)
+        assert reg.get_counter(
+            'swarm_plane_drops{plane="raft"}') == 1
+        assert reg.get_counter(
+            'swarm_plane_defers{plane="raft"}') == 2
+        rep = p.report()
+        assert rep["drops"] == 1 and rep["defers"] == 2
+
+
+def test_plane_probe_failure_never_raises():
+    """A dying component's probe (or a dead weakref target) must not
+    take observability down — roll() swallows and reports stale."""
+    with VirtualClock(1000.0) as clk:
+        p = PlaneStats("device", registry=Registry())
+
+        def boom():
+            raise RuntimeError("component mid-teardown")
+        p.set_probe(boom)
+        clk.advance_to(1001.0)
+        p.roll()                      # must not raise
+        assert p.report()["queue_depth"] == 0.0
+
+
+def test_report_all_empty_and_sorted(fresh_planes):
+    assert planes_mod.report_all() == {}
+    for name in ("watch", "raft", "device"):
+        planes_mod.plane(name)
+    assert list(planes_mod.report_all()) == ["device", "raft", "watch"]
+
+
+# ---------------------------------------------------------- journey ledger
+
+def _task(tid, state, ts, created_at=0.0):
+    return Task(id=tid, meta=Meta(created_at=created_at),
+                status=TaskStatus(state=state, timestamp=ts))
+
+
+def _feed(ledger, tid, t0=1000.0):
+    """One complete created->running journey, milestones 1s apart."""
+    ledger.observe_task(_task(tid, TaskState.NEW, t0, created_at=t0),
+                        version=1, created=True)
+    ledger.observe_task(_task(tid, TaskState.PENDING, t0 + 1.0),
+                        version=2)
+    ledger.observe_task(_task(tid, TaskState.ASSIGNED, t0 + 3.0),
+                        version=3)
+    ledger.note_sent(tid, ts=t0 + 4.0)
+    ledger.observe_task(_task(tid, TaskState.ACCEPTED, t0 + 5.0),
+                        version=4)
+    ledger.observe_task(_task(tid, TaskState.RUNNING, t0 + 7.0),
+                        version=5)
+
+
+def test_journey_milestones_dedup_and_edges():
+    led = JourneyLedger(sample_rate=1.0)
+    led.enabled = True
+    _feed(led, "t1")
+    # replicated re-sightings (another member, post-failover replay)
+    # are idempotent: first stamp wins
+    led.observe_task(_task("t1", TaskState.RUNNING, 2000.0), version=9)
+    ms = led.journey_of("t1")
+    names = [n for n, _ts, _v in ms]
+    assert names == ["created", "admitted", "planned", "committed",
+                     "assigned_sent", "agent_ack", "running"]
+    assert ms[-1][1] == 1007.0        # not the 2000.0 re-sighting
+    # the edges partition created->running exactly
+    total = sum(dt for _e, dt, _p in led.edges(ms))
+    assert total == pytest.approx(7.0)
+
+
+def test_critical_path_fractions_sum_to_one():
+    led = JourneyLedger(sample_rate=1.0)
+    led.enabled = True
+    for i in range(10):
+        _feed(led, f"t{i:03d}", t0=1000.0 + 50.0 * i)
+    attr = led.critical_path(0.99)
+    assert attr["tasks"] == 10 and attr["cohort"] >= 1
+    assert attr["planes"], "attribution must name owning planes"
+    frac = sum(p["frac"] for p in attr["planes"].values())
+    assert frac == pytest.approx(1.0, abs=0.01)
+    secs = sum(p["seconds"] for p in attr["planes"].values())
+    assert secs == pytest.approx(attr["total_s"])
+    # every edge of a journey is charged to the later milestone's plane
+    assert set(attr["planes"]) <= {"api", "orchestrator", "scheduler",
+                                   "commit", "dispatcher", "agent"}
+
+
+def test_journey_cap_and_sampling_are_counted():
+    led = JourneyLedger(sample_rate=1.0, cap=2)
+    led.enabled = True
+    for i in range(4):
+        _feed(led, f"t{i}")
+    s = led.summary()
+    assert s["sampled_tasks"] == 2
+    assert s["overflow"] > 0          # refusals are counted, not silent
+    led2 = JourneyLedger(sample_rate=0.0)
+    led2.enabled = True
+    _feed(led2, "tx")
+    assert led2.summary()["sampled_tasks"] == 0
+    assert led2.summary()["refused"] > 0
+
+
+def test_disabled_ledger_records_nothing():
+    led = JourneyLedger(sample_rate=1.0)
+    assert led.enabled is False       # dark by default
+    led.handle_event(None)
+    led.note_sent("t1")
+    assert led.summary()["sampled_tasks"] == 0
+
+
+# ------------------------------------------- saturation checker sensitivity
+
+def _hev(check):
+    return HealthEvaluator(registry=check_reg, recorder=FlightRecorder(),
+                           checks=[check])
+
+
+check_reg = None   # rebound per test
+
+
+def _sched_check():
+    return Check("scheduler_occupancy", plane_saturation_value(
+        "scheduler"), 1.0, 2.0, "state")
+
+
+def _lag_check():
+    return Check("apply_lag", apply_lag_value(warn_entries=256.0, n=4),
+                 1.0, 2.0, "state")
+
+
+def test_scheduler_occupancy_check_fires_and_green_twin():
+    global check_reg
+    check_reg = reg = Registry()
+    hev = _hev(_sched_check())
+    # no data: a fresh manager is healthy, not unknown-unhealthy
+    assert hev.evaluate() == {"scheduler_occupancy": PASS}
+    # sustained occupancy at the ceiling -> warn
+    reg.gauge('swarm_plane_occupancy{plane="scheduler"}', 0.95)
+    assert hev.evaluate() == {"scheduler_occupancy": WARN}
+    # unbounded backlog-age growth (strict, over the floor) -> fail
+    for age in (1.0, 2.0, 4.0, 8.0):
+        reg.gauge('swarm_plane_oldest_age_s{plane="scheduler"}', age)
+        states = hev.evaluate()
+    assert states == {"scheduler_occupancy": FAIL}
+    assert hev.failing()
+    # green twin: same shape, healthy numbers — must stay green
+    check_reg = reg2 = Registry()
+    hev2 = _hev(_sched_check())
+    reg2.gauge('swarm_plane_occupancy{plane="scheduler"}', 0.30)
+    for _ in range(4):               # flat age: no growth, no fail
+        reg2.gauge('swarm_plane_oldest_age_s{plane="scheduler"}', 1.0)
+        assert hev2.evaluate() == {"scheduler_occupancy": PASS}
+
+
+def test_apply_lag_check_stalled_committer_fails_green_twin_passes():
+    global check_reg
+    check_reg = reg = Registry()
+    hev = _hev(_lag_check())
+    assert hev.evaluate() == {"apply_lag": PASS}      # no raft plane yet
+    # stalled committer: lag over the bar AND strictly growing
+    for lag in (300.0, 340.0, 400.0, 500.0):
+        reg.gauge('swarm_plane_queue_depth{plane="raft_apply"}', lag)
+        states = hev.evaluate()
+    assert states == {"apply_lag": FAIL}
+    # over the bar but NOT growing: catching up -> warn only
+    check_reg = reg2 = Registry()
+    hev2 = _hev(_lag_check())
+    for lag in (500.0, 400.0, 300.0, 280.0):
+        reg2.gauge('swarm_plane_queue_depth{plane="raft_apply"}', lag)
+        states = hev2.evaluate()
+    assert states == {"apply_lag": WARN}
+    # green twin: healthy lag stays green forever
+    check_reg = reg3 = Registry()
+    hev3 = _hev(_lag_check())
+    for lag in (3.0, 5.0, 2.0, 7.0, 4.0):
+        reg3.gauge('swarm_plane_queue_depth{plane="raft_apply"}', lag)
+        assert hev3.evaluate() == {"apply_lag": PASS}
+
+
+def test_default_checks_include_saturation_checks():
+    names = {c.name for c in default_checks()}
+    assert {"scheduler_occupancy", "apply_lag"} <= names
+
+
+# ----------------------------------------------- hash-seed independence
+
+_HASHSEED_SCRIPT = r"""
+import hashlib, json, sys
+from swarmkit_tpu.sim.clock import VirtualClock
+from swarmkit_tpu.models import Meta, Task, TaskState, TaskStatus
+from swarmkit_tpu.obs import planes as planes_mod
+from swarmkit_tpu.obs.debugpages import _h_planes
+from swarmkit_tpu.obs.journey import journeys
+
+planes_mod.reset()
+journeys.reset(sample_rate=0.5)
+journeys.enabled = True
+with VirtualClock(1000.0) as clk:
+    # feed task ids out of a SET: iteration order varies with the hash
+    # seed, the ledger's output must not
+    ids = {f"task-{i:04d}" for i in range(200)}
+    for tid in ids:
+        journeys.observe_task(
+            Task(id=tid, meta=Meta(created_at=1000.0),
+                 status=TaskStatus(state=TaskState.NEW,
+                                   timestamp=1000.0)),
+            version=1, created=True)
+        journeys.observe_task(
+            Task(id=tid,
+                 status=TaskStatus(state=TaskState.RUNNING,
+                                   timestamp=1002.0)),
+            version=2)
+    for name in {"scheduler", "raft", "watch", "device"}:
+        planes_mod.plane(name).note_busy(0.5)
+    clk.advance_to(1010.0)
+    planes_mod.roll_all()
+body, code, _ = _h_planes(None, {})
+assert code == 200
+print(hashlib.sha256(journeys.dump_bytes()).hexdigest())
+print(hashlib.sha256(body).hexdigest())
+"""
+
+
+def test_hashseed_independent_ledger_and_planes_page():
+    """The journey ledger bytes and the /debug/planes body are pure
+    functions of the fed events — two processes with different
+    PYTHONHASHSEED must emit identical hashes (crc32 sampling + sorted
+    dumps, never hash())."""
+    outs = []
+    for seed in ("0", "424242"):
+        env = dict(os.environ, PYTHONHASHSEED=seed,
+                   JAX_PLATFORMS="cpu")
+        r = subprocess.run([sys.executable, "-c", _HASHSEED_SCRIPT],
+                           capture_output=True, text=True, cwd=REPO,
+                           env=env, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        outs.append(r.stdout)
+    assert outs[0] == outs[1], f"hash-seed dependent output:\n{outs}"
+
+
+# ------------------------------------- sim determinism: stitched journeys
+
+def _assert_stitched(report):
+    """The ledger survived the crash stitched: complete journeys exist
+    (created AND running present on one task), and planned/committed
+    milestones carry store-version tokens."""
+    summary = report.journeys_dump["summary"]
+    assert summary["sampled_tasks"] > 0, "ledger is empty"
+    assert summary["complete"] > 0, "no complete journey: truncated?"
+    versioned = [
+        v for ms in report.journeys_dump["journeys"].values()
+        for name, _ts, v in ms if name == "committed"]
+    assert versioned and all(v > 0 for v in versioned)
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_journey_byte_identity_across_leader_crash(seed):
+    """Same scenario + seed twice: the raft-attached leader-crash run
+    must produce byte-identical journey ledgers (the acceptance bar:
+    milestones ride replicated stamps, so a successor leader's events
+    dedup instead of forking the ledger)."""
+    from swarmkit_tpu.sim.scenario import run_scenario
+
+    r1 = run_scenario("leader-crash-mid-tick", seed=seed)
+    r2 = run_scenario("leader-crash-mid-tick", seed=seed)
+    assert r1.ok and r2.ok, (r1.violations, r2.violations)
+    assert r1.journeys_sha256 == r2.journeys_sha256
+    assert r1.journeys_dump == r2.journeys_dump
+    _assert_stitched(r1)
+
+
+@pytest.mark.slow
+def test_journey_byte_identity_twenty_seeds():
+    from swarmkit_tpu.sim.scenario import run_scenario
+
+    for seed in range(20):
+        r1 = run_scenario("leader-crash-mid-tick", seed=seed)
+        r2 = run_scenario("leader-crash-mid-tick", seed=seed)
+        assert r1.journeys_sha256 == r2.journeys_sha256, f"seed {seed}"
+        _assert_stitched(r1)
+
+
+# --------------------------------------------- debug pages render-on-empty
+
+def test_debug_pages_render_on_fresh_manager(fresh_planes):
+    """Bugfix sweep: /debug/health and /debug/planes must render (not
+    500) on a fresh manager with zero observations."""
+    import urllib.request
+
+    from swarmkit_tpu.utils.httpdebug import DebugServer
+
+    hev = HealthEvaluator(registry=Registry(),
+                          recorder=FlightRecorder(),
+                          checks=default_checks())
+    srv = DebugServer(health_evaluator=hev)
+    srv.start()
+    try:
+        for path in ("/debug/health", "/debug/planes"):
+            url = f"http://{srv.addr[0]}:{srv.addr[1]}{path}"
+            with urllib.request.urlopen(url) as resp:
+                assert resp.status == 200, path
+                doc = json.loads(resp.read().decode())
+        # the planes page on an empty process: empty taxonomy + the
+        # ledger summary, never a traceback
+        assert doc["planes"] == {}
+        assert doc["journeys"]["sampled_tasks"] == 0
+    finally:
+        srv.stop()
+
+
+def test_debug_pages_render_on_deposed_ex_leader(fresh_planes):
+    """Bugfix sweep, second arm: a deposed ex-leader's components are
+    torn down (weakref probes dead, probes may raise) — the pages must
+    still render from the module-level state that remains."""
+    import gc
+    import weakref
+
+    from swarmkit_tpu.obs.debugpages import _h_planes
+
+    class Dying:
+        def depth(self):
+            return {"depth": 1.0}
+
+    comp = Dying()
+    ref = weakref.ref(comp)
+    planes_mod.plane("scheduler").set_probe(
+        lambda: ref().depth() if ref() is not None else {})
+
+    def boom():
+        raise RuntimeError("session torn down")
+    planes_mod.plane("dispatcher").set_probe(boom)
+    del comp
+    gc.collect()
+    planes_mod.roll_all()            # dead + raising probes: no crash
+    body, code, ctype = _h_planes(None, {})
+    assert code == 200 and ctype == "application/json"
+    doc = json.loads(body)
+    assert set(doc["planes"]) == {"dispatcher", "scheduler"}

@@ -117,7 +117,11 @@ def test_rule_passes_clean_twin(rule):
     #                              strategy-kernel shapes (ISSUE 15):
     #                              numpy sort in the score stage, D2H
     #                              float() cast on a traced score
-    ("metric-hygiene", 4),     # bad chars/unsorted/duplicate/upper key
+    ("metric-hygiene", 7),     # bad chars/unsorted/duplicate/upper key
+    #                            + the metric-cardinality shapes
+    #                            (ISSUE 17): per-entity task= / node_id=
+    #                            / session= label keys, one series per
+    #                            entity
 ])
 def test_rule_sensitivity_floor(rule, min_findings):
     bad, _good, relpath = FIXTURES[rule]
