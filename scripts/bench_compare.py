@@ -45,6 +45,7 @@ def _norm(doc):
     streaming, p99 = {}, {}
     strategy = {}
     gangs = {}
+    h2d_per_tick = {}
     for name, cfg in (doc.get("configs") or {}).items():
         dps = cfg.get("decisions_per_sec")
         if dps:
@@ -63,6 +64,8 @@ def _norm(doc):
             streaming[name] = cfg["streaming"]
         if cfg.get("pending_assigned_p99_s") is not None:
             p99[name] = float(cfg["pending_assigned_p99_s"])
+        if cfg.get("h2d_bytes_per_tick") is not None:
+            h2d_per_tick[name] = float(cfg["h2d_bytes_per_tick"])
         if cfg.get("stranded_frac_spread") is not None:
             strategy[name] = {
                 "stranded_frac_spread": cfg.get("stranded_frac_spread"),
@@ -106,6 +109,21 @@ def _norm(doc):
         # dict and the pending->assigned p99 the regression bound judges
         "streaming": streaming,
         "pending_assigned_p99_s": p99,
+        # device-telemetry evidence (this PR): cfg10 steady-state H2D
+        # bytes/tick from the transfer ledger, the per-direction run
+        # totals, and the compile-cache repeat misses inside the
+        # obs-overhead window (a previously-seen signature recompiling)
+        "h2d_bytes_per_tick": h2d_per_tick,
+        "device_transfer_bytes": {
+            d: sum(r["bytes"] for r in tbl.values())
+            for d, tbl in (doc.get("device_telemetry") or {})
+            .get("transfers", {}).items()}
+        if isinstance(doc.get("device_telemetry"), dict)
+        else doc.get("device_transfer_bytes"),
+        "obs_window_repeat_misses": (doc.get("obs") or {}).get(
+            "window_repeat_misses")
+        if isinstance(doc.get("obs"), dict)
+        else doc.get("obs_window_repeat_misses"),
         # strategy-seam evidence per config (cfg11): fragmentation pair,
         # spread-through-the-seam dec/s, and the fallback counters the
         # gates pin at zero
@@ -371,6 +389,23 @@ def main(argv=None) -> int:
             gate_failures.append(
                 ("streaming-p99-regression",
                  f"{_STREAM_CFG} p99 {p99_old}->{p99_new}"))
+        # device-transfer gate (device-telemetry PR): steady-state H2D
+        # bytes/tick from the transfer ledger growing >20% run-over-run
+        # means the resident tier started re-shipping columns it used
+        # to keep device-side — a transfer regression even while
+        # decisions/s still clears the threshold
+        xb_old = old.get("h2d_bytes_per_tick", {}).get(_STREAM_CFG)
+        xb_new = new.get("h2d_bytes_per_tick", {}).get(_STREAM_CFG)
+        if xb_old is not None or xb_new is not None:
+            print(f"h2d_bytes_per_tick[{_STREAM_CFG}]: "
+                  f"{xb_old} -> {xb_new}")
+        if xb_old and xb_new and xb_new > xb_old * (1.0 + 0.20):
+            print(f"\n{_STREAM_CFG} steady-state H2D bytes/tick grew "
+                  f"{xb_old} -> {xb_new} (>20%)", file=sys.stderr)
+            gate_failures.append(
+                ("device-transfer-regression",
+                 f"{_STREAM_CFG} h2d_bytes_per_tick "
+                 f"{xb_old}->{xb_new}"))
     # strategy-seam gates (ISSUE 15), judged on the NEW run's cfg11:
     # (a) binpack must actually beat spread on the stranded-capacity
     # fraction — the whole point of shipping the policy; (b) zero
@@ -562,6 +597,24 @@ def main(argv=None) -> int:
               "overhead delta is not trustworthy", file=sys.stderr)
         gate_failures.append(("obs-compile-growth",
                               f"window_compiles={owc}"))
+    # compile-cache-hit gate (device-telemetry PR), NEW run alone: any
+    # timed-window MISS on a signature the compile-cache ledger had
+    # already seen means a warm jit cache was invalidated mid-run —
+    # the per-signature twin of the aggregate compile-flatness gate
+    wrm = new.get("obs_window_repeat_misses")
+    if wrm is not None:
+        print(f"obs_window_repeat_misses: "
+              f"{old.get('obs_window_repeat_misses')} -> {wrm}")
+    if wrm:
+        print(f"\ncompile-cache ledger counted timed-window miss(es) "
+              f"on previously-seen signature(s): {', '.join(wrm)}",
+              file=sys.stderr)
+        gate_failures.append(("compile-cache-hit",
+                              f"repeat_misses={','.join(wrm)}"))
+    dtb_old = old.get("device_transfer_bytes")
+    dtb_new = new.get("device_transfer_bytes")
+    if dtb_old or dtb_new:
+        print(f"device_transfer_bytes: {dtb_old} -> {dtb_new}")
     hc_old = old.get("health_checks") or {}
     hc_new = new.get("health_checks") or {}
     for check, gate in (

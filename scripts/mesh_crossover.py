@@ -59,10 +59,12 @@ def _child(n_devices: int, nb: int, groups: int, k: int,
     import jax
     import numpy as np
 
+    from swarmkit_tpu.obs import devicetelemetry as _devtel
     from swarmkit_tpu.ops import fusedbatch
     from swarmkit_tpu.ops.kernel import (
-        FusedCarry, FusedGroups, FusedShared, plan_fused_jit,
+        FusedCarry, FusedGroups, FusedShared, fetch_plan, plan_fused_jit,
     )
+    from swarmkit_tpu.ops.planner import _jit_cache_size
 
     devices = jax.devices()
     if len(devices) < n_devices:
@@ -100,30 +102,40 @@ def _child(n_devices: int, nb: int, groups: int, k: int,
     with fusedbatch.x64():
         if n_devices == 1:
             import jax.numpy as jnp
+            # the device ledger accounts this point's staging the same
+            # way the planner's _prepare_fused cold path does
+            _devtel.note_h2d("cold_build", _devtel.tree_nbytes(
+                (tuple(shared), tuple(carry))))
             sh = FusedShared(*(jnp.asarray(a) for a in shared))
             ca = FusedCarry(*(jnp.asarray(a) for a in carry))
+            probe = plan_fused_jit
 
             def run(ca):
                 xs, fcs, spills, ca = plan_fused_jit(sh, g, ca, 1)
-                return jax.device_get((xs, fcs, spills)), ca
+                return fetch_plan((xs, fcs, spills)), ca
         else:
             from swarmkit_tpu.parallel.sharded import (
                 ShardedPlanFn, make_mesh, plan_fused_sharded,
             )
             fn = ShardedPlanFn(make_mesh(devices[:n_devices]))
+            # ShardedPlanFn._shard accounts the mesh_reshard H2D itself
             sh, ca = fn.prepare_fused(shared, carry)
+            probe = plan_fused_sharded
 
             def run(ca):
                 xs, fcs, spills, ca = plan_fused_sharded(
                     sh, g, ca, 1, fn.mesh)
-                return jax.device_get((xs, fcs, spills)), ca
+                return fetch_plan((xs, fcs, spills)), ca
 
         (x0, _, _), _ = run(ca)            # compile + parity sample
+        warm_compiles = _jit_cache_size(probe) or 0
+        tt0 = _devtel.transfer_totals()
         times = []
         for _ in range(repeats):
             t0 = time.perf_counter()
             _, _ = run(ca)                 # fresh carry each repeat
             times.append(time.perf_counter() - t0)
+        tt1 = _devtel.transfer_totals()
 
     print(json.dumps({
         "n_devices": n_devices,
@@ -133,6 +145,14 @@ def _child(n_devices: int, nb: int, groups: int, k: int,
             np.ascontiguousarray(
                 np.asarray(x0).astype(np.int64)).tobytes()).hexdigest(),
         "placed": int(np.asarray(x0).sum()),
+        # per-point device-ledger evidence: bytes moved during the
+        # timed repeats (steady-state D2H; H2D should be ~0 — the
+        # carry stays device-resident) and the jit signatures this
+        # point compiled, with timed-window growth pinned at 0
+        "transfer_bytes": {d: tt1[d] - tt0.get(d, 0) for d in tt1},
+        "compiles": warm_compiles,
+        "timed_window_compiles": (_jit_cache_size(probe) or 0)
+        - warm_compiles,
         "platform": devices[0].platform,
     }))
 

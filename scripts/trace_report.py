@@ -6,6 +6,7 @@ Usage:
     python scripts/trace_report.py sim_trace.json --json
     python scripts/trace_report.py --diff A.json B.json
     python scripts/trace_report.py --critical-path BENCH_ART.json
+    python scripts/trace_report.py --device BENCH_ART.json
 
 Works on any trace the obs tracer emits: ``bench.py``'s BENCH_TRACE_OUT,
 ``python -m swarmkit_tpu.sim --trace-json``, or a ``/debug/trace``
@@ -23,6 +24,11 @@ saturation windows and prints one row per plane — which plane owns the
 slow tail, and whether that plane's occupancy/backlog corroborates it.
 Exits 1 when the attribution is missing, empty, or does not account
 for ~100% of the tail (the CI wiring keys on that).
+``--device ART`` also takes a bench artifact: it renders the device
+telemetry ledger (kernel rows per compile bucket joined with the device
+plane's occupancy window, per-reason transfer bytes, the compile-cache
+ledger, memory watermarks, donation balance).  Exits 1 when the
+artifact predates the ledger.
 """
 
 import argparse
@@ -34,8 +40,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from swarmkit_tpu.obs.report import (  # noqa: E402
-    config_windows, diff_phase_tables, format_diff, format_table,
-    phase_table, validate_chrome_trace, x_events,
+    config_windows, device_table, diff_phase_tables, format_device_table,
+    format_diff, format_table, phase_table, validate_chrome_trace,
+    x_events,
 )
 
 
@@ -174,6 +181,26 @@ def _run_critical_path(path: str, as_json: bool) -> int:
     return 0
 
 
+def _run_device(path: str, as_json: bool) -> int:
+    """Render a bench artifact's device-telemetry ledger: kernel rows
+    joined with the device plane's occupancy window, per-reason
+    transfer bytes, compile-cache ledger, watermarks, donation
+    balance.  Exits 1 when the artifact predates the ledger."""
+    art = _load_artifact(path)
+    table = device_table(art)
+    if table is None:
+        print(f"{path}: artifact carries no device_telemetry (bench "
+              "predates the device ledger, or telemetry was disabled)",
+              file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(table, indent=2, sort_keys=True))
+        return 0
+    print(f"device telemetry ({path})")
+    print(format_device_table(table))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python scripts/trace_report.py")
     p.add_argument("trace", nargs="+",
@@ -189,8 +216,17 @@ def main(argv=None) -> int:
                    help="per-plane attribution of time-to-running p99 "
                         "from a bench ARTIFACT (exit 1 when empty or "
                         "malformed)")
+    p.add_argument("--device", action="store_true",
+                   help="device-telemetry ledger from a bench ARTIFACT: "
+                        "kernel rows per compile bucket + device-plane "
+                        "window, per-reason transfer bytes, "
+                        "compile-cache ledger (exit 1 when absent)")
     args = p.parse_args(argv)
 
+    if args.device:
+        if len(args.trace) != 1:
+            p.error("--device takes exactly one bench artifact")
+        return _run_device(args.trace[0], args.json)
     if args.critical_path:
         if len(args.trace) != 1:
             p.error("--critical-path takes exactly one bench artifact")

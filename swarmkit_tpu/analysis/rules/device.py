@@ -28,8 +28,19 @@ without being rebound from the call's result.  (The companion hazard —
 a host read of a resident array *inside* the program — is the np./
 .item() class above and already fires.)
 
+The device-telemetry ledger (obs/devicetelemetry.py, ISSUE 18) adds the
+UNACCOUNTED TRANSFER shape in the host drivers: every H2D staged with
+``jax.device_put`` and every ``.block_until_ready()`` fetch sync in a
+host function of these modules must flow through the device ledger — a
+transfer the ledger never sees is a byte stream the bench regression
+gates cannot gate on.  A host function touching those seams passes only
+when its body also carries an accounting call (``note_h2d`` /
+``note_d2h`` / ``note_bytes_avoided``, or any dotted call through
+``devicetelemetry``).
+
 Other host-side driver code in the same modules (``TPUPlanner``, the
-``ShardedPlanFn`` padding wrapper) is untouched: syncs are its job.
+``ShardedPlanFn`` padding wrapper) is untouched: syncs are its job —
+but transfers must be counted.
 """
 
 from __future__ import annotations
@@ -42,6 +53,26 @@ from ..core import Checker, Finding, ImportMap, ModuleInfo, register
 SCOPE_PREFIXES = ("swarmkit_tpu/ops/", "swarmkit_tpu/parallel/")
 
 _SYNC_ATTRS = {"item", "block_until_ready"}
+
+#: a host fn carrying any of these calls is "accounted": the transfer
+#: seams it touches report into the device ledger
+_ACCOUNT_ATTRS = {"note_h2d", "note_d2h", "note_bytes_avoided"}
+
+
+def _is_accounted(fn: ast.FunctionDef) -> bool:
+    """True when the function body carries a device-ledger accounting
+    call — an ``_ACCOUNT_ATTRS`` attr call (works for the conventional
+    ``_devtel`` alias) or any dotted call through ``devicetelemetry``."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _ACCOUNT_ATTRS:
+            return True
+        d = _dotted(node.func)
+        if d and "devicetelemetry" in d:
+            return True
+    return False
 
 
 def _is_jit_decorator(dec: ast.AST, imports: ImportMap) -> bool:
@@ -167,6 +198,60 @@ class DevicePathPurity(Checker):
         if donating:
             for fn in fns.values():
                 out.extend(self._check_donation_reuse(mod, fn, donating))
+
+        # ---- transfer accounting in the HOST drivers: device_put /
+        # block_until_ready outside the telemetry-wrapped seams is a
+        # byte stream the device ledger (and every regression gate
+        # keyed on it) never sees
+        for name, fn in fns.items():
+            if name in device:
+                continue   # device fns: the sync shapes above own these
+            out.extend(self._check_unaccounted_transfer(
+                mod, fn, imports))
+        return out
+
+    def _check_unaccounted_transfer(self, mod: ModuleInfo,
+                                    fn: ast.FunctionDef,
+                                    imports: ImportMap) -> List[Finding]:
+        """One host function: collect its ``jax.device_put`` calls
+        (direct or via a local ``put = jax.device_put`` alias) and its
+        ``.block_until_ready()`` syncs; all pass when the body carries
+        an accounting call, all fire when it does not."""
+        aliases: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and imports.resolve(node.value) == "jax.device_put":
+                aliases.update(t.id for t in node.targets
+                               if isinstance(t, ast.Name))
+        puts: List[ast.Call] = []
+        syncs: List[ast.Call] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if imports.resolve(node.func) == "jax.device_put" \
+                    or (isinstance(node.func, ast.Name)
+                        and node.func.id in aliases):
+                puts.append(node)
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "block_until_ready":
+                syncs.append(node)
+        if not (puts or syncs) or _is_accounted(fn):
+            return []
+        out: List[Finding] = []
+        for node in puts:
+            out.append(mod.finding(
+                self.name, node,
+                f"unaccounted transfer: jax.device_put in host fn "
+                f"{fn.name} with no device-ledger accounting — note "
+                "the staged bytes (obs.devicetelemetry.note_h2d) or "
+                "route through an accounted seam"))
+        for node in syncs:
+            out.append(mod.finding(
+                self.name, node,
+                f"unaccounted transfer: .block_until_ready() in host "
+                f"fn {fn.name} with no device-ledger accounting — "
+                "note the fetch (obs.devicetelemetry.note_d2h) or "
+                "fetch via ops/kernel.py fetch_plan"))
         return out
 
     def _check_donation_reuse(self, mod: ModuleInfo,

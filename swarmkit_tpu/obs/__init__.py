@@ -14,14 +14,15 @@ and the derived planes on top.
 """
 
 from . import debugpages  # noqa: F401  (installs /debug/* endpoint hook)
+from . import devicetelemetry  # noqa: F401  (device-plane ledger)
 from . import planes  # noqa: F401  (per-plane saturation signals)
 from .flightrec import FlightRecorder, flightrec
 from .health import Check, HealthEvaluator
 from .journey import JourneyLedger, journeys
 from .lifecycle import LifecycleTracker
 from .report import (
-    diff_phase_tables, format_diff, format_table, phase_table,
-    validate_chrome_trace,
+    device_table, diff_phase_tables, format_device_table, format_diff,
+    format_table, phase_table, validate_chrome_trace,
 )
 from .sampler import Sampler
 from .trace import Span, Tracer, tracer
@@ -29,7 +30,7 @@ from .trace import Span, Tracer, tracer
 __all__ = [
     "Check", "FlightRecorder", "HealthEvaluator", "JourneyLedger",
     "LifecycleTracker", "Sampler", "Span", "Tracer",
-    "diff_phase_tables", "flightrec", "format_diff", "format_table",
-    "journeys", "phase_table", "planes", "tracer",
-    "validate_chrome_trace",
+    "device_table", "devicetelemetry", "diff_phase_tables", "flightrec",
+    "format_device_table", "format_diff", "format_table", "journeys",
+    "phase_table", "planes", "tracer", "validate_chrome_trace",
 ]

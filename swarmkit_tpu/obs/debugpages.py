@@ -62,6 +62,20 @@ def _h_planes(server, query) -> Tuple[bytes, int, str]:
     return body, 200, "application/json"
 
 
+def _h_device(server, query) -> Tuple[bytes, int, str]:
+    """Device-plane telemetry ledger: kernel rows, per-reason transfer
+    bytes, the compile-cache ledger, memory watermarks, and the
+    donation balance.  Renders on a fresh manager (all tables empty)
+    and on a deposed ex-leader (module-level state always exists) — the
+    _h_planes discipline."""
+    from .devicetelemetry import snapshot
+    from .planes import DEVICE, report_all
+    doc = {"device_telemetry": snapshot(),
+           "device_plane": report_all().get(DEVICE, {})}
+    body = json.dumps(doc, sort_keys=True, indent=1).encode()
+    return body, 200, "application/json"
+
+
 def _install(server: "httpdebug.DebugServer") -> None:
     server.register("/debug/trace",
                     lambda query: _h_trace(server, query),
@@ -81,6 +95,11 @@ def _install(server: "httpdebug.DebugServer") -> None:
                     "per-plane saturation report (occupancy, queue "
                     "depth, oldest-item age, drops/defers) + journey "
                     "ledger summary")
+    server.register("/debug/device",
+                    lambda query: _h_device(server, query),
+                    "device-plane telemetry: kernel ledger, per-reason "
+                    "transfer bytes, compile-cache ledger, memory "
+                    "watermarks, donation balance")
 
 
 httpdebug.register_default_endpoints(_install)

@@ -269,6 +269,13 @@ class FlightRecorder:
             from ..utils.metrics import registry
             doc["counters"] = dict(sorted(
                 registry.counters_snapshot().items()))
+            # device-telemetry + compile-cache snapshot at dump time: a
+            # post-mortem must distinguish a recompile storm from a
+            # transfer storm without a second capture.  Omitted from
+            # deterministic (sim) captures with the registry counters —
+            # its ns fields are wall-clock-tainted.
+            from . import devicetelemetry as _devtel
+            doc["device_telemetry"] = _devtel.snapshot()
         # full journeys of invariant-implicated tasks: a violation note
         # naming a sampled task id gets that task's complete milestone
         # ledger in the post-mortem, so "task X stuck" arrives WITH

@@ -236,6 +236,17 @@ class JourneyLedger:
             p: {"seconds": round(s, 9),
                 "frac": round(s / grand, 6) if grand > 0 else 0.0}
             for p, s in sorted(by_plane.items())}
+        # device sub-attribution for the ``planned`` milestone: the
+        # scheduler plane's edge gains a NESTED breakdown (dispatch vs
+        # d2h vs compile from the device-telemetry ledger) — nested,
+        # not a sibling plane row, so per-plane fracs still sum to ~1.0
+        # (the trace_report --critical-path invariant).
+        sched_row = planes.get("scheduler")
+        if sched_row is not None:
+            from . import devicetelemetry as _devtel
+            sub = _devtel.journey_sub_attribution(sched_row["seconds"])
+            if sub:
+                sched_row["device_sub"] = sub
         return {"tasks": len(complete), "cohort": len(cohort),
                 "p": quantile, "total_s": round(grand, 9),
                 "planes": planes}

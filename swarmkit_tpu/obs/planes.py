@@ -160,13 +160,25 @@ class PlaneStats:
 
     def report(self) -> Dict[str, float]:
         with self._mu:
-            return {
+            out = {
                 "occupancy": round(self.last_occupancy, 6),
                 "queue_depth": self._depth,
                 "oldest_age_s": round(self._oldest_age, 6),
                 "drops": self._drops,
                 "defers": self._defers,
             }
+        if self.name == DEVICE:
+            # sub-plane rows from the device-telemetry ledger: where the
+            # plane's busy time went (dispatch vs d2h vs compile) and
+            # what it moved.  Lazy import, device plane only — the
+            # ledger imports nothing above utils, so no cycle; an empty
+            # ledger contributes nothing (fresh-manager rendering stays
+            # byte-identical to PR 17).
+            from . import devicetelemetry as _devtel
+            sub = _devtel.sub_plane_rows()
+            if sub:
+                out["sub"] = sub
+        return out
 
 
 # ------------------------------------------------------------- module state

@@ -684,8 +684,16 @@ def fetch_plan(arrays):
     """Stage 2: one blocking D2H round-trip for a dispatched plan's
     outputs.  Fetch everything in one call — transfer latency dominates
     over tunneled links, so never fetch twice.  Works for single-device
-    and mesh-sharded (shard_map) outputs alike."""
-    return jax.device_get(arrays)
+    and mesh-sharded (shard_map) outputs alike.
+
+    This is THE accounted D2H seam: every fetched byte lands in the
+    device-telemetry transfer ledger (host-side nbytes of the numpy
+    results — no device introspection, so accounting cannot change
+    placements)."""
+    out = jax.device_get(arrays)
+    from ..obs import devicetelemetry as _devtel
+    _devtel.note_d2h("fetch", _devtel.tree_nbytes(out))
+    return out
 
 
 @jax.jit

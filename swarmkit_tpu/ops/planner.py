@@ -43,6 +43,7 @@ from ..scheduler import strategy as strategy_mod
 from ..scheduler.filters import normalize_arch, _references_volume_plugin
 from ..scheduler.nodeinfo import NodeInfo
 from ..models.types import TaskState, TaskStatus
+from ..obs import devicetelemetry as _devtel
 from ..obs import planes as _planes
 from ..obs.trace import tracer
 from ..utils.metrics import registry as _metrics
@@ -90,17 +91,27 @@ def _bucket_label(nodes_in, group_in, L: int, hier) -> str:
 
 
 def _observe_compile(fn, bucket: str, cache_before: Optional[int],
-                     dt: float) -> None:
+                     dt: float) -> float:
     """Count an XLA cache miss when the jit cache grew across one call:
     a ``swarm_planner_compiles{bucket=...}`` counter tick, a compile
     timer observation, and a retroactive ``plan.compile`` span — the
-    explanation trail for ``shape_cost_x``/bench variance swings."""
+    explanation trail for ``shape_cost_x``/bench variance swings.
+
+    Doubles as THE compile-cache ledger feed: every dispatch lands in
+    the per-signature hit/miss registry (obs/devicetelemetry.py), so
+    "compiles 0 in the timed window" is auditable per-bucket.  Returns
+    the retro-measured compile seconds (0.0 on a hit) for the caller's
+    kernel-ledger row."""
     after = _jit_cache_size(fn)
-    if cache_before is None or after is None or after <= cache_before:
-        return
+    if cache_before is None or after is None:
+        return 0.0
+    if after <= cache_before:
+        _devtel.note_cache_hit(bucket)
+        return 0.0
     _metrics.counter(f'swarm_planner_compiles{{bucket="{bucket}"}}',
                      after - cache_before)
     _COMPILE_TIMER.observe(dt)
+    _devtel.note_compile(bucket, dt, after - cache_before)
     # under a virtual clock (the simulator) the wall-clock compile
     # duration would be the ONLY nondeterministic bytes in an otherwise
     # seed-pure span trace: keep the event, zero the duration
@@ -108,6 +119,7 @@ def _observe_compile(fn, bucket: str, cache_before: Optional[int],
     tracer.record_complete("plan.compile", "plan",
                            0.0 if time_source_installed() else dt,
                            bucket=bucket)
+    return dt
 
 
 # shape-bucket helpers live in ops/fusedbatch.py (single source for the
@@ -271,10 +283,10 @@ class _InFlightPlan:
     needs to finish the group once the device triple lands."""
 
     __slots__ = ("sched", "t", "task_group", "decisions", "built",
-                 "plan_t0", "arrays")
+                 "plan_t0", "arrays", "bucket", "route")
 
     def __init__(self, sched, t, task_group, decisions, built, plan_t0,
-                 arrays):
+                 arrays, bucket="", route="group"):
         self.sched = sched
         self.t = t
         self.task_group = task_group
@@ -282,6 +294,10 @@ class _InFlightPlan:
         self.built = built
         self.plan_t0 = plan_t0
         self.arrays = arrays
+        # kernel-ledger attribution for the fetch stage (the dispatch
+        # stage noted its half under the same key)
+        self.bucket = bucket
+        self.route = route
 
 
 class TPUPlanner:
@@ -419,14 +435,22 @@ class TPUPlanner:
     def _call_plan_fn(self, nodes_in, group_in, L, hier):
         """Every device-plan dispatch goes through here so XLA cache
         misses are *observed* per static shape bucket (jit cache-size
-        delta around the call), not inferred from timing swings."""
+        delta around the call), not inferred from timing swings.  The
+        dispatch also lands in the device kernel ledger with its input
+        columns' H2D bytes (host-side nbytes — the implicit
+        numpy->device transfer at the jit boundary)."""
         import time as _time
         bucket = _bucket_label(nodes_in, group_in, L, hier)
+        _devtel.note_h2d("group_inputs",
+                         _devtel.tree_nbytes((nodes_in, group_in, hier)))
         before = _jit_cache_size(self._plan_fn)
         t0 = _time.perf_counter()
         out = self._plan_fn(nodes_in, group_in, L, hier)
-        _observe_compile(self._plan_fn, bucket, before,
-                         _time.perf_counter() - t0)
+        dt = _time.perf_counter() - t0
+        comp = _observe_compile(self._plan_fn, bucket, before, dt)
+        _devtel.note_kernel(bucket, "group", dispatch_s=dt,
+                            compile_s=comp, task_rows=int(group_in.k),
+                            node_rows=nodes_in.valid.shape[0])
         return out
 
     def _call_strategy_fn(self, nodes_in, group_in, sin, sinfo):
@@ -436,11 +460,17 @@ class TPUPlanner:
         import time as _time
         bucket = (_bucket_label(nodes_in, group_in, 1, ())
                   + f"_st{sinfo.sid}")
+        _devtel.note_h2d("group_inputs",
+                         _devtel.tree_nbytes((nodes_in, group_in, sin)))
         before = _jit_cache_size(plan_strategy_jit)
         t0 = _time.perf_counter()
         out = plan_strategy_jit(nodes_in, group_in, sin, sinfo.sid)
-        _observe_compile(plan_strategy_jit, bucket, before,
-                         _time.perf_counter() - t0)
+        dt = _time.perf_counter() - t0
+        comp = _observe_compile(plan_strategy_jit, bucket, before, dt)
+        _devtel.note_kernel(bucket, "strategy", dispatch_s=dt,
+                            compile_s=comp, task_rows=int(group_in.k),
+                            node_rows=nodes_in.valid.shape[0],
+                            strategy_id=sinfo.sid)
         return out
 
     def _build_strategy_inputs(self, built, t, sinfo) -> StrategyInputs:
@@ -671,8 +701,11 @@ class TPUPlanner:
         try:
             _jax.device_get(self._call_plan_fn(nodes_in, group_in, 1, ()))
             t0 = _time.perf_counter()
-            _jax.device_get(self._call_plan_fn(nodes_in, group_in, 1, ()))
+            probe_out = _jax.device_get(
+                self._call_plan_fn(nodes_in, group_in, 1, ()))
             self._launch_overhead = _time.perf_counter() - t0
+            _devtel.note_d2h("probe",
+                             2 * _devtel.tree_nbytes(probe_out))
             # only successful measurements are shared: caching a failed
             # probe (0.0) would poison every future planner's break-even
             cls._launch_overhead_shared = self._launch_overhead
@@ -834,8 +867,12 @@ class TPUPlanner:
             return None
         if flat:
             strategy_mod.count_group(sinfo.name, "device")
+        bucket = _bucket_label(nodes_in, group_in, L, hier)
+        if flat:
+            bucket += f"_st{sinfo.sid}"
         handle = _InFlightPlan(sched, t, task_group, decisions, built,
-                               _plan_t0, arrays)
+                               _plan_t0, arrays, bucket=bucket,
+                               route="strategy" if flat else "group")
         self._inflight.append(handle)
         return handle
 
@@ -1181,12 +1218,20 @@ class TPUPlanner:
                 _feas_bucket = "feas_" + _bucket_label(nodes_in, group_in,
                                                        1, ())
                 _cache_before = _jit_cache_size(feasibility_jit)
+                _devtel.note_h2d("group_inputs",
+                                 _devtel.tree_nbytes((nodes_in, group_in)))
                 _feas_t0 = _time.perf_counter()
-                mask, cap, _ = _jax.device_get(
+                _fetched = _jax.device_get(
                     feasibility_jit(nodes_in, group_in))
-                _observe_compile(feasibility_jit, _feas_bucket,
-                                 _cache_before,
-                                 _time.perf_counter() - _feas_t0)
+                _feas_dt = _time.perf_counter() - _feas_t0
+                mask, cap, _ = _fetched
+                _devtel.note_d2h("feasibility",
+                                 _devtel.tree_nbytes(_fetched))
+                _comp = _observe_compile(feasibility_jit, _feas_bucket,
+                                         _cache_before, _feas_dt)
+                _devtel.note_kernel(_feas_bucket, "feasibility",
+                                    dispatch_s=_feas_dt, compile_s=_comp,
+                                    task_rows=len(tasks), node_rows=nb)
         except Exception:
             log.exception("device feasibility failed; host validates")
             self._count("groups_device_error")
@@ -1278,8 +1323,13 @@ class TPUPlanner:
         handle.arrays = None
         # the d2h wait IS the device plane's busy window: the host is
         # stalled on the accelerator, which is what saturation means here
-        _planes.plane(_planes.DEVICE).note_busy(
-            _time.perf_counter() - _d2h_t0)
+        _d2h_dt = _time.perf_counter() - _d2h_t0
+        _planes.plane(_planes.DEVICE).note_busy(_d2h_dt)
+        if handle.bucket:
+            # the fetch half of this plan's kernel-ledger row (bytes
+            # were counted inside the fetch_plan seam)
+            _devtel.note_kernel(handle.bucket, handle.route,
+                                d2h_s=_d2h_dt)
         self.breaker.record_success()
         self._note_inflight(_time.perf_counter() - _plan_t0)
         if bool(spill):
@@ -1366,8 +1416,11 @@ class TPUPlanner:
             with tracer.span("plan.preempt", "plan", picks=n_picks):
                 picks, bucket, fn = _preempt.plan_victims(
                     cand, cpu_d, mem_d, gen_d, n_picks, budget)
-            _observe_compile(fn, bucket, before,
-                             _time.perf_counter() - t0)
+            dt = _time.perf_counter() - t0
+            comp = _observe_compile(fn, bucket, before, dt)
+            _devtel.note_kernel(bucket, "preempt", dispatch_s=dt,
+                                compile_s=comp, task_rows=n_picks,
+                                node_rows=int(cand.ok.shape[0]))
         except Exception:
             log.exception("device victim selection failed; host oracle")
             self._count("preempt_device_error")
@@ -1404,13 +1457,19 @@ class TPUPlanner:
         if self.breaker.allow_device():
             try:
                 before = _jit_cache_size(gang_fit_jit)
+                _devtel.note_h2d("gang_inputs",
+                                 _devtel.tree_nbytes((nodes_in, group_in)))
                 t0 = _time.perf_counter()
                 with tracer.span("plan.gang_fit", "plan",
                                  k=int(group_in.k)):
                     fit, _fc = gang_fit_jit(nodes_in, group_in)
                     fit = bool(fit)
-                _observe_compile(gang_fit_jit, bucket, before,
-                                 _time.perf_counter() - t0)
+                dt = _time.perf_counter() - t0
+                comp = _observe_compile(gang_fit_jit, bucket, before, dt)
+                _devtel.note_kernel(bucket, "gang", dispatch_s=dt,
+                                    compile_s=comp,
+                                    task_rows=int(group_in.k),
+                                    node_rows=nodes_in.valid.shape[0])
             except Exception:
                 log.exception("device gang_fit failed; host oracle")
                 self._count("gang_device_error")
@@ -1462,14 +1521,20 @@ class TPUPlanner:
                     np.stack([getattr(r[2], f) for r in rows])
                     for f in GroupInputs._fields])
                 before = _jit_cache_size(gang_fit_fused_jit)
+                _devtel.note_h2d("gang_inputs", _devtel.tree_nbytes(
+                    (stacked_nodes, stacked_groups)))
                 t0 = _time.perf_counter()
                 with tracer.span("plan.gang_fit_fused", "plan",
                                  gangs=len(rows)):
                     fits, _fcs = gang_fit_fused_jit(stacked_nodes,
                                                     stacked_groups)
                     fits = [bool(f) for f in fits]
-                _observe_compile(gang_fit_fused_jit, label + "_gfF",
-                                 before, _time.perf_counter() - t0)
+                dt = _time.perf_counter() - t0
+                comp = _observe_compile(gang_fit_fused_jit,
+                                        label + "_gfF", before, dt)
+                _devtel.note_kernel(label + "_gfF", "gang_fused",
+                                    dispatch_s=dt, compile_s=comp,
+                                    groups=len(rows))
             except Exception:
                 log.exception("fused gang_fit failed; host oracle")
                 self._count("gang_device_error")
@@ -1570,12 +1635,23 @@ class TPUPlanner:
         if dev is not None:
             d_valid, d_ready, d_cpu, d_mem, d_total = dev
             self._count("streaming_device_carries")
+            # the resident carry spares this run the five node-state
+            # column uploads; only the small per-run extras transfer
+            _devtel.note_bytes_avoided(_devtel.tree_nbytes(
+                (shared.valid, shared.ready, carry.total, carry.cpu,
+                 carry.mem)))
+            _devtel.note_h2d("cold_build", _devtel.tree_nbytes(
+                (shared.os_hash, shared.arch_hash, shared.svc0,
+                 carry.svc_acc)))
             return (FusedShared(valid=d_valid, ready=d_ready,
                                 os_hash=jnp.asarray(shared.os_hash),
                                 arch_hash=jnp.asarray(shared.arch_hash),
                                 svc0=jnp.asarray(shared.svc0)),
                     FusedCarry(total=d_total, cpu=d_cpu, mem=d_mem,
                                svc_acc=jnp.asarray(carry.svc_acc)))
+        _devtel.note_h2d("cold_build",
+                         _devtel.tree_nbytes((tuple(shared),
+                                              tuple(carry))))
         return (FusedShared(*(jnp.asarray(a) for a in shared)),
                 FusedCarry(*(jnp.asarray(a) for a in carry)))
 
@@ -1602,6 +1678,8 @@ class TPUPlanner:
             bucket = run.bucket_label(c)
             probe = self._fused_jit_probe()
             before = _jit_cache_size(probe)
+            _devtel.note_h2d("fused_inputs",
+                             _devtel.tree_nbytes(c.groups))
             c.t0 = _time.perf_counter()
             try:
                 with tracer.span("plan.dispatch", "plan", tasks=c.tasks,
@@ -1620,8 +1698,11 @@ class TPUPlanner:
                 self._fused_dead = True
                 run.dispatch_dead = True
                 return
-            _observe_compile(probe, bucket, before,
-                             _time.perf_counter() - c.t0)
+            dt = _time.perf_counter() - c.t0
+            comp = _observe_compile(probe, bucket, before, dt)
+            _devtel.note_kernel(bucket, "fused", dispatch_s=dt,
+                                compile_s=comp, groups=c.count,
+                                task_rows=c.tasks)
             c.arrays = (xs, fcs, spills)
             c.groups = None   # release the np staging buffers
             run.carry = carry   # device-resident; never fetched
@@ -1655,6 +1736,8 @@ class TPUPlanner:
         self.breaker.record_success()
         end = _time.perf_counter()
         _planes.plane(_planes.DEVICE).note_busy(end - _d2h_t0)
+        _devtel.note_kernel(run.bucket_label(c), "fused",
+                            d2h_s=end - _d2h_t0)
         # chunk windows overlap (two dispatches in flight): charge
         # plan_seconds only the wall time this chunk ADDED beyond the
         # previous fetch, or summed plan_s would exceed the tick wall

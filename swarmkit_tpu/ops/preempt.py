@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import devicetelemetry as _devtel
 from ..scheduler.preempt import CandidateSet
 from . import fusedbatch
 
@@ -169,11 +170,15 @@ def plan_victims(cand: CandidateSet, cpu_d: int, mem_d: int, gen_d: int,
     vgen = np.zeros((V, nb), np.int64)
     vgen[:, :n] = cand.vgen
     label = f"preempt_nb{nb}_v{V}_p{pb}"
+    _devtel.note_h2d("preempt_inputs", _devtel.tree_nbytes(
+        (ok, free_cpu, free_mem, free_gen, vvalid, vprio, vcpu, vmem,
+         vgen)))
     with fusedbatch.x64():
         nodes, ms = jax.device_get(select_victims_jit(
             ok, free_cpu, free_mem, free_gen, vvalid, vprio, vcpu, vmem,
             vgen, np.int64(cpu_d), np.int64(mem_d), np.int64(gen_d),
             np.int32(n_picks), np.int32(budget), pb))
+    _devtel.note_d2h("preempt", _devtel.tree_nbytes((nodes, ms)))
     picks: List[Tuple[int, int]] = []
     for j, m in zip(nodes.tolist(), ms.tolist()):
         if j < 0:

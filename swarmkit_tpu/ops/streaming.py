@@ -56,6 +56,7 @@ import jax
 import numpy as np
 
 from ..models.types import NodeAvailability, NodeState
+from ..obs import devicetelemetry as _devtel
 from ..utils.metrics import registry as _metrics
 from . import fusedbatch
 from .fusedbatch import SENTINEL, n_bucket, split_hash
@@ -155,7 +156,7 @@ class ResidentState:
         self.stats = {"colds": 0, "resyncs": 0, "fallbacks": 0,
                       "incremental": 0, "full": 0, "rows": 0,
                       "dirty_frac": 0.0, "device_syncs": 0,
-                      "svc_evictions": 0}
+                      "svc_evictions": 0, "bytes_avoided": 0}
 
     # ------------------------------------------------------------- refresh
 
@@ -408,6 +409,9 @@ class ResidentState:
         self._pending_dev_rows = {}
         for i, info in enumerate(infos):
             self._recompute_row(i, info)
+        _devtel.set_watermark("host_mirror", _devtel.tree_nbytes(
+            (self.valid, self.ready, self.cpu, self.mem, self.total,
+             self.os_hash, self.arch_hash)))
 
     # -------------------------------------------------- cached precursors
 
@@ -508,7 +512,7 @@ class ResidentState:
 
     # --------------------------------------------------------- device tier
 
-    def _device_upload(self) -> None:
+    def _device_upload(self, reason: str = "cold_build") -> None:
         """Fresh device placement of the five node-state columns (full
         rebuild, or a delta too wide for the scatter buckets).  Covers
         every row, so the host-only backlog is consumed by definition."""
@@ -527,6 +531,12 @@ class ResidentState:
             self.dev = None
             _metrics.counter("swarm_streaming_device_disabled")
             return
+        # host nbytes == device nbytes here (jnp.asarray copies the
+        # host columns wholesale under the x64 guard)
+        _devtel.note_h2d(reason, _devtel.tree_nbytes(
+            (self.valid, self.ready, self.cpu, self.mem, self.total)))
+        _devtel.set_watermark("device_resident",
+                              _devtel.tree_nbytes(self.dev))
         self.stats["device_syncs"] += 1
         self._dev_version = self._tracker.version \
             if self._tracker is not None else -1
@@ -553,7 +563,7 @@ class ResidentState:
             return
         db = _d_bucket(len(rows))
         if db is None:
-            self._device_upload()
+            self._device_upload(reason="wide_reupload")
             return
         idx = np.full(db, self.nb, np.int32)   # pad = out of bounds, drops
         idx[:len(rows)] = rows
@@ -572,6 +582,21 @@ class ResidentState:
         import time as _time
         bucket = f"stream_nb{self.nb}_d{db}"
         before = _jit_cache_size(_scatter_rows_jit)
+        staged = _devtel.tree_nbytes(
+            (idx, u_valid, u_ready, u_cpu, u_mem, u_total))
+        _devtel.note_h2d("dirty_scatter", staged)
+        # what a non-streaming tick would have shipped instead: the
+        # full five-column upload, minus what the scatter staged
+        full = _devtel.tree_nbytes(
+            (self.valid, self.ready, self.cpu, self.mem, self.total))
+        avoided = max(0, full - staged)
+        _devtel.note_bytes_avoided(avoided)
+        self.stats["bytes_avoided"] += avoided
+        # the resident buffers are DONATED to the scatter program: the
+        # old array objects are dead after this call, and the donation
+        # balance catches anyone who kept a reference and reads them
+        old_ids = [id(a) for a in self.dev]
+        _devtel.note_donated(old_ids)
         t0 = _time.perf_counter()
         try:
             with warnings.catch_warnings():
@@ -584,11 +609,17 @@ class ResidentState:
                         u_total)
         except Exception:
             log.exception("resident device scatter failed; re-uploading")
+            _devtel.note_retired(old_ids)   # buffers gone either way
             self.dev = None
             self._device_upload()
             return
-        _observe_compile(_scatter_rows_jit, bucket, before,
-                         _time.perf_counter() - t0)
+        dt = _time.perf_counter() - t0
+        _devtel.note_retired(old_ids)
+        comp = _observe_compile(_scatter_rows_jit, bucket, before, dt)
+        _devtel.note_kernel(bucket, "scatter", dispatch_s=dt,
+                            compile_s=comp, node_rows=len(rows))
+        _devtel.set_watermark("device_resident",
+                              _devtel.tree_nbytes(self.dev))
         self.stats["device_syncs"] += 1
         self._dev_version = self._tracker.version \
             if self._tracker is not None else -1
@@ -603,6 +634,10 @@ class ResidentState:
         if self._tracker.version != self._dev_version \
                 or self._tracker.pending:
             return None
+        # donation-balance runtime check: a consumer is about to read
+        # these arrays — if any was donated to a scatter and never
+        # rebound, that read would be use-after-donation
+        _devtel.check_live([id(a) for a in self.dev])
         return self.dev
 
     # --------------------------------------------------------------- bench
@@ -619,4 +654,5 @@ class ResidentState:
             "full_ticks": self.stats["full"],
             "rows": self.stats["rows"],
             "device_syncs": self.stats["device_syncs"],
+            "bytes_avoided": self.stats["bytes_avoided"],
         }

@@ -213,10 +213,13 @@ class ShardedPlanFn:
     # ------------------------------------------------------- fused batch
 
     def _shard(self, value, specs):
+        from ..obs import devicetelemetry as _devtel
         put = jax.device_put
+        staged = [np.asarray(a) for a in value]
+        _devtel.note_h2d("mesh_reshard", _devtel.tree_nbytes(staged))
         return type(value)(*(
-            put(np.asarray(a), NamedSharding(self.mesh, spec))
-            for a, spec in zip(value, specs)))
+            put(a, NamedSharding(self.mesh, spec))
+            for a, spec in zip(staged, specs)))
 
     def prepare_fused(self, shared: FusedShared, carry: FusedCarry):
         """Place a fused run's node state on the mesh once, so every

@@ -87,6 +87,20 @@ def drive_streaming(cpu, mem, idx, vals):
     return cpu2, mem2, stale + total
 
 
+def stage_gang_inputs(batch):
+    # host driver: H2D staging the device ledger never sees — the
+    # bench transfer gates cannot gate on invisible bytes
+    staged = [np.asarray(b) for b in batch]
+    return [jax.device_put(s) for s in staged]
+
+
+def drain_results(handles):
+    # host driver: fetch syncs with no device-ledger accounting
+    for h in handles:
+        h.block_until_ready()               # unaccounted fetch sync
+    return [np.asarray(h) for h in handles]
+
+
 @functools.partial(jax.jit, static_argnames=("strategy",))
 def plan_strategy(caps, scores, weights, strategy):
     # pluggable scoring stage (ISSUE 15): the strategy kernel is device

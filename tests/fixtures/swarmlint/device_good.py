@@ -9,6 +9,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from swarmkit_tpu.obs import devicetelemetry
+
 LOAD_CLAMP = 1 << 20
 
 
@@ -63,9 +65,21 @@ def plan_fused_sharded(x):
 
 
 def dispatch_chunks(run, chunks):
-    # host driver: np staging + device placement happen OUTSIDE jit
+    # host driver: np staging + device placement happen OUTSIDE jit,
+    # and the staged bytes report into the device ledger
     staged = [np.asarray(c) for c in chunks]
+    devicetelemetry.note_h2d("fused_inputs",
+                             sum(int(s.nbytes) for s in staged))
     return [jax.device_put(s) for s in staged]
+
+
+def fetch_ready(handles):
+    # host driver: the sync is accounted before the fetch returns
+    for h in handles:
+        h.block_until_ready()
+    devicetelemetry.note_d2h("fetch",
+                             sum(int(h.nbytes) for h in handles))
+    return [np.asarray(h) for h in handles]
 
 
 @functools.partial(jax.jit, static_argnames=("picks",))

@@ -1,0 +1,408 @@
+"""Device-telemetry ledger tests (ISSUE 18).
+
+The contract under test, in order of importance:
+
+* **byte-identity** — enabling the ledger must not move a single
+  placement byte: telemetry-on vs telemetry-off runs of the same
+  workload produce identical decisions, store snapshots and watch-event
+  streams on every dispatch route (fused, per-group, streaming);
+* **determinism** — the snapshot document is PYTHONHASHSEED-independent
+  (sorted keys, crc32 shape hashes): two subprocesses with different
+  seeds serialize byte-identical ledgers;
+* **bounded cardinality** — a pathological workload minting unbounded
+  bucket names costs O(cap) rows with counted overflow, and unknown
+  transfer reasons lump into "other" instead of minting labels;
+* **donation balance** — a read of a still-donated buffer is a counted,
+  returned violation (the runtime twin of the swarmlint rule), and the
+  check never raises;
+* **render-on-empty** — ``/debug/device`` serves a fresh process with
+  empty tables (the _h_planes discipline);
+* **flightrec embedding** — live dumps carry the device ledger +
+  compile-cache snapshot; deterministic (sim) captures stay seed-pure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from swarmkit_tpu.models import (
+    Annotations, Node, NodeDescription, NodeSpec, NodeState, NodeStatus,
+    Placement, PlacementPreference, ReplicatedService, Resources,
+    ResourceRequirements, Service, ServiceMode, ServiceSpec, SpreadOver,
+    Task, TaskSpec, TaskState, TaskStatus, Version,
+)
+from swarmkit_tpu.models import types as model_types
+from swarmkit_tpu.obs import devicetelemetry
+from swarmkit_tpu.ops import TPUPlanner
+from swarmkit_tpu.scheduler import Scheduler
+from swarmkit_tpu.state import MemoryStore
+from swarmkit_tpu.state.events import (
+    Event, EventCommit, EventSnapshotRestore, EventTaskBlock,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def frozen_clock():
+    model_types.set_time_source(lambda: 1_700_000_000.0)
+    try:
+        yield
+    finally:
+        model_types.set_time_source(None)
+
+
+@pytest.fixture(autouse=True)
+def fresh_ledger():
+    """Every test gets its own ledger; the process-wide one (and its
+    enabled flag) is restored afterwards — the save/restore lifecycle
+    every obs singleton shares."""
+    prev = devicetelemetry.save_state()
+    devicetelemetry.reset()
+    devicetelemetry.set_enabled(True)
+    try:
+        yield
+    finally:
+        devicetelemetry.restore_state(prev)
+
+
+# ------------------------------------------------------------ workload
+
+_RES = ResourceRequirements(
+    reservations=Resources(nano_cpus=10 ** 8, memory_bytes=64 << 20))
+
+
+def _mk_node(i, cpus=8 * 10 ** 9, mem=32 << 30):
+    return Node(
+        id=f"n{i:04d}",
+        spec=NodeSpec(annotations=Annotations(
+            name=f"node-{i:04d}",
+            labels={"rack": f"r{i % 3}",
+                    "tier": "web" if i % 2 else "db"})),
+        status=NodeStatus(state=NodeState.READY),
+        description=NodeDescription(
+            hostname=f"node-{i:04d}",
+            resources=Resources(nano_cpus=cpus, memory_bytes=mem)))
+
+
+def _mk_service(sid, n_tasks, spec):
+    svc = Service(
+        id=sid,
+        spec=ServiceSpec(annotations=Annotations(name=f"svc-{sid}"),
+                         mode=ServiceMode.REPLICATED,
+                         replicated=ReplicatedService(replicas=n_tasks),
+                         task=spec),
+        spec_version=Version(index=1))
+    tasks = [Task(id=f"{sid}-t{k:04d}", service_id=sid, slot=k + 1,
+                  desired_state=TaskState.RUNNING, spec=spec,
+                  spec_version=Version(index=1),
+                  status=TaskStatus(state=TaskState.PENDING,
+                                    timestamp=model_types.now()))
+             for k in range(n_tasks)]
+    return svc, tasks
+
+
+def _build_store(n_nodes=24):
+    store = MemoryStore()
+    store.update(lambda tx: [tx.create(_mk_node(i))
+                             for i in range(n_nodes)])
+    specs = {
+        "sva": TaskSpec(resources=_RES),
+        "svb": TaskSpec(resources=_RES,
+                        placement=Placement(
+                            constraints=["node.labels.tier==web"])),
+        "svc": TaskSpec(resources=_RES,
+                        placement=Placement(preferences=[
+                            PlacementPreference(spread=SpreadOver(
+                                spread_descriptor="node.labels.rack"))])),
+    }
+    seeded = {"sva": 20, "svb": 12, "svc": 9}
+
+    def mk(tx):
+        for sid, spec in specs.items():
+            svc, tasks = _mk_service(sid, seeded[sid], spec)
+            tx.create(svc)
+            for t in tasks:
+                tx.create(t)
+    store.update(mk)
+    return store, specs, dict(seeded)
+
+
+def _event_key(ev):
+    if isinstance(ev, EventTaskBlock):
+        return ("block", tuple(o.id for o in ev.olds),
+                tuple(ev.node_ids), ev.base_version, ev.state, ev.message)
+    if isinstance(ev, EventCommit):
+        return ("commit", ev.version)
+    if isinstance(ev, Event):
+        obj = ev.obj
+        return (ev.action, obj.id, getattr(obj, "node_id", None),
+                int(obj.status.state) if hasattr(obj, "status") else None,
+                obj.meta.version.index)
+    return ("other", repr(ev))
+
+
+def _pump(sched, sub):
+    while True:
+        ev = sub.poll()
+        if ev is None:
+            return
+        if isinstance(ev, EventSnapshotRestore):
+            sched._resync()
+        elif isinstance(ev, Event):
+            sched._handle_event(ev)
+
+
+def _run_route(route: str, enabled: bool):
+    """Cold tick + one incremental tick (arrivals + failures) through
+    the scheduler's real event feed, on one dispatch route."""
+    devicetelemetry.reset()
+    devicetelemetry.set_enabled(enabled)
+    store, specs, seqs = _build_store()
+    planner = TPUPlanner()
+    planner.enable_small_group_routing = False
+    planner.fused_enabled = route != "group"
+    planner.streaming_enabled = route == "streaming"
+    sched = Scheduler(store, batch_planner=planner, pipeline_depth=1)
+    _, sub = store.view_and_watch(
+        lambda tx: sched._setup_tasks_list(tx), accepts_blocks=True)
+    obs = store.queue.subscribe(accepts_blocks=True)
+
+    decisions = sched.tick()                      # cold tick
+    spec = specs["sva"]
+    base = seqs["sva"]
+
+    def add(tx):
+        for k in range(5):
+            tx.create(Task(
+                id=f"sva-t{base + k:04d}", service_id="sva",
+                slot=base + k + 1, desired_state=TaskState.RUNNING,
+                spec=spec, spec_version=Version(index=1),
+                status=TaskStatus(state=TaskState.PENDING,
+                                  timestamp=model_types.now())))
+    store.update(add)
+    victims = sorted(
+        (t for t in store.view(lambda tx: tx.find(Task))
+         if t.service_id == "svb" and t.node_id),
+        key=lambda t: t.id)[:2]
+
+    def fail(tx):
+        for v in victims:
+            cur = tx.get(Task, v.id).copy()
+            cur.status = TaskStatus(state=TaskState.FAILED,
+                                    timestamp=model_types.now(),
+                                    message="churn exit")
+            tx.update(cur)
+    store.update(fail)
+    _pump(sched, sub)
+    decisions += sched.tick()                     # incremental tick
+
+    events = [_event_key(e) for e in obs.drain()]
+    store.queue.unsubscribe(obs)
+    store.queue.unsubscribe(sub)
+    tasks = store.view(lambda tx: tx.find(Task))
+    state = sorted((t.id, t.node_id, int(t.status.state),
+                    t.status.message, t.meta.version.index)
+                   for t in tasks)
+    return decisions, state, events, store.save_bytes(), planner
+
+
+# --------------------------------------------------------- byte identity
+
+@pytest.mark.parametrize("route", ["fused", "group", "streaming"])
+def test_placements_byte_identical_telemetry_on_off(frozen_clock, route):
+    """The ledger observes; it must never steer.  Placements, store
+    snapshot bytes and the watch-event stream are identical with the
+    ledger on and off, per dispatch route."""
+    d_on, s_on, e_on, b_on, p_on = _run_route(route, True)
+    snap = devicetelemetry.snapshot()
+    d_off, s_off, e_off, b_off, _p = _run_route(route, False)
+    off_snap = devicetelemetry.snapshot()
+
+    assert (d_on, s_on, e_on) == (d_off, s_off, e_off)
+    assert b_on == b_off
+
+    # the on-run actually recorded the route (non-vacuous differential)
+    routes = {k.split("|", 1)[1] for k in snap["kernel"]}
+    if route == "fused":
+        assert p_on.stats.get("groups_fused", 0) > 0
+        assert "fused" in routes, snap["kernel"]
+        assert "h2d" in snap["transfers"] \
+            and "cold_build" in snap["transfers"]["h2d"]
+    elif route == "group":
+        assert p_on.stats.get("groups_planned", 0) > 0
+        assert routes & {"group", "strategy"}, snap["kernel"]
+    else:
+        st = p_on.streaming_snapshot()
+        assert st["enabled"] and st["incremental_ticks"] >= 1, st
+        h2d = snap["transfers"]["h2d"]
+        assert "cold_build" in h2d, h2d
+        assert {"dirty_scatter", "wide_reupload"} & set(h2d), h2d
+        assert "device_resident" in snap["memory"]
+
+    # ...and the off-run recorded nothing at all
+    assert off_snap["kernel"] == {}
+    assert off_snap["transfers"] == {"d2h": {}, "h2d": {}}
+    assert off_snap["compile_cache"] == {}
+
+
+# ----------------------------------------------------------- determinism
+
+_DET_SCRIPT = """\
+import json, sys
+sys.path.insert(0, sys.argv[1])
+from swarmkit_tpu.obs import devicetelemetry as dt
+dt.reset(); dt.set_enabled(True)
+for i in [3, 1, 4, 1, 5, 9, 2, 6]:
+    dt.note_kernel("nb1024_g%d" % i, "group", dispatch_s=0.001 * i,
+                   task_rows=10 * i, node_rows=100)
+    dt.note_compile("nb1024_g%d" % i, 0.01 * i)
+    dt.note_cache_hit("nb1024_g%d" % i)
+for r in ["fused_inputs", "cold_build", "group_inputs", "bogus"]:
+    dt.note_h2d(r, 1000)
+dt.note_d2h("fetch", 512)
+dt.note_d2h("weird", 7)
+dt.set_watermark("device_resident", 4096)
+dt.note_donated([11, 22, 33])
+dt.note_retired([22])
+dt.check_live([11, 44])
+print(json.dumps(dt.snapshot(), sort_keys=True))
+"""
+
+
+def test_ledger_serialization_hashseed_independent():
+    """Two subprocesses with different PYTHONHASHSEED values produce
+    byte-identical snapshot JSON (sorted keys + crc32 shape hashes —
+    no id()/hash() ordering anywhere in the document)."""
+    outs = []
+    for seed in ("0", "1"):
+        env = dict(os.environ, PYTHONHASHSEED=seed)
+        proc = subprocess.run(
+            [sys.executable, "-c", _DET_SCRIPT, REPO],
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        outs.append(proc.stdout)
+    assert outs[0] == outs[1]
+    doc = json.loads(outs[0])
+    assert doc["transfers"]["h2d"]["other"]["bytes"] == 1000
+    assert doc["transfers"]["d2h"]["other"]["count"] == 1
+    assert doc["donation"]["violations"] == 1
+
+
+# ----------------------------------------------------- bounded cardinality
+
+def test_bounded_cardinality_under_pathological_buckets(monkeypatch):
+    """1000 distinct bucket names cost O(cap) ledger rows; the excess
+    is aggregated under the overflow row and counted, never dropped.
+    The live metrics registry is isolated here so the pathological
+    bucket names can't leak series into the process-wide exposition
+    (which other tests bound)."""
+    from swarmkit_tpu.utils.metrics import Registry
+    sandbox = Registry()
+    monkeypatch.setattr(devicetelemetry, "_metrics", sandbox)
+    for i in range(1000):
+        devicetelemetry.note_kernel(f"bucket{i:04d}", "group")
+        devicetelemetry.note_compile(f"bucket{i:04d}", 0.001)
+    for i in range(50):
+        devicetelemetry.note_h2d(f"reason{i}", 10)
+    snap = devicetelemetry.snapshot()
+
+    # exported label combos are capped independently of ledger rows:
+    # MAX_METRIC_SERIES distinct (bucket, route) pairs + the overflow
+    # series; dispatch counts are conserved across them
+    series = sandbox.counters_snapshot("swarm_device_kernel_dispatches")
+    assert len(series) <= devicetelemetry.MAX_METRIC_SERIES + 1
+    assert any('bucket="__overflow__"' in k for k in series)
+    assert sum(series.values()) == 1000
+
+    # +1: the "__overflow__|group" aggregation row itself
+    assert len(snap["kernel"]) <= devicetelemetry.MAX_KERNEL_ROWS + 1
+    assert snap["kernel_overflow"] == 1000 - devicetelemetry.MAX_KERNEL_ROWS
+    assert sum(r["dispatches"] for r in snap["kernel"].values()) == 1000
+    assert "__overflow__|group" in snap["kernel"]
+
+    assert len(snap["compile_cache"]) <= devicetelemetry.MAX_CACHE_ROWS
+    assert snap["compile_cache_overflow"] \
+        == 1000 - devicetelemetry.MAX_CACHE_ROWS
+
+    # unknown reasons lump into "other" — reason labels stay a fixed set
+    assert set(snap["transfers"]["h2d"]) == {"other"}
+    assert snap["transfers"]["h2d"]["other"]["count"] == 50
+
+    devicetelemetry.note_donated(range(2 * devicetelemetry.MAX_DONATED_IDS))
+    assert devicetelemetry.snapshot()["donation"]["outstanding"] \
+        <= devicetelemetry.MAX_DONATED_IDS
+
+
+# ------------------------------------------------------- donation balance
+
+def test_donation_balance_detects_read_after_donation():
+    """note_donated → check_live on the same id is a counted, returned
+    violation; a retired id is clean; the check never raises."""
+    a, b = object(), object()
+    devicetelemetry.note_donated([id(a), id(b)])
+    devicetelemetry.note_retired([id(b)])
+    bad = devicetelemetry.check_live([id(a), id(b)])
+    assert bad == [id(a)]
+    don = devicetelemetry.snapshot()["donation"]
+    assert don == {"donated": 2, "retired": 1,
+                   "outstanding": 1, "violations": 1}
+    assert devicetelemetry.check_live([id(b)]) == []
+    # retiring an id that was never donated is a no-op, not an error
+    devicetelemetry.note_retired([id(a) + 12345])
+    assert devicetelemetry.snapshot()["donation"]["retired"] == 1
+
+
+# ------------------------------------------------------- render-on-empty
+
+def test_debug_device_page_renders_on_empty():
+    """/debug/device on a fresh process: 200, valid JSON, empty tables
+    — never a 500 because nothing has dispatched yet."""
+    from swarmkit_tpu.obs import debugpages
+    body, status, ctype = debugpages._h_device(None, {})
+    assert status == 200 and ctype == "application/json"
+    doc = json.loads(body)
+    assert doc["device_telemetry"]["kernel"] == {}
+    assert doc["device_telemetry"]["donation"]["donated"] == 0
+    assert "device_plane" in doc
+    # the plane sub-rows contract: empty dict before any device work
+    assert devicetelemetry.sub_plane_rows() == {}
+    assert devicetelemetry.journey_sub_attribution(1.0) is None
+
+
+# --------------------------------------------------- flightrec embedding
+
+def test_flightrec_dump_embeds_device_ledger(tmp_path):
+    """A live flight-recorder dump carries the device ledger and the
+    per-signature compile cache (read back from disk); deterministic
+    captures omit it (wall-clock-tainted ns fields stay out of
+    seed-pure sim dumps)."""
+    from swarmkit_tpu.obs.flightrec import flightrec
+    state = flightrec.save_state()
+    flightrec.reset(deterministic=False)
+    try:
+        devicetelemetry.note_kernel("nb1024", "fused", dispatch_s=0.002,
+                                    groups=4, task_rows=200)
+        devicetelemetry.note_compile("nb1024", 0.5)
+        devicetelemetry.note_h2d("cold_build", 4096)
+        path = str(tmp_path / "dump.json")
+        digest = flightrec.dump(path)
+        assert len(digest) == 64          # dump() returns the sha256
+        with open(path) as f:
+            doc = json.load(f)
+        led = doc["device_telemetry"]
+        assert led["kernel"]["nb1024|fused"]["dispatches"] == 1
+        assert led["kernel"]["nb1024|fused"]["groups"] == 4
+        cc = led["compile_cache"]["nb1024"]
+        assert cc["compiles"] == 1 and cc["compile_ns"] == 500_000_000
+        assert cc["shape_hash"] == __import__("zlib").crc32(b"nb1024")
+        assert led["transfers"]["h2d"]["cold_build"]["bytes"] == 4096
+
+        flightrec.reset(deterministic=True)
+        assert "device_telemetry" not in flightrec.snapshot()
+    finally:
+        flightrec.restore_state(state)
