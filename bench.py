@@ -96,7 +96,7 @@ def _mesh_devices() -> int:
 def _mesh_crossover():
     """The mesh crossover artifact (scripts/mesh_crossover.py), trimmed
     to the headline fields, or None when it has not been measured."""
-    path = os.environ.get("BENCH_MESH_CROSSOVER", "MULTICHIP_r06.json")
+    path = os.environ.get("BENCH_MESH_CROSSOVER", "MULTICHIP_r07.json")
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -106,8 +106,13 @@ def _mesh_crossover():
         "winner_by_shape": doc.get("winner_by_shape"),
         "placements_equal_across_mesh":
             doc.get("placements_equal_across_mesh"),
+        "strategy_host_fallbacks": doc.get("strategy_host_fallbacks"),
+        "skipped": doc.get("skipped"),
         "curves": {nb: s.get("curve")
                    for nb, s in (doc.get("shapes") or {}).items()},
+        "decisions_per_sec": {
+            nb: s.get("decisions_per_sec")
+            for nb, s in (doc.get("shapes") or {}).items()},
     }
 
 
@@ -1354,6 +1359,19 @@ def run_steady_state_churn(planner_factory):
         "h2d_bytes_per_tick": round(
             sum(r["bytes"] for r in xfer_s.get("h2d", {}).values())
             / float(WINDOWS), 1),
+        # the resident-tier slice of that ledger: dirty-row scatters
+        # (single-device and sharded) plus wide re-uploads.  Under a
+        # planner mesh this is what the mesh-resident-transfer gate
+        # pins at ~0 — churn must ride per-shard donated scatters,
+        # not re-uploads
+        "planner_mesh": _mesh_devices(),
+        "resident_h2d_bytes_per_tick": round(
+            sum(r["bytes"] for name, r in xfer_s.get("h2d", {}).items()
+                if name in ("dirty_scatter", "shard_scatter",
+                            "wide_reupload")) / float(WINDOWS), 1),
+        "strategy_host_groups": int(
+            planner_s.stats.get("groups_strategy_host", 0)
+            + planner_f.stats.get("groups_strategy_host", 0)),
         "fallback_groups": routed["groups_fallback"],
         "path": "device+streaming",
         "shape_cost_x": 1.0,

@@ -46,6 +46,7 @@ def _norm(doc):
     strategy = {}
     gangs = {}
     h2d_per_tick = {}
+    mesh_resident = {}
     for name, cfg in (doc.get("configs") or {}).items():
         dps = cfg.get("decisions_per_sec")
         if dps:
@@ -66,6 +67,13 @@ def _norm(doc):
             p99[name] = float(cfg["pending_assigned_p99_s"])
         if cfg.get("h2d_bytes_per_tick") is not None:
             h2d_per_tick[name] = float(cfg["h2d_bytes_per_tick"])
+        if cfg.get("planner_mesh") is not None:
+            mesh_resident[name] = {
+                "planner_mesh": cfg.get("planner_mesh"),
+                "resident_h2d_bytes_per_tick": cfg.get(
+                    "resident_h2d_bytes_per_tick"),
+                "strategy_host_groups": cfg.get("strategy_host_groups"),
+            }
         if cfg.get("stranded_frac_spread") is not None:
             strategy[name] = {
                 "stranded_frac_spread": cfg.get("stranded_frac_spread"),
@@ -114,6 +122,11 @@ def _norm(doc):
         # totals, and the compile-cache repeat misses inside the
         # obs-overhead window (a previously-seen signature recompiling)
         "h2d_bytes_per_tick": h2d_per_tick,
+        # mesh-resident evidence per config (ISSUE 19): the planner
+        # mesh size the run measured under, the resident-tier slice of
+        # its H2D ledger, and the host-routed strategy-group count the
+        # mesh gate pins at zero
+        "mesh_resident": mesh_resident,
         "device_transfer_bytes": {
             d: sum(r["bytes"] for r in tbl.values())
             for d, tbl in (doc.get("device_telemetry") or {})
@@ -406,6 +419,41 @@ def main(argv=None) -> int:
                 ("device-transfer-regression",
                  f"{_STREAM_CFG} h2d_bytes_per_tick "
                  f"{xb_old}->{xb_new}"))
+        # mesh-resident-transfer gate (ISSUE 19), NEW run alone: cfg10
+        # measured under a planner mesh (SWARM_PLANNER_MESH > 1) must
+        # keep the resident tier device-side — its per-tick
+        # resident-column H2D stays within the dirty-row scatter
+        # budget (a full column re-upload at these node counts is
+        # orders of magnitude above the bar) — and must route every
+        # strategy group through the sharded kernels (zero host-oracle
+        # groups).  Single-device runs carry the fields but skip the
+        # gate: the bar is the MESH contract.
+        _MESH_H2D_BAR = float(os.environ.get(
+            "BENCH_MESH_H2D_BAR", 65536.0))
+        mr = new.get("mesh_resident", {}).get(_STREAM_CFG) or {}
+        if (mr.get("planner_mesh") or 1) > 1:
+            rb = mr.get("resident_h2d_bytes_per_tick")
+            shg = mr.get("strategy_host_groups")
+            print(f"mesh_resident[{_STREAM_CFG}]: "
+                  f"mesh={mr.get('planner_mesh')} "
+                  f"resident_h2d_bytes_per_tick={rb} "
+                  f"strategy_host_groups={shg} "
+                  f"(bar <= {_MESH_H2D_BAR:g})")
+            if rb is None or rb > _MESH_H2D_BAR:
+                print(f"\n{_STREAM_CFG} under a planner mesh moved "
+                      f"{rb} resident H2D bytes/tick — the resident "
+                      "tier is re-shipping columns instead of "
+                      "scattering dirty rows", file=sys.stderr)
+                gate_failures.append(
+                    ("mesh-resident-transfer",
+                     f"{_STREAM_CFG} resident_h2d_bytes_per_tick={rb}"))
+            if shg:
+                print(f"\n{_STREAM_CFG} under a planner mesh routed "
+                      f"{shg} strategy group(s) to the host oracle",
+                      file=sys.stderr)
+                gate_failures.append(
+                    ("mesh-resident-transfer",
+                     f"{_STREAM_CFG} strategy_host_groups={shg}"))
     # strategy-seam gates (ISSUE 15), judged on the NEW run's cfg11:
     # (a) binpack must actually beat spread on the stranded-capacity
     # fraction — the whole point of shipping the policy; (b) zero

@@ -8,12 +8,22 @@ subprocess so XLA_FLAGS / device count / jit caches cannot leak between
 points.  The carry round-trips device-resident exactly as the planner
 drives it (``ShardedPlanFn.prepare_fused`` NamedShardings for meshes).
 
-Output: one JSON artifact (default MULTICHIP_r06.json) with the
-seconds-per-chunk curve, the winning N, and per-point parity checks
-(every mesh must produce byte-identical placements to the 1-device
-program).  ``bench.py`` embeds the artifact under ``mesh_crossover``
-when the file is present, which is how the curve reaches the bench
-ledger.
+Output: one JSON artifact (default MULTICHIP_r07.json) with the
+seconds-per-chunk / decisions-per-second curve, the winning N, and
+per-point parity checks (every mesh must produce byte-identical
+placements to the 1-device program — for the plain chunk AND for a
+strategy-mixed chunk cycling spread/binpack/weighted/learned group
+strategy ids).  Each point also records the device-ledger H2D bytes
+moved during the timed window (~0 once the carry is resident) and the
+host-route strategy-group counter delta (must stay 0: no sharded
+strategy kernel may fall back to the numpy oracle).  ``bench.py``
+embeds the artifact under ``mesh_crossover`` when the file is
+present, which is how the curve reaches the bench ledger.
+
+The whole --devices list is validated up front against every node
+bucket (n >= 1, bucket divisible by n); infeasible points are recorded
+under ``skipped`` with a reason instead of dying mid-sweep, and a
+child that cannot raise enough devices reports a skip the same way.
 
 Children default to JAX_PLATFORMS=cpu with forced host-platform
 devices (slices of the same cores — safe on containers where the TPU
@@ -24,13 +34,15 @@ silicon curve.  Export ``JAX_PLATFORMS=tpu`` (or any non-cpu backend)
 to map the true multi-chip curve — no force flag is injected then.
 On forced host devices no silicon is added, and repeat sweeps on a
 shared host swing per-point medians ±10-30% — within that noise the
-measured curve is flat at both buckets (N=2 tends to edge ahead,
-larger N never decisively pays): the ~120 per-scan-step [L]-psums
-cost about what the smaller per-device working set saves when XLA
-executes the shard programs across host cores, i.e. the break-even
-floor the cost model predicts for devices sharing one memory system.
-The cost model lives in docs/architecture.md ("Fused many-service
-planning & mesh sharding").
+measured curve is flat at the 16k/64k buckets (the ~120 per-scan-step
+[L]-psums cost about what the smaller per-device working set saves
+when XLA executes the shard programs across host cores).  At the
+131072-node bucket the per-shard columns drop back into cache and the
+mesh crosses over for real: N=4 beats N=1 on decisions/sec even with
+zero added silicon — the break-even floor the cost model predicts for
+devices sharing one memory system, and the regime the sharded
+resident tier exists for.  The cost model lives in
+docs/architecture.md ("Fused many-service planning & mesh sharding").
 
 Usage:
     python scripts/mesh_crossover.py                 # full curve
@@ -47,7 +59,7 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DEFAULT_OUT = os.path.join(REPO, "MULTICHIP_r06.json")
+DEFAULT_OUT = os.path.join(REPO, "MULTICHIP_r07.json")
 
 
 def _child(n_devices: int, nb: int, groups: int, k: int,
@@ -62,14 +74,21 @@ def _child(n_devices: int, nb: int, groups: int, k: int,
     from swarmkit_tpu.obs import devicetelemetry as _devtel
     from swarmkit_tpu.ops import fusedbatch
     from swarmkit_tpu.ops.kernel import (
-        FusedCarry, FusedGroups, FusedShared, fetch_plan, plan_fused_jit,
+        FusedCarry, FusedGroups, FusedShared, FusedStrategy, fetch_plan,
+        plan_fused_jit,
     )
     from swarmkit_tpu.ops.planner import _jit_cache_size
+    from swarmkit_tpu.scheduler import strategy as strategy_mod
+    from swarmkit_tpu.utils.metrics import registry
+
+    def _host_routed_groups() -> int:
+        return sum(v for key, v in registry.counters_snapshot(
+            "swarm_strategy_groups").items() if 'route="host"' in key)
 
     devices = jax.devices()
     if len(devices) < n_devices:
-        print(json.dumps({"error": f"need {n_devices} devices, "
-                                   f"have {len(devices)}"}))
+        print(json.dumps({"skipped": f"need {n_devices} devices, "
+                                     f"have {len(devices)}"}))
         return
 
     rng = np.random.RandomState(0)
@@ -99,6 +118,17 @@ def _child(n_devices: int, nb: int, groups: int, k: int,
         mem=np.full(nb, 256 << 30, np.int64),
         svc_acc=np.zeros((sb, nb), np.int32))
 
+    # strategy-mixed chunk: group strategy ids cycle spread / binpack /
+    # weighted / learned with fixed weighted terms and zero learned
+    # params — deterministic, so its placements digest must agree at
+    # every N (the ShardedPlanFn.fused route the planner takes)
+    f_dim = len(strategy_mod.MLP_FEATURES)
+    strat = FusedStrategy(
+        sid=(np.arange(gb, dtype=np.int32) % 4),
+        weights=np.tile(np.array([3, 1, 0, 0], np.int32), (gb, 1)),
+        w1=np.zeros((f_dim, 1), np.int32), b1=np.zeros(1, np.int32),
+        w2=np.zeros(1, np.int32), b2=np.zeros((), np.int32))
+
     with fusedbatch.x64():
         if n_devices == 1:
             import jax.numpy as jnp
@@ -110,8 +140,9 @@ def _child(n_devices: int, nb: int, groups: int, k: int,
             ca = FusedCarry(*(jnp.asarray(a) for a in carry))
             probe = plan_fused_jit
 
-            def run(ca):
-                xs, fcs, spills, ca = plan_fused_jit(sh, g, ca, 1)
+            def run(ca, strat=None):
+                xs, fcs, spills, ca = plan_fused_jit(sh, g, ca, 1,
+                                                     strat)
                 return fetch_plan((xs, fcs, spills)), ca
         else:
             from swarmkit_tpu.parallel.sharded import (
@@ -122,9 +153,8 @@ def _child(n_devices: int, nb: int, groups: int, k: int,
             sh, ca = fn.prepare_fused(shared, carry)
             probe = plan_fused_sharded
 
-            def run(ca):
-                xs, fcs, spills, ca = plan_fused_sharded(
-                    sh, g, ca, 1, fn.mesh)
+            def run(ca, strat=None):
+                xs, fcs, spills, ca = fn.fused(sh, g, ca, 1, strat)
                 return fetch_plan((xs, fcs, spills)), ca
 
         (x0, _, _), _ = run(ca)            # compile + parity sample
@@ -136,29 +166,61 @@ def _child(n_devices: int, nb: int, groups: int, k: int,
             _, _ = run(ca)                 # fresh carry each repeat
             times.append(time.perf_counter() - t0)
         tt1 = _devtel.transfer_totals()
+        timed_compiles = (_jit_cache_size(probe) or 0) - warm_compiles
 
+        # untimed strategy-mixed dispatch: digest parity across N plus
+        # proof no strategy group fell back to the numpy host oracle
+        host_before = _host_routed_groups()
+        (xs_s, _, _), _ = run(ca, strat)
+        strat_fallbacks = _host_routed_groups() - host_before
+
+    def _digest(x):
+        return hashlib.sha256(np.ascontiguousarray(
+            np.asarray(x).astype(np.int64)).tobytes()).hexdigest()
+
+    med = statistics.median(times)
     print(json.dumps({
         "n_devices": n_devices,
-        "chunk_seconds": round(statistics.median(times), 6),
+        "chunk_seconds": round(med, 6),
         "chunk_seconds_min": round(min(times), 6),
-        "placements_digest": hashlib.sha256(
-            np.ascontiguousarray(
-                np.asarray(x0).astype(np.int64)).tobytes()).hexdigest(),
+        "decisions_per_sec": round(groups * k / med),
+        "placements_digest": _digest(x0),
+        "strategy_placements_digest": _digest(xs_s),
+        "strategy_host_fallbacks": strat_fallbacks,
         "placed": int(np.asarray(x0).sum()),
         # per-point device-ledger evidence: bytes moved during the
-        # timed repeats (steady-state D2H; H2D should be ~0 — the
+        # timed repeats (steady-state D2H; H2D must be ~0 — the
         # carry stays device-resident) and the jit signatures this
         # point compiled, with timed-window growth pinned at 0
         "transfer_bytes": {d: tt1[d] - tt0.get(d, 0) for d in tt1},
+        "resident_h2d_bytes_timed": tt1["h2d"] - tt0.get("h2d", 0),
         "compiles": warm_compiles,
-        "timed_window_compiles": (_jit_cache_size(probe) or 0)
-        - warm_compiles,
+        "timed_window_compiles": timed_compiles,
         "platform": devices[0].platform,
     }))
 
 
-def _measure_shape(nodes, groups, k, repeats, devices):
-    points = {}
+def _validate_devices(devices, nodes_list):
+    """Whole-sweep feasibility check BEFORE any child runs: every
+    requested N must be >= 1 and divide every node bucket (fused
+    shards are unpadded so idx tie-keys match the 1-device program).
+    Infeasible Ns land in the returned ``skipped`` map with a reason
+    and the sweep proceeds over the rest — never dies mid-sweep."""
+    valid, skipped = [], {}
+    for n in devices:
+        if n < 1:
+            skipped[str(n)] = "n_devices must be >= 1"
+        elif any(nb % n for nb in nodes_list):
+            bad = [nb for nb in nodes_list if nb % n]
+            skipped[str(n)] = (f"node buckets {bad} not divisible "
+                               f"by {n}")
+        else:
+            valid.append(n)
+    return valid, skipped
+
+
+def _measure_shape(nodes, groups, k, repeats, devices, skipped):
+    points = {n: {"skipped": reason} for n, reason in skipped.items()}
     for n in devices:
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
@@ -177,22 +239,33 @@ def _measure_shape(nodes, groups, k, repeats, devices):
              "--repeats", str(repeats)],
             cwd=REPO, env=env, capture_output=True, text=True)
         if proc.returncode != 0:
-            points[str(n)] = {"error": proc.stderr[-500:]}
+            points[str(n)] = {"skipped": "child process failed: "
+                              + proc.stderr[-500:]}
             continue
         points[str(n)] = json.loads(proc.stdout.strip().splitlines()[-1])
         print(f"nb={nodes} N={n}: {points[str(n)]}", file=sys.stderr)
 
     ok = {n: pt for n, pt in points.items() if "chunk_seconds" in pt}
     digests = {pt["placements_digest"] for pt in ok.values()}
+    strat_digests = {pt["strategy_placements_digest"]
+                     for pt in ok.values()}
     winner = min(ok, key=lambda n: ok[n]["chunk_seconds"]) if ok else None
     base = ok.get("1", {}).get("chunk_seconds")
     return {
         "shape": {"nodes": nodes, "groups_per_chunk": groups,
                   "tasks_per_group": k},
         "curve": {n: pt.get("chunk_seconds") for n, pt in points.items()},
+        "decisions_per_sec": {n: pt.get("decisions_per_sec")
+                              for n, pt in points.items()},
         "overhead_x": {n: round(pt["chunk_seconds"] / base, 3)
                        for n, pt in ok.items()} if base else {},
         "placements_equal_across_mesh": len(digests) <= 1,
+        "strategy_placements_equal_across_mesh": len(strat_digests) <= 1,
+        "strategy_host_fallbacks": sum(
+            pt.get("strategy_host_fallbacks", 0) for pt in ok.values()),
+        "max_timed_h2d_bytes": max(
+            (pt.get("resident_h2d_bytes_timed", 0)
+             for pt in ok.values()), default=0),
         "winner_devices": int(winner) if winner else None,
         "points": points,
     }
@@ -201,15 +274,17 @@ def _measure_shape(nodes, groups, k, repeats, devices):
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python scripts/mesh_crossover.py")
     p.add_argument("--nodes", type=int, nargs="*",
-                   default=[16384, 65536],
+                   default=[16384, 65536, 131072],
                    help="node buckets to sweep (default: 16384 = the "
-                        "cfg6/cfg7 10k-node shape AND 65536 = the "
-                        "50k-node target shape)")
+                        "cfg6/cfg7 10k-node shape, 65536 = the "
+                        "50k-node target shape, 131072 = the 100k+ "
+                        "regime where per-shard working sets drop "
+                        "back into cache and the mesh crosses over)")
     p.add_argument("--groups", type=int, default=4,
                    help="groups per fused chunk (default 4)")
     p.add_argument("--k", type=int, default=50_000,
                    help="tasks per group (default 50000)")
-    p.add_argument("--repeats", type=int, default=7)
+    p.add_argument("--repeats", type=int, default=9)
     p.add_argument("--devices", type=int, nargs="*",
                    default=[1, 2, 4, 8])
     p.add_argument("--out", default=DEFAULT_OUT)
@@ -221,10 +296,15 @@ def main(argv=None) -> int:
                args.repeats)
         return 0
 
+    valid_devices, skipped = _validate_devices(args.devices, args.nodes)
+    for n, reason in skipped.items():
+        print(f"skipping N={n}: {reason}", file=sys.stderr)
     shapes = {str(nb): _measure_shape(nb, args.groups, args.k,
-                                      args.repeats, args.devices)
+                                      args.repeats, valid_devices,
+                                      skipped)
               for nb in args.nodes}
     all_parity = all(s["placements_equal_across_mesh"]
+                     and s["strategy_placements_equal_across_mesh"]
                      for s in shapes.values())
     platforms = sorted({pt["platform"]
                         for s in shapes.values()
@@ -233,10 +313,13 @@ def main(argv=None) -> int:
     artifact = {
         "metric": "fused planner chunk seconds vs mesh size N",
         "devices_swept": args.devices,
+        "skipped": skipped,
         "shapes": shapes,
         "winner_by_shape": {nb: s["winner_devices"]
                             for nb, s in shapes.items()},
         "placements_equal_across_mesh": all_parity,
+        "strategy_host_fallbacks": sum(
+            s["strategy_host_fallbacks"] for s in shapes.values()),
         # honest provenance: True only when every point actually ran
         # on forced host-cpu devices — a silicon curve says so
         "platforms": platforms,
@@ -246,7 +329,7 @@ def main(argv=None) -> int:
         json.dump(artifact, f, indent=2, sort_keys=True)
         f.write("\n")
     print(json.dumps(artifact))
-    return 0 if all_parity and shapes else 1
+    return 0 if all_parity and shapes and valid_devices else 1
 
 
 if __name__ == "__main__":

@@ -38,6 +38,19 @@ when its body also carries an accounting call (``note_h2d`` /
 ``note_d2h`` / ``note_bytes_avoided``, or any dotted call through
 ``devicetelemetry``).
 
+The mesh-native resident tier (ISSUE 19) adds the CROSS-SHARD shapes
+in the host drivers: the sharded fused pipeline keeps its carry and
+resident columns laid out across the mesh between chunk dispatches, so
+
+* a **mid-chunk ``jax.device_get``** — a value fetched D2H and then
+  passed onward to a device dispatcher later in the same function —
+  round-trips the sharded carry through the host between chunks
+  (gather + re-lay-out across every shard) instead of fetching once
+  after the last dispatch;
+* a **re-``device_put`` of an already-resident array** — re-staging a
+  name that is itself bound from a prior ``jax.device_put`` — pays a
+  full cross-mesh re-lay-out for an array the devices already hold.
+
 Other host-side driver code in the same modules (``TPUPlanner``, the
 ``ShardedPlanFn`` padding wrapper) is untouched: syncs are its job —
 but transfers must be counted.
@@ -208,6 +221,77 @@ class DevicePathPurity(Checker):
                 continue   # device fns: the sync shapes above own these
             out.extend(self._check_unaccounted_transfer(
                 mod, fn, imports))
+
+        # ---- cross-shard discipline in the HOST drivers (ISSUE 19):
+        # mid-chunk D2H of a value still being dispatched, and re-puts
+        # of arrays a prior device_put already made resident
+        dispatchers = device | set(donating)
+        for name, fn in fns.items():
+            if name in device:
+                continue
+            out.extend(self._check_cross_shard(
+                mod, fn, imports, dispatchers))
+        return out
+
+    def _check_cross_shard(self, mod: ModuleInfo, fn: ast.FunctionDef,
+                           imports: ImportMap,
+                           dispatchers: Set[str]) -> List[Finding]:
+        """One host function: flag ``jax.device_get(x)`` where the same
+        dotted ``x`` is passed to a device dispatcher (a jitted or
+        donating callable of this module) on a LATER line — the sharded
+        carry is round-tripping through the host mid-chunk — and flag
+        ``jax.device_put`` of a name bound from a prior ``device_put``
+        — the array is already device-resident and the re-put re-lays
+        it out across the whole mesh."""
+        out: List[Finding] = []
+        dispatch_arg_lines: Dict[str, List[int]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in dispatchers:
+                for a in node.args:
+                    d = _dotted(a)
+                    if d:
+                        dispatch_arg_lines.setdefault(d, []).append(
+                            node.lineno)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and imports.resolve(node.func) == "jax.device_get" \
+                    and node.args:
+                d = _dotted(node.args[0])
+                if d and any(ln > node.lineno
+                             for ln in dispatch_arg_lines.get(d, ())):
+                    out.append(mod.finding(
+                        self.name, node,
+                        f"mid-chunk jax.device_get of {d!r} in host fn "
+                        f"{fn.name}: the value feeds a device dispatch "
+                        "below — keep the sharded carry device-resident "
+                        "between chunks and fetch once, after the last "
+                        "dispatch"))
+        resident: Dict[str, int] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and imports.resolve(node.value.func) \
+                    == "jax.device_put":
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        resident[tgt.id] = node.lineno
+        if resident:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and imports.resolve(node.func) \
+                        == "jax.device_put" \
+                        and node.args:
+                    d = _dotted(node.args[0])
+                    if d in resident and node.lineno > resident[d]:
+                        out.append(mod.finding(
+                            self.name, node,
+                            f"re-device_put of already-resident {d!r} "
+                            f"in host fn {fn.name}: staged at line "
+                            f"{resident[d]} — reuse the resident "
+                            "handle (a sharded column re-put re-lays "
+                            "out the whole mesh)"))
         return out
 
     def _check_unaccounted_transfer(self, mod: ModuleInfo,

@@ -50,6 +50,7 @@ from ..utils.metrics import registry as _metrics
 H2D_REASONS = (
     "cold_build",      # resident full upload / fused run node state
     "dirty_scatter",   # streaming donated scatter staging buffers
+    "shard_scatter",   # per-shard staged scatter buffers (mesh tier)
     "wide_reupload",   # delta wider than the scatter buckets
     "mesh_reshard",    # NamedSharding device_put over the mesh
     "group_inputs",    # per-group kernel input columns

@@ -47,7 +47,9 @@ from ..scheduler import constraint as constraint_mod
 from ..scheduler import strategy as strategy_mod
 from ..scheduler.filters import normalize_arch
 from .hashing import str_hash
-from .kernel import FusedCarry, FusedGroups, FusedShared, K_CLAMP
+from .kernel import (
+    FusedCarry, FusedGroups, FusedShared, FusedStrategy, K_CLAMP,
+)
 
 # static shape buckets to bound recompiles (shared with the per-group
 # planner — ops/planner.py imports these so both paths use one ladder)
@@ -210,6 +212,16 @@ def group_quota_blocked(sched, t: Task) -> bool:
     return ledger.group_blocked(t)
 
 
+def fused_strategies_ok(planner) -> bool:
+    """Whether the planner's fused entry can serve non-spread strategy
+    groups: the default kernel (plan_fused's in-scan strategy switch)
+    or an injected fn that declares ``supports_strategies``
+    (parallel.sharded.ShardedPlanFn).  Stubs without the flag keep the
+    pre-strategy contract: non-spread groups break the run."""
+    fn = getattr(planner, "_fused_fn", None)
+    return fn is None or bool(getattr(fn, "supports_strategies", False))
+
+
 def needs_plugins(t: Task) -> bool:
     from ..scheduler.filters import _references_volume_plugin
     c = t.spec.container
@@ -252,12 +264,14 @@ class GroupSpec:
 
     __slots__ = ("group", "t", "k", "constraints", "platforms",
                  "pref_descriptor", "wants_plugins", "cpu_d", "mem_d",
-                 "maxrep", "slot", "quota_blocked")
+                 "maxrep", "slot", "quota_blocked", "sid", "sname",
+                 "weights")
 
     def __init__(self, group: Dict[str, Task], t: Task, k: int,
                  constraints, platforms, pref_descriptor, wants_plugins,
                  cpu_d: int, mem_d: int, maxrep: int,
-                 quota_blocked: bool = False):
+                 quota_blocked: bool = False, sid: int = 0,
+                 sname: str = "", weights=None):
         self.group = group
         self.t = t
         self.k = k
@@ -272,6 +286,11 @@ class GroupSpec:
         # frozen tenant-quota admission verdict (group_quota_blocked):
         # True builds an all-False quota mask row for this group
         self.quota_blocked = quota_blocked
+        # strategy routing facts: sid 0 = spread; non-spread groups
+        # ride the fused in-scan strategy switch (FusedStrategy)
+        self.sid = sid
+        self.sname = sname
+        self.weights = weights   # i32[4] (weighted strategy) or None
 
 
 def probe_group(planner, sched,
@@ -285,17 +304,25 @@ def probe_group(planner, sched,
     if not planner._supported(t):
         return None
     sinfo = strategy_mod.resolve(strategy_mod.strategy_of(t))
-    if sinfo is None or sinfo.sid != strategy_mod.STRAT_SPREAD:
-        # non-spread strategies break the run: the fused scan's score
-        # stage is spread (one program shape for the whole run); they
-        # ride the per-group strategy kernel instead
+    if sinfo is None:
+        # unknown strategy name: the host path serves it through the
+        # spread tree and counts the strategy fallback
+        return None
+    flat = sinfo.sid != strategy_mod.STRAT_SPREAD
+    if flat and not fused_strategies_ok(planner):
+        # an injected fused fn without the strategy switch (test stubs,
+        # older mesh fns): non-spread groups break the run and ride the
+        # per-group strategy kernel instead
         return None
     k = len(group)
     if k == 0 or k > K_CLAMP:
         return None
     placement = t.spec.placement
-    prefs = [p for p in (placement.preferences if placement else [])
-             if p.spread]
+    # non-spread strategies own the scoring stage and ignore spread
+    # preferences entirely (the per-group route plans them flat too)
+    prefs = [] if flat else \
+        [p for p in (placement.preferences if placement else [])
+         if p.spread]
     if len(prefs) > 1:
         return None    # multi-level spread: per-group hier path
     res = t.spec.resources.reservations if t.spec.resources else None
@@ -326,7 +353,10 @@ def probe_group(planner, sched,
         int(res.nano_cpus) if res else 0,
         int(res.memory_bytes) if res else 0,
         placement.max_replicas if placement else 0,
-        quota_blocked=group_quota_blocked(sched, t))
+        quota_blocked=group_quota_blocked(sched, t),
+        sid=sinfo.sid, sname=sinfo.name,
+        weights=(strategy_mod.weights_of(t)
+                 if sinfo.uses_weights else None))
 
 
 # ------------------------------------------------------------ run builder
@@ -334,15 +364,16 @@ def probe_group(planner, sched,
 class FusedChunk:
     """One dispatch unit of a fused run."""
 
-    __slots__ = ("start", "count", "gb", "groups", "arrays", "tasks",
-                 "t0")
+    __slots__ = ("start", "count", "gb", "groups", "strat", "arrays",
+                 "tasks", "t0")
 
     def __init__(self, start: int, count: int, gb: int,
-                 groups: FusedGroups, tasks: int):
+                 groups: FusedGroups, tasks: int, strat=None):
         self.start = start
         self.count = count
         self.gb = gb
         self.groups = groups   # np-backed FusedGroups; dropped at dispatch
+        self.strat = strat     # np-backed FusedStrategy or None (spread)
         self.arrays = None     # dispatched (x, fail_counts, spill) triple
         self.tasks = tasks
         self.t0 = 0.0
@@ -354,11 +385,11 @@ class FusedRun:
 
     __slots__ = ("sched", "specs", "cols", "shared", "carry", "chunks",
                  "next_dispatch", "next_fetch", "last_fetch_end", "L",
-                 "nb", "cc", "pb", "sb", "has_quota", "aborted",
-                 "dispatch_dead", "applied")
+                 "nb", "cc", "pb", "sb", "has_quota", "has_strat",
+                 "aborted", "dispatch_dead", "applied")
 
     def __init__(self, sched, specs, cols, shared, carry, chunks,
-                 L, nb, cc, pb, sb, has_quota=False):
+                 L, nb, cc, pb, sb, has_quota=False, has_strat=False):
         self.sched = sched
         self.specs = specs
         self.cols = cols
@@ -374,6 +405,7 @@ class FusedRun:
         self.pb = pb
         self.sb = sb
         self.has_quota = has_quota
+        self.has_strat = has_strat
         self.aborted = False
         self.dispatch_dead = False
         self.applied = 0
@@ -385,8 +417,9 @@ class FusedRun:
     def bucket_label(self, chunk: FusedChunk) -> str:
         """Stable jit-signature name for one fused chunk shape."""
         q = "_q1" if self.has_quota else ""
+        m = "_mx1" if self.has_strat else ""
         return (f"fused_g{chunk.gb}_nb{self.nb}_cc{self.cc}"
-                f"_p{self.pb}_L{self.L}_s{self.sb}{q}")
+                f"_p{self.pb}_L{self.L}_s{self.sb}{q}{m}")
 
 
 def build_run(planner, sched, specs: List[GroupSpec]
@@ -474,6 +507,24 @@ def build_run(planner, sched, specs: List[GroupSpec]
     # with no blocked group ships quota_ok=None — the quota-free jit
     # signature, untouched.
     has_quota = any(sp.quota_blocked for sp in specs)
+    # Strategy-mixed runs carry per-group strategy ids + weighted terms
+    # and ONE run-wide learned-scorer parameter set (all groups share the
+    # deployed scorer).  Spread-only runs ship strat=None — the
+    # strategy-free jit signature, untouched.
+    has_strat = any(sp.sid for sp in specs)
+    if has_strat:
+        if any(sp.sid == strategy_mod.STRAT_LEARNED for sp in specs):
+            lw1, lb1, lw2, lb2 = strategy_mod.learned_params()
+            lw1 = np.asarray(lw1, np.int32)
+            lb1 = np.asarray(lb1, np.int32)
+            lw2 = np.asarray(lw2, np.int32)
+            lb2 = np.asarray(lb2, np.int32)
+        else:
+            f = len(strategy_mod.MLP_FEATURES)
+            lw1 = np.zeros((f, 1), np.int32)
+            lb1 = np.zeros(1, np.int32)
+            lw2 = np.zeros(1, np.int32)
+            lb2 = np.zeros((), np.int32)
     chunks: List[FusedChunk] = []
     start = 0
     for count in chunk_sizes(len(specs), default_chunk_groups()):
@@ -491,11 +542,17 @@ def build_run(planner, sched, specs: List[GroupSpec]
         leaf = np.zeros((gb, nb), np.int32)
         extra = np.ones((gb, nb), bool)
         quota = np.ones((gb, nb), bool) if has_quota else None
+        sid = np.zeros(gb, np.int32) if has_strat else None
+        weights = np.zeros((gb, 4), np.int32) if has_strat else None
         tasks = 0
         for j in range(count):
             sp = specs[start + j]
             if quota is not None and sp.quota_blocked:
                 quota[j] = False
+            if sid is not None:
+                sid[j] = sp.sid
+                if sp.weights is not None:
+                    weights[j] = sp.weights
             k[j] = sp.k
             slot[j] = sp.slot
             maxrep[j] = sp.maxrep
@@ -525,8 +582,12 @@ def build_run(planner, sched, specs: List[GroupSpec]
                         mem_d=mem_d, con_hash=con_hash, con_op=con_op,
                         con_exp=con_exp, plat=plat, failures=failures,
                         leaf=leaf, extra_mask=extra, quota_ok=quota),
-            tasks))
+            tasks,
+            strat=(FusedStrategy(sid=sid, weights=weights, w1=lw1,
+                                 b1=lb1, w2=lw2, b2=lb2)
+                   if has_strat else None)))
         start += count
 
     return FusedRun(sched, specs, cols, shared, carry, chunks,
-                    L, nb, cc, pb, sb, has_quota=has_quota)
+                    L, nb, cc, pb, sb, has_quota=has_quota,
+                    has_strat=has_strat)

@@ -612,9 +612,35 @@ class FusedCarry(NamedTuple):
     #                           within this fused run
 
 
+class FusedStrategy(NamedTuple):
+    """Per-group strategy columns for a mixed-strategy fused run.
+    ``sid``/``weights`` ride the scan xs next to FusedGroups; the
+    learned-scorer parameters are run-wide and stay outside the scan
+    (closed over).  Spread-only runs ship ``strat=None`` — the
+    pre-strategy jit signatures, untouched."""
+
+    sid: jnp.ndarray          # i32[G] strategy id (0 = spread)
+    weights: jnp.ndarray      # i32[G, 4] weighted terms per group
+    w1: jnp.ndarray           # i32[F, H] learned-scorer layer 1
+    b1: jnp.ndarray           # i32[H]
+    w2: jnp.ndarray           # i32[H]
+    b2: jnp.ndarray           # i32[] scalar
+
+
+def _fused_headroom(avail: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
+    """In-scan headroom column in demand units: the exact int64 floor
+    division planner._build_strategy_inputs applies host-side (callers
+    dispatch under enable_x64), so a fused strategy group scores the
+    same headrooms a per-group dispatch would densify after the
+    preceding groups applied."""
+    hr = jnp.clip(avail // jnp.maximum(d, 1), 0, HR_CLAMP)
+    return jnp.where(d > 0, hr, HR_CLAMP).astype(jnp.int32)
+
+
 def plan_fused(shared: FusedShared, groups: FusedGroups,
                carry: FusedCarry, L: int, reduce: Reduce = _identity,
-               idx_offset: Optional[jnp.ndarray] = None):
+               idx_offset: Optional[jnp.ndarray] = None,
+               strat: Optional[FusedStrategy] = None):
     """Plan a fused batch of task groups in one program.
 
     Returns (x i32[G, N] tasks per node per group, fail_counts
@@ -622,10 +648,21 @@ def plan_fused(shared: FusedShared, groups: FusedGroups,
     byte-identical to dispatching `plan_group` per group in order and
     applying each result before densifying the next — the scan carry
     IS that apply, restricted to the signals the kernel reads.
-    """
+
+    ``strat`` (mixed-strategy runs): per-group strategy ids select the
+    scoring stage in-scan via lax.switch over the four static-strategy
+    programs — binpack/weighted/learned groups fuse alongside spread
+    ones instead of breaking the run.  Headroom columns are computed
+    from the carry (the same int64 divisions the host densifier runs),
+    and hr_gen is the neutral HR_CLAMP because groups demanding
+    generic resources never fuse (probe_group rejects them)."""
     no_ports = jnp.zeros_like(shared.valid)
 
-    def step(state: FusedCarry, g):
+    def step(state: FusedCarry, xs):
+        if strat is None:
+            g = xs
+        else:
+            g, g_sid, g_weights = xs
         # exact int64 resource math, matching the host densifier:
         # res_ok &= avail >= demand and cap = min(cap, avail // demand)
         # for each demanded resource, then clip to [0, K_CLAMP] in i32
@@ -649,8 +686,32 @@ def plan_fused(shared: FusedShared, groups: FusedGroups,
             k=g.k, con_hash=g.con_hash, con_op=g.con_op,
             con_exp=g.con_exp, plat=g.plat, maxrep=g.maxrep,
             port_limited=jnp.zeros((), jnp.bool_))
-        x, fail_counts, spill = plan_group(nodes, grp, L, reduce=reduce,
-                                           idx_offset=idx_offset)
+        if strat is None:
+            x, fail_counts, spill = plan_group(
+                nodes, grp, L, reduce=reduce, idx_offset=idx_offset)
+        else:
+            sin = StrategyInputs(
+                hr_cpu=_fused_headroom(state.cpu, g.cpu_d),
+                hr_mem=_fused_headroom(state.mem, g.mem_d),
+                hr_gen=jnp.full(res_cap.shape, HR_CLAMP, jnp.int32),
+                weights=g_weights, w1=strat.w1, b1=strat.b1,
+                w2=strat.w2, b2=strat.b2)
+
+            def _spread():
+                return plan_group(nodes, grp, L, reduce=reduce,
+                                  idx_offset=idx_offset)
+
+            def _strategy(sid_static):
+                return plan_strategy(nodes, grp, sin, sid_static,
+                                     reduce=reduce,
+                                     idx_offset=idx_offset)
+
+            x, fail_counts, spill = jax.lax.switch(
+                jnp.clip(g_sid, 0, 3),
+                [_spread,
+                 lambda: _strategy(STRAT_BINPACK),
+                 lambda: _strategy(STRAT_WEIGHTED),
+                 lambda: _strategy(STRAT_LEARNED)])
         nxt = FusedCarry(
             total=state.total + x,
             cpu=state.cpu - x.astype(state.cpu.dtype) * g.cpu_d,
@@ -658,14 +719,17 @@ def plan_fused(shared: FusedShared, groups: FusedGroups,
             svc_acc=state.svc_acc.at[g.slot].add(x))
         return nxt, (x, fail_counts, spill)
 
-    carry_out, (xs, fcs, spills) = jax.lax.scan(step, carry, groups)
+    xs_in = groups if strat is None \
+        else (groups, strat.sid, strat.weights)
+    carry_out, (xs, fcs, spills) = jax.lax.scan(step, carry, xs_in)
     return xs, fcs, spills, carry_out
 
 
 @functools.partial(jax.jit, static_argnames=("L",))
 def plan_fused_jit(shared: FusedShared, groups: FusedGroups,
-                   carry: FusedCarry, L: int):
-    return plan_fused(shared, groups, carry, L)
+                   carry: FusedCarry, L: int,
+                   strat: Optional[FusedStrategy] = None):
+    return plan_fused(shared, groups, carry, L, strat=strat)
 
 
 # --------------------------------------------------------- pipeline stages

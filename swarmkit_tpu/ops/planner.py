@@ -462,11 +462,16 @@ class TPUPlanner:
                   + f"_st{sinfo.sid}")
         _devtel.note_h2d("group_inputs",
                          _devtel.tree_nbytes((nodes_in, group_in, sin)))
-        before = _jit_cache_size(plan_strategy_jit)
+        sfn = getattr(self._plan_fn, "strategy", None)
+        probe = self._strategy_jit_probe()
+        before = _jit_cache_size(probe)
         t0 = _time.perf_counter()
-        out = plan_strategy_jit(nodes_in, group_in, sin, sinfo.sid)
+        if sfn is not None:
+            out = sfn(nodes_in, group_in, sin, sinfo.sid)
+        else:
+            out = plan_strategy_jit(nodes_in, group_in, sin, sinfo.sid)
         dt = _time.perf_counter() - t0
-        comp = _observe_compile(plan_strategy_jit, bucket, before, dt)
+        comp = _observe_compile(probe, bucket, before, dt)
         _devtel.note_kernel(bucket, "strategy", dispatch_s=dt,
                             compile_s=comp, task_rows=int(group_in.k),
                             node_rows=nodes_in.valid.shape[0],
@@ -546,9 +551,16 @@ class TPUPlanner:
         if not self.streaming_enabled \
                 or getattr(sched, "delta", None) is None:
             return None
+        mesh = self.mesh \
+            or getattr(self._plan_fn, "mesh", None) \
+            or getattr(self._fused_fn, "mesh", None)
         if self._streaming is None:
             from .streaming import ResidentState
-            self._streaming = ResidentState(self._node_value)
+            self._streaming = ResidentState(self._node_value, mesh=mesh)
+        else:
+            # mesh teardown / shard-count change between ticks resyncs
+            # the device tier (set_mesh is a no-op on identity)
+            self._streaming.set_mesh(mesh)
         return self._streaming
 
     def _resident_for(self, cols):
@@ -802,11 +814,13 @@ class TPUPlanner:
             self._fallback()
             return None
         if sinfo.sid != strategy_mod.STRAT_SPREAD \
-                and self._plan_fn is not plan_group_jit:
-            # an injected plan_fn (mesh ShardedPlanFn, test stubs) owns
-            # the device path and has no strategy twin: the group rides
-            # its HOST ORACLE — identical placements by the seam's
-            # bit-parity contract, one densify on the host instead
+                and self._plan_fn is not plan_group_jit \
+                and not hasattr(self._plan_fn, "strategy"):
+            # an injected plan_fn (test stubs) owns the device path and
+            # has no strategy twin: the group rides its HOST ORACLE —
+            # identical placements by the seam's bit-parity contract,
+            # one densify on the host instead.  Mesh ShardedPlanFn
+            # exposes .strategy and keeps non-spread groups on device.
             self._count("groups_strategy_host")
             self._cache = None   # host path mutates NodeInfos
             return None
@@ -1619,6 +1633,23 @@ class TPUPlanner:
         the same per-row updates), so placements cannot change."""
         fn = self._fused_fn
         if fn is not None and hasattr(fn, "prepare_fused"):
+            # mesh path: when the streaming plane's device tier is
+            # sharded over THIS plan fn's mesh, the run seeds node state
+            # from the resident shards — zero cross-device reshuffle,
+            # only the small per-run extras transfer (sharded by the
+            # plan fn).  Same identity guard as the single-device path.
+            st = self._streaming
+            if st is not None and (not self.streaming_enabled
+                                   or shared.valid is not st.valid):
+                st = None
+            dev = st.device_carry() if st is not None else None
+            if dev is not None and getattr(st, "_mesh_active", False) \
+                    and st.mesh is getattr(fn, "mesh", None):
+                self._count("streaming_device_carries")
+                _devtel.note_bytes_avoided(_devtel.tree_nbytes(
+                    (shared.valid, shared.ready, carry.total, carry.cpu,
+                     carry.mem)))
+                return fn.prepare_fused(shared, carry, resident=dev)
             return fn.prepare_fused(shared, carry)
         import jax.numpy as jnp
         from .kernel import FusedCarry, FusedShared
@@ -1632,6 +1663,11 @@ class TPUPlanner:
                                or shared.valid is not st.valid):
             st = None
         dev = st.device_carry() if st is not None else None
+        if dev is not None and getattr(st, "_mesh_active", False):
+            # resident tier sharded but no mesh plan fn to consume it:
+            # the single-device fused path re-uploads from the host
+            # mirror rather than gathering shards through the host
+            dev = None
         if dev is not None:
             d_valid, d_ready, d_cpu, d_mem, d_total = dev
             self._count("streaming_device_carries")
@@ -1663,6 +1699,13 @@ class TPUPlanner:
         from ..parallel.sharded import plan_fused_sharded
         return plan_fused_sharded
 
+    def _strategy_jit_probe(self):
+        """Strategy-kernel twin of ``_fused_jit_probe``."""
+        if not hasattr(self._plan_fn, "strategy"):
+            return plan_strategy_jit
+        from ..parallel.sharded import plan_strategy_sharded
+        return plan_strategy_sharded
+
     def _dispatch_fused_chunks(self, run) -> None:
         """Dispatch chunks until two are in flight (or the run is fully
         dispatched).  Two in flight = the device computes chunk i+1
@@ -1679,7 +1722,7 @@ class TPUPlanner:
             probe = self._fused_jit_probe()
             before = _jit_cache_size(probe)
             _devtel.note_h2d("fused_inputs",
-                             _devtel.tree_nbytes(c.groups))
+                             _devtel.tree_nbytes((c.groups, c.strat)))
             c.t0 = _time.perf_counter()
             try:
                 with tracer.span("plan.dispatch", "plan", tasks=c.tasks,
@@ -1688,8 +1731,13 @@ class TPUPlanner:
                         fn = (self._fused_fn.fused
                               if self._fused_fn is not None
                               else plan_fused_jit)
-                        xs, fcs, spills, carry = fn(
-                            run.shared, c.groups, run.carry, run.L)
+                        if c.strat is not None:
+                            xs, fcs, spills, carry = fn(
+                                run.shared, c.groups, run.carry, run.L,
+                                c.strat)
+                        else:
+                            xs, fcs, spills, carry = fn(
+                                run.shared, c.groups, run.carry, run.L)
             except Exception:
                 log.exception("fused chunk dispatch failed; remaining "
                               "groups ride the per-group path")
@@ -1705,6 +1753,7 @@ class TPUPlanner:
                                 task_rows=c.tasks)
             c.arrays = (xs, fcs, spills)
             c.groups = None   # release the np staging buffers
+            c.strat = None
             run.carry = carry   # device-resident; never fetched
             run.next_dispatch += 1
             self._count("fused_chunks")
@@ -1776,6 +1825,10 @@ class TPUPlanner:
                 del task_group[task_id]
         run.applied = gi + 1
         self._count("groups_fused")
+        if spec.sid:
+            # non-spread group served by the fused device path: same
+            # per-strategy route accounting as the per-group kernel
+            strategy_mod.count_group(spec.sname, "device")
         self._count("tasks_planned", placed)
         return placed
 
@@ -1796,6 +1849,7 @@ class TPUPlanner:
         for c in run.chunks:
             c.arrays = None
             c.groups = None
+            c.strat = None
         run.carry = None
         run.shared = None
         if self._fused_active is run:

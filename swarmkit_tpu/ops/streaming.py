@@ -28,6 +28,15 @@ Two tiers of residency:
   never read back to host mid-program — D2H belongs to the fetch stage
   (swarmlint device-path-purity).
 
+On a planner mesh (``SWARM_PLANNER_MESH``) the device tier is
+**node-axis sharded** (parallel/sharded.py): each device owns nb/D
+rows, uploads stage per shard (``device_put`` with a NamedSharding
+ships each device its own slice), and the dirty-row scatter becomes a
+per-shard donated program — rows are bucketed by owning shard
+host-side, so a streaming tick moves O(churn) bytes and zero
+cross-device traffic, and the fused run seeds sharded
+``FusedShared``/``FusedCarry`` columns with no reshuffle.
+
 Fallback matrix (every full rebuild is counted; the escape hatch
 ``SWARM_STREAMING_PLANNER=0`` turns the whole plane off):
 
@@ -42,6 +51,11 @@ node-bucket overflow   cluster outgrew ``nb`` → rebuild into
                        the next pow2 bucket
 tracker divergence     mirror count != resident count (a missed
                        hook) → rebuild, never trust drifted rows
+mesh shard-count       planner mesh resized → the resident
+change                 shards have the wrong layout; device tier
+                       re-uploads (host mirror stays valid)
+mesh teardown          planner mesh removed → device tier
+                       demotes to single-device residency
 =====================  =======================================
 """
 
@@ -119,9 +133,15 @@ class _ConColumn:
 class ResidentState:
     """Persistent densified node state, refreshed O(churn) per tick."""
 
-    def __init__(self, node_value: Callable, device: bool = True):
+    def __init__(self, node_value: Callable, device: bool = True,
+                 mesh=None):
         #: planner._node_value — constraint-key lookup per NodeInfo
         self._node_value = node_value
+        #: planner mesh (parallel/sharded.py) — when set and the node
+        #: bucket divides evenly over it, the device tier lives as
+        #: node-axis-sharded arrays with per-shard donated scatters
+        self.mesh = mesh
+        self._mesh_active = False
         self.infos: Optional[List] = None
         self.row_of: Dict[str, int] = {}
         self.n = 0
@@ -156,7 +176,45 @@ class ResidentState:
         self.stats = {"colds": 0, "resyncs": 0, "fallbacks": 0,
                       "incremental": 0, "full": 0, "rows": 0,
                       "dirty_frac": 0.0, "device_syncs": 0,
-                      "svc_evictions": 0, "bytes_avoided": 0}
+                      "svc_evictions": 0, "bytes_avoided": 0,
+                      "shard_syncs": 0}
+
+    # --------------------------------------------------------- mesh tier
+
+    def set_mesh(self, mesh) -> None:
+        """(Re)wire the planner mesh.  A layout change while device
+        arrays exist — mesh resized ("shard-count") or removed
+        ("mesh-teardown") — drops the device tier for a counted
+        re-upload on the next sync; the host mirror stays valid, so no
+        host rebuild happens."""
+        if mesh is self.mesh:
+            return
+        old, self.mesh = self.mesh, mesh
+        if self.dev is None and not self._mesh_active:
+            return
+        reason = "mesh-teardown" if mesh is None else "shard-count"
+        self.stats["resyncs"] += 1
+        _metrics.counter(
+            f'swarm_streaming_resyncs{{reason="{reason}"}}')
+        log.info("resident device tier reset (%s): mesh %s -> %s",
+                 reason, old, mesh)
+        self.dev = None
+        self._mesh_active = False
+        self._dev_version = -1
+
+    def _mesh_for(self):
+        """The usable mesh for the device tier: set, >1 device, and
+        evenly dividing the node bucket (pow2 buckets and mesh sizes
+        make that the norm; a non-pow2 mesh demotes to the
+        single-device tier)."""
+        mesh = self.mesh
+        if mesh is None or not self.nb:
+            return None
+        from ..parallel.sharded import NODE_AXIS
+        d = mesh.shape[NODE_AXIS]
+        if d <= 1 or self.nb % d:
+            return None
+        return mesh
 
     # ------------------------------------------------------------- refresh
 
@@ -515,20 +573,33 @@ class ResidentState:
     def _device_upload(self, reason: str = "cold_build") -> None:
         """Fresh device placement of the five node-state columns (full
         rebuild, or a delta too wide for the scatter buckets).  Covers
-        every row, so the host-only backlog is consumed by definition."""
+        every row, so the host-only backlog is consumed by definition.
+        On a mesh the wide-delta re-upload is STAGED PER SHARD:
+        ``device_put`` with a node-axis NamedSharding ships each device
+        its own nb/D slice directly."""
         if not self.device_enabled:
             return
         self._pending_dev_rows = {}
+        mesh = self._mesh_for()
         try:
-            import jax.numpy as jnp
             with fusedbatch.x64():
-                self.dev = tuple(jnp.asarray(a) for a in (
-                    self.valid, self.ready, self.cpu, self.mem,
-                    self.total))
+                if mesh is not None:
+                    from ..parallel.sharded import put_resident
+                    self.dev = put_resident(
+                        (self.valid, self.ready, self.cpu, self.mem,
+                         self.total), mesh)
+                    self._mesh_active = True
+                else:
+                    import jax.numpy as jnp
+                    self.dev = tuple(jnp.asarray(a) for a in (
+                        self.valid, self.ready, self.cpu, self.mem,
+                        self.total))
+                    self._mesh_active = False
         except Exception:
             log.exception("resident device upload failed; host tier only")
             self.device_enabled = False
             self.dev = None
+            self._mesh_active = False
             _metrics.counter("swarm_streaming_device_disabled")
             return
         # host nbytes == device nbytes here (jnp.asarray copies the
@@ -544,11 +615,21 @@ class ResidentState:
     def _device_sync(self, rows: List[int]) -> None:
         """Scatter dirty rows — plus any host-only backlog — into the
         resident device arrays via the donated update program; wide
-        deltas re-upload wholesale."""
+        deltas re-upload wholesale.  On a mesh the dirty rows are
+        bucketed by owning shard (row // local_n) and scattered by the
+        per-shard donated program — each device updates only rows it
+        owns, zero cross-device traffic."""
         if not self.device_enabled:
             return
         if self.dev is None:
             self._pending_dev_rows = {}
+            self._device_upload()
+            return
+        mesh = self._mesh_for() if self._mesh_active else None
+        if self._mesh_active and mesh is None:
+            # the mesh became unusable under live device arrays (bucket
+            # regrew to a non-dividing width): re-place
+            self.dev = None
             self._device_upload()
             return
         if self._pending_dev_rows:
@@ -565,26 +646,57 @@ class ResidentState:
         if db is None:
             self._device_upload(reason="wide_reupload")
             return
-        idx = np.full(db, self.nb, np.int32)   # pad = out of bounds, drops
-        idx[:len(rows)] = rows
-        u_valid = np.zeros(db, bool)
-        u_ready = np.zeros(db, bool)
-        u_cpu = np.zeros(db, np.int64)
-        u_mem = np.zeros(db, np.int64)
-        u_total = np.zeros(db, np.int32)
-        for j, i in enumerate(rows):
-            u_valid[j] = self.valid[i]
-            u_ready[j] = self.ready[i]
-            u_cpu[j] = self.cpu[i]
-            u_mem[j] = self.mem[i]
-            u_total[j] = self.total[i]
         from .planner import _jit_cache_size, _observe_compile
         import time as _time
-        bucket = f"stream_nb{self.nb}_d{db}"
-        before = _jit_cache_size(_scatter_rows_jit)
+        if mesh is not None:
+            from ..parallel.sharded import NODE_AXIS
+            nd = mesh.shape[NODE_AXIS]
+            local_n = self.nb // nd
+            # pad slot = local_n: out of bounds for the shard, drops
+            idx = np.full((nd, db), local_n, np.int32)
+            u_valid = np.zeros((nd, db), bool)
+            u_ready = np.zeros((nd, db), bool)
+            u_cpu = np.zeros((nd, db), np.int64)
+            u_mem = np.zeros((nd, db), np.int64)
+            u_total = np.zeros((nd, db), np.int32)
+            fill = [0] * nd
+            for i in rows:
+                s, r = divmod(i, local_n)
+                j = fill[s]
+                fill[s] += 1
+                idx[s, j] = r
+                u_valid[s, j] = self.valid[i]
+                u_ready[s, j] = self.ready[i]
+                u_cpu[s, j] = self.cpu[i]
+                u_mem[s, j] = self.mem[i]
+                u_total[s, j] = self.total[i]
+            bucket = f"stream_nb{self.nb}_d{db}_x{nd}"
+            reason = "shard_scatter"
+            probe = None   # resolved below (import-order safety)
+        else:
+            idx = np.full(db, self.nb, np.int32)   # pad = oob, drops
+            idx[:len(rows)] = rows
+            u_valid = np.zeros(db, bool)
+            u_ready = np.zeros(db, bool)
+            u_cpu = np.zeros(db, np.int64)
+            u_mem = np.zeros(db, np.int64)
+            u_total = np.zeros(db, np.int32)
+            for j, i in enumerate(rows):
+                u_valid[j] = self.valid[i]
+                u_ready[j] = self.ready[i]
+                u_cpu[j] = self.cpu[i]
+                u_mem[j] = self.mem[i]
+                u_total[j] = self.total[i]
+            bucket = f"stream_nb{self.nb}_d{db}"
+            reason = "dirty_scatter"
+            probe = _scatter_rows_jit
+        if probe is None:
+            from ..parallel.sharded import scatter_rows_sharded
+            probe = scatter_rows_sharded
+        before = _jit_cache_size(probe)
         staged = _devtel.tree_nbytes(
             (idx, u_valid, u_ready, u_cpu, u_mem, u_total))
-        _devtel.note_h2d("dirty_scatter", staged)
+        _devtel.note_h2d(reason, staged)
         # what a non-streaming tick would have shipped instead: the
         # full five-column upload, minus what the scatter staged
         full = _devtel.tree_nbytes(
@@ -604,9 +716,19 @@ class ResidentState:
                 # program is correct either way (donation is the TPU win)
                 warnings.filterwarnings("ignore", message=".*onat.*")
                 with fusedbatch.x64():
-                    self.dev = _scatter_rows_jit(
-                        *self.dev, idx, u_valid, u_ready, u_cpu, u_mem,
-                        u_total)
+                    if mesh is not None:
+                        from ..parallel.sharded import (
+                            put_scatter_updates, scatter_rows_sharded)
+                        bufs = put_scatter_updates(
+                            (idx, u_valid, u_ready, u_cpu, u_mem,
+                             u_total), mesh)
+                        self.dev = scatter_rows_sharded(
+                            *self.dev, *bufs, mesh=mesh)
+                        self.stats["shard_syncs"] += 1
+                    else:
+                        self.dev = _scatter_rows_jit(
+                            *self.dev, idx, u_valid, u_ready, u_cpu,
+                            u_mem, u_total)
         except Exception:
             log.exception("resident device scatter failed; re-uploading")
             _devtel.note_retired(old_ids)   # buffers gone either way
@@ -615,7 +737,7 @@ class ResidentState:
             return
         dt = _time.perf_counter() - t0
         _devtel.note_retired(old_ids)
-        comp = _observe_compile(_scatter_rows_jit, bucket, before, dt)
+        comp = _observe_compile(probe, bucket, before, dt)
         _devtel.note_kernel(bucket, "scatter", dispatch_s=dt,
                             compile_s=comp, node_rows=len(rows))
         _devtel.set_watermark("device_resident",
@@ -655,4 +777,12 @@ class ResidentState:
             "rows": self.stats["rows"],
             "device_syncs": self.stats["device_syncs"],
             "bytes_avoided": self.stats["bytes_avoided"],
+            "shard_syncs": self.stats["shard_syncs"],
+            "mesh_devices": self._mesh_devices(),
         }
+
+    def _mesh_devices(self) -> int:
+        if not self._mesh_active or self.mesh is None:
+            return 0
+        from ..parallel.sharded import NODE_AXIS
+        return int(self.mesh.shape[NODE_AXIS])

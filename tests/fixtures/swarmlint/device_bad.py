@@ -101,6 +101,20 @@ def drain_results(handles):
     return [np.asarray(h) for h in handles]
 
 
+def drive_sharded_chunks(shared, groups, carry, L):
+    # host driver of the sharded fused pipeline (ISSUE 19); the ledger
+    # call keeps the unaccounted-transfer shapes quiet so only the
+    # cross-shard shapes fire here
+    from swarmkit_tpu.obs import devicetelemetry
+    devicetelemetry.note_h2d("fused_inputs", 0)
+    for g in groups:
+        carry = jax.device_get(carry)       # mid-chunk D2H of the carry
+        _, carry = plan_fused(shared, g, carry, L)
+    resident = jax.device_put(carry)
+    again = jax.device_put(resident)        # re-put of a resident array
+    return carry, again
+
+
 @functools.partial(jax.jit, static_argnames=("strategy",))
 def plan_strategy(caps, scores, weights, strategy):
     # pluggable scoring stage (ISSUE 15): the strategy kernel is device

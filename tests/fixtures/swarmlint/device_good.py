@@ -116,6 +116,20 @@ def drive_streaming(cpu, mem, idx, vals):
     return cpu.sum() + mem.sum(), cpu, mem
 
 
+def drive_sharded_chunks(shared, groups, carry, L):
+    # host driver of the sharded fused pipeline (ISSUE 19): the carry
+    # stays device-resident ACROSS chunk dispatches and is fetched
+    # once, after the last; staging happens once — the resident handle
+    # is reused, never re-put
+    resident = jax.device_put(carry)
+    devicetelemetry.note_h2d("fused_inputs", int(carry.nbytes))
+    ys = []
+    for g in groups:
+        y, resident = plan_fused(shared, g, resident, L)
+        ys.append(y)
+    return ys, jax.device_get(resident)
+
+
 @functools.partial(jax.jit, static_argnames=("strategy",))
 def plan_strategy(caps, scores, weights, strategy):
     # pluggable scoring stage (ISSUE 15): sorts, shifts and the MLP

@@ -103,7 +103,7 @@ def test_rule_passes_clean_twin(rule):
     #                            GIL-released native fan-out under the
     #                            writer lock (ISSUE 13 commit plane)
     ("layering", 4),           # state/manager/sim/orchestrator imports
-    ("device-path-purity", 18),  # float()/np./jax.debug/.item() + the
+    ("device-path-purity", 20),  # float()/np./jax.debug/.item() + the
     #                              fused shapes: np/.item() in a scan
     #                              step, mid-program device_get,
     #                              block_until_ready in a mesh kernel +
@@ -120,7 +120,11 @@ def test_rule_passes_clean_twin(rule):
     #                              unaccounted-transfer shapes (ISSUE
     #                              18): host device_put with no ledger
     #                              call, host block_until_ready fetch
-    #                              with no ledger call
+    #                              with no ledger call + the
+    #                              cross-shard shapes (ISSUE 19):
+    #                              mid-chunk device_get of a carry that
+    #                              feeds a later dispatch, re-put of an
+    #                              already-resident sharded array
     ("metric-hygiene", 7),     # bad chars/unsorted/duplicate/upper key
     #                            + the metric-cardinality shapes
     #                            (ISSUE 17): per-entity task= / node_id=
