@@ -1854,6 +1854,250 @@ def run_e2e(n_agents=5,
         mgr.stop()
 
 
+def run_million_swarm(planner_factory):
+    """Config 13: overload-safe serving at fleet scale — >=1k REAL
+    dispatcher sessions over ONE threadless dispatcher (batched
+    assignment fan-out, bounded session/update/assignment bookkeeping)
+    carrying a ~1M-replica fan-out end to end.  Phases: register the
+    fleet (heartbeat stretch engages as the session count passes the
+    threshold), open every assignment stream, schedule the full replica
+    set in one timed tick (compiles must be zero — same warm-up
+    discipline as cfg6/7), deliver assignments through the batched
+    fan-out, then absorb the status-writeback storm at the bounded
+    admission edge: batches that would overflow the buffer are shed
+    WHOLE with ErrOverloaded, counted on both sides of the RPC, and
+    re-sent by the client next round until every replica is RUNNING —
+    degraded, never silently lossy.  Records time-to-running
+    percentiles (tick start -> RUNNING committed), the exact
+    shed/recovery ledger, heartbeat-stretch evidence, fan-out traffic,
+    and the dispatcher/scheduler plane saturation snapshot.
+    BENCH_CFG13_* env knobs scale it; defaults hit the 1k-session x
+    1M-replica target shape."""
+    _trim_heap()
+    import time as time_mod
+
+    from swarmkit_tpu.manager.dispatcher import (
+        Config_ as _DCfg, Dispatcher, ErrOverloaded,
+    )
+    from swarmkit_tpu.models import (
+        Resources, Task as _Task, TaskState, TaskStatus,
+    )
+    from swarmkit_tpu.obs.planes import plane as _plane
+
+    n_agents = int(os.environ.get("BENCH_CFG13_AGENTS", 1000))
+    n_replicas = int(os.environ.get("BENCH_CFG13_REPLICAS", 1_000_000))
+    n_services = int(os.environ.get("BENCH_CFG13_SERVICES", 10))
+    pending_cap = int(os.environ.get("BENCH_CFG13_PENDING_CAP", 65_536))
+    report_batch = int(os.environ.get("BENCH_CFG13_REPORT_BATCH", 1024))
+
+    # the default bench reservation (0.1 CPU) caps a 64-CPU node at 640
+    # tasks — 1000 nodes would top out at 640k replicas.  This config
+    # models the 1000x-agent serving shape: light replicas, ~3200/node
+    # CPU headroom so the full 1M fan-out fits with imbalance slack
+    _rsv = Resources(nano_cpus=2 * 10**7, memory_bytes=16 << 20)
+
+    # warm-up at this config's exact fused jit signatures (same node
+    # bucket, same service count) so no compile lands in the timed tick
+    from swarmkit_tpu.obs import tracer as _tracer
+    was_tracing = _tracer.enabled
+    _tracer.disable()
+    try:
+        warm_store, *_ = build_cluster(n_agents, 16 * n_services,
+                                       reservations=_rsv,
+                                       n_services=n_services)
+        warm_planner = planner_factory()
+        warm_planner.enable_small_group_routing = False
+        one_tick(warm_store, warm_planner)
+        del warm_store, warm_planner
+        # second pass with default routing: small/remainder groups may
+        # take the single-group kernel at this shape — warm it too
+        warm_store, *_ = build_cluster(n_agents, 16 * n_services,
+                                       reservations=_rsv,
+                                       n_services=n_services)
+        one_tick(warm_store, planner_factory())
+        del warm_store
+        _trim_heap()
+    finally:
+        _tracer.enabled = was_tracing
+
+    store, svc, nodes, tasks = build_cluster(n_agents, n_replicas,
+                                             reservations=_rsv,
+                                             n_services=n_services)
+    # overload bounds live: session cap just above the fleet (steady
+    # registration stays admitted), stretch threshold well under it
+    # (the period MUST stretch), update buffer far under the storm
+    # (the writeback MUST shed).  max_batch_items sits above the
+    # admission bound so the buffer drains on this harness's explicit
+    # flush turns, not behind an implicit mid-round flush.
+    d = Dispatcher(store, _DCfg(
+        heartbeat_period=30.0,
+        max_batch_items=pending_cap * 2,
+        max_sessions=n_agents + 64,
+        hb_stretch_start=max(8, n_agents // 16),
+        hb_stretch_max=4.0,
+        max_pending_updates=pending_cap,
+        max_terminal_tasks=max(1024, n_replicas // 64)))
+    d.run(start_worker=False)   # threadless: this harness is the clock
+    fan = d.enable_batched_fanout()
+    try:
+        t_reg0 = time_mod.perf_counter()
+        sessions = {}
+        for n in nodes:
+            sessions[n.id] = d.register(n.id)[0]
+        register_s = time_mod.perf_counter() - t_reg0
+        stretch = d._stretch_factor()
+
+        t_open0 = time_mod.perf_counter()
+        streams = {n.id: fan.open(n.id, sessions[n.id]) for n in nodes}
+        open_s = time_mod.perf_counter() - t_open0
+
+        def drain_streams():
+            msgs = changes = 0
+            for s in streams.values():
+                while True:
+                    try:
+                        m = s.get(timeout=0)
+                    except Exception:   # TimeoutError / Closed: drained
+                        break
+                    msgs += 1
+                    changes += len(m.changes)
+            return msgs, changes
+
+        # ---- timed scheduling window (compiles gated to zero)
+        planner = planner_factory()
+        snap = _planner_counter_snapshot()
+        _plane("scheduler").roll()    # open the tick occupancy window
+        t_create = time_mod.time()
+        sched, n_dec, dt = one_tick(store, planner)
+        _plane("scheduler").note_busy(dt)
+        compiles = _compile_delta(snap)
+
+        # ---- assignment fan-out: one subscription drains into 1k
+        # bounded per-node sets; flush sends the incremental batches
+        t_fan0 = time_mod.perf_counter()
+        fan_msgs = fan_changes = 0
+        while True:
+            fan.flush()
+            m, c = drain_streams()
+            fan_msgs += m
+            fan_changes += c
+            if not m:
+                break
+        fanout_s = time_mod.perf_counter() - t_fan0
+
+        # ---- status-writeback storm against the bounded admission
+        # edge: every shed is counted on both sides and the batch is
+        # re-queued for the next round (recovery is total by exit)
+        backlog = {}
+        for t in store.view(lambda tx: tx.find(_Task)):
+            if t.node_id:
+                backlog.setdefault(t.node_id, []).append(t.id)
+        node_ids = [n.id for n in nodes]
+        client = {"shed_batches": 0, "shed_updates": 0, "rounds": 0,
+                  "heartbeats": 0}
+        sheds0 = d.stats["sheds"]
+        _plane("dispatcher").roll()   # open the writeback window
+        peak_depth = 0
+        t_wb0 = time_mod.perf_counter()
+        rr = 0
+        while backlog:
+            client["rounds"] += 1
+            for nid in node_ids:   # keep the 1k-session TTL wheel hot
+                d.heartbeat(nid, sessions[nid])
+                client["heartbeats"] += 1
+            shed_this_round = 0
+            for _ in range(len(node_ids)):
+                nid = node_ids[rr % len(node_ids)]
+                rr += 1
+                ids = backlog.get(nid)
+                if not ids:
+                    continue
+                chunk = ids[:report_batch]
+                ts = time_mod.time()
+                ups = [(tid, TaskStatus(state=TaskState.RUNNING,
+                                        message="started",
+                                        timestamp=ts))
+                       for tid in chunk]
+                try:
+                    d.update_task_status(nid, sessions[nid], ups)
+                except ErrOverloaded:
+                    client["shed_batches"] += 1
+                    client["shed_updates"] += len(ups)
+                    shed_this_round += 1
+                    if shed_this_round >= 4:
+                        break   # edge saturated: drain before resending
+                    continue
+                del ids[:len(chunk)]
+                if not ids:
+                    del backlog[nid]
+            peak_depth = max(peak_depth, len(d._task_updates))
+            _plane("dispatcher").set_depth(peak_depth)
+            with _plane("dispatcher").busy():
+                d._flush_updates()      # the worker's process turn
+                d.process_deadlines()   # TTL wheel + fan-out flush
+            m, c = drain_streams()
+            fan_msgs += m
+            fan_changes += c
+        writeback_s = time_mod.perf_counter() - t_wb0
+        shed_count = d.stats["sheds"] - sheds0
+
+        # the shed ledger must reconcile EXACTLY: every shed the
+        # dispatcher counted is one a client observed (and re-sent)
+        assert shed_count == client["shed_updates"], \
+            (shed_count, client)
+        assert d.stats["premature_expirations"] == 0, d.stats
+
+        lat = sorted(
+            (t.status.applied_at or t.status.timestamp) - t_create
+            for t in store.view(lambda tx: tx.find(_Task))
+            if t.status.state == TaskState.RUNNING)
+        assert len(lat) >= n_replicas, \
+            f"cfg13: only {len(lat)}/{n_replicas} RUNNING"
+
+        def pct(p):
+            return round(lat[min(len(lat) - 1, int(p * len(lat)))], 3)
+        _plane("dispatcher").roll()
+        _plane("scheduler").roll()
+        return {
+            "agents": n_agents, "replicas": n_replicas,
+            "services": n_services, "sessions": len(sessions),
+            "decisions": n_dec,
+            "decisions_per_sec": round(n_dec / dt, 1),
+            "tick_s": round(dt, 3),
+            "register_s": round(register_s, 3),
+            "stream_open_s": round(open_s, 3),
+            "fanout_s": round(fanout_s, 3),
+            "fanout_messages": fan_msgs,
+            "fanout_changes": fan_changes,
+            "fanout_compactions": fan.stats["compactions"],
+            "writeback_s": round(writeback_s, 3),
+            "writeback_rounds": client["rounds"],
+            "peak_update_depth": peak_depth,
+            "heartbeats": client["heartbeats"],
+            "hb_stretch_factor": round(stretch, 3),
+            "hb_stretches": d.stats["hb_stretches"],
+            "premature_expirations": d.stats["premature_expirations"],
+            "expirations": d.stats["expirations"],
+            "sheds": {
+                "dispatcher": shed_count,
+                "client_observed": client["shed_updates"],
+                "shed_batches": client["shed_batches"],
+                "uncounted": shed_count - client["shed_updates"],
+                "unrecovered": n_replicas - len(lat)},
+            "time_to_running": {
+                "p50_s": pct(0.50), "p90_s": pct(0.90),
+                "p99_s": pct(0.99), "max_s": round(lat[-1], 3),
+                "running": len(lat)},
+            "planes": {"dispatcher": _plane("dispatcher").report(),
+                       "scheduler": _plane("scheduler").report()},
+            "path": "dispatcher+fanout+writeback",
+            "compiles": compiles,
+        }
+    finally:
+        d.stop()
+        _trim_heap()
+
+
 def main():
     from swarmkit_tpu.models import Platform, PlacementPreference, Resources, SpreadOver
     from swarmkit_tpu.obs import tracer
@@ -2126,6 +2370,14 @@ def main():
         # tick's dec/s within 4x of the plain tick)
         with tracer.span("bench.config", "bench", cfg="cfg12"):
             configs["12_gang_pipeline"] = run_gang_pipeline(tpu)
+    if _cfg_enabled(13):
+        # overload-safe serving at fleet scale: >=1k real dispatcher
+        # sessions + ~1M-replica fan-out through the batched assignment
+        # plane with the admission bounds LIVE (bench_compare gates the
+        # time-to-running p99 regression, ledger-exact shed counting
+        # with zero unrecovered, and zero timed-window compiles)
+        with tracer.span("bench.config", "bench", cfg="cfg13"):
+            configs["13_million_swarm"] = run_million_swarm(tpu)
     if SKIP_E2E:
         e2e = None
     else:

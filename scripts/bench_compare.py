@@ -47,6 +47,7 @@ def _norm(doc):
     gangs = {}
     h2d_per_tick = {}
     mesh_resident = {}
+    overload = {}
     for name, cfg in (doc.get("configs") or {}).items():
         dps = cfg.get("decisions_per_sec")
         if dps:
@@ -83,6 +84,17 @@ def _norm(doc):
                     "spread_decisions_per_sec"),
                 "strategy_fallbacks": cfg.get("strategy_fallbacks"),
                 "fallback_groups": cfg.get("fallback_groups"),
+            }
+        if isinstance(cfg.get("sheds"), dict):
+            overload[name] = {
+                "sheds": cfg.get("sheds"),
+                "sessions": cfg.get("sessions"),
+                "hb_stretches": cfg.get("hb_stretches"),
+                "hb_stretch_factor": cfg.get("hb_stretch_factor"),
+                "premature_expirations": cfg.get(
+                    "premature_expirations"),
+                "time_to_running_p99_s": (cfg.get("time_to_running")
+                                          or {}).get("p99_s"),
             }
         if cfg.get("gangs_admitted") is not None:
             gangs[name] = {
@@ -145,6 +157,11 @@ def _norm(doc):
         # counters, the gate-held count, and the gang-vs-plain dec/s
         # ratio the regression bound judges
         "gangs": gangs,
+        # overload-plane evidence per config (cfg13): the shed ledger
+        # (dispatcher-counted vs client-observed, uncounted/unrecovered
+        # pinned at zero), heartbeat-stretch evidence, and the
+        # time-to-running p99 the regression bound judges
+        "overload": overload,
         "headline_compiles": _compiles(doc.get("planner_compiles")),
         "t": doc.get("t"),
         "health": (doc.get("health") or {}).get("status")
@@ -570,6 +587,75 @@ def main(argv=None) -> int:
                   f"below the plain tick's ({ratio}x)", file=sys.stderr)
             gate_failures.append(("gang-admission-overhead",
                                   f"gang_vs_plain_x={ratio}"))
+    # overload-plane gates (ISSUE 20), judged on the NEW run's cfg13:
+    # (a) the shed ledger must reconcile EXACTLY — an uncounted shed is
+    # silent loss, an unrecovered one means a replica never reached
+    # RUNNING after admission shed it; (b) the plane must have actually
+    # FIRED (zero sheds at a fan-out sized to saturate the admission
+    # edge means the bound went dead, and an unstretched heartbeat
+    # period at >=1k sessions means the stretch plumbing rotted);
+    # (c) zero premature expirations — the stretch an agent was
+    # PROMISED must extend its expiry window; (d) compile-flat timed
+    # windows; (e) the time-to-running p99 regressing >20% loses the
+    # latency bound the config exists to hold.
+    _OVL_CFG = "13_million_swarm"
+    if _OVL_CFG in new.get("configs", {}):
+        ov = new.get("overload", {}).get(_OVL_CFG) or {}
+        sheds = ov.get("sheds") or {}
+        print(f"overload[{_OVL_CFG}]: sessions={ov.get('sessions')} "
+              f"sheds={sheds.get('dispatcher')} "
+              f"uncounted={sheds.get('uncounted')} "
+              f"unrecovered={sheds.get('unrecovered')} "
+              f"hb_stretch={ov.get('hb_stretch_factor')}x "
+              f"premature_expirations="
+              f"{ov.get('premature_expirations')}")
+        if sheds.get("uncounted") or sheds.get("unrecovered"):
+            print(f"\n{_OVL_CFG}: shed ledger did not reconcile "
+                  f"(uncounted={sheds.get('uncounted')} "
+                  f"unrecovered={sheds.get('unrecovered')}) — "
+                  "degraded mode went silently lossy", file=sys.stderr)
+            gate_failures.append(
+                ("shed-ledger",
+                 f"uncounted={sheds.get('uncounted')} "
+                 f"unrecovered={sheds.get('unrecovered')}"))
+        if not sheds.get("dispatcher"):
+            print(f"\n{_OVL_CFG}: the admission edge never shed under "
+                  "a fan-out sized to saturate it", file=sys.stderr)
+            gate_failures.append(
+                ("overload-inactive",
+                 f"sheds={sheds.get('dispatcher')}"))
+        if not ov.get("hb_stretches") \
+                or (ov.get("hb_stretch_factor") or 0) <= 1.0:
+            print(f"\n{_OVL_CFG}: heartbeat period never stretched at "
+                  f"{ov.get('sessions')} sessions", file=sys.stderr)
+            gate_failures.append(
+                ("heartbeat-stretch-inactive",
+                 f"stretches={ov.get('hb_stretches')} "
+                 f"factor={ov.get('hb_stretch_factor')}"))
+        if ov.get("premature_expirations"):
+            print(f"\n{_OVL_CFG}: session(s) expired before their "
+                  "promised (stretched) window", file=sys.stderr)
+            gate_failures.append(
+                ("premature-expiration",
+                 f"premature={ov.get('premature_expirations')}"))
+        cfg13_compiles = new.get("compiles", {}).get(_OVL_CFG, 0)
+        if cfg13_compiles:
+            print(f"\n{_OVL_CFG} paid {cfg13_compiles} XLA "
+                  "compile(s) in its timed window", file=sys.stderr)
+            gate_failures.append(("overload-compile-growth",
+                                  f"compiles={cfg13_compiles}"))
+        ttr_old = (old.get("overload", {}).get(_OVL_CFG)
+                   or {}).get("time_to_running_p99_s")
+        ttr_new = ov.get("time_to_running_p99_s")
+        if ttr_old is not None or ttr_new is not None:
+            print(f"time_to_running_p99_s[{_OVL_CFG}]: "
+                  f"{ttr_old} -> {ttr_new}")
+        if ttr_old and ttr_new and ttr_new > ttr_old * (1.0 + 0.20):
+            print(f"\n{_OVL_CFG} time-to-running p99 regressed "
+                  f"{ttr_old} -> {ttr_new} (>20%)", file=sys.stderr)
+            gate_failures.append(
+                ("overload-p99-regression",
+                 f"{_OVL_CFG} p99 {ttr_old}->{ttr_new}"))
     # commit-plane gates (ISSUE 13), judged on the live-manager configs:
     # (a) the commit phase regressing >20% wall-clock loses the columnar
     # plane's win even while decisions/s still clears the threshold;
@@ -667,7 +753,9 @@ def main(argv=None) -> int:
     hc_new = new.get("health_checks") or {}
     for check, gate in (
             ("scheduler_occupancy", "scheduler-occupancy-saturation"),
-            ("apply_lag", "apply-lag-saturation")):
+            ("apply_lag", "apply-lag-saturation"),
+            ("dispatcher_overload", "dispatcher-overload-saturation"),
+            ("heartbeat_stretch", "heartbeat-stretch-saturation")):
         st = hc_new.get(check)
         if st is not None or hc_old.get(check) is not None:
             print(f"health[{check}]: {hc_old.get(check)} -> {st}")

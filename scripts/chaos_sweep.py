@@ -46,8 +46,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from swarmkit_tpu.sim.scenario import (          # noqa: E402
     FAILOVER_SCENARIOS, FUZZ_POOL, GANG_SCENARIOS, LEGACY_RCP_SCENARIOS,
-    PREEMPT_SCENARIOS, QOS_SCENARIOS, READ_SCENARIOS, SCENARIOS,
-    STREAMING_SCENARIOS, UPDATE_SCENARIOS, run_scenario,
+    OVERLOAD_SCENARIOS, PREEMPT_SCENARIOS, QOS_SCENARIOS, READ_SCENARIOS,
+    SCENARIOS, STREAMING_SCENARIOS, UPDATE_SCENARIOS, run_scenario,
 )
 
 #: named scenario subsets.  "default" is what CI's slow sweep runs; the
@@ -61,10 +61,12 @@ SUITES: Dict[str, tuple] = {
     "read": READ_SCENARIOS,
     "streaming": STREAMING_SCENARIOS,
     "gang": GANG_SCENARIOS,
+    "overload": OVERLOAD_SCENARIOS,
     "legacy-rcp": LEGACY_RCP_SCENARIOS,
     "default": FAILOVER_SCENARIOS + UPDATE_SCENARIOS
     + PREEMPT_SCENARIOS + QOS_SCENARIOS + READ_SCENARIOS
-    + STREAMING_SCENARIOS + GANG_SCENARIOS + LEGACY_RCP_SCENARIOS,
+    + STREAMING_SCENARIOS + GANG_SCENARIOS + OVERLOAD_SCENARIOS
+    + LEGACY_RCP_SCENARIOS,
     "fuzz": FUZZ_POOL,
 }
 
@@ -91,6 +93,12 @@ _FIXED_COMPONENT = {
     # streaming scheduler: logged when a leader handoff ACTUALLY
     # rebuilt the resident device-input state (epoch resync observed)
     "streaming-resync": "scheduler",
+    # overload plane: logged the first time the dispatcher ACTUALLY
+    # shed an admission / the first time the heartbeat period ACTUALLY
+    # stretched — an empty cell means the backpressure plane went dead
+    "overload-shed": "dispatcher",
+    "heartbeat-stretch": "agent",
+    "fan-out-burst": "dispatcher",
     "cut": "network", "heal": "network", "split": "network",
     "heal-all": "network", "drop": "network", "drop-burst": "network",
     "clock-skew": "clock",
@@ -205,6 +213,18 @@ REQUIRED_CELLS: Dict[str, Set[Tuple[str, str]]] = {
         ("stage-poison", "agent"),
         ("crash", "manager"), ("restart", "manager"),
         ("stepdown", "manager"), ("drop", "network")},
+    # million-swarm overload harness: the dispatcher must ACTUALLY shed
+    # (not just be configured to) and the heartbeat period must ACTUALLY
+    # stretch under the session load — empty cells mean the fan-out no
+    # longer saturates the admission plane and the scenario is testing
+    # nothing
+    "million-swarm": {
+        ("overload-shed", "dispatcher"),
+        ("heartbeat-stretch", "agent"),
+        ("fan-out-burst", "dispatcher"),
+        ("crash", "manager"), ("restart", "manager"),
+        ("agent-crash", "agent"), ("agent-restart", "agent"),
+        ("drop", "network")},
 }
 
 
@@ -303,7 +323,8 @@ def main(argv=None) -> int:
                    help="CI subset: 3 seeds x rolling-upgrade-chaos + "
                         "preemption-storm + follower-read-failover, "
                         "plus 1 tenant-storm, 1 steady-state-churn, "
-                        "1 gang-deadlock and 1 pipeline-chaos seed "
+                        "1 gang-deadlock, 1 pipeline-chaos and "
+                        "1 million-swarm seed "
                         "(overrides --fuzz/--suite/--scenario)")
     p.add_argument("--no-coverage-gate", action="store_true",
                    help="report the coverage matrix but never fail on "
@@ -328,7 +349,8 @@ def main(argv=None) -> int:
                             "follower-read-failover")
         n_seeds = 3
         extra_runs = (("tenant-storm", 1), ("steady-state-churn", 1),
-                      ("gang-deadlock", 1), ("pipeline-chaos", 1))
+                      ("gang-deadlock", 1), ("pipeline-chaos", 1),
+                      ("million-swarm", 1))
     else:
         if args.scenario:
             scenarios = tuple(args.scenario)

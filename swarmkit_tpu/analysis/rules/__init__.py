@@ -1,3 +1,3 @@
 """Rule modules register themselves with the checker registry on import."""
 
-from . import determinism, device, fencing, layering, locking, metrics  # noqa: F401
+from . import backpressure, determinism, device, fencing, layering, locking, metrics  # noqa: F401

@@ -472,6 +472,51 @@ class ControlAPI:
 
         self.store.update(cb)
 
+    def resume_pipeline(self, service_id: str) -> Service:
+        """Operator restart for a halted pipeline stage (the sticky
+        halt's one legitimate exit): flips the verdict back to
+        "waiting" and resets the poison ledger of the stage AND its
+        direct upstreams, stamping ``resumed_at`` so every failure
+        observed at/before the resume is forgiven — the poison the
+        operator just fixed cannot re-trip the threshold.  Replicas
+        zeroed by a rollback halt are NOT restored (rescale
+        explicitly); an upstream stage that is itself halted must be
+        resumed separately, bottom-up."""
+        from ..models.objects import PipelineStatus
+
+        def cb(tx):
+            svc = tx.get(Service, service_id)
+            if svc is None:
+                raise NotFound(f"service {service_id} not found")
+            if not svc.spec.depends_on:
+                raise FailedPrecondition(
+                    f"service {service_id} is not a pipeline stage")
+            st = svc.pipeline_status
+            state = st.state if st is not None else "waiting"
+            if state != "halted":
+                raise FailedPrecondition(
+                    f'pipeline stage {service_id} is not halted '
+                    f'(state "{state}")')
+            stamp = now()
+            svc = svc.copy()
+            svc.pipeline_status = PipelineStatus(
+                state="waiting", reason="", updated_at=stamp,
+                failed_ids=[], resumed_at=stamp)
+            tx.update(svc)
+            for dep in svc.spec.depends_on:
+                for up in tx.find(Service, ByName(dep)):
+                    up = up.copy()
+                    up_st = up.pipeline_status
+                    up.pipeline_status = PipelineStatus(
+                        state=up_st.state if up_st else "waiting",
+                        reason=up_st.reason if up_st else "",
+                        updated_at=stamp, failed_ids=[],
+                        resumed_at=stamp)
+                    tx.update(up)
+
+        self.store.update(cb)
+        return self.store.view(lambda tx: tx.get(Service, service_id))
+
     def list_services(self, name_prefix: str = "") -> List[Service]:
         from ..state.store import All, ByNamePrefix
         by = ByNamePrefix(name_prefix) if name_prefix else All()

@@ -62,6 +62,23 @@ class Config_:
     assignment_batching_wait: float = 0.100
     modification_batch_limit: int = 100
     orphan_timeout: float = 24 * 3600.0
+    # --- overload protection (backpressure plane).  All defaults keep
+    # classic behavior; bounds opt in per deployment.
+    #: hard admission bound on concurrent sessions; register() beyond it
+    #: sheds with ErrOverloaded (counted, client retries under backoff)
+    max_sessions: Optional[int] = None
+    #: session count beyond which the heartbeat period stretches
+    #: linearly (leader tells agents to slow down); 0 disables
+    hb_stretch_start: int = 0
+    #: cap on the stretch factor
+    hb_stretch_max: float = 4.0
+    #: bound on buffered task-status updates; an update batch that would
+    #: overflow it is shed with ErrOverloaded (counted, client re-sends)
+    max_pending_updates: Optional[int] = None
+    #: per-node assignment-set bound on retained TERMINAL tasks; beyond
+    #: it the oldest terminal entries are compacted out (counted) as
+    #: explicit "remove" changes — memory stays O(assigned tasks)
+    max_terminal_tasks: Optional[int] = None
 
 
 DefaultConfig = Config_
@@ -91,6 +108,14 @@ class ErrRateLimited(DispatcherError):
     rate_limit_period)."""
 
 
+class ErrOverloaded(DispatcherError):
+    """Backpressure shed at the RPC edge: the dispatcher is at a
+    configured bound (sessions or status buffer).  Degraded, never
+    silently lossy — every shed is counted in ``swarm_plane_drops``
+    and the client re-queues under its existing jittered backoff."""
+    code = "overloaded"
+
+
 RATE_LIMIT_COUNT = 3   # reference: nodes.go:14
 
 
@@ -99,6 +124,10 @@ class _RegisteredNode:
     node_id: str
     session_id: str
     deadline: float = 0.0
+    #: end of the window PROMISED to the agent (stretched period ×
+    #: grace) — an expiry firing before it is a premature expiration,
+    #: the bug heartbeat-liveness-under-stretch exists to catch
+    promised_until: float = 0.0
     registered_at: float = field(default_factory=now)
     attempts: int = 0
     streams: List["AssignmentStream"] = field(default_factory=list)
@@ -181,9 +210,18 @@ class _AssignmentSet:
     """Tracks what a node currently knows and computes diffs
     (reference: assignments.go newAssignmentSet)."""
 
-    def __init__(self, node_id: str, driver_provider=None):
+    def __init__(self, node_id: str, driver_provider=None,
+                 terminal_bound: Optional[int] = None,
+                 on_compact: Optional[Callable[[int], None]] = None):
         self.node_id = node_id
         self.driver_provider = driver_provider
+        #: bound on retained terminal (> RUNNING) tasks; beyond it the
+        #: oldest are compacted out as explicit "remove" changes so the
+        #: set stays O(assigned tasks) under churn
+        self.terminal_bound = terminal_bound
+        self.on_compact = on_compact
+        self.compactions = 0
+        self._terminal: Dict[str, None] = {}   # insertion-ordered ids
         self.tasks: Dict[str, Task] = {}
         self.deps_use: Dict[Tuple[str, str], Set[str]] = {}  # (kind,id)->task ids
         self.changes: Dict[Tuple[str, str], tuple] = {}
@@ -333,15 +371,46 @@ class _AssignmentSet:
                     and old.node_id == t.node_id):
                 self.tasks[t.id] = t
                 if t.status.state > TaskState.RUNNING:
-                    return self._release_task_deps(t)
+                    modified = self._release_task_deps(t)
+                    return self._note_terminal(t) or modified
                 return False
         elif t.status.state <= TaskState.RUNNING:
             self._add_task_deps(tx, t)
         self.tasks[t.id] = t
         self.changes[("task", t.id)] = ("update", "task", t)
+        self._note_terminal(t)
+        return True
+
+    def _note_terminal(self, t: Task) -> bool:
+        """Track terminal (> RUNNING) tasks in arrival order and compact
+        the oldest beyond ``terminal_bound`` as explicit "remove"
+        changes: the agent forgets them a little early (it would on the
+        reaper's delete anyway) and set memory stays O(assigned tasks)
+        under churn instead of O(task history)."""
+        if t.status.state <= TaskState.RUNNING:
+            return False
+        self._terminal.setdefault(t.id, None)
+        bound = self.terminal_bound
+        if bound is None or len(self._terminal) <= bound:
+            return False
+        evicted = 0
+        while len(self._terminal) > bound:
+            tid = next(iter(self._terminal))
+            del self._terminal[tid]
+            old = self.tasks.pop(tid, None)
+            if old is not None:
+                self._release_task_deps(old)
+                self.changes[("task", tid)] = ("remove", "task",
+                                               Task(id=tid))
+            evicted += 1
+        self.compactions += evicted
+        _metrics.counter("swarm_dispatcher_aset_compactions", evicted)
+        if self.on_compact is not None:
+            self.on_compact(evicted)
         return True
 
     def remove_task(self, t: Task) -> bool:
+        self._terminal.pop(t.id, None)
         if t.id not in self.tasks:
             return False
         self.changes[("task", t.id)] = ("remove", "task", Task(id=t.id))
@@ -389,7 +458,7 @@ class BatchedAssignmentFanout:
         self._streams: Dict[str, AssignmentStream] = {}
         self._seq: Dict[str, int] = {}
         self._applies: Dict[str, str] = {}
-        self.stats = {"sends": 0, "complete_sends": 0}
+        self.stats = {"sends": 0, "complete_sends": 0, "compactions": 0}
         self._sub = dispatcher.store.queue.subscribe(
             lambda ev: isinstance(ev, EventTaskBlock)
             or (isinstance(ev, Event)
@@ -403,8 +472,15 @@ class BatchedAssignmentFanout:
         current store view, then incremental batches via flush()."""
         self.d._check_session(node_id, session_id)
         stream = AssignmentStream(node_id)
+
+        def _on_compact(n):
+            self.stats["compactions"] += n
+
         aset = _AssignmentSet(node_id,
-                              driver_provider=self.d.driver_provider)
+                              driver_provider=self.d.driver_provider,
+                              terminal_bound=self.d.config
+                              .max_terminal_tasks,
+                              on_compact=_on_compact)
         with self._drain_mu:
             # session re-check + stream registration BEFORE any state
             # lands in the maps: a failure here must leak nothing
@@ -593,7 +669,17 @@ class Dispatcher:
         #: the thread-per-stream assignments loop with one subscription
         #: + per-node batched flushes driven from process_deadlines
         self.fanout: Optional[BatchedAssignmentFanout] = None
-        self.stats = {"heartbeats": 0, "expirations": 0}
+        self.stats = {"heartbeats": 0, "expirations": 0,
+                      "sheds": 0, "hb_stretches": 0,
+                      "premature_expirations": 0}
+        #: checker-sensitivity seam: with the seam off, the expiry
+        #: deadline forgets the stretch the agent was PROMISED — the
+        #: exact bug heartbeat-liveness-under-stretch exists to catch
+        self.stretch_extends_deadline = True
+        #: checker-sensitivity seam: with the seam off, admission sheds
+        #: still happen but are NOT counted — silently lossy degradation,
+        #: the exact bug overload-sheds-are-counted-and-recovered catches
+        self.count_sheds = True
         # cached Timer references — no per-call registry lookup on the
         # flush/assignments paths (reset() resets these in place)
         self._flush_timer = _metrics.timer(
@@ -617,12 +703,15 @@ class Dispatcher:
             with d._mu:
                 sessions = float(len(d._nodes))
             _metrics.gauge("swarm_dispatcher_sessions", sessions)
-            depth = 0.0
+            with d._updates_lock:
+                pending = float(len(d._task_updates))
+            _metrics.gauge("swarm_dispatcher_pending_updates", pending)
+            depth = pending
             fan = d.fanout
             if fan is not None:
                 with fan._mu:
-                    depth = float(sum(len(s.changes)
-                                      for s in fan._sets.values()))
+                    depth += float(sum(len(s.changes)
+                                       for s in fan._sets.values()))
             return {"depth": depth}
         _planes.plane(_planes.DISPATCHER).set_probe(_disp_probe)
 
@@ -762,9 +851,17 @@ class Dispatcher:
         node = self.store.raw_get(Node, node_id)
         if node is None:
             raise ErrNodeNotFound(node_id)
+        maxs = self.config.max_sessions
+        if maxs is not None and node_id not in self._nodes \
+                and len(self._nodes) >= maxs:
+            self._count_shed(1)
+            raise ErrOverloaded(
+                f"session bound {maxs} reached; node {node_id} shed")
 
         session_id = new_id()
         period = self._heartbeat_period()
+        window = period if self.stretch_extends_deadline \
+            else period / self._stretch_factor()
         with self._mu:
             old = self._nodes.get(node_id)
             attempts = 0
@@ -794,7 +891,9 @@ class Dispatcher:
                     stream.close(ErrSessionInvalid("node re-registered"))
             rn = _RegisteredNode(node_id=node_id, session_id=session_id,
                                  attempts=attempts)
-            rn.deadline = now() + period * self.config.grace_multiplier
+            rn.deadline = now() + window * self.config.grace_multiplier
+            rn.promised_until = now() + period * \
+                self.config.grace_multiplier
             self._nodes[node_id] = rn
             self._down_nodes.pop(node_id, None)
             self._push_deadline(rn.deadline, "hb", node_id)
@@ -805,8 +904,40 @@ class Dispatcher:
 
     def _heartbeat_period(self) -> float:
         base = self.config.heartbeat_period
-        return base + self._rng.uniform(-self.config.heartbeat_epsilon,
-                                        self.config.heartbeat_epsilon)
+        jittered = base + self._rng.uniform(
+            -self.config.heartbeat_epsilon, self.config.heartbeat_epsilon)
+        stretch = self._stretch_factor()
+        if stretch > 1.0:
+            self.stats["hb_stretches"] += 1
+            _metrics.counter("swarm_dispatcher_hb_stretches")
+        return jittered * stretch
+
+    def _stretch_factor(self) -> float:
+        """Adaptive heartbeat stretching: beyond ``hb_stretch_start``
+        sessions the advertised period grows linearly with load (capped
+        at ``hb_stretch_max``) — the leader tells agents to slow down,
+        so heartbeat arrival rate stays ~flat as sessions multiply.
+        Lock-free read of len(_nodes); callers may hold ``_mu``."""
+        start = self.config.hb_stretch_start
+        if start <= 0:
+            return 1.0
+        sessions = len(self._nodes)
+        if sessions <= start:
+            return 1.0
+        factor = min(self.config.hb_stretch_max,
+                     sessions / float(start))
+        _metrics.gauge("swarm_dispatcher_hb_stretch", factor)
+        return factor
+
+    def _count_shed(self, n: int) -> None:
+        """Every admission shed is COUNTED before it is raised — the
+        overload-sheds-are-counted-and-recovered invariant audits the
+        client-observed sheds against exactly this ledger."""
+        if not self.count_sheds:
+            return   # sensitivity seam: shed silently (the bug)
+        self.stats["sheds"] += n
+        _metrics.counter("swarm_dispatcher_sheds", n)
+        _planes.plane(_planes.DISPATCHER).drop(n)
 
     def publish_logs(self, node_id: str, session_id: str,
                      messages) -> None:
@@ -835,13 +966,17 @@ class Dispatcher:
         """TTL refresh; returns the next period
         (reference: dispatcher.go:1317)."""
         period = self._heartbeat_period()
+        window = period if self.stretch_extends_deadline \
+            else period / self._stretch_factor()
         with self._mu:
             rn = self._nodes.get(node_id)
             if rn is None:
                 raise ErrNodeNotRegistered(node_id)
             if rn.session_id != session_id:
                 raise ErrSessionInvalid(node_id)
-            rn.deadline = now() + period * self.config.grace_multiplier
+            rn.deadline = now() + window * self.config.grace_multiplier
+            rn.promised_until = now() + period * \
+                self.config.grace_multiplier
             self._push_deadline(rn.deadline, "hb", node_id)
         self.stats["heartbeats"] += 1
         _metrics.counter("swarm_dispatcher_heartbeats")
@@ -923,10 +1058,29 @@ class Dispatcher:
                 raise DispatcherError(
                     "cannot update a task not assigned this node")
             valid.append((task_id, status))
+        bound = self.config.max_pending_updates
+        shed = 0
         with self._updates_lock:
-            for task_id, status in valid:
-                self._task_updates[task_id] = status
+            # admission check at the RPC edge: a batch that would
+            # overflow the buffer is shed WHOLE (newest-rejected ==
+            # oldest-first retention: buffered updates, already
+            # admitted, are never dropped to make room).  Updates
+            # rewriting an already-buffered task don't grow the buffer
+            # and always land.
+            if bound is not None and valid:
+                growth = sum(1 for task_id, _ in valid
+                             if task_id not in self._task_updates)
+                if growth and len(self._task_updates) + growth > bound:
+                    shed = len(valid)
+            if not shed:
+                for task_id, status in valid:
+                    self._task_updates[task_id] = status
             n = len(self._task_updates)
+        if shed:
+            self._count_shed(shed)
+            raise ErrOverloaded(
+                f"status buffer at bound {bound}: shed {shed} updates "
+                f"from node {node_id}")
         if n >= self.config.max_batch_items:
             self._flush_updates()
 
@@ -1095,6 +1249,13 @@ class Dispatcher:
                 if kind == "hb":
                     rn = self._nodes.get(node_id)
                     expired = rn is not None and rn.deadline <= ts
+                    if expired and rn.promised_until > ts:
+                        # the node is being DOWNed INSIDE the window the
+                        # dispatcher promised it (a stretch the deadline
+                        # forgot) — the liveness invariant reads this
+                        self.stats["premature_expirations"] += 1
+                        _metrics.counter(
+                            "swarm_dispatcher_premature_expirations")
                 elif kind == "reg":
                     # registration grace after a leadership change; the
                     # ownership veto keeps a sharded dispatcher from
@@ -1149,7 +1310,9 @@ class Dispatcher:
     def _assignments_loop(self, stream: AssignmentStream, node_id: str,
                           session_id: str) -> None:
         aset = _AssignmentSet(node_id,
-                              driver_provider=self.driver_provider)
+                              driver_provider=self.driver_provider,
+                              terminal_bound=self.config
+                              .max_terminal_tasks)
         sequence = 0
         applies_to = ""
 

@@ -172,10 +172,16 @@ class PipelineStatus:
     reason: str = ""
     updated_at: float = 0.0
     failed_ids: List[str] = field(default_factory=list)
+    #: operator-resume watermark (controlapi ``resume_pipeline``):
+    #: failures stamped at/before it are forgiven — supervisors reset
+    #: their local observation ledgers when the stamp changes and skip
+    #: failed task rows older than it, so the poison the operator just
+    #: fixed can never re-trip the threshold.  0.0 = never resumed.
+    resumed_at: float = 0.0
 
     def copy(self) -> "PipelineStatus":
         return PipelineStatus(self.state, self.reason, self.updated_at,
-                              list(self.failed_ids))
+                              list(self.failed_ids), self.resumed_at)
 
 
 @dataclass

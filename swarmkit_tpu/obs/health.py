@@ -242,6 +242,56 @@ def apply_lag_value(warn_entries: float = 256.0, n: int = 4
     return get
 
 
+def dispatcher_overload_value(n: int = 4
+                              ) -> Callable[[Registry], Optional[float]]:
+    """Dispatcher backpressure condition: 1 (warn) while admission
+    sheds are actively being counted (``swarm_dispatcher_sheds`` grew
+    since the last evaluation — the edge is rejecting work, clients are
+    re-queuing under backoff); 2 (fail) when sheds grew STRICTLY across
+    the last ``n`` evaluations — sustained overload, load is not
+    subsiding and degraded service is the steady state.  None (pass)
+    until the dispatcher exports its first overload signal."""
+    history: deque = deque(maxlen=n)
+
+    def get(reg: Registry) -> Optional[float]:
+        sheds = reg.get_counter("swarm_dispatcher_sheds")
+        if sheds <= 0 \
+                and reg.get_gauge("swarm_dispatcher_pending_updates") \
+                is None:
+            return None
+        history.append(sheds)
+        if len(history) == n and all(b > a for a, b in
+                                     zip(history, list(history)[1:])):
+            return 2.0
+        if len(history) >= 2 and history[-1] > history[-2]:
+            return 1.0
+        return 0.0
+    return get
+
+
+def heartbeat_stretch_value(stretch_warn: float = 2.0
+                            ) -> Callable[[Registry], Optional[float]]:
+    """Heartbeat-stretch condition: 2 (fail) the moment ANY premature
+    expiration is counted (``swarm_dispatcher_premature_expirations`` —
+    a node marked DOWN inside the window the dispatcher PROMISED it;
+    correct stretching keeps it at zero forever, the
+    heartbeat-liveness-under-stretch invariant in live form); 1 (warn)
+    while the advertised stretch factor is at/over ``stretch_warn`` —
+    agents have been told to slow down materially, the session plane is
+    loaded.  None (pass) until the stretch plane exports."""
+    def get(reg: Registry) -> Optional[float]:
+        if reg.get_counter("swarm_dispatcher_premature_expirations") > 0:
+            return 2.0
+        s = reg.get_gauge("swarm_dispatcher_hb_stretch")
+        if s is None \
+                and reg.get_counter("swarm_dispatcher_hb_stretches") <= 0:
+            return None
+        if s is not None and s >= stretch_warn:
+            return 1.0
+        return 0.0
+    return get
+
+
 def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
                    edge_warn: float = 10.0, edge_fail: float = 60.0,
                    fallback_warn: float = 0.1, fallback_fail: float = 0.5,
@@ -320,6 +370,18 @@ def default_checks(tick_warn: float = 5.0, tick_fail: float = 30.0,
         Check("apply_lag", apply_lag_value(),
               1.0, 2.0, "state",
               ("swarm_plane_", "swarm_raft_")),
+        # dispatcher backpressure (manager/dispatcher.py overload
+        # plane): 1 = admission sheds actively counted, 2 = sheds
+        # growing strictly across evaluations (sustained overload)
+        Check("dispatcher_overload", dispatcher_overload_value(),
+              1.0, 2.0, "state",
+              ("swarm_dispatcher_", "swarm_plane_")),
+        # heartbeat stretching: 1 = agents told to slow down >= 2x,
+        # 2 = a node was DOWNed inside its promised window (liveness
+        # breach — the stretch the expiry deadline forgot)
+        Check("heartbeat_stretch", heartbeat_stretch_value(),
+              1.0, 2.0, "state",
+              ("swarm_dispatcher_h",)),
     ]
 
 

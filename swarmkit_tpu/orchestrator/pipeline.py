@@ -14,9 +14,12 @@ cumulative task failures) — or itself halted — **halts** every
 downstream stage.  A halted stage's pending tasks defer at the gate
 with the halt reason; ``ServiceSpec.on_upstream_failure ==
 "rollback"`` additionally scales the stage to zero replicas so its
-running tasks drain.  Halt verdicts are sticky (operator action —
-a spec update bumping ``depends_on`` or clearing the upstream —
-restarts the pipeline by recreating the stage).
+running tasks drain.  Halt verdicts are sticky: the operator re-arms
+a halted stage with controlapi ``resume_pipeline`` after fixing the
+poison — the resume stamps a ``resumed_at`` watermark that forgives
+every failure observed at/before it (replicated ledger cleared in the
+resume transaction, leader-local ledgers dropped on seeing the fresh
+stamp, pre-watermark failed task rows skipped on re-scan).
 
 The loop is the established threadless-drivable FSM shape
 (orchestrator/autoscaler.py, update.py): production wraps one thread
@@ -75,6 +78,9 @@ class PipelineSupervisor:
         #: seen FAILED/REJECTED at least once (cumulative — a restarted
         #: slot failing again is a NEW task id, so flapping accrues)
         self._failed_seen: Dict[str, Set[str]] = {}
+        #: last ``resumed_at`` watermark acted on per service — a fresh
+        #: stamp (operator resume_pipeline) drops local observations
+        self._resume_seen: Dict[str, float] = {}
         self.stats = {"released": 0, "halted": 0, "rollbacks": 0}
 
     # --------------------------------------------------------------- running
@@ -150,12 +156,20 @@ class PipelineSupervisor:
         for svc in services:
             seen = self._failed_seen.setdefault(svc.id, set())
             st = svc.pipeline_status
+            watermark = st.resumed_at if st is not None else 0.0
+            if watermark and self._resume_seen.get(svc.id) != watermark:
+                # operator resume: observations predating the stamp are
+                # forgiven — drop the local ledger (the replicated one
+                # was cleared in the resume transaction)
+                self._resume_seen[svc.id] = watermark
+                seen.clear()
             if st is not None and st.failed_ids:
                 # a prior leader's (or our own committed) observations
                 seen.update(st.failed_ids)
             for t in by_service.get(svc.id, []):
                 if t.status.state in (TaskState.FAILED,
-                                      TaskState.REJECTED):
+                                      TaskState.REJECTED) \
+                        and t.status.timestamp > watermark:
                     seen.add(t.id)
             if svc.id in relevant:
                 have = set(st.failed_ids) if st is not None else set()
@@ -261,7 +275,8 @@ class PipelineSupervisor:
             cur = cur.copy()
             cur.pipeline_status = PipelineStatus(
                 state="released", reason="", updated_at=now(),
-                failed_ids=list(cur_st.failed_ids) if cur_st else [])
+                failed_ids=list(cur_st.failed_ids) if cur_st else [],
+                resumed_at=cur_st.resumed_at if cur_st else 0.0)
             tx.update(cur)
             state["written"] = True
 
@@ -287,7 +302,8 @@ class PipelineSupervisor:
             cur = cur.copy()
             cur.pipeline_status = PipelineStatus(
                 state="halted", reason=reason, updated_at=now(),
-                failed_ids=list(cur_st.failed_ids) if cur_st else [])
+                failed_ids=list(cur_st.failed_ids) if cur_st else [],
+                resumed_at=cur_st.resumed_at if cur_st else 0.0)
             if rollback and cur.spec.replicated is not None:
                 # rollback policy: drain the stage — the orchestrator
                 # shuts the running tasks down as replicas go to zero

@@ -185,7 +185,8 @@ class Scheduler:
                  max_latency: float = MAX_LATENCY,
                  pipeline_depth: Optional[int] = None,
                  preempt_budget: Optional[int] = None,
-                 preempt_cooldown: Optional[float] = None):
+                 preempt_cooldown: Optional[float] = None,
+                 tick_budget_s: Optional[float] = None):
         self.store = store
         # bounded-depth plan/commit software pipeline: while group i's
         # draft commits on the committer thread, group i+1's device plan
@@ -242,6 +243,18 @@ class Scheduler:
                                          cooldown=preempt_cooldown)
         self.preempt_enabled = \
             _os.environ.get("SWARM_PREEMPTION", "") != "0"
+
+        # overload protection: per-tick deadline budget (seconds).  A
+        # tick that exceeds it mid-walk commits what it planned CLEANLY
+        # and re-enqueues the remaining groups for the next tick —
+        # backlog converts to bounded per-tick latency instead of one
+        # unboundedly long tick that starves heartbeats and fan-out.
+        # Virtual-clock sims never trip it (the clock is frozen inside
+        # a control step), so sim runs stay byte-deterministic.
+        _budget = _os.environ.get("SWARM_TICK_BUDGET_S", "")
+        self.tick_budget_s = tick_budget_s if tick_budget_s is not None \
+            else (float(_budget) if _budget else None)
+        self._tick_deadline: Optional[float] = None
 
         # multi-tenant quota plane (scheduler/quota.py): admission-side
         # clamp + the host half of the quota mask column.  The filter
@@ -638,6 +651,8 @@ class Scheduler:
     def _tick_inner(self) -> int:
         t0 = now()
         self.stats["ticks"] += 1
+        self._tick_deadline = (t0 + self.tick_budget_s
+                               if self.tick_budget_s else None)
         # one reign per tick: every draft planned below commits under the
         # epoch read here or not at all (leadership-epoch fencing)
         self._tick_epoch = getattr(self.store._proposer,
@@ -773,7 +788,31 @@ class Scheduler:
             if t is not None and not t.node_id:
                 entries.append((task_priority(t), {t.id: t}))
         entries.sort(key=lambda e: -e[0])
-        for _, group in entries:
+        yielded = 0
+        for i, (_, group) in enumerate(entries):
+            # tick deadline budget: once over budget — and with at
+            # least one group yielded, so a single huge group still
+            # makes progress — the rest of the queue re-enqueues for
+            # the next tick and this tick commits partially.  The
+            # priority sort above means the deferral always lands on
+            # the LOWEST bands of this tick's queue.
+            if (self._tick_deadline is not None and yielded > 0
+                    and now() >= self._tick_deadline):
+                deferred = 0
+                for _, g in entries[i:]:
+                    for t in g.values():
+                        self._enqueue(t)
+                        deferred += 1
+                self.stats["partial_ticks"] = \
+                    self.stats.get("partial_ticks", 0) + 1
+                self.stats["deferred_tasks"] = \
+                    self.stats.get("deferred_tasks", 0) + deferred
+                _metrics.counter("swarm_scheduler_partial_ticks")
+                _planes.plane(_planes.SCHEDULER).defer(deferred)
+                log.info("tick budget %.3fs exceeded: %d tasks "
+                         "deferred to the next tick",
+                         self.tick_budget_s, deferred)
+                return
             # pipeline gate (scheduler/gang.py): a group whose service
             # awaits an upstream DAG stage defers before admission so
             # gated work never consumes quota or placement capacity
@@ -782,6 +821,7 @@ class Scheduler:
                 continue
             group = self._quota_admit(group, decisions)
             if group:
+                yielded += 1
                 yield group
 
     # -------------------------------------------------------- tenant quota

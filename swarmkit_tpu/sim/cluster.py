@@ -39,12 +39,13 @@ pure function of (scenario, seed).
 
 from __future__ import annotations
 
+import heapq
 import json
 import os
 from typing import Callable, Dict, List, Optional
 
 from ..manager.dispatcher import Config_ as DispatcherConfig, Dispatcher, \
-    DispatcherError
+    DispatcherError, ErrOverloaded
 from ..models import (
     Annotations, Node, NodeDescription, NodeSpec, NodeState, NodeStatus,
     ReplicatedService, Resources, Service, ServiceMode, ServiceSpec, Task,
@@ -63,7 +64,7 @@ from ..utils.identity import set_id_source
 from .engine import SimEngine
 from .faults import NetConfig, SimNetwork
 from .invariants import (
-    GangInvariants, PipelineInvariants,
+    GangInvariants, OverloadInvariants, PipelineInvariants,
     PreemptionInvariants, QosInvariants, RaftInvariants, ReadInvariants,
     TaskInvariants, UpdateInvariants, Violations,
     check_placement_quality, entry_digest,
@@ -372,6 +373,16 @@ class SimAgent:
         self._member_id: Optional[str] = None
         self._avoid: Dict[str, float] = {}
         self._fail_attempts = 0
+        # thundering-herd spread: after a session failure the NEXT
+        # re-registration waits out a seeded jittered window, so a
+        # leader failover doesn't re-register the whole fleet inside
+        # one heartbeat interval
+        self._reg_defer_until = 0.0
+        # admission-shed backoff: an ErrOverloaded status batch is
+        # re-queued client-side (level-triggered re-derive) behind a
+        # jittered window instead of hammering the saturated edge
+        self._shed_attempts = 0
+        self._send_defer_until = 0.0
         self._rng = cp.engine.fork_rng()
         self._schedule()
 
@@ -404,6 +415,9 @@ class SimAgent:
         d = cp.dispatcher
         if d is None:
             return   # no leader control plane right now (failover gap)
+        if self.session is None \
+                and self.engine.clock.elapsed() < self._reg_defer_until:
+            return   # spread re-registration phase after a failure
         drain = getattr(cp, "drain_deferred", None)
         if drain is not None:
             drain()   # never stage an RPC's write over a deferred backlog
@@ -421,6 +435,7 @@ class SimAgent:
                         resources=Resources(nano_cpus=8 * 10 ** 9,
                                             memory_bytes=32 << 30)))
                 self.engine.log(f"agent {self.node_id} registered")
+                self._fail_attempts = 0
             else:
                 d.heartbeat(self.node_id, self.session)
             # keep using the dispatcher captured above: the register/
@@ -428,13 +443,45 @@ class SimAgent:
             # the cp.dispatcher property would now be None — a stopped
             # dispatcher raises DispatcherError, which is handled
             self._advance_tasks(d)
+        except ErrOverloaded:
+            # admission shed at the session edge: the session (if any)
+            # is STILL VALID — back off and retry, don't re-register
+            self._note_shed(None)
         except AGENT_RPC_ERRORS:
             # an RPC failure — invalid session, dispatcher stopping, a
             # proposal fenced by leadership loss — drops the session;
-            # the agent re-registers with whoever leads next
+            # the agent re-registers with whoever leads next, behind a
+            # seeded jittered window (thundering-herd spread)
+            from ..remotes import backoff_with_jitter
             self.session = None
+            self._reg_defer_until = self.engine.clock.elapsed() + \
+                backoff_with_jitter(self._fail_attempts, self._rng,
+                                    base=0.25)
+            self._fail_attempts += 1
         finally:
             cp.busy = False
+
+    def _note_shed(self, updates) -> None:
+        """An ErrOverloaded from the dispatcher edge: the RPC was shed
+        by admission control, NOT a session failure.  Record what the
+        client observed (the overload invariants audit that every shed
+        is dispatcher-counted and every shed task recovers), then back
+        off behind the existing jittered-backoff seam — degraded is
+        never silently lossy: ``_advance_tasks`` is level-triggered
+        from committed rows, so the same updates re-derive and re-send
+        once the window passes."""
+        from ..remotes import backoff_with_jitter
+        t = self.engine.clock.elapsed()
+        delay = backoff_with_jitter(self._shed_attempts, self._rng,
+                                    base=0.5)
+        self._shed_attempts += 1
+        self._send_defer_until = t + delay
+        if self.session is None:
+            # a shed REGISTRATION: hold the retry too
+            self._reg_defer_until = t + delay
+        inv = getattr(self.cp, "overload_inv", None)
+        if inv is not None:
+            inv.note_client_shed(self.node_id, updates)
 
     # --------------------------------------------- follower-served mode
 
@@ -478,6 +525,9 @@ class SimAgent:
         from ..remotes import backoff_with_jitter, count_reconnect
         if cp.busy:
             return
+        if self.session is None \
+                and self.engine.clock.elapsed() < self._reg_defer_until:
+            return   # spread re-registration phase after a failure
         member = self._resolve_member()
         if member is None:
             return
@@ -510,6 +560,10 @@ class SimAgent:
                 d.heartbeat(self.node_id, self.session)
             cp.count_read(member)
             self._advance_tasks(d, store=member.store)
+        except ErrOverloaded:
+            # admission shed: the session stays valid, the member stays
+            # resolvable — back off, don't fail over
+            self._note_shed(None)
         except AGENT_RPC_ERRORS:
             # session failover: avoid THIS member for a jittered window
             # so the re-register lands on a different one
@@ -599,8 +653,15 @@ class SimAgent:
             updates.append((t.id, TaskStatus(
                 state=nxt, timestamp=now(), message="sim")))
         if updates:
+            if self.engine.clock.elapsed() < self._send_defer_until:
+                return   # shed backoff window: re-derive next step
             try:
                 d.update_task_status(self.node_id, self.session, updates)
+                self._shed_attempts = 0
+            except ErrOverloaded:
+                # the edge shed this batch whole: session stays valid,
+                # the level-triggered loop re-sends after the backoff
+                self._note_shed(updates)
             except AGENT_RPC_ERRORS:
                 self.session = None
 
@@ -621,6 +682,104 @@ class SimAgent:
         self.partitioned = on
         self.engine.log(f"fault agent-partition {self.node_id} "
                         f"{'on' if on else 'off'}")
+
+
+class _MuxAgent(SimAgent):
+    """One multiplexed session: full ``SimAgent`` semantics — register,
+    heartbeat, FSM advance, faults, follower failover — but NO private
+    engine timer.  The owning :class:`MuxAgentFleet`'s shared wheel
+    re-arms it after every step."""
+
+    def __init__(self, node_id: str, cp, fleet: "MuxAgentFleet",
+                 interval: float = 1.0):
+        # set BEFORE super().__init__: the base constructor calls
+        # _schedule(), which we route to the fleet's wheel
+        self._fleet = fleet
+        super().__init__(node_id, cp, interval=interval)
+
+    def _schedule(self) -> None:
+        # the same deterministic phase spread a solo agent gets, armed
+        # on the shared wheel instead of a per-agent engine timer
+        self._fleet._arm(self, self._rng.random() * self.interval)
+
+
+class MuxAgentFleet:
+    """The million-swarm harness (ISSUE 20 tentpole): thousands of
+    dispatcher sessions multiplexed over ONE engine timer, one due-heap
+    ("heartbeat wheel") and one per-tick RPC budget — the driver pops
+    due sessions, steps each through the ordinary ``SimAgent`` path,
+    and re-arms it at its own jittered cadence.  Sessions the budget
+    could not serve stay due and drain on the next tick: client-side
+    queueing IS the backpressure model, nothing is dropped.
+
+    Seed-deterministic by construction: each session forks its own RNG
+    from the engine tree (same draws as a solo agent), and the wheel
+    orders ties by a monotone sequence number.
+
+    Attach the fleet at scenario-setup time, BEFORE the run starts:
+    the first leader's bootstrap creates worker Node records for every
+    agent present on ``cp.agents`` at that moment.
+
+    ``stats`` exposes the knobs the overload tests pin:
+
+    * ``steps`` / ``driver_ticks`` — total sessions served / timer fires
+    * ``max_due_backlog`` — peak count of due-but-unserved sessions
+      right after a tick (the budget's queueing signal)
+    * ``max_concurrent_registrations`` — peak registrations inside one
+      driver tick; the thundering-herd test bounds this after a leader
+      failover (the agents' seeded re-registration jitter spreads it)
+    """
+
+    def __init__(self, cp, n_sessions: int, interval: float = 1.0,
+                 driver_interval: float = 0.25, rpc_budget: int = 256,
+                 prefix: str = "f"):
+        self.cp = cp
+        self.engine = cp.engine
+        self.interval = interval
+        self.driver_interval = driver_interval
+        self.rpc_budget = rpc_budget
+        self._wheel: List[tuple] = []   # (due, seq, agent)
+        self._seq = 0
+        self.stats = {"steps": 0, "driver_ticks": 0,
+                      "max_due_backlog": 0,
+                      "max_concurrent_registrations": 0}
+        self.agents: List[_MuxAgent] = [
+            _MuxAgent(f"{prefix}{i}", cp, self, interval=interval)
+            for i in range(n_sessions)]
+        cp.agents.extend(self.agents)
+        self.engine.every(driver_interval, "fleet driver", self._drive)
+
+    def _arm(self, agent: SimAgent, delay: float) -> None:
+        self._seq += 1
+        heapq.heappush(self._wheel,
+                       (self.engine.clock.elapsed() + delay,
+                        self._seq, agent))
+
+    def _drive(self):
+        if self.cp.stopped:
+            return False
+        self.stats["driver_ticks"] += 1
+        t = self.engine.clock.elapsed()
+        budget = self.rpc_budget
+        registrations = 0
+        while self._wheel and self._wheel[0][0] <= t and budget > 0:
+            _, _, a = heapq.heappop(self._wheel)
+            budget -= 1
+            had_session = a.session is not None
+            a.step()
+            self.stats["steps"] += 1
+            if a.session is not None and not had_session:
+                registrations += 1
+            # stepping may have pumped virtual time (a store write on
+            # this stack re-enters the engine); re-read the clock so the
+            # re-arm lands relative to NOW, not the tick's start
+            self._arm(a, a.interval * a.rate_scale)
+        if registrations > self.stats["max_concurrent_registrations"]:
+            self.stats["max_concurrent_registrations"] = registrations
+        backlog = sum(1 for e in self._wheel if e[0] <= t)
+        if backlog > self.stats["max_due_backlog"]:
+            self.stats["max_due_backlog"] = backlog
+        return None
 
 
 class SimRaftProposer:
@@ -1107,6 +1266,7 @@ class SimMemberControl:
             # must not grace-DOWN nodes that never register with it
             shard_filter=(lambda nid: False) if cp.follower_reads
             else None)
+        cp.apply_overload_seams(self.dispatcher)
         from ..manager.allocator import Allocator
         self.allocator = Allocator(store)
         self.restarts = RestartSupervisor(store, start_worker=False)
@@ -1117,7 +1277,8 @@ class SimMemberControl:
         self.scheduler = Scheduler(store, batch_planner=planner,
                                    pipeline_depth=1,
                                    preempt_budget=cp.preempt_budget,
-                                   preempt_cooldown=cp.preempt_cooldown)
+                                   preempt_cooldown=cp.preempt_cooldown,
+                                   tick_budget_s=cp.tick_budget_s)
         # checker-sensitivity seam: preemption off means a feasible
         # higher-priority task can starve — no-priority-inversion fires
         self.scheduler.preempt_enabled = cp.preemption_enabled
@@ -1549,7 +1710,28 @@ class RaftControlPlane:
         self.pipeline_expectations: List[tuple] = []
         #: preemption records archived from crash-replaced checkers
         self._preempt_archive: List[tuple] = []
-        self._dispatcher_totals = {"heartbeats": 0, "expirations": 0}
+        self._dispatcher_totals = {"heartbeats": 0, "expirations": 0,
+                                   "sheds": 0, "hb_stretches": 0,
+                                   "premature_expirations": 0}
+        # ---- overload-protection plane (ISSUE 20)
+        #: DispatcherConfig field overrides (max_sessions,
+        #: hb_stretch_start, max_pending_updates, max_terminal_tasks,
+        #: ...) applied to EVERY dispatcher the plane builds — the
+        #: leader's control dispatcher and the follower read planes
+        self.dispatcher_overrides: Dict[str, object] = {}
+        #: scheduler tick deadline budget (virtual seconds; None = off),
+        #: applied at (re)attach
+        self.tick_budget_s: Optional[float] = None
+        #: checker-sensitivity seam: False makes heartbeat-period
+        #: stretching promise a long window but enforce the UNstretched
+        #: deadline — heartbeat-liveness-under-stretch must fire
+        self.stretch_extends_deadline = True
+        #: checker-sensitivity seam: False sheds WITHOUT counting —
+        #: overload-sheds-are-counted-and-recovered must fire
+        self.count_sheds = True
+        self.overload_inv = OverloadInvariants(violations, self)
+        self._sheds_prev = 0
+        self._hb_stretches_prev = 0
         # ---- follower-served read plane (ISSUE 11)
         #: scenario knob: serve agent sessions + watch streams from the
         #: members' replicated stores (sharded by node-id hash), writes
@@ -1629,6 +1811,15 @@ class RaftControlPlane:
                 totals[k] += d.stats.get(k, 0)
         return totals
 
+    def apply_overload_seams(self, d: Dispatcher) -> None:
+        """Overload-plane knobs + checker-sensitivity seams, applied to
+        every dispatcher this plane builds (leader control plane and
+        follower read planes alike — bounds are plane-wide policy)."""
+        for k, v in self.dispatcher_overrides.items():
+            setattr(d.config, k, v)
+        d.stretch_extends_deadline = self.stretch_extends_deadline
+        d.count_sheds = self.count_sheds
+
     # ------------------------------------------- follower-served reads
 
     def enable_follower_reads(self) -> None:
@@ -1676,6 +1867,7 @@ class RaftControlPlane:
         # ANY member (ownership is control-plane-wide state)
         d.reg_grace_check = \
             lambda nid: self.session_owner.get(nid) is None
+        self.apply_overload_seams(d)
         d.run(start_worker=False)
         if os.environ.get("SWARM_BATCH_FANOUT", "1") != "0":
             # batched assignment fan-out is the DEFAULT consumer plane
@@ -1954,6 +2146,17 @@ class RaftControlPlane:
         if qc and not self._quota_clamps_prev:
             self.engine.log("fault quota-clamp scheduler")
         self._quota_clamps_prev = qc
+        # same honest-coverage pattern for the overload plane: the first
+        # ACTUAL admission shed / heartbeat stretch marks its cell
+        ds = self.dispatcher_stats
+        sheds = ds.get("sheds", 0)
+        if sheds and not self._sheds_prev:
+            self.engine.log("fault overload-shed dispatcher")
+        self._sheds_prev = sheds
+        stretches = ds.get("hb_stretches", 0)
+        if stretches and not self._hb_stretches_prev:
+            self.engine.log("fault heartbeat-stretch agent")
+        self._hb_stretches_prev = stretches
         return None
 
     # ----------------------------------------------- autoscaler + QoS
@@ -2066,8 +2269,10 @@ class RaftControlPlane:
         service, replicated to every member.  Idempotent — a retry after
         a dropped-but-committed proposal skips existing objects."""
         def cb(tx):
-            for i in range(self.n_agents):
-                nid = f"w{i}"
+            # every agent the scenario attached BEFORE first leadership
+            # — including a MuxAgentFleet's multiplexed sessions — gets
+            # its worker Node record here
+            for nid in [a.node_id for a in self.agents]:
                 if tx.get(Node, nid) is None:
                     tx.create(Node(
                         id=nid,
@@ -2493,6 +2698,10 @@ class RaftControlPlane:
                 f"{self.read_stats['probe_unavailable']} linearizable "
                 "read probe(s) failed outright under churn — reads must "
                 "degrade to read-index latency, never to errors")
+        # ---- overload-plane end checks (ISSUE 20): every client-observed
+        # shed is dispatcher-counted, every shed task recovered, and no
+        # node expired inside its promised heartbeat window
+        self.overload_inv.finalize()
 
 
 class Sim:
@@ -2720,4 +2929,18 @@ class Sim:
             reads["watch_hops"] = sum(
                 w.hops for w in self.cp.watchers)
             out["reads"] = reads
+            out["overload"] = {
+                "sheds": disp.get("sheds", 0),
+                "client_sheds": self.cp.overload_inv.client_sheds,
+                "shed_tasks": len(self.cp.overload_inv.shed_tasks),
+                "hb_stretches": disp.get("hb_stretches", 0),
+                "premature_expirations": disp.get(
+                    "premature_expirations", 0),
+            }
+            fleets = [a._fleet for a in self.cp.agents
+                      if isinstance(a, _MuxAgent)]
+            if fleets:
+                fleet = fleets[0]
+                out["fleet"] = dict(fleet.stats)
+                out["fleet"]["sessions"] = len(fleet.agents)
         return out

@@ -1315,6 +1315,93 @@ class ReadInvariants:
                     "or stale lease was honored")
 
 
+class OverloadInvariants:
+    """Overload-protection-plane invariants (ISSUE 20), judged at
+    scenario end against two ledgers:
+
+    * overload-sheds-are-counted-and-recovered — degraded is never
+      silently lossy.  Two halves: (1) every shed a CLIENT observed
+      (an ``ErrOverloaded`` on a registration or a status batch) must
+      be covered by the dispatcher-side shed ledger
+      (``stats["sheds"]`` accumulated across attach epochs and read
+      planes) — a shed the server didn't count is invisible to
+      operators; (2) every task whose status update was shed must
+      reach AT LEAST the shed state — or some terminal state, or be
+      deleted — in the authoritative store once load subsides: the
+      client's level-triggered re-derive plus the jittered backoff
+      must have recovered it.
+    * heartbeat-liveness-under-stretch — adaptive heartbeat-period
+      stretching may slow the cadence, but a node must NEVER be
+      expired inside the window the dispatcher PROMISED it (the
+      dispatcher counts such expiries as ``premature_expirations``;
+      only reachable with the ``stretch_extends_deadline`` seam off).
+    """
+
+    def __init__(self, violations: Violations, cp):
+        self.v = violations
+        self.cp = cp
+        #: sheds as the CLIENTS saw them: one per shed registration,
+        #: len(batch) per shed status batch
+        self.client_sheds = 0
+        #: task id -> highest shed state the client tried to report
+        self.shed_tasks: Dict[str, int] = {}
+
+    def note_client_shed(self, node_id: str, updates) -> None:
+        """Called by the agent the instant it catches ErrOverloaded.
+        ``updates`` is the shed (task_id, TaskStatus) batch, or None
+        for a shed registration."""
+        if updates is None:
+            self.client_sheds += 1
+            return
+        self.client_sheds += len(updates)
+        for tid, status in updates:
+            st = int(status.state)
+            if st > self.shed_tasks.get(tid, 0):
+                self.shed_tasks[tid] = st
+
+    def finalize(self) -> None:
+        counted = self.cp.dispatcher_stats.get("sheds", 0)
+        if self.client_sheds > counted:
+            self.v.record(
+                "overload-sheds-are-counted-and-recovered",
+                f"clients observed {self.client_sheds} admission sheds "
+                f"but the dispatcher ledger counted only {counted} — "
+                "degradation went silently unaccounted")
+        store = self.cp.store
+        if store is not None and self.shed_tasks:
+            rows = {t.id: t for t in store.view(
+                lambda tx: tx.find(Task))}
+            lost = []
+            for tid, shed_state in sorted(self.shed_tasks.items()):
+                t = rows.get(tid)
+                if t is None:
+                    continue   # reaped/removed: nothing to recover
+                got = int(t.status.state)
+                # recovered: the store caught up to (or past) what the
+                # client tried to report, or the task reached SOME
+                # terminal outcome that supersedes the shed report
+                if got >= shed_state or got > int(TaskState.RUNNING):
+                    continue
+                lost.append((tid, shed_state, got))
+            if lost:
+                tid, shed_state, got = lost[0]
+                self.v.record(
+                    "overload-sheds-are-counted-and-recovered",
+                    f"{len(lost)} shed status update(s) never recovered "
+                    f"after heal+grace — e.g. task {tid[:12]} was shed "
+                    f"reporting {TaskState(shed_state).name} but the "
+                    f"store still shows {TaskState(got).name}")
+        premature = self.cp.dispatcher_stats.get(
+            "premature_expirations", 0)
+        if premature:
+            self.v.record(
+                "heartbeat-liveness-under-stretch",
+                f"{premature} session(s) were expired INSIDE their "
+                "promised heartbeat window — the stretched period was "
+                "promised to the agent but not honored by the expiry "
+                "deadline")
+
+
 class WatchContinuity:
     """Reference ledger + judgment for ``watch-resume-no-gap-no-dup``.
 

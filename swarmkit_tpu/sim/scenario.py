@@ -1662,6 +1662,108 @@ def _read_storm_degraded(sim: Sim) -> float:
 _read_storm_degraded.raft_cp = True
 
 
+# ------------------------------------------- million-swarm harness
+#
+# ISSUE 20: overload-safe serving at fleet scale.  A MuxAgentFleet
+# multiplexes an env-scalable session count over one driver timer and
+# one RPC budget; the dispatcher runs with its overload-protection
+# bounds ON (session cap, adaptive heartbeat stretch, bounded status
+# buffer, counted assignment-set compaction) and the scenario drives a
+# full task fan-out through a leader crash, a follower-plane member
+# crash, and a drop burst.  Judged by the shared checkers plus
+# overload-sheds-are-counted-and-recovered and
+# heartbeat-liveness-under-stretch.
+
+
+def _million_swarm(sim: Sim) -> float:
+    """Full fan-out at fleet scale under overload bounds: the status
+    storm right after the fan-out overruns the bounded per-plane update
+    buffer (admission sheds — every one counted and recovered), the
+    session count runs past the stretch threshold (adaptive heartbeat
+    stretching — no premature expiry allowed), and the usual chaos
+    (leader crash, follower-plane crash, drop burst, fleet-agent churn)
+    rides on top.  Sessions/tasks scale via
+    ``SWARM_MILLION_SWARM_SESSIONS`` / ``SWARM_MILLION_SWARM_TASKS``
+    (defaults sized for the sweep; crank them for soak runs — the
+    event budget scales along)."""
+    from .cluster import MuxAgentFleet
+    eng = sim.engine
+    cp = sim.cp
+    sessions = int(os.environ.get("SWARM_MILLION_SWARM_SESSIONS", "64"))
+    fanout = int(os.environ.get("SWARM_MILLION_SWARM_TASKS", "150"))
+    eng.max_events = max(eng.max_events, sessions * 50_000)
+    cp.enable_follower_reads()
+    # overload-protection bounds, applied to every dispatcher the plane
+    # builds (leader + follower read planes).  The update-buffer bound
+    # sits well under the fan-out's per-window status arrivals, so the
+    # storm MUST shed; the session cap sits above the fleet, so steady
+    # registration stays admitted (register-path sheds are pinned by
+    # unit tests instead — a scenario-level cap would just park part of
+    # the fleet forever).
+    cp.dispatcher_overrides = {
+        "max_sessions": sessions + cp.n_agents + 8,
+        "hb_stretch_start": max(4, sessions // 8),
+        "hb_stretch_max": 4.0,
+        "max_pending_updates": max(12, fanout // 12),
+        "max_terminal_tasks": max(64, fanout),
+    }
+    # generous tick budget: the deadline plumbing runs live (virtual
+    # now() advances through each group's consensus commit) without
+    # starving convergence in the common case
+    cp.tick_budget_s = 1.5
+    fleet = MuxAgentFleet(cp, sessions, interval=1.0,
+                          driver_interval=0.25,
+                          rpc_budget=max(64, sessions // 2))
+    sim.start_raft_workload(interval=0.8)
+    cp.create_tasks(12)
+
+    # the fan-out: one burst to the full task count — the status storm
+    # in the following windows is the overload the plane must absorb
+    def fan_out():
+        eng.log("fault fan-out-burst dispatcher")
+        cp.scale(fanout)
+    eng.at(eng.clock.start + 8.0, "full fan-out", fan_out)
+
+    # leader crash AT full fan-out: the successor re-learns the fleet
+    def crash_leader():
+        m = sim.leader()
+        if m is None:
+            return
+        m.crash()
+        eng.after(6.0, "restart ex-leader", m.restart)
+    eng.at(eng.clock.start + 14.0, "crash leader at fan-out",
+           crash_leader)
+
+    # follower-plane failover: kill a member SERVING sessions — its
+    # shard re-registers across the survivors (jitter-spread, not a
+    # thundering herd)
+    def crash_follower():
+        lead = sim.leader()
+        victim = next((m for m in sim.managers
+                       if m.alive and m is not lead), None)
+        if victim is None:
+            return
+        victim.crash()
+        eng.after(8.0, "restart follower", victim.restart)
+    eng.at(eng.clock.start + 26.0, "crash follower plane",
+           crash_follower)
+
+    eng.at(eng.clock.start + 34.0, "drop burst",
+           lambda: setattr(sim.net.config, "drop_p", 0.12))
+    eng.at(eng.clock.start + 40.0, "drop off",
+           lambda: setattr(sim.net.config, "drop_p", 0.0))
+
+    # fleet-agent churn: a slice of sessions dies and returns
+    for i, (t_down, t_up) in enumerate(((20.0, 36.0), (30.0, 44.0))):
+        a = fleet.agents[i * 7]
+        eng.at(eng.clock.start + t_down, "fleet agent crash", a.crash)
+        eng.at(eng.clock.start + t_up, "fleet agent restart", a.restart)
+    return 60.0
+
+
+_million_swarm.raft_cp = True
+
+
 # ----------------------------------------------- rolling-update scenarios
 #
 # The UpdateSupervisor is live inside the raft-attached control plane
@@ -1934,6 +2036,8 @@ SCENARIOS: Dict[str, Callable[[Sim], float]] = {
     # follower-served read plane (read-index/lease reads, resume tokens)
     "follower-read-failover": _follower_read_failover,
     "read-storm-degraded": _read_storm_degraded,
+    # overload plane + mux-fleet harness (ISSUE 20)
+    "million-swarm": _million_swarm,
     # rolling-update suite (real UpdateSupervisor, threadless drive)
     "rolling-upgrade-chaos": _rolling_upgrade_chaos,
     "cascading-failure-rebalance": _cascading_failure_rebalance,
@@ -1973,6 +2077,9 @@ READ_SCENARIOS = ("follower-read-failover", "read-storm-degraded")
 #: streaming scheduler differential (ISSUE 14)
 STREAMING_SCENARIOS = ("steady-state-churn",)
 
+#: overload plane + million-swarm harness (ISSUE 20)
+OVERLOAD_SCENARIOS = ("million-swarm",)
+
 #: legacy fault timelines re-driven through Sim(raft_cp=True)
 LEGACY_RCP_SCENARIOS = (
     "partition-churn-rcp", "crash-restart-churn-rcp", "agent-storm-rcp",
@@ -1986,6 +2093,10 @@ LEGACY_RCP_SCENARIOS = (
 FUZZ_EXCLUDED: Dict[str, str] = {
     "long-soak": "minutes of virtual time per run; swept by the "
                  "dedicated slow soak test, not per-seed rotation",
+    "million-swarm": "heavyweight mux-fleet fan-out (an order of "
+                     "magnitude more events per run than the rotation "
+                     "scenarios); swept by its own chaos_sweep suite "
+                     "and the dedicated determinism test instead",
 }
 FUZZ_POOL: tuple = tuple(
     sorted(n for n in SCENARIOS if n not in FUZZ_EXCLUDED))

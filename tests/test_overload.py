@@ -1,0 +1,477 @@
+"""Overload-protection plane + million-swarm harness (ISSUE 20).
+
+Four claims are under test:
+
+1. bounded admission — the dispatcher's session cap, update-buffer
+   bound and terminal-assignment compaction each reject/evict at their
+   declared bound, count every shed, and never corrupt an admitted
+   session;
+2. adaptive stretching — past the session threshold the advertised
+   heartbeat period stretches (capped), and the expiry deadline honors
+   the stretched promise;
+3. graceful degradation end-to-end — the million-swarm scenario stays
+   green and byte-identical across seeds with both overload invariants
+   live, sheds exactly reconciled, and zero premature expirations;
+4. checker sensitivity — flipping each seam (count_sheds,
+   stretch_extends_deadline) makes the matching invariant fire, so a
+   green sweep reflects checker coverage, not blindness.
+"""
+
+import os
+
+import pytest
+
+from swarmkit_tpu.manager.dispatcher import (
+    Config_, Dispatcher, ErrOverloaded,
+)
+from swarmkit_tpu.models import (
+    Annotations, Cluster, Task, TaskState, TaskStatus,
+)
+from swarmkit_tpu.models.specs import ClusterSpec
+from swarmkit_tpu.models.types import now
+from swarmkit_tpu.scheduler import Scheduler
+from swarmkit_tpu.state import MemoryStore
+from swarmkit_tpu.utils import new_id
+
+from test_scheduler import make_ready_node, make_service_with_tasks
+
+
+@pytest.fixture
+def store():
+    s = MemoryStore()
+    cluster = Cluster(id=new_id(), spec=ClusterSpec(
+        annotations=Annotations(name="default")))
+    s.update(lambda tx: tx.create(cluster))
+    yield s
+    s.close()
+
+
+def overload_config(**kw):
+    defaults = dict(heartbeat_period=5.0, heartbeat_epsilon=0.0,
+                    grace_multiplier=3.0, rate_limit_period=0.0)
+    defaults.update(kw)
+    return Config_(**defaults)
+
+
+def _mk_nodes(store, n):
+    nodes = [make_ready_node(f"n{i:04d}") for i in range(n)]
+    def setup(tx):
+        for nd in nodes:
+            tx.create(nd)
+    store.update(setup)
+    return nodes
+
+
+def _mk_assigned_tasks(store, node_id, n, state=TaskState.ASSIGNED):
+    svc, tasks = make_service_with_tasks(n)
+    def setup(tx):
+        tx.create(svc)
+        for t in tasks:
+            t.node_id = node_id
+            t.status = TaskStatus(state=state, timestamp=now())
+            tx.create(t)
+    store.update(setup)
+    return tasks
+
+
+# ------------------------------------------------- bounded admission
+
+def test_register_shed_at_session_cap(store):
+    """The session bound sheds NEW nodes (counted), while an already-
+    registered node's re-registration stays admitted at the cap: the
+    bound limits concurrent sessions, it never evicts a live one."""
+    nodes = _mk_nodes(store, 5)
+    d = Dispatcher(store, overload_config(max_sessions=4))
+    d.run(start_worker=False)
+    try:
+        for nd in nodes[:4]:
+            d.register(nd.id)
+        with pytest.raises(ErrOverloaded):
+            d.register(nodes[4].id)
+        assert d.stats["sheds"] == 1
+        # the cap bounds sessions, not re-registrations
+        sid, _period = d.register(nodes[0].id)
+        assert sid
+        assert d.stats["sheds"] == 1
+    finally:
+        d.stop()
+
+
+def test_update_batch_shed_whole_counted_and_recoverable(store):
+    """A status batch that would overflow max_pending_updates is shed
+    WHOLE with ErrOverloaded: the shed is counted, already-buffered
+    updates survive untouched, the session stays valid, and the same
+    batch lands after a flush drains the buffer — degraded, never
+    silently lossy."""
+    (node,) = _mk_nodes(store, 1)
+    tasks = _mk_assigned_tasks(store, node.id, 12)
+    d = Dispatcher(store, overload_config(
+        max_pending_updates=8, max_batch_items=1000))
+    d.run(start_worker=False)
+    try:
+        sid, _ = d.register(node.id)
+        ups = lambda ts: [(t.id, TaskStatus(state=TaskState.RUNNING,
+                                            message="started",
+                                            timestamp=now()))
+                          for t in ts]
+        d.update_task_status(node.id, sid, ups(tasks[:6]))
+        assert len(d._task_updates) == 6
+        with pytest.raises(ErrOverloaded):
+            d.update_task_status(node.id, sid, ups(tasks[6:12]))
+        assert d.stats["sheds"] == 6
+        assert len(d._task_updates) == 6      # admitted work untouched
+        # rewrites of already-buffered tasks never grow the buffer and
+        # always land, even at the bound
+        d.update_task_status(node.id, sid, ups(tasks[:6]))
+        # the session survived the shed: heartbeat + retry both work
+        d.heartbeat(node.id, sid)
+        d._flush_updates()
+        d.update_task_status(node.id, sid, ups(tasks[6:12]))
+        d._flush_updates()
+        running = [t for t in store.view(lambda tx: tx.find(Task))
+                   if t.status.state == TaskState.RUNNING]
+        assert len(running) == 12             # recovery is total
+        assert d.stats["sheds"] == 6          # and exactly counted
+    finally:
+        d.stop()
+
+
+def test_heartbeat_stretch_engages_and_extends_promise(store):
+    """Past hb_stretch_start sessions the advertised period stretches
+    linearly (capped at hb_stretch_max) and every stretched advertisement
+    is counted; the expiry deadline extends with the stretched promise
+    so slowing down can never expire a compliant agent early."""
+    nodes = _mk_nodes(store, 8)
+    d = Dispatcher(store, overload_config(
+        hb_stretch_start=4, hb_stretch_max=3.0))
+    d.run(start_worker=False)
+    try:
+        sids = {}
+        for nd in nodes[:4]:
+            sids[nd.id] = d.register(nd.id)[0]
+        assert d._stretch_factor() == 1.0
+        p0 = d.heartbeat(nodes[0].id, sids[nodes[0].id])
+        assert p0 == pytest.approx(5.0)
+        for nd in nodes[4:]:
+            sids[nd.id] = d.register(nd.id)[0]
+        assert d._stretch_factor() == pytest.approx(2.0)
+        before = d.stats["hb_stretches"]
+        p1 = d.heartbeat(nodes[0].id, sids[nodes[0].id])
+        assert p1 == pytest.approx(10.0)      # 5.0 x stretch 2.0
+        assert d.stats["hb_stretches"] > before
+        # the deadline honors the stretched promise: window = period,
+        # not period/stretch
+        rn = d._nodes[nodes[0].id]
+        assert rn.deadline == pytest.approx(now() + p1 * 3.0, abs=0.2)
+        assert rn.promised_until == pytest.approx(rn.deadline, abs=0.2)
+        assert d.stats["premature_expirations"] == 0
+    finally:
+        d.stop()
+
+
+# -------------------------------------- batched fan-out memory bounds
+
+def test_fanout_terminal_compaction_bounds_memory(store):
+    """Terminal tasks beyond max_terminal_tasks are compacted out of the
+    per-node assignment set as explicit removes: set memory stays
+    O(assigned + bound) under churn instead of O(task history), and
+    every eviction lands in the shared compaction counter."""
+    (node,) = _mk_nodes(store, 1)
+    tasks = _mk_assigned_tasks(store, node.id, 40)
+    d = Dispatcher(store, overload_config(max_terminal_tasks=8))
+    d.run(start_worker=False)
+    fan = d.enable_batched_fanout()
+    try:
+        sid, _ = d.register(node.id)
+        stream = fan.open(node.id, sid)
+        first = stream.get(timeout=0)
+        assert first.type == first.COMPLETE
+        assert len(first.changes) == 40
+        # churn: 30 of the 40 finish (terminal > RUNNING)
+        def finish(tx):
+            for t in tasks[:30]:
+                t2 = tx.get(Task, t.id).copy()
+                t2.status = TaskStatus(state=TaskState.COMPLETE,
+                                       timestamp=now())
+                tx.update(t2)
+        store.update(finish)
+        fan.flush()
+        aset = fan._sets[node.id]
+        assert fan.stats["compactions"] >= 22     # 30 terminal - bound 8
+        assert len(aset._terminal) <= 8
+        # O(assigned + bound): 10 live + <= 8 retained terminal
+        assert len(aset.tasks) <= 18
+    finally:
+        d.stop()
+
+
+def test_fanout_open_after_leader_gap_at_1k_sessions():
+    """A re-elected leader's fresh dispatcher rebuilds every assignment
+    stream from the store view: after a full leader gap, 1000 re-opened
+    sessions each receive a COMPLETE set carrying exactly their node's
+    assignments — nothing lost, nothing duplicated, and the rebuilt
+    fan-out state stays O(assigned) per node."""
+    s = MemoryStore()
+    try:
+        s.update(lambda tx: tx.create(Cluster(
+            id=new_id(),
+            spec=ClusterSpec(annotations=Annotations(name="default")))))
+        n_nodes = 1000
+        nodes = _mk_nodes(s, n_nodes)
+        svc, tasks = make_service_with_tasks(2 * n_nodes)
+        def setup(tx):
+            tx.create(svc)
+            for i, t in enumerate(tasks):
+                t.node_id = nodes[i % n_nodes].id
+                t.status = TaskStatus(state=TaskState.ASSIGNED,
+                                      timestamp=now())
+                tx.create(t)
+        s.update(setup)
+
+        def fleet_register(d, fan):
+            sids = {nd.id: d.register(nd.id)[0] for nd in nodes}
+            streams = {nid: fan.open(nid, sid)
+                       for nid, sid in sids.items()}
+            return sids, streams
+
+        d1 = Dispatcher(s, overload_config(max_sessions=n_nodes + 8))
+        d1.run(start_worker=False)
+        fan1 = d1.enable_batched_fanout()
+        _, streams1 = fleet_register(d1, fan1)
+        assert fan1.stats["complete_sends"] == n_nodes
+        d1.stop()                      # the leader gap
+
+        d2 = Dispatcher(s, overload_config(max_sessions=n_nodes + 8))
+        d2.run(start_worker=False)
+        fan2 = d2.enable_batched_fanout()
+        try:
+            _, streams2 = fleet_register(d2, fan2)
+            for nid, stream in streams2.items():
+                msg = stream.get(timeout=0)
+                assert msg.type == msg.COMPLETE
+                got = sorted(c[2].id for c in msg.changes)
+                want = sorted(t.id for t in tasks if t.node_id == nid)
+                assert got == want
+                assert len(fan2._sets[nid].tasks) == 2  # O(assigned)
+        finally:
+            d2.stop()
+    finally:
+        s.close()
+
+
+# --------------------------------------- scheduler tick deadline budget
+
+def test_scheduler_partial_tick_commits_cleanly():
+    """A tick that overruns tick_budget_s commits the groups it already
+    planned, defers the rest intact (counted), and later ticks finish
+    the backlog: partial progress, no lost or double-planned task."""
+    s = MemoryStore()
+    try:
+        nodes = [make_ready_node(f"n{i}", cpus=64) for i in range(4)]
+        services = [make_service_with_tasks(6) for _ in range(5)]
+        def setup(tx):
+            for nd in nodes:
+                tx.create(nd)
+            for svc, tasks in services:
+                tx.create(svc)
+                for t in tasks:
+                    tx.create(t)
+        s.update(setup)
+        sched = Scheduler(s, tick_budget_s=1e-9)
+        s.view(sched._setup_tasks_list)
+        n1 = sched.tick()
+        assert 0 < n1 < 30          # partial: progress, not the world
+        assert sched.stats["partial_ticks"] == 1
+        assert sched.stats["deferred_tasks"] == 30 - n1
+        total = n1
+        for _ in range(10):
+            if total >= 30:
+                break
+            total += sched.tick()
+        assert total == 30
+        assigned = [t for t in s.view(lambda tx: tx.find(Task))
+                    if t.status.state == TaskState.ASSIGNED
+                    and t.node_id]
+        assert len(assigned) == 30   # nothing lost, nothing doubled
+    finally:
+        s.close()
+
+
+# --------------------------------------------------- health conditions
+
+def test_health_dispatcher_overload_condition():
+    """warn while sheds are actively counted, fail only on sustained
+    strict growth, pass/None before the overload plane exports."""
+    from swarmkit_tpu.obs.health import dispatcher_overload_value
+    from swarmkit_tpu.utils.metrics import Registry
+    reg = Registry()
+    get = dispatcher_overload_value(n=3)
+    assert get(reg) is None                      # plane not exporting
+    reg.counter("swarm_dispatcher_sheds", 5)
+    assert get(reg) == 0.0                       # first sample: baseline
+    reg.counter("swarm_dispatcher_sheds", 5)
+    assert get(reg) == 1.0                       # growing: warn
+    assert get(reg) == 0.0                       # flat: recovered
+    reg.counter("swarm_dispatcher_sheds", 5)
+    assert get(reg) == 1.0
+    reg.counter("swarm_dispatcher_sheds", 5)
+    assert get(reg) == 2.0     # strict growth across the window: fail
+
+
+def test_health_heartbeat_stretch_condition():
+    """fail the instant a premature expiration is counted; warn while
+    the advertised stretch is material; pass otherwise."""
+    from swarmkit_tpu.obs.health import heartbeat_stretch_value
+    from swarmkit_tpu.utils.metrics import Registry
+    reg = Registry()
+    get = heartbeat_stretch_value(stretch_warn=2.0)
+    assert get(reg) is None
+    reg.gauge("swarm_dispatcher_hb_stretch", 1.2)
+    assert get(reg) == 0.0
+    reg.gauge("swarm_dispatcher_hb_stretch", 2.5)
+    assert get(reg) == 1.0
+    reg.counter("swarm_dispatcher_premature_expirations")
+    assert get(reg) == 2.0     # a broken promise is an instant fail
+
+
+# ------------------------------------------------ controlapi: resume
+
+def test_resume_pipeline_errors_and_success(store):
+    """resume_pipeline's exact error surface, and the success path:
+    halted -> waiting with a fresh resumed_at watermark, poison ledger
+    cleared on the stage AND its direct upstreams."""
+    from swarmkit_tpu.manager.controlapi import (
+        ControlAPI, FailedPrecondition, NotFound,
+    )
+    from swarmkit_tpu.models.objects import PipelineStatus
+
+    api = ControlAPI(store)
+    with pytest.raises(NotFound):
+        api.resume_pipeline("nope")
+
+    plain, _ = make_service_with_tasks(1)
+    up, _ = make_service_with_tasks(1)
+    stage, _ = make_service_with_tasks(1)
+    stage.spec.depends_on = [up.spec.annotations.name]
+    stage.pipeline_status = PipelineStatus(
+        state="halted", reason="poisoned", updated_at=now(),
+        failed_ids=["t1", "t2"])
+    up.pipeline_status = PipelineStatus(
+        state="ready", reason="", updated_at=now(), failed_ids=["t0"])
+    def setup(tx):
+        for svc in (plain, up, stage):
+            tx.create(svc)
+    store.update(setup)
+
+    with pytest.raises(FailedPrecondition):
+        api.resume_pipeline(plain.id)      # not a pipeline stage
+    with pytest.raises(FailedPrecondition):
+        api.resume_pipeline(up.id)         # upstream isn't halted
+    got = api.resume_pipeline(stage.id)
+    st = got.pipeline_status
+    assert st.state == "waiting"
+    assert st.failed_ids == [] and st.resumed_at is not None
+    up2 = store.view(lambda tx: tx.get(type(up), up.id))
+    assert up2.pipeline_status.state == "ready"     # state untouched
+    assert up2.pipeline_status.failed_ids == []     # poison forgiven
+    assert up2.pipeline_status.resumed_at == st.resumed_at
+
+
+# --------------------------------- million-swarm scenario + sensitivity
+
+def _small_swarm_env(monkeypatch, sessions=32, tasks=100):
+    monkeypatch.setenv("SWARM_MILLION_SWARM_SESSIONS", str(sessions))
+    monkeypatch.setenv("SWARM_MILLION_SWARM_TASKS", str(tasks))
+
+
+def test_million_swarm_green_and_deterministic(monkeypatch):
+    """The flagship overload scenario: full fan-out + leader crash +
+    follower crash + drop burst + fleet churn over a mux fleet, green
+    with both overload invariants live — sheds exactly reconciled
+    against what clients observed, stretching engaged, zero premature
+    expirations — and byte-identical on replay."""
+    from swarmkit_tpu.sim import run_scenario
+    _small_swarm_env(monkeypatch)
+    a = run_scenario("million-swarm", seed=3)
+    assert a.ok, a.violations
+    ovl = a.stats["overload"]
+    assert ovl["sheds"] > 0                       # the storm really shed
+    assert ovl["sheds"] == ovl["client_sheds"]    # ledger reconciles
+    assert ovl["hb_stretches"] > 0                # stretching engaged
+    assert ovl["premature_expirations"] == 0      # promises honored
+    assert a.stats["fleet"]["sessions"] == 32
+    assert a.stats["fleet"]["max_concurrent_registrations"] < 32
+    b = run_scenario("million-swarm", seed=3)
+    assert (a.trace_hash, a.obs_trace_sha256) \
+        == (b.trace_hash, b.obs_trace_sha256)
+
+
+def _run_seeded_swarm(seed, flip):
+    """Run million-swarm manually with a seam flipped pre-attach."""
+    from swarmkit_tpu.sim.cluster import Sim
+    from swarmkit_tpu.sim.faults import NetConfig
+    from swarmkit_tpu.sim.scenario import SCENARIOS
+    sim = Sim(seed, net_config=NetConfig(), raft_cp=True)
+    with sim:
+        flip(sim.cp)
+        duration = SCENARIOS["million-swarm"](sim)
+        sim.run(duration)
+        sim.finish(grace=20.0)
+    return sim
+
+
+def test_checker_fires_when_sheds_go_uncounted(monkeypatch):
+    """Seam: shed WITHOUT counting (the silent-loss bug).  The
+    overload-sheds-are-counted-and-recovered invariant must flag the
+    client-observed sheds the dispatcher ledger never covered —
+    proving a green sweep reflects checker sensitivity."""
+    _small_swarm_env(monkeypatch)
+    def flip(cp):
+        cp.count_sheds = False
+    sim = _run_seeded_swarm(3, flip)
+    assert any("overload-sheds-are-counted-and-recovered" in v
+               for v in sim.violations.items), (
+        "checker failed to flag uncounted sheds:\n"
+        + "\n".join(sim.violations.items[:5]))
+
+
+def test_checker_fires_on_broken_stretch_promise(monkeypatch):
+    """Seam: advertise the stretched period but enforce the UNstretched
+    expiry deadline.  A fleet agent that crashes for less than its
+    promised window gets expired inside the promise — the
+    heartbeat-liveness-under-stretch invariant must fire."""
+    _small_swarm_env(monkeypatch)
+    def flip(cp):
+        cp.stretch_extends_deadline = False
+    sim = _run_seeded_swarm(3, flip)
+    assert any("heartbeat-liveness-under-stretch" in v
+               for v in sim.violations.items), (
+        "checker failed to flag the premature expiry:\n"
+        + "\n".join(sim.violations.items[:5]))
+
+
+def test_mux_fleet_thundering_herd_bounded(monkeypatch):
+    """Satellite: a leader failover must NOT re-register the whole
+    fleet inside one driver tick — each agent's seeded re-registration
+    jitter spreads the herd, pinned by the fleet's own peak counter."""
+    from swarmkit_tpu.sim.cluster import MuxAgentFleet, Sim
+    from swarmkit_tpu.sim.faults import NetConfig
+    n = 32
+    sim = Sim(19, net_config=NetConfig(), raft_cp=True)
+    with sim:
+        eng = sim.engine
+        fleet = MuxAgentFleet(sim.cp, n, interval=1.0,
+                              driver_interval=0.25, rpc_budget=64)
+        sim.run(12.0)          # elect, bootstrap, register the fleet
+        lead = sim.leader()
+        assert lead is not None
+        lead.crash()
+        eng.after(5.0, "restart ex-leader", lead.restart)
+        sim.run(25.0)          # failover + full re-registration wave
+        sim.finish(grace=20.0)
+    assert sim.violations.items == []
+    assert fleet.stats["steps"] > 0
+    herd = fleet.stats["max_concurrent_registrations"]
+    assert 1 <= herd < n, (
+        f"failover re-registered {herd}/{n} sessions in one driver "
+        "tick: the jitter spread collapsed")

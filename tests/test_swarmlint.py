@@ -50,6 +50,9 @@ FIXTURES = {
                            "swarmkit_tpu/ops/fixture.py"),
     "metric-hygiene": ("metrics_bad.py", "metrics_good.py",
                        "swarmkit_tpu/obs/fixture.py"),
+    "backpressure-discipline": ("backpressure_bad.py",
+                                "backpressure_good.py",
+                                "swarmkit_tpu/manager/fixture.py"),
 }
 
 
@@ -130,6 +133,12 @@ def test_rule_passes_clean_twin(rule):
     #                            (ISSUE 17): per-entity task= / node_id=
     #                            / session= label keys, one series per
     #                            entity
+    ("backpressure-discipline", 4),  # ISSUE 20 overload plane: RPC-edge
+    #                            list.append, heartbeat residue into an
+    #                            unbounded deque, heappush admission
+    #                            wheel, scheduler _enqueue batch extend
+    #                            — each without a declared bound or a
+    #                            counted shed
 ])
 def test_rule_sensitivity_floor(rule, min_findings):
     bad, _good, relpath = FIXTURES[rule]
